@@ -1,0 +1,36 @@
+"""horovod_tpu — a TPU-native distributed training framework.
+
+A from-scratch rebuild of Horovod's capabilities (reference:
+mackrorysd/horovod) designed TPU-first:
+
+* The **data plane** is XLA collectives (``psum``/``all_gather``/
+  ``psum_scatter``/``all_to_all``/``ppermute``) over a
+  ``jax.sharding.Mesh`` riding TPU ICI/DCN — not NCCL/MPI/Gloo
+  (reference: ``horovod/common/ops/nccl_operations.cc``).
+* The **control plane** (which named tensors are ready on every rank,
+  fusion, response caching, stall detection, timelines) is a native C++
+  coordination core with a background cycle thread, mirroring the
+  reference runtime (``horovod/common/operations.cc:353``) but speaking
+  a TCP controller protocol instead of MPI.
+* Framework shims (``DistributedOptimizer`` for PyTorch and Optax,
+  gradient-transform analogs of ``DistributedGradientTape``) keep the
+  product surface of ``horovod.torch`` / ``horovod.tensorflow``.
+
+Two API tiers:
+
+1. :mod:`horovod_tpu.ops` — pure functional collectives usable inside
+   ``jit``/``shard_map`` (the TPU-idiomatic SPMD surface).
+2. The eager, named-tensor API on this module (``hvd.init()``,
+   ``hvd.allreduce(t, name=...)``) with Horovod's process-rank
+   semantics, negotiated by the native core.
+"""
+
+__version__ = "0.1.0"
+
+from horovod_tpu.common.exceptions import (  # noqa: F401
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+)
+from horovod_tpu.common.ops_enum import (  # noqa: F401
+    Average, Sum, Min, Max, Product, Adasum, ReduceOp,
+)

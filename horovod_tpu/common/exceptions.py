@@ -1,0 +1,30 @@
+"""Framework exceptions.
+
+Mirrors the reference's ``horovod/common/exceptions.py``: a failed
+collective raises :class:`HorovodInternalError` (caught by the elastic
+runner to trigger state restore + re-rendezvous), and a host-membership
+change surfaces as :class:`HostsUpdatedInterrupt` at commit points
+(reference ``horovod/common/elastic.py:60-96``).
+"""
+
+
+class HorovodInternalError(RuntimeError):
+    """Internal error raised when a collective operation fails.
+
+    Under elastic training this is recoverable: state is restored from
+    the last commit and the job re-rendezvouses.
+    """
+
+
+class HostsUpdatedInterrupt(Exception):
+    """Raised asynchronously (at commit/sync points) when the set of
+    available hosts changed and the job should re-initialize."""
+
+    def __init__(self, skip_sync: bool = False):
+        super().__init__()
+        self.skip_sync = skip_sync
+
+
+class TensorShapeError(ValueError):
+    """Cross-rank tensor shape/dtype mismatch detected by the controller
+    (reference ``controller.cc:471-748`` produces an ERROR response)."""

@@ -1,0 +1,24 @@
+"""Reduction op constants.
+
+Reference: ``horovod/common/basics.py`` exposes Average/Sum/Adasum;
+``horovod/common/message.h`` carries the reduce op on the wire. We add
+Min/Max/Product which XLA provides for free (``lax.pmin``/``pmax``)."""
+
+import enum
+
+
+class ReduceOp(enum.IntEnum):
+    AVERAGE = 0
+    SUM = 1
+    ADASUM = 2
+    MIN = 3
+    MAX = 4
+    PRODUCT = 5
+
+
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Adasum = ReduceOp.ADASUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+Product = ReduceOp.PRODUCT

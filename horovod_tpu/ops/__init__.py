@@ -1,0 +1,11 @@
+from horovod_tpu.ops.collectives import (  # noqa: F401
+    allreduce,
+    grouped_allreduce,
+    allgather,
+    broadcast,
+    alltoall,
+    reducescatter,
+    ring_permute,
+    axis_rank,
+    axis_size,
+)

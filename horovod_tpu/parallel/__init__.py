@@ -1,0 +1,8 @@
+from horovod_tpu.parallel.mesh import (  # noqa: F401
+    MeshSpec,
+    build_mesh,
+    data_parallel_mesh,
+    current_mesh,
+    set_current_mesh,
+    mesh_scope,
+)

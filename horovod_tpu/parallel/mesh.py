@@ -1,0 +1,148 @@
+"""Device-mesh management.
+
+The TPU analog of Horovod's communicator setup: where the reference
+derives MPI global/local/cross communicators
+(``horovod/common/mpi/mpi_context.{h,cc}``) and lazily creates NCCL
+communicators per device-map (``ops/nccl_operations.cc:61-94``), a
+TPU-native framework expresses parallelism as a named
+``jax.sharding.Mesh`` over the PJRT device grid; XLA then lowers
+``psum``/``all_gather``/... onto ICI rings/tori per mesh axis.
+
+Canonical axis names (used throughout the framework):
+
+* ``dp``  — data parallel (gradient allreduce rides here)
+* ``fsdp``— fully-sharded data parallel (param allgather / grad
+  reduce-scatter)
+* ``tp``  — tensor (model) parallel
+* ``sp``  — sequence/context parallel (ring attention / Ulysses)
+* ``pp``  — pipeline parallel
+* ``ep``  — expert parallel (MoE all_to_all)
+
+Axes the caller does not mention get size 1, so a single mesh shape is
+usable by every layer of the stack.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import math
+import threading
+from typing import Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "fsdp", "pp", "sp", "tp", "ep")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh shape; ``-1`` on at most one axis means "all
+    remaining devices" (like a reshape wildcard)."""
+
+    dp: int = 1
+    fsdp: int = 1
+    pp: int = 1
+    sp: int = 1
+    tp: int = 1
+    ep: int = 1
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        sizes = {a: getattr(self, a) for a in AXES}
+        bad = {a: s for a, s in sizes.items() if s < 1 and s != -1}
+        if bad:
+            raise ValueError(
+                f"mesh axis sizes must be >= 1 (or exactly -1 for wildcard); got {bad}")
+        wild = [a for a, s in sizes.items() if s == -1]
+        if len(wild) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}")
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {fixed} devices but {n_devices} are available")
+        return MeshSpec(**sizes)
+
+    def axis_sizes(self) -> Mapping[str, int]:
+        return {a: getattr(self, a) for a in AXES}
+
+
+def build_mesh(spec: Optional[MeshSpec] = None,
+               devices: Optional[Sequence[jax.Device]] = None,
+               **axis_sizes: int) -> Mesh:
+    """Build a named Mesh over ``devices`` (default: all).
+
+    ``build_mesh(dp=2, tp=4)`` or ``build_mesh(MeshSpec(dp=-1))``.
+
+    Axis order is fixed (dp, fsdp, pp, sp, tp, ep) — outermost axes map
+    to the slowest-varying device dimension so that ``tp``/``sp``
+    (latency-sensitive, every-layer collectives) land on adjacent ICI
+    neighbors while ``dp`` (once-per-step allreduce) spans the longer
+    paths, the standard TPU layout recipe.
+    """
+    if spec is None:
+        spec = MeshSpec(**axis_sizes)
+    elif axis_sizes:
+        raise ValueError("pass either a MeshSpec or axis kwargs, not both")
+    if devices is None:
+        devices = jax.devices()
+    spec = spec.resolve(len(devices))
+    sizes = spec.axis_sizes()
+    grid = np.asarray(devices, dtype=object).reshape([sizes[a] for a in AXES])
+    return Mesh(grid, AXES)
+
+
+def data_parallel_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Pure-DP mesh over every device — the Horovod default world."""
+    return build_mesh(MeshSpec(dp=-1), devices=devices)
+
+
+# ---------------------------------------------------------------------------
+# Current-mesh registry (thread-local with a process-global default).
+# ---------------------------------------------------------------------------
+
+_state = threading.local()
+_default_mesh: Optional[Mesh] = None
+_default_lock = threading.Lock()
+
+
+def set_current_mesh(mesh: Optional[Mesh]) -> None:
+    global _default_mesh
+    with _default_lock:
+        _default_mesh = mesh
+
+
+def current_mesh() -> Mesh:
+    m = getattr(_state, "mesh", None)
+    if m is not None:
+        return m
+    global _default_mesh
+    with _default_lock:
+        if _default_mesh is None:
+            _default_mesh = data_parallel_mesh()
+        return _default_mesh
+
+
+@contextlib.contextmanager
+def mesh_scope(mesh: Mesh):
+    prev = getattr(_state, "mesh", None)
+    _state.mesh = mesh
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        _state.mesh = prev
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def sharded(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))

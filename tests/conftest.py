@@ -1,0 +1,41 @@
+"""Test fixtures: force an 8-device virtual CPU platform BEFORE jax
+import so every test can exercise real mesh shardings without TPU
+hardware (the driver's dryrun does the same trick)."""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("HOROVOD_LOG_LEVEL", "warning")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# The container's sitecustomize registers the TPU PJRT plugin and pins
+# JAX_PLATFORMS before we run; the config update reliably forces CPU.
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def mesh8(devices):
+    from horovod_tpu.parallel import build_mesh
+    return build_mesh(dp=8)
+
+
+@pytest.fixture()
+def mesh2x4(devices):
+    from horovod_tpu.parallel import build_mesh
+    return build_mesh(dp=2, tp=4)
