@@ -34,3 +34,34 @@ from horovod_tpu.common.exceptions import (  # noqa: F401
 from horovod_tpu.common.ops_enum import (  # noqa: F401
     Average, Sum, Min, Max, Product, Adasum, ReduceOp,
 )
+from horovod_tpu.api import (  # noqa: F401
+    init,
+    shutdown,
+    is_initialized,
+    rank,
+    size,
+    local_rank,
+    local_size,
+    cross_rank,
+    cross_size,
+    allreduce,
+    allreduce_async,
+    grouped_allreduce,
+    grouped_allreduce_async,
+    allgather,
+    allgather_async,
+    broadcast,
+    broadcast_async,
+    alltoall,
+    alltoall_async,
+    reducescatter,
+    reducescatter_async,
+    join,
+    barrier,
+    synchronize,
+    poll,
+    mpi_threads_supported,
+    start_timeline,
+    stop_timeline,
+)
+from horovod_tpu.compression import Compression  # noqa: F401

@@ -1,0 +1,240 @@
+"""Public eager named-tensor API.
+
+Mirrors the product surface of ``horovod.torch.mpi_ops`` /
+``horovod.common.basics`` (reference ``torch/mpi_ops.py:95-882``,
+``common/basics.py:33-288``): ``init``/``rank``/``size``, sync and
+async (`*_async` + ``synchronize``/``poll``) variants of every
+collective, Join, and barrier — with the data plane re-targeted to TPU
+(XLA programs for device tensors, native TCP for host tensors).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from horovod_tpu.common import basics
+from horovod_tpu.common.ops_enum import Average, ReduceOp, Sum
+from horovod_tpu.common.topology import Topology
+from horovod_tpu.runtime import Handle, get_runtime
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
+    "local_size", "cross_rank", "cross_size", "allreduce", "allreduce_async",
+    "grouped_allreduce", "grouped_allreduce_async", "allgather",
+    "allgather_async", "broadcast", "broadcast_async", "alltoall",
+    "alltoall_async", "reducescatter", "reducescatter_async", "join",
+    "barrier", "synchronize", "poll", "mpi_threads_supported",
+    "start_timeline", "stop_timeline",
+]
+
+
+def init(topology: Optional[Topology] = None) -> None:
+    """Initialize the runtime (reference ``hvd.init()``,
+    ``operations.cc:710``). Topology comes from launcher env vars when
+    not given explicitly."""
+    get_runtime().init(topology)
+
+
+def shutdown() -> None:
+    get_runtime().shutdown()
+
+
+def is_initialized() -> bool:
+    return get_runtime().initialized()
+
+
+def rank() -> int:
+    return get_runtime().rank()
+
+
+def size() -> int:
+    return get_runtime().size()
+
+
+def local_rank() -> int:
+    return get_runtime().local_rank()
+
+
+def local_size() -> int:
+    return get_runtime().local_size()
+
+
+def cross_rank() -> int:
+    return get_runtime().cross_rank()
+
+
+def cross_size() -> int:
+    return get_runtime().cross_size()
+
+
+def mpi_threads_supported() -> bool:
+    # No MPI underneath; the native controller is always thread-safe.
+    return True
+
+
+def _resolve_op(op: Optional[ReduceOp], average: Optional[bool]) -> ReduceOp:
+    if op is not None and average is not None:
+        raise ValueError("specify either op= or the legacy average=, not both")
+    if op is None:
+        op = Average if (average is None or average) else Sum
+    return op
+
+
+# ---------------------------------------------------------------------------
+# allreduce
+# ---------------------------------------------------------------------------
+
+def allreduce_async(tensor, average: Optional[bool] = None,
+                    name: Optional[str] = None, op: Optional[ReduceOp] = None,
+                    prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0) -> Handle:
+    rt = get_runtime()
+    return rt.enqueue(
+        basics.OP_ALLREDUCE, tensor, rt.auto_name("allreduce", name),
+        reduce_op=_resolve_op(op, average), prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor)
+
+
+def allreduce(tensor, average: Optional[bool] = None,
+              name: Optional[str] = None, op: Optional[ReduceOp] = None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+    return synchronize(allreduce_async(tensor, average, name, op,
+                                       prescale_factor, postscale_factor))
+
+
+def grouped_allreduce_async(tensors: Sequence, average: Optional[bool] = None,
+                            name: Optional[str] = None,
+                            op: Optional[ReduceOp] = None,
+                            prescale_factor: float = 1.0,
+                            postscale_factor: float = 1.0) -> List[Handle]:
+    """Atomic multi-tensor allreduce (reference
+    ``EnqueueTensorAllreduces``, ``operations.cc:943`` + GroupTable).
+    The member names are hashed into a rank-invariant group key."""
+    rt = get_runtime()
+    reduce_op = _resolve_op(op, average)
+    base = rt.auto_name("grouped_allreduce", name)
+    names = [f"{base}.{i}" for i in range(len(tensors))]
+    key = _group_key(names)
+    return [
+        rt.enqueue(basics.OP_ALLREDUCE, t, nm, reduce_op=reduce_op,
+                   prescale_factor=prescale_factor,
+                   postscale_factor=postscale_factor,
+                   group_key=key, group_size=len(tensors))
+        for t, nm in zip(tensors, names)
+    ]
+
+
+def grouped_allreduce(tensors: Sequence, average: Optional[bool] = None,
+                      name: Optional[str] = None,
+                      op: Optional[ReduceOp] = None,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0) -> List:
+    handles = grouped_allreduce_async(tensors, average, name, op,
+                                      prescale_factor, postscale_factor)
+    return [synchronize(h) for h in handles]
+
+
+def _group_key(names: Sequence[str]) -> int:
+    # FNV-1a over the sorted member names — identical on every rank.
+    h = 1469598103934665603
+    for nm in sorted(names):
+        for b in nm.encode():
+            h = ((h ^ b) * 1099511628211) & 0x7FFFFFFFFFFFFFFF
+    return h
+
+
+# ---------------------------------------------------------------------------
+# allgather / broadcast / alltoall / reducescatter
+# ---------------------------------------------------------------------------
+
+def allgather_async(tensor, name: Optional[str] = None) -> Handle:
+    rt = get_runtime()
+    return rt.enqueue(basics.OP_ALLGATHER, tensor,
+                      rt.auto_name("allgather", name))
+
+
+def allgather(tensor, name: Optional[str] = None):
+    return synchronize(allgather_async(tensor, name))
+
+
+def broadcast_async(tensor, root_rank: int = 0,
+                    name: Optional[str] = None) -> Handle:
+    rt = get_runtime()
+    return rt.enqueue(basics.OP_BROADCAST, tensor,
+                      rt.auto_name("broadcast", name), root_rank=root_rank)
+
+
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
+    return synchronize(broadcast_async(tensor, root_rank, name))
+
+
+def alltoall_async(tensor, splits=None, name: Optional[str] = None) -> Handle:
+    rt = get_runtime()
+    return rt.enqueue(basics.OP_ALLTOALL, tensor,
+                      rt.auto_name("alltoall", name), splits=splits)
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None):
+    """Returns (tensor, received_splits) like the reference
+    (``torch/mpi_ops.py`` alltoall returns recv splits when asked; we
+    always return them — drop with ``[0]`` if unneeded)."""
+    h = alltoall_async(tensor, splits, name)
+    rt = get_runtime()
+    out, st = rt.synchronize(h)
+    return out, st.recvsplits
+
+
+def reducescatter_async(tensor, op: Optional[ReduceOp] = None,
+                        name: Optional[str] = None,
+                        prescale_factor: float = 1.0,
+                        postscale_factor: float = 1.0) -> Handle:
+    rt = get_runtime()
+    return rt.enqueue(basics.OP_REDUCESCATTER, tensor,
+                      rt.auto_name("reducescatter", name),
+                      reduce_op=op if op is not None else Average,
+                      prescale_factor=prescale_factor,
+                      postscale_factor=postscale_factor)
+
+
+def reducescatter(tensor, op: Optional[ReduceOp] = None,
+                  name: Optional[str] = None, prescale_factor: float = 1.0,
+                  postscale_factor: float = 1.0):
+    return synchronize(reducescatter_async(tensor, op, name, prescale_factor,
+                                           postscale_factor))
+
+
+# ---------------------------------------------------------------------------
+# join / barrier / handles
+# ---------------------------------------------------------------------------
+
+def join() -> None:
+    """Signal that this rank has no more data (reference ``hvd.join()``,
+    ``EnqueueJoin`` operations.cc:1197): pending collectives from other
+    ranks proceed with this rank contributing zeros; returns when every
+    rank has joined."""
+    rt = get_runtime()
+    rt.synchronize(rt.join())
+
+
+def barrier() -> None:
+    rt = get_runtime()
+    rt.synchronize(rt.barrier())
+
+
+def synchronize(handle: Handle):
+    """Block until an async handle completes; returns the output tensor
+    (reference ``torch/mpi_ops.py`` ``synchronize``)."""
+    out, _st = get_runtime().synchronize(handle)
+    return out
+
+
+def poll(handle: Handle) -> bool:
+    return get_runtime().poll(handle)
+
+
+def start_timeline(path: str) -> None:
+    get_runtime().start_timeline(path)
+
+
+def stop_timeline() -> None:
+    get_runtime().stop_timeline()

@@ -1,0 +1,133 @@
+"""ctypes bridge to the native coordination core.
+
+Rebuild of the reference's ``horovod/common/basics.py:33-288``
+(``HorovodBasics``): loads the shared library, declares the C ABI
+signatures, and exposes init/shutdown/rank/size plus the raw enqueue
+surface consumed by :mod:`horovod_tpu.runtime`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_LIB_CANDIDATES = [
+    os.path.join(_REPO_ROOT, "native", "libhorovod_tpu_core.so"),
+    os.path.join(os.path.dirname(__file__), "libhorovod_tpu_core.so"),
+]
+
+# C ABI op codes (native/include/hvd/message.h RequestType).
+OP_ALLREDUCE = 0
+OP_ALLGATHER = 1
+OP_BROADCAST = 2
+OP_ALLTOALL = 3
+OP_JOIN = 4
+OP_BARRIER = 5
+OP_REDUCESCATTER = 6
+
+EXEC_HOST = 0
+EXEC_CALLBACK = 1
+
+# numpy dtype -> native DataType id (native/include/hvd/common.h).
+_DTYPE_MAP = {
+    np.dtype(np.uint8): 0,
+    np.dtype(np.int8): 1,
+    np.dtype(np.uint16): 2,
+    np.dtype(np.int16): 3,
+    np.dtype(np.int32): 4,
+    np.dtype(np.int64): 5,
+    np.dtype(np.float16): 6,
+    np.dtype(np.float32): 7,
+    np.dtype(np.float64): 8,
+    np.dtype(np.bool_): 9,
+}
+_BFLOAT16_ID = 10
+
+
+def dtype_id(dtype) -> int:
+    dtype = np.dtype(dtype) if not hasattr(dtype, "name") else dtype
+    if getattr(dtype, "name", "") == "bfloat16":
+        return _BFLOAT16_ID
+    try:
+        return _DTYPE_MAP[np.dtype(dtype)]
+    except KeyError:
+        raise TypeError(f"unsupported dtype for collective: {dtype}") from None
+
+
+EXEC_CB_TYPE = ctypes.CFUNCTYPE(
+    None, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+    ctypes.POINTER(ctypes.c_char_p), ctypes.c_int32,
+    ctypes.POINTER(ctypes.c_int64), ctypes.c_int32)
+ALLOC_CB_TYPE = ctypes.CFUNCTYPE(
+    ctypes.c_void_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+    ctypes.c_int32)
+
+
+def _build_native() -> None:
+    subprocess.run(["make", "-C", os.path.join(_REPO_ROOT, "native"), "-j"],
+                   check=True, capture_output=True)
+
+
+def load_library() -> ctypes.CDLL:
+    path = next((p for p in _LIB_CANDIDATES if os.path.exists(p)), None)
+    if path is None:
+        _build_native()
+        path = _LIB_CANDIDATES[0]
+    lib = ctypes.CDLL(path)
+
+    lib.hvd_init.restype = ctypes.c_int
+    lib.hvd_init.argtypes = [ctypes.c_int] * 6
+    lib.hvd_shutdown.restype = None
+    for fn in ("hvd_initialized", "hvd_rank", "hvd_size", "hvd_local_rank",
+               "hvd_local_size", "hvd_cross_rank", "hvd_cross_size",
+               "hvd_is_homogeneous"):
+        getattr(lib, fn).restype = ctypes.c_int
+    lib.hvd_enqueue.restype = ctypes.c_int64
+    lib.hvd_enqueue.argtypes = [
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_double,
+        ctypes.c_double, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ctypes.c_int, ctypes.c_int64, ctypes.c_int,
+    ]
+    lib.hvd_last_enqueue_error.restype = ctypes.c_char_p
+    lib.hvd_join.restype = ctypes.c_int64
+    lib.hvd_barrier.restype = ctypes.c_int64
+    lib.hvd_poll.restype = ctypes.c_int
+    lib.hvd_poll.argtypes = [ctypes.c_int64]
+    lib.hvd_wait.restype = ctypes.c_int
+    lib.hvd_wait.argtypes = [ctypes.c_int64, ctypes.c_int, ctypes.c_char_p,
+                             ctypes.c_int]
+    lib.hvd_release_handle.restype = None
+    lib.hvd_release_handle.argtypes = [ctypes.c_int64]
+    lib.hvd_get_recvsplits.restype = ctypes.c_int
+    lib.hvd_get_recvsplits.argtypes = [ctypes.c_int64,
+                                       ctypes.POINTER(ctypes.c_int64),
+                                       ctypes.c_int]
+    lib.hvd_exec_done.restype = None
+    lib.hvd_exec_done.argtypes = [ctypes.c_int64, ctypes.c_int,
+                                  ctypes.c_char_p]
+    lib.hvd_set_exec_callback.restype = None
+    lib.hvd_set_exec_callback.argtypes = [EXEC_CB_TYPE]
+    lib.hvd_set_alloc_callback.restype = None
+    lib.hvd_set_alloc_callback.argtypes = [ALLOC_CB_TYPE]
+    lib.hvd_start_timeline.restype = None
+    lib.hvd_start_timeline.argtypes = [ctypes.c_char_p]
+    lib.hvd_stop_timeline.restype = None
+    lib.hvd_pending_count.restype = ctypes.c_int64
+    return lib
+
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def get_lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        _lib = load_library()
+    return _lib

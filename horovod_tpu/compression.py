@@ -1,0 +1,82 @@
+"""Gradient compression (reference ``horovod/torch/compression.py:20-74``
+and ``tensorflow/compression.py``): compress before the wire, decompress
+after. On TPU the interesting codec is bf16 (native MXU dtype); fp16 is
+kept for parity."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Compressor:
+    @staticmethod
+    def compress(tensor):
+        """Returns (compressed_tensor, context)."""
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+def _cast(tensor, dtype_name: str):
+    mod = type(tensor).__module__
+    if mod.startswith("torch"):
+        import torch
+        return tensor.to(getattr(torch, dtype_name))
+    if mod.startswith("jax"):
+        import jax.numpy as jnp
+        return tensor.astype(getattr(jnp, dtype_name))
+    if dtype_name == "bfloat16":
+        import ml_dtypes
+        return np.asarray(tensor).astype(ml_dtypes.bfloat16)
+    return np.asarray(tensor).astype(dtype_name)
+
+
+class FP16Compressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        dt = getattr(tensor, "dtype", None)
+        if dt is not None and ("float32" in str(dt) or "float64" in str(dt)):
+            return _cast(tensor, "float16"), dt
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is None:
+            return tensor
+        return _cast(tensor, str(ctx).replace("torch.", ""))
+
+
+class BF16Compressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        dt = getattr(tensor, "dtype", None)
+        if dt is not None and ("float32" in str(dt) or "float64" in str(dt)):
+            return _cast(tensor, "bfloat16"), dt
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is None:
+            return tensor
+        return _cast(tensor, str(ctx).replace("torch.", ""))
+
+
+class Compression:
+    """Namespace matching ``hvd.Compression.{none,fp16}`` + TPU-native
+    ``bf16``."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

@@ -1,0 +1,129 @@
+"""XLA executor for eager CALLBACK-mode responses.
+
+The NCCL-ops analog (reference ``horovod/common/ops/nccl_operations.cc``):
+the native controller decides *when* and *in what order* a fused batch
+runs; this module decides *how* — by launching a jitted XLA program.
+Grouped entries become one multi-operand program (XLA's combiner plays
+the role of the fusion-buffer memcpy kernels, reference
+``cuda/cuda_kernels.cu``).
+
+Process topologies:
+
+* size == 1: collectives over ranks degenerate to (scaled) identity —
+  jitted so dtype/scale semantics match the distributed path exactly.
+* multi-process under ``jax.distributed`` with one device per process:
+  ``psum``-style programs over a process-spanning mesh move bytes over
+  ICI/DCN. The controller guarantees all processes launch the same
+  program in the same order (the requirement XLA multi-controller
+  imposes, and exactly what Horovod's coordinator was built to
+  provide).
+* multi-device-per-process pods route through the SPMD tier
+  (:mod:`horovod_tpu.ops.collectives`) instead; the eager tier raises
+  until the pod launcher lands.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import List
+
+import numpy as np
+
+from horovod_tpu.common import basics
+from horovod_tpu.common.ops_enum import ReduceOp
+
+
+def _scale_factor(st, size: int) -> float:
+    f = st.prescale * st.postscale
+    if st.reduce_op == ReduceOp.AVERAGE:
+        f /= size
+    return f
+
+
+@lru_cache(maxsize=None)
+def _scale_jit():
+    import jax
+    from functools import partial
+    from horovod_tpu.ops.collectives import _scale
+
+    return partial(jax.jit, static_argnums=(1,))(_scale)
+
+
+def execute(op: int, states, sizes: List[int], size: int, rank: int):
+    if size == 1:
+        outs = []
+        for st in states:
+            x = st.input_dev
+            if op in (basics.OP_ALLREDUCE, basics.OP_REDUCESCATTER):
+                f = _scale_factor(st, 1)
+                if f != 1.0:
+                    x = _scale_jit()(x, f)
+            # allgather/broadcast/alltoall over 1 rank: identity
+            # (alltoall recvsplits are filled by the native core).
+            outs.append(x)
+        return outs
+    if op == basics.OP_ALLREDUCE:
+        return _distributed_allreduce(states, size)
+    raise NotImplementedError(
+        f"multi-process XLA execution for op {op} lands with the pod "
+        "launcher; host-staged execution handles this case today")
+
+
+@lru_cache(maxsize=None)
+def _rank_mesh():
+    """1-D mesh over all processes' devices, axis "rank". Requires one
+    device per process so the axis length equals the world size."""
+    import jax
+    from jax.sharding import Mesh
+
+    if jax.local_device_count() != 1:
+        raise NotImplementedError(
+            "eager distributed XLA allreduce currently requires one device "
+            "per process (the Horovod process model); use the SPMD "
+            "functional API (horovod_tpu.ops) for multi-device processes")
+    return Mesh(np.asarray(jax.devices(), dtype=object), ("rank",))
+
+
+@lru_cache(maxsize=None)
+def _reduce_jit(op: ReduceOp, factor: float):
+    import jax
+    import jax.numpy as jnp
+    from horovod_tpu.ops.collectives import _scale
+
+    def fn(arr):
+        if op in (ReduceOp.AVERAGE, ReduceOp.SUM, ReduceOp.ADASUM):
+            y = jnp.sum(arr, axis=0)
+        elif op == ReduceOp.MIN:
+            y = jnp.min(arr, axis=0)
+        elif op == ReduceOp.MAX:
+            y = jnp.max(arr, axis=0)
+        elif op == ReduceOp.PRODUCT:
+            y = jnp.prod(arr, axis=0)
+        else:
+            raise ValueError(f"unknown reduce op {op!r}")
+        return _scale(y, factor) if factor != 1.0 else y
+
+    return jax.jit(fn)
+
+
+def _distributed_allreduce(states, size: int):
+    """Reduce each entry across processes: build a global batch-of-
+    shards array (leading axis = process), reduce over it, read back
+    the (replicated) result. XLA lowers the sum-over-sharded-axis to an
+    all-reduce over ICI/DCN."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _rank_mesh()
+    sharding = NamedSharding(mesh, P("rank"))
+    local_device = mesh.local_mesh.devices.flat[0]
+
+    outs = []
+    for st in states:
+        x = st.input_dev
+        local = jax.device_put(jnp.asarray(x)[None], local_device)
+        arr = jax.make_array_from_single_device_arrays(
+            (size,) + tuple(x.shape), sharding, [local])
+        outs.append(_reduce_jit(st.reduce_op, _scale_factor(st, size))(arr))
+    return outs

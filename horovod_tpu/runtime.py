@@ -1,0 +1,383 @@
+"""Eager runtime: Python orchestration over the native coordination core.
+
+The split mirrors the reference: the C++ core owns negotiation, fusion
+planning, caching, stall detection and the host (TCP) data plane
+(reference ``horovod/common/operations.cc``); this module owns
+
+* tensor registries (keeping inputs/outputs alive while in flight),
+* the output **allocator callback** (the ``OpContext::AllocateOutput``
+  analog, reference ``common/common.h:196-210``) for late-sized
+  allgather/alltoall outputs, and
+* the **XLA executor callback** — the NCCL-ops analog: CALLBACK-mode
+  responses (JAX device arrays) are executed as jitted XLA collective
+  programs instead of being routed through host TCP.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from horovod_tpu.common import basics
+from horovod_tpu.common.exceptions import HorovodInternalError
+from horovod_tpu.common.ops_enum import ReduceOp
+from horovod_tpu.common.topology import Topology, topology_from_env
+
+
+class _InFlight:
+    """State for one in-flight collective (registry entry)."""
+
+    __slots__ = ("name", "op", "input_np", "input_dev", "output", "orig_kind",
+                 "orig_dtype", "reduce_op", "prescale", "postscale", "splits",
+                 "recvsplits", "root_rank")
+
+    def __init__(self):
+        self.name = None
+        self.op = None
+        self.input_np = None      # host buffer (kept alive for native core)
+        self.input_dev = None     # jax array for CALLBACK mode
+        self.output = None
+        self.orig_kind = "np"     # np | jax | torch
+        self.orig_dtype = None
+        self.reduce_op = ReduceOp.AVERAGE
+        self.prescale = 1.0
+        self.postscale = 1.0
+        self.splits = None
+        self.recvsplits = None
+        self.root_rank = 0
+
+
+class Handle:
+    """Async collective handle (reference ``horovod/torch/mpi_ops.py``
+    handle model + ``handle_manager.h``)."""
+
+    __slots__ = ("native", "runtime")
+
+    def __init__(self, native: int, runtime: "Runtime"):
+        self.native = native
+        self.runtime = runtime
+
+
+class Runtime:
+    def __init__(self):
+        self.lib = None
+        self.topology: Optional[Topology] = None
+        self._lock = threading.RLock()
+        self._inflight: Dict[int, _InFlight] = {}   # native handle -> state
+        self._name_to_handle: Dict[str, int] = {}
+        self._name_counters: Dict[str, int] = {}
+        self._exec_cb = None   # keep callbacks alive for the C core
+        self._alloc_cb = None
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def init(self, topology: Optional[Topology] = None) -> None:
+        if self.initialized():
+            return
+        self.lib = basics.get_lib()
+        topo = topology or topology_from_env()
+        self._exec_cb = basics.EXEC_CB_TYPE(self._on_exec)
+        self._alloc_cb = basics.ALLOC_CB_TYPE(self._on_alloc)
+        self.lib.hvd_set_exec_callback(self._exec_cb)
+        self.lib.hvd_set_alloc_callback(self._alloc_cb)
+        rc = self.lib.hvd_init(topo.rank, topo.size, topo.local_rank,
+                               topo.local_size, topo.cross_rank,
+                               topo.cross_size)
+        if rc != 0:
+            raise HorovodInternalError("native core initialization failed")
+        self.topology = topo
+
+    def shutdown(self) -> None:
+        if self.lib is not None and self.initialized():
+            self.lib.hvd_shutdown()
+        with self._lock:
+            self._inflight.clear()
+            self._name_to_handle.clear()
+            self._name_counters.clear()
+
+    def initialized(self) -> bool:
+        return self.lib is not None and bool(self.lib.hvd_initialized())
+
+    def rank(self) -> int:
+        self._check_init()
+        return self.lib.hvd_rank()
+
+    def size(self) -> int:
+        self._check_init()
+        return self.lib.hvd_size()
+
+    def local_rank(self) -> int:
+        self._check_init()
+        return self.lib.hvd_local_rank()
+
+    def local_size(self) -> int:
+        self._check_init()
+        return self.lib.hvd_local_size()
+
+    def cross_rank(self) -> int:
+        self._check_init()
+        return self.lib.hvd_cross_rank()
+
+    def cross_size(self) -> int:
+        self._check_init()
+        return self.lib.hvd_cross_size()
+
+    def _check_init(self) -> None:
+        if not self.initialized():
+            raise RuntimeError(
+                "horovod_tpu has not been initialized; call hvd.init() first")
+
+    # ------------------------------------------------------------------
+    # enqueue / synchronize
+    # ------------------------------------------------------------------
+
+    def auto_name(self, prefix: str, explicit: Optional[str]) -> str:
+        if explicit is not None:
+            return explicit
+        with self._lock:
+            i = self._name_counters.get(prefix, 0)
+            self._name_counters[prefix] = i + 1
+        return f"{prefix}.noname.{i}"
+
+    @staticmethod
+    def _classify(tensor):
+        """Returns (kind, np_view_or_none, jax_array_or_none)."""
+        mod = type(tensor).__module__
+        if isinstance(tensor, np.ndarray):
+            return "np", tensor, None
+        if mod.startswith("torch"):
+            import torch
+            t = tensor.detach()
+            if t.device.type != "cpu":
+                t = t.cpu()
+            t = t.contiguous()
+            if t.dtype == torch.bfloat16:
+                # torch refuses bf16->numpy; stage through a uint16 view
+                # and rewrap with ml_dtypes so the native core sees the
+                # real dtype.
+                import ml_dtypes
+                return "torch", t.view(torch.uint16).numpy().view(
+                    ml_dtypes.bfloat16), None
+            return "torch", t.numpy(), None
+        if mod.startswith("jax") or hasattr(tensor, "addressable_shards"):
+            return "jax", None, tensor
+        # Anything array-like (lists, scalars) becomes numpy.
+        return "np", np.ascontiguousarray(tensor), None
+
+    def enqueue(self, op: int, tensor, name: str, *,
+                reduce_op: ReduceOp = ReduceOp.AVERAGE,
+                root_rank: int = 0,
+                prescale_factor: float = 1.0,
+                postscale_factor: float = 1.0,
+                splits=None,
+                group_key: int = -1,
+                group_size: int = 0) -> Handle:
+        self._check_init()
+        kind, np_in, dev_in = self._classify(tensor)
+
+        st = _InFlight()
+        st.name = name
+        st.op = op
+        st.orig_kind = kind
+        st.reduce_op = reduce_op
+        st.prescale = prescale_factor
+        st.postscale = postscale_factor
+        st.root_rank = root_rank
+
+        if kind == "jax" and self.size() > 1 and not _jax_distributed_active():
+            # No process-spanning mesh available: stage through the host
+            # data plane (the reference's CPU-fallback, gloo_operations.cc).
+            kind = "np"
+            np_in = np.asarray(dev_in)
+            st.orig_kind = "jax"
+
+        if kind == "jax":
+            # Device path: the native core negotiates; execution happens
+            # in the XLA executor callback.
+            exec_mode = basics.EXEC_CALLBACK
+            st.input_dev = dev_in
+            shape = list(dev_in.shape)
+            dt = basics.dtype_id(dev_in.dtype)
+            data_ptr = None
+            out_ptr = None
+        else:
+            exec_mode = basics.EXEC_HOST
+            np_in = np.ascontiguousarray(np_in)
+            st.input_np = np_in
+            st.orig_dtype = np_in.dtype
+            shape = list(np_in.shape)
+            dt = basics.dtype_id(np_in.dtype)
+            data_ptr = np_in.ctypes.data
+            if op in (basics.OP_ALLREDUCE, basics.OP_BROADCAST):
+                st.output = np.empty_like(np_in)
+                out_ptr = st.output.ctypes.data
+            else:
+                out_ptr = None  # allocated by callback once sizes known
+
+        shape_arr = (ctypes.c_int64 * len(shape))(*shape)
+        if splits is not None:
+            splits = list(int(s) for s in splits)
+            st.splits = splits
+            splits_arr = (ctypes.c_int64 * len(splits))(*splits)
+            nsplits = len(splits)
+        else:
+            splits_arr = None
+            nsplits = 0
+
+        with self._lock:
+            handle = self.lib.hvd_enqueue(
+                op, name.encode(), dt, shape_arr, len(shape), data_ptr,
+                out_ptr, root_rank, int(reduce_op), prescale_factor,
+                postscale_factor, splits_arr, nsplits, exec_mode,
+                group_key, group_size)
+            if handle < 0:
+                err = self.lib.hvd_last_enqueue_error().decode()
+                raise HorovodInternalError(err)
+            self._inflight[handle] = st
+            self._name_to_handle[name] = handle
+        return Handle(handle, self)
+
+    def poll(self, handle: Handle) -> bool:
+        return bool(self.lib.hvd_poll(handle.native))
+
+    def synchronize(self, handle: Handle):
+        err_buf = ctypes.create_string_buffer(1024)
+        rc = self.lib.hvd_wait(handle.native, -1, err_buf, len(err_buf))
+        with self._lock:
+            st = self._inflight.pop(handle.native, None)
+            if st is not None and self._name_to_handle.get(st.name) == handle.native:
+                self._name_to_handle.pop(st.name, None)
+        if rc != 0:
+            self.lib.hvd_release_handle(handle.native)
+            raise HorovodInternalError(
+                err_buf.value.decode() or f"collective failed (rc={rc})")
+        if st is None:
+            self.lib.hvd_release_handle(handle.native)
+            raise HorovodInternalError("unknown handle")
+        # Alltoall recv splits.
+        if st.op == basics.OP_ALLTOALL:
+            n = self.lib.hvd_get_recvsplits(handle.native, None, 0)
+            if n > 0:
+                buf = (ctypes.c_int64 * n)()
+                self.lib.hvd_get_recvsplits(handle.native, buf, n)
+                st.recvsplits = list(buf)
+        self.lib.hvd_release_handle(handle.native)
+
+        out = st.output
+        if st.orig_kind == "jax":
+            import jax.numpy as jnp
+            if out is None:
+                out = st.input_dev
+            elif not hasattr(out, "devices"):
+                out = jnp.asarray(out)
+            return out, st
+        if st.orig_kind == "torch":
+            import torch
+            out = np.ascontiguousarray(out)
+            if out.dtype.name == "bfloat16":
+                return torch.from_numpy(out.view(np.uint16)).view(
+                    torch.bfloat16), st
+            return torch.from_numpy(out), st
+        return out, st
+
+    # ------------------------------------------------------------------
+    # native-core callbacks (run on the background thread)
+    # ------------------------------------------------------------------
+
+    def _on_alloc(self, handle: int, shape_ptr, ndim: int) -> int:
+        try:
+            shape = tuple(shape_ptr[i] for i in range(ndim))
+            with self._lock:
+                st = self._inflight.get(handle)
+                if st is None:
+                    return 0
+                st.output = np.empty(shape, dtype=st.orig_dtype)
+                return st.output.ctypes.data
+        except Exception:
+            return 0
+
+    def _on_exec(self, exec_id: int, op: int, n: int, names_ptr, dtype: int,
+                 sizes_ptr, sizes_len: int) -> None:
+        try:
+            names = [names_ptr[i].decode() for i in range(n)]
+            sizes = [sizes_ptr[i] for i in range(sizes_len)] if sizes_len else []
+            self._execute_xla(op, names, sizes)
+            self.lib.hvd_exec_done(exec_id, 0, None)
+        except Exception as e:  # noqa: BLE001 — must not unwind into C
+            self.lib.hvd_exec_done(exec_id, 1, str(e).encode())
+
+    def _execute_xla(self, op: int, names: List[str], sizes: List[int]) -> None:
+        """Execute one CALLBACK-mode response with XLA.
+
+        Single-process: collectives over ranks degenerate to (scaled)
+        identity. Multi-process pods run under ``jax.distributed`` with
+        a process-spanning mesh (the launcher sets it up); every process
+        executes this same program in the same order — the ordering is
+        guaranteed by the controller's broadcast ResponseList.
+        """
+        from horovod_tpu.ops import xla_exec
+
+        with self._lock:
+            states = [self._inflight[self._name_to_handle[nm]] for nm in names]
+        outs = xla_exec.execute(op, states, sizes, self.size(), self.rank())
+        with self._lock:
+            for st, out in zip(states, outs):
+                st.output = out
+
+    # ------------------------------------------------------------------
+    # misc
+    # ------------------------------------------------------------------
+
+    def join(self) -> Handle:
+        self._check_init()
+        h = self.lib.hvd_join()
+        st = _InFlight()
+        st.name, st.op = "join", basics.OP_JOIN
+        with self._lock:
+            self._inflight[h] = st
+            self._name_to_handle[st.name] = h
+        return Handle(h, self)
+
+    def barrier(self) -> Handle:
+        self._check_init()
+        h = self.lib.hvd_barrier()
+        st = _InFlight()
+        st.name, st.op = "barrier", basics.OP_BARRIER
+        with self._lock:
+            self._inflight[h] = st
+            self._name_to_handle[st.name] = h
+        return Handle(h, self)
+
+    def start_timeline(self, path: str) -> None:
+        self._check_init()
+        self.lib.hvd_start_timeline(path.encode())
+
+    def stop_timeline(self) -> None:
+        self._check_init()
+        self.lib.hvd_stop_timeline()
+
+
+def _jax_distributed_active() -> bool:
+    try:
+        import jax
+        return jax.process_count() > 1
+    except Exception:
+        return False
+
+
+_runtime: Optional[Runtime] = None
+_runtime_lock = threading.Lock()
+
+
+def get_runtime() -> Runtime:
+    global _runtime
+    with _runtime_lock:
+        if _runtime is None:
+            _runtime = Runtime()
+        return _runtime

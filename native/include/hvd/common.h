@@ -1,0 +1,168 @@
+// Core types shared across the coordination runtime.
+//
+// TPU-native rebuild of the reference's framework-neutral core types
+// (horovod/common/common.h:138-281: Status, TensorShape,
+// TensorTableEntry, DataType, and the named activity constants). The
+// data plane here never touches CUDA: host tensors are reduced natively
+// over the controller's TCP links (the Gloo-ops analog), device tensors
+// are executed by a registered callback that launches XLA collective
+// programs (the NCCL-ops analog, with XLA/ICI in place of NCCL/NVLink).
+#pragma once
+
+#include <cstdint>
+#include <functional>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace hvd {
+
+enum class StatusType : int {
+  OK = 0,
+  UNKNOWN_ERROR = 1,
+  PRECONDITION_ERROR = 2,
+  ABORTED = 3,
+  INVALID_ARGUMENT = 4,
+  IN_PROGRESS = 5,
+};
+
+class Status {
+ public:
+  Status() = default;
+  static Status OK() { return Status(); }
+  static Status UnknownError(std::string msg) {
+    return Status(StatusType::UNKNOWN_ERROR, std::move(msg));
+  }
+  static Status PreconditionError(std::string msg) {
+    return Status(StatusType::PRECONDITION_ERROR, std::move(msg));
+  }
+  static Status Aborted(std::string msg) {
+    return Status(StatusType::ABORTED, std::move(msg));
+  }
+  static Status InvalidArgument(std::string msg) {
+    return Status(StatusType::INVALID_ARGUMENT, std::move(msg));
+  }
+  static Status InProgress() { return Status(StatusType::IN_PROGRESS, ""); }
+  bool ok() const { return type_ == StatusType::OK; }
+  bool in_progress() const { return type_ == StatusType::IN_PROGRESS; }
+  StatusType type() const { return type_; }
+  const std::string& reason() const { return reason_; }
+
+ private:
+  Status(StatusType type, std::string reason)
+      : type_(type), reason_(std::move(reason)) {}
+  StatusType type_ = StatusType::OK;
+  std::string reason_;
+};
+
+// Wire-stable dtype ids (mirror of common/message.h DataType).
+enum class DataType : uint8_t {
+  UINT8 = 0,
+  INT8 = 1,
+  UINT16 = 2,
+  INT16 = 3,
+  INT32 = 4,
+  INT64 = 5,
+  FLOAT16 = 6,
+  FLOAT32 = 7,
+  FLOAT64 = 8,
+  BOOL = 9,
+  BFLOAT16 = 10,
+};
+
+inline size_t DataTypeSize(DataType dt) {
+  switch (dt) {
+    case DataType::UINT8:
+    case DataType::INT8:
+    case DataType::BOOL:
+      return 1;
+    case DataType::UINT16:
+    case DataType::INT16:
+    case DataType::FLOAT16:
+    case DataType::BFLOAT16:
+      return 2;
+    case DataType::INT32:
+    case DataType::FLOAT32:
+      return 4;
+    case DataType::INT64:
+    case DataType::FLOAT64:
+      return 8;
+  }
+  return 0;
+}
+
+const char* DataTypeName(DataType dt);
+
+enum class ReduceOp : uint8_t {
+  AVERAGE = 0,
+  SUM = 1,
+  ADASUM = 2,
+  MIN = 3,
+  MAX = 4,
+  PRODUCT = 5,
+};
+
+class TensorShape {
+ public:
+  TensorShape() = default;
+  explicit TensorShape(std::vector<int64_t> dims) : dims_(std::move(dims)) {}
+  void AddDim(int64_t d) { dims_.push_back(d); }
+  int ndim() const { return static_cast<int>(dims_.size()); }
+  int64_t dim_size(int i) const { return dims_[i]; }
+  const std::vector<int64_t>& dims() const { return dims_; }
+  int64_t num_elements() const {
+    int64_t n = 1;
+    for (auto d : dims_) n *= d;
+    return n;
+  }
+  bool operator==(const TensorShape& o) const { return dims_ == o.dims_; }
+  bool operator!=(const TensorShape& o) const { return dims_ != o.dims_; }
+  std::string DebugString() const;
+
+ private:
+  std::vector<int64_t> dims_;
+};
+
+// How the data plane executes the entry once negotiated.
+enum class ExecMode : uint8_t {
+  HOST = 0,      // native TCP/local ops on the host buffer
+  CALLBACK = 1,  // hand to the registered Python/XLA executor
+};
+
+using StatusCallback = std::function<void(const Status&)>;
+
+// One named in-flight tensor (reference TensorTableEntry,
+// common/common.h:231-262).
+struct TensorTableEntry {
+  std::string name;
+  DataType dtype = DataType::FLOAT32;
+  TensorShape shape;
+  const void* data = nullptr;  // input buffer (host pointer; may be null
+                               // for CALLBACK entries)
+  void* output = nullptr;      // preallocated output, or null until the
+                               // allocator callback runs
+  int root_rank = 0;           // broadcast root
+  int device = -1;             // -1 = host
+  ReduceOp reduce_op = ReduceOp::SUM;
+  double prescale_factor = 1.0;
+  double postscale_factor = 1.0;
+  std::vector<int64_t> splits;      // alltoall send splits (may be empty)
+  std::vector<int64_t> recvsplits;  // filled on completion
+  ExecMode exec_mode = ExecMode::HOST;
+  int64_t handle = -1;
+  StatusCallback callback;
+  int64_t group_key = -1;
+  int32_t group_size = 0;
+};
+
+// Named timeline activities (reference common/common.h:33-64).
+constexpr const char* ACT_QUEUE = "QUEUE";
+constexpr const char* ACT_MEMCPY_IN_FUSION_BUFFER = "MEMCPY_IN_FUSION_BUFFER";
+constexpr const char* ACT_MEMCPY_OUT_FUSION_BUFFER = "MEMCPY_OUT_FUSION_BUFFER";
+constexpr const char* ACT_TCP_ALLREDUCE = "TCP_ALLREDUCE";
+constexpr const char* ACT_TCP_ALLGATHER = "TCP_ALLGATHER";
+constexpr const char* ACT_TCP_BROADCAST = "TCP_BROADCAST";
+constexpr const char* ACT_TCP_ALLTOALL = "TCP_ALLTOALL";
+constexpr const char* ACT_XLA_EXEC = "XLA_EXEC";
+
+}  // namespace hvd

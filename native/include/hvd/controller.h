@@ -1,0 +1,140 @@
+// Coordination controllers.
+//
+// Rebuild of horovod/common/controller.{h,cc}: rank 0 plays coordinator
+// — every cycle each rank announces which named tensors it has ready
+// (full Requests, or cache-bit indices for steady-state tensors), the
+// coordinator counts readiness per name across ranks, validates
+// cross-rank agreement (dtype/shape/op/root mismatch => ERROR response,
+// reference controller.cc:471-748), fuses small allreduces up to the
+// fusion threshold (controller.cc:777), and broadcasts the ordered
+// ResponseList that every rank then executes identically. That ordering
+// guarantee is exactly what the XLA data plane needs: multi-controller
+// SPMD requires all processes to launch the same programs in the same
+// order.
+//
+// Two transports:
+//  * LocalController — single process; negotiation is trivial but the
+//    cache/fusion/timeline machinery still runs (so single-host
+//    behavior matches multi-host).
+//  * TcpController — rank 0 listens on HOROVOD_CONTROLLER_ADDR, workers
+//    connect (the Gloo-controller analog, gloo/gloo_controller.cc:35).
+//    Control and data planes use separate sockets per worker.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <set>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "hvd/common.h"
+#include "hvd/group_table.h"
+#include "hvd/message.h"
+#include "hvd/response_cache.h"
+#include "hvd/stall_inspector.h"
+#include "hvd/tcp.h"
+#include "hvd/tensor_queue.h"
+#include "hvd/timeline.h"
+
+namespace hvd {
+
+struct ControllerDeps {
+  TensorQueue* tensor_queue = nullptr;
+  ResponseCache* response_cache = nullptr;
+  GroupTable* group_table = nullptr;
+  StallInspector* stall_inspector = nullptr;
+  Timeline* timeline = nullptr;
+};
+
+class Controller {
+ public:
+  Controller(int rank, int size, ControllerDeps deps)
+      : rank_(rank), size_(size), deps_(deps) {}
+  virtual ~Controller() = default;
+
+  virtual Status Initialize() = 0;
+  // One negotiation cycle. `shutdown_requested` is this process's flag;
+  // the returned list's shutdown bit is the global OR.
+  virtual ResponseList ComputeResponseList(bool shutdown_requested) = 0;
+
+  int rank() const { return rank_; }
+  int size() const { return size_; }
+
+  // Data-plane access for the ops layer (TcpController only).
+  virtual TcpConn* DataConn(int peer_rank) { return nullptr; }
+
+ protected:
+  // ----- shared coordinator logic (used by rank 0 and LocalController)
+  struct PendingTensor {
+    std::vector<Request> requests;  // one per announcing rank
+    std::set<int> ranks;
+  };
+
+  // Merge one rank's announcement into the pending table.
+  void AccumulateRequest(const Request& req,
+                         std::map<std::string, PendingTensor>* table);
+  // Build (and validate) a single-tensor response once all active ranks
+  // are ready (reference ConstructResponse, controller.cc:471).
+  Response ConstructResponse(const std::string& name, PendingTensor& pending,
+                             const std::vector<int>& active_ranks);
+  // Collect ready tensors (group-atomic), fuse allreduces, cache.
+  // active_ranks = ranks not currently joined.
+  ResponseList CoordinatorStep(std::map<std::string, PendingTensor>* table,
+                               const std::vector<int>& active_ranks,
+                               bool shutdown);
+  // Apply a broadcast response list to this rank's deterministic cache.
+  void UpdateCacheFromResponses(const ResponseList& list);
+
+  int rank_;
+  int size_;
+  ControllerDeps deps_;
+  int64_t fusion_threshold_bytes_ = 64 * 1024 * 1024;
+
+ public:
+  void SetFusionThreshold(int64_t bytes) { fusion_threshold_bytes_ = bytes; }
+  int64_t fusion_threshold() const { return fusion_threshold_bytes_; }
+};
+
+class LocalController : public Controller {
+ public:
+  LocalController(ControllerDeps deps) : Controller(0, 1, deps) {}
+  Status Initialize() override { return Status::OK(); }
+  ResponseList ComputeResponseList(bool shutdown_requested) override;
+
+ private:
+  std::map<std::string, PendingTensor> table_;
+};
+
+class TcpController : public Controller {
+ public:
+  TcpController(int rank, int size, std::string addr, ControllerDeps deps)
+      : Controller(rank, size, deps), addr_(std::move(addr)) {}
+  Status Initialize() override;
+  ResponseList ComputeResponseList(bool shutdown_requested) override;
+  TcpConn* DataConn(int peer_rank) override;
+
+ private:
+  ResponseList CoordinatorCycle(RequestList my_list, bool shutdown);
+  ResponseList WorkerCycle(RequestList my_list);
+  void Broadcast(const ResponseList& list);
+  // Split drained queue messages into cache hits vs. full requests.
+  RequestList BuildRequestList(bool shutdown, bool* saw_join);
+
+  std::string addr_;
+  TcpServer server_;                 // rank 0
+  std::vector<TcpConn> ctrl_conns_;  // rank 0: by rank; worker: [0]
+  std::vector<TcpConn> data_conns_;
+  std::map<std::string, PendingTensor> table_;  // rank 0
+  std::vector<bool> joined_ranks_;              // rank 0
+  bool i_am_joined_ = false;
+  // Announced-but-unresolved requests (purge recovery re-announces them).
+  std::unordered_map<std::string, Request> announced_;
+
+ public:
+  void SetJoined(bool j) { i_am_joined_ = j; }
+  const std::vector<bool>& joined_ranks() const { return joined_ranks_; }
+};
+
+}  // namespace hvd

@@ -1,0 +1,27 @@
+// Fusion buffer: one persistent host buffer per device/stream key that
+// small tensors are packed into so the transport sees a few large
+// messages instead of many small ones. Rebuild of
+// horovod/common/fusion_buffer_manager.{h,cc} (threshold knob
+// HOROVOD_FUSION_THRESHOLD, default 64 MB like reference common.h:103).
+#pragma once
+
+#include <cstdint>
+#include <unordered_map>
+#include <vector>
+
+namespace hvd {
+
+class FusionBufferManager {
+ public:
+  void SetInitialSize(int64_t bytes) { size_ = bytes; }
+  int64_t size() const { return size_; }
+
+  // Returns the buffer for a key, (re)allocating to at least min_bytes.
+  void* GetBuffer(int key, int64_t min_bytes);
+
+ private:
+  int64_t size_ = 64 * 1024 * 1024;
+  std::unordered_map<int, std::vector<uint8_t>> buffers_;
+};
+
+}  // namespace hvd

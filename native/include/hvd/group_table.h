@@ -1,0 +1,29 @@
+// Registered groups of tensors that must complete atomically (grouped
+// allreduce). Rebuild of horovod/common/group_table.{h,cc}
+// (group_table.h:31-55); registration happens at enqueue
+// (reference operations.cc:1036-1043) and the coordinator only emits a
+// response once every member of the group is ready on every rank.
+#pragma once
+
+#include <cstdint>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace hvd {
+
+class GroupTable {
+ public:
+  int32_t RegisterGroup(std::vector<std::string> names);
+  bool GetGroup(int32_t id, std::vector<std::string>* names) const;
+  void DeregisterGroup(int32_t id);
+  size_t GroupSize(int32_t id) const;
+
+ private:
+  mutable std::mutex mu_;
+  int32_t next_id_ = 0;
+  std::unordered_map<int32_t, std::vector<std::string>> groups_;
+};
+
+}  // namespace hvd

@@ -1,0 +1,91 @@
+// fp16/bf16 <-> float bit conversion for host-side reductions
+// (rebuild of horovod/common/half.{h,cc}; scalar path only — the hot
+// reductions on TPU happen in XLA, this covers the host/CPU fallback
+// data plane).
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+
+namespace hvd {
+
+inline float HalfBits2Float(uint16_t h) {
+  uint32_t sign = (h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1f;
+  uint32_t mant = h & 0x3ffu;
+  uint32_t f;
+  if (exp == 0) {
+    if (mant == 0) {
+      f = sign;
+    } else {
+      // subnormal: normalize
+      exp = 127 - 15 + 1;
+      while ((mant & 0x400u) == 0) {
+        mant <<= 1;
+        exp--;
+      }
+      mant &= 0x3ffu;
+      f = sign | (exp << 23) | (mant << 13);
+    }
+  } else if (exp == 0x1f) {
+    f = sign | 0x7f800000u | (mant << 13);
+  } else {
+    f = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+  }
+  float out;
+  std::memcpy(&out, &f, 4);
+  return out;
+}
+
+inline uint16_t Float2HalfBits(float value) {
+  uint32_t f;
+  std::memcpy(&f, &value, 4);
+  uint32_t sign = (f >> 16) & 0x8000u;
+  int32_t exp = static_cast<int32_t>((f >> 23) & 0xff) - 127 + 15;
+  uint32_t mant = f & 0x7fffffu;
+  if (exp >= 0x1f) {
+    // overflow -> inf (or NaN preserved)
+    uint32_t nan_bit = (((f >> 23) & 0xff) == 0xff && mant) ? 0x200u : 0;
+    return static_cast<uint16_t>(sign | 0x7c00u | nan_bit);
+  }
+  if (exp <= 0) {
+    if (exp < -10) return static_cast<uint16_t>(sign);
+    // subnormal with round-to-nearest-even
+    mant |= 0x800000u;
+    uint32_t shift = static_cast<uint32_t>(14 - exp);
+    uint32_t half_mant = mant >> shift;
+    uint32_t rem = mant & ((1u << shift) - 1);
+    uint32_t halfway = 1u << (shift - 1);
+    if (rem > halfway || (rem == halfway && (half_mant & 1))) half_mant++;
+    return static_cast<uint16_t>(sign | half_mant);
+  }
+  uint32_t half_mant = mant >> 13;
+  uint32_t rem = mant & 0x1fffu;
+  if (rem > 0x1000u || (rem == 0x1000u && (half_mant & 1))) {
+    half_mant++;
+    if (half_mant == 0x400u) {
+      half_mant = 0;
+      exp++;
+      if (exp >= 0x1f) return static_cast<uint16_t>(sign | 0x7c00u);
+    }
+  }
+  return static_cast<uint16_t>(sign | (static_cast<uint32_t>(exp) << 10) | half_mant);
+}
+
+inline float BFloat2Float(uint16_t b) {
+  uint32_t f = static_cast<uint32_t>(b) << 16;
+  float out;
+  std::memcpy(&out, &f, 4);
+  return out;
+}
+
+inline uint16_t Float2BFloat(float value) {
+  uint32_t f;
+  std::memcpy(&f, &value, 4);
+  // round-to-nearest-even on the dropped 16 bits
+  uint32_t lsb = (f >> 16) & 1;
+  f += 0x7fffu + lsb;
+  return static_cast<uint16_t>(f >> 16);
+}
+
+}  // namespace hvd

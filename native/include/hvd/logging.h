@@ -1,0 +1,36 @@
+// Leveled logger (reference horovod/common/logging.{h,cc}): TRACE..FATAL,
+// configured by HOROVOD_LOG_LEVEL / HOROVOD_LOG_HIDE_TIME.
+#pragma once
+
+#include <sstream>
+#include <string>
+
+namespace hvd {
+
+enum class LogLevel : int { TRACE = 0, DEBUG = 1, INFO = 2, WARNING = 3, ERROR = 4, FATAL = 5 };
+
+LogLevel MinLogLevelFromEnv();
+bool LogTimestampFromEnv();
+
+class LogMessage {
+ public:
+  LogMessage(const char* file, int line, LogLevel level);
+  ~LogMessage();
+  std::ostringstream& stream() { return stream_; }
+
+ private:
+  std::ostringstream stream_;
+  LogLevel level_;
+};
+
+#define HVD_LOG_LEVEL(lvl) \
+  if (static_cast<int>(lvl) >= static_cast<int>(::hvd::MinLogLevelFromEnv())) \
+  ::hvd::LogMessage(__FILE__, __LINE__, lvl).stream()
+
+#define LOG_TRACE HVD_LOG_LEVEL(::hvd::LogLevel::TRACE)
+#define LOG_DEBUG HVD_LOG_LEVEL(::hvd::LogLevel::DEBUG)
+#define LOG_INFO HVD_LOG_LEVEL(::hvd::LogLevel::INFO)
+#define LOG_WARNING HVD_LOG_LEVEL(::hvd::LogLevel::WARNING)
+#define LOG_ERROR HVD_LOG_LEVEL(::hvd::LogLevel::ERROR)
+
+}  // namespace hvd

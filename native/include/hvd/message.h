@@ -1,0 +1,119 @@
+// Control-plane wire format.
+//
+// Rebuild of the reference's Request/Response messages
+// (horovod/common/message.h:50-251, FlatBuffers schema
+// common/wire/message.fbs). We use a hand-rolled little-endian binary
+// codec instead of FlatBuffers — the messages are small, fixed-layout,
+// and versioned by a single byte, so a dependency-free codec keeps the
+// native core self-contained.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "hvd/common.h"
+
+namespace hvd {
+
+enum class RequestType : uint8_t {
+  ALLREDUCE = 0,
+  ALLGATHER = 1,
+  BROADCAST = 2,
+  ALLTOALL = 3,
+  JOIN = 4,
+  BARRIER = 5,
+  REDUCESCATTER = 6,
+};
+
+const char* RequestTypeName(RequestType t);
+
+// A rank announces "tensor X is ready on me" (reference message.h:50).
+struct Request {
+  int32_t request_rank = 0;
+  RequestType request_type = RequestType::ALLREDUCE;
+  DataType tensor_type = DataType::FLOAT32;
+  std::string tensor_name;
+  std::vector<int64_t> tensor_shape;
+  int32_t root_rank = 0;
+  ReduceOp reduce_op = ReduceOp::SUM;
+  double prescale_factor = 1.0;
+  double postscale_factor = 1.0;
+  std::vector<int64_t> splits;  // alltoall
+  ExecMode exec_mode = ExecMode::HOST;
+  // Grouped collectives: members of a group complete atomically. The
+  // key must be identical across ranks, so it is derived from the
+  // member names (not a per-process counter): key = FNV-1a of the
+  // sorted member-name list. group_size = member count.
+  int64_t group_key = -1;
+  int32_t group_size = 0;
+
+  void SerializeTo(std::string* out) const;
+  static bool ParseFrom(const char** p, const char* end, Request* out);
+};
+
+struct RequestList {
+  std::vector<Request> requests;
+  std::vector<uint32_t> cache_hits;  // bit positions of cached ready tensors
+  bool shutdown = false;
+  int32_t joined = 0;  // 1 if this rank has called join()
+  // Incremental hash of this rank's response-cache contents. The
+  // coordinator compares signatures each cycle; any divergence triggers
+  // a global cache purge + full re-announcement (safety net replacing
+  // the reference's per-cycle bitvector AND/OR sync,
+  // response_cache.h:107-169).
+  uint64_t cache_sig = 0;
+
+  void SerializeTo(std::string* out) const;
+  static bool ParseFrom(const std::string& buf, RequestList* out);
+};
+
+enum class ResponseType : uint8_t {
+  ALLREDUCE = 0,
+  ALLGATHER = 1,
+  BROADCAST = 2,
+  ALLTOALL = 3,
+  JOIN = 4,
+  BARRIER = 5,
+  REDUCESCATTER = 6,
+  ERROR = 7,
+};
+
+const char* ResponseTypeName(ResponseType t);
+
+// Coordinator verdict: these (fused) tensors are ready everywhere — or
+// an agreed-upon error (reference message.h:159-251).
+struct Response {
+  ResponseType response_type = ResponseType::ALLREDUCE;
+  std::vector<std::string> tensor_names;
+  std::string error_message;
+  DataType tensor_type = DataType::FLOAT32;
+  ExecMode exec_mode = ExecMode::HOST;
+  ReduceOp reduce_op = ReduceOp::SUM;  // fused responses share an op class
+  // ALLGATHER: per-rank first-dimension sizes (reference
+  // Response.tensor_sizes). ALLREDUCE: per-tensor element counts, so a
+  // rank without a local entry (joined coordinator) can still serve the
+  // hub data plane. REDUCESCATTER: per-rank first-dim shard sizes.
+  std::vector<int64_t> tensor_sizes;
+  // Alltoall: per-rank recv splits for the (single) tensor.
+  std::vector<int64_t> recvsplits;
+  // Cache bit positions this response (re)occupies, in tensor order;
+  // kept in lockstep on every rank so hit indices agree.
+  std::vector<uint32_t> cache_bits;
+
+  int64_t TotalByteSize() const;  // metadata-derived fused payload size
+
+  void SerializeTo(std::string* out) const;
+  static bool ParseFrom(const char** p, const char* end, Response* out);
+};
+
+struct ResponseList {
+  std::vector<Response> responses;
+  bool shutdown = false;
+  bool purge_cache = false;  // all ranks clear caches + re-announce
+
+  void SerializeTo(std::string* out) const;
+  static bool ParseFrom(const std::string& buf, ResponseList* out);
+};
+
+}  // namespace hvd

@@ -1,0 +1,75 @@
+// Host data-plane executors.
+//
+// Rebuild of the reference op layer (horovod/common/ops/
+// collective_operations.{h,cc} + gloo_operations.cc): once the
+// controller emits a Response, PerformOperation hands the fused entries
+// to an executor. Three executors exist:
+//  * LocalOps  — size==1 semantics (copy input -> output), the analog
+//    of running Horovod without mpirun.
+//  * TcpOps    — multi-process host tensors: pack into the fusion
+//    buffer, reduce through rank 0 over the data-plane sockets
+//    (hub topology v1; the CPU-fallback Gloo analog).
+//  * The CALLBACK path (device tensors / XLA) is dispatched in
+//    operations.cc to the registered Python executor, which launches
+//    jitted XLA collectives over the TPU mesh — the NCCL-ops analog.
+#pragma once
+
+#include <vector>
+
+#include "hvd/common.h"
+#include "hvd/controller.h"
+#include "hvd/fusion_buffer.h"
+#include "hvd/message.h"
+#include "hvd/timeline.h"
+
+namespace hvd {
+
+class OpExecutor {
+ public:
+  OpExecutor(Controller* controller, FusionBufferManager* fusion,
+             Timeline* timeline)
+      : controller_(controller), fusion_(fusion), timeline_(timeline) {}
+  virtual ~OpExecutor() = default;
+
+  // Executes all entries of one response; fires no callbacks (the
+  // caller completes entries so error paths stay uniform).
+  virtual Status Execute(const Response& response,
+                         std::vector<TensorTableEntry>& entries) = 0;
+
+ protected:
+  Controller* controller_;
+  FusionBufferManager* fusion_;
+  Timeline* timeline_;
+};
+
+class LocalOps : public OpExecutor {
+ public:
+  using OpExecutor::OpExecutor;
+  Status Execute(const Response& response,
+                 std::vector<TensorTableEntry>& entries) override;
+};
+
+class TcpOps : public OpExecutor {
+ public:
+  using OpExecutor::OpExecutor;
+  Status Execute(const Response& response,
+                 std::vector<TensorTableEntry>& entries) override;
+
+ private:
+  Status Allreduce(const Response& r, std::vector<TensorTableEntry>& entries);
+  Status Allgather(const Response& r, std::vector<TensorTableEntry>& entries);
+  Status Broadcast(const Response& r, std::vector<TensorTableEntry>& entries);
+  Status Alltoall(const Response& r, std::vector<TensorTableEntry>& entries);
+  Status Reducescatter(const Response& r,
+                       std::vector<TensorTableEntry>& entries);
+};
+
+// Accumulate src into dst elementwise on the host ("SUM"/"MIN"/...),
+// converting 16-bit floats through f32 (reference ops/adasum + CPU
+// ScaleBuffer paths, collective_operations.h:89-125).
+void HostAccumulate(ReduceOp op_class, DataType dtype, const void* src,
+                    void* dst, int64_t count);
+// dst *= factor (f32 math for 16-bit floats).
+void HostScale(DataType dtype, void* dst, int64_t count, double factor);
+
+}  // namespace hvd

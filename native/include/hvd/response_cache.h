@@ -1,0 +1,72 @@
+// Response cache: steady-state training enqueues the same named tensors
+// every step, so after the first negotiation each rank can announce
+// readiness with a single bit index instead of a full Request.
+// Rebuild of horovod/common/response_cache.{h,cc} (response_cache.h:45-102).
+//
+// Divergence from the reference: instead of coordinating cache state
+// with cross-rank bitvector AND/OR allreduces
+// (response_cache.h:107-169 CacheCoordinator), cache contents are kept
+// in deterministic lockstep — every rank inserts/evicts identically,
+// driven by the broadcast ResponseList (which carries the assigned bit
+// in Response::cache_bits). Hit indices therefore agree by
+// construction, and the coordinator simply counts per-bit readiness
+// like it counts named requests.
+#pragma once
+
+#include <cstdint>
+#include <list>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "hvd/common.h"
+#include "hvd/message.h"
+
+namespace hvd {
+
+class ResponseCache {
+ public:
+  enum class CacheState { MISS, HIT, INVALID };
+
+  void SetCapacity(uint32_t capacity) { capacity_ = capacity; }
+  uint32_t capacity() const { return capacity_; }
+  size_t num_active_bits() const { return bit_to_entry_.size(); }
+  // Order-independent content hash (XOR-fold of per-entry hashes);
+  // compared across ranks every cycle to detect divergence.
+  uint64_t signature() const { return sig_; }
+
+  // MISS if not cached; INVALID if cached with different parameters
+  // (shape/dtype/op changed — stale entry must be dropped and
+  // renegotiated); HIT otherwise.
+  CacheState Lookup(const Request& req, uint32_t* bit) const;
+
+  // Deterministic insert-or-touch driven by a broadcast response entry.
+  // Returns the bit position assigned (stable across ranks).
+  uint32_t Put(const Request& req);
+
+  // Rebuilds a Request (for readiness counting / execution metadata)
+  // from a cache bit.
+  bool GetRequestByBit(uint32_t bit, Request* out) const;
+
+  void Erase(uint32_t bit);
+  void Clear();
+
+ private:
+  struct Entry {
+    Request request;
+    uint32_t bit = 0;
+  };
+  uint32_t capacity_ = 1024;
+  uint32_t next_bit_ = 0;
+  uint64_t sig_ = 0;
+  std::unordered_map<std::string, Entry> entries_;     // name -> entry
+  std::unordered_map<uint32_t, std::string> bit_to_entry_;
+  std::list<std::string> lru_;  // front = most recent
+  std::unordered_map<std::string, std::list<std::string>::iterator> lru_pos_;
+
+  void Touch(const std::string& name);
+  static bool SameParams(const Request& a, const Request& b);
+  static uint64_t EntryHash(const Request& req, uint32_t bit);
+};
+
+}  // namespace hvd

@@ -1,0 +1,42 @@
+// Stall inspector: warns when some ranks submitted a tensor while
+// others have not for longer than a threshold — the classic "rank 3
+// diverged" hang. Rebuild of horovod/common/stall_inspector.{h,cc}
+// (stall_inspector.h:30-96); invoked from the coordinator cycle like
+// controller.cc:126-135.
+#pragma once
+
+#include <chrono>
+#include <cstdint>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace hvd {
+
+class StallInspector {
+ public:
+  void SetWarningTime(double secs) { warning_secs_ = secs; }
+  void SetShutdownTime(double secs) { shutdown_secs_ = secs; }
+  double shutdown_time() const { return shutdown_secs_; }
+
+  // Coordinator side: a rank announced readiness for a tensor.
+  void RecordUncachedTensor(const std::string& name, int rank);
+  void RemoveUncachedTensor(const std::string& name);
+
+  // Returns true if the stall has exceeded the shutdown threshold.
+  // Logs a warning listing stalled tensors + missing ranks.
+  bool CheckForStalledTensors(int global_size);
+
+ private:
+  struct Info {
+    std::chrono::steady_clock::time_point first_seen;
+    std::vector<int> ranks;
+  };
+  double warning_secs_ = 60.0;
+  double shutdown_secs_ = 0.0;  // 0 = never shut down
+  std::chrono::steady_clock::time_point last_check_ =
+      std::chrono::steady_clock::now();
+  std::unordered_map<std::string, Info> pending_;
+};
+
+}  // namespace hvd

@@ -1,0 +1,61 @@
+// Minimal TCP framing used by both the controller (control plane) and
+// the host data plane. Plays the role of the reference's Gloo TCP
+// full-mesh + HTTP rendezvous (horovod/common/gloo/): rank 0 listens on
+// HOROVOD_CONTROLLER_ADDR, workers connect and identify themselves, and
+// all traffic is length-prefixed frames.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace hvd {
+
+class TcpConn {
+ public:
+  TcpConn() = default;
+  explicit TcpConn(int fd) : fd_(fd) {}
+  TcpConn(const TcpConn&) = delete;
+  TcpConn& operator=(const TcpConn&) = delete;
+  TcpConn(TcpConn&& o) noexcept : fd_(o.fd_) { o.fd_ = -1; }
+  TcpConn& operator=(TcpConn&& o) noexcept;
+  ~TcpConn();
+
+  bool valid() const { return fd_ >= 0; }
+  int fd() const { return fd_; }
+  void Close();
+
+  // Length-prefixed frame IO; false on socket error/EOF.
+  bool SendFrame(const void* data, uint64_t len);
+  bool SendFrame(const std::string& s) { return SendFrame(s.data(), s.size()); }
+  bool RecvFrame(std::string* out);
+  // Raw exact-count IO for the data plane (no extra copy into a frame).
+  bool SendAll(const void* data, uint64_t len);
+  bool RecvAll(void* data, uint64_t len);
+
+ private:
+  int fd_ = -1;
+};
+
+// Rank-0 side: bind+listen, accept `n` peers on each of two channels
+// (0 = control plane, 1 = data plane); each peer handshakes with
+// (rank, channel). Connections are returned indexed by rank (slot 0
+// unused — rank 0 talks to itself in-process).
+class TcpServer {
+ public:
+  // addr "host:port"; port 0 = ephemeral. Returns bound port or -1.
+  int Listen(const std::string& addr);
+  bool AcceptPeers(int n, std::vector<TcpConn>* control_by_rank,
+                   std::vector<TcpConn>* data_by_rank, int timeout_ms);
+  void Close();
+  ~TcpServer() { Close(); }
+
+ private:
+  int listen_fd_ = -1;
+};
+
+// Worker side: connect (with retry) and handshake (rank, channel).
+bool TcpConnect(const std::string& addr, int my_rank, int channel,
+                int timeout_ms, TcpConn* out);
+
+}  // namespace hvd

@@ -1,0 +1,42 @@
+// Thread-safe pending-tensor table + message queue shared between the
+// enqueueing (framework/Python) threads and the background coordination
+// thread. Rebuild of horovod/common/tensor_queue.{h,cc}
+// (tensor_queue.h:28-64), including duplicate-name rejection.
+#pragma once
+
+#include <deque>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+#include "hvd/common.h"
+#include "hvd/message.h"
+
+namespace hvd {
+
+class TensorQueue {
+ public:
+  // Atomically adds entries+requests; rejects duplicate in-flight names.
+  Status AddToTensorQueue(std::vector<TensorTableEntry> entries,
+                          std::vector<Request> requests);
+
+  // Drains pending requests for one controller cycle.
+  void PopMessagesFromQueue(std::vector<Request>* out);
+
+  // Removes and returns the entries named by a response.
+  void GetTensorEntriesFromResponse(const Response& response,
+                                    std::vector<TensorTableEntry>* entries);
+
+  // Fails every in-flight entry (shutdown / fatal controller error).
+  void FailAll(const Status& status);
+
+  size_t size() const;
+  bool Lookup(const std::string& name, TensorTableEntry* out) const;
+
+ private:
+  mutable std::mutex mu_;
+  std::unordered_map<std::string, TensorTableEntry> table_;
+  std::deque<Request> queue_;
+};
+
+}  // namespace hvd

@@ -1,0 +1,65 @@
+// Chrome-tracing timeline: per-tensor NEGOTIATING / TOP_LEVEL /
+// ACTIVITY phases written as chrome://tracing JSON by a dedicated
+// writer thread. Rebuild of horovod/common/timeline.{h,cc}
+// (timeline.h:48-148) with a mutex'd MPSC queue in place of the boost
+// lock-free SPSC (the writer drains in batches; producers only append a
+// small struct under the lock, which at cycle cadence is not a
+// bottleneck on the host side — the TPU-side trace story is
+// jax.profiler, this host timeline covers the coordination runtime).
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <fstream>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+
+namespace hvd {
+
+class Timeline {
+ public:
+  ~Timeline();
+
+  void Initialize(const std::string& path, int rank);
+  void Shutdown();
+  bool Initialized() const { return initialized_.load(); }
+
+  // Phase transitions (reference timeline.cc:496,543,599).
+  void NegotiateStart(const std::string& name, const std::string& op);
+  void NegotiateRankReady(const std::string& name, int rank);
+  void NegotiateEnd(const std::string& name);
+  void Start(const std::string& name, const std::string& op);
+  void ActivityStart(const std::string& name, const std::string& activity);
+  void ActivityEnd(const std::string& name);
+  void End(const std::string& name, int64_t bytes);
+  void MarkCycleStart();
+
+ private:
+  struct Event {
+    char phase;  // 'B' begin, 'E' end, 'i' instant
+    std::string tid;
+    std::string name;
+    std::string args;
+    int64_t ts_us;
+  };
+  void Enqueue(char phase, const std::string& tid, const std::string& name,
+               std::string args = "");
+  void WriterLoop();
+  int64_t NowUs() const;
+
+  std::atomic<bool> initialized_{false};
+  std::atomic<bool> shutdown_{false};
+  std::ofstream file_;
+  std::thread writer_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<Event> events_;
+  int64_t start_us_ = 0;
+  bool wrote_header_ = false;
+};
+
+}  // namespace hvd

@@ -1,0 +1,620 @@
+#include "hvd/controller.h"
+
+#include <algorithm>
+#include <cstdlib>
+
+#include "hvd/logging.h"
+
+namespace hvd {
+
+namespace {
+
+// Reduce ops that can share a fused buffer (AVERAGE folds into SUM via
+// per-entry postscale at the executor).
+int OpClass(ReduceOp op) {
+  switch (op) {
+    case ReduceOp::AVERAGE:
+    case ReduceOp::SUM:
+    case ReduceOp::ADASUM:
+      return 0;
+    case ReduceOp::MIN:
+      return 1;
+    case ReduceOp::MAX:
+      return 2;
+    case ReduceOp::PRODUCT:
+      return 3;
+  }
+  return 0;
+}
+
+int64_t RequestBytes(const Request& req) {
+  int64_t n = 1;
+  for (auto d : req.tensor_shape) n *= d;
+  return n * static_cast<int64_t>(DataTypeSize(req.tensor_type));
+}
+
+}  // namespace
+
+void Controller::AccumulateRequest(const Request& req,
+                                   std::map<std::string, PendingTensor>* table) {
+  auto& pending = (*table)[req.tensor_name];
+  if (pending.ranks.count(req.request_rank)) {
+    LOG_WARNING << "rank " << req.request_rank << " re-announced tensor "
+                << req.tensor_name << " before completion; ignoring";
+    return;
+  }
+  pending.ranks.insert(req.request_rank);
+  pending.requests.push_back(req);
+  if (deps_.stall_inspector)
+    deps_.stall_inspector->RecordUncachedTensor(req.tensor_name,
+                                                req.request_rank);
+  if (deps_.timeline && req.request_rank == rank_)
+    deps_.timeline->NegotiateStart(req.tensor_name,
+                                   RequestTypeName(req.request_type));
+}
+
+Response Controller::ConstructResponse(const std::string& name,
+                                       PendingTensor& pending,
+                                       const std::vector<int>& active_ranks) {
+  auto& reqs = pending.requests;
+  const Request& first = reqs.front();
+  Response resp;
+  resp.tensor_names = {name};
+  resp.response_type = static_cast<ResponseType>(first.request_type);
+  resp.tensor_type = first.tensor_type;
+  resp.exec_mode = first.exec_mode;
+  resp.reduce_op = first.reduce_op;
+
+  std::string err;
+  for (const auto& r : reqs) {
+    if (r.request_type != first.request_type) {
+      err = "mismatched collective type across ranks (" +
+            std::string(RequestTypeName(first.request_type)) + " vs " +
+            RequestTypeName(r.request_type) + ")";
+      break;
+    }
+    if (r.tensor_type != first.tensor_type) {
+      err = "mismatched dtype across ranks (" +
+            std::string(DataTypeName(first.tensor_type)) + " vs " +
+            DataTypeName(r.tensor_type) + ")";
+      break;
+    }
+    if (r.exec_mode != first.exec_mode) {
+      err = "mismatched execution mode across ranks";
+      break;
+    }
+  }
+
+  bool has_joined = static_cast<int>(active_ranks.size()) < size_;
+
+  if (err.empty()) {
+    switch (first.request_type) {
+      case RequestType::ALLREDUCE:
+      case RequestType::REDUCESCATTER: {
+        for (const auto& r : reqs) {
+          if (r.tensor_shape != first.tensor_shape) {
+            err = "mismatched shape across ranks";
+            break;
+          }
+          if (r.reduce_op != first.reduce_op ||
+              r.prescale_factor != first.prescale_factor ||
+              r.postscale_factor != first.postscale_factor) {
+            err = "mismatched reduce op / scale factors across ranks";
+            break;
+          }
+          if (r.group_key != first.group_key ||
+              r.group_size != first.group_size) {
+            err = "mismatched grouping across ranks";
+            break;
+          }
+        }
+        if (err.empty() && first.request_type == RequestType::ALLREDUCE) {
+          int64_t n = 1;
+          for (auto d : first.tensor_shape) n *= d;
+          resp.tensor_sizes.push_back(n);  // element count (hub sizing)
+        }
+        if (err.empty() && first.request_type == RequestType::REDUCESCATTER) {
+          if (has_joined) {
+            err = "reducescatter is not supported while ranks are joined";
+          } else {
+            // Per-rank output first-dims: dim0 split as evenly as
+            // possible, remainder to the lower ranks.
+            int64_t dim0 = first.tensor_shape.empty() ? 1 : first.tensor_shape[0];
+            int64_t base = dim0 / size_, rem = dim0 % size_;
+            for (int r = 0; r < size_; ++r)
+              resp.tensor_sizes.push_back(base + (r < rem ? 1 : 0));
+          }
+        }
+        break;
+      }
+      case RequestType::BROADCAST: {
+        if (has_joined) {
+          err = "broadcast is not supported while ranks are joined";
+          break;
+        }
+        for (const auto& r : reqs) {
+          if (r.root_rank != first.root_rank) {
+            err = "mismatched broadcast root rank across ranks";
+            break;
+          }
+          if (r.tensor_shape != first.tensor_shape) {
+            err = "mismatched shape across ranks";
+            break;
+          }
+        }
+        if (first.root_rank < 0 || first.root_rank >= size_)
+          err = "broadcast root rank out of range";
+        break;
+      }
+      case RequestType::ALLGATHER: {
+        if (has_joined) {
+          err = "allgather is not supported while ranks are joined";
+          break;
+        }
+        // Shapes must agree on every dim except 0; gather per-rank dim0
+        // ordered by rank (reference Response.tensor_sizes).
+        std::vector<const Request*> by_rank(size_, nullptr);
+        for (const auto& r : reqs) by_rank[r.request_rank] = &r;
+        for (const auto& r : reqs) {
+          if (r.tensor_shape.size() != first.tensor_shape.size() ||
+              r.tensor_shape.empty()) {
+            err = "mismatched tensor rank across ranks (allgather needs >= 1 "
+                  "dim, equal beyond dim 0)";
+            break;
+          }
+          for (size_t d = 1; d < r.tensor_shape.size(); ++d) {
+            if (r.tensor_shape[d] != first.tensor_shape[d]) {
+              err = "mismatched non-first dimension across ranks";
+              break;
+            }
+          }
+          if (!err.empty()) break;
+        }
+        if (err.empty())
+          for (int r = 0; r < size_; ++r)
+            resp.tensor_sizes.push_back(by_rank[r]->tensor_shape[0]);
+        break;
+      }
+      case RequestType::ALLTOALL: {
+        if (has_joined) {
+          err = "alltoall is not supported while ranks are joined";
+          break;
+        }
+        std::vector<const Request*> by_rank(size_, nullptr);
+        for (const auto& r : reqs) by_rank[r.request_rank] = &r;
+        std::vector<std::vector<int64_t>> splits(size_);
+        for (int r = 0; r < size_ && err.empty(); ++r) {
+          const Request& rq = *by_rank[r];
+          if (rq.tensor_shape.empty()) {
+            err = "alltoall tensor needs >= 1 dim";
+            break;
+          }
+          for (size_t d = 1; d < rq.tensor_shape.size(); ++d) {
+            if (rq.tensor_shape.size() != first.tensor_shape.size() ||
+                rq.tensor_shape[d] != first.tensor_shape[d]) {
+              err = "mismatched non-first dimension across ranks";
+              break;
+            }
+          }
+          if (!err.empty()) break;
+          if (rq.splits.empty()) {
+            if (rq.tensor_shape[0] % size_ != 0) {
+              err = "alltoall first dim not divisible by size and no splits "
+                    "given";
+              break;
+            }
+            splits[r].assign(size_, rq.tensor_shape[0] / size_);
+          } else if (static_cast<int>(rq.splits.size()) != size_) {
+            err = "alltoall splits length must equal size";
+            break;
+          } else {
+            int64_t sum = 0;
+            for (auto s : rq.splits) {
+              if (s < 0) {
+                err = "negative alltoall split";
+                break;
+              }
+              sum += s;
+            }
+            if (err.empty() && sum != rq.tensor_shape[0]) {
+              err = "alltoall splits do not sum to first dimension";
+              break;
+            }
+            splits[r] = rq.splits;
+          }
+        }
+        if (err.empty()) {
+          // recvsplits[r * size + k] = what rank r receives from rank k.
+          resp.recvsplits.resize(static_cast<size_t>(size_) * size_);
+          for (int r = 0; r < size_; ++r)
+            for (int k = 0; k < size_; ++k)
+              resp.recvsplits[static_cast<size_t>(r) * size_ + k] =
+                  splits[k][r];
+        }
+        break;
+      }
+      case RequestType::BARRIER:
+      case RequestType::JOIN:
+        break;
+    }
+  }
+
+  if (!err.empty()) {
+    resp.response_type = ResponseType::ERROR;
+    resp.error_message = name + ": " + err;
+    LOG_ERROR << "coordinator: " << resp.error_message;
+  }
+  return resp;
+}
+
+ResponseList Controller::CoordinatorStep(
+    std::map<std::string, PendingTensor>* table,
+    const std::vector<int>& active_ranks, bool shutdown) {
+  const int needed = static_cast<int>(active_ranks.size());
+
+  // Ready names (all active ranks announced), group-atomically.
+  std::vector<std::string> ready;
+  std::map<int64_t, std::vector<std::string>> group_ready;
+  for (auto& kv : *table) {
+    if (static_cast<int>(kv.second.ranks.size()) != needed) continue;
+    const Request& first = kv.second.requests.front();
+    if (first.group_key >= 0) {
+      group_ready[first.group_key].push_back(kv.first);
+    } else {
+      ready.push_back(kv.first);
+    }
+  }
+  for (auto& kv : group_ready) {
+    const auto& names = kv.second;
+    int group_size = (*table)[names.front()].requests.front().group_size;
+    if (static_cast<int>(names.size()) >= group_size)
+      ready.insert(ready.end(), names.begin(), names.end());
+  }
+  std::sort(ready.begin(), ready.end());
+
+  struct Built {
+    Response resp;
+    int64_t bytes;
+    int op_class;
+  };
+  std::vector<Built> built;
+  for (const auto& name : ready) {
+    auto it = table->find(name);
+    Built b;
+    b.resp = ConstructResponse(name, it->second, active_ranks);
+    b.bytes = RequestBytes(it->second.requests.front());
+    b.op_class = OpClass(it->second.requests.front().reduce_op);
+    built.push_back(std::move(b));
+    if (deps_.stall_inspector)
+      deps_.stall_inspector->RemoveUncachedTensor(name);
+    table->erase(it);
+  }
+
+  // Fuse allreduces with matching (dtype, exec mode, op class) up to the
+  // fusion threshold (reference FuseResponses, controller.cc:777).
+  ResponseList out;
+  out.shutdown = shutdown;
+  std::vector<bool> used(built.size(), false);
+  for (size_t i = 0; i < built.size(); ++i) {
+    if (used[i]) continue;
+    used[i] = true;
+    Response merged = std::move(built[i].resp);
+    if (merged.response_type == ResponseType::ALLREDUCE) {
+      int64_t bytes = built[i].bytes;
+      for (size_t j = i + 1; j < built.size(); ++j) {
+        if (used[j]) continue;
+        const Response& cand = built[j].resp;
+        if (cand.response_type != ResponseType::ALLREDUCE ||
+            cand.tensor_type != merged.tensor_type ||
+            cand.exec_mode != merged.exec_mode ||
+            built[j].op_class != built[i].op_class)
+          continue;
+        if (bytes + built[j].bytes > fusion_threshold_bytes_) continue;
+        merged.tensor_names.push_back(cand.tensor_names.front());
+        merged.tensor_sizes.push_back(cand.tensor_sizes.front());
+        bytes += built[j].bytes;
+        used[j] = true;
+      }
+    }
+    out.responses.push_back(std::move(merged));
+  }
+
+  if (deps_.stall_inspector &&
+      deps_.stall_inspector->CheckForStalledTensors(size_)) {
+    LOG_ERROR << "stall inspector exceeded shutdown threshold; shutting down";
+    out.shutdown = true;
+  }
+  return out;
+}
+
+void Controller::UpdateCacheFromResponses(const ResponseList& list) {
+  if (!deps_.response_cache || !deps_.tensor_queue) return;
+  for (const auto& resp : list.responses) {
+    if (resp.response_type == ResponseType::ERROR ||
+        resp.response_type == ResponseType::JOIN ||
+        resp.response_type == ResponseType::BARRIER)
+      continue;
+    for (const auto& name : resp.tensor_names) {
+      TensorTableEntry entry;
+      if (!deps_.tensor_queue->Lookup(name, &entry)) continue;
+      Request req;
+      req.request_rank = rank_;
+      req.request_type = static_cast<RequestType>(resp.response_type);
+      req.tensor_type = entry.dtype;
+      req.tensor_name = name;
+      req.tensor_shape = entry.shape.dims();
+      req.root_rank = entry.root_rank;
+      req.reduce_op = entry.reduce_op;
+      req.prescale_factor = entry.prescale_factor;
+      req.postscale_factor = entry.postscale_factor;
+      req.splits = entry.splits;
+      req.exec_mode = entry.exec_mode;
+      req.group_key = entry.group_key;
+      req.group_size = entry.group_size;
+      deps_.response_cache->Put(req);
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// LocalController
+// ---------------------------------------------------------------------------
+
+ResponseList LocalController::ComputeResponseList(bool shutdown_requested) {
+  std::vector<Request> msgs;
+  deps_.tensor_queue->PopMessagesFromQueue(&msgs);
+  ResponseList out;
+  std::vector<Response> pre;
+  for (auto& req : msgs) {
+    if (req.request_type == RequestType::JOIN) {
+      Response r;
+      r.response_type = ResponseType::JOIN;
+      r.tensor_names = {req.tensor_name};
+      pre.push_back(std::move(r));
+      continue;
+    }
+    req.request_rank = 0;
+    AccumulateRequest(req, &table_);
+  }
+  out = CoordinatorStep(&table_, {0}, shutdown_requested);
+  for (auto& r : pre) out.responses.push_back(std::move(r));
+  UpdateCacheFromResponses(out);
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// TcpController
+// ---------------------------------------------------------------------------
+
+Status TcpController::Initialize() {
+  joined_ranks_.assign(size_, false);
+  if (size_ == 1) return Status::OK();
+  int timeout_ms = 120000;
+  if (const char* t = std::getenv("HOROVOD_CONTROLLER_TIMEOUT_MS"))
+    timeout_ms = std::atoi(t);
+  if (rank_ == 0) {
+    // addr may be "0.0.0.0:port"; the launcher guarantees the port.
+    if (server_.Listen(addr_) < 0)
+      return Status::UnknownError("controller failed to listen on " + addr_);
+    if (!server_.AcceptPeers(size_ - 1, &ctrl_conns_, &data_conns_,
+                             timeout_ms))
+      return Status::UnknownError(
+          "controller timed out waiting for workers to connect");
+  } else {
+    ctrl_conns_.resize(1);
+    data_conns_.resize(1);
+    if (!TcpConnect(addr_, rank_, 0, timeout_ms, &ctrl_conns_[0]) ||
+        !TcpConnect(addr_, rank_, 1, timeout_ms, &data_conns_[0]))
+      return Status::UnknownError("worker failed to connect to controller at " +
+                                  addr_);
+  }
+  LOG_DEBUG << "rank " << rank_ << "/" << size_ << " controller connected";
+  return Status::OK();
+}
+
+TcpConn* TcpController::DataConn(int peer_rank) {
+  if (size_ == 1) return nullptr;
+  if (rank_ == 0) return &data_conns_[peer_rank];
+  return &data_conns_[0];
+}
+
+RequestList TcpController::BuildRequestList(bool shutdown, bool* saw_join) {
+  std::vector<Request> msgs;
+  deps_.tensor_queue->PopMessagesFromQueue(&msgs);
+  RequestList list;
+  list.shutdown = shutdown;
+  list.joined = i_am_joined_ ? 1 : 0;
+  for (auto& req : msgs) {
+    req.request_rank = rank_;
+    if (req.request_type == RequestType::JOIN) {
+      *saw_join = true;
+      i_am_joined_ = true;
+      list.joined = 1;
+      continue;  // conveyed via the joined flag
+    }
+    uint32_t bit = 0;
+    if (deps_.response_cache) {
+      auto state = deps_.response_cache->Lookup(req, &bit);
+      if (state == ResponseCache::CacheState::HIT) {
+        list.cache_hits.push_back(bit);
+        if (deps_.timeline)
+          deps_.timeline->NegotiateStart(req.tensor_name,
+                                         RequestTypeName(req.request_type));
+        continue;
+      }
+    }
+    list.requests.push_back(req);
+  }
+  list.cache_sig = deps_.response_cache ? deps_.response_cache->signature() : 0;
+  return list;
+}
+
+ResponseList TcpController::ComputeResponseList(bool shutdown_requested) {
+  bool saw_join = false;
+  RequestList my_list = BuildRequestList(shutdown_requested, &saw_join);
+  if (size_ == 1) {
+    // Degenerate distributed mode: behave like LocalController.
+    ResponseList out;
+    for (auto& req : my_list.requests) AccumulateRequest(req, &table_);
+    std::vector<int> active = {0};
+    out = CoordinatorStep(&table_, active, my_list.shutdown);
+    if (saw_join) {
+      Response r;
+      r.response_type = ResponseType::JOIN;
+      r.tensor_names = {"join"};
+      out.responses.push_back(std::move(r));
+      i_am_joined_ = false;
+    }
+    UpdateCacheFromResponses(out);
+    return out;
+  }
+  return rank_ == 0 ? CoordinatorCycle(std::move(my_list), shutdown_requested)
+                    : WorkerCycle(std::move(my_list));
+}
+
+ResponseList TcpController::CoordinatorCycle(RequestList my_list,
+                                             bool shutdown) {
+  // Track own announcements for purge recovery (same as workers).
+  for (const auto& req : my_list.requests) announced_[req.tensor_name] = req;
+  for (uint32_t bit : my_list.cache_hits) {
+    Request req;
+    if (deps_.response_cache &&
+        deps_.response_cache->GetRequestByBit(bit, &req))
+      announced_[req.tensor_name] = req;
+  }
+
+  std::vector<RequestList> lists(size_);
+  lists[0] = std::move(my_list);
+  bool any_shutdown = lists[0].shutdown;
+  for (int r = 1; r < size_; ++r) {
+    std::string buf;
+    if (!ctrl_conns_[r].RecvFrame(&buf) ||
+        !RequestList::ParseFrom(buf, &lists[r])) {
+      LOG_ERROR << "coordinator lost connection to rank " << r
+                << "; shutting down";
+      ResponseList out;
+      out.shutdown = true;
+      Broadcast(out);
+      return out;
+    }
+    any_shutdown |= lists[r].shutdown;
+  }
+
+  // Cache-signature agreement check.
+  bool purge = false;
+  for (int r = 1; r < size_; ++r) {
+    if (lists[r].cache_sig != lists[0].cache_sig) purge = true;
+  }
+  if (purge) {
+    LOG_WARNING << "response cache divergence detected; purging all caches";
+    table_.clear();
+    if (deps_.response_cache) deps_.response_cache->Clear();
+    // Re-announce rank 0's unresolved requests next cycle.
+    std::vector<Request> requeue;
+    for (auto& kv : announced_) requeue.push_back(kv.second);
+    announced_.clear();
+    deps_.tensor_queue->AddToTensorQueue({}, std::move(requeue));
+    ResponseList out;
+    out.purge_cache = true;
+    out.shutdown = any_shutdown;
+    Broadcast(out);
+    return out;
+  }
+
+  for (int r = 0; r < size_; ++r) {
+    if (lists[r].joined) joined_ranks_[r] = true;
+    for (auto& req : lists[r].requests) AccumulateRequest(req, &table_);
+    for (uint32_t bit : lists[r].cache_hits) {
+      Request req;
+      if (!deps_.response_cache ||
+          !deps_.response_cache->GetRequestByBit(bit, &req)) {
+        LOG_ERROR << "unknown cache bit " << bit << " from rank " << r
+                  << " despite matching signatures";
+        continue;
+      }
+      req.request_rank = r;
+      AccumulateRequest(req, &table_);
+    }
+  }
+
+  std::vector<int> active;
+  for (int r = 0; r < size_; ++r)
+    if (!joined_ranks_[r]) active.push_back(r);
+
+  ResponseList out;
+  if (active.empty()) {
+    // Everyone joined: emit the JOIN response and reset.
+    out.shutdown = any_shutdown;
+    Response r;
+    r.response_type = ResponseType::JOIN;
+    r.tensor_names = {"join"};
+    out.responses.push_back(std::move(r));
+    joined_ranks_.assign(size_, false);
+    i_am_joined_ = false;
+  } else {
+    out = CoordinatorStep(&table_, active, any_shutdown);
+  }
+  Broadcast(out);
+  UpdateCacheFromResponses(out);
+  return out;
+}
+
+ResponseList TcpController::WorkerCycle(RequestList my_list) {
+  // Track announced-but-unresolved names for purge recovery.
+  for (const auto& req : my_list.requests) announced_[req.tensor_name] = req;
+  for (uint32_t bit : my_list.cache_hits) {
+    Request req;
+    if (deps_.response_cache &&
+        deps_.response_cache->GetRequestByBit(bit, &req))
+      announced_[req.tensor_name] = req;
+  }
+
+  std::string buf;
+  my_list.SerializeTo(&buf);
+  ResponseList out;
+  if (!ctrl_conns_[0].SendFrame(buf) || !ctrl_conns_[0].RecvFrame(&buf) ||
+      !ResponseList::ParseFrom(buf, &out)) {
+    LOG_ERROR << "worker lost connection to coordinator; shutting down";
+    out.responses.clear();
+    out.shutdown = true;
+    return out;
+  }
+  if (out.purge_cache) {
+    if (deps_.response_cache) deps_.response_cache->Clear();
+    // Re-announce everything unresolved as full requests next cycle.
+    std::vector<Request> requeue;
+    for (auto& kv : announced_) requeue.push_back(kv.second);
+    announced_.clear();
+    deps_.tensor_queue->AddToTensorQueue({}, std::move(requeue));
+    return out;
+  }
+  for (const auto& resp : out.responses) {
+    for (const auto& name : resp.tensor_names) {
+      announced_.erase(name);
+      if (deps_.timeline) deps_.timeline->NegotiateEnd(name);
+    }
+    if (resp.response_type == ResponseType::JOIN) i_am_joined_ = false;
+  }
+  UpdateCacheFromResponses(out);
+  return out;
+}
+
+void TcpController::Broadcast(const ResponseList& list) {
+  std::string buf;
+  list.SerializeTo(&buf);
+  for (int r = 1; r < size_; ++r) {
+    if (!ctrl_conns_[r].SendFrame(buf))
+      LOG_ERROR << "coordinator failed to send responses to rank " << r;
+  }
+  if (deps_.timeline) {
+    for (const auto& resp : list.responses)
+      for (const auto& name : resp.tensor_names)
+        deps_.timeline->NegotiateEnd(name);
+  }
+  for (const auto& resp : list.responses) {
+    if (resp.response_type == ResponseType::JOIN) i_am_joined_ = false;
+    for (const auto& name : resp.tensor_names) announced_.erase(name);
+  }
+}
+
+}  // namespace hvd

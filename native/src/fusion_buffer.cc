@@ -1,0 +1,14 @@
+#include "hvd/fusion_buffer.h"
+
+#include <algorithm>
+
+namespace hvd {
+
+void* FusionBufferManager::GetBuffer(int key, int64_t min_bytes) {
+  auto& buf = buffers_[key];
+  int64_t want = std::max<int64_t>(min_bytes, size_);
+  if (static_cast<int64_t>(buf.size()) < want) buf.resize(want);
+  return buf.data();
+}
+
+}  // namespace hvd

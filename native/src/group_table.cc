@@ -1,0 +1,31 @@
+#include "hvd/group_table.h"
+
+namespace hvd {
+
+int32_t GroupTable::RegisterGroup(std::vector<std::string> names) {
+  std::lock_guard<std::mutex> lock(mu_);
+  int32_t id = next_id_++;
+  groups_[id] = std::move(names);
+  return id;
+}
+
+bool GroupTable::GetGroup(int32_t id, std::vector<std::string>* names) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = groups_.find(id);
+  if (it == groups_.end()) return false;
+  *names = it->second;
+  return true;
+}
+
+void GroupTable::DeregisterGroup(int32_t id) {
+  std::lock_guard<std::mutex> lock(mu_);
+  groups_.erase(id);
+}
+
+size_t GroupTable::GroupSize(int32_t id) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = groups_.find(id);
+  return it == groups_.end() ? 0 : it->second.size();
+}
+
+}  // namespace hvd

@@ -1,0 +1,64 @@
+#include "hvd/logging.h"
+
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <mutex>
+
+namespace hvd {
+
+static LogLevel ParseLevel() {
+  const char* env = std::getenv("HOROVOD_LOG_LEVEL");
+  if (env == nullptr) return LogLevel::WARNING;
+  std::string s(env);
+  for (auto& c : s) c = static_cast<char>(::tolower(c));
+  if (s == "trace") return LogLevel::TRACE;
+  if (s == "debug") return LogLevel::DEBUG;
+  if (s == "info") return LogLevel::INFO;
+  if (s == "warning" || s == "warn") return LogLevel::WARNING;
+  if (s == "error") return LogLevel::ERROR;
+  if (s == "fatal") return LogLevel::FATAL;
+  return LogLevel::WARNING;
+}
+
+LogLevel MinLogLevelFromEnv() {
+  static LogLevel level = ParseLevel();
+  return level;
+}
+
+bool LogTimestampFromEnv() {
+  static bool hide = std::getenv("HOROVOD_LOG_HIDE_TIME") != nullptr;
+  return !hide;
+}
+
+static const char* kLevelNames[] = {"trace", "debug", "info",
+                                    "warning", "error", "fatal"};
+
+LogMessage::LogMessage(const char* file, int line, LogLevel level)
+    : level_(level) {
+  const char* base = std::strrchr(file, '/');
+  stream_ << "[" << kLevelNames[static_cast<int>(level)] << " "
+          << (base ? base + 1 : file) << ":" << line << "] ";
+}
+
+LogMessage::~LogMessage() {
+  static std::mutex mu;
+  std::lock_guard<std::mutex> lock(mu);
+  if (LogTimestampFromEnv()) {
+    auto now = std::chrono::system_clock::now();
+    auto t = std::chrono::system_clock::to_time_t(now);
+    auto us = std::chrono::duration_cast<std::chrono::microseconds>(
+                  now.time_since_epoch()).count() % 1000000;
+    char buf[32];
+    std::strftime(buf, sizeof(buf), "%H:%M:%S", std::localtime(&t));
+    std::fprintf(stderr, "[%s.%06ld] %s\n", buf, static_cast<long>(us),
+                 stream_.str().c_str());
+  } else {
+    std::fprintf(stderr, "%s\n", stream_.str().c_str());
+  }
+  if (level_ == LogLevel::FATAL) std::abort();
+}
+
+}  // namespace hvd

@@ -1,0 +1,228 @@
+#include "hvd/message.h"
+
+#include <cstring>
+
+namespace hvd {
+
+namespace {
+
+// Little-endian primitive writers/readers with bounds checks.
+template <typename T>
+void WriteScalar(std::string* out, T v) {
+  out->append(reinterpret_cast<const char*>(&v), sizeof(T));
+}
+
+void WriteString(std::string* out, const std::string& s) {
+  WriteScalar<uint32_t>(out, static_cast<uint32_t>(s.size()));
+  out->append(s);
+}
+
+template <typename T>
+void WriteVec(std::string* out, const std::vector<T>& v) {
+  WriteScalar<uint32_t>(out, static_cast<uint32_t>(v.size()));
+  if (!v.empty())
+    out->append(reinterpret_cast<const char*>(v.data()), v.size() * sizeof(T));
+}
+
+template <typename T>
+bool ReadScalar(const char** p, const char* end, T* v) {
+  if (end - *p < static_cast<ptrdiff_t>(sizeof(T))) return false;
+  std::memcpy(v, *p, sizeof(T));
+  *p += sizeof(T);
+  return true;
+}
+
+bool ReadString(const char** p, const char* end, std::string* s) {
+  uint32_t n;
+  if (!ReadScalar(p, end, &n)) return false;
+  if (end - *p < static_cast<ptrdiff_t>(n)) return false;
+  s->assign(*p, n);
+  *p += n;
+  return true;
+}
+
+template <typename T>
+bool ReadVec(const char** p, const char* end, std::vector<T>* v) {
+  uint32_t n;
+  if (!ReadScalar(p, end, &n)) return false;
+  if (end - *p < static_cast<ptrdiff_t>(n * sizeof(T))) return false;
+  v->resize(n);
+  if (n) std::memcpy(v->data(), *p, n * sizeof(T));
+  *p += n * sizeof(T);
+  return true;
+}
+
+}  // namespace
+
+const char* RequestTypeName(RequestType t) {
+  switch (t) {
+    case RequestType::ALLREDUCE: return "ALLREDUCE";
+    case RequestType::ALLGATHER: return "ALLGATHER";
+    case RequestType::BROADCAST: return "BROADCAST";
+    case RequestType::ALLTOALL: return "ALLTOALL";
+    case RequestType::JOIN: return "JOIN";
+    case RequestType::BARRIER: return "BARRIER";
+    case RequestType::REDUCESCATTER: return "REDUCESCATTER";
+  }
+  return "?";
+}
+
+const char* ResponseTypeName(ResponseType t) {
+  if (t == ResponseType::ERROR) return "ERROR";
+  return RequestTypeName(static_cast<RequestType>(t));
+}
+
+const char* DataTypeName(DataType dt) {
+  switch (dt) {
+    case DataType::UINT8: return "uint8";
+    case DataType::INT8: return "int8";
+    case DataType::UINT16: return "uint16";
+    case DataType::INT16: return "int16";
+    case DataType::INT32: return "int32";
+    case DataType::INT64: return "int64";
+    case DataType::FLOAT16: return "float16";
+    case DataType::FLOAT32: return "float32";
+    case DataType::FLOAT64: return "float64";
+    case DataType::BOOL: return "bool";
+    case DataType::BFLOAT16: return "bfloat16";
+  }
+  return "?";
+}
+
+std::string TensorShape::DebugString() const {
+  std::string s = "[";
+  for (size_t i = 0; i < dims_.size(); ++i) {
+    if (i) s += ", ";
+    s += std::to_string(dims_[i]);
+  }
+  return s + "]";
+}
+
+void Request::SerializeTo(std::string* out) const {
+  WriteScalar<int32_t>(out, request_rank);
+  WriteScalar<uint8_t>(out, static_cast<uint8_t>(request_type));
+  WriteScalar<uint8_t>(out, static_cast<uint8_t>(tensor_type));
+  WriteString(out, tensor_name);
+  WriteVec(out, tensor_shape);
+  WriteScalar<int32_t>(out, root_rank);
+  WriteScalar<uint8_t>(out, static_cast<uint8_t>(reduce_op));
+  WriteScalar<double>(out, prescale_factor);
+  WriteScalar<double>(out, postscale_factor);
+  WriteVec(out, splits);
+  WriteScalar<uint8_t>(out, static_cast<uint8_t>(exec_mode));
+  WriteScalar<int64_t>(out, group_key);
+  WriteScalar<int32_t>(out, group_size);
+}
+
+bool Request::ParseFrom(const char** p, const char* end, Request* r) {
+  uint8_t rt, tt, ro, em;
+  bool ok = ReadScalar(p, end, &r->request_rank) && ReadScalar(p, end, &rt) &&
+            ReadScalar(p, end, &tt) && ReadString(p, end, &r->tensor_name) &&
+            ReadVec(p, end, &r->tensor_shape) &&
+            ReadScalar(p, end, &r->root_rank) && ReadScalar(p, end, &ro) &&
+            ReadScalar(p, end, &r->prescale_factor) &&
+            ReadScalar(p, end, &r->postscale_factor) &&
+            ReadVec(p, end, &r->splits) && ReadScalar(p, end, &em) &&
+            ReadScalar(p, end, &r->group_key) &&
+            ReadScalar(p, end, &r->group_size);
+  if (!ok) return false;
+  r->request_type = static_cast<RequestType>(rt);
+  r->tensor_type = static_cast<DataType>(tt);
+  r->reduce_op = static_cast<ReduceOp>(ro);
+  r->exec_mode = static_cast<ExecMode>(em);
+  return true;
+}
+
+void RequestList::SerializeTo(std::string* out) const {
+  WriteScalar<uint8_t>(out, 1);  // version
+  WriteScalar<uint8_t>(out, shutdown ? 1 : 0);
+  WriteScalar<int32_t>(out, joined);
+  WriteScalar<uint64_t>(out, cache_sig);
+  WriteVec(out, cache_hits);
+  WriteScalar<uint32_t>(out, static_cast<uint32_t>(requests.size()));
+  for (const auto& r : requests) r.SerializeTo(out);
+}
+
+bool RequestList::ParseFrom(const std::string& buf, RequestList* out) {
+  const char* p = buf.data();
+  const char* end = p + buf.size();
+  uint8_t ver, sd;
+  if (!ReadScalar(&p, end, &ver) || ver != 1) return false;
+  if (!ReadScalar(&p, end, &sd)) return false;
+  out->shutdown = sd != 0;
+  if (!ReadScalar(&p, end, &out->joined)) return false;
+  if (!ReadScalar(&p, end, &out->cache_sig)) return false;
+  if (!ReadVec(&p, end, &out->cache_hits)) return false;
+  uint32_t n;
+  if (!ReadScalar(&p, end, &n)) return false;
+  out->requests.resize(n);
+  for (uint32_t i = 0; i < n; ++i)
+    if (!Request::ParseFrom(&p, end, &out->requests[i])) return false;
+  return true;
+}
+
+int64_t Response::TotalByteSize() const {
+  // Only meaningful for ALLREDUCE (fused) responses where every entry
+  // keeps its enqueue-time shape; other op types derive sizes from
+  // tensor_sizes/recvsplits at execution.
+  return 0;
+}
+
+void Response::SerializeTo(std::string* out) const {
+  WriteScalar<uint8_t>(out, static_cast<uint8_t>(response_type));
+  WriteScalar<uint8_t>(out, static_cast<uint8_t>(tensor_type));
+  WriteScalar<uint8_t>(out, static_cast<uint8_t>(exec_mode));
+  WriteScalar<uint8_t>(out, static_cast<uint8_t>(reduce_op));
+  WriteString(out, error_message);
+  WriteScalar<uint32_t>(out, static_cast<uint32_t>(tensor_names.size()));
+  for (const auto& n : tensor_names) WriteString(out, n);
+  WriteVec(out, tensor_sizes);
+  WriteVec(out, recvsplits);
+  WriteVec(out, cache_bits);
+}
+
+bool Response::ParseFrom(const char** p, const char* end, Response* r) {
+  uint8_t rt, tt, em, ro;
+  if (!ReadScalar(p, end, &rt) || !ReadScalar(p, end, &tt) ||
+      !ReadScalar(p, end, &em) || !ReadScalar(p, end, &ro) ||
+      !ReadString(p, end, &r->error_message))
+    return false;
+  r->response_type = static_cast<ResponseType>(rt);
+  r->tensor_type = static_cast<DataType>(tt);
+  r->exec_mode = static_cast<ExecMode>(em);
+  r->reduce_op = static_cast<ReduceOp>(ro);
+  uint32_t n;
+  if (!ReadScalar(p, end, &n)) return false;
+  r->tensor_names.resize(n);
+  for (uint32_t i = 0; i < n; ++i)
+    if (!ReadString(p, end, &r->tensor_names[i])) return false;
+  return ReadVec(p, end, &r->tensor_sizes) && ReadVec(p, end, &r->recvsplits) &&
+         ReadVec(p, end, &r->cache_bits);
+}
+
+void ResponseList::SerializeTo(std::string* out) const {
+  WriteScalar<uint8_t>(out, 1);  // version
+  WriteScalar<uint8_t>(out, shutdown ? 1 : 0);
+  WriteScalar<uint8_t>(out, purge_cache ? 1 : 0);
+  WriteScalar<uint32_t>(out, static_cast<uint32_t>(responses.size()));
+  for (const auto& r : responses) r.SerializeTo(out);
+}
+
+bool ResponseList::ParseFrom(const std::string& buf, ResponseList* out) {
+  const char* p = buf.data();
+  const char* end = p + buf.size();
+  uint8_t ver, sd, pc;
+  if (!ReadScalar(&p, end, &ver) || ver != 1) return false;
+  if (!ReadScalar(&p, end, &sd)) return false;
+  out->shutdown = sd != 0;
+  if (!ReadScalar(&p, end, &pc)) return false;
+  out->purge_cache = pc != 0;
+  uint32_t n;
+  if (!ReadScalar(&p, end, &n)) return false;
+  out->responses.resize(n);
+  for (uint32_t i = 0; i < n; ++i)
+    if (!Response::ParseFrom(&p, end, &out->responses[i])) return false;
+  return true;
+}
+
+}  // namespace hvd

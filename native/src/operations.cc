@@ -1,0 +1,556 @@
+// Runtime lifecycle + C ABI.
+//
+// Rebuild of horovod/common/operations.cc: a per-process global state
+// holding every subsystem, a background thread running the fixed-
+// cadence coordination cycle (reference BackgroundThreadLoop
+// operations.cc:353 / RunLoopOnce :587), the enqueue API, and the
+// extern "C" surface consumed by the Python ctypes bridge (reference
+// horovod_init/... operations.cc:708-910, bound by common/basics.py).
+//
+// Execution of device-tensor (CALLBACK) responses is delegated to a
+// registered Python executor that launches jitted XLA collectives —
+// see horovod_tpu/runtime.py. Host-tensor responses run natively
+// (LocalOps/TcpOps).
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "hvd/common.h"
+#include "hvd/controller.h"
+#include "hvd/fusion_buffer.h"
+#include "hvd/group_table.h"
+#include "hvd/logging.h"
+#include "hvd/message.h"
+#include "hvd/ops.h"
+#include "hvd/response_cache.h"
+#include "hvd/stall_inspector.h"
+#include "hvd/tensor_queue.h"
+#include "hvd/timeline.h"
+
+namespace hvd {
+namespace {
+
+// ---- handle manager (reference horovod/torch/handle_manager.h:31-40)
+class HandleManager {
+ public:
+  int64_t Allocate() {
+    std::lock_guard<std::mutex> lock(mu_);
+    int64_t h = next_++;
+    results_.emplace(h, Result{});
+    return h;
+  }
+  void MarkDone(int64_t h, const Status& s) {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = results_.find(h);
+    if (it == results_.end()) return;
+    it->second.status = s;
+    it->second.done = true;
+    cv_.notify_all();
+  }
+  bool Poll(int64_t h) {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = results_.find(h);
+    return it == results_.end() || it->second.done;
+  }
+  // timeout_ms < 0: wait forever. Returns false on timeout.
+  bool Wait(int64_t h, int timeout_ms, Status* out) {
+    std::unique_lock<std::mutex> lock(mu_);
+    auto pred = [&] {
+      auto it = results_.find(h);
+      return it == results_.end() || it->second.done;
+    };
+    if (timeout_ms < 0) {
+      cv_.wait(lock, pred);
+    } else if (!cv_.wait_for(lock, std::chrono::milliseconds(timeout_ms),
+                             pred)) {
+      return false;
+    }
+    auto it = results_.find(h);
+    *out = it == results_.end() ? Status::OK() : it->second.status;
+    return true;
+  }
+  void Release(int64_t h) {
+    std::lock_guard<std::mutex> lock(mu_);
+    results_.erase(h);
+  }
+  void GetStatus(int64_t h, Status* out) {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = results_.find(h);
+    *out = it == results_.end() ? Status::OK() : it->second.status;
+  }
+
+ private:
+  struct Result {
+    bool done = false;
+    Status status;
+  };
+  std::mutex mu_;
+  std::condition_variable cv_;
+  int64_t next_ = 0;
+  std::unordered_map<int64_t, Result> results_;
+};
+
+// Python-side hooks (set before hvd_init).
+// Executor: runs one CALLBACK-mode response; must call hvd_exec_done.
+typedef void (*ExecCallback)(int64_t exec_id, int op_type, int num_tensors,
+                             const char** tensor_names, int32_t dtype,
+                             const int64_t* sizes, int32_t sizes_len);
+// Allocator: returns a host buffer for late-sized outputs
+// (allgather/alltoall), keyed by the entry's handle.
+typedef void* (*AllocCallback)(int64_t handle, const int64_t* shape,
+                               int32_t ndim);
+
+struct PendingExec {
+  Response response;
+  std::vector<TensorTableEntry> entries;
+};
+
+struct GlobalState {
+  std::atomic<bool> initialized{false};
+  std::atomic<bool> shutdown_requested{false};
+  std::atomic<bool> shut_down{false};
+
+  int rank = 0, size = 1, local_rank = 0, local_size = 1;
+  int cross_rank = 0, cross_size = 1;
+
+  TensorQueue tensor_queue;
+  ResponseCache response_cache;
+  GroupTable group_table;
+  StallInspector stall_inspector;
+  Timeline timeline;
+  FusionBufferManager fusion;
+  HandleManager handles;
+
+  std::unique_ptr<Controller> controller;
+  std::unique_ptr<OpExecutor> host_ops;
+  std::thread background_thread;
+
+  double cycle_time_ms = 1.0;
+  ExecCallback exec_cb = nullptr;
+  AllocCallback alloc_cb = nullptr;
+
+  std::mutex exec_mu;
+  int64_t next_exec_id = 0;
+  std::unordered_map<int64_t, PendingExec> pending_execs;
+
+  std::mutex recvsplits_mu;
+  std::unordered_map<int64_t, std::vector<int64_t>> recvsplits;  // by handle
+};
+
+GlobalState& State() {
+  static GlobalState* state = new GlobalState();
+  return *state;
+}
+
+int64_t EnvInt64(const char* name, int64_t dflt) {
+  const char* v = std::getenv(name);
+  return v ? std::atoll(v) : dflt;
+}
+
+double EnvDouble(const char* name, double dflt) {
+  const char* v = std::getenv(name);
+  return v ? std::atof(v) : dflt;
+}
+
+void CompleteEntry(GlobalState& st, TensorTableEntry& e, const Status& s) {
+  if (!e.recvsplits.empty()) {
+    std::lock_guard<std::mutex> lock(st.recvsplits_mu);
+    st.recvsplits[e.handle] = e.recvsplits;
+  }
+  if (e.callback) e.callback(s);
+}
+
+// Allocate late-sized outputs (allgather/alltoall) via the Python
+// allocator before the data plane runs (reference OpContext::
+// AllocateOutput driven from PrepareOutputAndParams,
+// collective_operations.h:206-268).
+Status AllocateOutputs(GlobalState& st, const Response& resp,
+                       std::vector<TensorTableEntry>& entries) {
+  if (resp.response_type != ResponseType::ALLGATHER &&
+      resp.response_type != ResponseType::ALLTOALL &&
+      resp.response_type != ResponseType::REDUCESCATTER)
+    return Status::OK();
+  for (auto& e : entries) {
+    if (e.output != nullptr || e.exec_mode != ExecMode::HOST) continue;
+    std::vector<int64_t> shape = e.shape.dims();
+    if (resp.response_type == ResponseType::ALLGATHER) {
+      int64_t rows = 0;
+      for (auto s : resp.tensor_sizes) rows += s;
+      shape[0] = rows;
+    } else if (resp.response_type == ResponseType::ALLTOALL) {
+      int64_t rows = 0;
+      for (int k = 0; k < st.size; ++k)
+        rows += resp.recvsplits[static_cast<size_t>(st.rank) * st.size + k];
+      shape[0] = rows;
+    } else {  // REDUCESCATTER
+      shape[0] = resp.tensor_sizes[st.rank];
+    }
+    if (st.alloc_cb == nullptr)
+      return Status::PreconditionError("no output allocator registered");
+    e.output = st.alloc_cb(e.handle, shape.data(),
+                           static_cast<int32_t>(shape.size()));
+    if (e.output == nullptr)
+      return Status::PreconditionError("output allocation failed for " +
+                                       e.name);
+  }
+  return Status::OK();
+}
+
+void PerformOperation(GlobalState& st, const Response& response) {
+  std::vector<TensorTableEntry> entries;
+  st.tensor_queue.GetTensorEntriesFromResponse(response, &entries);
+
+  if (response.response_type == ResponseType::ERROR) {
+    Status err = Status::PreconditionError(response.error_message);
+    for (auto& e : entries) CompleteEntry(st, e, err);
+    return;
+  }
+  if (entries.empty()) {
+    // Joined rank: no local work — except rank 0, which still serves
+    // as the hub for host-mode allreduces.
+    if (st.rank == 0 && st.size > 1 &&
+        response.response_type == ResponseType::ALLREDUCE &&
+        response.exec_mode == ExecMode::HOST) {
+      st.host_ops->Execute(response, entries);
+    }
+    return;
+  }
+
+  const std::string& tname = entries.front().name;
+  st.timeline.Start(tname, ResponseTypeName(response.response_type));
+
+  Status status = AllocateOutputs(st, response, entries);
+  if (status.ok()) {
+    if (entries.front().exec_mode == ExecMode::CALLBACK) {
+      // Hand off to the Python/XLA executor; completion arrives via
+      // hvd_exec_done (possibly from another thread).
+      if (st.exec_cb == nullptr) {
+        status = Status::PreconditionError("no XLA executor registered");
+      } else {
+        int64_t exec_id;
+        std::vector<const char*> names;
+        {
+          std::lock_guard<std::mutex> lock(st.exec_mu);
+          exec_id = st.next_exec_id++;
+          auto& pe = st.pending_execs[exec_id];
+          pe.response = response;
+          pe.entries = std::move(entries);
+          for (auto& e : pe.entries) names.push_back(e.name.c_str());
+        }
+        st.timeline.ActivityStart(tname, ACT_XLA_EXEC);
+        const std::vector<int64_t>& sizes =
+            response.response_type == ResponseType::ALLTOALL
+                ? response.recvsplits
+                : response.tensor_sizes;
+        st.exec_cb(exec_id, static_cast<int>(response.response_type),
+                   static_cast<int>(names.size()), names.data(),
+                   static_cast<int32_t>(response.tensor_type), sizes.data(),
+                   static_cast<int32_t>(sizes.size()));
+        return;  // completed asynchronously
+      }
+    } else {
+      status = st.host_ops->Execute(response, entries);
+    }
+  }
+  st.timeline.End(tname, 0);
+  for (auto& e : entries) CompleteEntry(st, e, status);
+}
+
+void BackgroundThreadLoop(GlobalState& st) {
+  while (true) {
+    auto cycle_start = std::chrono::steady_clock::now();
+    st.timeline.MarkCycleStart();
+    ResponseList list =
+        st.controller->ComputeResponseList(st.shutdown_requested.load());
+    for (const auto& resp : list.responses) PerformOperation(st, resp);
+    if (list.shutdown) break;
+    auto elapsed = std::chrono::steady_clock::now() - cycle_start;
+    auto budget = std::chrono::duration<double, std::milli>(st.cycle_time_ms);
+    if (elapsed < budget)
+      std::this_thread::sleep_for(budget - elapsed);
+  }
+  st.tensor_queue.FailAll(Status::Aborted("Horovod has been shut down"));
+  st.timeline.Shutdown();
+  st.shut_down.store(true);
+}
+
+Status EnqueueEntries(std::vector<TensorTableEntry> entries,
+                      RequestType type) {
+  GlobalState& st = State();
+  if (!st.initialized.load() || st.shut_down.load())
+    return Status::PreconditionError("horovod_tpu core not initialized");
+  std::vector<Request> requests;
+  requests.reserve(entries.size());
+  for (auto& e : entries) {
+    Request req;
+    req.request_rank = st.rank;
+    req.request_type = type;
+    req.tensor_type = e.dtype;
+    req.tensor_name = e.name;
+    req.tensor_shape = e.shape.dims();
+    req.root_rank = e.root_rank;
+    req.reduce_op = e.reduce_op;
+    req.prescale_factor = e.prescale_factor;
+    req.postscale_factor = e.postscale_factor;
+    req.splits = e.splits;
+    req.exec_mode = e.exec_mode;
+    req.group_key = e.group_key;
+    req.group_size = e.group_size;
+    requests.push_back(std::move(req));
+  }
+  return st.tensor_queue.AddToTensorQueue(std::move(entries),
+                                          std::move(requests));
+}
+
+}  // namespace
+}  // namespace hvd
+
+// ===========================================================================
+// C ABI (consumed by horovod_tpu/common/basics.py via ctypes).
+// ===========================================================================
+
+extern "C" {
+
+using hvd::GlobalState;
+
+int hvd_init(int rank, int size, int local_rank, int local_size,
+             int cross_rank, int cross_size) {
+  auto& st = hvd::State();
+  if (st.initialized.load()) return 0;
+  if (st.shut_down.load()) {
+    // Elastic re-init: reset the single-shot state.
+    st.shut_down.store(false);
+    st.shutdown_requested.store(false);
+    st.response_cache.Clear();
+    if (st.background_thread.joinable()) st.background_thread.join();
+  }
+  st.rank = rank;
+  st.size = size;
+  st.local_rank = local_rank;
+  st.local_size = local_size;
+  st.cross_rank = cross_rank;
+  st.cross_size = cross_size;
+
+  st.cycle_time_ms = hvd::EnvDouble("HOROVOD_CYCLE_TIME", 1.0);
+  st.response_cache.SetCapacity(static_cast<uint32_t>(
+      hvd::EnvInt64("HOROVOD_CACHE_CAPACITY", 1024)));
+  st.fusion.SetInitialSize(
+      hvd::EnvInt64("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024));
+  st.stall_inspector.SetWarningTime(
+      hvd::EnvDouble("HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0));
+  st.stall_inspector.SetShutdownTime(
+      hvd::EnvDouble("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0));
+
+  hvd::ControllerDeps deps;
+  deps.tensor_queue = &st.tensor_queue;
+  deps.response_cache = &st.response_cache;
+  deps.group_table = &st.group_table;
+  deps.stall_inspector = &st.stall_inspector;
+  deps.timeline = &st.timeline;
+
+  const char* addr = std::getenv("HOROVOD_CONTROLLER_ADDR");
+  if (size > 1 && addr == nullptr) {
+    LOG_ERROR << "multi-process init requires HOROVOD_CONTROLLER_ADDR";
+    return -1;
+  }
+  if (size > 1) {
+    st.controller = std::make_unique<hvd::TcpController>(
+        rank, size, addr, deps);
+  } else {
+    st.controller = std::make_unique<hvd::LocalController>(deps);
+  }
+  st.controller->SetFusionThreshold(
+      hvd::EnvInt64("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024));
+  hvd::Status s = st.controller->Initialize();
+  if (!s.ok()) {
+    LOG_ERROR << "controller init failed: " << s.reason();
+    return -1;
+  }
+  if (size > 1) {
+    st.host_ops = std::make_unique<hvd::TcpOps>(st.controller.get(),
+                                                &st.fusion, &st.timeline);
+  } else {
+    st.host_ops = std::make_unique<hvd::LocalOps>(st.controller.get(),
+                                                  &st.fusion, &st.timeline);
+  }
+  if (const char* tl = std::getenv("HOROVOD_TIMELINE"))
+    st.timeline.Initialize(tl, rank);
+
+  st.background_thread = std::thread([&st] { hvd::BackgroundThreadLoop(st); });
+  st.initialized.store(true);
+  LOG_INFO << "horovod_tpu core initialized: rank " << rank << "/" << size;
+  return 0;
+}
+
+void hvd_shutdown() {
+  auto& st = hvd::State();
+  if (!st.initialized.load()) return;
+  st.shutdown_requested.store(true);
+  if (st.background_thread.joinable()) st.background_thread.join();
+  st.initialized.store(false);
+}
+
+int hvd_initialized() { return hvd::State().initialized.load() ? 1 : 0; }
+int hvd_rank() { return hvd::State().rank; }
+int hvd_size() { return hvd::State().size; }
+int hvd_local_rank() { return hvd::State().local_rank; }
+int hvd_local_size() { return hvd::State().local_size; }
+int hvd_cross_rank() { return hvd::State().cross_rank; }
+int hvd_cross_size() { return hvd::State().cross_size; }
+int hvd_is_homogeneous() {
+  auto& st = hvd::State();
+  return st.size == st.local_size * st.cross_size ? 1 : 0;
+}
+
+void hvd_set_exec_callback(hvd::ExecCallback cb) {
+  hvd::State().exec_cb = cb;
+}
+void hvd_set_alloc_callback(hvd::AllocCallback cb) {
+  hvd::State().alloc_cb = cb;
+}
+
+// Generic enqueue. Returns handle >= 0, or -1 on immediate error (use
+// hvd_last_enqueue_error for the message).
+static thread_local std::string g_last_enqueue_error;
+
+int64_t hvd_enqueue(int op_type, const char* name, int dtype,
+                    const int64_t* shape, int ndim, const void* data,
+                    void* output, int root_rank, int reduce_op,
+                    double prescale, double postscale, const int64_t* splits,
+                    int nsplits, int exec_mode, int64_t group_key,
+                    int group_size) {
+  auto& st = hvd::State();
+  hvd::TensorTableEntry e;
+  e.name = name;
+  e.dtype = static_cast<hvd::DataType>(dtype);
+  e.shape = hvd::TensorShape(std::vector<int64_t>(shape, shape + ndim));
+  e.data = data;
+  e.output = output;
+  e.root_rank = root_rank;
+  e.reduce_op = static_cast<hvd::ReduceOp>(reduce_op);
+  e.prescale_factor = prescale;
+  e.postscale_factor = postscale;
+  if (splits && nsplits > 0)
+    e.splits.assign(splits, splits + nsplits);
+  e.exec_mode = static_cast<hvd::ExecMode>(exec_mode);
+  e.group_key = group_key;
+  e.group_size = group_size;
+  int64_t handle = st.handles.Allocate();
+  e.handle = handle;
+  e.callback = [&st, handle](const hvd::Status& s) {
+    st.handles.MarkDone(handle, s);
+  };
+  hvd::Status s = hvd::EnqueueEntries({std::move(e)},
+                                      static_cast<hvd::RequestType>(op_type));
+  if (!s.ok()) {
+    g_last_enqueue_error = s.reason();
+    st.handles.Release(handle);
+    return -1;
+  }
+  return handle;
+}
+
+const char* hvd_last_enqueue_error() { return g_last_enqueue_error.c_str(); }
+
+int64_t hvd_join() {
+  return hvd_enqueue(static_cast<int>(hvd::RequestType::JOIN), "join",
+                     static_cast<int>(hvd::DataType::UINT8), nullptr, 0,
+                     nullptr, nullptr, 0, 1, 1.0, 1.0, nullptr, 0, 0, -1, 0);
+}
+
+int64_t hvd_barrier() {
+  return hvd_enqueue(static_cast<int>(hvd::RequestType::BARRIER), "barrier",
+                     static_cast<int>(hvd::DataType::UINT8), nullptr, 0,
+                     nullptr, nullptr, 0, 1, 1.0, 1.0, nullptr, 0, 0, -1, 0);
+}
+
+int hvd_poll(int64_t handle) {
+  return hvd::State().handles.Poll(handle) ? 1 : 0;
+}
+
+// Returns: 0 ok, 1 timeout, negative = status error code.
+int hvd_wait(int64_t handle, int timeout_ms, char* err_buf, int err_len) {
+  hvd::Status s;
+  if (!hvd::State().handles.Wait(handle, timeout_ms, &s)) return 1;
+  if (s.ok()) return 0;
+  if (err_buf && err_len > 0) {
+    std::strncpy(err_buf, s.reason().c_str(), err_len - 1);
+    err_buf[err_len - 1] = '\0';
+  }
+  return -static_cast<int>(s.type());
+}
+
+void hvd_release_handle(int64_t handle) {
+  auto& st = hvd::State();
+  st.handles.Release(handle);
+  std::lock_guard<std::mutex> lock(st.recvsplits_mu);
+  st.recvsplits.erase(handle);
+}
+
+// Copies the alltoall recv splits recorded for `handle`; returns count.
+int hvd_get_recvsplits(int64_t handle, int64_t* out, int max_n) {
+  auto& st = hvd::State();
+  std::lock_guard<std::mutex> lock(st.recvsplits_mu);
+  auto it = st.recvsplits.find(handle);
+  if (it == st.recvsplits.end()) return 0;
+  int n = static_cast<int>(it->second.size());
+  if (out) {
+    for (int i = 0; i < n && i < max_n; ++i) out[i] = it->second[i];
+  }
+  return n;
+}
+
+// Completion path for the Python/XLA executor.
+void hvd_exec_done(int64_t exec_id, int status_code, const char* err) {
+  auto& st = hvd::State();
+  hvd::PendingExec pe;
+  {
+    std::lock_guard<std::mutex> lock(st.exec_mu);
+    auto it = st.pending_execs.find(exec_id);
+    if (it == st.pending_execs.end()) return;
+    pe = std::move(it->second);
+    st.pending_execs.erase(it);
+  }
+  hvd::Status s = status_code == 0
+                      ? hvd::Status::OK()
+                      : hvd::Status::UnknownError(err ? err : "exec failed");
+  if (!pe.entries.empty()) {
+    const std::string& tname = pe.entries.front().name;
+    st.timeline.ActivityEnd(tname);
+    st.timeline.End(tname, 0);
+  }
+  // Alltoall recvsplits for CALLBACK entries.
+  if (pe.response.response_type == hvd::ResponseType::ALLTOALL) {
+    for (auto& e : pe.entries) {
+      e.recvsplits.clear();
+      for (int k = 0; k < st.size; ++k)
+        e.recvsplits.push_back(
+            pe.response
+                .recvsplits[static_cast<size_t>(st.rank) * st.size + k]);
+    }
+  }
+  for (auto& e : pe.entries) hvd::CompleteEntry(st, e, s);
+}
+
+void hvd_start_timeline(const char* path) {
+  auto& st = hvd::State();
+  st.timeline.Initialize(path, st.rank);
+}
+
+void hvd_stop_timeline() { hvd::State().timeline.Shutdown(); }
+
+// Test hook: number of tensors currently in flight.
+int64_t hvd_pending_count() {
+  return static_cast<int64_t>(hvd::State().tensor_queue.size());
+}
+
+}  // extern "C"

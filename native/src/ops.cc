@@ -1,0 +1,476 @@
+#include "hvd/ops.h"
+
+#include <algorithm>
+#include <cstring>
+
+#include "hvd/half.h"
+#include "hvd/logging.h"
+
+namespace hvd {
+
+namespace {
+
+template <typename T>
+void AccumulateTyped(ReduceOp op, const T* src, T* dst, int64_t n) {
+  switch (op) {
+    case ReduceOp::AVERAGE:
+    case ReduceOp::SUM:
+    case ReduceOp::ADASUM:
+      for (int64_t i = 0; i < n; ++i) dst[i] += src[i];
+      break;
+    case ReduceOp::MIN:
+      for (int64_t i = 0; i < n; ++i) dst[i] = std::min(dst[i], src[i]);
+      break;
+    case ReduceOp::MAX:
+      for (int64_t i = 0; i < n; ++i) dst[i] = std::max(dst[i], src[i]);
+      break;
+    case ReduceOp::PRODUCT:
+      for (int64_t i = 0; i < n; ++i) dst[i] *= src[i];
+      break;
+  }
+}
+
+template <float (*ToF)(uint16_t), uint16_t (*FromF)(float)>
+void Accumulate16(ReduceOp op, const uint16_t* src, uint16_t* dst, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    float a = ToF(dst[i]), b = ToF(src[i]);
+    float r;
+    switch (op) {
+      case ReduceOp::MIN: r = std::min(a, b); break;
+      case ReduceOp::MAX: r = std::max(a, b); break;
+      case ReduceOp::PRODUCT: r = a * b; break;
+      default: r = a + b; break;
+    }
+    dst[i] = FromF(r);
+  }
+}
+
+}  // namespace
+
+void HostAccumulate(ReduceOp op, DataType dtype, const void* src, void* dst,
+                    int64_t count) {
+  switch (dtype) {
+    case DataType::FLOAT32:
+      AccumulateTyped(op, static_cast<const float*>(src),
+                      static_cast<float*>(dst), count);
+      break;
+    case DataType::FLOAT64:
+      AccumulateTyped(op, static_cast<const double*>(src),
+                      static_cast<double*>(dst), count);
+      break;
+    case DataType::INT32:
+      AccumulateTyped(op, static_cast<const int32_t*>(src),
+                      static_cast<int32_t*>(dst), count);
+      break;
+    case DataType::INT64:
+      AccumulateTyped(op, static_cast<const int64_t*>(src),
+                      static_cast<int64_t*>(dst), count);
+      break;
+    case DataType::UINT8:
+      AccumulateTyped(op, static_cast<const uint8_t*>(src),
+                      static_cast<uint8_t*>(dst), count);
+      break;
+    case DataType::INT8:
+      AccumulateTyped(op, static_cast<const int8_t*>(src),
+                      static_cast<int8_t*>(dst), count);
+      break;
+    case DataType::UINT16:
+      AccumulateTyped(op, static_cast<const uint16_t*>(src),
+                      static_cast<uint16_t*>(dst), count);
+      break;
+    case DataType::INT16:
+      AccumulateTyped(op, static_cast<const int16_t*>(src),
+                      static_cast<int16_t*>(dst), count);
+      break;
+    case DataType::FLOAT16:
+      Accumulate16<HalfBits2Float, Float2HalfBits>(
+          op, static_cast<const uint16_t*>(src), static_cast<uint16_t*>(dst),
+          count);
+      break;
+    case DataType::BFLOAT16:
+      Accumulate16<BFloat2Float, Float2BFloat>(
+          op, static_cast<const uint16_t*>(src), static_cast<uint16_t*>(dst),
+          count);
+      break;
+    case DataType::BOOL: {
+      // logical OR for sum-class, AND for min, OR for max.
+      auto* s = static_cast<const uint8_t*>(src);
+      auto* d = static_cast<uint8_t*>(dst);
+      if (op == ReduceOp::MIN || op == ReduceOp::PRODUCT) {
+        for (int64_t i = 0; i < count; ++i) d[i] = d[i] && s[i];
+      } else {
+        for (int64_t i = 0; i < count; ++i) d[i] = d[i] || s[i];
+      }
+      break;
+    }
+  }
+}
+
+void HostScale(DataType dtype, void* dst, int64_t count, double factor) {
+  if (factor == 1.0) return;
+  switch (dtype) {
+    case DataType::FLOAT32: {
+      auto* d = static_cast<float*>(dst);
+      for (int64_t i = 0; i < count; ++i) d[i] = static_cast<float>(d[i] * factor);
+      break;
+    }
+    case DataType::FLOAT64: {
+      auto* d = static_cast<double*>(dst);
+      for (int64_t i = 0; i < count; ++i) d[i] *= factor;
+      break;
+    }
+    case DataType::FLOAT16: {
+      auto* d = static_cast<uint16_t*>(dst);
+      for (int64_t i = 0; i < count; ++i)
+        d[i] = Float2HalfBits(static_cast<float>(HalfBits2Float(d[i]) * factor));
+      break;
+    }
+    case DataType::BFLOAT16: {
+      auto* d = static_cast<uint16_t*>(dst);
+      for (int64_t i = 0; i < count; ++i)
+        d[i] = Float2BFloat(static_cast<float>(BFloat2Float(d[i]) * factor));
+      break;
+    }
+    default:
+      // Integer scaling is rejected at the Python layer.
+      break;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// LocalOps: single-process semantics — output := input (allreduce with
+// size 1, broadcast from self, allgather of one shard, alltoall to
+// self). Scale factors still apply (pre * post).
+// ---------------------------------------------------------------------------
+
+Status LocalOps::Execute(const Response& response,
+                         std::vector<TensorTableEntry>& entries) {
+  for (auto& e : entries) {
+    if (response.response_type == ResponseType::JOIN ||
+        response.response_type == ResponseType::BARRIER)
+      continue;
+    int64_t bytes = e.shape.num_elements() * DataTypeSize(e.dtype);
+    if (e.output != nullptr && e.data != nullptr && e.output != e.data)
+      std::memcpy(e.output, e.data, bytes);
+    double factor = e.prescale_factor * e.postscale_factor;
+    if (response.response_type == ResponseType::ALLREDUCE ||
+        response.response_type == ResponseType::REDUCESCATTER) {
+      if (e.reduce_op == ReduceOp::AVERAGE) factor /= 1.0;  // size == 1
+      if (e.output) HostScale(e.dtype, e.output, e.shape.num_elements(), factor);
+    }
+    if (response.response_type == ResponseType::ALLTOALL) {
+      e.recvsplits = e.splits.empty()
+                         ? std::vector<int64_t>{e.shape.dim_size(0)}
+                         : e.splits;
+    }
+  }
+  return Status::OK();
+}
+
+// ---------------------------------------------------------------------------
+// TcpOps: hub-topology host collectives through rank 0.
+// ---------------------------------------------------------------------------
+
+Status TcpOps::Execute(const Response& response,
+                       std::vector<TensorTableEntry>& entries) {
+  switch (response.response_type) {
+    case ResponseType::ALLREDUCE:
+      return Allreduce(response, entries);
+    case ResponseType::ALLGATHER:
+      return Allgather(response, entries);
+    case ResponseType::BROADCAST:
+      return Broadcast(response, entries);
+    case ResponseType::ALLTOALL:
+      return Alltoall(response, entries);
+    case ResponseType::REDUCESCATTER:
+      return Reducescatter(response, entries);
+    case ResponseType::JOIN:
+    case ResponseType::BARRIER:
+      return Status::OK();
+    case ResponseType::ERROR:
+      return Status::UnknownError(response.error_message);
+  }
+  return Status::UnknownError("unhandled response type");
+}
+
+Status TcpOps::Allreduce(const Response& r,
+                         std::vector<TensorTableEntry>& entries) {
+  const int rank = controller_->rank();
+  const int size = controller_->size();
+  auto* tcp = static_cast<TcpController*>(controller_);
+  const auto& joined = tcp->joined_ranks();
+  auto is_joined = [&](int rk) {
+    return rk < static_cast<int>(joined.size()) && joined[rk];
+  };
+  // A joined rank has no local entries, but rank 0 must still serve as
+  // the hub — sizes come from the response metadata, not the entries.
+  const DataType dtype = r.tensor_type;
+  int64_t total_elems = 0;
+  for (auto n : r.tensor_sizes) total_elems += n;
+  const int64_t total_bytes = total_elems * DataTypeSize(dtype);
+  const bool i_participate = !entries.empty() && !is_joined(rank);
+  if (!i_participate && rank != 0) return Status::OK();
+
+  const std::string tname =
+      entries.empty() ? r.tensor_names.front() : entries.front().name;
+  uint8_t* buf = static_cast<uint8_t*>(fusion_->GetBuffer(0, total_bytes));
+
+  if (i_participate) {
+    // Pack into the fusion buffer, applying prescale.
+    if (timeline_)
+      timeline_->ActivityStart(tname, ACT_MEMCPY_IN_FUSION_BUFFER);
+    int64_t off = 0;
+    for (auto& e : entries) {
+      int64_t bytes = e.shape.num_elements() * DataTypeSize(e.dtype);
+      std::memcpy(buf + off, e.data, bytes);
+      if (e.prescale_factor != 1.0)
+        HostScale(e.dtype, buf + off, e.shape.num_elements(),
+                  e.prescale_factor);
+      off += bytes;
+    }
+    if (timeline_) timeline_->ActivityEnd(tname);
+  }
+
+  if (timeline_) timeline_->ActivityStart(tname, ACT_TCP_ALLREDUCE);
+  const ReduceOp op = r.reduce_op;
+  const int64_t count = total_elems;
+  if (rank == 0) {
+    // Accumulate every participant's buffer (own packed data is the
+    // initial value when participating, else the first received
+    // buffer), then send the result back to all participants.
+    bool have_initial = i_participate;
+    std::vector<uint8_t> scratch(total_bytes);
+    for (int peer = 1; peer < size; ++peer) {
+      if (is_joined(peer)) continue;
+      uint8_t* dst = have_initial ? scratch.data() : buf;
+      if (!controller_->DataConn(peer)->RecvAll(dst, total_bytes))
+        return Status::UnknownError("allreduce: lost data connection");
+      if (have_initial) {
+        HostAccumulate(op, dtype, scratch.data(), buf, count);
+      } else {
+        have_initial = true;
+      }
+    }
+    for (int peer = 1; peer < size; ++peer) {
+      if (is_joined(peer)) continue;
+      if (!controller_->DataConn(peer)->SendAll(buf, total_bytes))
+        return Status::UnknownError("allreduce: lost data connection");
+    }
+  } else {
+    if (!controller_->DataConn(0)->SendAll(buf, total_bytes) ||
+        !controller_->DataConn(0)->RecvAll(buf, total_bytes))
+      return Status::UnknownError("allreduce: lost data connection");
+  }
+  if (timeline_) timeline_->ActivityEnd(tname);
+
+  // Unpack with postscale (+ 1/size for AVERAGE; joined ranks count as
+  // zero contributions, matching the reference's Join semantics).
+  if (timeline_) timeline_->ActivityStart(tname, ACT_MEMCPY_OUT_FUSION_BUFFER);
+  int64_t off = 0;
+  for (auto& e : entries) {
+    int64_t n = e.shape.num_elements();
+    int64_t bytes = n * DataTypeSize(e.dtype);
+    if (e.output) {
+      std::memcpy(e.output, buf + off, bytes);
+      double factor = e.postscale_factor;
+      if (e.reduce_op == ReduceOp::AVERAGE) factor /= size;
+      if (factor != 1.0) HostScale(e.dtype, e.output, n, factor);
+    }
+    off += bytes;
+  }
+  if (timeline_) timeline_->ActivityEnd(tname);
+  return Status::OK();
+}
+
+Status TcpOps::Allgather(const Response& r,
+                         std::vector<TensorTableEntry>& entries) {
+  const int rank = controller_->rank();
+  const int size = controller_->size();
+  // One tensor per response (allgather responses are not fused in v1).
+  auto& e = entries.front();
+  if (timeline_) timeline_->ActivityStart(e.name, ACT_TCP_ALLGATHER);
+  int64_t row_bytes = DataTypeSize(e.dtype);
+  for (int d = 1; d < e.shape.ndim(); ++d) row_bytes *= e.shape.dim_size(d);
+  int64_t my_bytes = e.shape.dim_size(0) * row_bytes;
+  int64_t total_rows = 0;
+  for (auto s : r.tensor_sizes) total_rows += s;
+  int64_t total_bytes = total_rows * row_bytes;
+
+  uint8_t* out = static_cast<uint8_t*>(e.output);
+  if (out == nullptr)
+    return Status::PreconditionError("allgather output not allocated");
+
+  if (rank == 0) {
+    // Own shard first (rank order), then receive each peer's shard.
+    int64_t off = 0;
+    std::memcpy(out + off, e.data, my_bytes);
+    off += my_bytes;
+    for (int peer = 1; peer < size; ++peer) {
+      int64_t peer_bytes = r.tensor_sizes[peer] * row_bytes;
+      if (!controller_->DataConn(peer)->RecvAll(out + off, peer_bytes))
+        return Status::UnknownError("allgather: lost data connection");
+      off += peer_bytes;
+    }
+    for (int peer = 1; peer < size; ++peer) {
+      if (!controller_->DataConn(peer)->SendAll(out, total_bytes))
+        return Status::UnknownError("allgather: lost data connection");
+    }
+  } else {
+    if (!controller_->DataConn(0)->SendAll(e.data, my_bytes) ||
+        !controller_->DataConn(0)->RecvAll(out, total_bytes))
+      return Status::UnknownError("allgather: lost data connection");
+  }
+  if (timeline_) timeline_->ActivityEnd(e.name);
+  return Status::OK();
+}
+
+Status TcpOps::Broadcast(const Response& r,
+                         std::vector<TensorTableEntry>& entries) {
+  const int rank = controller_->rank();
+  const int size = controller_->size();
+  auto& e = entries.front();
+  if (timeline_) timeline_->ActivityStart(e.name, ACT_TCP_BROADCAST);
+  int64_t bytes = e.shape.num_elements() * DataTypeSize(e.dtype);
+  // Output buffer: root writes its input through to output too.
+  uint8_t* out = static_cast<uint8_t*>(e.output ? e.output
+                                                : const_cast<void*>(e.data));
+  if (rank == 0) {
+    if (e.root_rank == 0) {
+      std::memcpy(out, e.data, bytes);
+    } else {
+      if (!controller_->DataConn(e.root_rank)->RecvAll(out, bytes))
+        return Status::UnknownError("broadcast: lost data connection");
+    }
+    for (int peer = 1; peer < size; ++peer) {
+      if (peer == e.root_rank) continue;
+      if (!controller_->DataConn(peer)->SendAll(out, bytes))
+        return Status::UnknownError("broadcast: lost data connection");
+    }
+  } else if (rank == e.root_rank) {
+    if (!controller_->DataConn(0)->SendAll(e.data, bytes))
+      return Status::UnknownError("broadcast: lost data connection");
+    if (out != e.data) std::memcpy(out, e.data, bytes);
+  } else {
+    if (!controller_->DataConn(0)->RecvAll(out, bytes))
+      return Status::UnknownError("broadcast: lost data connection");
+  }
+  if (timeline_) timeline_->ActivityEnd(e.name);
+  return Status::OK();
+}
+
+Status TcpOps::Alltoall(const Response& r,
+                        std::vector<TensorTableEntry>& entries) {
+  const int rank = controller_->rank();
+  const int size = controller_->size();
+  auto& e = entries.front();
+  if (timeline_) timeline_->ActivityStart(e.name, ACT_TCP_ALLTOALL);
+  int64_t row_bytes = DataTypeSize(e.dtype);
+  for (int d = 1; d < e.shape.ndim(); ++d) row_bytes *= e.shape.dim_size(d);
+
+  // recvsplits matrix: recv[r0 * size + k] = rows rank r0 gets from k.
+  auto recv_rows = [&](int r0, int k) {
+    return r.recvsplits[static_cast<size_t>(r0) * size + k];
+  };
+  e.recvsplits.clear();
+  int64_t my_recv_rows = 0;
+  for (int k = 0; k < size; ++k) {
+    e.recvsplits.push_back(recv_rows(rank, k));
+    my_recv_rows += recv_rows(rank, k);
+  }
+  uint8_t* out = static_cast<uint8_t*>(e.output);
+  if (out == nullptr)
+    return Status::PreconditionError("alltoall output not allocated");
+
+  int64_t my_send_bytes = e.shape.dim_size(0) * row_bytes;
+  if (rank == 0) {
+    // Gather all payloads, then redistribute columns.
+    std::vector<std::vector<uint8_t>> payloads(size);
+    payloads[0].assign(static_cast<const uint8_t*>(e.data),
+                       static_cast<const uint8_t*>(e.data) + my_send_bytes);
+    for (int peer = 1; peer < size; ++peer) {
+      int64_t peer_rows = 0;
+      for (int k = 0; k < size; ++k) peer_rows += recv_rows(k, peer);
+      payloads[peer].resize(peer_rows * row_bytes);
+      if (!controller_->DataConn(peer)->RecvAll(payloads[peer].data(),
+                                                payloads[peer].size()))
+        return Status::UnknownError("alltoall: lost data connection");
+    }
+    // Build each destination's output: concat over sources k of the
+    // slice destined to r0 (source k's offset = sum of its splits to
+    // ranks < r0).
+    for (int dest = 0; dest < size; ++dest) {
+      std::vector<uint8_t> outbuf;
+      for (int k = 0; k < size; ++k) {
+        int64_t src_off_rows = 0;
+        for (int d2 = 0; d2 < dest; ++d2) src_off_rows += recv_rows(d2, k);
+        int64_t nrows = recv_rows(dest, k);
+        const uint8_t* src = payloads[k].data() + src_off_rows * row_bytes;
+        outbuf.insert(outbuf.end(), src, src + nrows * row_bytes);
+      }
+      if (dest == 0) {
+        std::memcpy(out, outbuf.data(), outbuf.size());
+      } else {
+        if (!controller_->DataConn(dest)->SendAll(outbuf.data(),
+                                                  outbuf.size()))
+          return Status::UnknownError("alltoall: lost data connection");
+      }
+    }
+  } else {
+    if (!controller_->DataConn(0)->SendAll(e.data, my_send_bytes) ||
+        !controller_->DataConn(0)->RecvAll(out, my_recv_rows * row_bytes))
+      return Status::UnknownError("alltoall: lost data connection");
+  }
+  if (timeline_) timeline_->ActivityEnd(e.name);
+  return Status::OK();
+}
+
+Status TcpOps::Reducescatter(const Response& r,
+                             std::vector<TensorTableEntry>& entries) {
+  const int rank = controller_->rank();
+  const int size = controller_->size();
+  auto& e = entries.front();
+  if (timeline_) timeline_->ActivityStart(e.name, ACT_TCP_ALLREDUCE);
+  int64_t n = e.shape.num_elements();
+  int64_t bytes = n * DataTypeSize(e.dtype);
+  int64_t row_bytes = DataTypeSize(e.dtype);
+  for (int d = 1; d < e.shape.ndim(); ++d) row_bytes *= e.shape.dim_size(d);
+
+  uint8_t* buf = static_cast<uint8_t*>(fusion_->GetBuffer(0, bytes));
+  std::memcpy(buf, e.data, bytes);
+  if (e.prescale_factor != 1.0)
+    HostScale(e.dtype, buf, n, e.prescale_factor);
+
+  // Row offset/extent of each rank's shard.
+  std::vector<int64_t> offs(size + 1, 0);
+  for (int k = 0; k < size; ++k) offs[k + 1] = offs[k] + r.tensor_sizes[k];
+
+  if (rank == 0) {
+    std::vector<uint8_t> scratch(bytes);
+    for (int peer = 1; peer < size; ++peer) {
+      if (!controller_->DataConn(peer)->RecvAll(scratch.data(), bytes))
+        return Status::UnknownError("reducescatter: lost data connection");
+      HostAccumulate(e.reduce_op, e.dtype, scratch.data(), buf,
+                     bytes / DataTypeSize(e.dtype));
+    }
+    for (int peer = 1; peer < size; ++peer) {
+      if (!controller_->DataConn(peer)->SendAll(
+              buf + offs[peer] * row_bytes,
+              r.tensor_sizes[peer] * row_bytes))
+        return Status::UnknownError("reducescatter: lost data connection");
+    }
+    std::memcpy(e.output, buf, r.tensor_sizes[0] * row_bytes);
+  } else {
+    if (!controller_->DataConn(0)->SendAll(buf, bytes) ||
+        !controller_->DataConn(0)->RecvAll(e.output,
+                                           r.tensor_sizes[rank] * row_bytes))
+      return Status::UnknownError("reducescatter: lost data connection");
+  }
+  int64_t out_n = r.tensor_sizes[rank] * row_bytes / DataTypeSize(e.dtype);
+  double factor = e.postscale_factor;
+  if (e.reduce_op == ReduceOp::AVERAGE) factor /= size;
+  if (factor != 1.0) HostScale(e.dtype, e.output, out_n, factor);
+  if (timeline_) timeline_->ActivityEnd(e.name);
+  return Status::OK();
+}
+
+}  // namespace hvd

@@ -1,0 +1,114 @@
+#include "hvd/response_cache.h"
+
+namespace hvd {
+
+bool ResponseCache::SameParams(const Request& a, const Request& b) {
+  return a.request_type == b.request_type && a.tensor_type == b.tensor_type &&
+         a.tensor_shape == b.tensor_shape && a.root_rank == b.root_rank &&
+         a.reduce_op == b.reduce_op &&
+         a.prescale_factor == b.prescale_factor &&
+         a.postscale_factor == b.postscale_factor && a.splits == b.splits &&
+         a.exec_mode == b.exec_mode && a.group_key == b.group_key &&
+         a.group_size == b.group_size;
+}
+
+uint64_t ResponseCache::EntryHash(const Request& req, uint32_t bit) {
+  // request_rank is per-rank; zero it so signatures agree across ranks.
+  Request canon = req;
+  canon.request_rank = 0;
+  std::string buf;
+  canon.SerializeTo(&buf);
+  // FNV-1a over the serialized request + bit position.
+  uint64_t h = 1469598103934665603ull;
+  auto mix = [&h](const char* p, size_t n) {
+    for (size_t i = 0; i < n; ++i) {
+      h ^= static_cast<uint8_t>(p[i]);
+      h *= 1099511628211ull;
+    }
+  };
+  mix(buf.data(), buf.size());
+  mix(reinterpret_cast<const char*>(&bit), sizeof(bit));
+  return h;
+}
+
+ResponseCache::CacheState ResponseCache::Lookup(const Request& req,
+                                                uint32_t* bit) const {
+  auto it = entries_.find(req.tensor_name);
+  if (it == entries_.end()) return CacheState::MISS;
+  if (!SameParams(it->second.request, req)) return CacheState::INVALID;
+  *bit = it->second.bit;
+  return CacheState::HIT;
+}
+
+void ResponseCache::Touch(const std::string& name) {
+  auto pos = lru_pos_.find(name);
+  if (pos != lru_pos_.end()) lru_.erase(pos->second);
+  lru_.push_front(name);
+  lru_pos_[name] = lru_.begin();
+}
+
+uint32_t ResponseCache::Put(const Request& req) {
+  auto it = entries_.find(req.tensor_name);
+  if (it != entries_.end()) {
+    sig_ ^= EntryHash(it->second.request, it->second.bit);
+    it->second.request = req;
+    sig_ ^= EntryHash(req, it->second.bit);
+    Touch(req.tensor_name);
+    return it->second.bit;
+  }
+  if (entries_.size() >= capacity_ && !lru_.empty()) {
+    const std::string victim = lru_.back();
+    auto vit = entries_.find(victim);
+    if (vit != entries_.end()) {
+      sig_ ^= EntryHash(vit->second.request, vit->second.bit);
+      bit_to_entry_.erase(vit->second.bit);
+      entries_.erase(vit);
+    }
+    lru_.pop_back();
+    lru_pos_.erase(victim);
+  }
+  Entry e;
+  e.request = req;
+  e.bit = next_bit_++;
+  bit_to_entry_[e.bit] = req.tensor_name;
+  sig_ ^= EntryHash(req, e.bit);
+  entries_[req.tensor_name] = e;
+  Touch(req.tensor_name);
+  return e.bit;
+}
+
+bool ResponseCache::GetRequestByBit(uint32_t bit, Request* out) const {
+  auto it = bit_to_entry_.find(bit);
+  if (it == bit_to_entry_.end()) return false;
+  auto eit = entries_.find(it->second);
+  if (eit == entries_.end()) return false;
+  *out = eit->second.request;
+  return true;
+}
+
+void ResponseCache::Erase(uint32_t bit) {
+  auto it = bit_to_entry_.find(bit);
+  if (it == bit_to_entry_.end()) return;
+  const std::string name = it->second;
+  auto eit = entries_.find(name);
+  if (eit != entries_.end())
+    sig_ ^= EntryHash(eit->second.request, eit->second.bit);
+  bit_to_entry_.erase(it);
+  entries_.erase(name);
+  auto pos = lru_pos_.find(name);
+  if (pos != lru_pos_.end()) {
+    lru_.erase(pos->second);
+    lru_pos_.erase(pos);
+  }
+}
+
+void ResponseCache::Clear() {
+  entries_.clear();
+  bit_to_entry_.clear();
+  lru_.clear();
+  lru_pos_.clear();
+  next_bit_ = 0;
+  sig_ = 0;
+}
+
+}  // namespace hvd

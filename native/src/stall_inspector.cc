@@ -1,0 +1,65 @@
+#include "hvd/stall_inspector.h"
+
+#include <algorithm>
+#include <sstream>
+
+#include "hvd/logging.h"
+
+namespace hvd {
+
+void StallInspector::RecordUncachedTensor(const std::string& name, int rank) {
+  auto it = pending_.find(name);
+  if (it == pending_.end()) {
+    Info info;
+    info.first_seen = std::chrono::steady_clock::now();
+    info.ranks.push_back(rank);
+    pending_[name] = std::move(info);
+  } else if (std::find(it->second.ranks.begin(), it->second.ranks.end(),
+                       rank) == it->second.ranks.end()) {
+    it->second.ranks.push_back(rank);
+  }
+}
+
+void StallInspector::RemoveUncachedTensor(const std::string& name) {
+  pending_.erase(name);
+}
+
+bool StallInspector::CheckForStalledTensors(int global_size) {
+  auto now = std::chrono::steady_clock::now();
+  if (std::chrono::duration<double>(now - last_check_).count() <
+      warning_secs_ / 2)
+    return false;
+  last_check_ = now;
+
+  bool should_shutdown = false;
+  std::ostringstream warn;
+  int stalled = 0;
+  for (const auto& kv : pending_) {
+    double age =
+        std::chrono::duration<double>(now - kv.second.first_seen).count();
+    if (age < warning_secs_) continue;
+    std::vector<bool> ready(global_size, false);
+    for (int r : kv.second.ranks) {
+      if (r >= 0 && r < global_size) ready[r] = true;
+    }
+    std::ostringstream missing;
+    for (int r = 0; r < global_size; ++r) {
+      if (!ready[r]) missing << (missing.tellp() > 0 ? "," : "") << r;
+    }
+    if (stalled++ < 5) {
+      warn << "\n  " << kv.first << " (" << static_cast<int>(age)
+           << "s, missing ranks: [" << missing.str() << "])";
+    }
+    if (shutdown_secs_ > 0 && age > shutdown_secs_) should_shutdown = true;
+  }
+  if (stalled > 0) {
+    LOG_WARNING << "One or more tensors were submitted to be reduced/gathered "
+                << "but some ranks have not yet submitted them (" << stalled
+                << " stalled):" << warn.str()
+                << "\nThis typically indicates diverged control flow "
+                << "across ranks.";
+  }
+  return should_shutdown;
+}
+
+}  // namespace hvd

@@ -1,0 +1,197 @@
+#include "hvd/tcp.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstring>
+#include <thread>
+
+#include "hvd/logging.h"
+
+namespace hvd {
+
+namespace {
+
+bool SplitAddr(const std::string& addr, std::string* host, int* port) {
+  auto pos = addr.rfind(':');
+  if (pos == std::string::npos) return false;
+  *host = addr.substr(0, pos);
+  *port = std::atoi(addr.c_str() + pos + 1);
+  return true;
+}
+
+void SetNoDelay(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+}  // namespace
+
+TcpConn& TcpConn::operator=(TcpConn&& o) noexcept {
+  if (this != &o) {
+    Close();
+    fd_ = o.fd_;
+    o.fd_ = -1;
+  }
+  return *this;
+}
+
+TcpConn::~TcpConn() { Close(); }
+
+void TcpConn::Close() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+bool TcpConn::SendAll(const void* data, uint64_t len) {
+  const char* p = static_cast<const char*>(data);
+  while (len > 0) {
+    ssize_t n = ::send(fd_, p, len, MSG_NOSIGNAL);
+    if (n <= 0) {
+      if (n < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    p += n;
+    len -= static_cast<uint64_t>(n);
+  }
+  return true;
+}
+
+bool TcpConn::RecvAll(void* data, uint64_t len) {
+  char* p = static_cast<char*>(data);
+  while (len > 0) {
+    ssize_t n = ::recv(fd_, p, len, 0);
+    if (n <= 0) {
+      if (n < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += n;
+    len -= static_cast<uint64_t>(n);
+  }
+  return true;
+}
+
+bool TcpConn::SendFrame(const void* data, uint64_t len) {
+  uint64_t hdr = len;
+  return SendAll(&hdr, sizeof(hdr)) && (len == 0 || SendAll(data, len));
+}
+
+bool TcpConn::RecvFrame(std::string* out) {
+  uint64_t len;
+  if (!RecvAll(&len, sizeof(len))) return false;
+  if (len > (1ull << 40)) return false;  // sanity
+  out->resize(len);
+  return len == 0 || RecvAll(&(*out)[0], len);
+}
+
+int TcpServer::Listen(const std::string& addr) {
+  std::string host;
+  int port;
+  if (!SplitAddr(addr, &host, &port)) return -1;
+  listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (listen_fd_ < 0) return -1;
+  int one = 1;
+  setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons(static_cast<uint16_t>(port));
+  sa.sin_addr.s_addr =
+      host == "0.0.0.0" || host.empty() ? INADDR_ANY : inet_addr(host.c_str());
+  if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) < 0 ||
+      ::listen(listen_fd_, 128) < 0) {
+    ::close(listen_fd_);
+    listen_fd_ = -1;
+    return -1;
+  }
+  socklen_t slen = sizeof(sa);
+  getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&sa), &slen);
+  return ntohs(sa.sin_port);
+}
+
+bool TcpServer::AcceptPeers(int n, std::vector<TcpConn>* control_by_rank,
+                            std::vector<TcpConn>* data_by_rank,
+                            int timeout_ms) {
+  control_by_rank->clear();
+  control_by_rank->resize(n + 1);
+  data_by_rank->clear();
+  data_by_rank->resize(n + 1);
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  for (int i = 0; i < 2 * n; ++i) {
+    timeval tv{};
+    auto remain = std::chrono::duration_cast<std::chrono::microseconds>(
+                      deadline - std::chrono::steady_clock::now())
+                      .count();
+    if (remain <= 0) return false;
+    tv.tv_sec = remain / 1000000;
+    tv.tv_usec = remain % 1000000;
+    fd_set fds;
+    FD_ZERO(&fds);
+    FD_SET(listen_fd_, &fds);
+    if (::select(listen_fd_ + 1, &fds, nullptr, nullptr, &tv) <= 0)
+      return false;
+    int fd = ::accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) return false;
+    SetNoDelay(fd);
+    TcpConn conn(fd);
+    int32_t hello[2];
+    if (!conn.RecvAll(hello, sizeof(hello)) || hello[0] < 1 || hello[0] > n ||
+        (hello[1] != 0 && hello[1] != 1)) {
+      LOG_ERROR << "controller handshake: bad (rank, channel) = (" << hello[0]
+                << ", " << hello[1] << ")";
+      return false;
+    }
+    auto* vec = hello[1] == 0 ? control_by_rank : data_by_rank;
+    (*vec)[hello[0]] = std::move(conn);
+  }
+  return true;
+}
+
+void TcpServer::Close() {
+  if (listen_fd_ >= 0) {
+    ::close(listen_fd_);
+    listen_fd_ = -1;
+  }
+}
+
+bool TcpConnect(const std::string& addr, int my_rank, int channel,
+                int timeout_ms, TcpConn* out) {
+  std::string host;
+  int port;
+  if (!SplitAddr(addr, &host, &port)) return false;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  while (std::chrono::steady_clock::now() < deadline) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return false;
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons(static_cast<uint16_t>(port));
+    hostent* he = gethostbyname(host.c_str());
+    if (he != nullptr) {
+      std::memcpy(&sa.sin_addr, he->h_addr, he->h_length);
+    } else {
+      sa.sin_addr.s_addr = inet_addr(host.c_str());
+    }
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) == 0) {
+      SetNoDelay(fd);
+      TcpConn conn(fd);
+      int32_t hello[2] = {my_rank, channel};
+      if (!conn.SendAll(hello, sizeof(hello))) return false;
+      *out = std::move(conn);
+      return true;
+    }
+    ::close(fd);
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+  return false;
+}
+
+}  // namespace hvd

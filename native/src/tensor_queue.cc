@@ -1,0 +1,65 @@
+#include "hvd/tensor_queue.h"
+
+namespace hvd {
+
+Status TensorQueue::AddToTensorQueue(std::vector<TensorTableEntry> entries,
+                                     std::vector<Request> requests) {
+  std::lock_guard<std::mutex> lock(mu_);
+  for (const auto& e : entries) {
+    if (table_.count(e.name)) {
+      return Status::InvalidArgument(
+          "Duplicate tensor name in-flight: " + e.name +
+          "; if you need concurrent collectives on one tensor, give each "
+          "call a distinct name= argument");
+    }
+  }
+  for (auto& e : entries) table_.emplace(e.name, std::move(e));
+  for (auto& r : requests) queue_.push_back(std::move(r));
+  return Status::OK();
+}
+
+void TensorQueue::PopMessagesFromQueue(std::vector<Request>* out) {
+  std::lock_guard<std::mutex> lock(mu_);
+  out->insert(out->end(), std::make_move_iterator(queue_.begin()),
+              std::make_move_iterator(queue_.end()));
+  queue_.clear();
+}
+
+void TensorQueue::GetTensorEntriesFromResponse(
+    const Response& response, std::vector<TensorTableEntry>* entries) {
+  std::lock_guard<std::mutex> lock(mu_);
+  for (const auto& name : response.tensor_names) {
+    auto it = table_.find(name);
+    if (it != table_.end()) {
+      entries->push_back(std::move(it->second));
+      table_.erase(it);
+    }
+  }
+}
+
+void TensorQueue::FailAll(const Status& status) {
+  std::unordered_map<std::string, TensorTableEntry> table;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    table.swap(table_);
+    queue_.clear();
+  }
+  for (auto& kv : table) {
+    if (kv.second.callback) kv.second.callback(status);
+  }
+}
+
+size_t TensorQueue::size() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return table_.size();
+}
+
+bool TensorQueue::Lookup(const std::string& name, TensorTableEntry* out) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = table_.find(name);
+  if (it == table_.end()) return false;
+  *out = it->second;
+  return true;
+}
+
+}  // namespace hvd

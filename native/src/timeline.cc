@@ -1,0 +1,105 @@
+#include "hvd/timeline.h"
+
+#include <chrono>
+
+#include "hvd/logging.h"
+
+namespace hvd {
+
+int64_t Timeline::NowUs() const {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+void Timeline::Initialize(const std::string& path, int rank) {
+  if (initialized_.load()) return;
+  file_.open(path, std::ios::out | std::ios::trunc);
+  if (!file_.good()) {
+    LOG_ERROR << "Failed to open timeline file: " << path;
+    return;
+  }
+  start_us_ = NowUs();
+  shutdown_.store(false);
+  file_ << "[\n";
+  // Process metadata so chrome://tracing shows the rank.
+  file_ << R"({"name": "process_name", "ph": "M", "pid": )" << rank
+        << R"(, "args": {"name": "rank )" << rank << R"("}})" << ",\n";
+  wrote_header_ = true;
+  writer_ = std::thread([this] { WriterLoop(); });
+  initialized_.store(true);
+}
+
+Timeline::~Timeline() { Shutdown(); }
+
+void Timeline::Shutdown() {
+  if (!initialized_.load()) return;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    shutdown_.store(true);
+  }
+  cv_.notify_all();
+  if (writer_.joinable()) writer_.join();
+  file_.close();
+  initialized_.store(false);
+}
+
+void Timeline::Enqueue(char phase, const std::string& tid,
+                       const std::string& name, std::string args) {
+  if (!initialized_.load()) return;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    events_.push_back(Event{phase, tid, name, std::move(args), NowUs() - start_us_});
+  }
+  cv_.notify_one();
+}
+
+void Timeline::WriterLoop() {
+  std::unique_lock<std::mutex> lock(mu_);
+  while (true) {
+    cv_.wait(lock, [this] { return !events_.empty() || shutdown_.load(); });
+    std::deque<Event> batch;
+    batch.swap(events_);
+    bool done = shutdown_.load();
+    lock.unlock();
+    for (const auto& e : batch) {
+      file_ << R"({"ph": ")" << e.phase << R"(", "ts": )" << e.ts_us
+            << R"(, "pid": 0, "tid": ")" << e.tid << R"(", "name": ")"
+            << e.name << '"';
+      if (!e.args.empty()) file_ << R"(, "args": )" << e.args;
+      file_ << "},\n";
+    }
+    file_.flush();
+    if (done) return;
+    lock.lock();
+  }
+}
+
+void Timeline::NegotiateStart(const std::string& name, const std::string& op) {
+  Enqueue('B', name, "NEGOTIATE_" + op);
+}
+
+void Timeline::NegotiateRankReady(const std::string& name, int rank) {
+  Enqueue('i', name, std::to_string(rank));
+}
+
+void Timeline::NegotiateEnd(const std::string& name) { Enqueue('E', name, ""); }
+
+void Timeline::Start(const std::string& name, const std::string& op) {
+  Enqueue('B', name, op);
+}
+
+void Timeline::ActivityStart(const std::string& name,
+                             const std::string& activity) {
+  Enqueue('B', name, activity);
+}
+
+void Timeline::ActivityEnd(const std::string& name) { Enqueue('E', name, ""); }
+
+void Timeline::End(const std::string& name, int64_t bytes) {
+  Enqueue('E', name, "", bytes > 0 ? "{\"bytes\": " + std::to_string(bytes) + "}" : "");
+}
+
+void Timeline::MarkCycleStart() { Enqueue('i', "cycle", "CYCLE_START"); }
+
+}  // namespace hvd

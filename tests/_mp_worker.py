@@ -1,0 +1,138 @@
+"""Worker script for multi-process eager tests: runs the full op matrix
+and asserts per-rank results (the tests/parallel analog of the
+reference, test/parallel/test_torch.py style, over the TCP controller +
+host data plane)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.common.exceptions import HorovodInternalError  # noqa: E402
+
+
+def main():
+    scenario = sys.argv[1]
+    hvd.init()
+    r, s = hvd.rank(), hvd.size()
+
+    if scenario == "matrix":
+        # --- allreduce sum/avg, several dtypes and shapes
+        for dtype in (np.float32, np.float64, np.int32, np.int64, np.float16):
+            x = (np.arange(24, dtype=dtype) + r).reshape(2, 3, 4)
+            out = hvd.allreduce(x, op=hvd.Sum, name=f"ar.{np.dtype(dtype).name}")
+            want = sum((np.arange(24, dtype=np.float64) + k) for k in range(s))
+            np.testing.assert_allclose(
+                np.asarray(out, np.float64).ravel(), want,
+                rtol=1e-2 if dtype == np.float16 else 1e-6)
+        avg = hvd.allreduce(np.full(5, float(r), np.float32), name="ar.avg")
+        np.testing.assert_allclose(avg, np.full(5, (s - 1) / 2.0), rtol=1e-6)
+
+        # prescale/postscale
+        out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
+                            prescale_factor=0.5, postscale_factor=2.0,
+                            name="ar.scaled")
+        np.testing.assert_allclose(out, np.full(4, s), rtol=1e-6)
+
+        # min/max
+        mn = hvd.allreduce(np.full(3, float(r), np.float32), op=hvd.Min,
+                           name="ar.min")
+        mx = hvd.allreduce(np.full(3, float(r), np.float32), op=hvd.Max,
+                           name="ar.max")
+        np.testing.assert_allclose(mn, 0.0)
+        np.testing.assert_allclose(mx, float(s - 1))
+
+        # --- grouped allreduce (atomic, enqueued in different order per rank)
+        ts = [np.full(4, float(r), np.float32), np.full(2, 2.0 * r, np.float32)]
+        outs = hvd.grouped_allreduce(ts, op=hvd.Sum, name="grp")
+        np.testing.assert_allclose(outs[0], np.full(4, s * (s - 1) / 2.0))
+        np.testing.assert_allclose(outs[1], np.full(2, s * (s - 1)))
+
+        # --- allgather with ragged first dim
+        x = np.full((r + 1, 2), float(r), np.float32)
+        g = hvd.allgather(x, name="ag")
+        rows = sum(k + 1 for k in range(s))
+        assert g.shape == (rows, 2), g.shape
+        off = 0
+        for k in range(s):
+            np.testing.assert_allclose(g[off:off + k + 1], float(k))
+            off += k + 1
+
+        # --- broadcast from nonzero root
+        val = np.full((3,), float(r) + 7.0, np.float32)
+        b = hvd.broadcast(val, root_rank=s - 1, name="bc")
+        np.testing.assert_allclose(b, float(s - 1) + 7.0)
+
+        # --- alltoall with uneven splits: rank r sends k+1 rows to rank k
+        total = sum(k + 1 for k in range(s))
+        x = np.repeat(np.arange(s), [k + 1 for k in range(s)]).astype(np.float32)
+        x = (x * 10 + r)[:, None]  # row value = dest*10 + src
+        out, rsplits = hvd.alltoall(x, splits=[k + 1 for k in range(s)],
+                                    name="a2a")
+        assert list(rsplits) == [r + 1] * s, rsplits
+        assert out.shape == (s * (r + 1), 1)
+        off = 0
+        for k in range(s):
+            np.testing.assert_allclose(out[off:off + r + 1, 0], r * 10 + k)
+            off += r + 1
+
+        # --- reducescatter
+        x = np.full((2 * s, 3), 1.0, np.float32)
+        rs = hvd.reducescatter(x, op=hvd.Sum, name="rs")
+        assert rs.shape == (2, 3), rs.shape
+        np.testing.assert_allclose(rs, float(s))
+
+        # --- barrier
+        hvd.barrier()
+
+        # --- steady-state loop (response cache path)
+        for i in range(50):
+            out = hvd.allreduce(np.full(8, float(r + i), np.float32),
+                                op=hvd.Sum, name="steady")
+            np.testing.assert_allclose(
+                out, float(s * i) + s * (s - 1) / 2.0, rtol=1e-6)
+
+    elif scenario == "join":
+        # Rank k does k+1 allreduces then joins; reductions keep working
+        # with the joined ranks contributing zeros.
+        for i in range(r + 1):
+            contributors = [k for k in range(s) if k >= i]
+            out = hvd.allreduce(np.full(2, float(r + 1), np.float32),
+                                op=hvd.Sum, name=f"j.{i}")
+            want = float(sum(k + 1 for k in contributors))
+            np.testing.assert_allclose(out, want, rtol=1e-6)
+        hvd.join()
+
+    elif scenario == "shape_mismatch":
+        # Shape disagreement must produce an agreed-on error on every
+        # rank, not a hang (reference controller.cc:471 ERROR response).
+        shape = (2, 3) if r == 0 else (2, 4)
+        try:
+            hvd.allreduce(np.ones(shape, np.float32), name="bad")
+            raise SystemExit("expected HorovodInternalError")
+        except HorovodInternalError as e:
+            assert "mismatched shape" in str(e), str(e)
+        # ...and the job is still usable afterwards.
+        out = hvd.allreduce(np.ones(3, np.float32), op=hvd.Sum, name="good")
+        np.testing.assert_allclose(out, float(s))
+
+    elif scenario == "dtype_mismatch":
+        dt = np.float32 if r == 0 else np.float64
+        try:
+            hvd.allreduce(np.ones(3, dt), name="bad")
+            raise SystemExit("expected HorovodInternalError")
+        except HorovodInternalError as e:
+            assert "mismatched dtype" in str(e), str(e)
+
+    else:
+        raise SystemExit(f"unknown scenario {scenario}")
+
+    hvd.shutdown()
+    print(f"OK rank={r}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+"""Launches real multi-process jobs over the TCP controller (the
+test/parallel tier of the reference, run via localhost processes the way
+its CI runs gloo over loopback)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(ROOT, "tests", "_mp_worker.py")
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_job(scenario: str, np_: int, timeout: int = 120):
+    port = _free_port()
+    procs = []
+    for r in range(np_):
+        env = dict(os.environ)
+        env.update({
+            "HOROVOD_RANK": str(r),
+            "HOROVOD_SIZE": str(np_),
+            "HOROVOD_LOCAL_RANK": str(r),
+            "HOROVOD_LOCAL_SIZE": str(np_),
+            "HOROVOD_CROSS_RANK": "0",
+            "HOROVOD_CROSS_SIZE": "1",
+            "HOROVOD_CONTROLLER_ADDR": f"127.0.0.1:{port}",
+            # Skip TPU plugin registration in worker processes.
+            "PALLAS_AXON_POOL_IPS": "",
+            "JAX_PLATFORMS": "cpu",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER, scenario], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    failed = []
+    for r, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise AssertionError(f"rank {r} timed out; output so far unknown")
+        outs.append(out)
+        if p.returncode != 0:
+            failed.append((r, p.returncode, out))
+    assert not failed, "\n".join(
+        f"--- rank {r} rc={rc}\n{out}" for r, rc, out in failed)
+    return outs
+
+
+@pytest.mark.parametrize("np_", [2, 4])
+def test_full_matrix(np_):
+    outs = run_job("matrix", np_)
+    for r, out in enumerate(outs):
+        assert f"OK rank={r}" in out
+
+
+def test_join(capfd):
+    outs = run_job("join", 3)
+    for r, out in enumerate(outs):
+        assert f"OK rank={r}" in out
+
+
+def test_shape_mismatch_error_no_hang():
+    run_job("shape_mismatch", 2, timeout=60)
+
+
+def test_dtype_mismatch_error_no_hang():
+    run_job("dtype_mismatch", 2, timeout=60)
