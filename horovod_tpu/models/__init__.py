@@ -1,0 +1,20 @@
+"""Model zoo — benchmark-grade models the framework trains natively.
+
+The reference repo ships example models through TF/Keras/torchvision
+(``examples/pytorch/pytorch_synthetic_benchmark.py`` uses
+torchvision's ResNet-50; ``examples/tensorflow2/
+tensorflow2_synthetic_benchmark.py`` the Keras one). A standalone TPU
+framework cannot lean on torchvision, so the benchmark model families
+live here, written JAX-first (bf16 matmuls on the MXU, static shapes,
+scan-over-layers for compile time, explicit mesh shardings).
+"""
+
+from horovod_tpu.models.resnet import ResNetConfig, resnet50, resnet101  # noqa: F401
+from horovod_tpu.models.transformer import (  # noqa: F401
+    TransformerConfig,
+    init_params as init_transformer,
+    forward as transformer_forward,
+    lm_loss,
+    make_train_step,
+    param_specs,
+)

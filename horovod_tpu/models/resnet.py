@@ -1,0 +1,83 @@
+"""ResNet v1.5 (50/101) in Flax — the benchmark workhorse.
+
+The reference benchmarks Horovod with torchvision/Keras ResNet-50
+(``examples/pytorch/pytorch_synthetic_benchmark.py:29``,
+``docs/benchmarks.rst:17-43``); a standalone TPU framework needs its
+own. Written MXU-first: bf16 convs (f32 variance accumulation in BN),
+NHWC layout (TPU conv native), no data-dependent shapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Tuple[int, ...] = (3, 4, 6, 3)  # ResNet-50
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+
+
+class Bottleneck(nn.Module):
+    filters: int
+    strides: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        bn = partial(nn.BatchNorm, use_running_average=not train,
+                     momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (1, 1))(x)
+        y = nn.relu(bn()(y))
+        # v1.5: stride lives on the 3x3, not the 1x1
+        y = conv(self.filters, (3, 3), strides=(self.strides,) * 2)(y)
+        y = nn.relu(bn()(y))
+        y = conv(self.filters * 4, (1, 1))(y)
+        y = bn(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, (1, 1),
+                            strides=(self.strides,) * 2,
+                            name="proj")(residual)
+            residual = bn(name="proj_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    cfg: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cfg = self.cfg
+        x = x.astype(cfg.dtype)
+        x = nn.Conv(cfg.width, (7, 7), strides=(2, 2), padding=[(3, 3)] * 2,
+                    use_bias=False, dtype=cfg.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=cfg.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1)] * 2)
+        for i, n_blocks in enumerate(cfg.stage_sizes):
+            for j in range(n_blocks):
+                x = Bottleneck(cfg.width * 2 ** i,
+                               strides=2 if i > 0 and j == 0 else 1,
+                               dtype=cfg.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(cfg.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+def resnet50(num_classes: int = 1000, dtype=jnp.bfloat16) -> ResNet:
+    return ResNet(ResNetConfig((3, 4, 6, 3), num_classes, dtype=dtype))
+
+
+def resnet101(num_classes: int = 1000, dtype=jnp.bfloat16) -> ResNet:
+    return ResNet(ResNetConfig((3, 4, 23, 3), num_classes, dtype=dtype))

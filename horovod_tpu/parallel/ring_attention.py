@@ -1,0 +1,188 @@
+"""Ring attention — context/sequence parallelism over the ``sp`` mesh axis.
+
+Long-context scaling the TPU way: the sequence dimension is sharded
+across the ``sp`` axis and K/V blocks rotate around the ICI ring with
+``lax.ppermute`` while each device accumulates its queries' attention
+with an online (flash-style) softmax. Communication overlaps with the
+block matmuls and no device ever materialises the full [T, T] score
+matrix or the full-sequence K/V.
+
+The reference framework (mackrorysd/horovod) has no sequence
+parallelism at all (SURVEY.md §5.7; the closest primitive is alltoall,
+``horovod/common/operations.cc:1131``). This module is the TPU-native
+answer: ring attention (Liu et al., 2023) for block-SP, and
+:func:`ulysses_attention` (all-to-all head/sequence exchange) as the
+alltoall-based alternative.
+
+Layout convention: ``[batch, seq, heads, head_dim]`` for q/k/v.
+Functions here run *inside* ``shard_map`` (manual over ``sp`` at
+least); :func:`ring_self_attention` is the shard-local computation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_BIG = -1e30  # finite "-inf": keeps the online-softmax guards NaN-free
+
+
+def _rotate(x, axis_name: str, shift: int = 1):
+    """Pass shard-local ``x`` one hop around the ``axis_name`` ring."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm=perm)
+
+
+def _block_attend(q, k, v, o, l, m, *, scale, mask):
+    """One online-softmax accumulation step over a K/V block.
+
+    q: [B, Tq, H, D]; k/v: [B, Tk, H, D]; o: [B, Tq, H, D] f32;
+    l, m: [B, H, Tq] f32 running normaliser / running max.
+    mask: [Tq, Tk] bool (True = attend) or None.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, _NEG_BIG)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    if mask is not None:
+        p = jnp.where(mask[None, None], p, 0.0)
+    corr = jnp.exp(m - m_new)
+    l = l * corr + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
+    o = o * corr.transpose(0, 2, 1)[..., None] + pv
+    return o, l, m_new
+
+
+def ring_self_attention(q, k, v, *, axis_name: str = "sp",
+                        causal: bool = True,
+                        scale: Optional[float] = None):
+    """Shard-local ring attention body (call under ``shard_map``).
+
+    ``q``/``k``/``v``: ``[B, T_local, H, D]`` — the local sequence chunk
+    of a globally ``T_local * sp``-token sequence laid out contiguously
+    (chunk ``i`` on sp-rank ``i``). Returns ``[B, T_local, H, D]`` in
+    ``q.dtype``.
+
+    Each of the ``sp`` steps attends the local queries to the currently
+    held K/V chunk, then rotates K/V one hop (shift −1 so that at step
+    ``i`` rank ``r`` holds chunk ``(r + i) % sp``... direction is
+    irrelevant to correctness since every rank sees every chunk once;
+    causal masking keys off the chunk's global offset).
+    """
+    B, T, H, D = q.shape
+    sp = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    if scale is None:
+        scale = D ** -0.5
+
+    q32 = q
+    o = jnp.zeros((B, T, H, D), jnp.float32)
+    l = jnp.zeros((B, H, T), jnp.float32)
+    m = jnp.full((B, H, T), _NEG_BIG, jnp.float32)
+    # The accumulators become device-varying inside the loop (they mix
+    # in axis_index-dependent masks); declare that up front so the scan
+    # carry types line up under shard_map's VMA checking.
+    if hasattr(lax, "pcast"):
+        o, l, m = (lax.pcast(t, (axis_name,), to="varying")
+                   for t in (o, l, m))
+
+    qpos = my * T + jnp.arange(T)
+
+    def step(i, carry):
+        o, l, m, k_cur, v_cur = carry
+        src = (my + i) % sp  # which global chunk we currently hold
+        if causal:
+            kpos = src * T + jnp.arange(T)
+            mask = qpos[:, None] >= kpos[None, :]
+        else:
+            mask = None
+        o, l, m = _block_attend(q32, k_cur, v_cur, o, l, m,
+                                scale=scale, mask=mask)
+        # Shift -1: receive the next-higher rank's chunk each step.
+        k_nxt = _rotate(k_cur, axis_name, shift=-1)
+        v_nxt = _rotate(v_cur, axis_name, shift=-1)
+        return o, l, m, k_nxt, v_nxt
+
+    o, l, m, _, _ = lax.fori_loop(0, sp, step, (o, l, m, k, v))
+    out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def local_attention(q, k, v, *, causal: bool = True,
+                    scale: Optional[float] = None):
+    """Plain (single-device-sequence) attention with the same layout,
+    used when ``sp == 1`` and as the reference for ring tests."""
+    B, T, H, D = q.shape
+    if scale is None:
+        scale = D ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((T, k.shape[1]), bool))
+        s = jnp.where(mask[None, None], s, _NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, *, axis_name: str = "sp",
+                      causal: bool = True,
+                      scale: Optional[float] = None):
+    """DeepSpeed-Ulysses-style SP: all-to-all so each sp-rank holds the
+    FULL sequence for ``H / sp`` heads, attends locally, then
+    all-to-alls back to sequence sharding. This is exactly the
+    reference's alltoall primitive (``operations.cc:1131``) applied to
+    attention heads — the SP design its substrate anticipated
+    (SURVEY.md §2.6). Requires ``H % sp == 0``.
+    """
+    sp = lax.axis_size(axis_name)
+
+    def seq_to_heads(x):  # [B, T/sp, H, D] -> [B, T, H/sp, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):  # [B, T, H/sp, D] -> [B, T/sp, H, D]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    if sp == 1:
+        return local_attention(q, k, v, causal=causal, scale=scale)
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    oh = local_attention(qh, kh, vh, causal=causal, scale=scale)
+    return heads_to_seq(oh)
+
+
+def sequence_sharded_attention(q, k, v, mesh, *, axis_name: str = "sp",
+                               impl: str = "ring", causal: bool = True,
+                               spec=None):
+    """Convenience wrapper: run ring/Ulysses attention as a
+    partial-manual ``shard_map`` island inside an outer GSPMD program.
+
+    ``q``/``k``/``v`` are *global* ``[B, T, H, D]`` arrays whose ``T``
+    dim is sharded over ``axis_name``; all other mesh axes stay under
+    GSPMD control (``axis_names={axis_name}``).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    if spec is None:
+        spec = P(None, axis_name, None, None)
+    if impl == "ring":
+        body = functools.partial(ring_self_attention, axis_name=axis_name,
+                                 causal=causal)
+    elif impl == "ulysses":
+        body = functools.partial(ulysses_attention, axis_name=axis_name,
+                                 causal=causal)
+    else:
+        raise ValueError(f"unknown SP attention impl {impl!r}")
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, axis_names=frozenset({axis_name}),
+                       check_vma=False)
+    return fn(q, k, v)

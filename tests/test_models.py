@@ -1,0 +1,102 @@
+"""Model-zoo tests: transformer forward/grad under real mesh shardings
+(ring vs local attention equivalence), ResNet-50 shape/grad sanity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.models import (
+    TransformerConfig, init_transformer, transformer_forward, lm_loss,
+    make_train_step, resnet50,
+)
+from horovod_tpu.parallel import build_mesh
+from horovod_tpu.parallel.ring_attention import (
+    local_attention, ring_self_attention, ulysses_attention,
+)
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def test_ring_attention_matches_local(devices):
+    mesh = build_mesh(sp=8)
+    B, T, H, D = 2, 64, 4, 16
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, T, H, D), jnp.float32) for kk in ks)
+    ref = local_attention(q, k, v, causal=True)
+    spec = P(None, "sp", None, None)
+    ring = jax.jit(jax.shard_map(
+        lambda a, b, c: ring_self_attention(a, b, c, axis_name="sp"),
+        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec))
+    out = ring(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_attention_matches_local(devices):
+    mesh = build_mesh(dp=2, sp=4)
+    B, T, H, D = 2, 32, 8, 16
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q, k, v = (jax.random.normal(kk, (B, T, H, D), jnp.float32) for kk in ks)
+    ref = local_attention(q, k, v, causal=True)
+    spec = P(None, "sp", None, None)
+    uly = jax.jit(jax.shard_map(
+        lambda a, b, c: ulysses_attention(a, b, c, axis_name="sp"),
+        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec))
+    out = uly(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg():
+    return TransformerConfig.tiny(dtype=jnp.float32, remat=False)
+
+
+def test_transformer_forward_shape(tiny_cfg):
+    params = init_transformer(tiny_cfg, jax.random.PRNGKey(0))
+    toks = jnp.zeros((2, 16), jnp.int32)
+    logits = transformer_forward(params, toks, tiny_cfg)
+    assert logits.shape == (2, 16, tiny_cfg.vocab_size)
+
+
+def test_transformer_sharded_matches_unsharded(devices, tiny_cfg):
+    mesh = build_mesh(dp=2, sp=2, tp=2)
+    params = init_transformer(tiny_cfg, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0,
+                              tiny_cfg.vocab_size)
+    ref = lm_loss(params, {"tokens": toks}, tiny_cfg)
+    sharded = jax.jit(
+        lambda p, b: lm_loss(p, b, tiny_cfg, mesh))(params, {"tokens": toks})
+    np.testing.assert_allclose(float(sharded), float(ref), rtol=1e-5)
+
+
+def test_transformer_train_step_runs_sharded(devices):
+    cfg = TransformerConfig.tiny()
+    mesh = build_mesh(dp=2, fsdp=2, sp=2, tp=1)
+    init_state, step, _ = make_train_step(cfg, mesh)
+    state = init_state(jax.random.PRNGKey(0))
+    toks = jnp.zeros((4, 33), jnp.int32)
+    batch = {"tokens": jax.device_put(
+        toks, NamedSharding(mesh, P(("dp", "fsdp"), None)))}
+    state, loss1 = step(state, batch)
+    state, loss2 = step(state, batch)
+    assert np.isfinite(float(loss1)) and np.isfinite(float(loss2))
+    assert float(loss2) < float(loss1)  # overfits constant batch
+
+
+def test_resnet50_forward_and_grad():
+    model = resnet50(num_classes=10, dtype=jnp.float32)
+    x = jnp.ones((2, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+
+    def loss_fn(params):
+        out, _ = model.apply(
+            {"params": params, "batch_stats": variables["batch_stats"]},
+            x, train=True, mutable=["batch_stats"])
+        return jnp.mean(out ** 2)
+
+    g = jax.grad(loss_fn)(variables["params"])
+    assert np.isfinite(float(jax.tree.reduce(
+        lambda a, b: a + jnp.sum(jnp.abs(b)), g, 0.0)))
