@@ -49,6 +49,17 @@ _DTYPE_MAP = {
 _BFLOAT16_ID = 10
 
 
+def np_dtype(dt_id: int):
+    """Inverse of :func:`dtype_id` (bfloat16 via ml_dtypes)."""
+    if dt_id == _BFLOAT16_ID:
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    for dt, i in _DTYPE_MAP.items():
+        if i == dt_id:
+            return dt
+    raise TypeError(f"unknown native dtype id {dt_id}")
+
+
 def dtype_id(dtype) -> int:
     dtype = np.dtype(dtype) if not hasattr(dtype, "name") else dtype
     if getattr(dtype, "name", "") == "bfloat16":
@@ -62,23 +73,58 @@ def dtype_id(dtype) -> int:
 EXEC_CB_TYPE = ctypes.CFUNCTYPE(
     None, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
     ctypes.POINTER(ctypes.c_char_p), ctypes.c_int32,
-    ctypes.POINTER(ctypes.c_int64), ctypes.c_int32)
+    ctypes.POINTER(ctypes.c_int64), ctypes.c_int32, ctypes.c_int32)
 ALLOC_CB_TYPE = ctypes.CFUNCTYPE(
     ctypes.c_void_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
     ctypes.c_int32)
 
 
 def _build_native() -> None:
-    subprocess.run(["make", "-C", os.path.join(_REPO_ROOT, "native"), "-j"],
-                   check=True, capture_output=True)
+    # Serialize across processes: concurrently-launched ranks all try to
+    # (re)build on import, and an unlocked parallel make could relink
+    # the .so while a sibling rank is dlopen()ing it.
+    import fcntl
+    native_dir = os.path.join(_REPO_ROOT, "native")
+    with open(os.path.join(native_dir, ".build.lock"), "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+        subprocess.run(["make", "-C", native_dir, "-j"],
+                       check=True, capture_output=True)
 
 
 def load_library() -> ctypes.CDLL:
     path = next((p for p in _LIB_CANDIDATES if os.path.exists(p)), None)
-    if path is None:
-        _build_native()
-        path = _LIB_CANDIDATES[0]
+    # Always (re)run make when the source tree is present: make is a
+    # no-op when the .so is current, and this keeps stale binaries from
+    # silently shadowing native source edits.
+    if os.path.exists(os.path.join(_REPO_ROOT, "native", "Makefile")):
+        try:
+            _build_native()
+            path = next(p for p in _LIB_CANDIDATES if os.path.exists(p))
+        except Exception as e:
+            if path is None:
+                raise
+            # A stale prebuilt .so may predate ABI changes in this source
+            # tree — fall back only after the version check below
+            # confirms compatibility, and never silently.
+            import warnings
+            warnings.warn(
+                f"horovod_tpu: rebuilding the native core failed ({e}); "
+                f"falling back to existing {path}, which may be stale",
+                RuntimeWarning)
+    elif path is None:
+        raise OSError("horovod_tpu native core not found and no source tree "
+                      "to build it from")
     lib = ctypes.CDLL(path)
+
+    ABI_VERSION = 2
+    try:
+        got = lib.hvd_abi_version()
+    except AttributeError:
+        got = -1
+    if got != ABI_VERSION:
+        raise OSError(
+            f"horovod_tpu native core at {path} has ABI version {got}, "
+            f"expected {ABI_VERSION}; rebuild it (make -C native)")
 
     lib.hvd_init.restype = ctypes.c_int
     lib.hvd_init.argtypes = [ctypes.c_int] * 6

@@ -35,10 +35,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from horovod_tpu.parallel.ring_attention import (
-    local_attention,
-    ring_self_attention,
-)
+from horovod_tpu.parallel.ring_attention import make_sp_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -166,24 +163,13 @@ def _rope(x, pos, theta):
 
 
 def _attention_island(cfg: TransformerConfig, mesh: Optional[Mesh]):
-    """Return attn(q, k, v) — ring/Ulysses shard_map island over ``sp``
-    when a mesh with sp>1 is given, plain attention otherwise."""
-    if mesh is None or "sp" not in mesh.axis_names or \
-            mesh.shape.get("sp", 1) == 1 or cfg.sp_attention == "local":
-        return functools.partial(local_attention, causal=True)
-    spec = P(None, "sp", None, None)
-    if cfg.sp_attention == "ring":
-        body = functools.partial(ring_self_attention, axis_name="sp",
-                                 causal=True)
-    elif cfg.sp_attention == "ulysses":
-        from horovod_tpu.parallel.ring_attention import ulysses_attention
-        body = functools.partial(ulysses_attention, axis_name="sp",
-                                 causal=True)
-    else:
-        raise ValueError(f"unknown sp_attention {cfg.sp_attention!r}")
-    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, axis_names=frozenset({"sp"}),
-                         check_vma=False)
+    """attn(q, k, v) — ring/Ulysses shard_map island over ``sp`` when a
+    mesh with sp>1 is given, plain attention otherwise (single
+    construction point: :func:`~horovod_tpu.parallel.ring_attention.make_sp_attention`)."""
+    if mesh is not None and "sp" not in mesh.axis_names:
+        mesh = None
+    return make_sp_attention(mesh, axis_name="sp", impl=cfg.sp_attention,
+                             causal=True)
 
 
 def forward(params, tokens, cfg: TransformerConfig,

@@ -33,6 +33,24 @@ from horovod_tpu.common import basics
 from horovod_tpu.common.ops_enum import ReduceOp
 
 
+def zeros_state(name: str, op: int, n_elems: int, dtype_id: int,
+                reduce_op: int):
+    """Placeholder in-flight state for a rank with no local tensor (it
+    joined): a zeros contribution so the SPMD program still launches
+    here with collectives identical to every other process (reference
+    feeds zeros for joined ranks, ``operations.cc:260``)."""
+    import jax.numpy as jnp
+    from horovod_tpu.runtime import _InFlight
+
+    st = _InFlight()
+    st.name = name
+    st.op = op
+    st.orig_kind = "jax"
+    st.reduce_op = ReduceOp(reduce_op)
+    st.input_dev = jnp.zeros((int(n_elems),), basics.np_dtype(dtype_id))
+    return st
+
+
 def _scale_factor(st, size: int) -> float:
     f = st.prescale * st.postscale
     if st.reduce_op == ReduceOp.AVERAGE:
@@ -40,13 +58,46 @@ def _scale_factor(st, size: int) -> float:
     return f
 
 
+def _check_scalable(dtype, factor: float) -> None:
+    dt = np.dtype(dtype)
+    is_float = dt.kind == "f" or dt.name in ("bfloat16", "float8_e4m3",
+                                             "float8_e5m2")
+    if factor != 1.0 and not is_float:
+        raise TypeError(
+            f"scaling (average/prescale/postscale) is not defined for "
+            f"integer dtype {dt.name}; use op=Sum or cast to a float dtype "
+            "first")
+
+
+def _apply_factor(y, factor):
+    """Shared dtype-promotion policy for the traced scale factor: low
+    precision upcasts to f32 for the multiply; f32 and wider multiply
+    in their own dtype (the factor is passed as float64 so f64 inputs
+    keep full precision under x64 mode)."""
+    import jax.numpy as jnp
+
+    if jnp.dtype(y.dtype).itemsize < 4:
+        return (y.astype(jnp.float32) * factor.astype(jnp.float32)).astype(
+            y.dtype)
+    return y * factor.astype(y.dtype)
+
+
+def _factor_scalar(f: float) -> np.float64:
+    """Factor as a numpy scalar for the jitted programs. float64 so f64
+    tensors don't lose precision; under default (x64-disabled) JAX this
+    traces as f32, which is all the device path supports anyway."""
+    return np.float64(f)
+
+
 @lru_cache(maxsize=None)
 def _scale_jit():
+    """Jitted x*f with the factor TRACED (one compile per dtype/shape,
+    not per factor value — dynamic loss scaling changes the factor
+    every few steps). Callers must reject integer dtypes first
+    (:func:`_check_scalable`)."""
     import jax
-    from functools import partial
-    from horovod_tpu.ops.collectives import _scale
 
-    return partial(jax.jit, static_argnums=(1,))(_scale)
+    return jax.jit(_apply_factor)
 
 
 def execute(op: int, states, sizes: List[int], size: int, rank: int):
@@ -57,7 +108,8 @@ def execute(op: int, states, sizes: List[int], size: int, rank: int):
             if op in (basics.OP_ALLREDUCE, basics.OP_REDUCESCATTER):
                 f = _scale_factor(st, 1)
                 if f != 1.0:
-                    x = _scale_jit()(x, f)
+                    _check_scalable(x.dtype, f)
+                    x = _scale_jit()(x, _factor_scalar(f))
             # allgather/broadcast/alltoall over 1 rank: identity
             # (alltoall recvsplits are filled by the native core).
             outs.append(x)
@@ -85,12 +137,16 @@ def _rank_mesh():
 
 
 @lru_cache(maxsize=None)
-def _reduce_jit(op: ReduceOp, factor: float):
+def _reduce_jit(op: ReduceOp):
+    """One compiled program per (reduce op, dtype, elem count) — the
+    scale factor is a TRACED scalar so dynamic loss scaling never
+    recompiles. Operates on flattened tensors: program identity across
+    processes then depends only on element count, which joined ranks
+    know from the response metadata even without a local tensor."""
     import jax
     import jax.numpy as jnp
-    from horovod_tpu.ops.collectives import _scale
 
-    def fn(arr):
+    def fn(arr, factor):
         if op in (ReduceOp.AVERAGE, ReduceOp.SUM, ReduceOp.ADASUM):
             y = jnp.sum(arr, axis=0)
         elif op == ReduceOp.MIN:
@@ -101,16 +157,26 @@ def _reduce_jit(op: ReduceOp, factor: float):
             y = jnp.prod(arr, axis=0)
         else:
             raise ValueError(f"unknown reduce op {op!r}")
-        return _scale(y, factor) if factor != 1.0 else y
+        if jnp.issubdtype(y.dtype, jnp.inexact):
+            y = _apply_factor(y, factor)
+        return y
 
     return jax.jit(fn)
 
 
+def _reduce_factor(st, size: int) -> np.float64:
+    """Factor for the distributed reduce; rejects scaled integer inputs
+    loudly rather than truncating the factor to 0."""
+    f = _scale_factor(st, size)
+    _check_scalable(st.input_dev.dtype, f)
+    return _factor_scalar(f)
+
+
 def _distributed_allreduce(states, size: int):
     """Reduce each entry across processes: build a global batch-of-
-    shards array (leading axis = process), reduce over it, read back
-    the (replicated) result. XLA lowers the sum-over-sharded-axis to an
-    all-reduce over ICI/DCN."""
+    shards array (leading axis = process) from the FLATTENED local
+    tensor, reduce over it, reshape back. XLA lowers the
+    sum-over-sharded-axis to an all-reduce over ICI/DCN."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -122,8 +188,10 @@ def _distributed_allreduce(states, size: int):
     outs = []
     for st in states:
         x = st.input_dev
-        local = jax.device_put(jnp.asarray(x)[None], local_device)
+        shape = tuple(x.shape)
+        local = jax.device_put(jnp.ravel(jnp.asarray(x))[None], local_device)
         arr = jax.make_array_from_single_device_arrays(
-            (size,) + tuple(x.shape), sharding, [local])
-        outs.append(_reduce_jit(st.reduce_op, _scale_factor(st, size))(arr))
+            (size, local.shape[1]), sharding, [local])
+        y = _reduce_jit(st.reduce_op)(arr, _reduce_factor(st, size))
+        outs.append(y.reshape(shape))
     return outs

@@ -160,20 +160,24 @@ def ulysses_attention(q, k, v, *, axis_name: str = "sp",
     return heads_to_seq(oh)
 
 
-def sequence_sharded_attention(q, k, v, mesh, *, axis_name: str = "sp",
-                               impl: str = "ring", causal: bool = True,
-                               spec=None):
-    """Convenience wrapper: run ring/Ulysses attention as a
+def make_sp_attention(mesh, *, axis_name: str = "sp", impl: str = "ring",
+                      causal: bool = True, spec=None):
+    """Build ``attend(q, k, v)``: ring/Ulysses attention as a
     partial-manual ``shard_map`` island inside an outer GSPMD program.
 
-    ``q``/``k``/``v`` are *global* ``[B, T, H, D]`` arrays whose ``T``
-    dim is sharded over ``axis_name``; all other mesh axes stay under
-    GSPMD control (``axis_names={axis_name}``).
+    Inputs are *global* ``[B, T, H, D]`` arrays whose ``T`` dim is
+    sharded over ``axis_name``; all other mesh axes stay under GSPMD
+    control (``axis_names={axis_name}``). The single construction point
+    for the island — the model layer and the functional API both route
+    through here.
     """
     from jax.sharding import PartitionSpec as P
 
     if spec is None:
         spec = P(None, axis_name, None, None)
+    if impl == "local" or mesh is None or \
+            dict(getattr(mesh, "shape", {})).get(axis_name, 1) == 1:
+        return functools.partial(local_attention, causal=causal)
     if impl == "ring":
         body = functools.partial(ring_self_attention, axis_name=axis_name,
                                  causal=causal)
@@ -182,7 +186,14 @@ def sequence_sharded_attention(q, k, v, mesh, *, axis_name: str = "sp",
                                  causal=causal)
     else:
         raise ValueError(f"unknown SP attention impl {impl!r}")
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, axis_names=frozenset({axis_name}),
-                       check_vma=False)
-    return fn(q, k, v)
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, axis_names=frozenset({axis_name}),
+                         check_vma=False)
+
+
+def sequence_sharded_attention(q, k, v, mesh, *, axis_name: str = "sp",
+                               impl: str = "ring", causal: bool = True,
+                               spec=None):
+    """One-shot form of :func:`make_sp_attention`."""
+    return make_sp_attention(mesh, axis_name=axis_name, impl=impl,
+                             causal=causal, spec=spec)(q, k, v)

@@ -303,28 +303,47 @@ class Runtime:
             return 0
 
     def _on_exec(self, exec_id: int, op: int, n: int, names_ptr, dtype: int,
-                 sizes_ptr, sizes_len: int) -> None:
+                 sizes_ptr, sizes_len: int, reduce_op: int) -> None:
         try:
             names = [names_ptr[i].decode() for i in range(n)]
             sizes = [sizes_ptr[i] for i in range(sizes_len)] if sizes_len else []
-            self._execute_xla(op, names, sizes)
+            self._execute_xla(op, names, sizes, dtype, reduce_op)
             self.lib.hvd_exec_done(exec_id, 0, None)
         except Exception as e:  # noqa: BLE001 — must not unwind into C
             self.lib.hvd_exec_done(exec_id, 1, str(e).encode())
 
-    def _execute_xla(self, op: int, names: List[str], sizes: List[int]) -> None:
+    def _execute_xla(self, op: int, names: List[str], sizes: List[int],
+                     dtype: int, reduce_op: int) -> None:
         """Execute one CALLBACK-mode response with XLA.
 
         Single-process: collectives over ranks degenerate to (scaled)
         identity. Multi-process pods run under ``jax.distributed`` with
         a process-spanning mesh (the launcher sets it up); every process
         executes this same program in the same order — the ordering is
-        guaranteed by the controller's broadcast ResponseList.
+        guaranteed by the controller's broadcast ResponseList. A name
+        with no local handle means this rank joined (reference feeds
+        zeros, ``operations.cc:260``): synthesize a zeros contribution
+        of the response's element count so the collective still launches
+        here.
         """
         from horovod_tpu.ops import xla_exec
 
         with self._lock:
-            states = [self._inflight[self._name_to_handle[nm]] for nm in names]
+            states = []
+            for i, nm in enumerate(names):
+                h = self._name_to_handle.get(nm)
+                if h is not None and h in self._inflight:
+                    states.append(self._inflight[h])
+                elif op == basics.OP_ALLREDUCE:
+                    # Only allreduce responses are launched on ranks with
+                    # no local tensor (the joined-rank path); for it,
+                    # sizes[i] is the tensor's element count.
+                    states.append(xla_exec.zeros_state(
+                        nm, op, sizes[i] if i < len(sizes) else 0, dtype,
+                        reduce_op))
+                else:
+                    raise KeyError(
+                        f"no in-flight state for tensor {nm!r} (op {op})")
         outs = xla_exec.execute(op, states, sizes, self.size(), self.rank())
         with self._lock:
             for st, out in zip(states, outs):

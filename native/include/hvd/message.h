@@ -97,6 +97,11 @@ struct Response {
   std::vector<int64_t> tensor_sizes;
   // Alltoall: per-rank recv splits for the (single) tensor.
   std::vector<int64_t> recvsplits;
+  // Ranks whose data participates (the announcers at fire time). Under
+  // Join this can include a rank that announced and THEN joined — its
+  // real data still counts (reference IncrementTensorCount semantics,
+  // controller.cc:942-965) — while joined non-announcers are absent.
+  std::vector<int32_t> contributors;
   // Cache bit positions this response (re)occupies, in tensor order;
   // kept in lockstep on every rank so hit indices agree.
   std::vector<uint32_t> cache_bits;

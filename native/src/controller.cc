@@ -64,6 +64,8 @@ Response Controller::ConstructResponse(const std::string& name,
   resp.tensor_type = first.tensor_type;
   resp.exec_mode = first.exec_mode;
   resp.reduce_op = first.reduce_op;
+  for (int r : pending.ranks)
+    resp.contributors.push_back(static_cast<int32_t>(r));
 
   std::string err;
   for (const auto& r : reqs) {
@@ -189,9 +191,14 @@ Response Controller::ConstructResponse(const std::string& name,
             err = "alltoall tensor needs >= 1 dim";
             break;
           }
+          // ndim check must sit outside the per-dim loop: a rank with
+          // FEWER dims than `first` would otherwise skip it entirely.
+          if (rq.tensor_shape.size() != first.tensor_shape.size()) {
+            err = "mismatched tensor rank across ranks";
+            break;
+          }
           for (size_t d = 1; d < rq.tensor_shape.size(); ++d) {
-            if (rq.tensor_shape.size() != first.tensor_shape.size() ||
-                rq.tensor_shape[d] != first.tensor_shape[d]) {
+            if (rq.tensor_shape[d] != first.tensor_shape[d]) {
               err = "mismatched non-first dimension across ranks";
               break;
             }
@@ -252,11 +259,18 @@ ResponseList Controller::CoordinatorStep(
     const std::vector<int>& active_ranks, bool shutdown) {
   const int needed = static_cast<int>(active_ranks.size());
 
-  // Ready names (all active ranks announced), group-atomically.
+  // Ready names (all active ranks announced), group-atomically. A rank
+  // that announced a tensor and then joined still has its request in
+  // the table; readiness must count only the *active* announcers or the
+  // tensor never fires (reference: joined ranks lower the needed count,
+  // controller.cc:942-965).
   std::vector<std::string> ready;
   std::map<int64_t, std::vector<std::string>> group_ready;
   for (auto& kv : *table) {
-    if (static_cast<int>(kv.second.ranks.size()) != needed) continue;
+    int present = 0;
+    for (int r : active_ranks)
+      if (kv.second.ranks.count(r)) ++present;
+    if (present != needed) continue;
     const Request& first = kv.second.requests.front();
     if (first.group_key >= 0) {
       group_ready[first.group_key].push_back(kv.first);
@@ -307,7 +321,8 @@ ResponseList Controller::CoordinatorStep(
         if (cand.response_type != ResponseType::ALLREDUCE ||
             cand.tensor_type != merged.tensor_type ||
             cand.exec_mode != merged.exec_mode ||
-            built[j].op_class != built[i].op_class)
+            built[j].op_class != built[i].op_class ||
+            cand.contributors != merged.contributors)
           continue;
         if (bytes + built[j].bytes > fusion_threshold_bytes_) continue;
         merged.tensor_names.push_back(cand.tensor_names.front());
@@ -543,8 +558,12 @@ ResponseList TcpController::CoordinatorCycle(RequestList my_list,
 
   ResponseList out;
   if (active.empty()) {
-    // Everyone joined: emit the JOIN response and reset.
-    out.shutdown = any_shutdown;
+    // Everyone joined. First flush tensors announced only by
+    // since-joined ranks (needed == 0, so every pending tensor fires
+    // with its announcers as contributors — otherwise an
+    // announce-then-join rank's synchronize() would hang forever),
+    // then emit the JOIN response and reset.
+    out = CoordinatorStep(&table_, active, any_shutdown);
     Response r;
     r.response_type = ResponseType::JOIN;
     r.tensor_names = {"join"};

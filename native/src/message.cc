@@ -179,6 +179,7 @@ void Response::SerializeTo(std::string* out) const {
   WriteVec(out, tensor_sizes);
   WriteVec(out, recvsplits);
   WriteVec(out, cache_bits);
+  WriteVec(out, contributors);
 }
 
 bool Response::ParseFrom(const char** p, const char* end, Response* r) {
@@ -197,7 +198,7 @@ bool Response::ParseFrom(const char** p, const char* end, Response* r) {
   for (uint32_t i = 0; i < n; ++i)
     if (!ReadString(p, end, &r->tensor_names[i])) return false;
   return ReadVec(p, end, &r->tensor_sizes) && ReadVec(p, end, &r->recvsplits) &&
-         ReadVec(p, end, &r->cache_bits);
+         ReadVec(p, end, &r->cache_bits) && ReadVec(p, end, &r->contributors);
 }
 
 void ResponseList::SerializeTo(std::string* out) const {

@@ -103,7 +103,8 @@ class HandleManager {
 // Executor: runs one CALLBACK-mode response; must call hvd_exec_done.
 typedef void (*ExecCallback)(int64_t exec_id, int op_type, int num_tensors,
                              const char** tensor_names, int32_t dtype,
-                             const int64_t* sizes, int32_t sizes_len);
+                             const int64_t* sizes, int32_t sizes_len,
+                             int32_t reduce_op);
 // Allocator: returns a host buffer for late-sized outputs
 // (allgather/alltoall), keyed by the entry's handle.
 typedef void* (*AllocCallback)(int64_t handle, const int64_t* shape,
@@ -215,24 +216,38 @@ void PerformOperation(GlobalState& st, const Response& response) {
     return;
   }
   if (entries.empty()) {
-    // Joined rank: no local work — except rank 0, which still serves
-    // as the hub for host-mode allreduces.
-    if (st.rank == 0 && st.size > 1 &&
-        response.response_type == ResponseType::ALLREDUCE &&
-        response.exec_mode == ExecMode::HOST) {
-      st.host_ops->Execute(response, entries);
+    // Joined rank: no local tensors. HOST mode: rank 0 still serves as
+    // the hub for host allreduces. CALLBACK mode: this process must
+    // STILL launch the XLA program — every process in a multi-controller
+    // JAX job has to execute the same collective in the same order
+    // (xla_exec synthesizes a zeros contribution from the response's
+    // element counts; reference feeds zeros for joined ranks,
+    // operations.cc:260).
+    if (response.exec_mode == ExecMode::HOST) {
+      if (st.rank == 0 && st.size > 1 &&
+          response.response_type == ResponseType::ALLREDUCE) {
+        st.host_ops->Execute(response, entries);
+      }
+      return;
     }
-    return;
+    if (response.exec_mode != ExecMode::CALLBACK || st.exec_cb == nullptr ||
+        response.response_type != ResponseType::ALLREDUCE) {
+      return;
+    }
+    // fall through to the CALLBACK launch below with empty entries
   }
 
-  const std::string& tname = entries.front().name;
+  const std::string tname =
+      entries.empty() ? response.tensor_names.front() : entries.front().name;
   st.timeline.Start(tname, ResponseTypeName(response.response_type));
 
   Status status = AllocateOutputs(st, response, entries);
   if (status.ok()) {
-    if (entries.front().exec_mode == ExecMode::CALLBACK) {
+    if (response.exec_mode == ExecMode::CALLBACK) {
       // Hand off to the Python/XLA executor; completion arrives via
-      // hvd_exec_done (possibly from another thread).
+      // hvd_exec_done (possibly from another thread). Names come from
+      // the response (not the local entries) so a joined rank with no
+      // local tensors launches the identical program.
       if (st.exec_cb == nullptr) {
         status = Status::PreconditionError("no XLA executor registered");
       } else {
@@ -244,7 +259,8 @@ void PerformOperation(GlobalState& st, const Response& response) {
           auto& pe = st.pending_execs[exec_id];
           pe.response = response;
           pe.entries = std::move(entries);
-          for (auto& e : pe.entries) names.push_back(e.name.c_str());
+          for (auto& n : pe.response.tensor_names)
+            names.push_back(n.c_str());
         }
         st.timeline.ActivityStart(tname, ACT_XLA_EXEC);
         const std::vector<int64_t>& sizes =
@@ -254,7 +270,8 @@ void PerformOperation(GlobalState& st, const Response& response) {
         st.exec_cb(exec_id, static_cast<int>(response.response_type),
                    static_cast<int>(names.size()), names.data(),
                    static_cast<int32_t>(response.tensor_type), sizes.data(),
-                   static_cast<int32_t>(sizes.size()));
+                   static_cast<int32_t>(sizes.size()),
+                   static_cast<int32_t>(response.reduce_op));
         return;  // completed asynchronously
       }
     } else {
@@ -398,6 +415,10 @@ void hvd_shutdown() {
   if (st.background_thread.joinable()) st.background_thread.join();
   st.initialized.store(false);
 }
+
+// Bump whenever the callback signatures or the wire format change; the
+// Python bridge refuses to load a library whose version disagrees.
+int hvd_abi_version() { return 2; }
 
 int hvd_initialized() { return hvd::State().initialized.load() ? 1 : 0; }
 int hvd_rank() { return hvd::State().rank; }

@@ -152,10 +152,10 @@ Status LocalOps::Execute(const Response& response,
     int64_t bytes = e.shape.num_elements() * DataTypeSize(e.dtype);
     if (e.output != nullptr && e.data != nullptr && e.output != e.data)
       std::memcpy(e.output, e.data, bytes);
+    // size == 1, so AVERAGE's divide-by-size is a genuine no-op here.
     double factor = e.prescale_factor * e.postscale_factor;
     if (response.response_type == ResponseType::ALLREDUCE ||
         response.response_type == ResponseType::REDUCESCATTER) {
-      if (e.reduce_op == ReduceOp::AVERAGE) factor /= 1.0;  // size == 1
       if (e.output) HostScale(e.dtype, e.output, e.shape.num_elements(), factor);
     }
     if (response.response_type == ResponseType::ALLTOALL) {
@@ -197,18 +197,22 @@ Status TcpOps::Allreduce(const Response& r,
                          std::vector<TensorTableEntry>& entries) {
   const int rank = controller_->rank();
   const int size = controller_->size();
-  auto* tcp = static_cast<TcpController*>(controller_);
-  const auto& joined = tcp->joined_ranks();
-  auto is_joined = [&](int rk) {
-    return rk < static_cast<int>(joined.size()) && joined[rk];
-  };
-  // A joined rank has no local entries, but rank 0 must still serve as
+  // Participation follows the response's contributor set (the
+  // coordinator's announcer list at fire time) — NOT the local joined
+  // flags: a rank that announced and then joined still contributes its
+  // real data, and only the coordinator's view of join state is
+  // authoritative anyway. A non-contributing rank 0 still serves as
   // the hub — sizes come from the response metadata, not the entries.
+  auto contributes = [&](int rk) {
+    if (r.contributors.empty()) return true;  // legacy/local path: everyone
+    return std::find(r.contributors.begin(), r.contributors.end(), rk) !=
+           r.contributors.end();
+  };
   const DataType dtype = r.tensor_type;
   int64_t total_elems = 0;
   for (auto n : r.tensor_sizes) total_elems += n;
   const int64_t total_bytes = total_elems * DataTypeSize(dtype);
-  const bool i_participate = !entries.empty() && !is_joined(rank);
+  const bool i_participate = contributes(rank) && !entries.empty();
   if (!i_participate && rank != 0) return Status::OK();
 
   const std::string tname =
@@ -241,7 +245,7 @@ Status TcpOps::Allreduce(const Response& r,
     bool have_initial = i_participate;
     std::vector<uint8_t> scratch(total_bytes);
     for (int peer = 1; peer < size; ++peer) {
-      if (is_joined(peer)) continue;
+      if (!contributes(peer)) continue;
       uint8_t* dst = have_initial ? scratch.data() : buf;
       if (!controller_->DataConn(peer)->RecvAll(dst, total_bytes))
         return Status::UnknownError("allreduce: lost data connection");
@@ -252,7 +256,7 @@ Status TcpOps::Allreduce(const Response& r,
       }
     }
     for (int peer = 1; peer < size; ++peer) {
-      if (is_joined(peer)) continue;
+      if (!contributes(peer)) continue;
       if (!controller_->DataConn(peer)->SendAll(buf, total_bytes))
         return Status::UnknownError("allreduce: lost data connection");
     }

@@ -106,6 +106,46 @@ def main():
             np.testing.assert_allclose(out, want, rtol=1e-6)
         hvd.join()
 
+    elif scenario == "join_race":
+        # A rank that announces a collective and joins in the same cycle
+        # must not deadlock: the announced tensor still completes with
+        # every announcer's contribution (regression: readiness used to
+        # require ALL announcers to be active).
+        if r == 0:
+            h = hvd.allreduce_async(np.full(2, 1.0, np.float32), op=hvd.Sum,
+                                    name="t")
+            hvd.join()
+            out = hvd.synchronize(h)
+        else:
+            out = hvd.allreduce(np.full(2, 1.0, np.float32), op=hvd.Sum,
+                                name="t")
+            hvd.join()
+        np.testing.assert_allclose(out, float(s))
+
+    elif scenario == "join_solo_announce":
+        # A tensor announced ONLY by ranks that then join must still
+        # fire (with just the announcers contributing) when everyone has
+        # joined, not hang the announcer's synchronize().
+        if r == 0:
+            h = hvd.allreduce_async(np.full(3, 5.0, np.float32), op=hvd.Sum,
+                                    name="solo")
+            hvd.join()
+            out = hvd.synchronize(h)
+            np.testing.assert_allclose(out, 5.0)
+        else:
+            hvd.join()
+
+    elif scenario == "alltoall_ndim_mismatch":
+        # Rank with FEWER dims than the first announcer must still be
+        # rejected (regression: the ndim check was order-dependent).
+        x = (np.ones((4, 2), np.float32) if r == 0
+             else np.ones((4,), np.float32))
+        try:
+            hvd.alltoall(x, name="bad.a2a")
+            raise SystemExit("expected HorovodInternalError")
+        except HorovodInternalError as e:
+            assert "rank" in str(e) or "dimension" in str(e), str(e)
+
     elif scenario == "shape_mismatch":
         # Shape disagreement must produce an agreed-on error on every
         # rank, not a hang (reference controller.cc:471 ERROR response).

@@ -69,6 +69,22 @@ def test_join(capfd):
         assert f"OK rank={r}" in out
 
 
+def test_join_race_no_deadlock():
+    outs = run_job("join_race", 2, timeout=90)
+    for r, out in enumerate(outs):
+        assert f"OK rank={r}" in out
+
+
+def test_join_solo_announce_no_hang():
+    outs = run_job("join_solo_announce", 2, timeout=90)
+    for r, out in enumerate(outs):
+        assert f"OK rank={r}" in out
+
+
+def test_alltoall_ndim_mismatch_error_no_hang():
+    run_job("alltoall_ndim_mismatch", 2, timeout=60)
+
+
 def test_shape_mismatch_error_no_hang():
     run_job("shape_mismatch", 2, timeout=60)
 
