@@ -544,8 +544,11 @@ void hvd_exec_done(int64_t exec_id, int status_code, const char* err) {
   hvd::Status s = status_code == 0
                       ? hvd::Status::OK()
                       : hvd::Status::UnknownError(err ? err : "exec failed");
-  if (!pe.entries.empty()) {
-    const std::string& tname = pe.entries.front().name;
+  // Close the timeline span opened in PerformOperation — also on a
+  // joined rank whose launch had no local entries (tname came from the
+  // response there too).
+  if (!pe.response.tensor_names.empty()) {
+    const std::string& tname = pe.response.tensor_names.front();
     st.timeline.ActivityEnd(tname);
     st.timeline.End(tname, 0);
   }

@@ -1,0 +1,20 @@
+"""The driver's contract: entry() jits; dryrun_multichip(8) executes a
+full sharded training step on the virtual CPU mesh."""
+
+import sys
+
+import jax
+
+
+def test_entry_jits():
+    sys.path.insert(0, "/root/repo")
+    import __graft_entry__ as g
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape[0] == args[1].shape[0]
+
+
+def test_dryrun_multichip_8(devices):
+    sys.path.insert(0, "/root/repo")
+    import __graft_entry__ as g
+    g.dryrun_multichip(8)
