@@ -20,6 +20,7 @@ import json
 import os
 import sys
 import time
+from functools import partial
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
@@ -35,7 +36,13 @@ def main():
     from horovod_tpu.models import resnet50
     from horovod_tpu.parallel import build_mesh
 
-    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    mesh = build_mesh(dp=-1)
+    n_dev = mesh.devices.size
+
+    # The reference protocol is batch 32 PER DEVICE
+    # (pytorch_synthetic_benchmark.py); scale the global batch by the dp
+    # size so per-chip batch matches on any mesh.
+    batch = int(os.environ.get("BENCH_BATCH", str(32 * n_dev)))
     warmup, rounds, iters = 10, 10, 10
 
     model = resnet50(dtype=jnp.bfloat16)
@@ -48,8 +55,6 @@ def main():
     opt = optax.sgd(0.01, momentum=0.9)
     opt_state = opt.init(params)
 
-    mesh = build_mesh(dp=-1)
-    n_dev = mesh.devices.size
     repl = NamedSharding(mesh, P())
     data_sh = NamedSharding(mesh, P("dp"))
 
@@ -73,8 +78,9 @@ def main():
 
     # One jitted "round" = scan of `iters` training steps — the
     # TPU-idiomatic shape of the reference's 10-batch timeit body (no
-    # per-step host dispatch in the measured region).
-    @jax.jit
+    # per-step host dispatch in the measured region). State is donated
+    # so each round reuses the previous round's buffers in place.
+    @partial(jax.jit, donate_argnums=0)
     def run_round(state):
         state, losses = jax.lax.scan(step, state, None, length=iters)
         return state, losses[-1]

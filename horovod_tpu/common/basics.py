@@ -73,7 +73,8 @@ def dtype_id(dtype) -> int:
 EXEC_CB_TYPE = ctypes.CFUNCTYPE(
     None, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
     ctypes.POINTER(ctypes.c_char_p), ctypes.c_int32,
-    ctypes.POINTER(ctypes.c_int64), ctypes.c_int32, ctypes.c_int32)
+    ctypes.POINTER(ctypes.c_int64), ctypes.c_int32, ctypes.c_int32,
+    ctypes.c_int32)
 ALLOC_CB_TYPE = ctypes.CFUNCTYPE(
     ctypes.c_void_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
     ctypes.c_int32)
@@ -116,7 +117,7 @@ def load_library() -> ctypes.CDLL:
                       "to build it from")
     lib = ctypes.CDLL(path)
 
-    ABI_VERSION = 2
+    ABI_VERSION = 3
     try:
         got = lib.hvd_abi_version()
     except AttributeError:

@@ -303,28 +303,34 @@ class Runtime:
             return 0
 
     def _on_exec(self, exec_id: int, op: int, n: int, names_ptr, dtype: int,
-                 sizes_ptr, sizes_len: int, reduce_op: int) -> None:
+                 sizes_ptr, sizes_len: int, reduce_op: int,
+                 contributes: int) -> None:
         try:
             names = [names_ptr[i].decode() for i in range(n)]
             sizes = [sizes_ptr[i] for i in range(sizes_len)] if sizes_len else []
-            self._execute_xla(op, names, sizes, dtype, reduce_op)
+            self._execute_xla(op, names, sizes, dtype, reduce_op,
+                              bool(contributes))
             self.lib.hvd_exec_done(exec_id, 0, None)
         except Exception as e:  # noqa: BLE001 — must not unwind into C
             self.lib.hvd_exec_done(exec_id, 1, str(e).encode())
 
     def _execute_xla(self, op: int, names: List[str], sizes: List[int],
-                     dtype: int, reduce_op: int) -> None:
+                     dtype: int, reduce_op: int, contributes: bool) -> None:
         """Execute one CALLBACK-mode response with XLA.
 
         Single-process: collectives over ranks degenerate to (scaled)
         identity. Multi-process pods run under ``jax.distributed`` with
         a process-spanning mesh (the launcher sets it up); every process
         executes this same program in the same order — the ordering is
-        guaranteed by the controller's broadcast ResponseList. A name
-        with no local handle means this rank joined (reference feeds
-        zeros, ``operations.cc:260``): synthesize a zeros contribution
-        of the response's element count so the collective still launches
-        here.
+        guaranteed by the controller's broadcast ResponseList.
+
+        ``contributes`` comes from the Response's contributor set: only
+        when this rank is genuinely a non-contributor (it joined) may a
+        missing local handle be replaced by a zeros contribution
+        (reference feeds zeros for joined ranks, ``operations.cc:260``).
+        A missing handle on a contributing rank is a bug (name reuse,
+        premature cleanup) and raises instead of corrupting the
+        reduction with silent zeros.
         """
         from horovod_tpu.ops import xla_exec
 
@@ -334,16 +340,17 @@ class Runtime:
                 h = self._name_to_handle.get(nm)
                 if h is not None and h in self._inflight:
                     states.append(self._inflight[h])
-                elif op == basics.OP_ALLREDUCE:
-                    # Only allreduce responses are launched on ranks with
-                    # no local tensor (the joined-rank path); for it,
-                    # sizes[i] is the tensor's element count.
+                elif not contributes and op == basics.OP_ALLREDUCE:
+                    # Joined rank with no local tensor: sizes[i] is the
+                    # tensor's element count.
                     states.append(xla_exec.zeros_state(
                         nm, op, sizes[i] if i < len(sizes) else 0, dtype,
                         reduce_op))
                 else:
                     raise KeyError(
-                        f"no in-flight state for tensor {nm!r} (op {op})")
+                        f"no in-flight state for tensor {nm!r} (op {op}, "
+                        f"contributes={contributes}); a contributing rank "
+                        "must hold a live handle for every response tensor")
         outs = xla_exec.execute(op, states, sizes, self.size(), self.rank())
         with self._lock:
             for st, out in zip(states, outs):

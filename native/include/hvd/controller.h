@@ -30,7 +30,6 @@
 #include <vector>
 
 #include "hvd/common.h"
-#include "hvd/group_table.h"
 #include "hvd/message.h"
 #include "hvd/response_cache.h"
 #include "hvd/stall_inspector.h"
@@ -40,10 +39,12 @@
 
 namespace hvd {
 
+// Grouped collectives ride the group_key/group_size fields on each
+// Request (see CoordinatorStep's group-ready gate) — there is no
+// separate group registry.
 struct ControllerDeps {
   TensorQueue* tensor_queue = nullptr;
   ResponseCache* response_cache = nullptr;
-  GroupTable* group_table = nullptr;
   StallInspector* stall_inspector = nullptr;
   Timeline* timeline = nullptr;
 };

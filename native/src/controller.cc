@@ -281,7 +281,10 @@ ResponseList Controller::CoordinatorStep(
   for (auto& kv : group_ready) {
     const auto& names = kv.second;
     int group_size = (*table)[names.front()].requests.front().group_size;
-    if (static_cast<int>(names.size()) >= group_size)
+    // needed == 0 is the everyone-joined flush: a group whose announcer
+    // joined before announcing every member can never complete, so fire
+    // the partial group too or its synchronize() hangs forever.
+    if (needed == 0 || static_cast<int>(names.size()) >= group_size)
       ready.insert(ready.end(), names.begin(), names.end());
   }
   std::sort(ready.begin(), ready.end());

@@ -27,7 +27,6 @@
 #include "hvd/common.h"
 #include "hvd/controller.h"
 #include "hvd/fusion_buffer.h"
-#include "hvd/group_table.h"
 #include "hvd/logging.h"
 #include "hvd/message.h"
 #include "hvd/ops.h"
@@ -101,10 +100,15 @@ class HandleManager {
 
 // Python-side hooks (set before hvd_init).
 // Executor: runs one CALLBACK-mode response; must call hvd_exec_done.
+// `this_rank_contributes` is 1 when this rank's data participates in
+// the response (it announced the tensors); 0 means this rank joined and
+// the executor must synthesize a zeros contribution. Fused responses
+// share one contributor set (fusion requires it), so one flag suffices.
 typedef void (*ExecCallback)(int64_t exec_id, int op_type, int num_tensors,
                              const char** tensor_names, int32_t dtype,
                              const int64_t* sizes, int32_t sizes_len,
-                             int32_t reduce_op);
+                             int32_t reduce_op,
+                             int32_t this_rank_contributes);
 // Allocator: returns a host buffer for late-sized outputs
 // (allgather/alltoall), keyed by the entry's handle.
 typedef void* (*AllocCallback)(int64_t handle, const int64_t* shape,
@@ -125,7 +129,6 @@ struct GlobalState {
 
   TensorQueue tensor_queue;
   ResponseCache response_cache;
-  GroupTable group_table;
   StallInspector stall_inspector;
   Timeline timeline;
   FusionBufferManager fusion;
@@ -267,11 +270,16 @@ void PerformOperation(GlobalState& st, const Response& response) {
             response.response_type == ResponseType::ALLTOALL
                 ? response.recvsplits
                 : response.tensor_sizes;
+        // Empty contributor set means "everyone contributes" (same
+        // convention as the host data plane, ops.cc).
+        int32_t contributes = response.contributors.empty() ? 1 : 0;
+        for (int32_t r : response.contributors)
+          if (r == st.rank) contributes = 1;
         st.exec_cb(exec_id, static_cast<int>(response.response_type),
                    static_cast<int>(names.size()), names.data(),
                    static_cast<int32_t>(response.tensor_type), sizes.data(),
                    static_cast<int32_t>(sizes.size()),
-                   static_cast<int32_t>(response.reduce_op));
+                   static_cast<int32_t>(response.reduce_op), contributes);
         return;  // completed asynchronously
       }
     } else {
@@ -370,7 +378,6 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   hvd::ControllerDeps deps;
   deps.tensor_queue = &st.tensor_queue;
   deps.response_cache = &st.response_cache;
-  deps.group_table = &st.group_table;
   deps.stall_inspector = &st.stall_inspector;
   deps.timeline = &st.timeline;
 
@@ -418,7 +425,7 @@ void hvd_shutdown() {
 
 // Bump whenever the callback signatures or the wire format change; the
 // Python bridge refuses to load a library whose version disagrees.
-int hvd_abi_version() { return 2; }
+int hvd_abi_version() { return 3; }
 
 int hvd_initialized() { return hvd::State().initialized.load() ? 1 : 0; }
 int hvd_rank() { return hvd::State().rank; }

@@ -86,8 +86,9 @@ def test_allgather_broadcast_alltoall():
 def test_duplicate_name_rejected():
     # Slow the cycle so the first enqueue is reliably still in flight
     # when the same-name duplicate arrives (reference common.h:169-172).
+    # The window must outlast scheduler stalls under full-suite load.
     hvd.shutdown()
-    os.environ["HOROVOD_CYCLE_TIME"] = "200"
+    os.environ["HOROVOD_CYCLE_TIME"] = "1000"
     try:
         hvd.init()
         h1 = hvd.allreduce_async(np.ones(8, np.float32), name="dup",
