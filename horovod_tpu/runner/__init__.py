@@ -1,0 +1,16 @@
+"""Launcher / orchestration layer (the ``horovodrun`` analog).
+
+Mirrors the reference's ``horovod/runner/`` (CLI ``runner/launch.py:242``,
+static launch ``runner/gloo_run.py:226-271``, host model
+``runner/common/util/hosts.py``, HTTP rendezvous
+``runner/http/http_server.py:112-201``) rebuilt for the TPU runtime:
+workers get the ``HOROVOD_*`` env contract, the controller address is
+discovered through the launcher's KV store rather than pre-agreed, and
+``run()`` executes a pickled function on every rank and returns the
+per-rank results.
+"""
+
+from horovod_tpu.runner.api import run, run_command  # noqa: F401
+from horovod_tpu.runner.hosts import (  # noqa: F401
+    HostInfo, SlotInfo, get_host_assignments, parse_hostfile, parse_hosts,
+)

@@ -1,0 +1,7 @@
+"""``python -m horovod_tpu.runner`` == ``horovodrun``."""
+
+import sys
+
+from horovod_tpu.runner.launch import main
+
+sys.exit(main())

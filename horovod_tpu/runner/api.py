@@ -1,0 +1,100 @@
+"""Python launch API: ``horovod_tpu.runner.run(fn, ...)``.
+
+Rebuild of ``horovod.run`` (reference ``horovod/runner/__init__.py``):
+pickle a function, execute it on every rank of a freshly launched job,
+collect the per-rank return values through the launcher's KV store (the
+reference collects via its rendezvous KV too, ``runner/launch.py``
+``run_func`` path).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+from horovod_tpu.runner.http_kv import KVServer
+from horovod_tpu.runner.launch import LaunchSettings, launch_static
+
+FN_SCOPE = "exec"
+FN_KEY = "fn"
+RESULT_SCOPE = "results"
+
+
+def run_command(command, np: int, hosts: Optional[str] = None,
+                hostfile: Optional[str] = None,
+                env: Optional[Dict[str, str]] = None,
+                start_timeout: float = 120.0,
+                verbose: bool = False) -> None:
+    """Launch an arbitrary command on every slot; raises RuntimeError if
+    any rank fails."""
+    codes = launch_static(LaunchSettings(
+        np=np, command=command, hosts=hosts, hostfile=hostfile, env=env,
+        start_timeout=start_timeout, verbose=verbose))
+    failures = {r: c for r, c in codes.items() if c != 0}
+    if failures:
+        raise RuntimeError(f"horovodrun: ranks failed: {failures}")
+
+
+def run(fn, args: tuple = (), kwargs: Optional[dict] = None, *,
+        np: int = 1, hosts: Optional[str] = None,
+        hostfile: Optional[str] = None,
+        env: Optional[Dict[str, str]] = None,
+        start_timeout: float = 120.0,
+        verbose: bool = False) -> List[Any]:
+    """Run ``fn(*args, **kwargs)`` on ``np`` ranks; returns the list of
+    per-rank return values ordered by rank.
+
+    Remote hosts pull the pickled function over HTTP (no shared
+    filesystem needed for the *function*), but they do need
+    ``horovod_tpu`` itself importable — install it or make the same
+    path available there.
+    """
+    from horovod_tpu.runner.launch import _resolve_hosts, is_local_host
+    host_list = _resolve_hosts(LaunchSettings(
+        np=np, command=(), hosts=hosts, hostfile=hostfile))
+    all_local = all(is_local_host(h.hostname) for h in host_list)
+    server = KVServer(host="127.0.0.1" if all_local else "0.0.0.0")
+    server.start()
+    try:
+        payload = cloudpickle.dumps((fn, tuple(args), dict(kwargs or {})))
+        server_env = dict(env or {})
+        # Workers run `python -m horovod_tpu.runner.run_task`; make this
+        # package importable from any cwd.
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        existing = server_env.get("PYTHONPATH", os.environ.get("PYTHONPATH"))
+        server_env["PYTHONPATH"] = (pkg_root if not existing
+                                    else f"{pkg_root}{os.pathsep}{existing}")
+        command = [sys.executable, "-m", "horovod_tpu.runner.run_task"]
+        settings = LaunchSettings(
+            np=np, command=command, hosts=hosts, hostfile=hostfile,
+            env=server_env, start_timeout=start_timeout, verbose=verbose)
+        # Publish before spawning so workers never race the key.
+        server.put_local(FN_SCOPE, FN_KEY, payload)
+        codes = launch_static(settings, kv_server=server)
+
+        results: List[Any] = []
+        errors: Dict[int, str] = {}
+        for rank in range(np):
+            blob = server.get_local(RESULT_SCOPE, str(rank))
+            if blob is None:
+                errors[rank] = (f"no result (exit code "
+                                f"{codes.get(rank, 'unknown')})")
+                results.append(None)
+                continue
+            ok, value = cloudpickle.loads(blob)
+            if ok:
+                results.append(value)
+            else:
+                errors[rank] = value
+                results.append(None)
+        if errors:
+            detail = "\n".join(f"[rank {r}] {msg}"
+                               for r, msg in sorted(errors.items()))
+            raise RuntimeError(f"horovod_tpu.runner.run failed:\n{detail}")
+        return results
+    finally:
+        server.stop()

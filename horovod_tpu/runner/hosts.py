@@ -1,0 +1,105 @@
+"""Host/slot model: parse host specs, assign ranks to slots.
+
+Rebuild of ``horovod/runner/common/util/hosts.py`` (``parse_hosts``,
+``get_host_assignments`` -> ``SlotInfo``): ranks are assigned in block
+order host by host, ``local_rank`` counts within a host, ``cross_rank``
+is the host's index among the hosts actually used.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import List
+
+
+@dataclasses.dataclass(frozen=True)
+class HostInfo:
+    hostname: str
+    slots: int
+
+
+@dataclasses.dataclass(frozen=True)
+class SlotInfo:
+    hostname: str
+    rank: int
+    local_rank: int
+    cross_rank: int
+    size: int
+    local_size: int
+    cross_size: int
+
+
+_HOST_RE = re.compile(r"^(?P<host>[^:\s]+)(:(?P<slots>\d+))?$")
+
+
+def parse_hosts(hosts_string: str) -> List[HostInfo]:
+    """``"h1:2,h2:4"`` -> [HostInfo(h1, 2), HostInfo(h2, 4)]; a host
+    without an explicit slot count gets 1 slot."""
+    out = []
+    for part in hosts_string.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        m = _HOST_RE.match(part)
+        if m is None:
+            raise ValueError(f"invalid host spec: {part!r}")
+        out.append(HostInfo(m.group("host"),
+                            int(m.group("slots") or 1)))
+    if not out:
+        raise ValueError(f"no hosts in spec {hosts_string!r}")
+    return out
+
+
+def parse_hostfile(path: str) -> List[HostInfo]:
+    """One host per line: ``hostname slots=N``, ``hostname:N`` or bare
+    ``hostname`` (1 slot). ``#`` comments allowed."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            m = re.match(r"^(?P<host>\S+)\s+slots\s*=\s*(?P<slots>\d+)$", line)
+            if m:
+                out.append(HostInfo(m.group("host"), int(m.group("slots"))))
+            else:
+                out.extend(parse_hosts(line))
+    if not out:
+        raise ValueError(f"hostfile {path} contains no hosts")
+    return out
+
+
+def get_host_assignments(hosts: List[HostInfo], np: int) -> List[SlotInfo]:
+    """Assign ``np`` ranks to hosts in block order (reference
+    ``get_host_assignments``)."""
+    total = sum(h.slots for h in hosts)
+    if np > total:
+        raise ValueError(
+            f"requested {np} processes but hosts provide only {total} slots")
+    # Slots actually used per host, in order.
+    used: List[HostInfo] = []
+    remaining = np
+    for h in hosts:
+        if remaining <= 0:
+            break
+        take = min(h.slots, remaining)
+        used.append(HostInfo(h.hostname, take))
+        remaining -= take
+
+    # Cross coordinates are per local_rank "column": cross_size for
+    # local_rank L counts the hosts that have a rank L (matters only for
+    # heterogeneous slot counts), matching the reference's SlotInfo.
+    out: List[SlotInfo] = []
+    rank = 0
+    for host_idx, h in enumerate(used):
+        for local_rank in range(h.slots):
+            cross_rank = sum(1 for o in used[:host_idx]
+                             if o.slots > local_rank)
+            cross_size = sum(1 for o in used if o.slots > local_rank)
+            out.append(SlotInfo(
+                hostname=h.hostname, rank=rank, local_rank=local_rank,
+                cross_rank=cross_rank, size=np, local_size=h.slots,
+                cross_size=cross_size))
+            rank += 1
+    return out

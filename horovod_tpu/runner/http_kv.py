@@ -1,0 +1,152 @@
+"""Threaded HTTP key-value store for rendezvous + result collection.
+
+Rebuild of the reference's launcher-side KV server
+(``horovod/runner/http/http_server.py:112-201``) and client
+(``http_client.py``): scoped keys (``/scope/key``), PUT stores bytes,
+GET returns them (404 while absent, which clients poll through),
+DELETE finalizes a scope. Used for controller-address discovery, for
+shipping the pickled ``run()`` function to workers, and for collecting
+per-rank results.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+
+class _KVHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def _split(self) -> Tuple[str, str]:
+        parts = self.path.strip("/").split("/", 1)
+        scope = parts[0] if parts else ""
+        key = parts[1] if len(parts) > 1 else ""
+        return scope, key
+
+    def do_PUT(self):  # noqa: N802 (http.server API)
+        scope, key = self._split()
+        length = int(self.headers.get("Content-Length", 0))
+        value = self.rfile.read(length)
+        with self.server.kv_lock:
+            self.server.kv.setdefault(scope, {})[key] = value
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_GET(self):  # noqa: N802
+        scope, key = self._split()
+        with self.server.kv_lock:
+            value = self.server.kv.get(scope, {}).get(key)
+        if value is None:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(value)))
+        self.end_headers()
+        self.wfile.write(value)
+
+    def do_DELETE(self):  # noqa: N802
+        scope, _ = self._split()
+        with self.server.kv_lock:
+            self.server.kv.pop(scope, None)
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def log_message(self, fmt, *args):  # silence per-request noise
+        pass
+
+
+class KVServer:
+    """Launcher-side store. ``start()`` binds an ephemeral port.
+
+    Binds loopback by default: the ``exec`` scope carries pickles that
+    workers execute, so the store must not be reachable off-host unless
+    the job actually spans hosts (pass ``host="0.0.0.0"`` then).
+    """
+
+    def __init__(self, host: str = "127.0.0.1"):
+        self._host = host
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        self._httpd = ThreadingHTTPServer((self._host, 0), _KVHandler)
+        self._httpd.kv: Dict[str, Dict[str, bytes]] = {}
+        self._httpd.kv_lock = threading.Lock()
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="hvd-kv-server", daemon=True)
+        self._thread.start()
+        return self._httpd.server_address[1]
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def get_local(self, scope: str, key: str) -> Optional[bytes]:
+        with self._httpd.kv_lock:
+            return self._httpd.kv.get(scope, {}).get(key)
+
+    def put_local(self, scope: str, key: str, value: bytes) -> None:
+        with self._httpd.kv_lock:
+            self._httpd.kv.setdefault(scope, {})[key] = value
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+def kv_put(addr: str, scope: str, key: str, value: bytes,
+           timeout: float = 30.0) -> None:
+    req = urllib.request.Request(
+        f"http://{addr}/{scope}/{key}", data=value, method="PUT")
+    with urllib.request.urlopen(req, timeout=timeout):
+        pass
+
+
+def kv_get(addr: str, scope: str, key: str,
+           timeout: float = 30.0) -> Optional[bytes]:
+    """One fetch; None while the key is absent."""
+    try:
+        with urllib.request.urlopen(
+                f"http://{addr}/{scope}/{key}", timeout=timeout) as resp:
+            return resp.read()
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return None
+        raise
+
+
+def kv_wait(addr: str, scope: str, key: str, timeout: float,
+            poll_interval: float = 0.1) -> bytes:
+    """Poll until the key appears (rendezvous barrier semantics).
+    Transient connection failures during startup (launcher not yet
+    reachable) are retried until the deadline, like 404s."""
+    deadline = time.monotonic() + timeout
+    last_err: Optional[Exception] = None
+    while True:
+        try:
+            value = kv_get(addr, scope, key)
+            if value is not None:
+                return value
+        except (urllib.error.URLError, ConnectionError, TimeoutError) as e:
+            last_err = e
+        if time.monotonic() >= deadline:
+            detail = f" (last error: {last_err})" if last_err else ""
+            raise TimeoutError(
+                f"timed out after {timeout:.0f}s waiting for {scope}/{key} "
+                f"at {addr}{detail}")
+        time.sleep(poll_interval)

@@ -1,0 +1,241 @@
+"""``horovodrun`` CLI and static job launch.
+
+Rebuild of ``horovod/runner/launch.py:242-527`` (argument surface) and
+``runner/gloo_run.py:226-271`` (static launch): compute slot
+assignments, start the launcher KV store, spawn one worker per slot
+with the ``HOROVOD_*`` env contract (local ``subprocess`` or ``ssh``
+for remote hosts), stream their output, and tear the job down on the
+first failure. The controller address is *discovered*: rank 0 picks a
+free port and publishes it through the KV store
+(``horovod_tpu/runner/rendezvous.py``), the gloo-rendezvous analog
+(``gloo/gloo_context.cc:63-84``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import shlex
+import socket
+import sys
+from typing import Dict, List, Optional, Sequence
+
+from horovod_tpu.runner import hosts as hosts_mod
+from horovod_tpu.runner.http_kv import KVServer
+from horovod_tpu.runner.safe_exec import WorkerProcess, wait_all
+
+_LOCAL_NAMES = {"localhost", "127.0.0.1", "::1"}
+
+
+def is_local_host(hostname: str) -> bool:
+    return (hostname in _LOCAL_NAMES
+            or hostname == socket.gethostname()
+            or hostname == socket.getfqdn())
+
+
+@dataclasses.dataclass
+class LaunchSettings:
+    np: int
+    command: Sequence[str]
+    hosts: Optional[str] = None
+    hostfile: Optional[str] = None
+    env: Optional[Dict[str, str]] = None   # extra env for every worker
+    start_timeout: float = 120.0
+    verbose: bool = False
+    ssh_port: Optional[int] = None
+
+
+def _resolve_hosts(settings: LaunchSettings) -> List[hosts_mod.HostInfo]:
+    if settings.hosts and settings.hostfile:
+        raise ValueError("specify either hosts or hostfile, not both")
+    if settings.hostfile:
+        return hosts_mod.parse_hostfile(settings.hostfile)
+    if settings.hosts:
+        return hosts_mod.parse_hosts(settings.hosts)
+    return [hosts_mod.HostInfo("localhost", settings.np)]
+
+
+def _slot_env(slot: hosts_mod.SlotInfo, base: Dict[str, str],
+              kv_addr: str, controller_host: str,
+              start_timeout: float) -> Dict[str, str]:
+    env = dict(base)
+    env.update({
+        "HOROVOD_RANK": str(slot.rank),
+        "HOROVOD_SIZE": str(slot.size),
+        "HOROVOD_LOCAL_RANK": str(slot.local_rank),
+        "HOROVOD_LOCAL_SIZE": str(slot.local_size),
+        "HOROVOD_CROSS_RANK": str(slot.cross_rank),
+        "HOROVOD_CROSS_SIZE": str(slot.cross_size),
+        "HOROVOD_RENDEZVOUS_ADDR": kv_addr,
+        "HOROVOD_CONTROLLER_HOST": controller_host,
+        "HOROVOD_HOSTNAME": slot.hostname,
+        "HOROVOD_START_TIMEOUT": str(start_timeout),
+        # Controller init must outlast slow-starting peers.
+        "HOROVOD_CONTROLLER_TIMEOUT_MS":
+            str(int(start_timeout * 1000)),
+    })
+    env.pop("HOROVOD_CONTROLLER_ADDR", None)  # always discovered
+    if env.get("HOROVOD_TIMELINE"):
+        env["HOROVOD_TIMELINE"] = f"{env['HOROVOD_TIMELINE']}.{slot.rank}"
+    return env
+
+
+def _ssh_command(slot: hosts_mod.SlotInfo, command: Sequence[str],
+                 env: Dict[str, str], ssh_port: Optional[int],
+                 forward_keys: frozenset = frozenset()) -> List[str]:
+    """Build the ssh wrapper for a remote slot: forward the HOROVOD_*
+    contract plus every explicitly-passed env key (the remote login
+    shell provides the rest), run from the same working directory."""
+    exports = " ".join(
+        f"{k}={shlex.quote(v)}" for k, v in sorted(env.items())
+        if k.startswith("HOROVOD_") or k in forward_keys
+        or k in ("PYTHONPATH", "PATH"))
+    remote = (f"cd {shlex.quote(os.getcwd())} && "
+              f"env {exports} {' '.join(shlex.quote(c) for c in command)}")
+    cmd = ["ssh", "-o", "StrictHostKeyChecking=no", "-o", "BatchMode=yes"]
+    if ssh_port:
+        cmd += ["-p", str(ssh_port)]
+    cmd += [slot.hostname, remote]
+    return cmd
+
+
+def launch_static(settings: LaunchSettings,
+                  kv_server: Optional[KVServer] = None) -> Dict[int, int]:
+    """Run the job; returns {rank: exit_code}. Caller owns a passed-in
+    ``kv_server`` (used by ``run()`` to also collect results); otherwise
+    one is started and stopped here."""
+    host_list = _resolve_hosts(settings)
+    slots = hosts_mod.get_host_assignments(host_list, settings.np)
+
+    all_local = all(is_local_host(s.hostname) for s in slots)
+    own_server = kv_server is None
+    # Loopback-only unless the job actually spans hosts (the exec scope
+    # carries pickles that workers execute — keep it off the network).
+    server = kv_server or KVServer(
+        host="127.0.0.1" if all_local else "0.0.0.0")
+    if own_server:
+        server.start()
+    try:
+        launcher_host = "127.0.0.1" if all_local else socket.getfqdn()
+        kv_addr = f"{launcher_host}:{server.port}"
+        # The host every worker dials to reach rank 0's controller.
+        rank0_host = slots[0].hostname
+        controller_host = ("127.0.0.1" if is_local_host(rank0_host)
+                           else rank0_host)
+
+        base_env = dict(os.environ)
+        base_env.update(settings.env or {})
+
+        workers: List[WorkerProcess] = []
+        for slot in slots:
+            env = _slot_env(slot, base_env, kv_addr, controller_host,
+                            settings.start_timeout)
+            if is_local_host(slot.hostname):
+                args = list(settings.command)
+            else:
+                args = _ssh_command(
+                    slot, settings.command, env, settings.ssh_port,
+                    forward_keys=frozenset(settings.env or ()))
+                env = dict(os.environ)  # ssh itself runs with launcher env
+            if settings.verbose:
+                print(f"horovodrun: starting rank {slot.rank} on "
+                      f"{slot.hostname} (local_rank {slot.local_rank})",
+                      file=sys.stderr)
+            workers.append(WorkerProcess(slot.rank, args, env))
+        return wait_all(workers)
+    finally:
+        if own_server:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="horovodrun",
+        description="Launch a horovod_tpu training job.",
+        usage="horovodrun -np N [-H hosts | --hostfile F] [options] "
+              "command [args...]")
+    p.add_argument("-np", "--num-proc", type=int, required=True,
+                   dest="np", help="total number of worker processes")
+    p.add_argument("-H", "--hosts", dest="hosts",
+                   help='comma-separated host:slots list, e.g. "h1:2,h2:2" '
+                        "(default: localhost with np slots)")
+    p.add_argument("--hostfile", dest="hostfile",
+                   help='file with one "hostname slots=N" per line')
+    p.add_argument("-p", "--ssh-port", type=int, dest="ssh_port")
+    p.add_argument("--start-timeout", type=float, default=120.0,
+                   help="seconds to wait for all ranks to rendezvous")
+    p.add_argument("--verbose", action="store_true")
+
+    tune = p.add_argument_group("tuning")
+    tune.add_argument("--fusion-threshold-mb", type=float, default=None,
+                      help="tensor fusion buffer threshold (MB)")
+    tune.add_argument("--cycle-time-ms", type=float, default=None,
+                      help="coordination cycle time (ms)")
+    tune.add_argument("--cache-capacity", type=int, default=None,
+                      help="response cache capacity (0 disables)")
+    tune.add_argument("--timeline-filename", default=None,
+                      help="write a per-rank chrome-tracing timeline "
+                           "(rank is appended to the filename)")
+    tune.add_argument("--stall-check-time", type=float, default=None,
+                      help="seconds before a stall warning")
+    tune.add_argument("--stall-shutdown-time", type=float, default=None,
+                      help="seconds before a stall aborts the job")
+    tune.add_argument("--log-level", default=None,
+                      choices=["trace", "debug", "info", "warning", "error",
+                               "fatal"])
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="the training command to run on every slot")
+    return p
+
+
+def args_to_env(args: argparse.Namespace) -> Dict[str, str]:
+    """Map CLI tunables onto the HOROVOD_* env contract (the reference's
+    ``config_parser.set_env_from_args``)."""
+    env = {}
+    if args.fusion_threshold_mb is not None:
+        env["HOROVOD_FUSION_THRESHOLD"] = str(
+            int(args.fusion_threshold_mb * 1024 * 1024))
+    if args.cycle_time_ms is not None:
+        env["HOROVOD_CYCLE_TIME"] = str(args.cycle_time_ms)
+    if args.cache_capacity is not None:
+        env["HOROVOD_CACHE_CAPACITY"] = str(args.cache_capacity)
+    if args.timeline_filename is not None:
+        env["HOROVOD_TIMELINE"] = args.timeline_filename
+    if args.stall_check_time is not None:
+        env["HOROVOD_STALL_CHECK_TIME_SECONDS"] = str(args.stall_check_time)
+    if args.stall_shutdown_time is not None:
+        env["HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"] = str(
+            args.stall_shutdown_time)
+    if args.log_level is not None:
+        env["HOROVOD_LOG_LEVEL"] = args.log_level
+    return env
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    command = list(args.command)
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        print("horovodrun: no command given", file=sys.stderr)
+        return 2
+    settings = LaunchSettings(
+        np=args.np, command=command, hosts=args.hosts,
+        hostfile=args.hostfile, env=args_to_env(args),
+        start_timeout=args.start_timeout, verbose=args.verbose,
+        ssh_port=args.ssh_port)
+    codes = launch_static(settings)
+    failures = {r: c for r, c in codes.items() if c != 0}
+    if failures:
+        print(f"horovodrun: ranks failed: {failures}", file=sys.stderr)
+        return next(iter(failures.values()))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,47 @@
+"""Worker-side controller-address discovery.
+
+The gloo-rendezvous analog (reference ``gloo/gloo_context.cc:63-84``
+``Rendezvous`` + ``gloo/http_store.cc``): rank 0 binds a free port,
+publishes ``host:port`` under the launcher's KV store; every other rank
+polls for it. Called by :meth:`horovod_tpu.runtime.Runtime.init` when
+``HOROVOD_CONTROLLER_ADDR`` is absent but ``HOROVOD_RENDEZVOUS_ADDR``
+is set (i.e. the job was started by ``horovodrun``).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+
+from horovod_tpu.runner.http_kv import kv_put, kv_wait
+
+CONTROLLER_SCOPE = "global"
+
+
+def free_port(host: str = "") -> int:
+    """OS-assigned free TCP port. Released before use — the tiny reuse
+    race is against other processes on the same host only, the standard
+    ephemeral-port trade-off."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def discover_controller_addr(rank: int, timeout: float,
+                             epoch: int = 0) -> str:
+    """Returns the address for ``HOROVOD_CONTROLLER_ADDR``: the bind
+    address on rank 0 (all interfaces), the dial address on others.
+
+    ``epoch`` keys each init generation so a shutdown + re-init (the
+    elastic path) rediscovers a fresh port instead of racing workers
+    onto a stale published address.
+    """
+    rdv = os.environ["HOROVOD_RENDEZVOUS_ADDR"]
+    key = f"controller_addr.{epoch}"
+    if rank == 0:
+        port = free_port()
+        advertise = os.environ.get("HOROVOD_CONTROLLER_HOST", "127.0.0.1")
+        kv_put(rdv, CONTROLLER_SCOPE, key, f"{advertise}:{port}".encode())
+        return f"0.0.0.0:{port}"
+    return kv_wait(rdv, CONTROLLER_SCOPE, key, timeout).decode()

@@ -1,0 +1,35 @@
+"""Worker-side entry for :func:`horovod_tpu.runner.run`.
+
+Pulls the pickled ``(fn, args, kwargs)`` from the launcher's KV store,
+runs it, and posts the pickled ``(ok, value_or_traceback)`` result back
+under this rank's key.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import traceback
+
+import cloudpickle
+
+from horovod_tpu.runner.api import FN_KEY, FN_SCOPE, RESULT_SCOPE
+from horovod_tpu.runner.http_kv import kv_put, kv_wait
+
+
+def main() -> int:
+    rdv = os.environ["HOROVOD_RENDEZVOUS_ADDR"]
+    rank = os.environ.get("HOROVOD_RANK", "0")
+    timeout = float(os.environ.get("HOROVOD_START_TIMEOUT", "120"))
+    fn, args, kwargs = cloudpickle.loads(
+        kv_wait(rdv, FN_SCOPE, FN_KEY, timeout))
+    try:
+        payload = (True, fn(*args, **kwargs))
+    except BaseException:
+        payload = (False, traceback.format_exc())
+    kv_put(rdv, RESULT_SCOPE, rank, cloudpickle.dumps(payload))
+    return 0 if payload[0] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

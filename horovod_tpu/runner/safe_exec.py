@@ -1,0 +1,99 @@
+"""Process spawning with output forwarding and group termination.
+
+Rebuild of ``horovod/runner/common/util/safe_shell_exec.py``: each
+worker runs in its own session (process group) so a failure can kill
+the whole tree; stdout/stderr are pumped line-by-line to the launcher's
+streams with a rank prefix (the reference's ``[rank]<stdout>:``
+convention).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+
+class WorkerProcess:
+    def __init__(self, rank: int, args: Sequence[str],
+                 env: Dict[str, str], prefix: Optional[str] = None):
+        self.rank = rank
+        self.prefix = prefix if prefix is not None else f"[{rank}]"
+        self.proc = subprocess.Popen(
+            list(args), env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, start_new_session=True)
+        self._pumps = [
+            threading.Thread(target=self._pump, daemon=True,
+                             args=(self.proc.stdout, sys.stdout, "<stdout>")),
+            threading.Thread(target=self._pump, daemon=True,
+                             args=(self.proc.stderr, sys.stderr, "<stderr>")),
+        ]
+        for t in self._pumps:
+            t.start()
+
+    def _pump(self, src, dst, tag: str) -> None:
+        for raw in iter(src.readline, b""):
+            line = raw.decode(errors="replace")
+            try:
+                dst.write(f"{self.prefix}{tag}:{line}")
+                dst.flush()
+            except ValueError:  # launcher stream closed during teardown
+                break
+        src.close()
+
+    def poll(self) -> Optional[int]:
+        return self.proc.poll()
+
+    def wait_pumps(self) -> None:
+        for t in self._pumps:
+            t.join(timeout=5)
+
+    def terminate(self, grace_s: float = 3.0) -> None:
+        """SIGTERM the process group, escalate to SIGKILL after grace."""
+        if self.proc.poll() is not None:
+            return
+        try:
+            os.killpg(os.getpgid(self.proc.pid), signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            return
+        deadline = time.monotonic() + grace_s
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                return
+            time.sleep(0.05)
+        try:
+            os.killpg(os.getpgid(self.proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+def wait_all(workers: List[WorkerProcess],
+             stop_on_failure: bool = True) -> Dict[int, int]:
+    """Wait for every worker; on the first failure terminate the rest
+    (reference behavior: one dead rank dooms the job). Returns
+    {rank: exit_code}."""
+    codes: Dict[int, int] = {}
+    pending = {w.rank: w for w in workers}
+    failed = False
+    while pending:
+        progressed = False
+        for rank, w in list(pending.items()):
+            rc = w.poll()
+            if rc is None:
+                continue
+            progressed = True
+            codes[rank] = rc
+            del pending[rank]
+            if rc != 0 and stop_on_failure and not failed:
+                failed = True
+                for other in pending.values():
+                    other.terminate()
+        if not progressed:
+            time.sleep(0.05)
+    for w in workers:
+        w.wait_pumps()
+    return codes

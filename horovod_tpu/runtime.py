@@ -72,6 +72,7 @@ class Runtime:
         self._name_counters: Dict[str, int] = {}
         self._exec_cb = None   # keep callbacks alive for the C core
         self._alloc_cb = None
+        self._init_epoch = 0   # keys rendezvous rediscovery on re-init
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -82,6 +83,18 @@ class Runtime:
             return
         self.lib = basics.get_lib()
         topo = topology or topology_from_env()
+        discovered = False
+        if (topo.size > 1 and "HOROVOD_CONTROLLER_ADDR" not in os.environ
+                and os.environ.get("HOROVOD_RENDEZVOUS_ADDR")):
+            # horovodrun job: discover the controller address through
+            # the launcher's KV store instead of a pre-agreed port. The
+            # init epoch keys the lookup so a shutdown + re-init gets a
+            # fresh port, not the stale published one.
+            from horovod_tpu.runner.rendezvous import discover_controller_addr
+            timeout = float(os.environ.get("HOROVOD_START_TIMEOUT", "120"))
+            os.environ["HOROVOD_CONTROLLER_ADDR"] = discover_controller_addr(
+                topo.rank, timeout, epoch=self._init_epoch)
+            discovered = True
         self._exec_cb = basics.EXEC_CB_TYPE(self._on_exec)
         self._alloc_cb = basics.ALLOC_CB_TYPE(self._on_alloc)
         self.lib.hvd_set_exec_callback(self._exec_cb)
@@ -89,6 +102,11 @@ class Runtime:
         rc = self.lib.hvd_init(topo.rank, topo.size, topo.local_rank,
                                topo.local_size, topo.cross_rank,
                                topo.cross_size)
+        if discovered:
+            # The native core has read the env var; don't leak a stale
+            # address into re-inits or worker subprocesses.
+            os.environ.pop("HOROVOD_CONTROLLER_ADDR", None)
+        self._init_epoch += 1
         if rc != 0:
             raise HorovodInternalError("native core initialization failed")
         self.topology = topo

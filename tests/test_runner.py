@@ -1,0 +1,173 @@
+"""Launcher tests: host/slot model, KV store, CLI mapping, and real
+end-to-end ``horovodrun`` jobs on localhost (the reference's
+``test/single/test_run.py`` + ``test/integration/test_static_run.py``
+tiers)."""
+
+import os
+import sys
+
+import pytest
+
+from horovod_tpu.runner import (
+    HostInfo, get_host_assignments, parse_hostfile, parse_hosts, run,
+    run_command,
+)
+from horovod_tpu.runner.http_kv import KVServer, kv_get, kv_put, kv_wait
+from horovod_tpu.runner.launch import args_to_env, build_parser
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Workers must not grab the TPU plugin; conftest already pins cpu for
+# this process, children inherit — but be explicit about the pool var.
+# PYTHONPATH lets cloudpickle by-reference functions from this module
+# resolve in workers.
+_WORKER_ENV = {
+    "PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+    "PYTHONPATH": os.pathsep.join([ROOT, os.path.join(ROOT, "tests")]),
+}
+
+
+# ---------------------------------------------------------------------------
+# host/slot model
+# ---------------------------------------------------------------------------
+
+def test_parse_hosts():
+    hosts = parse_hosts("h1:2, h2:4,h3")
+    assert hosts == [HostInfo("h1", 2), HostInfo("h2", 4), HostInfo("h3", 1)]
+    with pytest.raises(ValueError):
+        parse_hosts("h1:x")
+    with pytest.raises(ValueError):
+        parse_hosts("")
+
+
+def test_parse_hostfile(tmp_path):
+    f = tmp_path / "hosts"
+    f.write_text("# comment\nh1 slots=2\nh2:3\nh3\n")
+    assert parse_hostfile(str(f)) == [
+        HostInfo("h1", 2), HostInfo("h2", 3), HostInfo("h3", 1)]
+
+
+def test_host_assignments_homogeneous():
+    slots = get_host_assignments(parse_hosts("h1:2,h2:2"), 4)
+    assert [(s.hostname, s.rank, s.local_rank, s.cross_rank) for s in slots] \
+        == [("h1", 0, 0, 0), ("h1", 1, 1, 0), ("h2", 2, 0, 1), ("h2", 3, 1, 1)]
+    assert all(s.size == 4 and s.local_size == 2 and s.cross_size == 2
+               for s in slots)
+
+
+def test_host_assignments_heterogeneous_cross():
+    # h1 has 2 slots, h2 has 1: the local_rank-1 "column" exists only on
+    # h1, so its cross_size is 1 (reference SlotInfo semantics).
+    slots = get_host_assignments(parse_hosts("h1:2,h2:1"), 3)
+    col1 = [s for s in slots if s.local_rank == 1]
+    assert len(col1) == 1 and col1[0].cross_size == 1
+    col0 = [s for s in slots if s.local_rank == 0]
+    assert [s.cross_rank for s in col0] == [0, 1]
+
+
+def test_host_assignments_oversubscribed():
+    with pytest.raises(ValueError, match="only 2 slots"):
+        get_host_assignments(parse_hosts("h1:2"), 3)
+
+
+def test_host_assignments_partial_use():
+    slots = get_host_assignments(parse_hosts("h1:4,h2:4"), 3)
+    assert all(s.hostname == "h1" for s in slots)
+    assert slots[0].local_size == 3 and slots[0].cross_size == 1
+
+
+# ---------------------------------------------------------------------------
+# KV store
+# ---------------------------------------------------------------------------
+
+def test_kv_roundtrip():
+    server = KVServer()
+    port = server.start()
+    addr = f"127.0.0.1:{port}"
+    try:
+        assert kv_get(addr, "s", "missing") is None
+        kv_put(addr, "s", "k", b"hello")
+        assert kv_get(addr, "s", "k") == b"hello"
+        assert kv_wait(addr, "s", "k", timeout=5) == b"hello"
+        assert server.get_local("s", "k") == b"hello"
+        with pytest.raises(TimeoutError):
+            kv_wait(addr, "s", "never", timeout=0.3)
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_cli_env_mapping():
+    args = build_parser().parse_args(
+        ["-np", "2", "--fusion-threshold-mb", "32", "--cycle-time-ms", "5",
+         "--cache-capacity", "0", "--timeline-filename", "/tmp/tl",
+         "--log-level", "debug", "python", "train.py"])
+    env = args_to_env(args)
+    assert env["HOROVOD_FUSION_THRESHOLD"] == str(32 * 1024 * 1024)
+    assert env["HOROVOD_CYCLE_TIME"] == "5.0"
+    assert env["HOROVOD_CACHE_CAPACITY"] == "0"
+    assert env["HOROVOD_TIMELINE"] == "/tmp/tl"
+    assert env["HOROVOD_LOG_LEVEL"] == "debug"
+    assert args.command == ["python", "train.py"]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end on localhost
+# ---------------------------------------------------------------------------
+
+_ALLREDUCE_SNIPPET = """
+import sys; sys.path.insert(0, {root!r})
+import numpy as np
+import horovod_tpu as hvd
+hvd.init()
+out = hvd.allreduce(np.full(4, float(hvd.rank() + 1), np.float32), name="t",
+                    op=hvd.Sum)
+expect = sum(range(1, hvd.size() + 1))
+assert np.allclose(out, expect), (hvd.rank(), out)
+print(f"RANK_OK {{hvd.rank()}}/{{hvd.size()}}")
+hvd.shutdown()
+"""
+
+
+def test_horovodrun_end_to_end(capfd):
+    run_command(
+        [sys.executable, "-c", _ALLREDUCE_SNIPPET.format(root=ROOT)],
+        np=3, env=_WORKER_ENV, start_timeout=90)
+    out = capfd.readouterr().out
+    for r in range(3):
+        assert f"RANK_OK {r}/3" in out
+
+
+def test_horovodrun_failure_propagates():
+    with pytest.raises(RuntimeError, match="ranks failed"):
+        run_command(
+            [sys.executable, "-c",
+             "import os, sys; sys.exit(3 if os.environ['HOROVOD_RANK'] == '1'"
+             " else 0)"],
+            np=2, env=_WORKER_ENV, start_timeout=60)
+
+
+def _fn_for_run(scale):
+    import horovod_tpu as hvd
+    import numpy as np
+    hvd.init()
+    out = hvd.allreduce(np.ones(2, np.float32), name="r", op=hvd.Sum)
+    result = (hvd.rank() * scale, float(out[0]))
+    hvd.shutdown()
+    return result
+
+
+def test_run_function_api():
+    results = run(_fn_for_run, args=(10,), np=2, env=_WORKER_ENV,
+                  start_timeout=90)
+    assert results == [(0, 2.0), (10, 2.0)]
+
+
+def test_run_function_error_reports_traceback():
+    def boom():
+        raise ValueError("worker exploded")
+    with pytest.raises(RuntimeError, match="worker exploded"):
+        run(boom, np=2, env=_WORKER_ENV, start_timeout=60)
