@@ -1,0 +1,62 @@
+"""Framework-neutral bootstrap/checkpoint helpers.
+
+Rebuild of ``horovod/torch/functions.py:190,233`` (``broadcast_object``
+/ ``allgather_object``: pickle over byte tensors) with numpy as the
+wire format; the torch and jax bindings re-export these and add
+framework-specific parameter sync.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any, List, Optional
+
+import cloudpickle
+import numpy as np
+
+import horovod_tpu.api as api
+
+
+def broadcast_object(obj: Any, root_rank: int = 0,
+                     name: Optional[str] = None) -> Any:
+    """Broadcast an arbitrary picklable object from ``root_rank``.
+
+    Two collectives, as in the reference: the byte length first (shapes
+    must agree on every rank before the payload broadcast can be
+    validated), then the payload itself.
+    """
+    name = name or "broadcast_object"
+    if api.rank() == root_rank:
+        payload = np.frombuffer(cloudpickle.dumps(obj), dtype=np.uint8)
+        length = np.asarray([payload.size], dtype=np.int64)
+    else:
+        payload = None
+        length = np.zeros(1, dtype=np.int64)
+    length = api.broadcast(length, root_rank=root_rank, name=f"{name}.len")
+    if payload is None:
+        payload = np.zeros(int(length[0]), dtype=np.uint8)
+    payload = api.broadcast(payload, root_rank=root_rank,
+                            name=f"{name}.data")
+    return cloudpickle.loads(payload.tobytes())
+
+
+def allgather_object(obj: Any, name: Optional[str] = None) -> List[Any]:
+    """Gather one picklable object per rank; returns them ordered by
+    rank (reference ``allgather_object``, ``torch/functions.py:233``).
+
+    Relies on allgather's variable first-dimension support — payload
+    sizes may differ per rank — with a size allgather first so the
+    concatenated buffer can be split back.
+    """
+    name = name or "allgather_object"
+    payload = np.frombuffer(cloudpickle.dumps(obj), dtype=np.uint8)
+    sizes = api.allgather(np.asarray([payload.size], dtype=np.int64),
+                          name=f"{name}.len")
+    gathered = api.allgather(payload, name=f"{name}.data")
+    out: List[Any] = []
+    offset = 0
+    for sz in sizes:
+        sz = int(sz)
+        out.append(cloudpickle.loads(gathered[offset:offset + sz].tobytes()))
+        offset += sz
+    return out

@@ -11,12 +11,18 @@ per-rank results.
 
 from __future__ import annotations
 
+import hmac
+import os
+import secrets
 import threading
 import time
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
+
+TOKEN_HEADER = "X-Horovod-Token"
+TOKEN_ENV = "HOROVOD_RENDEZVOUS_TOKEN"
 
 
 class _KVHandler(BaseHTTPRequestHandler):
@@ -28,7 +34,20 @@ class _KVHandler(BaseHTTPRequestHandler):
         key = parts[1] if len(parts) > 1 else ""
         return scope, key
 
+    def _authorized(self) -> bool:
+        """Per-job shared token: the exec scope carries pickles workers
+        execute, so nothing is served or accepted without it."""
+        got = self.headers.get(TOKEN_HEADER, "")
+        if hmac.compare_digest(got, self.server.kv_token):
+            return True
+        self.send_response(403)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+        return False
+
     def do_PUT(self):  # noqa: N802 (http.server API)
+        if not self._authorized():
+            return
         scope, key = self._split()
         length = int(self.headers.get("Content-Length", 0))
         value = self.rfile.read(length)
@@ -39,6 +58,8 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.end_headers()
 
     def do_GET(self):  # noqa: N802
+        if not self._authorized():
+            return
         scope, key = self._split()
         with self.server.kv_lock:
             value = self.server.kv.get(scope, {}).get(key)
@@ -53,6 +74,8 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.wfile.write(value)
 
     def do_DELETE(self):  # noqa: N802
+        if not self._authorized():
+            return
         scope, _ = self._split()
         with self.server.kv_lock:
             self.server.kv.pop(scope, None)
@@ -72,8 +95,10 @@ class KVServer:
     the job actually spans hosts (pass ``host="0.0.0.0"`` then).
     """
 
-    def __init__(self, host: str = "127.0.0.1"):
+    def __init__(self, host: str = "127.0.0.1",
+                 token: Optional[str] = None):
         self._host = host
+        self.token = token if token is not None else secrets.token_hex(16)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -81,6 +106,7 @@ class KVServer:
         self._httpd = ThreadingHTTPServer((self._host, 0), _KVHandler)
         self._httpd.kv: Dict[str, Dict[str, bytes]] = {}
         self._httpd.kv_lock = threading.Lock()
+        self._httpd.kv_token = self.token
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name="hvd-kv-server", daemon=True)
         self._thread.start()
@@ -109,20 +135,27 @@ class KVServer:
 # client
 # ---------------------------------------------------------------------------
 
+def _token(explicit: Optional[str]) -> str:
+    return explicit if explicit is not None else os.environ.get(TOKEN_ENV, "")
+
+
 def kv_put(addr: str, scope: str, key: str, value: bytes,
-           timeout: float = 30.0) -> None:
+           timeout: float = 30.0, token: Optional[str] = None) -> None:
     req = urllib.request.Request(
-        f"http://{addr}/{scope}/{key}", data=value, method="PUT")
+        f"http://{addr}/{scope}/{key}", data=value, method="PUT",
+        headers={TOKEN_HEADER: _token(token)})
     with urllib.request.urlopen(req, timeout=timeout):
         pass
 
 
-def kv_get(addr: str, scope: str, key: str,
-           timeout: float = 30.0) -> Optional[bytes]:
+def kv_get(addr: str, scope: str, key: str, timeout: float = 30.0,
+           token: Optional[str] = None) -> Optional[bytes]:
     """One fetch; None while the key is absent."""
+    req = urllib.request.Request(
+        f"http://{addr}/{scope}/{key}",
+        headers={TOKEN_HEADER: _token(token)})
     try:
-        with urllib.request.urlopen(
-                f"http://{addr}/{scope}/{key}", timeout=timeout) as resp:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
             return resp.read()
     except urllib.error.HTTPError as e:
         if e.code == 404:
@@ -131,17 +164,21 @@ def kv_get(addr: str, scope: str, key: str,
 
 
 def kv_wait(addr: str, scope: str, key: str, timeout: float,
-            poll_interval: float = 0.1) -> bytes:
+            poll_interval: float = 0.1,
+            token: Optional[str] = None) -> bytes:
     """Poll until the key appears (rendezvous barrier semantics).
     Transient connection failures during startup (launcher not yet
-    reachable) are retried until the deadline, like 404s."""
+    reachable) are retried until the deadline, like 404s. A 403 (bad
+    token) raises immediately — retrying cannot fix it."""
     deadline = time.monotonic() + timeout
     last_err: Optional[Exception] = None
     while True:
         try:
-            value = kv_get(addr, scope, key)
+            value = kv_get(addr, scope, key, token=token)
             if value is not None:
                 return value
+        except urllib.error.HTTPError:
+            raise
         except (urllib.error.URLError, ConnectionError, TimeoutError) as e:
             last_err = e
         if time.monotonic() >= deadline:

@@ -58,8 +58,10 @@ def _resolve_hosts(settings: LaunchSettings) -> List[hosts_mod.HostInfo]:
 
 def _slot_env(slot: hosts_mod.SlotInfo, base: Dict[str, str],
               kv_addr: str, controller_host: str,
-              start_timeout: float) -> Dict[str, str]:
+              start_timeout: float, token: str = "") -> Dict[str, str]:
     env = dict(base)
+    if token:
+        env["HOROVOD_RENDEZVOUS_TOKEN"] = token
     env.update({
         "HOROVOD_RANK": str(slot.rank),
         "HOROVOD_SIZE": str(slot.size),
@@ -119,30 +121,42 @@ def launch_static(settings: LaunchSettings,
     try:
         launcher_host = "127.0.0.1" if all_local else socket.getfqdn()
         kv_addr = f"{launcher_host}:{server.port}"
-        # The host every worker dials to reach rank 0's controller.
+        # The host every worker dials to reach rank 0's controller. In a
+        # mixed job whose rank 0 is local, remote ranks must still get a
+        # routable name — loopback only when EVERY rank is local.
         rank0_host = slots[0].hostname
-        controller_host = ("127.0.0.1" if is_local_host(rank0_host)
-                           else rank0_host)
+        if all_local:
+            controller_host = "127.0.0.1"
+        elif is_local_host(rank0_host):
+            controller_host = socket.getfqdn()
+        else:
+            controller_host = rank0_host
 
         base_env = dict(os.environ)
         base_env.update(settings.env or {})
 
         workers: List[WorkerProcess] = []
-        for slot in slots:
-            env = _slot_env(slot, base_env, kv_addr, controller_host,
-                            settings.start_timeout)
-            if is_local_host(slot.hostname):
-                args = list(settings.command)
-            else:
-                args = _ssh_command(
-                    slot, settings.command, env, settings.ssh_port,
-                    forward_keys=frozenset(settings.env or ()))
-                env = dict(os.environ)  # ssh itself runs with launcher env
-            if settings.verbose:
-                print(f"horovodrun: starting rank {slot.rank} on "
-                      f"{slot.hostname} (local_rank {slot.local_rank})",
-                      file=sys.stderr)
-            workers.append(WorkerProcess(slot.rank, args, env))
+        try:
+            for slot in slots:
+                env = _slot_env(slot, base_env, kv_addr, controller_host,
+                                settings.start_timeout, server.token)
+                if is_local_host(slot.hostname):
+                    args = list(settings.command)
+                else:
+                    args = _ssh_command(
+                        slot, settings.command, env, settings.ssh_port,
+                        forward_keys=frozenset(settings.env or ()))
+                    env = dict(os.environ)  # ssh runs with launcher env
+                if settings.verbose:
+                    print(f"horovodrun: starting rank {slot.rank} on "
+                          f"{slot.hostname} (local_rank {slot.local_rank})",
+                          file=sys.stderr)
+                workers.append(WorkerProcess(slot.rank, args, env))
+        except BaseException:
+            # A failed spawn must not orphan already-running workers.
+            for w in workers:
+                w.terminate()
+            raise
         return wait_all(workers)
     finally:
         if own_server:
@@ -233,7 +247,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     failures = {r: c for r, c in codes.items() if c != 0}
     if failures:
         print(f"horovodrun: ranks failed: {failures}", file=sys.stderr)
-        return next(iter(failures.values()))
+        # Prefer a real exit code (the root cause) over signal deaths —
+        # SIGTERM-reaped peers are usually collateral of our own
+        # teardown. Signals map to the shell convention 128+sig; raw
+        # negatives would wrap mod 256 into nonsense.
+        code = next((c for _, c in sorted(failures.items()) if c > 0), None)
+        if code is None:
+            code = 128 + abs(next(iter(failures.values())))
+        return code
     return 0
 
 

@@ -28,6 +28,15 @@ from horovod_tpu.common.ops_enum import ReduceOp
 from horovod_tpu.common.topology import Topology, topology_from_env
 
 
+def _contig(a: np.ndarray) -> np.ndarray:
+    """C-contiguous view/copy that PRESERVES 0-d shape
+    (``np.ascontiguousarray`` silently promotes 0-d to shape (1,))."""
+    out = np.ascontiguousarray(a)
+    if out.shape != np.shape(a):
+        out = out.reshape(np.shape(a))
+    return out
+
+
 class _InFlight:
     """State for one in-flight collective (registry entry)."""
 
@@ -186,7 +195,7 @@ class Runtime:
         if mod.startswith("jax") or hasattr(tensor, "addressable_shards"):
             return "jax", None, tensor
         # Anything array-like (lists, scalars) becomes numpy.
-        return "np", np.ascontiguousarray(tensor), None
+        return "np", _contig(np.asarray(tensor)), None
 
     def enqueue(self, op: int, tensor, name: str, *,
                 reduce_op: ReduceOp = ReduceOp.AVERAGE,
@@ -226,7 +235,7 @@ class Runtime:
             out_ptr = None
         else:
             exec_mode = basics.EXEC_HOST
-            np_in = np.ascontiguousarray(np_in)
+            np_in = _contig(np_in)
             st.input_np = np_in
             st.orig_dtype = np_in.dtype
             shape = list(np_in.shape)
@@ -297,7 +306,7 @@ class Runtime:
             return out, st
         if st.orig_kind == "torch":
             import torch
-            out = np.ascontiguousarray(out)
+            out = _contig(out)
             if out.dtype.name == "bfloat16":
                 return torch.from_numpy(out.view(np.uint16)).view(
                     torch.bfloat16), st

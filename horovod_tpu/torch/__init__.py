@@ -1,0 +1,31 @@
+"""PyTorch binding: the ``horovod.torch`` product surface.
+
+``import horovod_tpu.torch as hvd`` gives the same working set as the
+reference (``horovod/torch/__init__.py``): the full eager collective
+API plus ``DistributedOptimizer`` (per-parameter hooks), parameter /
+optimizer-state broadcast, and object collectives. Torch tensors ride
+the eager named-tensor runtime (host data plane; CPU torch in this
+image — on TPU, torch users stage through host memory exactly like the
+reference's CPU-fallback path, ``gloo_operations.cc``).
+"""
+
+from horovod_tpu.api import (  # noqa: F401
+    init, shutdown, is_initialized, rank, size, local_rank, local_size,
+    cross_rank, cross_size, allreduce, allreduce_async, grouped_allreduce,
+    grouped_allreduce_async, allgather, allgather_async, broadcast,
+    broadcast_async, alltoall, alltoall_async, reducescatter,
+    reducescatter_async, join, barrier, synchronize, poll,
+    mpi_threads_supported, start_timeline, stop_timeline,
+)
+from horovod_tpu.common.exceptions import HorovodInternalError  # noqa: F401
+from horovod_tpu.common.ops_enum import (  # noqa: F401
+    Adasum, Average, Max, Min, Product, ReduceOp, Sum,
+)
+from horovod_tpu.compression import Compression  # noqa: F401
+from horovod_tpu.functions import (  # noqa: F401
+    allgather_object, broadcast_object,
+)
+from horovod_tpu.torch.functions import (  # noqa: F401
+    broadcast_optimizer_state, broadcast_parameters,
+)
+from horovod_tpu.torch.optimizer import DistributedOptimizer  # noqa: F401

@@ -1,0 +1,56 @@
+"""Parameter / optimizer-state sync for PyTorch.
+
+Rebuild of ``horovod/torch/functions.py:29,61``: broadcast model
+parameters (or any ``state_dict``/``named_parameters`` collection) and
+full optimizer state from a root rank — the checkpoint-resume and
+train-start bootstrap primitives.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Tuple, Union
+
+import torch
+
+import horovod_tpu.api as api
+from horovod_tpu.functions import broadcast_object
+
+
+def broadcast_parameters(params: Union[dict, Iterable[Tuple[str, object]]],
+                         root_rank: int = 0) -> None:
+    """Broadcast ``model.state_dict()`` or ``model.named_parameters()``
+    in place from ``root_rank`` (reference ``torch/functions.py:29``)."""
+    if isinstance(params, dict):
+        items = sorted(params.items())
+    else:
+        items = list(params)
+    handles = []
+    for name, p in items:
+        if p is None:
+            continue
+        if not torch.is_tensor(p):
+            raise ValueError(
+                f"invalid params of type {type(p)} for key {name}")
+        handles.append((p, api.broadcast_async(
+            p, root_rank=root_rank, name=f"broadcast_parameters.{name}")))
+    for p, h in handles:
+        out = api.synchronize(h)
+        with torch.no_grad():
+            p.copy_(out.view(p.shape))
+
+
+def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
+                              root_rank: int = 0) -> None:
+    """Broadcast the optimizer's ``state_dict`` from ``root_rank``
+    (reference ``torch/functions.py:61``). State is shipped as one
+    pickled object — simpler than the reference's per-entry tensor
+    walk, with identical semantics for resumable state (momentum
+    buffers, step counters, hyperparameters)."""
+    if isinstance(optimizer, torch.optim.LBFGS):
+        raise ValueError(
+            "cannot broadcast torch.optim.LBFGS state (reference "
+            "limitation preserved)")
+    state = broadcast_object(optimizer.state_dict(), root_rank=root_rank,
+                             name="broadcast_optimizer_state")
+    if api.rank() != root_rank:
+        optimizer.load_state_dict(state)

@@ -1,0 +1,188 @@
+"""Hook-based ``DistributedOptimizer`` for PyTorch.
+
+Rebuild of ``horovod/torch/optimizer.py:128-286``: wrap any
+``torch.optim.Optimizer`` in a dynamic subclass whose per-parameter
+post-accumulate-grad hooks launch async allreduces as gradients become
+ready (overlapping communication with the rest of backward), and whose
+``step()`` synchronizes them before applying updates.
+
+Differences from the reference are mechanical, not semantic: torch's
+modern ``register_post_accumulate_grad_hook`` replaces the
+``grad_acc = p.expand_as(p).grad_fn.next_functions`` trick, and the
+underlying transport is the TPU runtime's negotiated eager path rather
+than NCCL/MPI.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import torch
+
+import horovod_tpu.api as api
+from horovod_tpu.common.ops_enum import Average, ReduceOp
+from horovod_tpu.compression import Compression
+
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    # Body grafted onto a dynamic subclass of the wrapped optimizer
+    # class (reference pattern), so isinstance checks and LR schedulers
+    # keep working.
+
+    def __init__(self, params, named_parameters, compression,
+                 backward_passes_per_step, op, gradient_predivide_factor):
+        super(self.__class__, self).__init__(params)
+        self._compression = compression
+        self._reduce_op = op
+        self._gradient_predivide_factor = gradient_predivide_factor
+        self.backward_passes_per_step = backward_passes_per_step
+
+        if named_parameters is not None:
+            named_parameters = list(named_parameters)
+        else:
+            named_parameters = [
+                (f"allreduce.noname.{i}.{j}", v)
+                for i, group in enumerate(self.param_groups)
+                for j, v in enumerate(group["params"])]
+        # Reference checks: all tuples, no duplicate names, every
+        # gradient-requiring parameter covered.
+        dups = _find_duplicates([k for k, _ in named_parameters])
+        if dups:
+            raise ValueError(
+                f"Parameter names in named_parameters must be unique; "
+                f"found duplicates: {sorted(dups)}")
+        all_params = {v for group in self.param_groups
+                      for v in group["params"]}
+        named_set = {v for _, v in named_parameters}
+        unnamed = [v for v in all_params
+                   if v.requires_grad and v not in named_set]
+        if unnamed:
+            raise ValueError(
+                "named_parameters was specified but does not cover all "
+                f"optimizer parameters ({len(unnamed)} missing)")
+
+        self._parameter_names = {v: k for k, v in named_parameters}
+        self._handles = {}          # param -> (Handle, compression ctx)
+        self._allreduce_delay = {}  # param -> remaining backward passes
+        self._requires_update = set()
+        self._synchronized = False
+        self._should_synchronize = True
+        self._hook_handles = []
+        if api.size() > 1:
+            self._register_hooks()
+
+    # -- hook plumbing ----------------------------------------------------
+
+    def _register_hooks(self):
+        for group in self.param_groups:
+            for p in group["params"]:
+                if p.requires_grad:
+                    self._requires_update.add(p)
+                    self._allreduce_delay[p] = self.backward_passes_per_step
+                    self._hook_handles.append(
+                        p.register_post_accumulate_grad_hook(
+                            self._make_hook()))
+
+    def _make_hook(self):
+        def hook(p):
+            if p in self._handles and self._handles[p][0] is not None:
+                if self._allreduce_delay[p] <= 0:
+                    raise AssertionError(
+                        "Gradients were computed more than "
+                        "backward_passes_per_step times before call to "
+                        "step(). Increase backward_passes_per_step.")
+            assert not p.grad.requires_grad
+            assert self._allreduce_delay[p] > 0
+            self._allreduce_delay[p] -= 1
+            if self._allreduce_delay[p] == 0:
+                self._handles[p] = self._allreduce_grad_async(p)
+        return hook
+
+    def _allreduce_grad_async(self, p) -> Tuple[object, object]:
+        if p.grad is None:
+            # Unused this step on this rank; contribute zeros so every
+            # rank still launches the same collective.
+            p.grad = p.data.new(p.size()).zero_()
+        name = self._parameter_names[p]
+        prescale, postscale = 1.0, 1.0
+        op = self._reduce_op
+        if self._gradient_predivide_factor != 1.0:
+            # Split the averaging into pre/post parts around the wire
+            # (reference DistributedOptimizer factory): only meaningful
+            # with op=Average, which becomes Sum + explicit scales.
+            prescale = 1.0 / self._gradient_predivide_factor
+            postscale = self._gradient_predivide_factor / api.size()
+            op = ReduceOp.SUM
+        tensor_compressed, ctx = self._compression.compress(p.grad)
+        handle = api.allreduce_async(
+            tensor_compressed, name=f"allreduce.{name}", op=op,
+            prescale_factor=prescale, postscale_factor=postscale)
+        return handle, ctx
+
+    # -- user surface -----------------------------------------------------
+
+    def synchronize(self) -> None:
+        """Finish every outstanding allreduce and install the reduced
+        gradients (reference ``synchronize()``,
+        ``torch/optimizer.py:249-286``)."""
+        if api.size() == 1:
+            self._synchronized = True
+            return
+        # Parameters whose hook never fired this step (e.g. layer
+        # skipped in forward) still must reduce — all ranks launch the
+        # same set of collectives or negotiation stalls.
+        missing = self._requires_update - set(self._handles)
+        for p in missing:
+            self._handles[p] = self._allreduce_grad_async(p)
+            self._allreduce_delay[p] = 0
+        for p, (handle, ctx) in sorted(
+                self._handles.items(),
+                key=lambda kv: self._parameter_names[kv[0]]):
+            output = api.synchronize(handle)
+            self._allreduce_delay[p] = self.backward_passes_per_step
+            grad = self._compression.decompress(output, ctx)
+            p.grad.copy_(grad.view(p.grad.shape))
+        self._handles.clear()
+        self._synchronized = True
+
+    def step(self, closure=None):
+        if self._should_synchronize:
+            self.synchronize()
+        self._synchronized = False
+        return super(self.__class__, self).step(closure)
+
+    def zero_grad(self, *args, **kwargs):
+        if self._handles:
+            raise AssertionError(
+                "optimizer.zero_grad() was called after loss.backward() "
+                "but before optimizer.step() or optimizer.synchronize(). "
+                "This is prohibited as it can cause a race condition.")
+        return super(self.__class__, self).zero_grad(*args, **kwargs)
+
+
+def _find_duplicates(lst):
+    seen, dups = set(), set()
+    for x in lst:
+        if x in seen:
+            dups.add(x)
+        seen.add(x)
+    return dups
+
+
+def DistributedOptimizer(optimizer: torch.optim.Optimizer,
+                         named_parameters: Optional[Iterator] = None,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1,
+                         op: ReduceOp = Average,
+                         gradient_predivide_factor: float = 1.0
+                         ) -> torch.optim.Optimizer:
+    """Wrap ``optimizer`` so gradients are averaged across ranks before
+    each ``step()`` (reference factory, ``torch/optimizer.py:599+``
+    semantics; usage identical: pass ``model.named_parameters()``)."""
+    if gradient_predivide_factor != 1.0 and op != Average:
+        raise ValueError(
+            "gradient_predivide_factor not supported with op != Average")
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+               dict(_DistributedOptimizer.__dict__))
+    return cls(optimizer.param_groups, named_parameters, compression,
+               backward_passes_per_step, op, gradient_predivide_factor)

@@ -1,0 +1,77 @@
+"""Example scripts as smoke tests under horovodrun (the reference CI
+runs its examples the same way, ``.buildkite/gen-pipeline.sh:171-295``),
+plus the 1-proc vs N-proc equivalence the optimizer wrappers promise."""
+
+import os
+import sys
+
+import numpy as np
+
+from horovod_tpu.runner import run, run_command
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_WORKER_ENV = {
+    "PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+    "PYTHONPATH": os.pathsep.join([ROOT, os.path.join(ROOT, "tests")]),
+}
+
+
+def test_torch_mnist_example_2proc(capfd):
+    run_command(
+        [sys.executable, os.path.join(ROOT, "examples", "torch_mnist.py"),
+         "--epochs", "1", "--train-size", "256"],
+        np=2, env=_WORKER_ENV, start_timeout=120)
+    out = capfd.readouterr().out
+    assert "epoch 0: mean rank loss" in out
+    assert "rank 0:" in out and "rank 1:" in out
+
+
+def _train_determinstic(n_steps=4):
+    """Full-batch training so 1-proc and N-proc see the same global
+    data: every rank holds a distinct half of a fixed global batch (or
+    all of it when np=1) and DistributedOptimizer averages gradients.
+    Returns final weights."""
+    import torch
+    import torch.nn as nn
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    torch.manual_seed(3)
+    model = nn.Linear(6, 3)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9),
+        named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+    g = torch.Generator().manual_seed(9)
+    X = torch.randn(8, 6, generator=g)
+    Y = torch.randn(8, 3, generator=g)
+    n, r = hvd.size(), hvd.rank()
+    shard = 8 // n
+    x, y = X[r * shard:(r + 1) * shard], Y[r * shard:(r + 1) * shard]
+
+    for _ in range(n_steps):
+        opt.zero_grad()
+        loss = (model(x) - y).pow(2).mean()
+        loss.backward()
+        opt.step()
+    out = {k: v.detach().numpy().copy()
+           for k, v in model.state_dict().items()}
+    hvd.shutdown()
+    return out
+
+
+def test_train_identical_1proc_vs_2proc():
+    """The core DistributedOptimizer contract (VERDICT done-criterion):
+    the same global batch gives the same trained weights on 1 and N
+    processes, because mean-of-shard-means equals the global mean when
+    shards are equal-sized."""
+    solo = run(_train_determinstic, np=1, env=_WORKER_ENV,
+               start_timeout=90)[0]
+    duo = run(_train_determinstic, np=2, env=_WORKER_ENV,
+              start_timeout=90)
+    assert sorted(solo) == sorted(duo[0])
+    for k in solo:
+        np.testing.assert_allclose(duo[0][k], duo[1][k], atol=1e-6)
+        np.testing.assert_allclose(solo[k], duo[0][k], atol=1e-5,
+                                   err_msg=f"weight {k} diverged")

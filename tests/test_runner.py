@@ -84,14 +84,30 @@ def test_kv_roundtrip():
     server = KVServer()
     port = server.start()
     addr = f"127.0.0.1:{port}"
+    tok = server.token
     try:
-        assert kv_get(addr, "s", "missing") is None
-        kv_put(addr, "s", "k", b"hello")
-        assert kv_get(addr, "s", "k") == b"hello"
-        assert kv_wait(addr, "s", "k", timeout=5) == b"hello"
+        assert kv_get(addr, "s", "missing", token=tok) is None
+        kv_put(addr, "s", "k", b"hello", token=tok)
+        assert kv_get(addr, "s", "k", token=tok) == b"hello"
+        assert kv_wait(addr, "s", "k", timeout=5, token=tok) == b"hello"
         assert server.get_local("s", "k") == b"hello"
         with pytest.raises(TimeoutError):
-            kv_wait(addr, "s", "never", timeout=0.3)
+            kv_wait(addr, "s", "never", timeout=0.3, token=tok)
+    finally:
+        server.stop()
+
+
+def test_kv_rejects_bad_token():
+    import urllib.error
+    server = KVServer()
+    port = server.start()
+    addr = f"127.0.0.1:{port}"
+    try:
+        kv_put(addr, "s", "k", b"secret", token=server.token)
+        with pytest.raises(urllib.error.HTTPError):
+            kv_get(addr, "s", "k", token="wrong")
+        with pytest.raises(urllib.error.HTTPError):
+            kv_put(addr, "exec", "fn", b"evil", token="")
     finally:
         server.stop()
 
