@@ -3,29 +3,34 @@
 The NCCL-ops analog (reference ``horovod/common/ops/nccl_operations.cc``):
 the native controller decides *when* and *in what order* a fused batch
 runs; this module decides *how* — by launching a jitted XLA program.
-Grouped entries become one multi-operand program (XLA's combiner plays
-the role of the fusion-buffer memcpy kernels, reference
-``cuda/cuda_kernels.cu``).
 
 Process topologies:
 
 * size == 1: collectives over ranks degenerate to (scaled) identity —
   jitted so dtype/scale semantics match the distributed path exactly.
-* multi-process under ``jax.distributed`` with one device per process:
-  ``psum``-style programs over a process-spanning mesh move bytes over
-  ICI/DCN. The controller guarantees all processes launch the same
-  program in the same order (the requirement XLA multi-controller
-  imposes, and exactly what Horovod's coordinator was built to
-  provide).
-* multi-device-per-process pods route through the SPMD tier
-  (:mod:`horovod_tpu.ops.collectives`) instead; the eager tier raises
-  until the pod launcher lands.
+* multi-process under ``jax.distributed`` with one device per process
+  (brought up by ``hvd.init()`` when ``HOROVOD_XLA_EXEC=1`` /
+  ``horovodrun --xla-exec``): every op in the matrix — allreduce
+  (fused batches), allgather (uneven rows), broadcast, alltoall (with
+  splits), reducescatter — runs as a jitted global-array program over a
+  1-D "rank" mesh. XLA lowers the sharded-in/replicated-or-resharded-
+  out programs to all-reduce / all-gather / collective-permute /
+  all-to-all over ICI/DCN. The controller's broadcast ResponseList
+  guarantees all processes launch identical programs in identical
+  order — the invariant XLA multi-controller execution requires.
+
+Fusion note: a fused allreduce response becomes ONE program over the
+concatenation of its flattened tensors (XLA's combiner plays the role
+of the reference's fusion-buffer memcpy kernels,
+``cuda/cuda_kernels.cu``); per-tensor average/prescale/postscale
+factors are applied as a traced per-segment factor vector, so dynamic
+loss scaling never recompiles.
 """
 
 from __future__ import annotations
 
 from functools import lru_cache
-from typing import List
+from typing import List, Tuple
 
 import numpy as np
 
@@ -115,11 +120,21 @@ def execute(op: int, states, sizes: List[int], size: int, rank: int):
             outs.append(x)
         return outs
     if op == basics.OP_ALLREDUCE:
-        return _distributed_allreduce(states, size)
-    raise NotImplementedError(
-        f"multi-process XLA execution for op {op} lands with the pod "
-        "launcher; host-staged execution handles this case today")
+        return _dist_allreduce(states, size)
+    if op == basics.OP_ALLGATHER:
+        return [_dist_allgather(states[0], tuple(sizes), size)]
+    if op == basics.OP_BROADCAST:
+        return [_dist_broadcast(states[0], size)]
+    if op == basics.OP_ALLTOALL:
+        return [_dist_alltoall(states[0], tuple(sizes), size, rank)]
+    if op == basics.OP_REDUCESCATTER:
+        return [_dist_reducescatter(states[0], tuple(sizes), size, rank)]
+    raise NotImplementedError(f"unknown CALLBACK op {op}")
 
+
+# ---------------------------------------------------------------------------
+# distributed programs (multi-process, one device per process)
+# ---------------------------------------------------------------------------
 
 @lru_cache(maxsize=None)
 def _rank_mesh():
@@ -130,68 +145,259 @@ def _rank_mesh():
 
     if jax.local_device_count() != 1:
         raise NotImplementedError(
-            "eager distributed XLA allreduce currently requires one device "
-            "per process (the Horovod process model); use the SPMD "
-            "functional API (horovod_tpu.ops) for multi-device processes")
+            "eager distributed XLA execution requires one device per "
+            "process (the Horovod process model); use the SPMD functional "
+            "API (horovod_tpu.ops) for multi-device processes")
     return Mesh(np.asarray(jax.devices(), dtype=object), ("rank",))
 
 
-@lru_cache(maxsize=None)
-def _reduce_jit(op: ReduceOp):
-    """One compiled program per (reduce op, dtype, elem count) — the
-    scale factor is a TRACED scalar so dynamic loss scaling never
-    recompiles. Operates on flattened tensors: program identity across
-    processes then depends only on element count, which joined ranks
-    know from the response metadata even without a local tensor."""
+def _make_global(local, size: int):
+    """Assemble the (size, ...) global array whose rank-th row is this
+    process's ``local`` (shape ``local.shape``), sharded over "rank"."""
     import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _rank_mesh()
+    sharding = NamedSharding(mesh, P("rank"))
+    dev = mesh.local_mesh.devices.flat[0]
+    local = jax.device_put(local[None], dev)
+    return jax.make_array_from_single_device_arrays(
+        (size,) + tuple(local.shape[1:]), sharding, [local])
+
+
+def _local(arr):
+    """This process's addressable piece of a global array (the full
+    value for replicated outputs, the local shard otherwise)."""
+    return arr.addressable_data(0)
+
+
+def _pad_rows(x, rows: int):
     import jax.numpy as jnp
 
-    def fn(arr, factor):
-        if op in (ReduceOp.AVERAGE, ReduceOp.SUM, ReduceOp.ADASUM):
-            y = jnp.sum(arr, axis=0)
-        elif op == ReduceOp.MIN:
-            y = jnp.min(arr, axis=0)
-        elif op == ReduceOp.MAX:
-            y = jnp.max(arr, axis=0)
-        elif op == ReduceOp.PRODUCT:
-            y = jnp.prod(arr, axis=0)
-        else:
-            raise ValueError(f"unknown reduce op {op!r}")
-        if jnp.issubdtype(y.dtype, jnp.inexact):
-            y = _apply_factor(y, factor)
-        return y
-
-    return jax.jit(fn)
+    if x.shape[0] == rows:
+        return x
+    pad = [(0, rows - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
+    return jnp.pad(x, pad)
 
 
-def _reduce_factor(st, size: int) -> np.float64:
-    """Factor for the distributed reduce; rejects scaled integer inputs
-    loudly rather than truncating the factor to 0."""
-    f = _scale_factor(st, size)
-    _check_scalable(st.input_dev.dtype, f)
-    return _factor_scalar(f)
+def _reduce_over_ranks(op: ReduceOp, arr):
+    """Shared rank-axis reduction for allreduce / reducescatter
+    programs (axis 0 is the mesh-sharded rank axis)."""
+    import jax.numpy as jnp
+
+    if op in (ReduceOp.AVERAGE, ReduceOp.SUM, ReduceOp.ADASUM):
+        return jnp.sum(arr, axis=0)
+    if op == ReduceOp.MIN:
+        return jnp.min(arr, axis=0)
+    if op == ReduceOp.MAX:
+        return jnp.max(arr, axis=0)
+    if op == ReduceOp.PRODUCT:
+        return jnp.prod(arr, axis=0)
+    raise ValueError(f"unknown reduce op {op!r}")
 
 
-def _distributed_allreduce(states, size: int):
-    """Reduce each entry across processes: build a global batch-of-
-    shards array (leading axis = process) from the FLATTENED local
-    tensor, reduce over it, reshape back. XLA lowers the
-    sum-over-sharded-axis to an all-reduce over ICI/DCN."""
+def _op_class(op: ReduceOp) -> ReduceOp:
+    """Program-identity class: AVERAGE/ADASUM fold into SUM (averaging
+    rides the traced factor vector), mirroring the controller's fusion
+    classes so every rank — including joined ranks that only know the
+    response-level op — derives the identical program key."""
+    if op in (ReduceOp.AVERAGE, ReduceOp.ADASUM):
+        return ReduceOp.SUM
+    return op
+
+
+@lru_cache(maxsize=None)
+def _allreduce_prog(op: ReduceOp, spans: Tuple[int, ...], inexact: bool):
+    """One program per (reduce class, segment layout, dtype kind):
+    reduce the (size, total) batch over ranks, then apply the traced
+    per-segment factor vector. Program identity must NOT depend on
+    factor values — a joined rank synthesizes factor 1.0 and still has
+    to trace the identical HLO — so the multiply is always present for
+    inexact dtypes (the factors are jit arguments)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     mesh = _rank_mesh()
-    sharding = NamedSharding(mesh, P("rank"))
-    local_device = mesh.local_mesh.devices.flat[0]
+    repl = NamedSharding(mesh, P())
+    repeats = np.asarray(spans)
 
-    outs = []
-    for st in states:
-        x = st.input_dev
-        shape = tuple(x.shape)
-        local = jax.device_put(jnp.ravel(jnp.asarray(x))[None], local_device)
-        arr = jax.make_array_from_single_device_arrays(
-            (size, local.shape[1]), sharding, [local])
-        y = _reduce_jit(st.reduce_op)(arr, _reduce_factor(st, size))
-        outs.append(y.reshape(shape))
+    def fn(arr, factors):
+        y = _reduce_over_ranks(op, arr)
+        if inexact:
+            y = _apply_factor(y, jnp.repeat(factors, repeats,
+                                            total_repeat_length=int(
+                                                repeats.sum())))
+        return y
+
+    return jax.jit(fn, out_shardings=repl)
+
+
+def _dist_allreduce(states, size: int):
+    """One fused program over the concatenation of the batch's
+    flattened tensors (all share a dtype — the controller's fusion
+    criterion)."""
+    import jax.numpy as jnp
+
+    spans = tuple(int(np.prod(st.input_dev.shape, dtype=np.int64))
+                  for st in states)
+    factors = [_scale_factor(st, size) for st in states]
+    for st, f in zip(states, factors):
+        if f != 1.0:
+            _check_scalable(st.input_dev.dtype, f)
+    local = jnp.concatenate(
+        [jnp.ravel(jnp.asarray(st.input_dev)) for st in states])
+    arr = _make_global(local, size)
+    inexact = np.dtype(local.dtype).kind == "f" or \
+        np.dtype(local.dtype).name == "bfloat16"
+    # numpy f64 in, silent downcast to f32 unless x64 is enabled — same
+    # policy as _factor_scalar.
+    y = _allreduce_prog(_op_class(states[0].reduce_op), spans, inexact)(
+        arr, jnp.asarray(np.asarray(factors, dtype=np.float64)))
+    y = _local(y)
+    outs, off = [], 0
+    for st, span in zip(states, spans):
+        outs.append(y[off:off + span].reshape(st.input_dev.shape))
+        off += span
     return outs
+
+
+@lru_cache(maxsize=None)
+def _allgather_prog(sizes: Tuple[int, ...], rest: Tuple[int, ...]):
+    """Gather uneven-row tensors: ranks pad to the max row count, the
+    program slices out the real rows and concatenates (XLA lowers the
+    replicated output to an all-gather)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _rank_mesh()
+    repl = NamedSharding(mesh, P())
+
+    def fn(arr):  # (size, max_rows, *rest)
+        return jnp.concatenate(
+            [arr[r, :sizes[r]] for r in range(len(sizes))], axis=0)
+
+    return jax.jit(fn, out_shardings=repl)
+
+
+def _dist_allgather(st, sizes: Tuple[int, ...], size: int):
+    import jax.numpy as jnp
+
+    x = jnp.asarray(st.input_dev)
+    arr = _make_global(_pad_rows(x, max(sizes)), size)
+    return _local(_allgather_prog(sizes, tuple(x.shape[1:]))(arr))
+
+
+@lru_cache(maxsize=None)
+def _broadcast_prog(root: int):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _rank_mesh()
+    repl = NamedSharding(mesh, P())
+    return jax.jit(lambda arr: arr[root], out_shardings=repl)
+
+
+def _dist_broadcast(st, size: int):
+    import jax.numpy as jnp
+
+    arr = _make_global(jnp.asarray(st.input_dev), size)
+    return _local(_broadcast_prog(int(st.root_rank))(arr))
+
+
+@lru_cache(maxsize=None)
+def _alltoall_prog(matrix: Tuple[int, ...], size: int,
+                   max_send: int, rest: Tuple[int, ...]):
+    """Uneven all-to-all from the full splits matrix
+    (``matrix[r*size+k]`` = rows rank r RECEIVES from rank k, i.e.
+    rank k's send chunk to r). Every rank pads its send buffer to
+    ``max_send`` rows; the program re-slices chunks into each
+    receiver's (padded) output row, sharded back over ranks."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _rank_mesh()
+    out_sh = NamedSharding(mesh, P("rank"))
+
+    def send_chunk(k: int, r: int) -> Tuple[int, int]:
+        # Rows k sends to r start after k's chunks for ranks < r.
+        start = sum(matrix[q * size + k] for q in range(r))
+        return start, matrix[r * size + k]
+
+    recv_rows = [sum(matrix[r * size + k] for k in range(size))
+                 for r in range(size)]
+    max_recv = max(recv_rows + [1])
+
+    def fn(arr):  # (size, max_send, *rest)
+        rows = []
+        for r in range(size):
+            chunks = []
+            for k in range(size):
+                start, n = send_chunk(k, r)
+                if n:
+                    chunks.append(arr[k, start:start + n])
+            row = (jnp.concatenate(chunks, axis=0) if chunks
+                   else jnp.zeros((0,) + rest, arr.dtype))
+            rows.append(_pad_rows(row, max_recv))
+        return jnp.stack(rows)
+
+    return jax.jit(fn, out_shardings=out_sh)
+
+
+def _dist_alltoall(st, matrix: Tuple[int, ...], size: int, rank: int):
+    import jax.numpy as jnp
+
+    x = jnp.asarray(st.input_dev)
+    # Every rank must pad to the same static max; send totals are the
+    # column sums of the matrix.
+    send_totals = [sum(matrix[r * size + k] for r in range(size))
+                   for k in range(size)]
+    max_send = max(send_totals + [1])
+    arr = _make_global(_pad_rows(x, max_send), size)
+    out = _alltoall_prog(matrix, size, max_send, tuple(x.shape[1:]))(arr)
+    my_rows = sum(matrix[rank * size + k] for k in range(size))
+    return _local(out)[0][:my_rows]
+
+
+@lru_cache(maxsize=None)
+def _reducescatter_prog(op: ReduceOp, sizes: Tuple[int, ...],
+                        inexact: bool):
+    """Reduce over ranks, then scatter dim-0 shards back (uneven shards
+    via per-rank slices padded to the max; output sharded over ranks so
+    XLA can lower to reduce-scatter). Factor traced, same identity
+    policy as :func:`_allreduce_prog`."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _rank_mesh()
+    out_sh = NamedSharding(mesh, P("rank"))
+    offs = np.concatenate([[0], np.cumsum(sizes)]).tolist()
+    max_shard = max(sizes)
+
+    def fn(arr, factor):  # (size, n0, *rest)
+        y = _reduce_over_ranks(op, arr)
+        if inexact:
+            y = _apply_factor(y, factor)
+        return jnp.stack([
+            _pad_rows(y[offs[r]:offs[r + 1]], max_shard)
+            for r in range(len(sizes))])
+
+    return jax.jit(fn, out_shardings=out_sh)
+
+
+def _dist_reducescatter(st, sizes: Tuple[int, ...], size: int, rank: int):
+    import jax.numpy as jnp
+
+    x = jnp.asarray(st.input_dev)
+    f = _scale_factor(st, size)
+    if f != 1.0:
+        _check_scalable(x.dtype, f)
+    inexact = np.dtype(x.dtype).kind == "f" or \
+        np.dtype(x.dtype).name == "bfloat16"
+    arr = _make_global(x, size)
+    out = _reducescatter_prog(_op_class(st.reduce_op), sizes, inexact)(
+        arr, _factor_scalar(f))
+    return _local(out)[0][:sizes[rank]]

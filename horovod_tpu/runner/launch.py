@@ -183,6 +183,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-p", "--ssh-port", type=int, dest="ssh_port")
     p.add_argument("--start-timeout", type=float, default=120.0,
                    help="seconds to wait for all ranks to rendezvous")
+    p.add_argument("--xla-exec", action="store_true",
+                   help="bring up jax.distributed in every worker so "
+                        "device tensors ride the XLA data plane instead "
+                        "of host TCP")
     p.add_argument("--verbose", action="store_true")
 
     tune = p.add_argument_group("tuning")
@@ -227,6 +231,8 @@ def args_to_env(args: argparse.Namespace) -> Dict[str, str]:
             args.stall_shutdown_time)
     if args.log_level is not None:
         env["HOROVOD_LOG_LEVEL"] = args.log_level
+    if args.xla_exec:
+        env["HOROVOD_XLA_EXEC"] = "1"
     return env
 
 

@@ -104,6 +104,8 @@ class Runtime:
             os.environ["HOROVOD_CONTROLLER_ADDR"] = discover_controller_addr(
                 topo.rank, timeout, epoch=self._init_epoch)
             discovered = True
+        if topo.size > 1 and os.environ.get("HOROVOD_XLA_EXEC") == "1":
+            self._init_jax_distributed(topo)
         self._exec_cb = basics.EXEC_CB_TYPE(self._on_exec)
         self._alloc_cb = basics.ALLOC_CB_TYPE(self._on_alloc)
         self.lib.hvd_set_exec_callback(self._exec_cb)
@@ -119,6 +121,46 @@ class Runtime:
         if rc != 0:
             raise HorovodInternalError("native core initialization failed")
         self.topology = topo
+
+    def _init_jax_distributed(self, topo: Topology) -> None:
+        """Bring up the process-spanning XLA runtime (``--xla-exec``):
+        ``jax.distributed`` + gloo CPU collectives, so eager CALLBACK
+        responses execute as cross-process XLA programs instead of
+        staging through the host TCP plane. Must run before the local
+        jax backend initializes."""
+        import jax
+
+        if getattr(self, "_jax_dist_up", False):
+            return  # already up (elastic re-init keeps the old runtime)
+        coord = os.environ.get("HOROVOD_XLA_COORD_ADDR")
+        if not coord:
+            if not os.environ.get("HOROVOD_RENDEZVOUS_ADDR"):
+                raise HorovodInternalError(
+                    "HOROVOD_XLA_EXEC=1 needs HOROVOD_XLA_COORD_ADDR or a "
+                    "launcher rendezvous (HOROVOD_RENDEZVOUS_ADDR)")
+            from horovod_tpu.runner.http_kv import kv_put, kv_wait
+            from horovod_tpu.runner.rendezvous import free_port
+            rdv = os.environ["HOROVOD_RENDEZVOUS_ADDR"]
+            timeout = float(os.environ.get("HOROVOD_START_TIMEOUT", "120"))
+            key = f"xla_coord_addr.{self._init_epoch}"
+            if topo.rank == 0:
+                host = os.environ.get("HOROVOD_CONTROLLER_HOST", "127.0.0.1")
+                coord = f"{host}:{free_port()}"
+                kv_put(rdv, "global", key, coord.encode())
+            else:
+                coord = kv_wait(rdv, "global", key, timeout).decode()
+        # Probing the backend here would initialize it — too early.
+        # Decide CPU-ness from the environment alone.
+        if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+            try:
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+            except Exception:
+                pass
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=topo.size,
+                                   process_id=topo.rank)
+        self._jax_dist_up = True
 
     def shutdown(self) -> None:
         if self.lib is not None and self.initialized():
@@ -220,6 +262,17 @@ class Runtime:
         if kind == "jax" and self.size() > 1 and not _jax_distributed_active():
             # No process-spanning mesh available: stage through the host
             # data plane (the reference's CPU-fallback, gloo_operations.cc).
+            # Loud, once — the XLA data plane is opt-in via --xla-exec.
+            global _warned_host_staging
+            if not _warned_host_staging:
+                _warned_host_staging = True
+                import warnings
+                warnings.warn(
+                    "horovod_tpu: jax tensors are staging through the host "
+                    "TCP data plane because jax.distributed is not "
+                    "initialized; launch with horovodrun --xla-exec (or set "
+                    "HOROVOD_XLA_EXEC=1) for the XLA data plane",
+                    RuntimeWarning, stacklevel=3)
             kind = "np"
             np_in = np.asarray(dev_in)
             st.orig_kind = "jax"
@@ -414,6 +467,9 @@ class Runtime:
     def stop_timeline(self) -> None:
         self._check_init()
         self.lib.hvd_stop_timeline()
+
+
+_warned_host_staging = False
 
 
 def _jax_distributed_active() -> bool:

@@ -167,6 +167,110 @@ def main():
         except HorovodInternalError as e:
             assert "mismatched dtype" in str(e), str(e)
 
+    elif scenario == "xla_matrix":
+        # Full op matrix on jax device arrays with exec_mode=CALLBACK:
+        # requires HOROVOD_XLA_EXEC=1 (hvd.init brought up
+        # jax.distributed before this point). Every collective below
+        # must run as a cross-process XLA program, NOT host staging —
+        # asserted by checking jax.distributed is actually active.
+        import jax
+        import jax.numpy as jnp
+
+        assert jax.process_count() == s, (
+            f"jax.distributed not spanning: {jax.process_count()} != {s}")
+
+        # allreduce f32/bf16, avg + scales
+        for dt, tol in ((jnp.float32, 1e-6), (jnp.bfloat16, 1e-1)):
+            x = (jnp.arange(12, dtype=dt) + r).reshape(3, 4)
+            out = hvd.allreduce(x, op=hvd.Sum, name=f"x.ar.{dt.__name__}")
+            assert out.shape == (3, 4)
+            want = sum((np.arange(12, dtype=np.float64) + k)
+                       for k in range(s))
+            np.testing.assert_allclose(
+                np.asarray(out, np.float64).ravel(), want, rtol=tol)
+        avg = hvd.allreduce(jnp.full(5, float(r)), name="x.avg",
+                            prescale_factor=2.0)
+        np.testing.assert_allclose(np.asarray(avg),
+                                   2.0 * (s - 1) / 2.0, rtol=1e-6)
+
+        # grouped allreduce -> one fused XLA program
+        ts = [jnp.full(4, float(r)), jnp.full(2, 2.0 * r)]
+        outs = hvd.grouped_allreduce(ts, op=hvd.Sum, name="x.grp")
+        np.testing.assert_allclose(np.asarray(outs[0]),
+                                   np.full(4, s * (s - 1) / 2.0))
+        np.testing.assert_allclose(np.asarray(outs[1]),
+                                   np.full(2, float(s * (s - 1))))
+
+        # allgather, ragged rows
+        g = hvd.allgather(jnp.full((r + 1, 2), float(r)), name="x.ag")
+        rows = sum(k + 1 for k in range(s))
+        assert g.shape == (rows, 2), g.shape
+        off = 0
+        for k in range(s):
+            np.testing.assert_allclose(np.asarray(g[off:off + k + 1]),
+                                       float(k))
+            off += k + 1
+
+        # broadcast from nonzero root
+        b = hvd.broadcast(jnp.full((2, 2), float(r) + 3.0),
+                          root_rank=s - 1, name="x.bc")
+        np.testing.assert_allclose(np.asarray(b), float(s - 1) + 3.0)
+
+        # alltoall, uneven splits (rank r sends k+1 rows to rank k)
+        x = np.repeat(np.arange(s), [k + 1 for k in range(s)]).astype(
+            np.float32)
+        x = jnp.asarray((x * 10 + r)[:, None])
+        out, rsplits = hvd.alltoall(x, splits=[k + 1 for k in range(s)],
+                                    name="x.a2a")
+        assert list(rsplits) == [r + 1] * s, rsplits
+        assert out.shape == (s * (r + 1), 1), out.shape
+        off = 0
+        for k in range(s):
+            np.testing.assert_allclose(np.asarray(out[off:off + r + 1, 0]),
+                                       r * 10 + k)
+            off += r + 1
+
+        # reducescatter (uneven dim0: 2s+1 rows)
+        x = jnp.full((2 * s + 1, 3), 1.0)
+        rs_out = hvd.reducescatter(x, op=hvd.Sum, name="x.rs")
+        want_rows = 3 if r == 0 else 2
+        assert rs_out.shape == (want_rows, 3), rs_out.shape
+        np.testing.assert_allclose(np.asarray(rs_out), float(s))
+
+        # steady-state cache loop with a PER-ITERATION factor change
+        # (dynamic loss scaling shape): the factor is a traced argument,
+        # so this must hit the compiled-program cache every iteration.
+        import time as _time
+        t0 = _time.monotonic()
+        for i in range(20):
+            out = hvd.allreduce(jnp.full(8, float(r)), op=hvd.Sum,
+                                prescale_factor=float(i + 1),
+                                name="x.steady")
+            np.testing.assert_allclose(
+                np.asarray(out), (i + 1) * s * (s - 1) / 2.0, rtol=1e-6)
+        # Recompiling per factor value would take >>1s/iteration; the
+        # traced path completes the whole loop in well under that.
+        assert _time.monotonic() - t0 < 15, "factor change likely recompiles"
+
+    elif scenario == "xla_join":
+        # CALLBACK-mode Join: joined rank synthesizes a zeros
+        # contribution and still launches the same XLA program.
+        import jax
+        import jax.numpy as jnp
+
+        assert jax.process_count() == s
+        if r == s - 1:
+            hvd.join()
+        else:
+            # Scaled allreduce under join: the joined rank only knows
+            # factor 1.0 — program identity must not depend on factor
+            # values or the ranks trace different HLO and hang.
+            out = hvd.allreduce(jnp.full(4, float(r + 1)), op=hvd.Sum,
+                                prescale_factor=3.0, name="xj")
+            want = 3.0 * sum(k + 1 for k in range(s - 1))
+            np.testing.assert_allclose(np.asarray(out), want)
+            hvd.join()
+
     else:
         raise SystemExit(f"unknown scenario {scenario}")
 

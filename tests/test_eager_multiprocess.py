@@ -19,7 +19,7 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def run_job(scenario: str, np_: int, timeout: int = 120):
+def run_job(scenario: str, np_: int, timeout: int = 120, extra_env=None):
     port = _free_port()
     procs = []
     for r in range(np_):
@@ -36,6 +36,7 @@ def run_job(scenario: str, np_: int, timeout: int = 120):
             "PALLAS_AXON_POOL_IPS": "",
             "JAX_PLATFORMS": "cpu",
         })
+        env.update(extra_env or {})
         procs.append(subprocess.Popen(
             [sys.executable, WORKER, scenario], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
@@ -77,6 +78,33 @@ def test_join_race_no_deadlock():
 
 def test_join_solo_announce_no_hang():
     outs = run_job("join_solo_announce", 2, timeout=90)
+    for r, out in enumerate(outs):
+        assert f"OK rank={r}" in out
+
+
+def _xla_env(np_):
+    """Env for CALLBACK-mode jobs: XLA exec on, explicit coordinator
+    (tests bypass the launcher's KV rendezvous)."""
+    return {
+        "HOROVOD_XLA_EXEC": "1",
+        "HOROVOD_XLA_COORD_ADDR": f"127.0.0.1:{_free_port()}",
+        # The conftest's 8-virtual-device flag would break the
+        # one-device-per-process model; workers get a clean slate.
+        "XLA_FLAGS": "",
+    }
+
+
+@pytest.mark.parametrize("np_", [2, 4])
+def test_xla_matrix(np_):
+    """Full op matrix on jax arrays with exec_mode=CALLBACK (the VERDICT
+    done-criterion for the eager XLA data plane)."""
+    outs = run_job("xla_matrix", np_, timeout=240, extra_env=_xla_env(np_))
+    for r, out in enumerate(outs):
+        assert f"OK rank={r}" in out
+
+
+def test_xla_join():
+    outs = run_job("xla_join", 3, timeout=240, extra_env=_xla_env(3))
     for r, out in enumerate(outs):
         assert f"OK rank={r}" in out
 
