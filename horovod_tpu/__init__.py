@@ -65,3 +65,8 @@ from horovod_tpu.api import (  # noqa: F401
     stop_timeline,
 )
 from horovod_tpu.compression import Compression  # noqa: F401
+from horovod_tpu.functions import (  # noqa: F401
+    allgather_object,
+    broadcast_object,
+)
+from horovod_tpu import elastic  # noqa: F401

@@ -25,6 +25,16 @@ class HostsUpdatedInterrupt(Exception):
         self.skip_sync = skip_sync
 
 
+class WorkerExcludedError(SystemExit):
+    """This worker's slot is not part of the new elastic assignment; the
+    process exits cleanly (code 0) so the driver does not count it as a
+    failure."""
+
+    def __init__(self, reason: str = ""):
+        super().__init__(0)
+        self.reason = reason
+
+
 class TensorShapeError(ValueError):
     """Cross-rank tensor shape/dtype mismatch detected by the controller
     (reference ``controller.cc:471-748`` produces an ERROR response)."""

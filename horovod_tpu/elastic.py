@@ -1,0 +1,213 @@
+"""Elastic training — worker side.
+
+Rebuild of ``horovod/common/elastic.py:26-175``: a ``State`` object
+carries everything training needs to survive a membership change
+(commit/restore/sync), and the ``run`` wrapper turns collective
+failures and host updates into state rollback + re-rendezvous instead
+of job death.
+
+Protocol differences from the reference are transport-level only: host
+updates arrive by polling the launcher's KV store at ``commit()`` /
+``check_host_updates()`` boundaries (the reference pushes them over a
+worker RPC service, but also only *applies* them at these same
+boundaries), and re-rendezvous asks the elastic driver's KV table for
+this worker's new coordinates instead of the Gloo
+``HOROVOD_GLOO_GET_RANK_AND_SIZE`` scope (``gloo_context.cc:154-200``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Optional
+
+import cloudpickle
+
+import horovod_tpu.api as api
+from horovod_tpu.common.exceptions import (
+    HorovodInternalError, HostsUpdatedInterrupt, WorkerExcludedError,
+)
+from horovod_tpu.common.topology import Topology
+from horovod_tpu.functions import broadcast_object
+
+ASSIGN_SCOPE = "elastic"
+
+
+def _rdv() -> Optional[str]:
+    return os.environ.get("HOROVOD_RENDEZVOUS_ADDR")
+
+
+def _kv():
+    from horovod_tpu.runner import http_kv
+    return http_kv
+
+
+def current_epoch() -> int:
+    """The driver-published membership epoch (0 when not elastic)."""
+    rdv = _rdv()
+    if not rdv:
+        return 0
+    raw = _kv().kv_get(rdv, ASSIGN_SCOPE, "epoch")
+    return int(raw) if raw else 0
+
+
+class State:
+    """Base state: commit/restore/sync + host-update detection
+    (reference ``common/elastic.py:26-96``)."""
+
+    def __init__(self, **kwargs):
+        self._reset_callbacks = []
+        self._known_epoch = current_epoch()
+
+    def register_reset_callbacks(self, callbacks) -> None:
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self) -> None:
+        for cb in self._reset_callbacks:
+            cb()
+
+    def commit(self) -> None:
+        """Save a restore point, then surface any pending membership
+        change as :class:`HostsUpdatedInterrupt`."""
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self) -> None:
+        epoch = current_epoch()
+        if epoch > self._known_epoch:
+            self._known_epoch = epoch
+            raise HostsUpdatedInterrupt()
+
+    # subclass surface ---------------------------------------------------
+    def save(self) -> None:
+        raise NotImplementedError
+
+    def restore(self) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        raise NotImplementedError
+
+
+class ObjectState(State):
+    """State carrying arbitrary picklable attributes, synced from rank 0
+    (reference ``ObjectState``, ``common/elastic.py:99-148``)."""
+
+    def __init__(self, **kwargs):
+        super().__init__()
+        self._saved: Dict[str, Any] = dict(kwargs)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self.save()  # deep-copied restore point, not aliased live attrs
+
+    def _attrs(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self._saved}
+
+    def save(self) -> None:
+        self._saved = {
+            k: cloudpickle.loads(cloudpickle.dumps(v))
+            for k, v in self._attrs().items()}
+
+    def restore(self) -> None:
+        for k, v in self._saved.items():
+            setattr(self, k, cloudpickle.loads(cloudpickle.dumps(v)))
+
+    def sync(self) -> None:
+        synced = broadcast_object(self._attrs(), root_rank=0,
+                                  name="elastic.object_state")
+        for k, v in synced.items():
+            setattr(self, k, v)
+        self.save()
+
+
+def _rendezvous_new_topology(timeout: float,
+                             min_epoch: int = 0) -> Topology:
+    """Ask the driver's KV table for this worker's coordinates (and the
+    epoch's controller address) at the newest epoch. Raises
+    WorkerExcludedError when this worker is not in the new assignment
+    (its slot was removed).
+
+    ``min_epoch``: after a collective FAILURE the driver is about to
+    roll the epoch (it sees the dead process slightly later than the
+    survivors see the broken connection); re-initializing at the old
+    epoch would bind the old address while respawned workers dial the
+    new one. Wait for the roll — bounded, because a global transient
+    error (stall shutdown) never rolls and same-epoch re-init is then
+    correct for everyone.
+    """
+    rdv = _rdv()
+    identity = os.environ.get("HOROVOD_ELASTIC_ID")
+    if not rdv or not identity:
+        raise HorovodInternalError(
+            "elastic reset requires a horovodrun elastic launch "
+            "(HOROVOD_RENDEZVOUS_ADDR + HOROVOD_ELASTIC_ID)")
+    kv = _kv()
+    epoch = current_epoch()
+    if epoch < min_epoch:
+        import time
+        deadline = time.monotonic() + min(timeout, 30.0)
+        while epoch < min_epoch and time.monotonic() < deadline:
+            time.sleep(0.1)
+            epoch = current_epoch()
+    payload = cloudpickle.loads(
+        kv.kv_wait(rdv, ASSIGN_SCOPE, f"assign.{epoch}", timeout))
+    slot = payload["slots"].get(identity)
+    if slot is None:
+        raise WorkerExcludedError(
+            f"worker {identity} is not part of epoch {epoch}")
+    # The driver picked the epoch's controller endpoint; rank 0 binds
+    # every interface, others dial the published host.
+    host, port = payload["controller_addr"].rsplit(":", 1)
+    os.environ["HOROVOD_CONTROLLER_ADDR"] = (
+        f"0.0.0.0:{port}" if slot.rank == 0 else f"{host}:{port}")
+    os.environ["HOROVOD_ELASTIC_EPOCH"] = str(epoch)
+    return Topology(rank=slot.rank, size=slot.size,
+                    local_rank=slot.local_rank, local_size=slot.local_size,
+                    cross_rank=slot.cross_rank, cross_size=slot.cross_size)
+
+
+def _reset(min_epoch: int = 0) -> None:
+    """Shutdown + re-rendezvous with the new membership (reference
+    ``common/elastic.py`` ``reset()``: shutdown, re-init)."""
+    api.shutdown()
+    timeout = float(os.environ.get("HOROVOD_START_TIMEOUT", "120"))
+    topo = _rendezvous_new_topology(timeout, min_epoch)
+    try:
+        api.init(topo)
+    finally:
+        os.environ.pop("HOROVOD_CONTROLLER_ADDR", None)
+
+
+def run(func: Callable) -> Callable:
+    """``@hvd.elastic.run`` — wrap a training function taking a
+    :class:`State` first argument (reference ``common/elastic.py:
+    151-175``). On collective failure: restore last commit, re-init,
+    retry. On host update: re-init (state is current), retry.
+    """
+
+    def wrapper(state: State, *args, **kwargs):
+        reset_limit = int(os.environ.get("HOROVOD_ELASTIC_RESET_LIMIT", "0"))
+        resets = 0
+        while True:
+            try:
+                # sync itself is collective — a failure there recovers
+                # the same way as one inside the training function.
+                state.sync()
+                return func(state, *args, **kwargs)
+            except HorovodInternalError:
+                state.restore()
+                # A failure means membership is about to change; wait
+                # for the driver's epoch roll before re-rendezvousing.
+                min_epoch = state._known_epoch + 1
+            except HostsUpdatedInterrupt:
+                # check_host_updates already advanced _known_epoch to
+                # the new epoch; rendezvous there.
+                min_epoch = state._known_epoch
+            resets += 1
+            if reset_limit and resets >= reset_limit:
+                raise RuntimeError(
+                    f"elastic reset limit ({reset_limit}) reached")
+            state.on_reset()
+            _reset(min_epoch)
+            state._known_epoch = current_epoch()
+
+    return wrapper

@@ -1,0 +1,346 @@
+"""Elastic driver: discovery polling, blacklist, stable rank
+reassignment, worker lifecycle.
+
+Rebuild of ``horovod/runner/elastic/driver.py:68`` + ``discovery.py`` +
+``registration.py``: a discovery thread polls the available hosts; on
+membership change (or a worker failure) the driver bumps the job
+epoch, computes new slot assignments that keep surviving workers'
+relative rank order, and publishes the assignment table — INCLUDING
+the controller address for that epoch — through its KV store. Running
+workers pick the change up at their next ``state.commit()``; new
+workers are spawned; workers whose slot disappeared exit.
+
+Driver-mediated rendezvous: because the epoch's controller address is
+part of the table, a transient collective failure (no membership
+change) re-initializes against the same address, and every membership
+change gets a fresh port — no peer-to-peer agreement protocol needed
+(the reference's rendezvous HTTP server plays the same role,
+``runner/gloo_run.py:287-323``).
+
+Worker identity is ``host:seq`` (seq monotonic per host, never
+reused), stable across epochs even as ranks and local ranks change.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import subprocess
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import cloudpickle
+
+from horovod_tpu.runner import hosts as hosts_mod
+from horovod_tpu.runner.http_kv import KVServer
+from horovod_tpu.runner.rendezvous import free_port
+
+ASSIGN_SCOPE = "elastic"
+
+
+class HostDiscovery:
+    """Returns {hostname: slots}. Subclass or use the script variant
+    (reference ``runner/elastic/discovery.py``)."""
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        raise NotImplementedError
+
+
+class HostDiscoveryScript(HostDiscovery):
+    """Runs an executable that prints one ``hostname[:slots]`` per line
+    (the reference's ``--host-discovery-script`` contract)."""
+
+    def __init__(self, script: str, default_slots: int = 1):
+        self._script = script
+        self._default_slots = default_slots
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        out = subprocess.run([self._script], capture_output=True, text=True,
+                             timeout=30, check=True).stdout
+        hosts: Dict[str, int] = {}
+        for line in out.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            if ":" in line:
+                name, slots = line.rsplit(":", 1)
+                hosts[name] = int(slots)
+            else:
+                hosts[line] = self._default_slots
+        return hosts
+
+
+class FixedHostDiscovery(HostDiscovery):
+    def __init__(self, hosts: Dict[str, int]):
+        self._hosts = dict(hosts)
+
+    def set_hosts(self, hosts: Dict[str, int]) -> None:
+        self._hosts = dict(hosts)
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        return dict(self._hosts)
+
+
+@dataclasses.dataclass
+class WorkerRecord:
+    identity: str
+    hostname: str
+    proc: object           # WorkerProcess-like (poll/terminate)
+    epoch_started: int
+    failures: int = 0
+    handled: bool = False        # exit already processed by the monitor
+    expected_exit: bool = False  # driver terminated it (scale-down)
+
+
+def assign_order(hosts: Dict[str, int], prev_order: Sequence[str],
+                 next_seq: Dict[str, int], min_np: int,
+                 max_np: int) -> List[str]:
+    """New identity order: surviving identities keep their relative
+    (rank) order, new identities (fresh ``host:seq``) fill remaining
+    slots. Mutates ``next_seq``. Raises RuntimeError below ``min_np``."""
+    budget = dict(hosts)
+    surviving: List[str] = []
+    for ident in prev_order:
+        h = ident.rsplit(":", 1)[0]
+        if budget.get(h, 0) > 0:
+            surviving.append(ident)
+            budget[h] -= 1
+    new: List[str] = []
+    for h in sorted(budget):
+        for _ in range(budget[h]):
+            seq = next_seq.get(h, 0)
+            next_seq[h] = seq + 1
+            new.append(f"{h}:{seq}")
+    order = surviving + new
+    if max_np:
+        order = order[:max_np]
+    if len(order) < max(1, min_np):
+        raise RuntimeError(
+            f"only {len(order)} slots available, need >= {min_np}")
+    return order
+
+
+def slots_for_order(order: Sequence[str]) -> Dict[str, hosts_mod.SlotInfo]:
+    """SlotInfo per identity for a given global order."""
+    by_host: Dict[str, List[str]] = {}
+    host_order: List[str] = []
+    for ident in order:
+        h = ident.rsplit(":", 1)[0]
+        if h not in by_host:
+            by_host[h] = []
+            host_order.append(h)
+        by_host[h].append(ident)
+    table: Dict[str, hosts_mod.SlotInfo] = {}
+    for rank, ident in enumerate(order):
+        h = ident.rsplit(":", 1)[0]
+        table[ident] = hosts_mod.SlotInfo(
+            hostname=h, rank=rank,
+            local_rank=by_host[h].index(ident),
+            cross_rank=host_order.index(h),
+            size=len(order), local_size=len(by_host[h]),
+            cross_size=len(host_order))
+    return table
+
+
+class ElasticDriver:
+    """Owns the KV server, the discovery loop, and worker processes.
+
+    ``spawn_fn(identity, slot, env, controller_addr)`` must start a
+    worker and return an object with ``poll()``/``terminate()``.
+    """
+
+    def __init__(self, discovery: HostDiscovery,
+                 spawn_fn: Callable[..., object],
+                 min_np: int = 1, max_np: int = 0,
+                 discovery_interval: float = 1.0,
+                 max_worker_failures: int = 3,
+                 kv_server: Optional[KVServer] = None,
+                 resolve_controller_host: Optional[
+                     Callable[[str, Dict[str, int]], str]] = None):
+        self._discovery = discovery
+        self._spawn_fn = spawn_fn
+        self._min_np = min_np
+        self._max_np = max_np
+        self._interval = discovery_interval
+        self._max_failures = max_worker_failures
+        self._resolve_host = resolve_controller_host or (lambda h, hosts: h)
+
+        self.kv = kv_server or KVServer()
+        self._own_kv = kv_server is None
+        self.epoch = 0
+        self._order: List[str] = []
+        self._last_hosts: Dict[str, int] = {}
+        self._next_seq: Dict[str, int] = {}
+        self._workers: Dict[str, WorkerRecord] = {}
+        self._completed: set = set()     # identities that exited 0
+        self._blacklist: set = set()
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self.result_codes: Dict[str, int] = {}
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        if self._own_kv:
+            self.kv.start()
+        self._apply_assignment(self._current_hosts(), first=True)
+        for target in (self._discovery_loop, self._monitor_loop):
+            t = threading.Thread(target=target, daemon=True,
+                                 name=target.__name__)
+            t.start()
+            self._threads.append(t)
+
+    def wait(self, timeout: Optional[float] = None) -> Dict[str, int]:
+        """Block until every worker has exited; returns
+        {identity: exit_code}."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                alive = [w for w in self._workers.values()
+                         if w.proc.poll() is None]
+            if not alive:
+                # All dead. Reap — and if any exit was a failure, this
+                # is a crash the monitor may not have respawned yet, not
+                # job completion: give the respawn path its chance
+                # rather than racing the monitor thread to declare
+                # failure.
+                if self._reap():
+                    try:
+                        self._apply_assignment(self._current_hosts())
+                        continue
+                    except Exception:
+                        pass
+                with self._lock:
+                    unfinished = [w for w in self._workers.values()
+                                  if w.proc.poll() is None]
+                if not unfinished:
+                    break
+            if deadline and time.monotonic() > deadline:
+                raise TimeoutError("elastic job did not finish in time")
+            time.sleep(0.2)
+        self._stop.set()
+        self._reap()  # the monitor thread may not have seen final exits
+        with self._lock:
+            return dict(self.result_codes)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        with self._lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            w.proc.terminate()
+        if self._own_kv:
+            self.kv.stop()
+
+    # -- internals --------------------------------------------------------
+
+    def _current_hosts(self) -> Dict[str, int]:
+        found = self._discovery.find_available_hosts_and_slots()
+        return {h: s for h, s in found.items()
+                if h not in self._blacklist and s > 0}
+
+    def _publish(self, table: Dict[str, hosts_mod.SlotInfo],
+                 controller_addr: str) -> None:
+        # Table first, epoch second: a worker that sees the new epoch
+        # always finds its table.
+        payload = {"slots": table, "controller_addr": controller_addr}
+        self.kv.put_local(ASSIGN_SCOPE, f"assign.{self.epoch}",
+                          cloudpickle.dumps(payload))
+        self.kv.put_local(ASSIGN_SCOPE, "epoch", str(self.epoch).encode())
+
+    def _apply_assignment(self, hosts: Dict[str, int],
+                          first: bool = False) -> None:
+        with self._lock:
+            # Reap dead-but-unprocessed workers first so their failure
+            # accounting isn't lost when we respawn over them below.
+            self._reap()
+            order = assign_order(hosts, self._order, self._next_seq,
+                                 self._min_np, self._max_np)
+            self._order = order
+            self._last_hosts = dict(hosts)
+            table = slots_for_order(order)
+            if not first:
+                self.epoch += 1
+            # The epoch's controller endpoint: rank 0's host + a port
+            # the driver picks (probed locally; for a remote rank 0
+            # this is a random-ish high port — a collision just fails
+            # that init and rolls the epoch again).
+            rank0_host = self._resolve_host(table[order[0]].hostname, hosts)
+            controller_addr = f"{rank0_host}:{free_port()}"
+            self._publish(table, controller_addr)
+
+            for ident, rec in list(self._workers.items()):
+                if ident not in table and rec.proc.poll() is None:
+                    # Scale-down: this exit is intentional, not a
+                    # failure (no blacklist, no respawn, code 0).
+                    rec.expected_exit = True
+                    rec.proc.terminate()
+            for ident, slot in table.items():
+                rec = self._workers.get(ident)
+                if (rec is None or rec.proc.poll() is not None) \
+                        and ident not in self._completed:
+                    self._spawn(ident, slot, controller_addr)
+
+    def _spawn(self, ident: str, slot: hosts_mod.SlotInfo,
+               controller_addr: str) -> None:
+        prev = self._workers.get(ident)
+        env = {
+            "HOROVOD_ELASTIC_ID": ident,
+            "HOROVOD_ELASTIC_EPOCH": str(self.epoch),
+        }
+        proc = self._spawn_fn(ident, slot, env, controller_addr)
+        self._workers[ident] = WorkerRecord(
+            identity=ident, hostname=slot.hostname, proc=proc,
+            epoch_started=self.epoch,
+            failures=prev.failures if prev else 0)
+
+    def _discovery_loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                hosts = self._current_hosts()
+            except Exception:
+                continue
+            with self._lock:
+                current = dict(self._last_hosts)
+            if hosts != current:
+                try:
+                    self._apply_assignment(hosts)
+                except RuntimeError:
+                    continue  # below min_np: wait for hosts to return
+
+    def _reap(self) -> bool:
+        """Record exits of unhandled workers; returns whether a failed
+        exit calls for a reassignment."""
+        respawn = False
+        with self._lock:
+            for ident, rec in list(self._workers.items()):
+                if rec.handled:
+                    continue
+                rc = rec.proc.poll()
+                if rc is None:
+                    continue
+                rec.handled = True
+                if rec.expected_exit:
+                    self.result_codes[ident] = 0
+                    continue
+                self.result_codes[ident] = rc
+                if rc == 0:
+                    self._completed.add(ident)
+                    continue
+                rec.failures += 1
+                if rec.failures >= self._max_failures:
+                    self._blacklist.add(rec.hostname)
+                respawn = True
+        return respawn
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(0.2):
+            if self._reap():
+                # Failure dooms the running group's collectives; roll
+                # the epoch so survivors re-rendezvous and the failed
+                # slot (or its host's replacement) is respawned.
+                try:
+                    self._apply_assignment(self._current_hosts())
+                except Exception:
+                    pass

@@ -102,6 +102,20 @@ def _ssh_command(slot: hosts_mod.SlotInfo, command: Sequence[str],
     return cmd
 
 
+def _spawn_worker(slot: hosts_mod.SlotInfo, env: Dict[str, str],
+                  settings: LaunchSettings,
+                  prefix: Optional[str] = None) -> WorkerProcess:
+    """Shared local-vs-ssh spawn body for the static and elastic
+    launchers (one copy of the env/ssh contract)."""
+    if is_local_host(slot.hostname):
+        args = list(settings.command)
+    else:
+        args = _ssh_command(slot, settings.command, env, settings.ssh_port,
+                            forward_keys=frozenset(settings.env or ()))
+        env = dict(os.environ)  # ssh itself runs with launcher env
+    return WorkerProcess(slot.rank, args, env, prefix=prefix)
+
+
 def launch_static(settings: LaunchSettings,
                   kv_server: Optional[KVServer] = None) -> Dict[int, int]:
     """Run the job; returns {rank: exit_code}. Caller owns a passed-in
@@ -140,18 +154,11 @@ def launch_static(settings: LaunchSettings,
             for slot in slots:
                 env = _slot_env(slot, base_env, kv_addr, controller_host,
                                 settings.start_timeout, server.token)
-                if is_local_host(slot.hostname):
-                    args = list(settings.command)
-                else:
-                    args = _ssh_command(
-                        slot, settings.command, env, settings.ssh_port,
-                        forward_keys=frozenset(settings.env or ()))
-                    env = dict(os.environ)  # ssh runs with launcher env
                 if settings.verbose:
                     print(f"horovodrun: starting rank {slot.rank} on "
                           f"{slot.hostname} (local_rank {slot.local_rank})",
                           file=sys.stderr)
-                workers.append(WorkerProcess(slot.rank, args, env))
+                workers.append(_spawn_worker(slot, env, settings))
         except BaseException:
             # A failed spawn must not orphan already-running workers.
             for w in workers:
@@ -161,6 +168,68 @@ def launch_static(settings: LaunchSettings,
     finally:
         if own_server:
             server.stop()
+
+
+def launch_elastic(settings: LaunchSettings, discovery,
+                   min_np: int = 1, max_np: int = 0,
+                   discovery_interval: float = 1.0) -> Dict[str, int]:
+    """Run an elastic job (reference ``launch_gloo_elastic``,
+    ``runner/gloo_run.py:287-323``): the ElasticDriver owns worker
+    processes and membership; this provides the spawn function with the
+    static launcher's env contract. Returns {identity: exit_code}."""
+    from horovod_tpu.runner.elastic_driver import ElasticDriver
+
+    try:
+        initial = discovery.find_available_hosts_and_slots()
+    except Exception:
+        initial = {}
+    initially_local = bool(initial) and all(
+        is_local_host(h) for h in initial)
+    # Loopback-only when the job starts all-local (same invariant as
+    # launch_static: the exec scope serves pickles). A later remote
+    # host joining an initially-local job is unsupported — by then the
+    # store is already bound.
+    server = KVServer(host="127.0.0.1" if initially_local else "0.0.0.0")
+    server.start()
+    try:
+        launcher_host = ("127.0.0.1" if initially_local
+                         else socket.getfqdn())
+        kv_addr = f"{launcher_host}:{server.port}"
+
+        base_env = dict(os.environ)
+        base_env.update(settings.env or {})
+
+        def resolve_controller_host(host, hosts):
+            """Routable controller host for the assignment table: a
+            local rank-0 host must be advertised as the launcher's
+            FQDN when any OTHER host in the membership is remote."""
+            if not is_local_host(host):
+                return host
+            if all(is_local_host(h) for h in hosts):
+                return "127.0.0.1"
+            return socket.getfqdn()
+
+        def spawn_fn(ident, slot, extra_env, controller_addr):
+            env = _slot_env(slot, base_env, kv_addr,
+                            controller_addr.rsplit(":", 1)[0],
+                            settings.start_timeout, server.token)
+            env.update(extra_env)
+            host, port = controller_addr.rsplit(":", 1)
+            env["HOROVOD_CONTROLLER_ADDR"] = (
+                f"0.0.0.0:{port}" if slot.rank == 0 else f"{host}:{port}")
+            return _spawn_worker(slot, env, settings, prefix=f"[{ident}]")
+
+        driver = ElasticDriver(
+            discovery, spawn_fn, min_np=min_np, max_np=max_np,
+            discovery_interval=discovery_interval, kv_server=server,
+            resolve_controller_host=resolve_controller_host)
+        driver.start()
+        try:
+            return driver.wait()
+        finally:
+            driver.shutdown()
+    finally:
+        server.stop()
 
 
 # ---------------------------------------------------------------------------
@@ -180,6 +249,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "(default: localhost with np slots)")
     p.add_argument("--hostfile", dest="hostfile",
                    help='file with one "hostname slots=N" per line')
+
+    elastic = p.add_argument_group("elastic")
+    elastic.add_argument("--host-discovery-script", dest="discovery_script",
+                         help="executable printing one host[:slots] per "
+                              "line; enables elastic mode")
+    elastic.add_argument("--min-np", type=int, default=None,
+                         help="minimum workers to keep running (elastic)")
+    elastic.add_argument("--max-np", type=int, default=None,
+                         help="maximum workers (elastic)")
+    elastic.add_argument("--slots", type=int, default=1,
+                         help="default slots per discovered host")
+    elastic.add_argument("--reset-limit", type=int, default=None,
+                         help="max elastic resets before a worker aborts")
     p.add_argument("-p", "--ssh-port", type=int, dest="ssh_port")
     p.add_argument("--start-timeout", type=float, default=120.0,
                    help="seconds to wait for all ranks to rendezvous")
@@ -244,11 +326,27 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if not command:
         print("horovodrun: no command given", file=sys.stderr)
         return 2
+    env = args_to_env(args)
+    if args.reset_limit is not None:
+        env["HOROVOD_ELASTIC_RESET_LIMIT"] = str(args.reset_limit)
     settings = LaunchSettings(
         np=args.np, command=command, hosts=args.hosts,
-        hostfile=args.hostfile, env=args_to_env(args),
+        hostfile=args.hostfile, env=env,
         start_timeout=args.start_timeout, verbose=args.verbose,
         ssh_port=args.ssh_port)
+    if args.discovery_script:
+        from horovod_tpu.runner.elastic_driver import HostDiscoveryScript
+        codes = launch_elastic(
+            settings, HostDiscoveryScript(args.discovery_script,
+                                          args.slots),
+            min_np=args.min_np or args.np,
+            max_np=args.max_np or args.np)
+        failures = {i: c for i, c in codes.items() if c != 0}
+        if failures:
+            print(f"horovodrun: workers failed: {failures}",
+                  file=sys.stderr)
+            return 1
+        return 0
     codes = launch_static(settings)
     failures = {r: c for r, c in codes.items() if c != 0}
     if failures:

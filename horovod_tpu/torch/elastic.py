@@ -1,0 +1,53 @@
+"""Elastic state for PyTorch (reference ``torch/elastic/state.py:27-104``
+``TorchState`` + handlers): model and optimizer state_dicts are saved /
+restored in place and synced from rank 0, alongside arbitrary
+``ObjectState`` attributes (epoch counters, samplers, ...)."""
+
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+import torch
+
+from horovod_tpu.elastic import ObjectState, run, State  # noqa: F401
+from horovod_tpu.torch.functions import (
+    broadcast_optimizer_state, broadcast_parameters,
+)
+
+
+class TorchState(ObjectState):
+    def __init__(self, model: Optional[torch.nn.Module] = None,
+                 optimizer: Optional[torch.optim.Optimizer] = None,
+                 **kwargs):
+        self.model = model
+        self.optimizer = optimizer
+        self._saved_model = None
+        self._saved_opt = None
+        super().__init__(**kwargs)
+
+    def save(self) -> None:
+        if self.model is not None:
+            self._saved_model = copy.deepcopy(self.model.state_dict())
+        if self.optimizer is not None:
+            self._saved_opt = copy.deepcopy(self.optimizer.state_dict())
+        super().save()
+
+    def restore(self) -> None:
+        if self.model is not None and self._saved_model is not None:
+            self.model.load_state_dict(self._saved_model)
+        if self.optimizer is not None and self._saved_opt is not None:
+            self.optimizer.load_state_dict(self._saved_opt)
+        super().restore()
+
+    def sync(self) -> None:
+        if self.model is not None:
+            broadcast_parameters(self.model.state_dict(), root_rank=0)
+        if self.optimizer is not None:
+            broadcast_optimizer_state(self.optimizer, root_rank=0)
+        super().sync()
+
+    def _attrs(self):
+        # model/optimizer are synced above, not through the pickle path.
+        return {k: v for k, v in super()._attrs().items()
+                if k not in ("model", "optimizer")}

@@ -1,0 +1,60 @@
+"""Worker for elastic integration tests: trains TOTAL batches with a
+committed ObjectState, logging each completed batch to a per-identity
+file so tests can assert resume-not-restart semantics. Scenario knobs
+via env:
+
+* ``ELASTIC_TOTAL``   — batches to run (default 40)
+* ``ELASTIC_SLEEP``   — seconds per batch (default 0.05)
+* ``ELASTIC_DIE_AT``  — batch at which the identity in
+  ``ELASTIC_DIE_ID`` hard-exits(1), only in epoch 0
+* ``ELASTIC_LOG_DIR`` — directory for per-identity batch logs
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+import horovod_tpu.elastic as elastic  # noqa: E402
+
+
+def main():
+    total = int(os.environ.get("ELASTIC_TOTAL", "40"))
+    pause = float(os.environ.get("ELASTIC_SLEEP", "0.05"))
+    die_at = int(os.environ.get("ELASTIC_DIE_AT", "-1"))
+    die_id = os.environ.get("ELASTIC_DIE_ID", "")
+    log_dir = os.environ["ELASTIC_LOG_DIR"]
+    ident = os.environ["HOROVOD_ELASTIC_ID"]
+    log_path = os.path.join(log_dir, ident.replace(":", "_") + ".log")
+
+    hvd.init()
+    state = elastic.ObjectState(batch=0, weight=0.0)
+
+    @elastic.run
+    def train(state):
+        while state.batch < total:
+            g = hvd.allreduce(np.ones(2) * (hvd.rank() + 1.0),
+                              op=hvd.Average, name="g")
+            state.weight = state.weight + float(np.asarray(g)[0])
+            state.batch += 1
+            if (state.batch == die_at and ident == die_id
+                    and os.environ.get("HOROVOD_ELASTIC_EPOCH") == "0"):
+                os._exit(1)  # hard failure, no cleanup
+            with open(log_path, "a") as f:
+                f.write(f"{state.batch} size={hvd.size()}\n")
+            time.sleep(pause)
+            state.commit()
+        return state.batch, state.weight
+
+    batch, weight = train(state)
+    print(f"RESULT ident={ident} batch={batch} weight={weight:.3f} "
+          f"size={hvd.size()}", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,218 @@
+"""Elastic training: assignment unit tests, state semantics, and real
+integration jobs — worker killed mid-training recovers with state
+intact; scale-up mid-training re-forms the group (the reference's
+``test/integration/test_elastic_torch.py`` tier via scripted
+discovery, ``elastic_common.py:35-60``)."""
+
+import glob
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+import horovod_tpu.elastic as elastic
+from horovod_tpu.runner.elastic_driver import (
+    FixedHostDiscovery, assign_order, slots_for_order,
+)
+from horovod_tpu.runner.launch import LaunchSettings, launch_elastic
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(ROOT, "tests", "_elastic_worker.py")
+_WORKER_ENV = {
+    "PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+    "PYTHONPATH": ROOT,
+    # Fast discovery reaction + commit cadence for tests.
+    "HOROVOD_CYCLE_TIME": "1",
+}
+
+
+# ---------------------------------------------------------------------------
+# assignment unit tests (reference test_elastic_driver.py tier)
+# ---------------------------------------------------------------------------
+
+def test_assign_order_initial_and_stability():
+    seq = {}
+    order = assign_order({"a": 2, "b": 1}, [], seq, 1, 0)
+    assert order == ["a:0", "a:1", "b:0"]
+    # b gains a slot; existing identities keep their relative order.
+    order2 = assign_order({"a": 2, "b": 2}, order, seq, 1, 0)
+    assert order2 == ["a:0", "a:1", "b:0", "b:1"]
+    # a loses one slot: one of a's identities survives (first listed).
+    order3 = assign_order({"a": 1, "b": 2}, order2, seq, 1, 0)
+    assert order3 == ["a:0", "b:0", "b:1"]
+    # a comes back: fresh seq, never reuses a:1.
+    order4 = assign_order({"a": 2, "b": 2}, order3, seq, 1, 0)
+    assert order4 == ["a:0", "b:0", "b:1", "a:2"]
+
+
+def test_assign_order_min_max():
+    seq = {}
+    with pytest.raises(RuntimeError, match="need >= 3"):
+        assign_order({"a": 2}, [], seq, 3, 0)
+    assert assign_order({"a": 5}, [], {}, 1, 2) == ["a:0", "a:1"]
+
+
+def test_slots_for_order_coordinates():
+    table = slots_for_order(["h1:0", "h1:1", "h2:0"])
+    s = table["h2:0"]
+    assert (s.rank, s.local_rank, s.cross_rank) == (2, 0, 1)
+    assert (s.size, s.local_size, s.cross_size) == (3, 1, 2)
+    # Rank 0 identity first in order.
+    assert table["h1:0"].rank == 0
+
+
+# ---------------------------------------------------------------------------
+# state semantics (single process)
+# ---------------------------------------------------------------------------
+
+def test_object_state_commit_restore():
+    hvd.init()
+    st = elastic.ObjectState(batch=3, data=[1, 2])
+    st.batch = 10
+    st.data.append(3)
+    st.restore()          # back to last save (construction)
+    assert st.batch == 3 and st.data == [1, 2]
+    st.batch = 7
+    st.commit()
+    st.batch = 99
+    st.restore()
+    assert st.batch == 7
+
+
+def test_torch_state_roundtrip():
+    import torch
+    from horovod_tpu.torch.elastic import TorchState
+
+    hvd.init()
+    model = torch.nn.Linear(2, 2)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    st = TorchState(model=model, optimizer=opt, epoch=1)
+    st.save()
+    before = {k: v.clone() for k, v in model.state_dict().items()}
+    with torch.no_grad():
+        for p in model.parameters():
+            p.mul_(0.0)
+    st.epoch = 5
+    st.restore()
+    after = model.state_dict()
+    for k in before:
+        assert torch.equal(before[k], after[k])
+    assert st.epoch == 1
+
+
+# ---------------------------------------------------------------------------
+# integration (real driver + real processes on localhost)
+# ---------------------------------------------------------------------------
+
+def _run_elastic_job(tmp_path, total, extra_env, discovery, min_np=1,
+                     max_np=0, mutate=None, timeout=180):
+    log_dir = str(tmp_path)
+    env = dict(_WORKER_ENV)
+    env["ELASTIC_LOG_DIR"] = log_dir
+    env["ELASTIC_TOTAL"] = str(total)
+    env.update(extra_env)
+    settings = LaunchSettings(
+        np=0, command=[sys.executable, WORKER], env=env, start_timeout=90)
+    result = {}
+
+    def runner():
+        result["codes"] = launch_elastic(
+            settings, discovery, min_np=min_np, max_np=max_np,
+            discovery_interval=0.3)
+
+    t = threading.Thread(target=runner, daemon=True)
+    t.start()
+    if mutate:
+        mutate()
+    t.join(timeout)
+    assert not t.is_alive(), "elastic job did not finish"
+    return result["codes"]
+
+
+def test_elastic_worker_failure_recovers_with_state(tmp_path, capfd):
+    """A rank hard-killed mid-training: survivors restore the last
+    commit, the slot respawns, everyone finishes all batches without
+    replaying more than the one uncommitted batch."""
+    total = 30
+    discovery = FixedHostDiscovery({"localhost": 2})
+    codes = _run_elastic_job(
+        tmp_path, total,
+        {"ELASTIC_DIE_AT": "5", "ELASTIC_DIE_ID": "localhost:1",
+         "ELASTIC_SLEEP": "0.05"},
+        discovery)
+    out = capfd.readouterr().out
+    results = [ln for ln in out.splitlines() if "RESULT" in ln]
+    # Both identities eventually completed all batches at size 2.
+    assert sum(f"batch={total}" in ln for ln in results) >= 2, out
+    assert all(c == 0 for c in codes.values()), codes
+
+    # Resume-not-restart: the survivor's log replays at most one
+    # uncommitted batch per reset (a fresh start would double-count).
+    surv = os.path.join(str(tmp_path), "localhost_0.log")
+    lines = [int(ln.split()[0]) for ln in open(surv)]
+    assert max(lines) == total
+    assert len(lines) <= total + 3, f"replayed too much: {len(lines)} lines"
+    # The killed identity's log resumes past the failure point rather
+    # than restarting at 1 after its respawn.
+    dead = os.path.join(str(tmp_path), "localhost_1.log")
+    dead_lines = [int(ln.split()[0]) for ln in open(dead)]
+    restarts = sum(1 for a, b in zip(dead_lines, dead_lines[1:])
+                   if b < a)
+    assert restarts <= 1  # at most the respawn boundary
+    assert dead_lines.count(1) <= 2
+
+
+def test_elastic_scale_down_mid_training(tmp_path, capfd):
+    """Discovery shrinks localhost:2 -> localhost:1: the removed
+    worker's termination is an expected exit (code 0, no blacklist),
+    and the survivor finishes alone."""
+    total = 60
+    discovery = FixedHostDiscovery({"localhost": 2})
+
+    def mutate():
+        time.sleep(2.0)
+        discovery.set_hosts({"localhost": 1})
+
+    codes = _run_elastic_job(
+        tmp_path, total, {"ELASTIC_SLEEP": "0.05"}, discovery,
+        max_np=2, mutate=mutate)
+    out = capfd.readouterr().out
+    results = [ln for ln in out.splitlines() if "RESULT" in ln]
+    assert sum(f"batch={total}" in ln for ln in results) >= 1, out
+    # Scale-down termination must NOT surface as a failure.
+    assert all(c == 0 for c in codes.values()), codes
+    first = os.path.join(str(tmp_path), "localhost_0.log")
+    sizes = [ln.strip().split("size=")[1] for ln in open(first)]
+    assert "2" in sizes and "1" in sizes, sizes[:10]
+
+
+def test_elastic_scale_up_mid_training(tmp_path, capfd):
+    """Discovery grows localhost:1 -> localhost:2 mid-run: the running
+    worker re-rendezvouses at the next commit, the new worker syncs
+    committed state, and both finish at size 2."""
+    total = 60
+    discovery = FixedHostDiscovery({"localhost": 1})
+
+    def mutate():
+        time.sleep(2.0)
+        discovery.set_hosts({"localhost": 2})
+
+    codes = _run_elastic_job(
+        tmp_path, total, {"ELASTIC_SLEEP": "0.05"}, discovery,
+        max_np=2, mutate=mutate)
+    out = capfd.readouterr().out
+    results = [ln for ln in out.splitlines() if "RESULT" in ln]
+    assert sum(f"batch={total}" in ln for ln in results) == 2, out
+    assert all(c == 0 for c in codes.values()), codes
+    # The original worker's log must show the size transition 1 -> 2.
+    first = os.path.join(str(tmp_path), "localhost_0.log")
+    sizes = [ln.strip().split("size=")[1] for ln in open(first)]
+    assert "1" in sizes and "2" in sizes, sizes[:10]
+    # The joiner starts from synced state, not from batch 1.
+    joiner = os.path.join(str(tmp_path), "localhost_1.log")
+    joiner_first = int(open(joiner).readline().split()[0])
+    assert joiner_first > 1, "new worker restarted from scratch"
