@@ -188,6 +188,8 @@ def reducescatter_async(tensor, op: Optional[ReduceOp] = None,
                         name: Optional[str] = None,
                         prescale_factor: float = 1.0,
                         postscale_factor: float = 1.0) -> Handle:
+    if op == ReduceOp.ADASUM:
+        raise ValueError("adasum reducescatter is not defined; use allreduce")
     rt = get_runtime()
     return rt.enqueue(basics.OP_REDUCESCATTER, tensor,
                       rt.auto_name("reducescatter", name),

@@ -1,0 +1,90 @@
+"""Adasum — scaling-insensitive gradient reduction, TPU-native.
+
+Rebuild of the reference's Adasum operator family
+(``horovod/common/ops/adasum/adasum.h:166-330``): instead of averaging,
+two gradients ``a``, ``b`` are combined with the projection rule
+
+    adasum(a, b) = (1 - a·b / (2|a|²)) · a  +  (1 - a·b / (2|b|²)) · b
+
+which keeps the update magnitude stable as the number of workers grows
+(orthogonal gradients add; identical gradients average). A world-sized
+reduction applies the rule over a binary tree of pairings — the
+reference's vector-halving distance-doubling (VHDD) is a
+bandwidth-optimal schedule of exactly that tree.
+
+Two tiers here, matching the rest of :mod:`horovod_tpu.ops`:
+
+* :func:`adasum_allreduce` — in-``jit`` SPMD under ``shard_map``: XOR
+  distance-doubling with ``lax.ppermute`` full-vector exchanges. Each
+  of the log2(P) rounds both partners compute the identical symmetric
+  combine, so no broadcast leg is needed. Dot products and norms are
+  accumulated per tensor (per pytree leaf) in f32 — the per-tensor
+  weighting of the reference (``adasum.h:101-122``), with XLA fusing
+  the elementwise work into the exchange.
+* The eager named-tensor path executes Adasum in the native core
+  (``native/src/ops.cc AdasumAllreduce``) with f64 host accumulation;
+  ``hvd.allreduce(t, op=hvd.Adasum)`` routes there.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.ops.collectives import AxisName, axis_size
+
+
+def adasum_combine(a, b):
+    """Combine two same-shaped tensors with the Adasum projection rule.
+
+    Zero-norm operands pass the other side through unchanged (the
+    reference guards the same division, ``adasum.h:258-266``). Math runs
+    in f32 (f64 when jax x64 is enabled and inputs are f64); the result
+    is cast back to the input dtype.
+    """
+    if not (jnp.issubdtype(a.dtype, jnp.inexact) and
+            jnp.issubdtype(b.dtype, jnp.inexact)):
+        raise TypeError(f"adasum is defined for float dtypes, got {a.dtype}")
+    acc = jnp.promote_types(a.dtype, jnp.float32)
+    af, bf = a.astype(acc), b.astype(acc)
+    dot = jnp.vdot(af, bf)
+    na2 = jnp.vdot(af, af)
+    nb2 = jnp.vdot(bf, bf)
+    ac = jnp.where(na2 > 0, 1.0 - dot / (2.0 * jnp.where(na2 > 0, na2, 1.0)),
+                   1.0)
+    bc = jnp.where(nb2 > 0, 1.0 - dot / (2.0 * jnp.where(nb2 > 0, nb2, 1.0)),
+                   1.0)
+    return (ac * af + bc * bf).astype(a.dtype)
+
+
+def adasum_allreduce(tree: Any, axis_name: AxisName = "dp"):
+    """Adasum-allreduce a pytree across ``axis_name`` inside
+    ``shard_map``/``pjit``.
+
+    The axis size must be a power of two (the natural shape of the
+    distance-doubling tree; the eager tier handles ragged world sizes
+    with a fold step). Per-tensor weighting: each leaf gets its own
+    dot/norm coefficients per round, exactly like the reference's
+    per-layer Adasum.
+    """
+    n = axis_size(axis_name)
+    if n & (n - 1):
+        raise ValueError(
+            f"adasum_allreduce needs a power-of-two axis size, got {n} "
+            f"(use the eager hvd.allreduce(op=Adasum) path for ragged "
+            f"world sizes)")
+    d = 1
+    while d < n:
+        perm = [(i, i ^ d) for i in range(n)]
+        theirs = jax.tree.map(
+            lambda x: lax.ppermute(x, axis_name, perm=perm), tree)
+        tree = jax.tree.map(adasum_combine, tree, theirs)
+        d *= 2
+    # Every shard now holds the identical result, but ppermute outputs
+    # are device-varying to the type system; a pmax over equal values
+    # re-establishes the replicated type (same trick as PRODUCT in
+    # collectives.py) so callers can use out_specs=P().
+    return jax.tree.map(lambda x: lax.pmax(x, axis_name), tree)

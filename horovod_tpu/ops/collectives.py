@@ -68,10 +68,10 @@ def allreduce(x, op: ReduceOp = Average, axis_name: AxisName = "dp", *,
     ``Average`` divides by the axis size after summation.
     """
     x = _scale(x, prescale_factor)
-    if op in (ReduceOp.AVERAGE, ReduceOp.SUM, ReduceOp.ADASUM):
-        # Adasum falls back to SUM here: the scaling-insensitive VHDD
-        # variant needs per-tensor dot products and lives in
-        # horovod_tpu.ops.adasum.
+    if op == ReduceOp.ADASUM:
+        from horovod_tpu.ops.adasum import adasum_allreduce
+        y = adasum_allreduce(x, axis_name)
+    elif op in (ReduceOp.AVERAGE, ReduceOp.SUM):
         y = lax.psum(x, axis_name)
         if op == ReduceOp.AVERAGE:
             y = _scale(y, 1.0 / axis_size(axis_name))
@@ -101,7 +101,12 @@ def grouped_allreduce(xs, op: ReduceOp = Average, axis_name: AxisName = "dp", *,
     one fused buffer — the moral equivalent of the reference's fusion
     buffer without the explicit memcpy kernels.
     """
-    if op in (ReduceOp.AVERAGE, ReduceOp.SUM, ReduceOp.ADASUM):
+    if op == ReduceOp.ADASUM:
+        from horovod_tpu.ops.adasum import adasum_allreduce
+        xs = jax.tree.map(lambda l: _scale(l, prescale_factor), xs)
+        reduced = adasum_allreduce(xs, axis_name)
+        return jax.tree.map(lambda l: _scale(l, postscale_factor), reduced)
+    if op in (ReduceOp.AVERAGE, ReduceOp.SUM):
         leaves, treedef = jax.tree.flatten(xs)
         leaves = [_scale(l, prescale_factor) for l in leaves]
         reduced = lax.psum(tuple(leaves), axis_name)

@@ -185,7 +185,7 @@ def _reduce_over_ranks(op: ReduceOp, arr):
     programs (axis 0 is the mesh-sharded rank axis)."""
     import jax.numpy as jnp
 
-    if op in (ReduceOp.AVERAGE, ReduceOp.SUM, ReduceOp.ADASUM):
+    if op in (ReduceOp.AVERAGE, ReduceOp.SUM):
         return jnp.sum(arr, axis=0)
     if op == ReduceOp.MIN:
         return jnp.min(arr, axis=0)
@@ -193,15 +193,53 @@ def _reduce_over_ranks(op: ReduceOp, arr):
         return jnp.max(arr, axis=0)
     if op == ReduceOp.PRODUCT:
         return jnp.prod(arr, axis=0)
+    if op == ReduceOp.ADASUM:
+        raise ValueError("adasum reducescatter is not defined; use allreduce")
     raise ValueError(f"unknown reduce op {op!r}")
 
 
+def _adasum_tree(arr, spans: Tuple[int, ...]):
+    """Adasum over the rank axis of a (size, total) batch: zero-pad
+    ranks to a power of two (a zero operand passes its partner through
+    unchanged) and fold consecutive pairs — the same binary operator
+    tree as the native core's distance-doubling (ops.cc
+    AdasumAllreduce), with dot/norm coefficients PER fused segment
+    (per-tensor weighting, reference adasum.h:101-122)."""
+    import jax.numpy as jnp
+
+    acc = jnp.promote_types(arr.dtype, jnp.float32)
+    offs = np.concatenate([[0], np.cumsum(spans)])
+    m = arr.shape[0]
+    pow2 = 1 << max(0, int(m - 1).bit_length())
+    if pow2 != m:
+        arr = jnp.pad(arr, [(0, pow2 - m)] + [(0, 0)] * (arr.ndim - 1))
+    x = arr.astype(acc)
+    while x.shape[0] > 1:
+        a, b = x[0::2], x[1::2]
+        segs = []
+        for i in range(len(spans)):
+            sa, sb = a[:, offs[i]:offs[i + 1]], b[:, offs[i]:offs[i + 1]]
+            dot = jnp.sum(sa * sb, axis=1, keepdims=True)
+            na2 = jnp.sum(sa * sa, axis=1, keepdims=True)
+            nb2 = jnp.sum(sb * sb, axis=1, keepdims=True)
+            ac = jnp.where(na2 > 0,
+                           1.0 - dot / (2.0 * jnp.where(na2 > 0, na2, 1.0)),
+                           1.0)
+            bc = jnp.where(nb2 > 0,
+                           1.0 - dot / (2.0 * jnp.where(nb2 > 0, nb2, 1.0)),
+                           1.0)
+            segs.append(ac * sa + bc * sb)
+        x = jnp.concatenate(segs, axis=1)
+    return x[0].astype(arr.dtype)
+
+
 def _op_class(op: ReduceOp) -> ReduceOp:
-    """Program-identity class: AVERAGE/ADASUM fold into SUM (averaging
-    rides the traced factor vector), mirroring the controller's fusion
-    classes so every rank — including joined ranks that only know the
-    response-level op — derives the identical program key."""
-    if op in (ReduceOp.AVERAGE, ReduceOp.ADASUM):
+    """Program-identity class: AVERAGE folds into SUM (averaging rides
+    the traced factor vector), mirroring the controller's fusion classes
+    so every rank — including joined ranks that only know the
+    response-level op — derives the identical program key. ADASUM stays
+    distinct: its program body differs."""
+    if op == ReduceOp.AVERAGE:
         return ReduceOp.SUM
     return op
 
@@ -223,7 +261,10 @@ def _allreduce_prog(op: ReduceOp, spans: Tuple[int, ...], inexact: bool):
     repeats = np.asarray(spans)
 
     def fn(arr, factors):
-        y = _reduce_over_ranks(op, arr)
+        if op == ReduceOp.ADASUM:
+            y = _adasum_tree(arr, spans)
+        else:
+            y = _reduce_over_ranks(op, arr)
         if inexact:
             y = _apply_factor(y, jnp.repeat(factors, repeats,
                                             total_repeat_length=int(
@@ -250,6 +291,9 @@ def _dist_allreduce(states, size: int):
     arr = _make_global(local, size)
     inexact = np.dtype(local.dtype).kind == "f" or \
         np.dtype(local.dtype).name == "bfloat16"
+    if states[0].reduce_op == ReduceOp.ADASUM and not inexact:
+        raise TypeError(
+            f"adasum requires a float dtype, got {local.dtype}")
     # numpy f64 in, silent downcast to f32 unless x64 is enabled — same
     # policy as _factor_scalar.
     y = _allreduce_prog(_op_class(states[0].reduce_op), spans, inexact)(

@@ -92,10 +92,17 @@ class Controller {
   int size_;
   ControllerDeps deps_;
   int64_t fusion_threshold_bytes_ = 64 * 1024 * 1024;
+  // Host data plane: payloads at/above this use ring allreduce, below
+  // it recursive doubling. The CHOICE must agree on every rank (mixed
+  // algorithms deadlock), so TcpController::Initialize syncs rank 0's
+  // value to all workers — env divergence cannot split the job.
+  int64_t ring_threshold_bytes_ = 64 * 1024;
 
  public:
   void SetFusionThreshold(int64_t bytes) { fusion_threshold_bytes_ = bytes; }
   int64_t fusion_threshold() const { return fusion_threshold_bytes_; }
+  void SetRingThreshold(int64_t bytes) { ring_threshold_bytes_ = bytes; }
+  int64_t ring_threshold() const { return ring_threshold_bytes_; }
 };
 
 class LocalController : public Controller {
@@ -123,10 +130,21 @@ class TcpController : public Controller {
   // Split drained queue messages into cache hits vs. full requests.
   RequestList BuildRequestList(bool shutdown, bool* saw_join);
 
+  // Worker↔worker mesh bootstrap: every worker opens an ephemeral-port
+  // server, addresses are gathered/broadcast through the rank-0 control
+  // links, then higher ranks dial lower ranks (channel 2). Rank 0's
+  // star data links double as its mesh edges. The full mesh is what
+  // lets the data plane run ring / recursive-doubling algorithms
+  // instead of serializing through a rank-0 hub (the reference gets the
+  // same from gloo's full-mesh TCP, horovod/common/gloo/).
+  Status InitializeMesh(int timeout_ms);
+
   std::string addr_;
   TcpServer server_;                 // rank 0
+  TcpServer mesh_server_;            // workers: peer-mesh listener
   std::vector<TcpConn> ctrl_conns_;  // rank 0: by rank; worker: [0]
   std::vector<TcpConn> data_conns_;
+  std::vector<TcpConn> mesh_conns_;  // workers: by peer rank (>=1)
   std::map<std::string, PendingTensor> table_;  // rank 0
   std::vector<bool> joined_ranks_;              // rank 0
   bool i_am_joined_ = false;

@@ -7,13 +7,18 @@
 //  * LocalOps  — size==1 semantics (copy input -> output), the analog
 //    of running Horovod without mpirun.
 //  * TcpOps    — multi-process host tensors: pack into the fusion
-//    buffer, reduce through rank 0 over the data-plane sockets
-//    (hub topology v1; the CPU-fallback Gloo analog).
+//    buffer and run bandwidth-scaling algorithms over the full TCP
+//    peer mesh (ring allreduce / reduce-scatter / allgather,
+//    recursive-doubling for latency-bound payloads, binomial-tree
+//    broadcast, pairwise alltoall, and Adasum's recursive
+//    distance-doubling) — the CPU Gloo-analog, minus the rank-0 hub
+//    that serialized v1.
 //  * The CALLBACK path (device tensors / XLA) is dispatched in
 //    operations.cc to the registered Python executor, which launches
 //    jitted XLA collectives over the TPU mesh — the NCCL-ops analog.
 #pragma once
 
+#include <functional>
 #include <vector>
 
 #include "hvd/common.h"
@@ -51,7 +56,8 @@ class LocalOps : public OpExecutor {
 
 class TcpOps : public OpExecutor {
  public:
-  using OpExecutor::OpExecutor;
+  TcpOps(Controller* controller, FusionBufferManager* fusion,
+         Timeline* timeline);
   Status Execute(const Response& response,
                  std::vector<TensorTableEntry>& entries) override;
 
@@ -62,6 +68,26 @@ class TcpOps : public OpExecutor {
   Status Alltoall(const Response& r, std::vector<TensorTableEntry>& entries);
   Status Reducescatter(const Response& r,
                        std::vector<TensorTableEntry>& entries);
+
+  // Allreduce algorithms over the contributor set `ranks` (my position
+  // is `p`). All operate in place on the packed fusion buffer.
+  Status RingAllreduce(uint8_t* buf, int64_t elems, DataType dtype,
+                       ReduceOp op, const std::vector<int>& ranks, int p);
+  // Distance-doubling driver (fold/unfold for ragged P); `combine`
+  // folds a partner buffer into `buf` and must be symmetric.
+  Status DoublingExchange(uint8_t* buf, int64_t bytes,
+                          const std::vector<int>& ranks, int p,
+                          const std::function<Status(const uint8_t*)>& combine);
+  Status RecursiveDoubling(uint8_t* buf, int64_t elems, DataType dtype,
+                           ReduceOp op, const std::vector<int>& ranks, int p);
+  // Adasum recursive distance-doubling with per-tensor dot/norm
+  // weighting (reference ops/adasum/adasum.h:166-330). `tensor_elems`
+  // gives each fused tensor's element extent inside the buffer.
+  Status AdasumAllreduce(uint8_t* buf, DataType dtype,
+                         const std::vector<int64_t>& tensor_elems,
+                         const std::vector<int>& ranks, int p);
+
+  int64_t ring_threshold_bytes_;  // below: recursive doubling
 };
 
 // Accumulate src into dst elementwise on the host ("SUM"/"MIN"/...),

@@ -5,6 +5,7 @@
 // all traffic is length-prefixed frames.
 #pragma once
 
+#include <chrono>
 #include <cstdint>
 #include <string>
 #include <vector>
@@ -32,10 +33,23 @@ class TcpConn {
   // Raw exact-count IO for the data plane (no extra copy into a frame).
   bool SendAll(const void* data, uint64_t len);
   bool RecvAll(void* data, uint64_t len);
+  // Local IP of this connection (the address peers can reach us on when
+  // we share a network with them). Empty string on failure.
+  std::string LocalIp() const;
+  // SO_RCVTIMEO in milliseconds (0 = blocking). Used during bootstrap
+  // phases so a dead peer surfaces as an error instead of a hang.
+  void SetRecvTimeout(int ms);
 
  private:
   int fd_ = -1;
 };
+
+// Full-duplex exchange: send `sbytes` to `to` while receiving `rbytes`
+// from `from` (which may be the same connection). The concurrent send
+// keeps ring/pairwise exchange steps deadlock-free even when payloads
+// exceed kernel socket buffers.
+bool SendRecv(TcpConn* to, const void* sbuf, uint64_t sbytes, TcpConn* from,
+              void* rbuf, uint64_t rbytes);
 
 // Rank-0 side: bind+listen, accept `n` peers on each of two channels
 // (0 = control plane, 1 = data plane); each peer handshakes with
@@ -47,10 +61,20 @@ class TcpServer {
   int Listen(const std::string& addr);
   bool AcceptPeers(int n, std::vector<TcpConn>* control_by_rank,
                    std::vector<TcpConn>* data_by_rank, int timeout_ms);
+  // Accept exactly `n` peer-mesh connections (channel 2). Each incoming
+  // handshake carries the dialing worker's rank, which must be >
+  // `my_rank` (lower ranks accept, higher ranks dial — a fixed
+  // direction so the mesh forms without symmetric races). Connections
+  // are stored in `out` keyed by peer rank.
+  bool AcceptMesh(int n, int my_rank, std::vector<TcpConn>* out_by_rank,
+                  int timeout_ms);
   void Close();
   ~TcpServer() { Close(); }
 
  private:
+  bool AcceptOne(std::chrono::steady_clock::time_point deadline,
+                 int32_t hello[2], TcpConn* out);
+
   int listen_fd_ = -1;
 };
 

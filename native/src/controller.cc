@@ -427,13 +427,108 @@ Status TcpController::Initialize() {
                                   addr_);
   }
   LOG_DEBUG << "rank " << rank_ << "/" << size_ << " controller connected";
+  Status st = InitializeMesh(timeout_ms);
+  if (!st.ok()) return st;
+  // Tunable sync: rank 0's thresholds win (the reference's
+  // SynchronizeParameters role, controller.cc:39-53) so per-rank env
+  // divergence can't make ranks pick different data-plane algorithms.
+  if (rank_ == 0) {
+    std::string params = std::to_string(fusion_threshold_bytes_) + ":" +
+                         std::to_string(ring_threshold_bytes_);
+    for (int peer = 1; peer < size_; ++peer) {
+      if (!ctrl_conns_[peer].SendFrame(params))
+        return Status::UnknownError("param sync: lost control link");
+    }
+  } else {
+    std::string params;
+    ctrl_conns_[0].SetRecvTimeout(timeout_ms);
+    bool ok = ctrl_conns_[0].RecvFrame(&params);
+    ctrl_conns_[0].SetRecvTimeout(0);
+    auto colon = params.find(':');
+    if (!ok || colon == std::string::npos)
+      return Status::UnknownError("param sync: lost control link");
+    fusion_threshold_bytes_ = std::atoll(params.c_str());
+    ring_threshold_bytes_ = std::atoll(params.c_str() + colon + 1);
+  }
+  return Status::OK();
+}
+
+Status TcpController::InitializeMesh(int timeout_ms) {
+  if (size_ <= 2) return Status::OK();  // star links already form the mesh
+  if (rank_ == 0) {
+    // Gather every worker's mesh address, broadcast the table. Recv
+    // timeouts bound the wait so a worker dying mid-bootstrap surfaces
+    // as an init error, not a permanent hang.
+    std::vector<std::string> addrs(size_);
+    for (int peer = 1; peer < size_; ++peer) {
+      ctrl_conns_[peer].SetRecvTimeout(timeout_ms);
+      bool ok = ctrl_conns_[peer].RecvFrame(&addrs[peer]);
+      ctrl_conns_[peer].SetRecvTimeout(0);
+      if (!ok)
+        return Status::UnknownError("mesh bootstrap: lost control link");
+    }
+    std::string table;
+    for (int peer = 1; peer < size_; ++peer) {
+      table += addrs[peer];
+      table += '\n';
+    }
+    for (int peer = 1; peer < size_; ++peer) {
+      if (!ctrl_conns_[peer].SendFrame(table))
+        return Status::UnknownError("mesh bootstrap: lost control link");
+    }
+    return Status::OK();
+  }
+  // Worker: listen on an ephemeral port; advertise the IP we reach
+  // rank 0 with (overridable for multi-NIC hosts).
+  int port = mesh_server_.Listen("0.0.0.0:0");
+  if (port < 0)
+    return Status::UnknownError("mesh bootstrap: failed to listen");
+  std::string host;
+  if (const char* h = std::getenv("HOROVOD_PEER_HOST")) host = h;
+  if (host.empty()) host = ctrl_conns_[0].LocalIp();
+  if (host.empty()) host = "127.0.0.1";
+  if (!ctrl_conns_[0].SendFrame(host + ":" + std::to_string(port)))
+    return Status::UnknownError("mesh bootstrap: lost control link");
+  std::string table;
+  ctrl_conns_[0].SetRecvTimeout(timeout_ms);
+  bool got_table = ctrl_conns_[0].RecvFrame(&table);
+  ctrl_conns_[0].SetRecvTimeout(0);
+  if (!got_table)
+    return Status::UnknownError("mesh bootstrap: lost control link");
+  std::vector<std::string> addrs(size_);
+  {
+    size_t pos = 0;
+    for (int peer = 1; peer < size_; ++peer) {
+      size_t nl = table.find('\n', pos);
+      if (nl == std::string::npos)
+        return Status::UnknownError("mesh bootstrap: short address table");
+      addrs[peer] = table.substr(pos, nl - pos);
+      pos = nl + 1;
+    }
+  }
+  // Every server is listening before its address reaches the table, so
+  // dialing lower ranks cannot race their accept loop (the kernel
+  // backlog holds the connection until AcceptMesh runs).
+  mesh_conns_.clear();
+  mesh_conns_.resize(size_);
+  for (int peer = 1; peer < rank_; ++peer) {
+    if (!TcpConnect(addrs[peer], rank_, 2, timeout_ms, &mesh_conns_[peer]))
+      return Status::UnknownError("mesh bootstrap: failed to reach rank " +
+                                  std::to_string(peer) + " at " + addrs[peer]);
+  }
+  if (!mesh_server_.AcceptMesh(size_ - 1 - rank_, rank_, &mesh_conns_,
+                               timeout_ms))
+    return Status::UnknownError("mesh bootstrap: timed out accepting peers");
+  mesh_server_.Close();
+  LOG_DEBUG << "rank " << rank_ << " peer mesh up (" << size_ - 2 << " links)";
   return Status::OK();
 }
 
 TcpConn* TcpController::DataConn(int peer_rank) {
   if (size_ == 1) return nullptr;
   if (rank_ == 0) return &data_conns_[peer_rank];
-  return &data_conns_[0];
+  if (peer_rank == 0) return &data_conns_[0];
+  return &mesh_conns_[peer_rank];
 }
 
 RequestList TcpController::BuildRequestList(bool shutdown, bool* saw_join) {

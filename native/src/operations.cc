@@ -394,6 +394,8 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   }
   st.controller->SetFusionThreshold(
       hvd::EnvInt64("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024));
+  st.controller->SetRingThreshold(
+      hvd::EnvInt64("HOROVOD_RING_THRESHOLD", 64 * 1024));
   hvd::Status s = st.controller->Initialize();
   if (!s.ok()) {
     LOG_ERROR << "controller init failed: " << s.reason();

@@ -1,7 +1,9 @@
 #include "hvd/ops.h"
 
 #include <algorithm>
+#include <cstdlib>
 #include <cstring>
+#include <vector>
 
 #include "hvd/half.h"
 #include "hvd/logging.h"
@@ -168,8 +170,155 @@ Status LocalOps::Execute(const Response& response,
 }
 
 // ---------------------------------------------------------------------------
-// TcpOps: hub-topology host collectives through rank 0.
+// Adasum host math: per-tensor dot products / squared norms accumulated
+// in f64 (reference DispatchComputeDotAndNormSqrds, adasum.h:101-122)
+// and the scaling-insensitive combine
+//   result = (1 - dot/(2·|a|²))·a + (1 - dot/(2·|b|²))·b
 // ---------------------------------------------------------------------------
+
+namespace {
+
+template <typename T>
+void DotNormsTyped(const T* a, const T* b, int64_t n, double* dot, double* na2,
+                   double* nb2) {
+  double d = 0, x = 0, y = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    double ai = static_cast<double>(a[i]), bi = static_cast<double>(b[i]);
+    d += ai * bi;
+    x += ai * ai;
+    y += bi * bi;
+  }
+  *dot = d;
+  *na2 = x;
+  *nb2 = y;
+}
+
+template <typename T>
+void CombineTyped(T* a, const T* b, int64_t n, double ac, double bc) {
+  for (int64_t i = 0; i < n; ++i)
+    a[i] = static_cast<T>(ac * static_cast<double>(a[i]) +
+                          bc * static_cast<double>(b[i]));
+}
+
+template <float (*ToF)(uint16_t), uint16_t (*FromF)(float)>
+void DotNorms16(const uint16_t* a, const uint16_t* b, int64_t n, double* dot,
+                double* na2, double* nb2) {
+  double d = 0, x = 0, y = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    double ai = ToF(a[i]), bi = ToF(b[i]);
+    d += ai * bi;
+    x += ai * ai;
+    y += bi * bi;
+  }
+  *dot = d;
+  *na2 = x;
+  *nb2 = y;
+}
+
+template <float (*ToF)(uint16_t), uint16_t (*FromF)(float)>
+void Combine16(uint16_t* a, const uint16_t* b, int64_t n, double ac,
+               double bc) {
+  for (int64_t i = 0; i < n; ++i)
+    a[i] = FromF(static_cast<float>(ac * ToF(a[i]) + bc * ToF(b[i])));
+}
+
+bool AdasumDotNorms(DataType dtype, const void* a, const void* b, int64_t n,
+                    double* dot, double* na2, double* nb2) {
+  switch (dtype) {
+    case DataType::FLOAT32:
+      DotNormsTyped(static_cast<const float*>(a), static_cast<const float*>(b),
+                    n, dot, na2, nb2);
+      return true;
+    case DataType::FLOAT64:
+      DotNormsTyped(static_cast<const double*>(a),
+                    static_cast<const double*>(b), n, dot, na2, nb2);
+      return true;
+    case DataType::FLOAT16:
+      DotNorms16<HalfBits2Float, Float2HalfBits>(
+          static_cast<const uint16_t*>(a), static_cast<const uint16_t*>(b), n,
+          dot, na2, nb2);
+      return true;
+    case DataType::BFLOAT16:
+      DotNorms16<BFloat2Float, Float2BFloat>(static_cast<const uint16_t*>(a),
+                                             static_cast<const uint16_t*>(b),
+                                             n, dot, na2, nb2);
+      return true;
+    default:
+      return false;  // Adasum is a float-only reduction
+  }
+}
+
+void AdasumCombineBuffers(DataType dtype, void* a, const void* b, int64_t n,
+                          double ac, double bc) {
+  switch (dtype) {
+    case DataType::FLOAT32:
+      CombineTyped(static_cast<float*>(a), static_cast<const float*>(b), n, ac,
+                   bc);
+      break;
+    case DataType::FLOAT64:
+      CombineTyped(static_cast<double*>(a), static_cast<const double*>(b), n,
+                   ac, bc);
+      break;
+    case DataType::FLOAT16:
+      Combine16<HalfBits2Float, Float2HalfBits>(
+          static_cast<uint16_t*>(a), static_cast<const uint16_t*>(b), n, ac,
+          bc);
+      break;
+    case DataType::BFLOAT16:
+      Combine16<BFloat2Float, Float2BFloat>(static_cast<uint16_t*>(a),
+                                            static_cast<const uint16_t*>(b), n,
+                                            ac, bc);
+      break;
+    default:
+      break;
+  }
+}
+
+// Combine `theirs` into `mine` per tensor: mine := adasum(mine, theirs).
+bool AdasumCombineTensors(DataType dtype, uint8_t* mine, const uint8_t* theirs,
+                          const std::vector<int64_t>& tensor_elems) {
+  const int64_t esize = DataTypeSize(dtype);
+  int64_t off = 0;
+  for (int64_t n : tensor_elems) {
+    double dot, na2, nb2;
+    if (!AdasumDotNorms(dtype, mine + off, theirs + off, n, &dot, &na2, &nb2))
+      return false;
+    // A zero-norm operand contributes nothing to the projection; its
+    // coefficient stays 1 so the other side passes through (reference
+    // guards the same division, adasum.h:258-266).
+    double ac = na2 > 0 ? 1.0 - dot / (2.0 * na2) : 1.0;
+    double bc = nb2 > 0 ? 1.0 - dot / (2.0 * nb2) : 1.0;
+    AdasumCombineBuffers(dtype, mine + off, theirs + off, n, ac, bc);
+    off += n * esize;
+  }
+  return true;
+}
+
+// Element split of a fused buffer into `parts` contiguous chunks:
+// chunk k covers elements [offs[k], offs[k+1]).
+std::vector<int64_t> ChunkOffsets(int64_t elems, int parts) {
+  std::vector<int64_t> offs(parts + 1, 0);
+  int64_t base = elems / parts, rem = elems % parts;
+  for (int k = 0; k < parts; ++k)
+    offs[k + 1] = offs[k] + base + (k < rem ? 1 : 0);
+  return offs;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// TcpOps: peer-mesh host collectives (ring / recursive-doubling /
+// binomial-tree / pairwise), replacing the v1 rank-0 hub that
+// serialized O(size · bytes) through one socket.
+// ---------------------------------------------------------------------------
+
+TcpOps::TcpOps(Controller* controller, FusionBufferManager* fusion,
+               Timeline* timeline)
+    : OpExecutor(controller, fusion, timeline) {
+  // Post-sync value: rank 0's HOROVOD_RING_THRESHOLD for every rank
+  // (a per-rank algorithm choice would deadlock the exchange).
+  ring_threshold_bytes_ = controller->ring_threshold();
+}
 
 Status TcpOps::Execute(const Response& response,
                        std::vector<TensorTableEntry>& entries) {
@@ -201,76 +350,62 @@ Status TcpOps::Allreduce(const Response& r,
   // coordinator's announcer list at fire time) — NOT the local joined
   // flags: a rank that announced and then joined still contributes its
   // real data, and only the coordinator's view of join state is
-  // authoritative anyway. A non-contributing rank 0 still serves as
-  // the hub — sizes come from the response metadata, not the entries.
-  auto contributes = [&](int rk) {
-    if (r.contributors.empty()) return true;  // legacy/local path: everyone
-    return std::find(r.contributors.begin(), r.contributors.end(), rk) !=
-           r.contributors.end();
-  };
+  // authoritative anyway.
+  std::vector<int> ranks;
+  if (r.contributors.empty()) {
+    for (int k = 0; k < size; ++k) ranks.push_back(k);
+  } else {
+    ranks.assign(r.contributors.begin(), r.contributors.end());
+    std::sort(ranks.begin(), ranks.end());
+  }
+  const auto me = std::find(ranks.begin(), ranks.end(), rank);
+  // Non-contributors (joined ranks) neither feed data nor need output;
+  // the reduction runs entirely among contributors — no hub role.
+  if (me == ranks.end() || entries.empty()) return Status::OK();
+  const int p = static_cast<int>(me - ranks.begin());
+
   const DataType dtype = r.tensor_type;
   int64_t total_elems = 0;
-  for (auto n : r.tensor_sizes) total_elems += n;
+  std::vector<int64_t> tensor_elems;
+  for (auto& e : entries) {
+    tensor_elems.push_back(e.shape.num_elements());
+    total_elems += tensor_elems.back();
+  }
   const int64_t total_bytes = total_elems * DataTypeSize(dtype);
-  const bool i_participate = contributes(rank) && !entries.empty();
-  if (!i_participate && rank != 0) return Status::OK();
-
-  const std::string tname =
-      entries.empty() ? r.tensor_names.front() : entries.front().name;
+  const std::string tname = entries.front().name;
   uint8_t* buf = static_cast<uint8_t*>(fusion_->GetBuffer(0, total_bytes));
 
-  if (i_participate) {
-    // Pack into the fusion buffer, applying prescale.
-    if (timeline_)
-      timeline_->ActivityStart(tname, ACT_MEMCPY_IN_FUSION_BUFFER);
-    int64_t off = 0;
-    for (auto& e : entries) {
-      int64_t bytes = e.shape.num_elements() * DataTypeSize(e.dtype);
-      std::memcpy(buf + off, e.data, bytes);
-      if (e.prescale_factor != 1.0)
-        HostScale(e.dtype, buf + off, e.shape.num_elements(),
-                  e.prescale_factor);
-      off += bytes;
-    }
-    if (timeline_) timeline_->ActivityEnd(tname);
-  }
-
-  if (timeline_) timeline_->ActivityStart(tname, ACT_TCP_ALLREDUCE);
-  const ReduceOp op = r.reduce_op;
-  const int64_t count = total_elems;
-  if (rank == 0) {
-    // Accumulate every participant's buffer (own packed data is the
-    // initial value when participating, else the first received
-    // buffer), then send the result back to all participants.
-    bool have_initial = i_participate;
-    std::vector<uint8_t> scratch(total_bytes);
-    for (int peer = 1; peer < size; ++peer) {
-      if (!contributes(peer)) continue;
-      uint8_t* dst = have_initial ? scratch.data() : buf;
-      if (!controller_->DataConn(peer)->RecvAll(dst, total_bytes))
-        return Status::UnknownError("allreduce: lost data connection");
-      if (have_initial) {
-        HostAccumulate(op, dtype, scratch.data(), buf, count);
-      } else {
-        have_initial = true;
-      }
-    }
-    for (int peer = 1; peer < size; ++peer) {
-      if (!contributes(peer)) continue;
-      if (!controller_->DataConn(peer)->SendAll(buf, total_bytes))
-        return Status::UnknownError("allreduce: lost data connection");
-    }
-  } else {
-    if (!controller_->DataConn(0)->SendAll(buf, total_bytes) ||
-        !controller_->DataConn(0)->RecvAll(buf, total_bytes))
-      return Status::UnknownError("allreduce: lost data connection");
+  // Pack into the fusion buffer, applying prescale.
+  if (timeline_) timeline_->ActivityStart(tname, ACT_MEMCPY_IN_FUSION_BUFFER);
+  int64_t off = 0;
+  for (auto& e : entries) {
+    int64_t bytes = e.shape.num_elements() * DataTypeSize(e.dtype);
+    std::memcpy(buf + off, e.data, bytes);
+    if (e.prescale_factor != 1.0)
+      HostScale(e.dtype, buf + off, e.shape.num_elements(), e.prescale_factor);
+    off += bytes;
   }
   if (timeline_) timeline_->ActivityEnd(tname);
+
+  if (timeline_) timeline_->ActivityStart(tname, ACT_TCP_ALLREDUCE);
+  Status st = Status::OK();
+  if (ranks.size() > 1) {
+    if (r.reduce_op == ReduceOp::ADASUM) {
+      st = AdasumAllreduce(buf, dtype, tensor_elems, ranks, p);
+    } else if (total_bytes >= ring_threshold_bytes_ &&
+               static_cast<int>(ranks.size()) >= 3) {
+      st = RingAllreduce(buf, total_elems, dtype, r.reduce_op, ranks, p);
+    } else {
+      st = RecursiveDoubling(buf, total_elems, dtype, r.reduce_op, ranks, p);
+    }
+  }
+  if (timeline_) timeline_->ActivityEnd(tname);
+  if (!st.ok()) return st;
 
   // Unpack with postscale (+ 1/size for AVERAGE; joined ranks count as
   // zero contributions, matching the reference's Join semantics).
   if (timeline_) timeline_->ActivityStart(tname, ACT_MEMCPY_OUT_FUSION_BUFFER);
-  int64_t off = 0;
+  off = 0;
   for (auto& e : entries) {
     int64_t n = e.shape.num_elements();
     int64_t bytes = n * DataTypeSize(e.dtype);
@@ -286,6 +421,141 @@ Status TcpOps::Allreduce(const Response& r,
   return Status::OK();
 }
 
+Status TcpOps::RingAllreduce(uint8_t* buf, int64_t elems, DataType dtype,
+                             ReduceOp op, const std::vector<int>& ranks,
+                             int p) {
+  // Bandwidth-optimal ring: P-1 reduce-scatter steps + P-1 allgather
+  // steps, each moving 1/P of the payload — 2·(P-1)/P · bytes per rank
+  // total, vs. 2·bytes through one socket in the v1 hub. Chunk k covers
+  // elements [offs[k], offs[k+1]); chunk k starts at rank k+1 and ends
+  // fully reduced on rank k after P-1 hops.
+  const int P = static_cast<int>(ranks.size());
+  const int64_t esize = DataTypeSize(dtype);
+  auto offs = ChunkOffsets(elems, P);
+  TcpConn* next = controller_->DataConn(ranks[(p + 1) % P]);
+  TcpConn* prev = controller_->DataConn(ranks[(p - 1 + P) % P]);
+  const int64_t max_chunk = offs[1] - offs[0];
+  std::vector<uint8_t> scratch(max_chunk * esize);
+
+  auto chunk_of = [&](int step, int shift) {
+    return ((p - step - shift) % P + P) % P;
+  };
+  // Reduce-scatter phase.
+  for (int s = 0; s < P - 1; ++s) {
+    int cs = chunk_of(s, 1), cr = chunk_of(s, 2);
+    int64_t sbytes = (offs[cs + 1] - offs[cs]) * esize;
+    int64_t rbytes = (offs[cr + 1] - offs[cr]) * esize;
+    if (!SendRecv(next, buf + offs[cs] * esize, sbytes, prev, scratch.data(),
+                  rbytes))
+      return Status::UnknownError("ring allreduce: lost data connection");
+    HostAccumulate(op, dtype, scratch.data(), buf + offs[cr] * esize,
+                   offs[cr + 1] - offs[cr]);
+  }
+  // Allgather phase: rank p now owns fully-reduced chunk p.
+  for (int s = 0; s < P - 1; ++s) {
+    int cs = chunk_of(s, 0), cr = chunk_of(s, 1);
+    int64_t sbytes = (offs[cs + 1] - offs[cs]) * esize;
+    int64_t rbytes = (offs[cr + 1] - offs[cr]) * esize;
+    if (!SendRecv(next, buf + offs[cs] * esize, sbytes, prev,
+                  buf + offs[cr] * esize, rbytes))
+      return Status::UnknownError("ring allreduce: lost data connection");
+  }
+  return Status::OK();
+}
+
+Status TcpOps::DoublingExchange(
+    uint8_t* buf, int64_t bytes, const std::vector<int>& ranks, int p,
+    const std::function<Status(const uint8_t*)>& combine) {
+  // Shared scaffolding for full-buffer recursive distance-doubling:
+  // log2(P) exchanges with partners at doubling distances, `combine`
+  // folding the partner's buffer into ours. Non-power-of-two counts use
+  // the standard fold: the first 2·t ranks (t = P − q) pair up, odds
+  // fold into evens, the q survivors run the doubling rounds, and
+  // results unfold back to the odds. `combine` must be symmetric
+  // (combine(a,b) == combine(b,a)) so both partners agree without a
+  // return leg.
+  const int P = static_cast<int>(ranks.size());
+  int q = 1;
+  while (q * 2 <= P) q *= 2;
+  const int t = P - q;
+  std::vector<uint8_t> scratch(bytes);
+
+  int v;  // my index within the q-member core
+  if (p < 2 * t) {
+    if (p % 2 == 1) {
+      // Odd member of a fold pair: contribute, then wait for the result.
+      if (!controller_->DataConn(ranks[p - 1])->SendAll(buf, bytes) ||
+          !controller_->DataConn(ranks[p - 1])->RecvAll(buf, bytes))
+        return Status::UnknownError("allreduce fold: lost data connection");
+      return Status::OK();
+    }
+    if (!controller_->DataConn(ranks[p + 1])->RecvAll(scratch.data(), bytes))
+      return Status::UnknownError("allreduce fold: lost data connection");
+    Status st = combine(scratch.data());
+    if (!st.ok()) return st;
+    v = p / 2;
+  } else {
+    v = p - t;
+  }
+  // Core index v maps back to contributor position: v < t → 2v, else v+t.
+  auto pos_of = [&](int vi) { return vi < t ? 2 * vi : vi + t; };
+  for (int d = 1; d < q; d *= 2) {
+    int partner = pos_of(v ^ d);
+    TcpConn* conn = controller_->DataConn(ranks[partner]);
+    if (!SendRecv(conn, buf, bytes, conn, scratch.data(), bytes))
+      return Status::UnknownError("allreduce: lost data connection");
+    Status st = combine(scratch.data());
+    if (!st.ok()) return st;
+  }
+  if (p < 2 * t) {
+    if (!controller_->DataConn(ranks[p + 1])->SendAll(buf, bytes))
+      return Status::UnknownError("allreduce unfold: lost data connection");
+  }
+  return Status::OK();
+}
+
+Status TcpOps::RecursiveDoubling(uint8_t* buf, int64_t elems, DataType dtype,
+                                 ReduceOp op, const std::vector<int>& ranks,
+                                 int p) {
+  // Latency-optimal path for small payloads.
+  return DoublingExchange(
+      buf, elems * DataTypeSize(dtype), ranks, p,
+      [&](const uint8_t* theirs) {
+        HostAccumulate(op, dtype, theirs, buf, elems);
+        return Status::OK();
+      });
+}
+
+Status TcpOps::AdasumAllreduce(uint8_t* buf, DataType dtype,
+                               const std::vector<int64_t>& tensor_elems,
+                               const std::vector<int>& ranks, int p) {
+  // Scaling-insensitive reduction (reference ops/adasum/adasum.h:166):
+  // recursive distance-doubling where each pairing combines the two
+  // aggregate gradients a, b as
+  //     (1 - a·b/(2|a|²))·a + (1 - a·b/(2|b|²))·b
+  // with dot products and norms taken PER TENSOR (per fused entry) and
+  // accumulated in f64. Both partners compute the identical symmetric
+  // combine, so after log2(P) rounds all ranks agree. The reference's
+  // vector-halving (VHDD) splits buffers to halve bandwidth; on the
+  // host plane we trade that for the simpler full-exchange recursion —
+  // same operator tree, same numerics.
+  // Validate BEFORE any traffic: a mid-algorithm failure on one rank
+  // would leave its partners blocked in RecvAll (every rank must fail
+  // or proceed uniformly).
+  if (dtype != DataType::FLOAT32 && dtype != DataType::FLOAT64 &&
+      dtype != DataType::FLOAT16 && dtype != DataType::BFLOAT16)
+    return Status::PreconditionError("adasum requires a float dtype");
+  int64_t elems = 0;
+  for (auto n : tensor_elems) elems += n;
+  return DoublingExchange(
+      buf, elems * DataTypeSize(dtype), ranks, p,
+      [&](const uint8_t* theirs) {
+        if (!AdasumCombineTensors(dtype, buf, theirs, tensor_elems))
+          return Status::PreconditionError("adasum requires a float dtype");
+        return Status::OK();
+      });
+}
+
 Status TcpOps::Allgather(const Response& r,
                          std::vector<TensorTableEntry>& entries) {
   const int rank = controller_->rank();
@@ -295,33 +565,26 @@ Status TcpOps::Allgather(const Response& r,
   if (timeline_) timeline_->ActivityStart(e.name, ACT_TCP_ALLGATHER);
   int64_t row_bytes = DataTypeSize(e.dtype);
   for (int d = 1; d < e.shape.ndim(); ++d) row_bytes *= e.shape.dim_size(d);
-  int64_t my_bytes = e.shape.dim_size(0) * row_bytes;
-  int64_t total_rows = 0;
-  for (auto s : r.tensor_sizes) total_rows += s;
-  int64_t total_bytes = total_rows * row_bytes;
 
   uint8_t* out = static_cast<uint8_t*>(e.output);
   if (out == nullptr)
     return Status::PreconditionError("allgather output not allocated");
 
-  if (rank == 0) {
-    // Own shard first (rank order), then receive each peer's shard.
-    int64_t off = 0;
-    std::memcpy(out + off, e.data, my_bytes);
-    off += my_bytes;
-    for (int peer = 1; peer < size; ++peer) {
-      int64_t peer_bytes = r.tensor_sizes[peer] * row_bytes;
-      if (!controller_->DataConn(peer)->RecvAll(out + off, peer_bytes))
-        return Status::UnknownError("allgather: lost data connection");
-      off += peer_bytes;
-    }
-    for (int peer = 1; peer < size; ++peer) {
-      if (!controller_->DataConn(peer)->SendAll(out, total_bytes))
-        return Status::UnknownError("allgather: lost data connection");
-    }
-  } else {
-    if (!controller_->DataConn(0)->SendAll(e.data, my_bytes) ||
-        !controller_->DataConn(0)->RecvAll(out, total_bytes))
+  // Ring allgather over ragged shards (r.tensor_sizes = per-rank row
+  // counts): every rank writes its shard at its displacement, then
+  // P-1 steps forward the newest shard around the ring. Each rank
+  // moves total−own bytes instead of the hub's size·total.
+  std::vector<int64_t> offs(size + 1, 0);
+  for (int k = 0; k < size; ++k)
+    offs[k + 1] = offs[k] + r.tensor_sizes[k] * row_bytes;
+  std::memcpy(out + offs[rank], e.data, offs[rank + 1] - offs[rank]);
+  TcpConn* next = controller_->DataConn((rank + 1) % size);
+  TcpConn* prev = controller_->DataConn((rank - 1 + size) % size);
+  for (int s = 0; s < size - 1; ++s) {
+    int cs = ((rank - s) % size + size) % size;       // shard to forward
+    int cr = ((rank - s - 1) % size + size) % size;   // shard arriving
+    if (!SendRecv(next, out + offs[cs], offs[cs + 1] - offs[cs], prev,
+                  out + offs[cr], offs[cr + 1] - offs[cr]))
       return Status::UnknownError("allgather: lost data connection");
   }
   if (timeline_) timeline_->ActivityEnd(e.name);
@@ -338,25 +601,29 @@ Status TcpOps::Broadcast(const Response& r,
   // Output buffer: root writes its input through to output too.
   uint8_t* out = static_cast<uint8_t*>(e.output ? e.output
                                                 : const_cast<void*>(e.data));
-  if (rank == 0) {
-    if (e.root_rank == 0) {
-      std::memcpy(out, e.data, bytes);
-    } else {
-      if (!controller_->DataConn(e.root_rank)->RecvAll(out, bytes))
+  // Binomial tree rooted at root_rank: log2(size) rounds instead of the
+  // hub's size−1 serialized sends from one socket. Virtual rank 0 is
+  // the root; a node receives from vr − lowbit(vr) and forwards to
+  // vr + mask for each remaining mask below its receive bit.
+  const int vr = (rank - e.root_rank + size) % size;
+  auto real = [&](int v) { return (v + e.root_rank) % size; };
+  if (rank == e.root_rank && out != e.data) std::memcpy(out, e.data, bytes);
+  int mask = 1;
+  while (mask < size) {
+    if (vr & mask) {
+      if (!controller_->DataConn(real(vr - mask))->RecvAll(out, bytes))
+        return Status::UnknownError("broadcast: lost data connection");
+      break;
+    }
+    mask <<= 1;
+  }
+  mask >>= 1;
+  while (mask > 0) {
+    if (vr + mask < size) {
+      if (!controller_->DataConn(real(vr + mask))->SendAll(out, bytes))
         return Status::UnknownError("broadcast: lost data connection");
     }
-    for (int peer = 1; peer < size; ++peer) {
-      if (peer == e.root_rank) continue;
-      if (!controller_->DataConn(peer)->SendAll(out, bytes))
-        return Status::UnknownError("broadcast: lost data connection");
-    }
-  } else if (rank == e.root_rank) {
-    if (!controller_->DataConn(0)->SendAll(e.data, bytes))
-      return Status::UnknownError("broadcast: lost data connection");
-    if (out != e.data) std::memcpy(out, e.data, bytes);
-  } else {
-    if (!controller_->DataConn(0)->RecvAll(out, bytes))
-      return Status::UnknownError("broadcast: lost data connection");
+    mask >>= 1;
   }
   if (timeline_) timeline_->ActivityEnd(e.name);
   return Status::OK();
@@ -376,52 +643,39 @@ Status TcpOps::Alltoall(const Response& r,
     return r.recvsplits[static_cast<size_t>(r0) * size + k];
   };
   e.recvsplits.clear();
-  int64_t my_recv_rows = 0;
-  for (int k = 0; k < size; ++k) {
-    e.recvsplits.push_back(recv_rows(rank, k));
-    my_recv_rows += recv_rows(rank, k);
-  }
+  for (int k = 0; k < size; ++k) e.recvsplits.push_back(recv_rows(rank, k));
   uint8_t* out = static_cast<uint8_t*>(e.output);
   if (out == nullptr)
     return Status::PreconditionError("alltoall output not allocated");
 
-  int64_t my_send_bytes = e.shape.dim_size(0) * row_bytes;
-  if (rank == 0) {
-    // Gather all payloads, then redistribute columns.
-    std::vector<std::vector<uint8_t>> payloads(size);
-    payloads[0].assign(static_cast<const uint8_t*>(e.data),
-                       static_cast<const uint8_t*>(e.data) + my_send_bytes);
-    for (int peer = 1; peer < size; ++peer) {
-      int64_t peer_rows = 0;
-      for (int k = 0; k < size; ++k) peer_rows += recv_rows(k, peer);
-      payloads[peer].resize(peer_rows * row_bytes);
-      if (!controller_->DataConn(peer)->RecvAll(payloads[peer].data(),
-                                                payloads[peer].size()))
-        return Status::UnknownError("alltoall: lost data connection");
-    }
-    // Build each destination's output: concat over sources k of the
-    // slice destined to r0 (source k's offset = sum of its splits to
-    // ranks < r0).
-    for (int dest = 0; dest < size; ++dest) {
-      std::vector<uint8_t> outbuf;
-      for (int k = 0; k < size; ++k) {
-        int64_t src_off_rows = 0;
-        for (int d2 = 0; d2 < dest; ++d2) src_off_rows += recv_rows(d2, k);
-        int64_t nrows = recv_rows(dest, k);
-        const uint8_t* src = payloads[k].data() + src_off_rows * row_bytes;
-        outbuf.insert(outbuf.end(), src, src + nrows * row_bytes);
-      }
-      if (dest == 0) {
-        std::memcpy(out, outbuf.data(), outbuf.size());
-      } else {
-        if (!controller_->DataConn(dest)->SendAll(outbuf.data(),
-                                                  outbuf.size()))
-          return Status::UnknownError("alltoall: lost data connection");
-      }
-    }
-  } else {
-    if (!controller_->DataConn(0)->SendAll(e.data, my_send_bytes) ||
-        !controller_->DataConn(0)->RecvAll(out, my_recv_rows * row_bytes))
+  // Pairwise exchange over the peer mesh (the dense analog of
+  // MPI_Alltoallv's pairwise algorithm): at step s each rank sends its
+  // block for (rank+s) directly to that peer while receiving from
+  // (rank−s). Send offset to dest d = rows this rank routes to ranks
+  // < d; recv offset from source k = rows already due from sources < k.
+  const uint8_t* in = static_cast<const uint8_t*>(e.data);
+  auto send_off_rows = [&](int dest) {
+    int64_t o = 0;
+    for (int d2 = 0; d2 < dest; ++d2) o += recv_rows(d2, rank);
+    return o;
+  };
+  auto recv_off_rows = [&](int src) {
+    int64_t o = 0;
+    for (int k = 0; k < src; ++k) o += recv_rows(rank, k);
+    return o;
+  };
+  std::memcpy(out + recv_off_rows(rank) * row_bytes,
+              in + send_off_rows(rank) * row_bytes,
+              recv_rows(rank, rank) * row_bytes);
+  for (int s = 1; s < size; ++s) {
+    int dest = (rank + s) % size;
+    int src = (rank - s + size) % size;
+    if (!SendRecv(controller_->DataConn(dest),
+                  in + send_off_rows(dest) * row_bytes,
+                  recv_rows(dest, rank) * row_bytes,
+                  controller_->DataConn(src),
+                  out + recv_off_rows(src) * row_bytes,
+                  recv_rows(rank, src) * row_bytes))
       return Status::UnknownError("alltoall: lost data connection");
   }
   if (timeline_) timeline_->ActivityEnd(e.name);
@@ -433,6 +687,11 @@ Status TcpOps::Reducescatter(const Response& r,
   const int rank = controller_->rank();
   const int size = controller_->size();
   auto& e = entries.front();
+  // Matches the XLA plane (xla_exec._reduce_over_ranks): Adasum is an
+  // allreduce-only operator — reject instead of silently summing.
+  if (e.reduce_op == ReduceOp::ADASUM)
+    return Status::PreconditionError(
+        "adasum reducescatter is not defined; use allreduce");
   if (timeline_) timeline_->ActivityStart(e.name, ACT_TCP_ALLREDUCE);
   int64_t n = e.shape.num_elements();
   int64_t bytes = n * DataTypeSize(e.dtype);
@@ -444,31 +703,32 @@ Status TcpOps::Reducescatter(const Response& r,
   if (e.prescale_factor != 1.0)
     HostScale(e.dtype, buf, n, e.prescale_factor);
 
-  // Row offset/extent of each rank's shard.
+  // Byte offset of each rank's shard (r.tensor_sizes = per-rank rows).
   std::vector<int64_t> offs(size + 1, 0);
-  for (int k = 0; k < size; ++k) offs[k + 1] = offs[k] + r.tensor_sizes[k];
+  for (int k = 0; k < size; ++k)
+    offs[k + 1] = offs[k] + r.tensor_sizes[k] * row_bytes;
 
-  if (rank == 0) {
-    std::vector<uint8_t> scratch(bytes);
-    for (int peer = 1; peer < size; ++peer) {
-      if (!controller_->DataConn(peer)->RecvAll(scratch.data(), bytes))
+  // Ring reduce-scatter with the rank shards as the ring chunks: P-1
+  // steps, each forwarding the partially-reduced chunk one hop; chunk k
+  // starts at rank k+1 and lands fully reduced on rank k.
+  if (size > 1) {
+    TcpConn* next = controller_->DataConn((rank + 1) % size);
+    TcpConn* prev = controller_->DataConn((rank - 1 + size) % size);
+    int64_t max_chunk = 0;
+    for (int k = 0; k < size; ++k)
+      max_chunk = std::max(max_chunk, offs[k + 1] - offs[k]);
+    std::vector<uint8_t> scratch(max_chunk);
+    for (int s = 0; s < size - 1; ++s) {
+      int cs = ((rank - s - 1) % size + size) % size;
+      int cr = ((rank - s - 2) % size + size) % size;
+      if (!SendRecv(next, buf + offs[cs], offs[cs + 1] - offs[cs], prev,
+                    scratch.data(), offs[cr + 1] - offs[cr]))
         return Status::UnknownError("reducescatter: lost data connection");
-      HostAccumulate(e.reduce_op, e.dtype, scratch.data(), buf,
-                     bytes / DataTypeSize(e.dtype));
+      HostAccumulate(e.reduce_op, e.dtype, scratch.data(), buf + offs[cr],
+                     (offs[cr + 1] - offs[cr]) / DataTypeSize(e.dtype));
     }
-    for (int peer = 1; peer < size; ++peer) {
-      if (!controller_->DataConn(peer)->SendAll(
-              buf + offs[peer] * row_bytes,
-              r.tensor_sizes[peer] * row_bytes))
-        return Status::UnknownError("reducescatter: lost data connection");
-    }
-    std::memcpy(e.output, buf, r.tensor_sizes[0] * row_bytes);
-  } else {
-    if (!controller_->DataConn(0)->SendAll(buf, bytes) ||
-        !controller_->DataConn(0)->RecvAll(e.output,
-                                           r.tensor_sizes[rank] * row_bytes))
-      return Status::UnknownError("reducescatter: lost data connection");
   }
+  std::memcpy(e.output, buf + offs[rank], offs[rank + 1] - offs[rank]);
   int64_t out_n = r.tensor_sizes[rank] * row_bytes / DataTypeSize(e.dtype);
   double factor = e.postscale_factor;
   if (e.reduce_op == ReduceOp::AVERAGE) factor /= size;

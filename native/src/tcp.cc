@@ -78,6 +78,43 @@ bool TcpConn::RecvAll(void* data, uint64_t len) {
   return true;
 }
 
+void TcpConn::SetRecvTimeout(int ms) {
+  if (fd_ < 0) return;
+  timeval tv{};
+  tv.tv_sec = ms / 1000;
+  tv.tv_usec = (ms % 1000) * 1000;
+  setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+}
+
+std::string TcpConn::LocalIp() const {
+  sockaddr_in sa{};
+  socklen_t slen = sizeof(sa);
+  if (fd_ < 0 ||
+      getsockname(fd_, reinterpret_cast<sockaddr*>(&sa), &slen) != 0)
+    return "";
+  char buf[INET_ADDRSTRLEN];
+  if (inet_ntop(AF_INET, &sa.sin_addr, buf, sizeof(buf)) == nullptr) return "";
+  return buf;
+}
+
+bool SendRecv(TcpConn* to, const void* sbuf, uint64_t sbytes, TcpConn* from,
+              void* rbuf, uint64_t rbytes) {
+  // Payloads comfortably below the kernel's minimum socket send buffer
+  // (SO_SNDBUF floor is 4 KB; defaults are ≥ 16 KB) cannot block in
+  // send(), so the latency-sensitive small-tensor path skips the
+  // concurrent-sender thread entirely.
+  constexpr uint64_t kNoBlockBytes = 8 * 1024;
+  if (sbytes <= kNoBlockBytes)
+    return (sbytes == 0 || to->SendAll(sbuf, sbytes)) &&
+           (rbytes == 0 || from->RecvAll(rbuf, rbytes));
+  bool send_ok = true;
+  std::thread sender(
+      [&] { send_ok = to->SendAll(sbuf, sbytes); });
+  bool recv_ok = rbytes == 0 || from->RecvAll(rbuf, rbytes);
+  sender.join();
+  return send_ok && recv_ok;
+}
+
 bool TcpConn::SendFrame(const void* data, uint64_t len) {
   uint64_t hdr = len;
   return SendAll(&hdr, sizeof(hdr)) && (len == 0 || SendAll(data, len));
@@ -115,6 +152,30 @@ int TcpServer::Listen(const std::string& addr) {
   return ntohs(sa.sin_port);
 }
 
+// Accept one connection with a shared deadline and read its (rank,
+// channel) handshake. Returns false on timeout/socket error.
+bool TcpServer::AcceptOne(std::chrono::steady_clock::time_point deadline,
+                          int32_t hello[2], TcpConn* out) {
+  timeval tv{};
+  auto remain = std::chrono::duration_cast<std::chrono::microseconds>(
+                    deadline - std::chrono::steady_clock::now())
+                    .count();
+  if (remain <= 0) return false;
+  tv.tv_sec = remain / 1000000;
+  tv.tv_usec = remain % 1000000;
+  fd_set fds;
+  FD_ZERO(&fds);
+  FD_SET(listen_fd_, &fds);
+  if (::select(listen_fd_ + 1, &fds, nullptr, nullptr, &tv) <= 0) return false;
+  int fd = ::accept(listen_fd_, nullptr, nullptr);
+  if (fd < 0) return false;
+  SetNoDelay(fd);
+  TcpConn conn(fd);
+  if (!conn.RecvAll(hello, sizeof(int32_t) * 2)) return false;
+  *out = std::move(conn);
+  return true;
+}
+
 bool TcpServer::AcceptPeers(int n, std::vector<TcpConn>* control_by_rank,
                             std::vector<TcpConn>* data_by_rank,
                             int timeout_ms) {
@@ -125,31 +186,35 @@ bool TcpServer::AcceptPeers(int n, std::vector<TcpConn>* control_by_rank,
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(timeout_ms);
   for (int i = 0; i < 2 * n; ++i) {
-    timeval tv{};
-    auto remain = std::chrono::duration_cast<std::chrono::microseconds>(
-                      deadline - std::chrono::steady_clock::now())
-                      .count();
-    if (remain <= 0) return false;
-    tv.tv_sec = remain / 1000000;
-    tv.tv_usec = remain % 1000000;
-    fd_set fds;
-    FD_ZERO(&fds);
-    FD_SET(listen_fd_, &fds);
-    if (::select(listen_fd_ + 1, &fds, nullptr, nullptr, &tv) <= 0)
-      return false;
-    int fd = ::accept(listen_fd_, nullptr, nullptr);
-    if (fd < 0) return false;
-    SetNoDelay(fd);
-    TcpConn conn(fd);
     int32_t hello[2];
-    if (!conn.RecvAll(hello, sizeof(hello)) || hello[0] < 1 || hello[0] > n ||
-        (hello[1] != 0 && hello[1] != 1)) {
+    TcpConn conn;
+    if (!AcceptOne(deadline, hello, &conn)) return false;
+    if (hello[0] < 1 || hello[0] > n || (hello[1] != 0 && hello[1] != 1)) {
       LOG_ERROR << "controller handshake: bad (rank, channel) = (" << hello[0]
                 << ", " << hello[1] << ")";
       return false;
     }
     auto* vec = hello[1] == 0 ? control_by_rank : data_by_rank;
     (*vec)[hello[0]] = std::move(conn);
+  }
+  return true;
+}
+
+bool TcpServer::AcceptMesh(int n, int my_rank, std::vector<TcpConn>* out_by_rank,
+                           int timeout_ms) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  for (int i = 0; i < n; ++i) {
+    int32_t hello[2];
+    TcpConn conn;
+    if (!AcceptOne(deadline, hello, &conn)) return false;
+    if (hello[1] != 2 || hello[0] <= my_rank ||
+        hello[0] >= static_cast<int32_t>(out_by_rank->size())) {
+      LOG_ERROR << "mesh handshake: bad (rank, channel) = (" << hello[0]
+                << ", " << hello[1] << ") at rank " << my_rank;
+      return false;
+    }
+    (*out_by_rank)[hello[0]] = std::move(conn);
   }
   return true;
 }

@@ -1,0 +1,49 @@
+"""NumPy reference model for Adasum (the analog of the reference's
+test_adasum_* numpy checks): the pairwise projection rule applied over
+the same operator trees the two data planes use."""
+
+import numpy as np
+
+
+def combine(a, b):
+    """adasum(a, b) with f64 accumulation, per the native core."""
+    a64 = np.asarray(a, np.float64)
+    b64 = np.asarray(b, np.float64)
+    dot = float((a64 * b64).sum())
+    na2 = float((a64 * a64).sum())
+    nb2 = float((b64 * b64).sum())
+    ac = 1.0 - dot / (2.0 * na2) if na2 > 0 else 1.0
+    bc = 1.0 - dot / (2.0 * nb2) if nb2 > 0 else 1.0
+    return (ac * a64 + bc * b64).astype(np.asarray(a).dtype)
+
+
+def adasum_fold_model(vectors):
+    """Host-plane operator tree (ops.cc AdasumAllreduce): fold the first
+    2·t ranks pairwise (t = P − q, q = largest power of two ≤ P), then
+    XOR distance-doubling over the q survivors."""
+    P = len(vectors)
+    q = 1
+    while q * 2 <= P:
+        q *= 2
+    t = P - q
+    core = [combine(vectors[2 * i], vectors[2 * i + 1]) for i in range(t)]
+    core += [v.copy() for v in vectors[2 * t:]]
+    d = 1
+    while d < q:
+        core = [combine(core[v], core[v ^ d]) for v in range(q)]
+        d *= 2
+    return core[0]
+
+
+def adasum_tree_model(vectors):
+    """XLA-callback operator tree (xla_exec._adasum_tree): zero-pad to a
+    power of two, fold consecutive pairs. Identical to the fold model
+    for power-of-two world sizes."""
+    P = len(vectors)
+    M = 1 << max(0, (P - 1).bit_length())
+    vals = [v.copy() for v in vectors]
+    vals += [np.zeros_like(vectors[0])] * (M - P)
+    while len(vals) > 1:
+        vals = [combine(vals[2 * i], vals[2 * i + 1])
+                for i in range(len(vals) // 2)]
+    return vals[0]

@@ -252,6 +252,77 @@ def main():
         # traced path completes the whole loop in well under that.
         assert _time.monotonic() - t0 < 15, "factor change likely recompiles"
 
+    elif scenario == "adasum":
+        # Host-plane Adasum vs. the NumPy fold model (the reference
+        # compares against a NumPy VHDD model the same way,
+        # test/parallel/test_adasum_*.py).
+        from _adasum_model import adasum_fold_model
+
+        def vec(k, n=33, seed=7):
+            rng = np.random.RandomState(seed + k)
+            return rng.randn(n).astype(np.float32)
+
+        vecs = [vec(k) for k in range(s)]
+        out = hvd.allreduce(vecs[r], op=hvd.Adasum, name="ad.f32")
+        np.testing.assert_allclose(out, adasum_fold_model(vecs), rtol=1e-5)
+
+        # f64 and f16 dtypes
+        v64 = [v.astype(np.float64) for v in vecs]
+        out = hvd.allreduce(v64[r], op=hvd.Adasum, name="ad.f64")
+        np.testing.assert_allclose(out, adasum_fold_model(v64), rtol=1e-12)
+        v16 = [v.astype(np.float16) for v in vecs]
+        out = hvd.allreduce(v16[r], op=hvd.Adasum, name="ad.f16")
+        np.testing.assert_allclose(np.asarray(out, np.float64),
+                                   np.asarray(adasum_fold_model(v16),
+                                              np.float64), rtol=5e-2,
+                                   atol=5e-2)
+
+        # grouped: per-TENSOR dot/norm weighting inside one fused buffer
+        a = [vec(k, 8, seed=100) for k in range(s)]
+        b = [vec(k, 5, seed=200) for k in range(s)]
+        outs = hvd.grouped_allreduce([a[r], b[r]], op=hvd.Adasum, name="ad.g")
+        np.testing.assert_allclose(outs[0], adasum_fold_model(a), rtol=1e-5)
+        np.testing.assert_allclose(outs[1], adasum_fold_model(b), rtol=1e-5)
+
+        # identical gradients -> adasum degenerates to the average
+        same = hvd.allreduce(np.full(6, 4.0, np.float32), op=hvd.Adasum,
+                             name="ad.same")
+        np.testing.assert_allclose(same, 4.0, rtol=1e-6)
+
+        # integer input is rejected, not silently summed
+        try:
+            hvd.allreduce(np.ones(4, np.int32), op=hvd.Adasum, name="ad.bad")
+            raise SystemExit("expected HorovodInternalError for int adasum")
+        except HorovodInternalError:
+            pass
+
+    elif scenario == "xla_adasum":
+        # CALLBACK-mode Adasum: the zero-padded pair tree, per-segment
+        # weighting in the fused program.
+        import jax
+        import jax.numpy as jnp
+        from _adasum_model import adasum_tree_model
+
+        assert jax.process_count() == s
+
+        def vec(k, n=17, seed=3):
+            rng = np.random.RandomState(seed + k)
+            return rng.randn(n).astype(np.float32)
+
+        vecs = [vec(k) for k in range(s)]
+        out = hvd.allreduce(jnp.asarray(vecs[r]), op=hvd.Adasum, name="xad")
+        # f32 accumulation in-program vs the f64 NumPy model
+        np.testing.assert_allclose(np.asarray(out), adasum_tree_model(vecs),
+                                   rtol=1e-4)
+        a = [vec(k, 9, seed=50) for k in range(s)]
+        b = [vec(k, 4, seed=60) for k in range(s)]
+        outs = hvd.grouped_allreduce([jnp.asarray(a[r]), jnp.asarray(b[r])],
+                                     op=hvd.Adasum, name="xad.g")
+        np.testing.assert_allclose(np.asarray(outs[0]), adasum_tree_model(a),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(outs[1]), adasum_tree_model(b),
+                                   rtol=1e-4)
+
     elif scenario == "xla_join":
         # CALLBACK-mode Join: joined rank synthesizes a zeros
         # contribution and still launches the same XLA program.
