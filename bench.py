@@ -7,13 +7,19 @@ of 10 batches; reports the mean images/sec on this chip.
 
 Prints ONE JSON line:
   {"metric": "resnet50_images_per_sec_per_chip", "value": N,
-   "unit": "images/sec", "vs_baseline": R}
+   "unit": "images/sec", "vs_baseline": R, "extra": {...}}
 
 ``vs_baseline`` compares against the reference's only published
 absolute throughput — 1,656.82 img/s over 16 P100s for ResNet-101
 (`docs/benchmarks.rst:40-43`), i.e. 103.55 img/s/GPU scaled by the
 ResNet-101/ResNet-50 FLOP ratio (7.6/3.8 GFLOPs ≈ 2.0) to a ~207
 img/s/GPU ResNet-50 equivalent.
+
+``extra`` carries secondary metrics from BASELINE.md's target table:
+the host-plane fused-allreduce **bus bandwidth** microbenchmark
+(np=4 local processes over the TCP peer mesh; NCCL convention
+busbw = 2·(P−1)/P · bytes/t) per payload size. Skippable with
+BENCH_SKIP_BUS=1.
 """
 
 import json
@@ -25,6 +31,80 @@ from functools import partial
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 REF_R50_IMG_PER_SEC_PER_DEVICE = 207.0  # P100-derived, see module docstring
+
+BUS_SIZES_MB = (1, 16, 64)
+BUS_NP = 4
+
+
+def _bus_worker():
+    """Per-rank body of the allreduce bandwidth microbenchmark (run in
+    subprocesses with the standard HOROVOD_* env)."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r, s = hvd.rank(), hvd.size()
+    results = {}
+    for mb in BUS_SIZES_MB:
+        n = mb * (1 << 20) // 4
+        x = np.ones(n, np.float32)
+        for i in range(2):  # warmup (mesh links, fusion buffer, cache)
+            hvd.allreduce(x, op=hvd.Sum, name=f"bw.{mb}")
+        iters = 5
+        t0 = time.perf_counter()
+        for i in range(iters):
+            hvd.allreduce(x, op=hvd.Sum, name=f"bw.{mb}")
+        dt = time.perf_counter() - t0
+        algbw = (n * 4 * iters / dt) / 1e9
+        results[f"{mb}MB"] = round(algbw * 2 * (s - 1) / s, 3)
+    if r == 0:
+        print("BUSBW " + json.dumps(results), flush=True)
+    hvd.shutdown()
+
+
+def _bus_bandwidth():
+    """Launch the np=4 host-plane bandwidth job; returns {size: GB/s}
+    or None on failure (the primary metric must still print)."""
+    import socket
+    import subprocess
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for r in range(BUS_NP):
+        env = dict(os.environ)
+        env.update({
+            "HOROVOD_RANK": str(r), "HOROVOD_SIZE": str(BUS_NP),
+            "HOROVOD_LOCAL_RANK": str(r), "HOROVOD_LOCAL_SIZE": str(BUS_NP),
+            "HOROVOD_CROSS_RANK": "0", "HOROVOD_CROSS_SIZE": "1",
+            "HOROVOD_CONTROLLER_ADDR": f"127.0.0.1:{port}",
+            "PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--bus-worker"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True))
+    out0 = None
+    try:
+        for r, p in enumerate(procs):
+            out, _ = p.communicate(timeout=180)
+            if r == 0:
+                out0 = out
+            if p.returncode != 0:
+                return None
+    except subprocess.TimeoutExpired:
+        return None
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for line in (out0 or "").splitlines():
+        if line.startswith("BUSBW "):
+            return json.loads(line[len("BUSBW "):])
+    return None
 
 
 def main():
@@ -108,13 +188,22 @@ def main():
     dt = time.perf_counter() - t0
 
     per_chip = (batch * iters * rounds / dt) / n_dev
+    extra = {}
+    if os.environ.get("BENCH_SKIP_BUS") != "1":
+        bus = _bus_bandwidth()
+        if bus is not None:
+            extra["host_allreduce_busbw_gbps_np4"] = bus
     print(json.dumps({
         "metric": "resnet50_images_per_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "images/sec",
         "vs_baseline": round(per_chip / REF_R50_IMG_PER_SEC_PER_DEVICE, 3),
+        "extra": extra,
     }))
 
 
 if __name__ == "__main__":
-    main()
+    if "--bus-worker" in sys.argv:
+        _bus_worker()
+    else:
+        main()

@@ -105,7 +105,28 @@ def _scale_jit():
     return jax.jit(_apply_factor)
 
 
+_OP_SPAN = {basics.OP_ALLREDUCE: "allreduce",
+            basics.OP_ALLGATHER: "allgather",
+            basics.OP_BROADCAST: "broadcast",
+            basics.OP_ALLTOALL: "alltoall",
+            basics.OP_REDUCESCATTER: "reducescatter"}
+
+
 def execute(op: int, states, sizes: List[int], size: int, rank: int):
+    """Execute one CALLBACK response. Wrapped in a ``jax.profiler``
+    span so device traces show the collective under the same phase
+    names as the host timeline (the reference's NVTX ranges,
+    ``common/nvtx_op_range.cc``; here the device story is
+    ``jax.profiler.trace``/TensorBoard)."""
+    import jax.profiler
+
+    name = states[0].name if states else "?"
+    with jax.profiler.TraceAnnotation(
+            f"hvd:{_OP_SPAN.get(op, op)}:{name}"):
+        return _execute(op, states, sizes, size, rank)
+
+
+def _execute(op: int, states, sizes: List[int], size: int, rank: int):
     if size == 1:
         outs = []
         for st in states:

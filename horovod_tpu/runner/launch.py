@@ -285,6 +285,14 @@ def build_parser() -> argparse.ArgumentParser:
                       help="seconds before a stall warning")
     tune.add_argument("--stall-shutdown-time", type=float, default=None,
                       help="seconds before a stall aborts the job")
+    tune.add_argument("--autotune", action="store_true",
+                      help="autotune fusion threshold and cycle time by "
+                           "observed reduction throughput")
+    tune.add_argument("--autotune-log-file", default=None,
+                      help="CSV log of autotune samples (rank 0)")
+    tune.add_argument("--hierarchical-allreduce", action="store_true",
+                      help="two-level intra-node/cross-node allreduce on "
+                           "the host data plane")
     tune.add_argument("--log-level", default=None,
                       choices=["trace", "debug", "info", "warning", "error",
                                "fatal"])
@@ -311,6 +319,12 @@ def args_to_env(args: argparse.Namespace) -> Dict[str, str]:
     if args.stall_shutdown_time is not None:
         env["HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"] = str(
             args.stall_shutdown_time)
+    if args.autotune:
+        env["HOROVOD_AUTOTUNE"] = "1"
+    if args.autotune_log_file is not None:
+        env["HOROVOD_AUTOTUNE_LOG"] = args.autotune_log_file
+    if args.hierarchical_allreduce:
+        env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
     if args.log_level is not None:
         env["HOROVOD_LOG_LEVEL"] = args.log_level
     if args.xla_exec:

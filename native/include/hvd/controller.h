@@ -62,6 +62,19 @@ class Controller {
 
   int rank() const { return rank_; }
   int size() const { return size_; }
+  // Node-local topology (launcher slot model, runner/common/util/
+  // hosts.py): used by the hierarchical data-plane decomposition.
+  void SetTopology(int local_rank, int local_size, int cross_rank,
+                   int cross_size) {
+    local_rank_ = local_rank;
+    local_size_ = local_size;
+    cross_rank_ = cross_rank;
+    cross_size_ = cross_size;
+  }
+  int local_rank() const { return local_rank_; }
+  int local_size() const { return local_size_; }
+  int cross_rank() const { return cross_rank_; }
+  int cross_size() const { return cross_size_; }
 
   // Data-plane access for the ops layer (TcpController only).
   virtual TcpConn* DataConn(int peer_rank) { return nullptr; }
@@ -90,6 +103,10 @@ class Controller {
 
   int rank_;
   int size_;
+  int local_rank_ = 0;
+  int local_size_ = 1;
+  int cross_rank_ = 0;
+  int cross_size_ = 1;
   ControllerDeps deps_;
   int64_t fusion_threshold_bytes_ = 64 * 1024 * 1024;
   // Host data plane: payloads at/above this use ring allreduce, below
@@ -97,12 +114,29 @@ class Controller {
   // algorithms deadlock), so TcpController::Initialize syncs rank 0's
   // value to all workers — env divergence cannot split the job.
   int64_t ring_threshold_bytes_ = 64 * 1024;
+  bool hierarchical_ = false;
 
  public:
   void SetFusionThreshold(int64_t bytes) { fusion_threshold_bytes_ = bytes; }
   int64_t fusion_threshold() const { return fusion_threshold_bytes_; }
   void SetRingThreshold(int64_t bytes) { ring_threshold_bytes_ = bytes; }
   int64_t ring_threshold() const { return ring_threshold_bytes_; }
+  // Hierarchical allreduce: rank 0's env decides the request; the
+  // value is only TRUE after Initialize when every rank's topology
+  // fits the node-major layout (the verdict is broadcast — a per-rank
+  // decision would deadlock the exchange).
+  void SetHierarchical(bool on) { hierarchical_ = on; }
+  bool hierarchical() const { return hierarchical_; }
+  // Autotune (rank 0): stage new tunables for the next broadcast
+  // ResponseList so every rank applies them on the same cycle.
+  void StageTunedParams(int64_t fusion, double cycle_ms) {
+    staged_fusion_ = fusion;
+    staged_cycle_ms_ = cycle_ms;
+  }
+
+ protected:
+  int64_t staged_fusion_ = 0;
+  double staged_cycle_ms_ = 0.0;
 };
 
 class LocalController : public Controller {
@@ -126,7 +160,7 @@ class TcpController : public Controller {
  private:
   ResponseList CoordinatorCycle(RequestList my_list, bool shutdown);
   ResponseList WorkerCycle(RequestList my_list);
-  void Broadcast(const ResponseList& list);
+  void Broadcast(ResponseList& list);
   // Split drained queue messages into cache hits vs. full requests.
   RequestList BuildRequestList(bool shutdown, bool* saw_join);
 

@@ -116,6 +116,10 @@ struct ResponseList {
   std::vector<Response> responses;
   bool shutdown = false;
   bool purge_cache = false;  // all ranks clear caches + re-announce
+  // Autotune sync (reference SynchronizeParameters, controller.cc:39):
+  // rank 0's parameter manager stages new tunables here; 0 = no change.
+  int64_t tuned_fusion_threshold = 0;
+  double tuned_cycle_time_ms = 0.0;
 
   void SerializeTo(std::string* out) const;
   static bool ParseFrom(const std::string& buf, ResponseList* out);

@@ -71,8 +71,20 @@ class TcpOps : public OpExecutor {
 
   // Allreduce algorithms over the contributor set `ranks` (my position
   // is `p`). All operate in place on the packed fusion buffer.
+  Status RingReduceScatterPhase(uint8_t* buf,
+                                const std::vector<int64_t>& offs,
+                                DataType dtype, ReduceOp op,
+                                const std::vector<int>& ranks, int p);
+  Status RingAllgatherPhase(uint8_t* buf, const std::vector<int64_t>& offs,
+                            DataType dtype, const std::vector<int>& ranks,
+                            int p);
   Status RingAllreduce(uint8_t* buf, int64_t elems, DataType dtype,
                        ReduceOp op, const std::vector<int>& ranks, int p);
+  // Two-level intra-node / cross-node decomposition (reference
+  // NCCLHierarchicalAllreduce, nccl_operations.cc:187-360).
+  Status HierarchicalAllreduce(uint8_t* buf, int64_t elems, DataType dtype,
+                               ReduceOp op);
+  bool HierarchicalApplicable(const std::vector<int>& ranks) const;
   // Distance-doubling driver (fold/unfold for ragged P); `combine`
   // folds a partner buffer into `buf` and must be symmetric.
   Status DoublingExchange(uint8_t* buf, int64_t bytes,
@@ -88,6 +100,7 @@ class TcpOps : public OpExecutor {
                          const std::vector<int>& ranks, int p);
 
   int64_t ring_threshold_bytes_;  // below: recursive doubling
+  bool hierarchical_ = false;     // HOROVOD_HIERARCHICAL_ALLREDUCE
 };
 
 // Accumulate src into dst elementwise on the host ("SUM"/"MIN"/...),

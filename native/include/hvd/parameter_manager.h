@@ -1,0 +1,71 @@
+// Autotuning of the coordination-cycle tunables.
+//
+// Rebuild of the reference's ParameterManager
+// (horovod/common/parameter_manager.h:42-246): score each parameter
+// setting by observed allreduce throughput (bytes/sec) and walk the
+// parameter space. The reference samples a Gaussian-process Bayesian
+// optimizer; here the space is two well-behaved log-scale knobs
+// (fusion threshold, cycle time), so a multiplicative coordinate
+// descent reaches the same plateaus with far less machinery: for each
+// knob try x2 / ÷2, keep moving while the score improves, converge
+// when a full pass over both knobs yields no gain. Rank 0 tunes and
+// stages the new values onto the broadcast ResponseList so every rank
+// applies them on the same cycle (the reference syncs through
+// Controller::SynchronizeParameters, controller.cc:39-53).
+#pragma once
+
+#include <cstdint>
+#include <fstream>
+#include <string>
+
+namespace hvd {
+
+class ParameterManager {
+ public:
+  // `fusion` / `cycle_ms` are the starting (env-configured) values.
+  void Initialize(int64_t fusion, double cycle_ms);
+  void SetEnabled(bool on) { enabled_ = on; }
+  bool enabled() const { return enabled_ && !converged_; }
+  void SetLogPath(const std::string& path);
+
+  // Record traffic finished this cycle (coordinator side).
+  void Record(int64_t bytes);
+
+  // Advance the tuner; returns true when the tunables changed (read
+  // them back via fusion_threshold()/cycle_time_ms()).
+  bool Update(double now_secs);
+
+  int64_t fusion_threshold() const { return fusion_; }
+  double cycle_time_ms() const { return cycle_ms_; }
+  bool converged() const { return converged_; }
+  double best_score() const { return best_score_; }
+
+ private:
+  void ApplyCandidate();
+  void LogSample(double score);
+
+  bool enabled_ = false;
+  bool converged_ = false;
+
+  int64_t fusion_ = 64 * 1024 * 1024;
+  double cycle_ms_ = 1.0;
+
+  // Measurement window.
+  double window_secs_ = 1.0;
+  double window_start_ = -1.0;
+  int64_t window_bytes_ = 0;
+  bool settling_ = true;  // discard the first window after a change
+
+  // Coordinate-descent state.
+  int dim_ = 0;          // 0 = fusion threshold, 1 = cycle time
+  int direction_ = +1;   // +1 = grow (x2), -1 = shrink (÷2)
+  bool tried_other_dir_ = false;
+  int stale_dims_ = 0;   // dims passed with no improvement
+  double best_score_ = 0.0;
+  int64_t best_fusion_ = 0;
+  double best_cycle_ms_ = 0.0;
+
+  std::ofstream log_;
+};
+
+}  // namespace hvd

@@ -51,6 +51,11 @@ void Controller::AccumulateRequest(const Request& req,
   if (deps_.timeline && req.request_rank == rank_)
     deps_.timeline->NegotiateStart(req.tensor_name,
                                    RequestTypeName(req.request_type));
+  // Straggler diagnostic: per-rank readiness tick in the coordinator's
+  // timeline (reference controller.cc:950-962) — the NEGOTIATING bar
+  // shows which rank was last to announce.
+  if (deps_.timeline)
+    deps_.timeline->NegotiateRankReady(req.tensor_name, req.request_rank);
 }
 
 Response Controller::ConstructResponse(const std::string& name,
@@ -429,26 +434,50 @@ Status TcpController::Initialize() {
   LOG_DEBUG << "rank " << rank_ << "/" << size_ << " controller connected";
   Status st = InitializeMesh(timeout_ms);
   if (!st.ok()) return st;
-  // Tunable sync: rank 0's thresholds win (the reference's
-  // SynchronizeParameters role, controller.cc:39-53) so per-rank env
-  // divergence can't make ranks pick different data-plane algorithms.
+  // Tunable sync (the reference's SynchronizeParameters role,
+  // controller.cc:39-53): data-plane algorithm choices MUST agree on
+  // every rank or the exchanges deadlock. Workers report whether their
+  // local topology fits the node-major hierarchical layout; rank 0
+  // ANDs those, checks homogeneity, and broadcasts the thresholds plus
+  // the final hierarchical verdict.
+  const bool my_hier_fit =
+      local_size_ > 1 && size_ % local_size_ == 0 &&
+      local_rank_ == rank_ % local_size_ &&
+      cross_rank_ == rank_ / local_size_;
   if (rank_ == 0) {
+    bool all_fit = my_hier_fit;
+    for (int peer = 1; peer < size_; ++peer) {
+      std::string fit;
+      ctrl_conns_[peer].SetRecvTimeout(timeout_ms);
+      bool ok = ctrl_conns_[peer].RecvFrame(&fit);
+      ctrl_conns_[peer].SetRecvTimeout(0);
+      if (!ok) return Status::UnknownError("param sync: lost control link");
+      all_fit = all_fit && fit == ("fit:" + std::to_string(local_size_));
+    }
+    hierarchical_ = hierarchical_ && all_fit;
     std::string params = std::to_string(fusion_threshold_bytes_) + ":" +
-                         std::to_string(ring_threshold_bytes_);
+                         std::to_string(ring_threshold_bytes_) + ":" +
+                         (hierarchical_ ? "1" : "0");
     for (int peer = 1; peer < size_; ++peer) {
       if (!ctrl_conns_[peer].SendFrame(params))
         return Status::UnknownError("param sync: lost control link");
     }
   } else {
+    std::string fit = my_hier_fit ? "fit:" + std::to_string(local_size_)
+                                  : "unfit";
+    if (!ctrl_conns_[0].SendFrame(fit))
+      return Status::UnknownError("param sync: lost control link");
     std::string params;
     ctrl_conns_[0].SetRecvTimeout(timeout_ms);
     bool ok = ctrl_conns_[0].RecvFrame(&params);
     ctrl_conns_[0].SetRecvTimeout(0);
-    auto colon = params.find(':');
-    if (!ok || colon == std::string::npos)
+    auto c1 = params.find(':');
+    auto c2 = c1 == std::string::npos ? c1 : params.find(':', c1 + 1);
+    if (!ok || c2 == std::string::npos)
       return Status::UnknownError("param sync: lost control link");
     fusion_threshold_bytes_ = std::atoll(params.c_str());
-    ring_threshold_bytes_ = std::atoll(params.c_str() + colon + 1);
+    ring_threshold_bytes_ = std::atoll(params.c_str() + c1 + 1);
+    hierarchical_ = params[c2 + 1] == '1';
   }
   return Status::OK();
 }
@@ -716,7 +745,13 @@ ResponseList TcpController::WorkerCycle(RequestList my_list) {
   return out;
 }
 
-void TcpController::Broadcast(const ResponseList& list) {
+void TcpController::Broadcast(ResponseList& list) {
+  if (staged_fusion_ > 0) {
+    list.tuned_fusion_threshold = staged_fusion_;
+    list.tuned_cycle_time_ms = staged_cycle_ms_;
+    staged_fusion_ = 0;
+    staged_cycle_ms_ = 0.0;
+  }
   std::string buf;
   list.SerializeTo(&buf);
   for (int r = 1; r < size_; ++r) {

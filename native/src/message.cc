@@ -162,10 +162,14 @@ bool RequestList::ParseFrom(const std::string& buf, RequestList* out) {
 }
 
 int64_t Response::TotalByteSize() const {
-  // Only meaningful for ALLREDUCE (fused) responses where every entry
-  // keeps its enqueue-time shape; other op types derive sizes from
-  // tensor_sizes/recvsplits at execution.
-  return 0;
+  // Only meaningful for ALLREDUCE (fused) responses, where
+  // tensor_sizes carries per-tensor element counts; other op types
+  // put per-RANK dimensions there, which don't convert to bytes
+  // without the entry shapes.
+  if (response_type != ResponseType::ALLREDUCE) return 0;
+  int64_t elems = 0;
+  for (auto n : tensor_sizes) elems += n;
+  return elems * DataTypeSize(tensor_type);
 }
 
 void Response::SerializeTo(std::string* out) const {
@@ -202,9 +206,11 @@ bool Response::ParseFrom(const char** p, const char* end, Response* r) {
 }
 
 void ResponseList::SerializeTo(std::string* out) const {
-  WriteScalar<uint8_t>(out, 1);  // version
+  WriteScalar<uint8_t>(out, 2);  // version
   WriteScalar<uint8_t>(out, shutdown ? 1 : 0);
   WriteScalar<uint8_t>(out, purge_cache ? 1 : 0);
+  WriteScalar<int64_t>(out, tuned_fusion_threshold);
+  WriteScalar<double>(out, tuned_cycle_time_ms);
   WriteScalar<uint32_t>(out, static_cast<uint32_t>(responses.size()));
   for (const auto& r : responses) r.SerializeTo(out);
 }
@@ -213,11 +219,13 @@ bool ResponseList::ParseFrom(const std::string& buf, ResponseList* out) {
   const char* p = buf.data();
   const char* end = p + buf.size();
   uint8_t ver, sd, pc;
-  if (!ReadScalar(&p, end, &ver) || ver != 1) return false;
+  if (!ReadScalar(&p, end, &ver) || ver != 2) return false;
   if (!ReadScalar(&p, end, &sd)) return false;
   out->shutdown = sd != 0;
   if (!ReadScalar(&p, end, &pc)) return false;
   out->purge_cache = pc != 0;
+  if (!ReadScalar(&p, end, &out->tuned_fusion_threshold)) return false;
+  if (!ReadScalar(&p, end, &out->tuned_cycle_time_ms)) return false;
   uint32_t n;
   if (!ReadScalar(&p, end, &n)) return false;
   out->responses.resize(n);

@@ -30,6 +30,7 @@
 #include "hvd/logging.h"
 #include "hvd/message.h"
 #include "hvd/ops.h"
+#include "hvd/parameter_manager.h"
 #include "hvd/response_cache.h"
 #include "hvd/stall_inspector.h"
 #include "hvd/tensor_queue.h"
@@ -133,6 +134,7 @@ struct GlobalState {
   Timeline timeline;
   FusionBufferManager fusion;
   HandleManager handles;
+  ParameterManager param_manager;
 
   std::unique_ptr<Controller> controller;
   std::unique_ptr<OpExecutor> host_ops;
@@ -219,20 +221,15 @@ void PerformOperation(GlobalState& st, const Response& response) {
     return;
   }
   if (entries.empty()) {
-    // Joined rank: no local tensors. HOST mode: rank 0 still serves as
-    // the hub for host allreduces. CALLBACK mode: this process must
+    // Joined rank: no local tensors. HOST mode: nothing to do — the
+    // peer-mesh algorithms run entirely among the contributors (the
+    // rank-0 hub role is gone). CALLBACK mode: this process must
     // STILL launch the XLA program — every process in a multi-controller
     // JAX job has to execute the same collective in the same order
     // (xla_exec synthesizes a zeros contribution from the response's
     // element counts; reference feeds zeros for joined ranks,
     // operations.cc:260).
-    if (response.exec_mode == ExecMode::HOST) {
-      if (st.rank == 0 && st.size > 1 &&
-          response.response_type == ResponseType::ALLREDUCE) {
-        st.host_ops->Execute(response, entries);
-      }
-      return;
-    }
+    if (response.exec_mode == ExecMode::HOST) return;
     if (response.exec_mode != ExecMode::CALLBACK || st.exec_cb == nullptr ||
         response.response_type != ResponseType::ALLREDUCE) {
       return;
@@ -291,6 +288,7 @@ void PerformOperation(GlobalState& st, const Response& response) {
 }
 
 void BackgroundThreadLoop(GlobalState& st) {
+  const auto loop_epoch = std::chrono::steady_clock::now();
   while (true) {
     auto cycle_start = std::chrono::steady_clock::now();
     st.timeline.MarkCycleStart();
@@ -298,6 +296,27 @@ void BackgroundThreadLoop(GlobalState& st) {
         st.controller->ComputeResponseList(st.shutdown_requested.load());
     for (const auto& resp : list.responses) PerformOperation(st, resp);
     if (list.shutdown) break;
+    // Autotune: rank 0 scores the window by reduction traffic and, on
+    // a parameter move, stages the new values onto the next broadcast
+    // (reference parameter-manager hook, operations.cc:635-642).
+    if (st.rank == 0 && st.param_manager.enabled()) {
+      int64_t bytes = 0;
+      for (const auto& r : list.responses) bytes += r.TotalByteSize();
+      st.param_manager.Record(bytes);  // allreduce traffic (others size 0)
+      double now = std::chrono::duration<double>(
+                       std::chrono::steady_clock::now() - loop_epoch)
+                       .count();
+      if (st.param_manager.Update(now)) {
+        st.controller->SetFusionThreshold(st.param_manager.fusion_threshold());
+        st.cycle_time_ms = st.param_manager.cycle_time_ms();
+        st.controller->StageTunedParams(st.param_manager.fusion_threshold(),
+                                        st.param_manager.cycle_time_ms());
+      }
+    } else if (st.rank != 0 && list.tuned_fusion_threshold > 0) {
+      st.controller->SetFusionThreshold(list.tuned_fusion_threshold);
+      if (list.tuned_cycle_time_ms > 0)
+        st.cycle_time_ms = list.tuned_cycle_time_ms;
+    }
     auto elapsed = std::chrono::steady_clock::now() - cycle_start;
     auto budget = std::chrono::duration<double, std::milli>(st.cycle_time_ms);
     if (elapsed < budget)
@@ -374,6 +393,13 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
       hvd::EnvDouble("HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0));
   st.stall_inspector.SetShutdownTime(
       hvd::EnvDouble("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0));
+  st.param_manager = hvd::ParameterManager();
+  st.param_manager.Initialize(
+      hvd::EnvInt64("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024),
+      st.cycle_time_ms);
+  st.param_manager.SetEnabled(hvd::EnvInt64("HOROVOD_AUTOTUNE", 0) != 0);
+  if (const char* lp = std::getenv("HOROVOD_AUTOTUNE_LOG"))
+    st.param_manager.SetLogPath(lp);
 
   hvd::ControllerDeps deps;
   deps.tensor_queue = &st.tensor_queue;
@@ -396,6 +422,9 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
       hvd::EnvInt64("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024));
   st.controller->SetRingThreshold(
       hvd::EnvInt64("HOROVOD_RING_THRESHOLD", 64 * 1024));
+  st.controller->SetTopology(local_rank, local_size, cross_rank, cross_size);
+  st.controller->SetHierarchical(
+      hvd::EnvInt64("HOROVOD_HIERARCHICAL_ALLREDUCE", 0) != 0);
   hvd::Status s = st.controller->Initialize();
   if (!s.ok()) {
     LOG_ERROR << "controller init failed: " << s.reason();

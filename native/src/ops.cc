@@ -318,6 +318,7 @@ TcpOps::TcpOps(Controller* controller, FusionBufferManager* fusion,
   // Post-sync value: rank 0's HOROVOD_RING_THRESHOLD for every rank
   // (a per-rank algorithm choice would deadlock the exchange).
   ring_threshold_bytes_ = controller->ring_threshold();
+  hierarchical_ = controller->hierarchical();
 }
 
 Status TcpOps::Execute(const Response& response,
@@ -392,6 +393,9 @@ Status TcpOps::Allreduce(const Response& r,
   if (ranks.size() > 1) {
     if (r.reduce_op == ReduceOp::ADASUM) {
       st = AdasumAllreduce(buf, dtype, tensor_elems, ranks, p);
+    } else if (HierarchicalApplicable(ranks) &&
+               total_bytes >= ring_threshold_bytes_) {
+      st = HierarchicalAllreduce(buf, total_elems, dtype, r.reduce_op);
     } else if (total_bytes >= ring_threshold_bytes_ &&
                static_cast<int>(ranks.size()) >= 3) {
       st = RingAllreduce(buf, total_elems, dtype, r.reduce_op, ranks, p);
@@ -421,46 +425,107 @@ Status TcpOps::Allreduce(const Response& r,
   return Status::OK();
 }
 
+Status TcpOps::RingReduceScatterPhase(uint8_t* buf,
+                                      const std::vector<int64_t>& offs,
+                                      DataType dtype, ReduceOp op,
+                                      const std::vector<int>& ranks, int p) {
+  // P-1 steps over element-offset chunks `offs`; chunk k starts at ring
+  // position k+1 and lands fully reduced on position k.
+  const int P = static_cast<int>(ranks.size());
+  const int64_t esize = DataTypeSize(dtype);
+  TcpConn* next = controller_->DataConn(ranks[(p + 1) % P]);
+  TcpConn* prev = controller_->DataConn(ranks[(p - 1 + P) % P]);
+  int64_t max_chunk = 0;
+  for (int k = 0; k < P; ++k)
+    max_chunk = std::max(max_chunk, offs[k + 1] - offs[k]);
+  std::vector<uint8_t> scratch(max_chunk * esize);
+  for (int s = 0; s < P - 1; ++s) {
+    int cs = ((p - s - 1) % P + P) % P, cr = ((p - s - 2) % P + P) % P;
+    if (!SendRecv(next, buf + offs[cs] * esize,
+                  (offs[cs + 1] - offs[cs]) * esize, prev, scratch.data(),
+                  (offs[cr + 1] - offs[cr]) * esize))
+      return Status::UnknownError("ring allreduce: lost data connection");
+    HostAccumulate(op, dtype, scratch.data(), buf + offs[cr] * esize,
+                   offs[cr + 1] - offs[cr]);
+  }
+  return Status::OK();
+}
+
+Status TcpOps::RingAllgatherPhase(uint8_t* buf,
+                                  const std::vector<int64_t>& offs,
+                                  DataType dtype,
+                                  const std::vector<int>& ranks, int p) {
+  // P-1 forwarding steps; position p starts owning chunk p.
+  const int P = static_cast<int>(ranks.size());
+  const int64_t esize = DataTypeSize(dtype);
+  TcpConn* next = controller_->DataConn(ranks[(p + 1) % P]);
+  TcpConn* prev = controller_->DataConn(ranks[(p - 1 + P) % P]);
+  for (int s = 0; s < P - 1; ++s) {
+    int cs = ((p - s) % P + P) % P, cr = ((p - s - 1) % P + P) % P;
+    if (!SendRecv(next, buf + offs[cs] * esize,
+                  (offs[cs + 1] - offs[cs]) * esize, prev,
+                  buf + offs[cr] * esize, (offs[cr + 1] - offs[cr]) * esize))
+      return Status::UnknownError("ring allreduce: lost data connection");
+  }
+  return Status::OK();
+}
+
 Status TcpOps::RingAllreduce(uint8_t* buf, int64_t elems, DataType dtype,
                              ReduceOp op, const std::vector<int>& ranks,
                              int p) {
   // Bandwidth-optimal ring: P-1 reduce-scatter steps + P-1 allgather
   // steps, each moving 1/P of the payload — 2·(P-1)/P · bytes per rank
-  // total, vs. 2·bytes through one socket in the v1 hub. Chunk k covers
-  // elements [offs[k], offs[k+1]); chunk k starts at rank k+1 and ends
-  // fully reduced on rank k after P-1 hops.
-  const int P = static_cast<int>(ranks.size());
-  const int64_t esize = DataTypeSize(dtype);
-  auto offs = ChunkOffsets(elems, P);
-  TcpConn* next = controller_->DataConn(ranks[(p + 1) % P]);
-  TcpConn* prev = controller_->DataConn(ranks[(p - 1 + P) % P]);
-  const int64_t max_chunk = offs[1] - offs[0];
-  std::vector<uint8_t> scratch(max_chunk * esize);
+  // total, vs. 2·bytes through one socket in the v1 hub.
+  auto offs = ChunkOffsets(elems, static_cast<int>(ranks.size()));
+  Status st = RingReduceScatterPhase(buf, offs, dtype, op, ranks, p);
+  if (!st.ok()) return st;
+  return RingAllgatherPhase(buf, offs, dtype, ranks, p);
+}
 
-  auto chunk_of = [&](int step, int shift) {
-    return ((p - step - shift) % P + P) % P;
-  };
-  // Reduce-scatter phase.
-  for (int s = 0; s < P - 1; ++s) {
-    int cs = chunk_of(s, 1), cr = chunk_of(s, 2);
-    int64_t sbytes = (offs[cs + 1] - offs[cs]) * esize;
-    int64_t rbytes = (offs[cr + 1] - offs[cr]) * esize;
-    if (!SendRecv(next, buf + offs[cs] * esize, sbytes, prev, scratch.data(),
-                  rbytes))
-      return Status::UnknownError("ring allreduce: lost data connection");
-    HostAccumulate(op, dtype, scratch.data(), buf + offs[cr] * esize,
-                   offs[cr + 1] - offs[cr]);
-  }
-  // Allgather phase: rank p now owns fully-reduced chunk p.
-  for (int s = 0; s < P - 1; ++s) {
-    int cs = chunk_of(s, 0), cr = chunk_of(s, 1);
-    int64_t sbytes = (offs[cs + 1] - offs[cs]) * esize;
-    int64_t rbytes = (offs[cr + 1] - offs[cr]) * esize;
-    if (!SendRecv(next, buf + offs[cs] * esize, sbytes, prev,
-                  buf + offs[cr] * esize, rbytes))
-      return Status::UnknownError("ring allreduce: lost data connection");
-  }
-  return Status::OK();
+Status TcpOps::HierarchicalAllreduce(uint8_t* buf, int64_t elems,
+                                     DataType dtype, ReduceOp op) {
+  // Two-level decomposition (reference NCCLHierarchicalAllreduce,
+  // nccl_operations.cc:187-360: intra-node reduce-scatter → cross-node
+  // allreduce → intra-node allgather). On TPU pods the analog is
+  // ICI-intra-slice + DCN-cross-slice; on the host plane "node" =
+  // the local_rank group. Requires the homogeneous node-major layout
+  // the launcher produces (rank = node·L + local_rank) — callers
+  // verify via HierarchicalApplicable().
+  const int rank = controller_->rank();
+  const int L = controller_->local_size();
+  const int node = rank / L, lr = rank % L;
+
+  std::vector<int> local(L);
+  for (int i = 0; i < L; ++i) local[i] = node * L + i;
+  auto offs = ChunkOffsets(elems, L);
+
+  Status st = RingReduceScatterPhase(buf, offs, dtype, op, local, lr);
+  if (!st.ok()) return st;
+
+  // Cross-node allreduce of my shard among same-local-rank peers.
+  const int C = controller_->size() / L;
+  std::vector<int> cross(C);
+  for (int k = 0; k < C; ++k) cross[k] = k * L + lr;
+  const int64_t esize = DataTypeSize(dtype);
+  st = DoublingExchange(
+      buf + offs[lr] * esize, (offs[lr + 1] - offs[lr]) * esize, cross, node,
+      [&](const uint8_t* theirs) {
+        HostAccumulate(op, dtype, theirs, buf + offs[lr] * esize,
+                       offs[lr + 1] - offs[lr]);
+        return Status::OK();
+      });
+  if (!st.ok()) return st;
+
+  return RingAllgatherPhase(buf, offs, dtype, local, lr);
+}
+
+bool TcpOps::HierarchicalApplicable(const std::vector<int>& ranks) const {
+  // Layout fitness was agreed globally at init (controller param sync);
+  // here only the per-response condition remains: the full world must
+  // contribute (join shrinks the set to something the two-level
+  // decomposition no longer tiles).
+  return hierarchical_ &&
+         static_cast<int>(ranks.size()) == controller_->size();
 }
 
 Status TcpOps::DoublingExchange(

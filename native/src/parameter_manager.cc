@@ -1,0 +1,115 @@
+#include "hvd/parameter_manager.h"
+
+#include <algorithm>
+#include <cstdlib>
+
+#include "hvd/logging.h"
+
+namespace hvd {
+
+namespace {
+constexpr int64_t kMinFusion = 1 << 10;          // 1 KB
+constexpr int64_t kMaxFusion = 256ll << 20;      // 256 MB
+constexpr double kMinCycleMs = 0.125;
+constexpr double kMaxCycleMs = 32.0;
+constexpr double kImprovement = 1.02;  // accept only >2% gains (noise floor)
+}  // namespace
+
+void ParameterManager::Initialize(int64_t fusion, double cycle_ms) {
+  fusion_ = fusion;
+  cycle_ms_ = cycle_ms;
+  best_fusion_ = fusion;
+  best_cycle_ms_ = cycle_ms;
+  if (const char* w = std::getenv("HOROVOD_AUTOTUNE_WINDOW_SECS"))
+    window_secs_ = std::atof(w);
+}
+
+void ParameterManager::SetLogPath(const std::string& path) {
+  log_.open(path, std::ios::out | std::ios::trunc);
+  if (log_.is_open())
+    log_ << "time_secs,fusion_threshold_bytes,cycle_time_ms,score_bytes_per_sec\n";
+}
+
+void ParameterManager::Record(int64_t bytes) {
+  if (enabled()) window_bytes_ += bytes;
+}
+
+void ParameterManager::LogSample(double score) {
+  if (log_.is_open()) {
+    log_ << window_start_ << "," << fusion_ << "," << cycle_ms_ << ","
+         << static_cast<int64_t>(score) << "\n";
+    log_.flush();
+  }
+}
+
+void ParameterManager::ApplyCandidate() {
+  if (dim_ == 0) {
+    int64_t next = direction_ > 0 ? fusion_ * 2 : fusion_ / 2;
+    fusion_ = std::min(kMaxFusion, std::max(kMinFusion, next));
+  } else {
+    double next = direction_ > 0 ? cycle_ms_ * 2 : cycle_ms_ / 2;
+    cycle_ms_ = std::min(kMaxCycleMs, std::max(kMinCycleMs, next));
+  }
+}
+
+bool ParameterManager::Update(double now_secs) {
+  if (!enabled()) return false;
+  if (window_start_ < 0) {
+    window_start_ = now_secs;
+    window_bytes_ = 0;
+    return false;
+  }
+  double elapsed = now_secs - window_start_;
+  if (elapsed < window_secs_) return false;
+
+  double score = window_bytes_ / elapsed;
+  window_start_ = now_secs;
+  window_bytes_ = 0;
+  if (settling_) {
+    // First window after a parameter change carries mixed traffic;
+    // throw it away and measure the next one clean.
+    settling_ = false;
+    return false;
+  }
+  LogSample(score);
+
+  const int64_t old_fusion = fusion_;
+  const double old_cycle = cycle_ms_;
+
+  if (score > best_score_ * kImprovement) {
+    // Current point is the new best: keep walking the same direction.
+    best_score_ = score;
+    best_fusion_ = fusion_;
+    best_cycle_ms_ = cycle_ms_;
+    tried_other_dir_ = false;
+    stale_dims_ = 0;
+    ApplyCandidate();
+  } else {
+    // Worse (or flat): back off to the best point and pick the next
+    // move — opposite direction first, then the other knob.
+    fusion_ = best_fusion_;
+    cycle_ms_ = best_cycle_ms_;
+    if (!tried_other_dir_) {
+      tried_other_dir_ = true;
+      direction_ = -direction_;
+      ApplyCandidate();
+    } else {
+      tried_other_dir_ = false;
+      direction_ = +1;
+      if (++stale_dims_ >= 2) {
+        converged_ = true;
+        LOG_INFO << "autotune converged: fusion_threshold=" << fusion_
+                 << " cycle_time_ms=" << cycle_ms_
+                 << " (score " << static_cast<int64_t>(best_score_)
+                 << " B/s)";
+      } else {
+        dim_ = 1 - dim_;
+        ApplyCandidate();
+      }
+    }
+  }
+  settling_ = true;
+  return fusion_ != old_fusion || cycle_ms_ != old_cycle || converged_;
+}
+
+}  // namespace hvd

@@ -1,0 +1,77 @@
+"""Autotune (parameter manager) + observability: the tuner must
+demonstrably move the fusion threshold / cycle time on a synthetic run
+and log its samples (reference parameter_manager.h:42-246 +
+--autotune-log-file); the timeline must carry per-rank readiness ticks
+(reference controller.cc:950-962)."""
+
+import csv
+import json
+import os
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+from test_eager_multiprocess import run_job
+
+
+def test_autotune_moves_parameters(tmp_path):
+    """Single-process synthetic run: steady allreduce traffic, tiny
+    windows — the hill climber must sample several parameter points and
+    write them to the CSV log."""
+    log = str(tmp_path / "autotune.csv")
+    hvd.shutdown()
+    os.environ.update({
+        "HOROVOD_AUTOTUNE": "1",
+        "HOROVOD_AUTOTUNE_WINDOW_SECS": "0.05",
+        "HOROVOD_AUTOTUNE_LOG": log,
+        "HOROVOD_CYCLE_TIME": "0.5",
+    })
+    try:
+        hvd.init()
+        deadline = time.monotonic() + 4.0
+        i = 0
+        while time.monotonic() < deadline:
+            hvd.allreduce(np.ones(4096, np.float32), op=hvd.Sum,
+                          name=f"at.{i % 4}")
+            i += 1
+        hvd.shutdown()
+        with open(log) as f:
+            rows = list(csv.DictReader(f))
+        assert len(rows) >= 3, rows
+        fusions = {r["fusion_threshold_bytes"] for r in rows}
+        cycles = {r["cycle_time_ms"] for r in rows}
+        # The walk must actually move at least one knob.
+        assert len(fusions) > 1 or len(cycles) > 1, (fusions, cycles)
+        assert all(int(r["score_bytes_per_sec"]) >= 0 for r in rows)
+    finally:
+        for k in ("HOROVOD_AUTOTUNE", "HOROVOD_AUTOTUNE_WINDOW_SECS",
+                  "HOROVOD_AUTOTUNE_LOG", "HOROVOD_CYCLE_TIME"):
+            os.environ.pop(k, None)
+        hvd.init()
+
+
+def test_autotune_multiprocess_sync():
+    """np=2 with autotune on: tuned values ride the broadcast
+    ResponseList; the job must stay protocol-correct end to end."""
+    run_job("matrix", 2, extra_env={
+        "HOROVOD_AUTOTUNE": "1",
+        "HOROVOD_AUTOTUNE_WINDOW_SECS": "0.05",
+    })
+
+
+def test_timeline_rank_ready_ticks(tmp_path):
+    path = str(tmp_path / "timeline.json")
+    hvd.start_timeline(path)
+    for i in range(3):
+        hvd.allreduce(np.ones(8, np.float32), op=hvd.Sum, name=f"tlr.{i}")
+    hvd.stop_timeline()
+    raw = open(path).read().rstrip().rstrip(",")
+    events = json.loads(raw + "]" if not raw.endswith("]") else raw)
+    # Instant ('i') readiness ticks on the negotiating tensor rows,
+    # tagged with the announcing rank.
+    ticks = [e for e in events
+             if e.get("ph") == "i" and str(e.get("name", "")) == "0"
+             and str(e.get("tid", "")).startswith("tlr.")]
+    assert ticks, [e for e in events if e.get("ph") == "i"][:5]
