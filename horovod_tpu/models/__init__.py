@@ -9,6 +9,12 @@ live here, written JAX-first (bf16 matmuls on the MXU, static shapes,
 scan-over-layers for compile time, explicit mesh shardings).
 """
 
+from horovod_tpu.models.moe import (  # noqa: F401
+    MoEConfig,
+    init_moe_params,
+    moe_ffn,
+    moe_param_specs,
+)
 from horovod_tpu.models.resnet import ResNetConfig, resnet50, resnet101  # noqa: F401
 from horovod_tpu.models.transformer import (  # noqa: F401
     TransformerConfig,

@@ -1,0 +1,132 @@
+"""Mixture-of-Experts FFN with expert parallelism over the ``ep`` mesh
+axis.
+
+The reference has no MoE (its only relevant primitive is alltoall,
+``horovod/common/operations.cc:1131`` — SURVEY.md §2.6 explicitly maps
+MoE expert dispatch onto it). The TPU-native design is the GShard
+dense-dispatch formulation: routing builds one-hot dispatch/combine
+tensors and the expert dimension is *sharded over* ``ep``, so GSPMD
+lowers the two dispatch einsums to ICI all-to-alls — no hand-written
+collectives, fully fused by XLA, and differentiable end to end.
+
+Shapes (per layer): tokens ``[B, T, D]``, experts ``E``, per-group
+capacity ``C = ceil(k · T · capacity_factor / E)`` with groups = batch
+rows. Top-k (default 2) gating with the standard load-balancing
+auxiliary loss (Switch/GShard form).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_coef: float = 0.01
+
+
+def capacity(cfg: MoEConfig, seq_len: int) -> int:
+    return max(1, math.ceil(cfg.top_k * seq_len * cfg.capacity_factor
+                            / cfg.n_experts))
+
+
+def moe_param_specs(n_layers_leading: bool = True) -> Dict[str, Any]:
+    """PartitionSpecs for one MoE FFN block (leading ``L`` dim when
+    stacked for the layer scan): experts over ``ep``, matrix dims over
+    ``fsdp``/``tp`` like the dense FFN."""
+    lead = (None,) if n_layers_leading else ()
+    return {
+        "router": P(*lead, None, None),           # [L?, D, E] replicated
+        "w_gate": P(*lead, "ep", "fsdp", "tp"),   # [L?, E, D, F]
+        "w_up": P(*lead, "ep", "fsdp", "tp"),
+        "w_down": P(*lead, "ep", "tp", "fsdp"),   # [L?, E, F, D]
+    }
+
+
+def init_moe_params(key, n_layers: int, d_model: int, d_ff: int,
+                    cfg: MoEConfig, dtype) -> Dict[str, Any]:
+    kr, kg, ku, kd = jax.random.split(key, 4)
+    L, D, F, E = n_layers, d_model, d_ff, cfg.n_experts
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(dtype)
+
+    return {
+        # Router in f32: small, and routing decisions are precision-
+        # sensitive (standard practice).
+        "router": (jax.random.normal(kr, (L, D, E), jnp.float32) * D ** -0.5),
+        "w_gate": dense(kg, (L, E, D, F), D),
+        "w_up": dense(ku, (L, E, D, F), D),
+        "w_down": dense(kd, (L, E, F, D), F),
+    }
+
+
+def moe_ffn(x, lp, cfg: MoEConfig):
+    """One MoE FFN block. ``x``: [B, T, D] (cfg.dtype); ``lp``: this
+    layer's param dict (no leading L). Returns (y [B, T, D], aux_loss
+    scalar f32).
+
+    Dispatch math follows GShard: one-hot ``dispatch [B,T,E,C]``
+    scatters tokens into per-expert capacity slots, the ``ebcd``
+    einsums move tokens to the ``ep``-sharded expert dim (GSPMD →
+    all-to-all over ICI), experts run SwiGLU batched over their local
+    shard, and ``combine`` (dispatch × gate prob) returns weighted
+    outputs. Tokens over capacity are dropped (their residual path
+    passes through unchanged — standard Switch behavior).
+    """
+    B, T, D = x.shape
+    E, K = cfg.n_experts, cfg.top_k
+    C = capacity(cfg, T)
+
+    logits = jnp.einsum("btd,de->bte", x.astype(jnp.float32), lp["router"])
+    probs = jax.nn.softmax(logits, axis=-1)            # [B, T, E]
+
+    # Top-k expert choice per token.
+    gate_vals, gate_idx = jax.lax.top_k(probs, K)      # [B, T, K]
+    # Renormalize the chosen gates (GShard: combine weights sum to 1).
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(-1, keepdims=True), 1e-9)
+
+    # Capacity positions: for the k-th choice, a token's slot in expert
+    # e is the number of earlier (token-major, choice-major) claims on
+    # e. Flatten choices so priorities are (t, k) ordered.
+    sel = jax.nn.one_hot(gate_idx, E, dtype=jnp.float32)   # [B, T, K, E]
+    # (t, k) priority: token t's k-th choice claims a slot before any
+    # claim of token t+1.
+    sel_flat = sel.reshape(B, T * K, E)
+    pos = jnp.cumsum(sel_flat, axis=1) - sel_flat      # claims before mine
+    pos = pos.reshape(B, T, K, E)
+    within = (pos < C) * sel                           # keep under-capacity
+    slot = pos.astype(jnp.int32)
+
+    # dispatch [B, T, E, C]: 1 where token (b,t) occupies slot c of e.
+    slot_oh = jax.nn.one_hot(slot, C, dtype=jnp.float32)   # [B, T, K, E, C]
+    dispatch = jnp.einsum("btke,btkec->btec", within, slot_oh)
+    combine = jnp.einsum("btk,btke,btkec->btec",
+                         gate_vals, within, slot_oh)
+
+    # Load-balancing aux loss (Switch eq. 4): E * sum_e f_e * p_e with
+    # f = fraction of tokens whose TOP-1 lands on e, p = mean prob.
+    top1 = jax.nn.one_hot(gate_idx[..., 0], E, dtype=jnp.float32)
+    aux = cfg.aux_loss_coef * E * jnp.sum(
+        top1.mean((0, 1)) * probs.mean((0, 1)))
+
+    # To experts (ep all-to-all by GSPMD), run SwiGLU, and back.
+    xin = jnp.einsum("btec,btd->ebcd", dispatch.astype(x.dtype), x)
+    g = jax.nn.silu(jnp.einsum("ebcd,edf->ebcf", xin,
+                               lp["w_gate"]).astype(jnp.float32))
+    u = jnp.einsum("ebcd,edf->ebcf", xin, lp["w_up"]).astype(jnp.float32)
+    h = (g * u).astype(x.dtype)
+    xout = jnp.einsum("ebcf,efd->ebcd", h, lp["w_down"])
+    y = jnp.einsum("btec,ebcd->btd", combine.astype(x.dtype), xout)
+    return y.astype(x.dtype), aux

@@ -35,6 +35,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from horovod_tpu.models import moe as moe_lib
 from horovod_tpu.parallel.ring_attention import make_sp_attention
 
 
@@ -52,6 +53,12 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16    # params/activations; reductions in f32
     remat: bool = True           # jax.checkpoint each layer (HBM for FLOPs)
     sp_attention: str = "ring"   # "ring" | "ulysses" | "local"
+    # Mixture-of-Experts: n_experts > 0 replaces the dense SwiGLU FFN
+    # with an expert-parallel MoE FFN in every layer (experts sharded
+    # over the `ep` mesh axis; see models/moe.py).
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -69,6 +76,14 @@ class TransformerConfig:
         return cls(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
                    n_kv_heads=2, d_ff=128, max_seq=128, **kw)
 
+    @property
+    def moe(self) -> Optional[moe_lib.MoEConfig]:
+        if self.n_experts <= 0:
+            return None
+        return moe_lib.MoEConfig(n_experts=self.n_experts,
+                                 top_k=self.moe_top_k,
+                                 capacity_factor=self.moe_capacity_factor)
+
 
 # ---------------------------------------------------------------------------
 # Parameter init + sharding specs
@@ -81,19 +96,25 @@ def param_specs(cfg: TransformerConfig) -> Dict[str, Any]:
     other matrix dim. Layer-stacked leaves carry a leading ``None``
     (the scan dim is never sharded).
     """
-    return {
-        "embed": P("tp", "fsdp"),          # [V, D] vocab-parallel
-        "layers": {
-            "attn_norm": P(None, None),    # [L, D]
-            "wq": P(None, "fsdp", "tp"),   # [L, D, H*Dh]
-            "wk": P(None, "fsdp", "tp"),   # [L, D, Hkv*Dh]
-            "wv": P(None, "fsdp", "tp"),
-            "wo": P(None, "tp", "fsdp"),   # [L, H*Dh, D]
-            "mlp_norm": P(None, None),
+    layers: Dict[str, Any] = {
+        "attn_norm": P(None, None),    # [L, D]
+        "wq": P(None, "fsdp", "tp"),   # [L, D, H*Dh]
+        "wk": P(None, "fsdp", "tp"),   # [L, D, Hkv*Dh]
+        "wv": P(None, "fsdp", "tp"),
+        "wo": P(None, "tp", "fsdp"),   # [L, H*Dh, D]
+        "mlp_norm": P(None, None),
+    }
+    if cfg.moe is not None:
+        layers["moe"] = moe_lib.moe_param_specs()
+    else:
+        layers.update({
             "w_gate": P(None, "fsdp", "tp"),  # [L, D, F]
             "w_up": P(None, "fsdp", "tp"),
             "w_down": P(None, "tp", "fsdp"),  # [L, F, D]
-        },
+        })
+    return {
+        "embed": P("tp", "fsdp"),          # [V, D] vocab-parallel
+        "layers": layers,
         "final_norm": P(None),
         "lm_head": P("fsdp", "tp"),        # [D, V]
     }
@@ -113,19 +134,25 @@ def init_params(cfg: TransformerConfig, key: jax.Array,
         return (jax.random.normal(kk, shape, jnp.float32)
                 * (fan_in ** -0.5)).astype(dt)
 
-    params = {
-        "embed": dense(next(k), (V, D), D),
-        "layers": {
-            "attn_norm": jnp.ones((L, D), dt),
-            "wq": dense(next(k), (L, D, H * Dh), D),
-            "wk": dense(next(k), (L, D, Hkv * Dh), D),
-            "wv": dense(next(k), (L, D, Hkv * Dh), D),
-            "wo": dense(next(k), (L, H * Dh, D), H * Dh),
-            "mlp_norm": jnp.ones((L, D), dt),
+    layers = {
+        "attn_norm": jnp.ones((L, D), dt),
+        "wq": dense(next(k), (L, D, H * Dh), D),
+        "wk": dense(next(k), (L, D, Hkv * Dh), D),
+        "wv": dense(next(k), (L, D, Hkv * Dh), D),
+        "wo": dense(next(k), (L, H * Dh, D), H * Dh),
+        "mlp_norm": jnp.ones((L, D), dt),
+    }
+    if cfg.moe is not None:
+        layers["moe"] = moe_lib.init_moe_params(next(k), L, D, F, cfg.moe, dt)
+    else:
+        layers.update({
             "w_gate": dense(next(k), (L, D, F), D),
             "w_up": dense(next(k), (L, D, F), D),
             "w_down": dense(next(k), (L, F, D), F),
-        },
+        })
+    params = {
+        "embed": dense(next(k), (V, D), D),
+        "layers": layers,
         "final_norm": jnp.ones((D,), dt),
         "lm_head": dense(next(k), (D, V), D),
     }
@@ -172,70 +199,94 @@ def _attention_island(cfg: TransformerConfig, mesh: Optional[Mesh]):
                              causal=True)
 
 
-def forward(params, tokens, cfg: TransformerConfig,
-            mesh: Optional[Mesh] = None):
-    """tokens ``[B, T]`` int32 → logits ``[B, T, V]`` (cfg.dtype).
-
-    With a mesh: activations constrained to ``P(('dp','fsdp'), 'sp')``
-    on [B, T] dims; attention heads tp-sharded by GSPMD propagation from
-    the weight specs.
-    """
-    H, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    B, T = tokens.shape
-
+def _constrainer(mesh: Optional[Mesh]):
     def constrain(x, *spec):
         if mesh is not None:
             return lax.with_sharding_constraint(
                 x, NamedSharding(mesh, P(*spec)))
         return x
+    return constrain
 
+
+def decoder_layer(cfg: TransformerConfig, attend, constrain, x, lp):
+    """One pre-norm decoder block (attention + FFN/MoE) on ``x``
+    [B, T, D]; ``lp`` is this layer's param dict (no leading L dim).
+    Returns (x, aux_loss) — aux is 0 for dense FFN, the load-balancing
+    term for MoE. Module-level so both the layer scan and the pipeline
+    stage function build on it."""
+    H, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    B, T = x.shape[0], x.shape[1]
+    pos = jnp.arange(T)
+
+    h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"]).reshape(B, T, H, Dh)
+    kk = (h @ lp["wk"]).reshape(B, T, Hkv, Dh)
+    vv = (h @ lp["wv"]).reshape(B, T, Hkv, Dh)
+    q = _rope(q, pos, cfg.rope_theta)
+    kk = _rope(kk, pos, cfg.rope_theta)
+    if Hkv != H:  # GQA: tile kv heads up to H
+        rep = H // Hkv
+        kk = jnp.repeat(kk, rep, axis=2)
+        vv = jnp.repeat(vv, rep, axis=2)
+    o = attend(q, kk, vv).reshape(B, T, H * Dh)
+    x = x + (o @ lp["wo"]).astype(cfg.dtype)
+    x = constrain(x, ("dp", "fsdp"), "sp", None)
+
+    h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+    if cfg.moe is not None:
+        y, aux = moe_lib.moe_ffn(h, lp["moe"], cfg.moe)
+        x = x + y.astype(cfg.dtype)
+    else:
+        g = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32))
+        u = (h @ lp["w_up"]).astype(jnp.float32)
+        x = x + ((g * u).astype(cfg.dtype) @ lp["w_down"]).astype(cfg.dtype)
+        aux = jnp.zeros((), jnp.float32)
+    x = constrain(x, ("dp", "fsdp"), "sp", None)
+    return x, aux
+
+
+def forward_with_aux(params, tokens, cfg: TransformerConfig,
+                     mesh: Optional[Mesh] = None):
+    """tokens ``[B, T]`` int32 → (logits ``[B, T, V]``, aux_loss).
+
+    With a mesh: activations constrained to ``P(('dp','fsdp'), 'sp')``
+    on [B, T] dims; attention heads tp-sharded by GSPMD propagation from
+    the weight specs.
+    """
+    constrain = _constrainer(mesh)
     attend = _attention_island(cfg, mesh)
-    pos = jnp.arange(T)  # global positions; T is the full sequence
 
     x = params["embed"].astype(cfg.dtype)[tokens]
     x = constrain(x, ("dp", "fsdp"), "sp", None)
 
     def layer(x, lp):
-        h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
-        q = (h @ lp["wq"]).reshape(B, T, H, Dh)
-        kk = (h @ lp["wk"]).reshape(B, T, Hkv, Dh)
-        vv = (h @ lp["wv"]).reshape(B, T, Hkv, Dh)
-        q = _rope(q, pos, cfg.rope_theta)
-        kk = _rope(kk, pos, cfg.rope_theta)
-        if Hkv != H:  # GQA: tile kv heads up to H
-            rep = H // Hkv
-            kk = jnp.repeat(kk, rep, axis=2)
-            vv = jnp.repeat(vv, rep, axis=2)
-        o = attend(q, kk, vv).reshape(B, T, H * Dh)
-        x = x + (o @ lp["wo"]).astype(cfg.dtype)
-        x = constrain(x, ("dp", "fsdp"), "sp", None)
-
-        h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
-        g = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32))
-        u = (h @ lp["w_up"]).astype(jnp.float32)
-        x = x + ((g * u).astype(cfg.dtype) @ lp["w_down"]).astype(cfg.dtype)
-        x = constrain(x, ("dp", "fsdp"), "sp", None)
-        return x, None
+        return decoder_layer(cfg, attend, constrain, x, lp)
 
     if cfg.remat:
         layer = jax.checkpoint(layer)
 
-    x, _ = lax.scan(layer, x, params["layers"])
+    x, auxes = lax.scan(layer, x, params["layers"])
     x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = x @ params["lm_head"]
-    return constrain(logits, ("dp", "fsdp"), "sp", "tp")
+    return constrain(logits, ("dp", "fsdp"), "sp", "tp"), auxes.sum()
+
+
+def forward(params, tokens, cfg: TransformerConfig,
+            mesh: Optional[Mesh] = None):
+    """tokens ``[B, T]`` int32 → logits ``[B, T, V]`` (cfg.dtype)."""
+    return forward_with_aux(params, tokens, cfg, mesh)[0]
 
 
 def lm_loss(params, batch, cfg: TransformerConfig,
             mesh: Optional[Mesh] = None):
     """Next-token cross-entropy (f32 log-softmax) over ``batch["tokens"]``
-    [B, T+1]; returns scalar mean loss."""
+    [B, T+1] plus the MoE load-balancing aux term; returns scalar."""
     tokens = batch["tokens"]
     inp, tgt = tokens[:, :-1], tokens[:, 1:]
-    logits = forward(params, inp, cfg, mesh).astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
+    logits, aux = forward_with_aux(params, inp, cfg, mesh)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-    return nll.mean()
+    return nll.mean() + aux
 
 
 # ---------------------------------------------------------------------------

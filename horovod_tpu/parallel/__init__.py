@@ -6,3 +6,9 @@ from horovod_tpu.parallel.mesh import (  # noqa: F401
     set_current_mesh,
     mesh_scope,
 )
+from horovod_tpu.parallel.pipeline import (  # noqa: F401
+    make_pp_train_step,
+    pipeline_apply,
+    pp_param_specs,
+    pp_reshape_layers,
+)

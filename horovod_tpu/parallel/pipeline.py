@@ -1,0 +1,223 @@
+"""Pipeline parallelism over the ``pp`` mesh axis.
+
+The reference is DP-only (SURVEY.md §2.6) — pipeline parallelism is a
+TPU-first addition. Design: a **GPipe microbatch schedule written as a
+``shard_map`` island, manual over ``pp`` only** (``axis_names={"pp"}``),
+so GSPMD keeps handling tp/fsdp sharding *inside* every stage:
+
+* every pp rank holds one stage's slice of the layer-stacked params
+  (leading dim ``S`` sharded over ``pp``);
+* one ``lax.scan`` over ``M + S - 1`` ticks; each tick every stage
+  runs its block on its current microbatch and ``ppermute``-shifts the
+  activation one hop down the chain (stage 0 ingests a fresh
+  microbatch, the last stage banks its output);
+* outputs are replicated back to all pp ranks with a masked ``psum``.
+
+The schedule is differentiable end to end (``jax.grad`` reverses the
+scan and the ppermutes), giving GPipe's forward-then-backward with a
+bubble fraction of ``(S-1)/(M+S-1)`` — raise ``n_micro`` to amortize.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _stage_specs(stage_params) -> Any:
+    """Leading dim of every leaf is the stage dim → shard over pp."""
+    return jax.tree.map(
+        lambda a: P("pp", *([None] * (jnp.ndim(a) - 1))), stage_params)
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, microbatches, *,
+                   mesh: Mesh, axis_name: str = "pp",
+                   remat_stage: bool = True):
+    """Run ``microbatches [M, mb, ...]`` through ``S`` pipeline stages.
+
+    ``stage_fn(params_slice, x) -> y`` must preserve ``x``'s
+    shape/dtype (decoder blocks do); ``stage_params`` leaves carry a
+    leading stage dim of size ``S = mesh.shape[axis_name]``. Returns
+    outputs shaped like ``microbatches``, replicated over ``pp``.
+    """
+    S = mesh.shape[axis_name]
+    M = microbatches.shape[0]
+    fn = jax.checkpoint(stage_fn) if remat_stage else stage_fn
+    # XLA-CPU workaround: under partial-manual shard_map the Shardy
+    # partitioner leaves a sharding_constraint inside all-reduce reducer
+    # regions, and the CPU AllReducePromotion pass aborts cloning any
+    # BF16 all-reduce shaped like that ("Invalid binary instruction
+    # opcode copy"). Every shard_map-level psum here — the forward
+    # output replication AND the autodiff transpose psum at the
+    # replicated-microbatch boundary — must therefore be f32 on CPU.
+    # TPU reduces bf16 natively and skips all of this.
+    dtype = microbatches.dtype
+    f32_wire = (jax.default_backend() == "cpu" and dtype == jnp.bfloat16)
+    if f32_wire:
+        microbatches = microbatches.astype(jnp.float32)
+
+    def island(sp, mb):
+        local = jax.tree.map(lambda a: a[0], sp)   # my stage's slice
+        idx = lax.axis_index(axis_name)
+        if f32_wire:
+            # Make mb pp-varying FIRST (adding a varying zero), THEN
+            # cast down: the replicated→varying boundary is where
+            # autodiff inserts its transpose psum, and it must sit on
+            # the f32 side of the cast.
+            mb = (mb + (idx * 0).astype(mb.dtype)).astype(dtype)
+
+        def tick(carry, t):
+            acts, outs = carry
+            m = t - idx                             # my microbatch index
+            mc = jnp.clip(m, 0, M - 1)
+            x0 = lax.dynamic_index_in_dim(mb, mc, 0, keepdims=False)
+            inp = jnp.where(idx == 0, x0, acts)
+            y = fn(local, inp)
+            bank = (m >= 0) & (m < M) & (idx == S - 1)
+            outs = jnp.where(bank,
+                             lax.dynamic_update_index_in_dim(outs, y, mc, 0),
+                             outs)
+            # Shift down the chain (no wraparound: stage 0's next input
+            # comes from mb, the last stage's output was banked).
+            acts = lax.ppermute(y, axis_name,
+                                [(i, i + 1) for i in range(S - 1)])
+            return (acts, outs), None
+
+        # The zeros are constant across pp but the loop makes them
+        # device-varying, so the scan carry needs a varying type on
+        # both sides. Adding a varying zero (derived from axis_index)
+        # does that WITHOUT lax.pcast: pcast's transpose is a psum over
+        # pp, and XLA's CPU AllReducePromotion pass crashes on the
+        # resulting bf16 all-reduce; the add's transpose stays local.
+        vzero = (idx * 0).astype(mb.dtype)
+        init = jax.tree.map(lambda a: a + vzero,
+                            (jnp.zeros_like(mb[0]), jnp.zeros_like(mb)))
+        (_, outs), _ = lax.scan(tick, init, jnp.arange(M + S - 1))
+        # Only the last stage's bank is real; replicate it everywhere
+        # (f32 on the wire under the CPU workaround above).
+        masked = jnp.where(idx == S - 1, outs, jnp.zeros_like(outs))
+        if f32_wire:
+            outs = lax.psum(masked.astype(jnp.float32),
+                            axis_name).astype(dtype)
+        else:
+            outs = lax.psum(masked, axis_name)
+        return outs
+
+    return shard_map(island, mesh=mesh,
+                     in_specs=(_stage_specs(stage_params), P()),
+                     out_specs=P(), axis_names={axis_name})(
+                         stage_params, microbatches)
+
+
+# ---------------------------------------------------------------------------
+# Transformer integration
+# ---------------------------------------------------------------------------
+
+def pp_reshape_layers(params, n_stages: int):
+    """[L, ...]-stacked layer leaves → [S, L/S, ...] for the stage dim."""
+    def reshape(a):
+        L = a.shape[0]
+        if L % n_stages:
+            raise ValueError(
+                f"n_layers={L} not divisible by pp={n_stages}")
+        return a.reshape(n_stages, L // n_stages, *a.shape[1:])
+    return {**params, "layers": jax.tree.map(reshape, params["layers"])}
+
+
+def pp_param_specs(cfg, n_stages: int):
+    """Sharding specs matching :func:`pp_reshape_layers`: stage dim over
+    ``pp``, the rest as in the flat model."""
+    from horovod_tpu.models import transformer as tr
+
+    base = tr.param_specs(cfg)
+    def respecs(s):
+        return P("pp", *s)  # s already leads with None for the L dim
+    return {**base, "layers": jax.tree.map(
+        respecs, base["layers"], is_leaf=lambda x: isinstance(x, P))}
+
+
+def make_pp_train_step(cfg, mesh: Mesh, n_micro: int, optimizer=None):
+    """GPipe training step for the transformer over a mesh with pp>1
+    (compose with dp/fsdp/tp as usual; sp inside a pipeline stage is
+    not supported yet — use ring attention without pp, or pp with full
+    sequences per stage).
+
+    Returns ``(init_state, jit_step, param_shardings)`` like
+    :func:`horovod_tpu.models.transformer.make_train_step`.
+    """
+    import optax
+
+    from horovod_tpu.models import transformer as tr
+
+    if cfg.moe is not None:
+        raise NotImplementedError(
+            "pp + MoE composition is not supported yet (the aux loss "
+            "does not thread through the pipeline schedule)")
+    if mesh.shape.get("sp", 1) > 1:
+        raise NotImplementedError(
+            "pp + sp composition is not supported yet (the pipeline "
+            "island owns the manual axis; use ring attention without "
+            "pp, or pp with full sequences per stage)")
+    if optimizer is None:
+        optimizer = optax.adamw(3e-4, weight_decay=0.01)
+    S = mesh.shape["pp"]
+    constrain = tr._constrainer(mesh)
+    # Plain attention per stage (the sp>1 case is rejected above).
+    attend = tr._attention_island(
+        dataclasses.replace(cfg, sp_attention="local"), None)
+
+    def stage_fn(stage_layers, x):
+        def one(x, lp):
+            y, _aux = tr.decoder_layer(cfg, attend, lambda v, *s: v, x, lp)
+            return y, None
+        y, _ = lax.scan(one, x, stage_layers)
+        return y
+
+    def loss_fn(params, batch):
+        tokens = batch["tokens"]
+        inp, tgt = tokens[:, :-1], tokens[:, 1:]
+        B, T = inp.shape
+        if B % n_micro:
+            raise ValueError(f"batch {B} not divisible by n_micro={n_micro}")
+        x = params["embed"].astype(cfg.dtype)[inp]
+        x = constrain(x, ("dp", "fsdp"), None, None)
+        mb = x.reshape(n_micro, B // n_micro, T, x.shape[-1])
+        y = pipeline_apply(stage_fn, params["layers"], mb, mesh=mesh,
+                           remat_stage=cfg.remat)
+        x = y.reshape(B, T, -1)
+        x = tr._rmsnorm(x, params["final_norm"], cfg.norm_eps)
+        logits = (x @ params["lm_head"]).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+        return nll.mean()
+
+    specs = pp_param_specs(cfg, S)
+
+    def init_state(key):
+        params = pp_reshape_layers(tr.init_params(cfg, key), S)
+        shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                                 is_leaf=lambda x: isinstance(x, P))
+        params = jax.device_put(params, shardings)
+        return {"params": params, "opt": optimizer.init(params),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def step(state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"], batch)
+        updates, new_opt = optimizer.update(grads, state["opt"],
+                                            state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return {"params": params, "opt": new_opt,
+                "step": state["step"] + 1}, loss
+
+    param_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                            is_leaf=lambda x: isinstance(x, P))
+    batch_sh = {"tokens": NamedSharding(mesh, P(("dp", "fsdp"), None))}
+    jit_step = jax.jit(step, donate_argnums=(0,),
+                       in_shardings=(None, batch_sh),
+                       out_shardings=(None, NamedSharding(mesh, P())))
+    return init_state, jit_step, param_sh
