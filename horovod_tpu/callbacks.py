@@ -1,0 +1,196 @@
+"""Training-loop callbacks — the reference's Keras callback family
+(``horovod/_keras/callbacks.py:23-179``) rebuilt framework-neutral.
+
+The reference ships five callbacks for Keras's ``model.fit`` loop;
+this framework has no house loop, so the same behaviors are exposed as
+small objects with ``on_train_begin`` / ``on_epoch_end(epoch,
+metrics)`` hooks plus plain functions usable from any loop:
+
+* :class:`BroadcastParametersCallback` — rank-0 state sync at start
+  (``BroadcastGlobalVariablesCallback``).
+* :class:`MetricAverageCallback` / :func:`average_metrics` — epoch-end
+  cross-rank metric averaging (``MetricAverageCallback``,
+  ``_keras/callbacks.py:49-92``).
+* :class:`LearningRateScheduleCallback` /
+  :class:`LearningRateWarmupCallback` — multiplier schedules incl. the
+  gradual-warmup recipe (lr ramps to ``base_lr * size`` — Goyal et al.,
+  the reference's ``LearningRateWarmupCallback``).
+* :func:`warmup_schedule` — the same recipe as an optax schedule for
+  the jitted JAX path (schedules must be traced, not driven by Python
+  callbacks, on TPU).
+* :class:`BestModelCheckpoint` — rank-0 saves on metric improvement
+  (``keras/callbacks.py:151``).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+import horovod_tpu.api as api
+from horovod_tpu.common.ops_enum import Average
+
+
+class Callback:
+    def on_train_begin(self, state: Any = None) -> Any:
+        return state
+
+    def on_epoch_end(self, epoch: int,
+                     metrics: Optional[Dict[str, float]] = None,
+                     state: Any = None) -> Any:
+        return state
+
+
+class BroadcastParametersCallback(Callback):
+    """Sync initial state from ``root_rank`` before training (the
+    reference's ``BroadcastGlobalVariablesCallback``)."""
+
+    def __init__(self, params: Any, root_rank: int = 0):
+        self.params = params
+        self.root_rank = root_rank
+
+    @staticmethod
+    def _is_torch(params: Any) -> bool:
+        """True for torch modules, state_dicts, and (name, tensor)
+        sequences (the ``model.named_parameters()`` shape the torch
+        path consumes)."""
+        mod = type(params).__module__
+        if mod.startswith("torch"):
+            return True
+        if isinstance(params, dict) and params:
+            probe = next(iter(params.values()))
+        elif isinstance(params, (list, tuple)) and params:
+            first = params[0]
+            probe = first[1] if (isinstance(first, tuple)
+                                 and len(first) == 2) else first
+        else:
+            return False
+        return type(probe).__module__.startswith("torch")
+
+    def on_train_begin(self, state: Any = None) -> Any:
+        if self._is_torch(self.params):
+            from horovod_tpu.torch.functions import broadcast_parameters
+            broadcast_parameters(self.params, self.root_rank)
+            return state
+        from horovod_tpu.jax import broadcast_parameters
+        self.params = broadcast_parameters(self.params, self.root_rank)
+        return self.params
+
+
+def average_metrics(metrics: Dict[str, float],
+                    name: str = "metric_avg") -> Dict[str, float]:
+    """Average scalar metrics across ranks (one fused allreduce)."""
+    if not metrics or api.size() == 1:
+        return dict(metrics)
+    keys = sorted(metrics)
+    vec = np.asarray([float(metrics[k]) for k in keys], np.float64)
+    out = api.allreduce(vec, op=Average, name=name)
+    return {k: float(v) for k, v in zip(keys, out)}
+
+
+class MetricAverageCallback(Callback):
+    def on_epoch_end(self, epoch, metrics=None, state=None):
+        if metrics is not None:
+            metrics.update(average_metrics(metrics, name=f"ma.{epoch % 2}"))
+        return state
+
+
+class LearningRateScheduleCallback(Callback):
+    """Set lr to ``initial_lr * multiplier(epoch)`` each epoch.
+
+    ``set_lr`` adapts to the loop's optimizer: pass a callable, or a
+    torch optimizer (param_groups updated in place, like the reference's
+    backend.set_value on Keras)."""
+
+    def __init__(self, initial_lr: float, multiplier: Callable[[int], float],
+                 set_lr=None):
+        self.initial_lr = initial_lr
+        self.multiplier = multiplier
+        self._set_lr = set_lr
+
+    def _apply(self, lr: float):
+        if self._set_lr is None:
+            return lr
+        if callable(self._set_lr):
+            self._set_lr(lr)
+            return lr
+        for group in self._set_lr.param_groups:  # torch optimizer
+            group["lr"] = lr
+        return lr
+
+    def on_epoch_end(self, epoch, metrics=None, state=None):
+        lr = self.initial_lr * self.multiplier(epoch + 1)
+        self._apply(lr)
+        if metrics is not None:
+            metrics["lr"] = lr
+        return state
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradual warmup: lr ramps linearly from ``initial_lr`` to
+    ``initial_lr * size`` over ``warmup_epochs`` (Goyal et al. 2017;
+    reference ``LearningRateWarmupCallback``)."""
+
+    def __init__(self, initial_lr: float, warmup_epochs: int = 5,
+                 set_lr=None, size: Optional[int] = None):
+        n = size if size is not None else api.size()
+
+        def multiplier(epoch):
+            if epoch >= warmup_epochs:
+                return float(n)
+            return 1.0 + (n - 1.0) * epoch / max(warmup_epochs, 1)
+
+        super().__init__(initial_lr, multiplier, set_lr=set_lr)
+
+
+def warmup_schedule(base_lr: float, *, warmup_steps: int,
+                    size: Optional[int] = None,
+                    after: Optional[Callable] = None):
+    """The warmup recipe as an **optax schedule** for jitted JAX loops:
+    step < warmup_steps ramps ``base_lr → base_lr * size``; afterwards
+    ``after(step - warmup_steps)`` (default: constant scaled lr)."""
+    import jax.numpy as jnp
+
+    n = float(size if size is not None else api.size())
+
+    def schedule(step):
+        frac = jnp.minimum(step / max(warmup_steps, 1), 1.0)
+        warm = base_lr * (1.0 + (n - 1.0) * frac)
+        if after is None:
+            return warm
+        return jnp.where(step < warmup_steps, warm,
+                         after(step - warmup_steps))
+
+    return schedule
+
+
+class BestModelCheckpoint(Callback):
+    """Rank-0 saves the state whenever the monitored metric improves
+    (reference ``keras/callbacks.py:151``: checkpointing must be
+    rank-0-only or ranks race on the file)."""
+
+    def __init__(self, path: str, monitor: str = "val_loss",
+                 mode: str = "min", save_fn=None):
+        self.path = path
+        self.monitor = monitor
+        self.sign = 1.0 if mode == "min" else -1.0
+        self.best = float("inf")
+        self.save_fn = save_fn
+
+    def on_epoch_end(self, epoch, metrics=None, state=None):
+        if api.rank() != 0 or not metrics or self.monitor not in metrics:
+            return state
+        score = self.sign * float(metrics[self.monitor])
+        if score < self.best:
+            self.best = score
+            if self.save_fn is not None:
+                self.save_fn(self.path, state)
+            else:
+                tmp = f"{self.path}.tmp"
+                with open(tmp, "wb") as f:
+                    pickle.dump(state, f)
+                os.replace(tmp, self.path)
+        return state

@@ -198,3 +198,47 @@ def broadcast_parameters(params: Any, root_rank: int = 0,
         synced.append(out.reshape(leaf.shape) if hasattr(out, "reshape")
                       else out)
     return jax.tree.unflatten(treedef, synced)
+
+
+def sync_batch_norm(x, *, axis_name: AxisName = "dp",
+                    scale=None, bias=None, eps: float = 1e-5,
+                    reduce_dims=None):
+    """Normalize ``x`` with batch statistics taken over BOTH the local
+    reduce dims and the ``axis_name`` mesh axis — the in-jit SPMD analog
+    of the reference's SyncBatchNorm (``torch/sync_batch_norm.py:22``,
+    ``tensorflow/sync_batch_norm.py:22``). Call inside
+    ``shard_map``/``pjit``; stats ride two small ``psum``\\ s that XLA
+    fuses into one.
+
+    ``reduce_dims`` defaults to all dims except the last (channel).
+    Returns ``(y, mean, var)`` so callers can maintain running stats.
+    For flax models, ``flax.linen.BatchNorm(axis_name="dp")`` achieves
+    the same inside ``pjit`` — this helper is the framework-free form.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    if reduce_dims is None:
+        reduce_dims = tuple(range(x.ndim - 1))
+    reduce_dims = tuple(d % x.ndim for d in reduce_dims)
+    h = x.astype(jnp.float32)
+    n_local = 1
+    for d in reduce_dims:
+        n_local *= x.shape[d]
+    stats = jnp.stack([jnp.sum(h, axis=reduce_dims),
+                       jnp.sum(h * h, axis=reduce_dims)])
+    stats = lax.psum(stats, axis_name)
+    from horovod_tpu.ops.collectives import axis_size
+    n = n_local * axis_size(axis_name)
+    mean = stats[0] / n
+    var = stats[1] / n - mean * mean
+    # Broadcast stats back to x's layout: kept (channel) dims stay,
+    # reduced dims become 1 — so NCHW-style reduce_dims=(0, 2, 3)
+    # works, not just channels-last.
+    bshape = [1 if d in reduce_dims else x.shape[d] for d in range(x.ndim)]
+    y = (h - mean.reshape(bshape)) * lax.rsqrt(var.reshape(bshape) + eps)
+    if scale is not None:
+        y = y * scale.astype(jnp.float32).reshape(bshape)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32).reshape(bshape)
+    return y.astype(x.dtype), mean, var

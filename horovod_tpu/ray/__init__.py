@@ -1,0 +1,3 @@
+"""Ray integration (reference ``horovod/ray/runner.py:248``)."""
+
+from horovod_tpu.ray.runner import RayExecutor  # noqa: F401

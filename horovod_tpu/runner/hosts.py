@@ -13,6 +13,19 @@ import re
 from typing import List
 
 
+def local_ip() -> str:
+    """This host's outbound IP (the address other job members can
+    reach it on when they share a network). UDP connect never sends a
+    packet; it only selects the routing interface."""
+    import socket
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+
+
 @dataclasses.dataclass(frozen=True)
 class HostInfo:
     hostname: str

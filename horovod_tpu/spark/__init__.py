@@ -1,0 +1,8 @@
+"""Spark integration (reference ``horovod/spark/runner.py:195``)."""
+
+from horovod_tpu.spark.runner import run  # noqa: F401
+from horovod_tpu.spark.estimator import (  # noqa: F401
+    Store,
+    TorchEstimator,
+    TorchModel,
+)

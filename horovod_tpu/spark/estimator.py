@@ -1,0 +1,184 @@
+"""Spark ML estimator for torch models.
+
+Compact rebuild of the reference ``TorchEstimator``
+(``horovod/spark/torch/estimator.py:91``): fit() materializes the
+DataFrame through a :class:`Store`, trains the model across Spark
+executors with :func:`horovod_tpu.spark.run` + ``DistributedOptimizer``
+(each rank reads its own shard), and returns a :class:`TorchModel`
+transformer for inference. The reference's Petastorm streaming reader
+and HDFS/S3 store drivers are out of scope — :class:`Store` is the
+pluggable seam where they would go (local-filesystem driver included).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Callable, List, Optional
+
+
+class Store:
+    """Shared-filesystem staging area for train shards + checkpoints
+    (reference ``spark/common/store.py``; this driver = LocalStore).
+    The path must be reachable from every executor (NFS etc.)."""
+
+    def __init__(self, prefix_path: str):
+        self.prefix_path = prefix_path
+        os.makedirs(prefix_path, exist_ok=True)
+
+    def shard_path(self, idx: int) -> str:
+        return os.path.join(self.prefix_path, f"shard.{idx}.pkl")
+
+    def write_shard(self, idx: int, rows: Any) -> None:
+        with open(self.shard_path(idx), "wb") as f:
+            pickle.dump(rows, f)
+
+    def read_shard(self, idx: int) -> Any:
+        with open(self.shard_path(idx), "rb") as f:
+            return pickle.load(f)
+
+    def model_path(self) -> str:
+        return os.path.join(self.prefix_path, "model.pt")
+
+
+class TorchEstimator:
+    """Spark-ML-style estimator: ``fit(df) -> TorchModel``.
+
+    Parameters mirror the reference's essentials: ``model`` (torch
+    module), ``optimizer`` factory ``(params) -> torch.optim``, ``loss``
+    ``(output, label) -> scalar``, feature/label columns, epochs,
+    batch_size, ``num_proc`` ranks.
+    """
+
+    def __init__(self, *, model, optimizer: Callable, loss: Callable,
+                 feature_cols: List[str], label_cols: List[str],
+                 store: Store, num_proc: int = 2, epochs: int = 1,
+                 batch_size: int = 32,
+                 compression=None):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss = loss
+        self.feature_cols = feature_cols
+        self.label_cols = label_cols
+        self.store = store
+        self.num_proc = num_proc
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.compression = compression
+
+    def fit(self, df) -> "TorchModel":
+        import numpy as np
+
+        from horovod_tpu.spark.runner import run as spark_run
+
+        # Stage the dataset: one shard per rank, rank order = partition
+        # order (reference writes train/val parquet via the Store).
+        # Shards are padded to EQUAL length by wrapping — every rank
+        # must run the same number of optimizer steps or the gradient
+        # allreduces desynchronize and the job hangs (the reference
+        # gets the same property from Petastorm's equal-length epochs).
+        cols = self.feature_cols + self.label_cols
+        rows = np.asarray([[float(row[c]) for c in cols]
+                           for row in df.select(*cols).collect()],
+                          dtype=np.float32)
+        if len(rows) == 0:
+            raise ValueError("fit() got an empty DataFrame")
+        per_rank = -(-len(rows) // self.num_proc)  # ceil
+        for i in range(self.num_proc):
+            idx = np.arange(i * per_rank, (i + 1) * per_rank) % len(rows)
+            self.store.write_shard(i, rows[idx])
+
+        n_feat = len(self.feature_cols)
+        payload = pickle.dumps(self.model)
+        opt_factory, loss_fn = self.optimizer, self.loss
+        store, epochs, bs = self.store, self.epochs, self.batch_size
+        compression = self.compression
+
+        def train_fn():
+            import torch
+
+            import horovod_tpu.torch as hvd
+
+            hvd.init()
+            model = pickle.loads(payload)
+            data = store.read_shard(hvd.rank())
+            x = torch.as_tensor(data[:, :n_feat])
+            y = torch.as_tensor(data[:, n_feat:])
+            opt = opt_factory(model.parameters())
+            extra = ({"compression": compression}
+                     if compression is not None else {})
+            opt = hvd.DistributedOptimizer(
+                opt, named_parameters=model.named_parameters(), **extra)
+            hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+            for _ in range(epochs):
+                for off in range(0, max(len(x), 1), bs):
+                    xb, yb = x[off:off + bs], y[off:off + bs]
+                    if not len(xb):
+                        continue
+                    opt.zero_grad()
+                    loss_fn(model(xb), yb).backward()
+                    opt.step()
+            state = None
+            if hvd.rank() == 0:
+                torch.save(model.state_dict(), store.model_path())
+                state = {k: v.numpy() for k, v in model.state_dict().items()}
+            hvd.shutdown()
+            return state
+
+        results = spark_run(train_fn, num_proc=self.num_proc)
+        state = next(r for r in results if r is not None)
+        return TorchModel(model=self.model, state=state,
+                          feature_cols=self.feature_cols,
+                          label_cols=self.label_cols)
+
+
+class TorchModel:
+    """Transformer returned by fit(): appends prediction columns
+    (reference returns a Spark ML Transformer; this one exposes both
+    ``transform(df)`` for DataFrames and ``predict(features)`` for
+    local numpy use)."""
+
+    def __init__(self, *, model, state, feature_cols, label_cols):
+        self.model = model
+        self.state = state
+        self.feature_cols = feature_cols
+        self.label_cols = label_cols
+
+    def _torch_model(self):
+        import torch
+        m = pickle.loads(pickle.dumps(self.model))
+        m.load_state_dict({k: torch.as_tensor(v)
+                           for k, v in self.state.items()})
+        m.eval()
+        return m
+
+    def predict(self, features):
+        import torch
+        with torch.no_grad():
+            return self._torch_model()(
+                torch.as_tensor(features, dtype=torch.float32)).numpy()
+
+    def transform(self, df):
+        n_feat = len(self.feature_cols)
+        state, model_pkl = self.state, pickle.dumps(self.model)
+        feature_cols, label_cols = self.feature_cols, self.label_cols
+
+        def map_partition(rows):
+            import numpy as np
+            import torch
+            m = pickle.loads(model_pkl)
+            m.load_state_dict({k: torch.as_tensor(v)
+                               for k, v in state.items()})
+            m.eval()
+            for row in rows:
+                feats = np.asarray([[float(row[c]) for c in feature_cols]],
+                                   np.float32)
+                with torch.no_grad():
+                    pred = m(torch.as_tensor(feats)).numpy()[0]
+                out = row.asDict()
+                for i, c in enumerate(label_cols):
+                    out[f"{c}__output"] = float(pred[i])
+                yield out
+
+        spark = df.sparkSession
+        return spark.createDataFrame(df.rdd.mapPartitions(map_partition))

@@ -28,4 +28,5 @@ from horovod_tpu.functions import (  # noqa: F401
 from horovod_tpu.torch.functions import (  # noqa: F401
     broadcast_optimizer_state, broadcast_parameters,
 )
+from horovod_tpu.torch.sync_batch_norm import SyncBatchNorm  # noqa: F401
 from horovod_tpu.torch.optimizer import DistributedOptimizer  # noqa: F401

@@ -1,0 +1,137 @@
+"""Synchronized BatchNorm for the torch binding.
+
+Rebuild of the reference ``horovod/torch/sync_batch_norm.py``: batch
+statistics (mean / variance) are computed over the GLOBAL batch — all
+ranks' samples — by allreducing the per-rank sums in forward and the
+per-rank gradient sums in backward, so small per-rank batches normalize
+as if they were one large batch. Collectives ride the eager
+named-tensor runtime (host data plane for CPU torch tensors, exactly
+like the reference's CPU/gloo path).
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import torch
+from torch.nn.modules.batchnorm import _BatchNorm
+
+import horovod_tpu.api as api
+from horovod_tpu.common.ops_enum import Sum
+
+# Collective names must agree across ranks; module construction order
+# is deterministic (same model code on every rank), so a per-instance
+# index is a stable cross-rank identifier.
+_instance_ids = itertools.count()
+
+
+class SyncBatchNorm(_BatchNorm):
+    """Drop-in BatchNorm1d/2d/3d replacement with cross-rank statistics.
+
+    Matches the reference surface (``sync_batch_norm.py:22``): same
+    constructor args as ``torch.nn.BatchNorm*``; in eval mode (or when
+    the job has a single rank) it behaves exactly like local BN.
+    """
+
+    def __init__(self, num_features, eps=1e-5, momentum=0.1, affine=True,
+                 track_running_stats=True):
+        super().__init__(num_features, eps, momentum, affine,
+                         track_running_stats)
+        self._hvd_bn_id = next(_instance_ids)
+
+    def _check_input_dim(self, input):
+        if input.dim() < 2:
+            raise ValueError(
+                f"expected at least 2D input (got {input.dim()}D)")
+
+    def forward(self, input):
+        if not (self.training and api.is_initialized() and api.size() > 1):
+            return super().forward(input)
+        self._check_input_dim(input)
+        if self.momentum is None:
+            exponential_average_factor = 0.0
+        else:
+            exponential_average_factor = self.momentum
+        if self.track_running_stats and self.num_batches_tracked is not None:
+            self.num_batches_tracked.add_(1)
+            if self.momentum is None:  # cumulative moving average
+                exponential_average_factor = \
+                    1.0 / float(self.num_batches_tracked)
+        return _SyncBatchNormFn.apply(
+            input, self.weight, self.bias, self.running_mean,
+            self.running_var, self.eps, exponential_average_factor,
+            self._hvd_bn_id)
+
+
+def _acc_dtype(dtype):
+    return torch.float64 if dtype == torch.float64 else torch.float32
+
+
+class _SyncBatchNormFn(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, input, weight, bias, running_mean, running_var, eps,
+                momentum, bn_id):
+        # Per-rank partial sums over all non-channel dims, reduced
+        # globally (reference forward allgathers mean/var + counts;
+        # sum/sqsum/count is the equivalent one-shot formulation).
+        c = input.shape[1]
+        acc = _acc_dtype(input.dtype)
+        x = input.transpose(0, 1).reshape(c, -1).to(acc)   # [C, N_local]
+        n_local = x.shape[1]
+        stats = torch.cat([x.sum(1), (x * x).sum(1),
+                           torch.full((1,), float(n_local), dtype=acc)])
+        stats = api.allreduce(stats, op=Sum, name=f"sync_bn.fwd.{bn_id}")
+        n = float(stats[-1].item())
+        mean = stats[:c] / n
+        var = stats[c:2 * c] / n - mean * mean             # biased (norm)
+        if running_mean is not None:
+            unbiased = var * n / max(n - 1.0, 1.0)
+            running_mean.mul_(1 - momentum).add_(
+                mean.to(running_mean.dtype), alpha=momentum)
+            running_var.mul_(1 - momentum).add_(
+                unbiased.to(running_var.dtype), alpha=momentum)
+
+        shape = [1, c] + [1] * (input.dim() - 2)
+        invstd = torch.rsqrt(var + eps).reshape(shape)
+        xhat = ((input.to(acc) - mean.reshape(shape)) * invstd).to(
+            input.dtype)
+        out = xhat
+        if weight is not None:
+            out = out * weight.reshape(shape)
+        if bias is not None:
+            out = out + bias.reshape(shape)
+        ctx.save_for_backward(xhat, invstd.to(input.dtype),
+                              weight if weight is not None else None)
+        ctx.n_global = n
+        ctx.bn_id = bn_id
+        return out
+
+    @staticmethod
+    def backward(ctx, grad_out):
+        xhat, invstd, weight = ctx.saved_tensors
+        c = grad_out.shape[1]
+        dims = [0] + list(range(2, grad_out.dim()))
+        acc = _acc_dtype(grad_out.dtype)
+
+        # Global sums of dy and dy*xhat (reference backward allreduces
+        # mean_dy / mean_dy_xmu). Parameter grads stay LOCAL sums —
+        # DistributedOptimizer's averaging allreduce handles them, same
+        # contract as the reference and torch-native SyncBatchNorm.
+        sum_dy = grad_out.sum(dims).to(acc)
+        sum_dy_xhat = (grad_out * xhat).sum(dims).to(acc)
+        packed = torch.cat([sum_dy, sum_dy_xhat])
+        packed = api.allreduce(packed, op=Sum,
+                               name=f"sync_bn.bwd.{ctx.bn_id}")
+        g_dy, g_dy_xhat = packed[:c], packed[c:]
+        n = ctx.n_global
+
+        shape = [1, c] + [1] * (grad_out.dim() - 2)
+        gw = weight.reshape(shape) if weight is not None else 1.0
+        # d/dx of BN: (dy - mean(dy) - xhat * mean(dy*xhat)) * invstd * w
+        gx = ((grad_out.to(acc) - (g_dy / n).reshape(shape)
+               - xhat.to(acc) * (g_dy_xhat / n).reshape(shape))
+              * invstd.to(acc) * gw).to(grad_out.dtype)
+        grad_weight = (sum_dy_xhat.to(grad_out.dtype)
+                       if weight is not None else None)
+        grad_bias = sum_dy.to(grad_out.dtype) if weight is not None else None
+        return gx, grad_weight, grad_bias, None, None, None, None, None

@@ -296,6 +296,61 @@ def main():
         except HorovodInternalError:
             pass
 
+    elif scenario == "sync_bn":
+        # Distributed SyncBatchNorm over the split batch must equal
+        # local BatchNorm over the concatenated batch — forward,
+        # running stats, input grads, and param grads (param grads are
+        # local sums; their allreduce-average times size equals the
+        # full-batch grad).
+        import torch
+        from horovod_tpu.torch import SyncBatchNorm
+
+        torch.manual_seed(0)
+        full = torch.randn(4 * s, 3, 5, 5, dtype=torch.float64)
+        mine = full[r * 4:(r + 1) * 4].clone().requires_grad_(True)
+
+        sbn = SyncBatchNorm(3).double()
+        out = sbn(mine)
+        loss = (out * out).sum()
+        loss.backward()
+
+        ref = torch.nn.BatchNorm2d(3).double()
+        x = full.clone().requires_grad_(True)
+        ref_out = ref(x)
+        (ref_out * ref_out).sum().backward()
+
+        np.testing.assert_allclose(out.detach().numpy(),
+                                   ref_out[r * 4:(r + 1) * 4].detach().numpy(),
+                                   rtol=1e-10)
+        np.testing.assert_allclose(sbn.running_mean.numpy(),
+                                   ref.running_mean.numpy(), rtol=1e-10)
+        np.testing.assert_allclose(sbn.running_var.numpy(),
+                                   ref.running_var.numpy(), rtol=1e-10)
+        np.testing.assert_allclose(mine.grad.numpy(),
+                                   x.grad[r * 4:(r + 1) * 4].numpy(),
+                                   rtol=1e-9, atol=1e-12)
+        # param grads: avg(local sums) * size == full-batch grad
+        gw = hvd.allreduce(sbn.weight.grad.numpy(), name="bn.gw")
+        np.testing.assert_allclose(gw * s, ref.weight.grad.numpy(),
+                                   rtol=1e-9)
+
+        # eval mode = local BN (no collectives)
+        sbn.eval()
+        ref.eval()
+        np.testing.assert_allclose(
+            sbn(mine).detach().numpy(),
+            ref(full)[r * 4:(r + 1) * 4].detach().numpy(), rtol=1e-9)
+
+    elif scenario == "callbacks":
+        from horovod_tpu.callbacks import (MetricAverageCallback,
+                                           average_metrics)
+        got = average_metrics({"loss": float(r), "acc": 2.0 * r})
+        np.testing.assert_allclose(got["loss"], (s - 1) / 2.0)
+        np.testing.assert_allclose(got["acc"], float(s - 1))
+        m = {"loss": float(r)}
+        MetricAverageCallback().on_epoch_end(0, m)
+        np.testing.assert_allclose(m["loss"], (s - 1) / 2.0)
+
     elif scenario == "xla_adasum":
         # CALLBACK-mode Adasum: the zero-padded pair tree, per-segment
         # weighting in the fused program.

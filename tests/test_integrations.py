@@ -1,0 +1,251 @@
+"""Ray / Spark integrations, tested with stub cluster modules — the
+reference's single-process tier mocks its exec layer the same way
+(test/single/test_run.py); real-cluster behavior is covered by the
+shared slot/rendezvous machinery these executors delegate to."""
+
+import sys
+import types
+
+import numpy as np
+import pytest
+
+
+# ---------------------------------------------------------------------------
+# stub ray
+# ---------------------------------------------------------------------------
+
+class _FakeRef:
+    def __init__(self, value):
+        self.value = value
+
+
+def _make_fake_ray():
+    ray = types.ModuleType("ray")
+
+    def remote(**_kw):
+        def wrap(cls):
+            class Handle:
+                def __init__(self, inst):
+                    self._inst = inst
+
+                def __getattr__(self, name):
+                    method = getattr(self._inst, name)
+
+                    class Caller:
+                        @staticmethod
+                        def remote(*a, **kw):
+                            return _FakeRef(method(*a, **kw))
+                    return Caller()
+
+            class RemoteCls:
+                @staticmethod
+                def remote(*a, **kw):
+                    return Handle(cls(*a, **kw))
+            return RemoteCls
+        return wrap
+
+    def get(refs):
+        if isinstance(refs, list):
+            return [r.value for r in refs]
+        return refs.value
+
+    ray.remote = remote
+    ray.get = get
+    ray.kill = lambda *_a, **_k: None
+    return ray
+
+
+@pytest.fixture()
+def fake_ray(monkeypatch):
+    ray = _make_fake_ray()
+    monkeypatch.setitem(sys.modules, "ray", ray)
+    return ray
+
+
+def test_ray_executor_slot_model_and_run(fake_ray):
+    from horovod_tpu.ray import RayExecutor
+
+    ex = RayExecutor(num_workers=3)
+    ex.start()
+    try:
+        envs = fake_ray.get([w.env.remote() for w in ex.workers])
+        assert [e["HOROVOD_RANK"] for e in envs] == ["0", "1", "2"]
+        assert all(e["HOROVOD_SIZE"] == "3" for e in envs)
+        # single fake node: local == global
+        assert [e["HOROVOD_LOCAL_RANK"] for e in envs] == ["0", "1", "2"]
+        assert all(e["HOROVOD_LOCAL_SIZE"] == "3" for e in envs)
+        assert all(e["HOROVOD_CROSS_SIZE"] == "1" for e in envs)
+        rdv = {e["HOROVOD_RENDEZVOUS_ADDR"] for e in envs}
+        assert len(rdv) == 1 and ":" in rdv.pop()
+
+        outs = ex.run(lambda a, b: a + b, args=(2, 3))
+        assert outs == [5, 5, 5]
+        assert ex.execute(lambda w: 1) == [1, 1, 1]
+    finally:
+        ex.shutdown()
+    assert ex.workers == []
+
+
+def test_ray_executor_requires_start(fake_ray):
+    from horovod_tpu.ray import RayExecutor
+    with pytest.raises(RuntimeError, match="start"):
+        RayExecutor(num_workers=2).run(lambda: None)
+
+
+# ---------------------------------------------------------------------------
+# stub pyspark (barrier execution)
+# ---------------------------------------------------------------------------
+
+class _FakeRow(dict):
+    def __getitem__(self, k):
+        return dict.__getitem__(self, k)
+
+    def asDict(self):
+        return dict(self)
+
+
+def _make_fake_pyspark():
+    pyspark = types.ModuleType("pyspark")
+    state = {"partition": None, "n": 0}
+
+    class _TaskInfo:
+        def __init__(self, address):
+            self.address = address
+
+    class BarrierTaskContext:
+        @staticmethod
+        def get():
+            return BarrierTaskContext()
+
+        def partitionId(self):
+            return state["partition"]
+
+        def getTaskInfos(self):
+            return [_TaskInfo("127.0.0.1:0") for _ in range(state["n"])]
+
+        def barrier(self):
+            pass
+
+    class _BarrierRDD:
+        def __init__(self, parts):
+            self.parts = parts
+
+        def mapPartitions(self, fn):
+            self.fn = fn
+            return self
+
+        def collect(self):
+            out = []
+            for p in self.parts:
+                state["partition"] = p
+                out.extend(self.fn(iter([p])))
+            return out
+
+    class _RDD:
+        def __init__(self, parts):
+            self.parts = parts
+
+        def barrier(self):
+            return _BarrierRDD(self.parts)
+
+    class _SC:
+        defaultParallelism = 2
+
+        def parallelize(self, data, n):
+            state["n"] = n
+            return _RDD(list(range(n)))
+
+    pyspark.BarrierTaskContext = BarrierTaskContext
+    sql = types.ModuleType("pyspark.sql")
+
+    class SparkSession:
+        class builder:  # noqa: N801 — pyspark API shape
+            @staticmethod
+            def getOrCreate():
+                s = SparkSession()
+                s.sparkContext = _SC()
+                return s
+    sql.SparkSession = SparkSession
+    pyspark.sql = sql
+    return pyspark, _SC
+
+
+@pytest.fixture()
+def fake_pyspark(monkeypatch):
+    pyspark, sc_cls = _make_fake_pyspark()
+    monkeypatch.setitem(sys.modules, "pyspark", pyspark)
+    monkeypatch.setitem(sys.modules, "pyspark.sql", pyspark.sql)
+    # The stub runs barrier tasks IN this process; task() mutates
+    # HOROVOD_* env vars that would confuse later tests' hvd.init().
+    import os
+    saved = {k: os.environ.get(k)
+             for k in ("HOROVOD_RANK", "HOROVOD_SIZE", "HOROVOD_LOCAL_RANK",
+                       "HOROVOD_LOCAL_SIZE", "HOROVOD_CROSS_RANK",
+                       "HOROVOD_CROSS_SIZE", "HOROVOD_RENDEZVOUS_ADDR",
+                       "HOROVOD_RENDEZVOUS_TOKEN", "HOROVOD_CONTROLLER_HOST",
+                       "HOROVOD_START_TIMEOUT")}
+    yield sc_cls
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def test_spark_run_sets_slot_env(fake_pyspark):
+    import os
+
+    from horovod_tpu.spark import run
+
+    def probe():
+        return {k: os.environ[k]
+                for k in ("HOROVOD_RANK", "HOROVOD_SIZE",
+                          "HOROVOD_LOCAL_RANK", "HOROVOD_RENDEZVOUS_ADDR")}
+
+    outs = run(probe, num_proc=2, spark_context=fake_pyspark())
+    assert [o["HOROVOD_RANK"] for o in outs] == ["0", "1"]
+    assert all(o["HOROVOD_SIZE"] == "2" for o in outs)
+
+
+def test_spark_run_propagates_failures(fake_pyspark):
+    from horovod_tpu.spark import run
+
+    def boom():
+        raise ValueError("kaput")
+
+    with pytest.raises(RuntimeError, match="kaput"):
+        run(boom, num_proc=2, spark_context=fake_pyspark())
+
+
+def test_torch_estimator_fit_predict(fake_pyspark, tmp_path):
+    import torch
+
+    from horovod_tpu.spark import Store, TorchEstimator
+
+    class FakeDF:
+        """y = 2x linear data with the select/collect surface fit uses."""
+
+        def select(self, *cols):
+            return self
+
+        def collect(self):
+            rng = np.random.RandomState(0)
+            xs = rng.randn(64).astype(np.float32)
+            return [_FakeRow({"x": float(v), "y": float(2.0 * v)})
+                    for v in xs]
+
+    est = TorchEstimator(
+        model=torch.nn.Linear(1, 1),
+        optimizer=lambda params: torch.optim.SGD(params, lr=0.1),
+        loss=torch.nn.functional.mse_loss,
+        feature_cols=["x"], label_cols=["y"],
+        store=Store(str(tmp_path)), num_proc=1, epochs=40, batch_size=16)
+    try:
+        model = est.fit(FakeDF())
+    finally:
+        # train_fn shut the in-process runtime down; restore for
+        # whatever test runs next.
+        import horovod_tpu as hvd
+        hvd.init()
+    pred = model.predict(np.asarray([[1.0], [2.0]], np.float32))
+    np.testing.assert_allclose(pred[:, 0], [2.0, 4.0], atol=0.2)
