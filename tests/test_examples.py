@@ -26,6 +26,16 @@ def test_torch_mnist_example_2proc(capfd):
     assert "rank 0:" in out and "rank 1:" in out
 
 
+def test_jax_mnist_example_2proc(capfd):
+    run_command(
+        [sys.executable, os.path.join(ROOT, "examples", "jax_mnist.py"),
+         "--epochs", "1"],
+        np=2, env=_WORKER_ENV, start_timeout=120)
+    out = capfd.readouterr().out
+    assert "epoch 0: mean loss" in out
+    assert "FINAL loss=" in out
+
+
 def _train_determinstic(n_steps=4):
     """Full-batch training so 1-proc and N-proc see the same global
     data: every rank holds a distinct half of a fixed global batch (or
