@@ -143,7 +143,10 @@ def _execute(op: int, states, sizes: List[int], size: int, rank: int):
     if op == basics.OP_ALLREDUCE:
         return _dist_allreduce(states, size)
     if op == basics.OP_ALLGATHER:
-        return [_dist_allgather(states[0], tuple(sizes), size)]
+        # Fused responses carry per-tensor blocks of `size` row counts.
+        return [_dist_allgather(st, tuple(sizes[t * size:(t + 1) * size]),
+                                size)
+                for t, st in enumerate(states)]
     if op == basics.OP_BROADCAST:
         return [_dist_broadcast(states[0], size)]
     if op == basics.OP_ALLTOALL:

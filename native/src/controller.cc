@@ -305,6 +305,18 @@ ResponseList Controller::CoordinatorStep(
     Built b;
     b.resp = ConstructResponse(name, it->second, active_ranks);
     b.bytes = RequestBytes(it->second.requests.front());
+    if (b.resp.response_type == ResponseType::ALLGATHER &&
+        !b.resp.tensor_sizes.empty()) {
+      // Threshold accounting must use the GATHERED size (all ranks'
+      // rows), not one rank's local shard — that is what the fused
+      // ring buffer will actually hold.
+      const auto& shape = it->second.requests.front().tensor_shape;
+      int64_t row_bytes = DataTypeSize(it->second.requests.front().tensor_type);
+      for (size_t d = 1; d < shape.size(); ++d) row_bytes *= shape[d];
+      int64_t rows = 0;
+      for (auto rsz : b.resp.tensor_sizes) rows += rsz;
+      b.bytes = rows * row_bytes;
+    }
     b.op_class = OpClass(it->second.requests.front().reduce_op);
     built.push_back(std::move(b));
     if (deps_.stall_inspector)
@@ -312,8 +324,12 @@ ResponseList Controller::CoordinatorStep(
     table->erase(it);
   }
 
-  // Fuse allreduces with matching (dtype, exec mode, op class) up to the
-  // fusion threshold (reference FuseResponses, controller.cc:777).
+  // Fuse allreduces with matching (dtype, exec mode, op class) up to
+  // the fusion threshold (reference FuseResponses, controller.cc:777),
+  // and allgathers with matching (dtype, exec mode) — the reference
+  // fuses those too (controller.cc:826-848). A fused ALLGATHER
+  // response carries per-tensor per-rank row counts as consecutive
+  // `size_`-long blocks in tensor_sizes.
   ResponseList out;
   out.shutdown = shutdown;
   std::vector<bool> used(built.size(), false);
@@ -321,20 +337,29 @@ ResponseList Controller::CoordinatorStep(
     if (used[i]) continue;
     used[i] = true;
     Response merged = std::move(built[i].resp);
-    if (merged.response_type == ResponseType::ALLREDUCE) {
+    if (merged.response_type == ResponseType::ALLREDUCE ||
+        merged.response_type == ResponseType::ALLGATHER) {
       int64_t bytes = built[i].bytes;
       for (size_t j = i + 1; j < built.size(); ++j) {
         if (used[j]) continue;
         const Response& cand = built[j].resp;
-        if (cand.response_type != ResponseType::ALLREDUCE ||
+        if (cand.response_type != merged.response_type ||
             cand.tensor_type != merged.tensor_type ||
-            cand.exec_mode != merged.exec_mode ||
-            built[j].op_class != built[i].op_class ||
-            cand.contributors != merged.contributors)
+            cand.exec_mode != merged.exec_mode)
+          continue;
+        if (merged.response_type == ResponseType::ALLREDUCE &&
+            (built[j].op_class != built[i].op_class ||
+             cand.contributors != merged.contributors))
           continue;
         if (bytes + built[j].bytes > fusion_threshold_bytes_) continue;
         merged.tensor_names.push_back(cand.tensor_names.front());
-        merged.tensor_sizes.push_back(cand.tensor_sizes.front());
+        if (merged.response_type == ResponseType::ALLREDUCE) {
+          merged.tensor_sizes.push_back(cand.tensor_sizes.front());
+        } else {
+          merged.tensor_sizes.insert(merged.tensor_sizes.end(),
+                                     cand.tensor_sizes.begin(),
+                                     cand.tensor_sizes.end());
+        }
         bytes += built[j].bytes;
         used[j] = true;
       }

@@ -185,12 +185,15 @@ Status AllocateOutputs(GlobalState& st, const Response& resp,
       resp.response_type != ResponseType::ALLTOALL &&
       resp.response_type != ResponseType::REDUCESCATTER)
     return Status::OK();
-  for (auto& e : entries) {
+  for (size_t t = 0; t < entries.size(); ++t) {
+    auto& e = entries[t];
     if (e.output != nullptr || e.exec_mode != ExecMode::HOST) continue;
     std::vector<int64_t> shape = e.shape.dims();
     if (resp.response_type == ResponseType::ALLGATHER) {
+      // Fused responses carry per-tensor blocks of `size` row counts.
       int64_t rows = 0;
-      for (auto s : resp.tensor_sizes) rows += s;
+      for (int k = 0; k < st.size; ++k)
+        rows += resp.tensor_sizes[t * st.size + k];
       shape[0] = rows;
     } else if (resp.response_type == ResponseType::ALLTOALL) {
       int64_t rows = 0;

@@ -625,34 +625,87 @@ Status TcpOps::Allgather(const Response& r,
                          std::vector<TensorTableEntry>& entries) {
   const int rank = controller_->rank();
   const int size = controller_->size();
-  // One tensor per response (allgather responses are not fused in v1).
-  auto& e = entries.front();
-  if (timeline_) timeline_->ActivityStart(e.name, ACT_TCP_ALLGATHER);
-  int64_t row_bytes = DataTypeSize(e.dtype);
-  for (int d = 1; d < e.shape.ndim(); ++d) row_bytes *= e.shape.dim_size(d);
+  const int nt = static_cast<int>(entries.size());
+  const std::string tname = entries.front().name;
+  if (timeline_) timeline_->ActivityStart(tname, ACT_TCP_ALLGATHER);
 
-  uint8_t* out = static_cast<uint8_t*>(e.output);
-  if (out == nullptr)
-    return Status::PreconditionError("allgather output not allocated");
-
-  // Ring allgather over ragged shards (r.tensor_sizes = per-rank row
-  // counts): every rank writes its shard at its displacement, then
-  // P-1 steps forward the newest shard around the ring. Each rank
-  // moves total−own bytes instead of the hub's size·total.
-  std::vector<int64_t> offs(size + 1, 0);
-  for (int k = 0; k < size; ++k)
-    offs[k + 1] = offs[k] + r.tensor_sizes[k] * row_bytes;
-  std::memcpy(out + offs[rank], e.data, offs[rank + 1] - offs[rank]);
-  TcpConn* next = controller_->DataConn((rank + 1) % size);
-  TcpConn* prev = controller_->DataConn((rank - 1 + size) % size);
-  for (int s = 0; s < size - 1; ++s) {
-    int cs = ((rank - s) % size + size) % size;       // shard to forward
-    int cr = ((rank - s - 1) % size + size) % size;   // shard arriving
-    if (!SendRecv(next, out + offs[cs], offs[cs + 1] - offs[cs], prev,
-                  out + offs[cr], offs[cr + 1] - offs[cr]))
-      return Status::UnknownError("allgather: lost data connection");
+  // Fused ring allgather (the reference fuses allgathers too,
+  // controller.cc:826-848): r.tensor_sizes holds per-tensor blocks of
+  // `size` row counts. One ring pass moves every tensor: each rank's
+  // ring "shard" is the concatenation of its rows of all fused
+  // tensors, packed into the fusion buffer, and the P-1 forwarding
+  // steps ship total−own bytes regardless of how many tensors fused.
+  auto rows = [&](int t, int k) { return r.tensor_sizes[t * size + k]; };
+  std::vector<int64_t> row_bytes(nt);
+  for (int t = 0; t < nt; ++t) {
+    auto& e = entries[t];
+    row_bytes[t] = DataTypeSize(e.dtype);
+    for (int d = 1; d < e.shape.ndim(); ++d)
+      row_bytes[t] *= e.shape.dim_size(d);
+    if (e.output == nullptr)
+      return Status::PreconditionError("allgather output not allocated");
   }
-  if (timeline_) timeline_->ActivityEnd(e.name);
+  // Per-rank ring block offsets (bytes). All ranks in ring order; the
+  // ring itself is RingAllgatherPhase with byte-granular (UINT8)
+  // chunks so the fused and unfused paths share one implementation.
+  std::vector<int64_t> offs(size + 1, 0);
+  for (int k = 0; k < size; ++k) {
+    int64_t b = 0;
+    for (int t = 0; t < nt; ++t) b += rows(t, k) * row_bytes[t];
+    offs[k + 1] = offs[k] + b;
+  }
+  std::vector<int> all_ranks(size);
+  for (int k = 0; k < size; ++k) all_ranks[k] = k;
+
+  if (nt == 1) {
+    // Single tensor: ring in place in the output buffer — no staging
+    // copy, no fusion-buffer growth to the gathered size.
+    auto& e = entries[0];
+    uint8_t* out = static_cast<uint8_t*>(e.output);
+    std::memcpy(out + offs[rank], e.data, offs[rank + 1] - offs[rank]);
+    if (size > 1) {
+      Status st = RingAllgatherPhase(out, offs, DataType::UINT8, all_ranks,
+                                     rank);
+      if (!st.ok()) return st;
+    }
+    if (timeline_) timeline_->ActivityEnd(tname);
+    return Status::OK();
+  }
+
+  uint8_t* buf = static_cast<uint8_t*>(fusion_->GetBuffer(0, offs[size]));
+
+  // Pack my block: my rows of every tensor, tensor order.
+  if (timeline_) timeline_->ActivityStart(tname, ACT_MEMCPY_IN_FUSION_BUFFER);
+  int64_t off = offs[rank];
+  for (int t = 0; t < nt; ++t) {
+    int64_t bytes = rows(t, rank) * row_bytes[t];
+    std::memcpy(buf + off, entries[t].data, bytes);
+    off += bytes;
+  }
+  if (timeline_) timeline_->ActivityEnd(tname);
+
+  if (size > 1) {
+    Status st = RingAllgatherPhase(buf, offs, DataType::UINT8, all_ranks,
+                                   rank);
+    if (!st.ok()) return st;
+  }
+
+  // Unpack: rank k's block holds its rows of each tensor in order.
+  if (timeline_) timeline_->ActivityStart(tname,
+                                          ACT_MEMCPY_OUT_FUSION_BUFFER);
+  std::vector<int64_t> out_off(nt, 0);
+  for (int k = 0; k < size; ++k) {
+    int64_t src = offs[k];
+    for (int t = 0; t < nt; ++t) {
+      int64_t bytes = rows(t, k) * row_bytes[t];
+      std::memcpy(static_cast<uint8_t*>(entries[t].output) + out_off[t],
+                  buf + src, bytes);
+      src += bytes;
+      out_off[t] += bytes;
+    }
+  }
+  if (timeline_) timeline_->ActivityEnd(tname);
+  if (timeline_) timeline_->ActivityEnd(tname);  // closes TCP_ALLGATHER
   return Status::OK();
 }
 

@@ -296,6 +296,66 @@ def main():
         except HorovodInternalError:
             pass
 
+    elif scenario == "fused_allgather":
+        # Several async allgathers enqueued together fuse into one
+        # response (same dtype) and must all come back correct: ragged
+        # per-rank rows, different widths, plus a different-dtype one
+        # that cannot fuse and an interleaved allreduce.
+        hs = []
+        hs.append(hvd.allgather_async(
+            np.full((r + 1, 2), float(r), np.float32), name="fg.a"))
+        hs.append(hvd.allgather_async(
+            np.full((2, 3), 10.0 + r, np.float32), name="fg.b"))
+        hs.append(hvd.allgather_async(
+            np.full((1,), 100.0 + r, np.float64), name="fg.c"))
+        hr = hvd.allreduce_async(np.full(4, float(r), np.float32),
+                                 op=hvd.Sum, name="fg.ar")
+        a = hvd.synchronize(hs[0])
+        b = hvd.synchronize(hs[1])
+        c = hvd.synchronize(hs[2])
+        ar = hvd.synchronize(hr)
+
+        assert a.shape == (s * (s + 1) // 2, 2), a.shape
+        off = 0
+        for k in range(s):
+            np.testing.assert_allclose(a[off:off + k + 1], float(k))
+            off += k + 1
+        assert b.shape == (2 * s, 3), b.shape
+        for k in range(s):
+            np.testing.assert_allclose(b[2 * k:2 * k + 2], 10.0 + k)
+        np.testing.assert_allclose(c, 100.0 + np.arange(s))
+        np.testing.assert_allclose(ar, s * (s - 1) / 2.0)
+
+        # steady state: same fused set again through the cache path
+        for i in range(10):
+            g = hvd.allgather(np.full((r + 1, 2), float(i), np.float32),
+                              name="fg.a2")
+            g2 = hvd.allgather(np.full((2, 3), float(i), np.float32),
+                               name="fg.b2")
+            np.testing.assert_allclose(g, float(i))
+            np.testing.assert_allclose(g2, float(i))
+
+    elif scenario == "xla_fused_allgather":
+        import jax
+        import jax.numpy as jnp
+
+        assert jax.process_count() == s
+        hs = [hvd.allgather_async(jnp.full((r + 1, 2), float(r)),
+                                  name="xfg.a"),
+              hvd.allgather_async(jnp.full((2, 3), 10.0 + r),
+                                  name="xfg.b")]
+        a = hvd.synchronize(hs[0])
+        b = hvd.synchronize(hs[1])
+        assert a.shape == (s * (s + 1) // 2, 2), a.shape
+        off = 0
+        for k in range(s):
+            np.testing.assert_allclose(np.asarray(a[off:off + k + 1]),
+                                       float(k))
+            off += k + 1
+        for k in range(s):
+            np.testing.assert_allclose(np.asarray(b[2 * k:2 * k + 2]),
+                                       10.0 + k)
+
     elif scenario == "sync_bn":
         # Distributed SyncBatchNorm over the split batch must equal
         # local BatchNorm over the concatenated batch — forward,

@@ -119,3 +119,12 @@ def test_shape_mismatch_error_no_hang():
 
 def test_dtype_mismatch_error_no_hang():
     run_job("dtype_mismatch", 2, timeout=60)
+
+
+@pytest.mark.parametrize("np_", [2, 4])
+def test_fused_allgather(np_):
+    run_job("fused_allgather", np_)
+
+
+def test_xla_fused_allgather():
+    run_job("xla_fused_allgather", 2, timeout=240, extra_env=_xla_env(2))
