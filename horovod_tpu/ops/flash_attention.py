@@ -1,0 +1,192 @@
+"""Flash attention — a Pallas TPU kernel for the hot op.
+
+The reference has no device kernels of its own (it drives NCCL); on
+TPU the framework's hot op is attention, and this module implements it
+as a **fused Pallas kernel**: online-softmax over KV blocks so the
+(T, T) score matrix never materializes in HBM — scores live in VMEM a
+block at a time and the MXU sees two big matmuls per block. Forward
+saves the per-row logsumexp; backward recomputes probabilities from it
+(the standard memory-for-FLOPs trade) in plain XLA, which fuses well
+and keeps the custom_vjp exactly consistent with the kernel's math.
+
+Used via ``TransformerConfig(sp_attention="flash")`` or directly:
+
+    out = flash_attention(q, k, v, causal=True)   # [B, T, H, D] each
+
+On CPU (tests, the virtual mesh) the kernel runs in Pallas interpret
+mode automatically.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
+                scale: float, causal: bool, block_q: int, block_k: int,
+                seq_len: int):
+    """One (batch*head, q-block, kv-block) grid step of the online
+    softmax. Scratch (acc, m, l) persists across the kv dimension."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    q = q_ref[0].astype(jnp.float32)            # [bq, d]
+    k = k_ref[0].astype(jnp.float32)            # [bk, d]
+    v = v_ref[0].astype(jnp.float32)
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32,
+                                                    (block_q, block_k), 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                    (block_q, block_k), 1)
+    mask = k_pos >= seq_len                     # padded kv rows
+    if causal:
+        mask = mask | (k_pos > q_pos)
+    s = jnp.where(mask, NEG_INF, s)
+
+    m_prev = m_scr[:]                            # [bq, 1]
+    l_prev = l_scr[:]
+    m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = alpha * l_prev + p.sum(axis=1, keepdims=True)
+    acc[:] = acc[:] * alpha + jax.lax.dot(
+        p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+    m_scr[:] = m_new
+    l_scr[:] = l_new
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _finalize():
+        l = l_scr[:]
+        safe_l = jnp.where(l > 0, l, 1.0)        # fully-masked (pad) rows
+        o_ref[0] = (acc[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_scr[:] + jnp.log(safe_l))[:, 0]
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
+    """q/k/v: [BH, T, D] -> (out [BH, T, D], lse [BH, T])."""
+    bh, t, d = q.shape
+    bq = min(block_q, _round_up(t, 128))
+    bk = min(block_k, _round_up(t, 128))
+    tp = _round_up(t, max(bq, bk))
+    if tp != t:
+        pad = [(0, 0), (0, tp - t), (0, 0)]
+        q, k, v = (jnp.pad(x, pad) for x in (q, k, v))
+
+    grid = (bh, tp // bq, tp // bk)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+        seq_len=t)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            # lse rides a (bh, 1, T) layout so every block's trailing
+            # two dims are TPU-tileable (1 == full dim, bq % 128 == 0).
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tp, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, tp), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out[:, :t], lse[:, 0, :t]
+
+
+def _bwd(scale, causal, residuals, g):
+    """Recompute-based backward from the saved logsumexp: exact same
+    probabilities the kernel computed, expressed as two XLA matmul
+    chains (fused by the compiler)."""
+    q, k, v, out, lse = residuals
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    do = g.astype(jnp.float32)
+    t = q.shape[1]
+
+    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
+    if causal:
+        q_pos = jnp.arange(t)[:, None]
+        k_pos = jnp.arange(t)[None, :]
+        s = jnp.where(k_pos > q_pos, NEG_INF, s)
+    p = jnp.exp(s - lse[..., None])              # [bh, tq, tk]
+
+    dv = jnp.einsum("bqk,bqd->bkd", p, do)
+    dp = jnp.einsum("bqd,bkd->bqk", do, vf)
+    delta = jnp.sum(do * out.astype(jnp.float32), axis=-1, keepdims=True)
+    ds = p * (dp - delta) * scale
+    dq = jnp.einsum("bqk,bkd->bqd", ds, kf)
+    dk = jnp.einsum("bqk,bqd->bkd", ds, qf)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, _ = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k, interpret=interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
+                    block_k=block_k, interpret=interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, g):
+    return _bwd(scale, causal, residuals, g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """Fused attention over ``[B, T, H, D]`` tensors (the layout the
+    transformer uses); K/V heads must already be expanded to H (GQA
+    tiling happens in the model). Differentiable via custom VJP."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    b, t, h, d = q.shape
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    out = _flash(to_bh(q), to_bh(k), to_bh(v), float(scale), causal,
+                 block_q, block_k, interpret)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)

@@ -175,8 +175,29 @@ def make_sp_attention(mesh, *, axis_name: str = "sp", impl: str = "ring",
 
     if spec is None:
         spec = P(None, axis_name, None, None)
-    if impl == "local" or mesh is None or \
-            dict(getattr(mesh, "shape", {})).get(axis_name, 1) == 1:
+    sp1 = mesh is None or \
+        dict(getattr(mesh, "shape", {})).get(axis_name, 1) == 1
+    if impl == "flash":
+        if not sp1:
+            raise NotImplementedError(
+                "flash + sequence parallelism is not composed yet; use "
+                "impl='ring' for sp>1 (flash composes with dp/fsdp/tp)")
+        from horovod_tpu.ops.flash_attention import flash_attention
+        fa = functools.partial(flash_attention, causal=causal)
+        if mesh is None:
+            return fa
+        # The Pallas kernel is embarrassingly parallel over batch and
+        # heads but Mosaic can't be auto-partitioned by GSPMD: run it
+        # as a manual island over the batch/head sharding axes, with
+        # each device invoking the kernel on its local block.
+        bspec = P(("dp", "fsdp"), None, "tp", None)
+        batch_axes = frozenset(a for a in ("dp", "fsdp", "tp")
+                               if a in mesh.axis_names)
+        return jax.shard_map(fa, mesh=mesh,
+                             in_specs=(bspec, bspec, bspec),
+                             out_specs=bspec,
+                             axis_names=batch_axes, check_vma=False)
+    if impl == "local" or sp1:
         return functools.partial(local_attention, causal=causal)
     if impl == "ring":
         body = functools.partial(ring_self_attention, axis_name=axis_name,
