@@ -3,6 +3,13 @@
 // diverged" hang. Rebuild of horovod/common/stall_inspector.{h,cc}
 // (stall_inspector.h:30-96); invoked from the coordinator cycle like
 // controller.cc:126-135.
+//
+// Cached tensors need no separate invalidation path here (the
+// reference's InvalidateStalledCachedTensors): our coordinator expands
+// cache-hit bits back into full Requests before accumulation
+// (controller.cc CoordinatorCycle), so a tensor stalled in the cached
+// steady state is tracked and reported through the exact same
+// RecordUncachedTensor bookkeeping as a first-time tensor.
 #pragma once
 
 #include <chrono>
