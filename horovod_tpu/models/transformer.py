@@ -52,8 +52,9 @@ class TransformerConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16    # params/activations; reductions in f32
     remat: bool = True           # jax.checkpoint each layer (HBM for FLOPs)
-    sp_attention: str = "ring"   # "ring" | "ulysses" | "local" | "flash"
-                                 # (flash = fused Pallas kernel, sp=1)
+    sp_attention: str = "ring"   # "ring" | "ulysses" | "local" |
+                                 # "flash" (Pallas kernel, sp=1) |
+                                 # "ring_flash" (Pallas blocks, sp>1)
     # Mixture-of-Experts: n_experts > 0 replaces the dense SwiGLU FFN
     # with an expert-parallel MoE FFN in every layer (experts sharded
     # over the `ep` mesh axis; see models/moe.py).

@@ -84,9 +84,11 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
-def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
+def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret,
+         out_dtype=None):
     """q/k/v: [BH, T, D] -> (out [BH, T, D], lse [BH, T])."""
     bh, t, d = q.shape
+    out_dtype = q.dtype if out_dtype is None else out_dtype
     bq = min(block_q, _round_up(t, 128))
     bk = min(block_k, _round_up(t, 128))
     tp = _round_up(t, max(bq, bk))
@@ -113,7 +115,7 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, tp, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, tp, d), out_dtype),
             jax.ShapeDtypeStruct((bh, 1, tp), jnp.float32),
         ],
         scratch_shapes=[
@@ -126,10 +128,12 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
     return out[:, :t], lse[:, 0, :t]
 
 
-def _bwd(scale, causal, residuals, g):
+def _bwd(scale, causal, residuals, g, g_lse=None):
     """Recompute-based backward from the saved logsumexp: exact same
-    probabilities the kernel computed, expressed as two XLA matmul
-    chains (fused by the compiler)."""
+    probabilities the kernel computed, expressed as XLA matmul chains
+    (fused by the compiler). ``g_lse`` carries the logsumexp cotangent
+    when the caller consumed it (ring-attention block merging);
+    d lse/d q = (p @ k)·scale and d lse/d k_j = p_j · q · scale."""
     q, k, v, out, lse = residuals
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
@@ -150,6 +154,10 @@ def _bwd(scale, causal, residuals, g):
     ds = p * (dp - delta) * scale
     dq = jnp.einsum("bqk,bkd->bqd", ds, kf)
     dk = jnp.einsum("bqk,bqd->bkd", ds, qf)
+    if g_lse is not None:
+        gl = g_lse.astype(jnp.float32)
+        dq = dq + gl[..., None] * jnp.einsum("bqk,bkd->bqd", p, kf) * scale
+        dk = dk + jnp.einsum("bq,bqk,bqd->bkd", gl, p, qf) * scale
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
@@ -171,6 +179,48 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, g):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_lse(q, k, v, scale, causal, block_q, block_k, interpret,
+               out_dtype):
+    return _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
+                block_k=block_k, interpret=interpret, out_dtype=out_dtype)
+
+
+def _flash_lse_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+                   out_dtype):
+    out, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
+                    block_k=block_k, interpret=interpret,
+                    out_dtype=out_dtype)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd(scale, causal, block_q, block_k, interpret, out_dtype,
+                   residuals, g):
+    g_out, g_lse = g
+    return _bwd(scale, causal, residuals, g_out, g_lse)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_with_lse(q, k, v, *, causal: bool = False,
+                             scale: Optional[float] = None,
+                             block_q: int = 128, block_k: int = 128,
+                             interpret: Optional[bool] = None,
+                             out_dtype=None):
+    """``[BH, T, D]``-layout flash attention returning ``(out, lse)``
+    — the building block for blockwise composition (ring attention
+    merges per-chunk results by logsumexp weighting). Differentiable
+    in both outputs. ``out_dtype=jnp.float32`` keeps chunk outputs at
+    merge precision (callers that round once at the end)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    return _flash_lse(q, k, v, float(scale), causal, block_q, block_k,
+                      interpret, jnp.dtype(out_dtype) if out_dtype else None)
 
 
 def flash_attention(q, k, v, *, causal: bool = True,

@@ -116,6 +116,87 @@ def ring_self_attention(q, k, v, *, axis_name: str = "sp",
     return out.astype(q.dtype)
 
 
+def ring_flash_attention(q, k, v, *, axis_name: str = "sp",
+                         causal: bool = True,
+                         scale: Optional[float] = None):
+    """Ring attention whose per-chunk block compute is the **flash
+    Pallas kernel** (:mod:`horovod_tpu.ops.flash_attention`): each of
+    the ``sp`` steps runs fused attention of the local queries against
+    the currently held K/V chunk, returning ``(out, lse)``, and chunks
+    are merged by logsumexp weighting — the blockwise-parallel
+    formulation of the same online softmax :func:`ring_self_attention`
+    does in plain XLA. Long-context + sequence-parallel with the MXU
+    kernel in the inner loop.
+
+    Causality is per chunk: a chunk strictly before mine is fully
+    visible, my own chunk is causal with aligned positions, a later
+    chunk contributes nothing (its lse stays -inf so the merge ignores
+    it — and under reverse-mode AD its zero weight kills the gradient).
+    """
+    from horovod_tpu.ops.flash_attention import flash_attention_with_lse
+
+    B, T, H, D = q.shape
+    sp = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    if scale is None:
+        scale = D ** -0.5
+
+    def to_bh(x):  # [B, T, H, D] -> [B*H, T, D]
+        return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+    # Transform to kernel layout ONCE; K/V rotate in that layout (the
+    # ppermute cost is layout-independent).
+    qb, kb0, vb0 = to_bh(q), to_bh(k), to_bh(v)
+
+    # Chunk outputs stay f32 until the final merge so bf16 inputs round
+    # exactly once, like ring_self_attention's f32 accumulator.
+    def full_chunk(qb, kb, vb):
+        return flash_attention_with_lse(qb, kb, vb, causal=False,
+                                        scale=scale, out_dtype=jnp.float32)
+
+    def diag_chunk(qb, kb, vb):
+        return flash_attention_with_lse(qb, kb, vb, causal=True,
+                                        scale=scale, out_dtype=jnp.float32)
+
+    def skip_chunk(qb, kb, vb):
+        return (jnp.zeros((B * H, T, D), jnp.float32),
+                jnp.full((B * H, T), _NEG_BIG, jnp.float32))
+
+    # Running logsumexp merge: out_i is chunk-normalized, so the global
+    # result is Σ_i out_i·exp(lse_i) / Σ_i exp(lse_i). Track the running
+    # max m, the weighted sum o = Σ out_i·exp(lse_i − m), and the
+    # normalizer l = Σ exp(lse_i − m).
+    o = jnp.zeros((B * H, T, D), jnp.float32)
+    m = jnp.full((B * H, T), _NEG_BIG, jnp.float32)
+    l = jnp.zeros((B * H, T), jnp.float32)
+    if hasattr(lax, "pcast"):
+        o, m, l = (lax.pcast(t, (axis_name,), to="varying")
+                   for t in (o, m, l))
+
+    def step(i, carry):
+        o, m, l, k_cur, v_cur = carry
+        src = (my + i) % sp                     # global chunk index held
+        if causal:
+            case = jnp.where(src < my, 0, jnp.where(src == my, 1, 2))
+            out_b, lse_b = lax.switch(
+                case, [full_chunk, diag_chunk, skip_chunk],
+                qb, k_cur, v_cur)
+        else:
+            out_b, lse_b = full_chunk(qb, k_cur, v_cur)
+        m_new = jnp.maximum(m, lse_b)
+        w_old = jnp.exp(m - m_new)
+        w_new = jnp.exp(lse_b - m_new)
+        o = o * w_old[..., None] + out_b * w_new[..., None]
+        l = l * w_old + w_new
+        k_nxt = _rotate(k_cur, axis_name, shift=-1)
+        v_nxt = _rotate(v_cur, axis_name, shift=-1)
+        return o, m_new, l, k_nxt, v_nxt
+
+    o, m, l, _, _ = lax.fori_loop(0, sp, step, (o, m, l, kb0, vb0))
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3).astype(q.dtype)
+
+
 def local_attention(q, k, v, *, causal: bool = True,
                     scale: Optional[float] = None):
     """Plain (single-device-sequence) attention with the same layout,
@@ -180,8 +261,8 @@ def make_sp_attention(mesh, *, axis_name: str = "sp", impl: str = "ring",
     if impl == "flash":
         if not sp1:
             raise NotImplementedError(
-                "flash + sequence parallelism is not composed yet; use "
-                "impl='ring' for sp>1 (flash composes with dp/fsdp/tp)")
+                "impl='flash' is the sp=1 kernel; use impl='ring_flash' "
+                "for sequence parallelism with the Pallas block kernel")
         from horovod_tpu.ops.flash_attention import flash_attention
         fa = functools.partial(flash_attention, causal=causal)
         if mesh is None:
@@ -201,6 +282,9 @@ def make_sp_attention(mesh, *, axis_name: str = "sp", impl: str = "ring",
         return functools.partial(local_attention, causal=causal)
     if impl == "ring":
         body = functools.partial(ring_self_attention, axis_name=axis_name,
+                                 causal=causal)
+    elif impl == "ring_flash":
+        body = functools.partial(ring_flash_attention, axis_name=axis_name,
                                  causal=causal)
     elif impl == "ulysses":
         body = functools.partial(ulysses_attention, axis_name=axis_name,
