@@ -1,3 +1,8 @@
-"""Ray integration (reference ``horovod/ray/runner.py:248``)."""
+"""Ray integration (reference ``horovod/ray/runner.py:248``,
+``horovod/ray/elastic.py:149``)."""
 
+from horovod_tpu.ray.elastic import (  # noqa: F401
+    ElasticRayExecutor,
+    RayHostDiscovery,
+)
 from horovod_tpu.ray.runner import RayExecutor  # noqa: F401

@@ -1,0 +1,82 @@
+"""Elastic training on Ray clusters.
+
+Rebuild of the reference ``ElasticRayExecutor`` + ``RayHostDiscovery``
+(``horovod/ray/elastic.py:149``, ``:40``): Ray supplies live cluster
+membership (``ray.nodes()``), and horovod_tpu's own elastic driver does
+everything else — rank assignment, worker spawn/respawn, blacklist,
+re-rendezvous. Adding or removing Ray nodes mid-job grows or shrinks
+the world exactly like a changed ``--host-discovery-script``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from horovod_tpu.runner.elastic_driver import HostDiscovery
+
+
+class RayHostDiscovery(HostDiscovery):
+    """Host/slot table from live Ray cluster state (reference
+    ``RayHostDiscovery.find_available_hosts_and_slots``)."""
+
+    def __init__(self, use_gpu: bool = False, cpus_per_slot: float = 1,
+                 gpus_per_slot: float = 1):
+        self.use_gpu = use_gpu
+        self.cpus_per_slot = cpus_per_slot
+        self.gpus_per_slot = gpus_per_slot
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        import ray
+
+        hosts: Dict[str, int] = {}
+        for node in ray.nodes():
+            if not node.get("Alive"):
+                continue
+            res = node.get("Resources", {})
+            if self.use_gpu:
+                slots = int(res.get("GPU", 0) // self.gpus_per_slot)
+            else:
+                slots = int(res.get("CPU", 0) // self.cpus_per_slot)
+            if slots > 0:
+                hosts[node["NodeManagerAddress"]] = slots
+        return hosts
+
+
+class ElasticRayExecutor:
+    """Run an elastic horovod_tpu job over a Ray cluster's hosts.
+
+    ``run(command)`` launches one worker per discovered slot (ssh for
+    remote nodes, local exec otherwise — the same transport as
+    ``horovodrun``), keeps the job alive through node add/remove within
+    ``[min_np, max_np]``, and returns {identity: exit_code}. Workers
+    use ``hvd.elastic.run`` + ``State`` for commit/restore exactly as
+    under script-based discovery.
+    """
+
+    def __init__(self, *, min_np: int = 1, max_np: int = 0,
+                 use_gpu: bool = False, cpus_per_slot: float = 1,
+                 gpus_per_slot: float = 1,
+                 env: Optional[Dict[str, str]] = None,
+                 discovery: Optional[HostDiscovery] = None,
+                 discovery_interval: float = 1.0,
+                 start_timeout: float = 120.0,
+                 verbose: bool = False):
+        self.min_np = min_np
+        self.max_np = max_np
+        self.discovery = discovery or RayHostDiscovery(
+            use_gpu=use_gpu, cpus_per_slot=cpus_per_slot,
+            gpus_per_slot=gpus_per_slot)
+        self.env = dict(env or {})
+        self.discovery_interval = discovery_interval
+        self.start_timeout = start_timeout
+        self.verbose = verbose
+
+    def run(self, command: List[str]) -> Dict[str, int]:
+        from horovod_tpu.runner.launch import LaunchSettings, launch_elastic
+
+        settings = LaunchSettings(
+            np=self.min_np, command=list(command), env=self.env,
+            start_timeout=self.start_timeout, verbose=self.verbose)
+        return launch_elastic(settings, self.discovery,
+                              min_np=self.min_np, max_np=self.max_np,
+                              discovery_interval=self.discovery_interval)

@@ -36,7 +36,9 @@ setup(
     package_data={"horovod_tpu.common": ["libhorovod_tpu_core.so"]},
     install_requires=["numpy", "cloudpickle"],
     extras_require={
-        "jax": ["jax", "optax"],
+        # >=0.6: lax.pcast + shard_map axis_names (pinned APIs — the
+        # attention islands use them unconditionally).
+        "jax": ["jax>=0.6", "optax"],
         "torch": ["torch"],
         "ray": ["ray"],
         "spark": ["pyspark"],

@@ -92,6 +92,47 @@ def test_ray_executor_requires_start(fake_ray):
         RayExecutor(num_workers=2).run(lambda: None)
 
 
+def test_ray_host_discovery(fake_ray):
+    from horovod_tpu.ray import RayHostDiscovery
+
+    fake_ray.nodes = lambda: [
+        {"Alive": True, "NodeManagerAddress": "10.0.0.1",
+         "Resources": {"CPU": 8.0, "GPU": 2.0}},
+        {"Alive": True, "NodeManagerAddress": "10.0.0.2",
+         "Resources": {"CPU": 4.0}},
+        {"Alive": False, "NodeManagerAddress": "10.0.0.3",
+         "Resources": {"CPU": 16.0}},
+    ]
+    assert RayHostDiscovery().find_available_hosts_and_slots() == {
+        "10.0.0.1": 8, "10.0.0.2": 4}
+    assert RayHostDiscovery(cpus_per_slot=4).find_available_hosts_and_slots() \
+        == {"10.0.0.1": 2, "10.0.0.2": 1}
+    assert RayHostDiscovery(use_gpu=True).find_available_hosts_and_slots() \
+        == {"10.0.0.1": 2}
+
+
+def test_elastic_ray_executor_wires_driver(fake_ray, monkeypatch):
+    from horovod_tpu import ray as hvd_ray
+    from horovod_tpu.ray.elastic import ElasticRayExecutor
+
+    captured = {}
+
+    def fake_launch_elastic(settings, discovery, min_np, max_np,
+                            discovery_interval):
+        captured.update(settings=settings, discovery=discovery,
+                        min_np=min_np, max_np=max_np)
+        return {"h:0": 0}
+
+    import horovod_tpu.runner.launch as launch_mod
+    monkeypatch.setattr(launch_mod, "launch_elastic", fake_launch_elastic)
+    ex = ElasticRayExecutor(min_np=2, max_np=6, env={"X": "1"})
+    codes = ex.run(["python", "train.py"])
+    assert codes == {"h:0": 0}
+    assert captured["min_np"] == 2 and captured["max_np"] == 6
+    assert captured["settings"].command == ["python", "train.py"]
+    assert isinstance(captured["discovery"], hvd_ray.RayHostDiscovery)
+
+
 # ---------------------------------------------------------------------------
 # stub pyspark (barrier execution)
 # ---------------------------------------------------------------------------
