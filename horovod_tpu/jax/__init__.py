@@ -87,10 +87,13 @@ def allreduce_gradients(grads: Any, *, axis_name: Optional[AxisName] = None,
                 g = lax.pmax(g, axis_name)
             elif op == Min:
                 g = lax.pmin(g, axis_name)
+            elif op == Adasum:
+                from horovod_tpu.ops.adasum import adasum_allreduce
+                g = adasum_allreduce(g, axis_name)
             else:
                 raise ValueError(
                     f"in-jit gradient reduction with op={op!r} is not "
-                    "supported (use Average/Sum/Max/Min)")
+                    "supported (use Average/Sum/Max/Min/Adasum)")
             return compression.decompress(g, ctx)
 
         return jax.tree.unflatten(treedef, [reduce_leaf(g) for g in leaves])

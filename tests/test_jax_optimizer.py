@@ -125,3 +125,23 @@ def test_eager_compression_bf16():
     out = hvd.allreduce_gradients(grads, compression=hvd.Compression.bf16)
     assert out["w"].dtype == jnp.float32
     assert np.allclose(out["w"], 1.0)  # bf16 rounding applied
+
+
+def test_in_jit_adasum_gradient_reduction(mesh8):
+    """allreduce_gradients(op=Adasum) inside shard_map runs the
+    distance-doubling tree per leaf."""
+    from jax import shard_map
+
+    from _adasum_model import adasum_fold_model
+
+    rng = np.random.RandomState(3)
+    per_rank = rng.randn(8, 12).astype(np.float32)
+
+    def f(g):
+        return hvd.allreduce_gradients({"w": g[0]}, axis_name="dp",
+                                       op=hvd.Adasum)["w"]
+
+    got = jax.jit(shard_map(f, mesh=mesh8, in_specs=P("dp"),
+                            out_specs=P()))(jnp.asarray(per_rank))
+    want = adasum_fold_model(list(per_rank))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4)

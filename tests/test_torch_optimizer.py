@@ -103,6 +103,46 @@ def test_two_rank_grad_average(compression):
     assert np.allclose(results[0], expect, atol=atol), (results[0], expect)
 
 
+def _adasum_step_worker():
+    """DistributedOptimizer(op=Adasum): the applied update must be the
+    native core's VHDD combine of the per-rank gradients."""
+    import numpy as np
+    import torch
+    import torch.nn as nn
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    torch.manual_seed(7)
+    model = nn.Linear(3, 1, bias=False)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=1.0),
+        named_parameters=model.named_parameters(), op=hvd.Adasum)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    x = torch.tensor([[float(r + 1), 0.0, 0.0],
+                      [0.0, float(2 - r), 0.0]])
+    model(x).sum().backward()
+    opt.step()
+    out = model.weight.detach().numpy().copy().ravel().tolist()
+    hvd.shutdown()
+    return out
+
+
+def test_two_rank_adasum_optimizer():
+    from _adasum_model import adasum_fold_model
+
+    results = run(_adasum_step_worker, np=2, env=_WORKER_ENV,
+                  start_timeout=90)
+    assert np.allclose(results[0], results[1]), results
+    torch.manual_seed(7)
+    w0 = nn.Linear(3, 1, bias=False).weight.detach().numpy().ravel()
+    # grad of sum(w.x): rank 0 -> [1, 2, 0], rank 1 -> [2, 1, 0]
+    g = adasum_fold_model([np.array([1.0, 2.0, 0.0], np.float32),
+                           np.array([2.0, 1.0, 0.0], np.float32)])
+    expect = w0 - g
+    assert np.allclose(results[0], expect, atol=1e-5), (results[0], expect)
+
+
 def test_backward_passes_per_step_accumulates():
     results = run(_two_rank_step, args=("none", 2), np=2,
                   env=_WORKER_ENV, start_timeout=90)
