@@ -1,0 +1,79 @@
+"""Elastic fault-tolerant training example.
+
+The shape of the reference's elastic examples
+(``examples/elastic/pytorch/pytorch_mnist_elastic.py``): wrap the
+training loop in ``@hvd.elastic.run`` with a committed ``State`` —
+when workers are added or removed (discovery change) or a worker dies
+mid-batch (``HorovodInternalError``), survivors restore the last
+committed state, re-rendezvous with the new world, and resume from the
+committed batch instead of restarting.
+
+Run with scripted discovery (hosts may change between polls):
+
+    horovodrun -np 2 --min-np 1 --max-np 4 \
+        --host-discovery-script ./my_discovery.sh \
+        python examples/elastic_train.py
+
+or on a Ray cluster:
+
+    from horovod_tpu.ray import ElasticRayExecutor
+    ElasticRayExecutor(min_np=2, max_np=8).run(
+        ["python", "examples/elastic_train.py"])
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+import horovod_tpu.elastic as elastic  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batches", type=int, default=50)
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args()
+
+    hvd.init()
+
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(8)
+
+    # Everything that must survive a membership change lives in the
+    # State: it is saved on commit(), restored after a failure, and
+    # synced (broadcast from rank 0) after every re-rendezvous.
+    state = elastic.ObjectState(batch=0, w=np.zeros(8))
+
+    @elastic.run
+    def train(state):
+        while state.batch < args.batches:
+            x = rng.randn(32, 8)
+            err = x @ state.w - x @ w_true
+            grad = x.T @ err / len(x)
+            # Averaged across however many ranks currently exist.
+            grad = hvd.allreduce(grad.astype(np.float32),
+                                 name=f"g.{state.batch % 2}")
+            state.w = state.w - args.lr * np.asarray(grad, np.float64)
+            state.batch += 1
+            if state.batch % 10 == 0:
+                state.commit()   # checkpoint + host-change check
+                if hvd.rank() == 0:
+                    loss = float(np.mean((state.w - w_true) ** 2))
+                    print(f"batch {state.batch}: size={hvd.size()} "
+                          f"loss={loss:.5f}", flush=True)
+        return state.w
+
+    w = train(state)
+    if hvd.rank() == 0:
+        print(f"FINAL err={float(np.mean((w - w_true) ** 2)):.6f}",
+              flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

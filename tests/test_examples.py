@@ -85,3 +85,24 @@ def test_train_identical_1proc_vs_2proc():
         np.testing.assert_allclose(duo[0][k], duo[1][k], atol=1e-6)
         np.testing.assert_allclose(solo[k], duo[0][k], atol=1e-5,
                                    err_msg=f"weight {k} diverged")
+
+
+def test_elastic_example_with_discovery(tmp_path):
+    """Run the elastic example end to end under scripted discovery."""
+    import stat
+    import subprocess
+
+    script = tmp_path / "discover.sh"
+    script.write_text("#!/bin/sh\necho 127.0.0.1:2\n")
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    env = dict(os.environ)
+    env.update(_WORKER_ENV)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bin", "horovodrun"),
+         "-np", "2", "--min-np", "1", "--max-np", "2",
+         "--host-discovery-script", str(script),
+         sys.executable, os.path.join(ROOT, "examples", "elastic_train.py"),
+         "--batches", "20"],
+        env=env, capture_output=True, text=True, timeout=150)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "FINAL err=" in proc.stdout

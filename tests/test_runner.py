@@ -187,3 +187,18 @@ def test_run_function_error_reports_traceback():
         raise ValueError("worker exploded")
     with pytest.raises(RuntimeError, match="worker exploded"):
         run(boom, np=2, env=_WORKER_ENV, start_timeout=60)
+
+
+def test_autotune_and_hierarchical_flags():
+    args = build_parser().parse_args(
+        ["-np", "2", "--autotune", "--autotune-log-file", "/tmp/at.csv",
+         "--hierarchical-allreduce", "python", "train.py"])
+    env = args_to_env(args)
+    assert env["HOROVOD_AUTOTUNE"] == "1"
+    assert env["HOROVOD_AUTOTUNE_LOG"] == "/tmp/at.csv"
+    assert env["HOROVOD_HIERARCHICAL_ALLREDUCE"] == "1"
+    # absent unless requested
+    env2 = args_to_env(build_parser().parse_args(
+        ["-np", "2", "python", "train.py"]))
+    assert "HOROVOD_AUTOTUNE" not in env2
+    assert "HOROVOD_HIERARCHICAL_ALLREDUCE" not in env2
