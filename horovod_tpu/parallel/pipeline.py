@@ -37,17 +37,26 @@ def _stage_specs(stage_params) -> Any:
 
 def pipeline_apply(stage_fn: Callable, stage_params, microbatches, *,
                    mesh: Mesh, axis_name: str = "pp",
-                   remat_stage: bool = True):
+                   remat_stage: bool = True, with_aux: bool = False):
     """Run ``microbatches [M, mb, ...]`` through ``S`` pipeline stages.
 
     ``stage_fn(params_slice, x) -> y`` must preserve ``x``'s
     shape/dtype (decoder blocks do); ``stage_params`` leaves carry a
     leading stage dim of size ``S = mesh.shape[axis_name]``. Returns
     outputs shaped like ``microbatches``, replicated over ``pp``.
+
+    ``with_aux=True``: ``stage_fn`` returns ``(y, aux_scalar_f32)``
+    (e.g. the MoE load-balancing term); aux is accumulated over every
+    REAL (non-bubble) tick and summed over stages — the return becomes
+    ``(outputs, aux_total)``.
     """
     S = mesh.shape[axis_name]
     M = microbatches.shape[0]
-    fn = jax.checkpoint(stage_fn) if remat_stage else stage_fn
+    base_fn = stage_fn
+    if not with_aux:
+        def base_fn(p, x):  # noqa: F811 — uniform (y, aux) contract
+            return stage_fn(p, x), jnp.zeros((), jnp.float32)
+    fn = jax.checkpoint(base_fn) if remat_stage else base_fn
     # XLA-CPU workaround: under partial-manual shard_map the Shardy
     # partitioner leaves a sharding_constraint inside all-reduce reducer
     # regions, and the CPU AllReducePromotion pass aborts cloning any
@@ -72,13 +81,15 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches, *,
             mb = (mb + (idx * 0).astype(mb.dtype)).astype(dtype)
 
         def tick(carry, t):
-            acts, outs = carry
+            acts, outs, aux_acc = carry
             m = t - idx                             # my microbatch index
             mc = jnp.clip(m, 0, M - 1)
             x0 = lax.dynamic_index_in_dim(mb, mc, 0, keepdims=False)
             inp = jnp.where(idx == 0, x0, acts)
-            y = fn(local, inp)
-            bank = (m >= 0) & (m < M) & (idx == S - 1)
+            y, aux = fn(local, inp)
+            real = (m >= 0) & (m < M)               # non-bubble tick
+            aux_acc = aux_acc + jnp.where(real, aux, 0.0)
+            bank = real & (idx == S - 1)
             outs = jnp.where(bank,
                              lax.dynamic_update_index_in_dim(outs, y, mc, 0),
                              outs)
@@ -86,7 +97,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches, *,
             # comes from mb, the last stage's output was banked).
             acts = lax.ppermute(y, axis_name,
                                 [(i, i + 1) for i in range(S - 1)])
-            return (acts, outs), None
+            return (acts, outs, aux_acc), None
 
         # The zeros are constant across pp but the loop makes them
         # device-varying, so the scan carry needs a varying type on
@@ -97,21 +108,30 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches, *,
         vzero = (idx * 0).astype(mb.dtype)
         init = jax.tree.map(lambda a: a + vzero,
                             (jnp.zeros_like(mb[0]), jnp.zeros_like(mb)))
-        (_, outs), _ = lax.scan(tick, init, jnp.arange(M + S - 1))
+        init = (*init, jnp.zeros((), jnp.float32)
+                + (idx * 0).astype(jnp.float32))
+        (_, outs, aux_acc), _ = lax.scan(tick, init,
+                                         jnp.arange(M + S - 1))
         # Only the last stage's bank is real; replicate it everywhere
-        # (f32 on the wire under the CPU workaround above).
+        # (f32 on the wire under the CPU workaround above). Aux sums
+        # over stages (already f32, so the psum is CPU-safe).
         masked = jnp.where(idx == S - 1, outs, jnp.zeros_like(outs))
         if f32_wire:
             outs = lax.psum(masked.astype(jnp.float32),
                             axis_name).astype(dtype)
         else:
             outs = lax.psum(masked, axis_name)
-        return outs
+        aux_total = lax.psum(aux_acc, axis_name)
+        return outs, aux_total
 
-    return shard_map(island, mesh=mesh,
-                     in_specs=(_stage_specs(stage_params), P()),
-                     out_specs=P(), axis_names={axis_name})(
-                         stage_params, microbatches)
+    outs, aux_total = shard_map(island, mesh=mesh,
+                                in_specs=(_stage_specs(stage_params), P()),
+                                out_specs=(P(), P()),
+                                axis_names={axis_name})(
+                                    stage_params, microbatches)
+    if with_aux:
+        return outs, aux_total
+    return outs
 
 
 # ---------------------------------------------------------------------------
@@ -143,9 +163,14 @@ def pp_param_specs(cfg, n_stages: int):
 
 def make_pp_train_step(cfg, mesh: Mesh, n_micro: int, optimizer=None):
     """GPipe training step for the transformer over a mesh with pp>1
-    (compose with dp/fsdp/tp as usual; sp inside a pipeline stage is
-    not supported yet — use ring attention without pp, or pp with full
-    sequences per stage).
+    (compose with dp/fsdp/tp/ep as usual; sp inside a pipeline stage
+    is not supported yet — use ring attention without pp, or pp with
+    full sequences per stage).
+
+    MoE composes: the load-balancing aux term threads through the
+    schedule, computed per microbatch (the natural statistic inside a
+    pipeline — it differs from a full-batch aux exactly as microbatched
+    MoE training always does).
 
     Returns ``(init_state, jit_step, param_shardings)`` like
     :func:`horovod_tpu.models.transformer.make_train_step`.
@@ -154,10 +179,6 @@ def make_pp_train_step(cfg, mesh: Mesh, n_micro: int, optimizer=None):
 
     from horovod_tpu.models import transformer as tr
 
-    if cfg.moe is not None:
-        raise NotImplementedError(
-            "pp + MoE composition is not supported yet (the aux loss "
-            "does not thread through the pipeline schedule)")
     if mesh.shape.get("sp", 1) > 1:
         raise NotImplementedError(
             "pp + sp composition is not supported yet (the pipeline "
@@ -173,10 +194,9 @@ def make_pp_train_step(cfg, mesh: Mesh, n_micro: int, optimizer=None):
 
     def stage_fn(stage_layers, x):
         def one(x, lp):
-            y, _aux = tr.decoder_layer(cfg, attend, lambda v, *s: v, x, lp)
-            return y, None
-        y, _ = lax.scan(one, x, stage_layers)
-        return y
+            return tr.decoder_layer(cfg, attend, lambda v, *s: v, x, lp)
+        y, auxes = lax.scan(one, x, stage_layers)
+        return y, auxes.sum()
 
     def loss_fn(params, batch):
         tokens = batch["tokens"]
@@ -187,14 +207,18 @@ def make_pp_train_step(cfg, mesh: Mesh, n_micro: int, optimizer=None):
         x = params["embed"].astype(cfg.dtype)[inp]
         x = constrain(x, ("dp", "fsdp"), None, None)
         mb = x.reshape(n_micro, B // n_micro, T, x.shape[-1])
-        y = pipeline_apply(stage_fn, params["layers"], mb, mesh=mesh,
-                           remat_stage=cfg.remat)
+        y, aux = pipeline_apply(stage_fn, params["layers"], mb, mesh=mesh,
+                                remat_stage=cfg.remat, with_aux=True)
         x = y.reshape(B, T, -1)
         x = tr._rmsnorm(x, params["final_norm"], cfg.norm_eps)
         logits = (x @ params["lm_head"]).astype(jnp.float32)
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-        return nll.mean()
+        # Aux accumulated once per (stage, microbatch); lm_loss's flat
+        # form sums per-layer aux once over the whole batch — per-
+        # microbatch MoE terms are means over their microbatch, so the
+        # microbatch-summed aux must be averaged back.
+        return nll.mean() + aux / n_micro
 
     specs = pp_param_specs(cfg, S)
 
