@@ -111,3 +111,4 @@ def test_pp_moe_matches_flat(devices, n_micro):
     np.testing.assert_allclose(float(loss), ref, rtol=2e-5)
     _, loss2 = jit_step(state, batch)
     assert float(loss2) < float(loss)
+
