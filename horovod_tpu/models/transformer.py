@@ -52,6 +52,10 @@ class TransformerConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16    # params/activations; reductions in f32
     remat: bool = True           # jax.checkpoint each layer (HBM for FLOPs)
+    # "dots": save matmul outputs, recompute elementwise (measured ~9%
+    # faster than full recompute at d=2048 on v5e); "full": recompute
+    # everything (minimum memory).
+    remat_policy: str = "dots"
     sp_attention: str = "ring"   # "ring" | "ulysses" | "local" |
                                  # "flash" (Pallas kernel, sp=1) |
                                  # "ring_flash" (Pallas blocks, sp>1)
@@ -201,6 +205,15 @@ def _attention_island(cfg: TransformerConfig, mesh: Optional[Mesh]):
                              causal=True)
 
 
+def remat_policy_fn(cfg: TransformerConfig):
+    """jax.checkpoint policy for the layer remat (None = full)."""
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if cfg.remat_policy == "full":
+        return None
+    raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}")
+
+
 def _constrainer(mesh: Optional[Mesh]):
     def constrain(x, *spec):
         if mesh is not None:
@@ -265,7 +278,7 @@ def forward_with_aux(params, tokens, cfg: TransformerConfig,
         return decoder_layer(cfg, attend, constrain, x, lp)
 
     if cfg.remat:
-        layer = jax.checkpoint(layer)
+        layer = jax.checkpoint(layer, policy=remat_policy_fn(cfg))
 
     x, auxes = lax.scan(layer, x, params["layers"])
     x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)

@@ -37,8 +37,8 @@ def _stage_specs(stage_params) -> Any:
 
 def pipeline_apply(stage_fn: Callable, stage_params, microbatches, *,
                    mesh: Mesh, axis_name: str = "pp",
-                   remat_stage: bool = True, with_aux: bool = False,
-                   check_vma: bool = True):
+                   remat_stage: bool = True, remat_policy=None,
+                   with_aux: bool = False, check_vma: bool = True):
     """Run ``microbatches [M, mb, ...]`` through ``S`` pipeline stages.
 
     ``stage_fn(params_slice, x) -> y`` must preserve ``x``'s
@@ -57,7 +57,8 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches, *,
     if not with_aux:
         def base_fn(p, x):  # noqa: F811 — uniform (y, aux) contract
             return stage_fn(p, x), jnp.zeros((), jnp.float32)
-    fn = jax.checkpoint(base_fn) if remat_stage else base_fn
+    fn = (jax.checkpoint(base_fn, policy=remat_policy) if remat_stage
+          else base_fn)
     # XLA-CPU workaround: under partial-manual shard_map the Shardy
     # partitioner leaves a sharding_constraint inside all-reduce reducer
     # regions, and the CPU AllReducePromotion pass aborts cloning any
@@ -222,7 +223,9 @@ def make_pp_train_step(cfg, mesh: Mesh, n_micro: int, optimizer=None):
         x = constrain(x, ("dp", "fsdp"), None, None)
         mb = x.reshape(n_micro, B // n_micro, T, x.shape[-1])
         y, aux = pipeline_apply(stage_fn, params["layers"], mb, mesh=mesh,
-                                remat_stage=cfg.remat, with_aux=True)
+                                remat_stage=cfg.remat,
+                                remat_policy=tr.remat_policy_fn(cfg),
+                                with_aux=True)
         x = y.reshape(B, T, -1)
         x = tr._rmsnorm(x, params["final_norm"], cfg.norm_eps)
         logits = (x @ params["lm_head"]).astype(jnp.float32)
