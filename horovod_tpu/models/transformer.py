@@ -239,7 +239,9 @@ def decoder_layer(cfg: TransformerConfig, attend, constrain, x, lp):
     vv = (h @ lp["wv"]).reshape(B, T, Hkv, Dh)
     q = _rope(q, pos, cfg.rope_theta)
     kk = _rope(kk, pos, cfg.rope_theta)
-    if Hkv != H:  # GQA: tile kv heads up to H
+    if Hkv != H and not getattr(attend, "handles_gqa", False):
+        # GQA: tile kv heads up to H for impls that need square heads
+        # (flash reads grouped K/V natively and skips this copy).
         rep = H // Hkv
         kk = jnp.repeat(kk, rep, axis=2)
         vv = jnp.repeat(vv, rep, axis=2)

@@ -85,8 +85,14 @@ def _round_up(x: int, m: int) -> int:
 
 
 def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret,
-         out_dtype=None):
-    """q/k/v: [BH, T, D] -> (out [BH, T, D], lse [BH, T])."""
+         out_dtype=None, q_per_kv: int = 1):
+    """q: [BH, T, D]; k/v: [B·Hkv, T, D] with BH = B·Hkv·q_per_kv ->
+    (out [BH, T, D], lse [BH, T]).
+
+    GQA runs natively: the K/V BlockSpec index map sends each query
+    head's grid step to its kv group's block, so grouped K/V are never
+    materialized ``q_per_kv`` times in HBM (the [B,H] flattening is
+    batch-major, so ``kv_index = q_index // q_per_kv``)."""
     bh, t, d = q.shape
     out_dtype = q.dtype if out_dtype is None else out_dtype
     bq = min(block_q, _round_up(t, 128))
@@ -105,8 +111,10 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d),
+                         lambda b, i, j: (b // q_per_kv, j, 0)),
+            pl.BlockSpec((1, bk, d),
+                         lambda b, i, j: (b // q_per_kv, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -128,78 +136,92 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret,
     return out[:, :t], lse[:, 0, :t]
 
 
-def _bwd(scale, causal, residuals, g, g_lse=None):
+def _bwd(scale, causal, residuals, g, g_lse=None, q_per_kv: int = 1):
     """Recompute-based backward from the saved logsumexp: exact same
     probabilities the kernel computed, expressed as XLA matmul chains
     (fused by the compiler). ``g_lse`` carries the logsumexp cotangent
     when the caller consumed it (ring-attention block merging);
-    d lse/d q = (p @ k)·scale and d lse/d k_j = p_j · q · scale."""
+    d lse/d q = (p @ k)·scale and d lse/d k_j = p_j · q · scale.
+
+    GQA (``q_per_kv > 1``): q-side tensors reshape to a [B·Hkv, rep]
+    grouping (consecutive query heads share a kv head under the
+    batch-major flattening) and dk/dv sum over the group."""
     q, k, v, out, lse = residuals
-    qf = q.astype(jnp.float32)
+    rep = q_per_kv
+    bkv = k.shape[0]
+    t = q.shape[1]
+    d = q.shape[2]
+    qf = q.astype(jnp.float32).reshape(bkv, rep, t, d)
     kf = k.astype(jnp.float32)
     vf = v.astype(jnp.float32)
-    do = g.astype(jnp.float32)
-    t = q.shape[1]
+    do = g.astype(jnp.float32).reshape(bkv, rep, t, d)
+    outf = out.astype(jnp.float32).reshape(bkv, rep, t, d)
+    lseg = lse.reshape(bkv, rep, t)
 
-    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
+    s = jnp.einsum("brqd,bkd->brqk", qf, kf) * scale
     if causal:
         q_pos = jnp.arange(t)[:, None]
         k_pos = jnp.arange(t)[None, :]
         s = jnp.where(k_pos > q_pos, NEG_INF, s)
-    p = jnp.exp(s - lse[..., None])              # [bh, tq, tk]
+    p = jnp.exp(s - lseg[..., None])             # [bkv, rep, tq, tk]
 
-    dv = jnp.einsum("bqk,bqd->bkd", p, do)
-    dp = jnp.einsum("bqd,bkd->bqk", do, vf)
-    delta = jnp.sum(do * out.astype(jnp.float32), axis=-1, keepdims=True)
+    dv = jnp.einsum("brqk,brqd->bkd", p, do)
+    dp = jnp.einsum("brqd,bkd->brqk", do, vf)
+    delta = jnp.sum(do * outf, axis=-1, keepdims=True)
     ds = p * (dp - delta) * scale
-    dq = jnp.einsum("bqk,bkd->bqd", ds, kf)
-    dk = jnp.einsum("bqk,bqd->bkd", ds, qf)
+    dq = jnp.einsum("brqk,bkd->brqd", ds, kf)
+    dk = jnp.einsum("brqk,brqd->bkd", ds, qf)
     if g_lse is not None:
-        gl = g_lse.astype(jnp.float32)
-        dq = dq + gl[..., None] * jnp.einsum("bqk,bkd->bqd", p, kf) * scale
-        dk = dk + jnp.einsum("bq,bqk,bqd->bkd", gl, p, qf) * scale
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+        gl = g_lse.astype(jnp.float32).reshape(bkv, rep, t)
+        dq = dq + gl[..., None] * jnp.einsum("brqk,bkd->brqd", p, kf) * scale
+        dk = dk + jnp.einsum("brq,brqk,brqd->bkd", gl, p, qf) * scale
+    return (dq.reshape(q.shape).astype(q.dtype), dk.astype(k.dtype),
+            dv.astype(v.dtype))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret, q_per_kv):
     out, _ = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
-                  block_k=block_k, interpret=interpret)
+                  block_k=block_k, interpret=interpret, q_per_kv=q_per_kv)
     return out
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+               q_per_kv):
     out, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
-                    block_k=block_k, interpret=interpret)
+                    block_k=block_k, interpret=interpret,
+                    q_per_kv=q_per_kv)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, g):
-    return _bwd(scale, causal, residuals, g)
+def _flash_bwd(scale, causal, block_q, block_k, interpret, q_per_kv,
+               residuals, g):
+    return _bwd(scale, causal, residuals, g, q_per_kv=q_per_kv)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _flash_lse(q, k, v, scale, causal, block_q, block_k, interpret,
-               out_dtype):
+               out_dtype, q_per_kv):
     return _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
-                block_k=block_k, interpret=interpret, out_dtype=out_dtype)
+                block_k=block_k, interpret=interpret, out_dtype=out_dtype,
+                q_per_kv=q_per_kv)
 
 
 def _flash_lse_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
-                   out_dtype):
+                   out_dtype, q_per_kv):
     out, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
                     block_k=block_k, interpret=interpret,
-                    out_dtype=out_dtype)
+                    out_dtype=out_dtype, q_per_kv=q_per_kv)
     return (out, lse), (q, k, v, out, lse)
 
 
 def _flash_lse_bwd(scale, causal, block_q, block_k, interpret, out_dtype,
-                   residuals, g):
+                   q_per_kv, residuals, g):
     g_out, g_lse = g
-    return _bwd(scale, causal, residuals, g_out, g_lse)
+    return _bwd(scale, causal, residuals, g_out, g_lse, q_per_kv=q_per_kv)
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
@@ -220,23 +242,33 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = False,
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     return _flash_lse(q, k, v, float(scale), causal, block_q, block_k,
-                      interpret, jnp.dtype(out_dtype) if out_dtype else None)
+                      interpret, jnp.dtype(out_dtype) if out_dtype else None,
+                      1)
 
 
 def flash_attention(q, k, v, *, causal: bool = True,
                     scale: Optional[float] = None,
                     block_q: int = 128, block_k: int = 128,
                     interpret: Optional[bool] = None):
-    """Fused attention over ``[B, T, H, D]`` tensors (the layout the
-    transformer uses); K/V heads must already be expanded to H (GQA
-    tiling happens in the model). Differentiable via custom VJP."""
+    """Fused attention over ``[B, T, H, D]`` q with ``[B, T, Hkv, D]``
+    k/v, ``H % Hkv == 0`` — **GQA runs natively**: grouped K/V are read
+    by index-map inside the kernel, never materialized per query head
+    (an Hkv=H/4 model moves 4× less K/V through HBM than pre-tiling).
+    Differentiable via custom VJP."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     b, t, h, d = q.shape
+    hkv = k.shape[2]
+    if h % hkv or v.shape[2] != hkv:
+        raise ValueError(
+            f"q heads ({h}) must be a multiple of kv heads ({hkv}); "
+            f"v has {v.shape[2]}")
+
     def to_bh(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+        return x.transpose(0, 2, 1, 3).reshape(b * x.shape[2], t, d)
+
     out = _flash(to_bh(q), to_bh(k), to_bh(v), float(scale), causal,
-                 block_q, block_k, interpret)
+                 block_q, block_k, interpret, h // hkv)
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)

@@ -263,19 +263,37 @@ def make_sp_attention(mesh, *, axis_name: str = "sp", impl: str = "ring",
                 "for sequence parallelism with the Pallas block kernel")
         from horovod_tpu.ops.flash_attention import flash_attention
         fa = functools.partial(flash_attention, causal=causal)
+        fa.handles_gqa = True  # native grouped K/V; no pre-tiling needed
         if mesh is None:
             return fa
         # The Pallas kernel is embarrassingly parallel over batch and
         # heads but Mosaic can't be auto-partitioned by GSPMD: run it
-        # as a manual island over the batch/head sharding axes, with
-        # each device invoking the kernel on its local block.
+        # as a manual island sharded over the batch/head axes. The
+        # island must be manual over ALL mesh axes — with a partial
+        # manual set, even size-1 leftover axes keep the pallas call
+        # under the auto partitioner and Mosaic refuses to lower
+        # ("cannot be automatically partitioned"), including on a
+        # single real chip.
         bspec = P(("dp", "fsdp"), None, "tp", None)
-        batch_axes = frozenset(a for a in ("dp", "fsdp", "tp")
-                               if a in mesh.axis_names)
-        return jax.shard_map(fa, mesh=mesh,
-                             in_specs=(bspec, bspec, bspec),
-                             out_specs=bspec,
-                             axis_names=batch_axes, check_vma=False)
+        mapped = jax.shard_map(fa, mesh=mesh,
+                               in_specs=(bspec, bspec, bspec),
+                               out_specs=bspec,
+                               axis_names=frozenset(mesh.axis_names),
+                               check_vma=False)
+        tp_size = dict(mesh.shape).get("tp", 1)
+
+        def wrapped(q, k, v):
+            # Native grouped K/V needs the kv-head axis shardable over
+            # tp; when tp > Hkv (e.g. flagship Hkv=8 with tp=16), tile
+            # KV up to H — the pre-GQA behavior — so the island specs
+            # still divide.
+            if k.shape[2] % tp_size:
+                rep = q.shape[2] // k.shape[2]
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            return mapped(q, k, v)
+        wrapped.handles_gqa = True
+        return wrapped
     if impl == "local" or sp1:
         return functools.partial(local_attention, causal=causal)
     if impl == "ring":
