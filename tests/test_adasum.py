@@ -105,9 +105,10 @@ def test_adasum_int_dtype_rejected(mesh8):
 # eager host plane (real multi-process jobs)
 # ---------------------------------------------------------------------------
 
-@pytest.mark.parametrize("np_", [2, 3, 4])
+@pytest.mark.parametrize("np_", [2, 3, 4, 5])
 def test_adasum_eager_host(np_):
-    """np=3 exercises the non-power-of-two fold; 2/4 the pure XOR tree."""
+    """np=3/5 exercise the non-power-of-two fold (5: a fold pair plus a
+    4-member core); 2/4 the pure XOR tree."""
     run_job("adasum", np_)
 
 
