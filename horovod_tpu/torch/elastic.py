@@ -1,19 +1,110 @@
 """Elastic state for PyTorch (reference ``torch/elastic/state.py:27-104``
-``TorchState`` + handlers): model and optimizer state_dicts are saved /
+``TorchState`` + handlers, ``torch/elastic/sampler.py:24``
+``ElasticSampler``): model and optimizer state_dicts are saved /
 restored in place and synced from rank 0, alongside arbitrary
-``ObjectState`` attributes (epoch counters, samplers, ...)."""
+``ObjectState`` attributes (epoch counters, samplers, ...).
+``ElasticSampler`` partitions a dataset across the *current* world and
+re-partitions only the not-yet-processed samples after a membership
+change, so an epoch continues where it left off instead of restarting."""
 
 from __future__ import annotations
 
 import copy
-from typing import Optional
+import random
+from typing import Iterable, Optional
 
 import torch
 
+import horovod_tpu.api as api
 from horovod_tpu.elastic import ObjectState, run, State  # noqa: F401
+from horovod_tpu.functions import allgather_object, broadcast_object
 from horovod_tpu.torch.functions import (
     broadcast_optimizer_state, broadcast_parameters,
 )
+
+
+class ElasticSampler(torch.utils.data.Sampler):
+    """Shard-and-resume sampler (reference ``torch/elastic/sampler.py:24``).
+
+    Like ``torch.utils.data.DistributedSampler``, but membership-aware:
+    the shard is computed from ``hvd.rank()/size()`` at every
+    ``reset()``, and samples recorded via :meth:`record_batch` /
+    :meth:`record_indices` are excluded from the re-shard, so after an
+    elastic resize the *remaining* work of the epoch is redistributed
+    over the new world. Intended use: hand it to ``TorchState`` (which
+    unions the processed sets across ranks on ``sync()``), call
+    ``record_batch`` after each step, and ``set_epoch`` at the **end**
+    of each epoch (clearing the processed set for the next one).
+    """
+
+    def __init__(self, dataset, shuffle: bool = True, seed: int = 0):
+        self.dataset = dataset
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.processed_indices: set = set()
+        self.indices: list = []
+        self.reset()
+
+    # bookkeeping --------------------------------------------------------
+    def record_batch(self, batch_idx: int, batch_size: int) -> None:
+        """Mark the samples served for local batch ``batch_idx`` done."""
+        self.record_indices(self.get_indices(batch_idx, batch_size))
+
+    def record_indices(self, indices: Iterable[int]) -> None:
+        self.processed_indices.update(indices)
+
+    def get_indices(self, batch_idx: int, batch_size: int) -> list:
+        """Dataset indices behind local batch ``batch_idx`` (this rank's
+        iteration order, as produced by the last ``__iter__``)."""
+        lo = batch_idx * batch_size
+        return self.indices[lo:lo + batch_size]
+
+    def set_epoch(self, epoch: int) -> None:
+        """Advance the shuffle epoch and clear the processed set. Call
+        at the *end* of an epoch so a mid-epoch restore never replays
+        samples the epoch already consumed."""
+        self.epoch = epoch
+        self.processed_indices = set()
+        self.reset()
+
+    # elastic state ------------------------------------------------------
+    def state_dict(self) -> dict:
+        return {"epoch": self.epoch,
+                "processed_indices": set(self.processed_indices)}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.epoch = state["epoch"]
+        self.processed_indices = set(state["processed_indices"])
+        self.reset()
+
+    def reset(self) -> None:
+        """Recompute this rank's shard of the unprocessed remainder
+        against the current world (called after every re-init)."""
+        self.num_replicas = api.size()
+        self.rank = api.rank()
+        self.remaining = [i for i in range(len(self.dataset))
+                          if i not in self.processed_indices]
+        self.num_samples = -(-len(self.remaining) // self.num_replicas)
+        self.total_size = self.num_samples * self.num_replicas
+
+    def __iter__(self):
+        order = list(self.remaining)
+        if self.shuffle:
+            # Same permutation on every rank: seeded by (seed, epoch)
+            # only, so the strided split below is a partition.
+            random.Random(self.seed + self.epoch).shuffle(order)
+        # Pad to even shards; loop because the remainder can be smaller
+        # than the pad (e.g. 1 sample left across 4 ranks) — a single
+        # slice would under-fill and ranks would run unequal step
+        # counts, deadlocking the collective.
+        while order and len(order) < self.total_size:
+            order += order[:self.total_size - len(order)]
+        self.indices = order[self.rank::self.num_replicas]
+        return iter(self.indices)
+
+    def __len__(self) -> int:
+        return self.num_samples
 
 
 class TorchState(ObjectState):
@@ -24,13 +115,23 @@ class TorchState(ObjectState):
         self.optimizer = optimizer
         self._saved_model = None
         self._saved_opt = None
-        super().__init__(**kwargs)
+        # Samplers get structural handling (state_dict save/restore,
+        # union-of-processed sync), not the generic pickle path.
+        self._samplers = {k: v for k, v in kwargs.items()
+                          if isinstance(v, ElasticSampler)}
+        self._saved_samplers: dict = {}
+        for k, v in self._samplers.items():
+            setattr(self, k, v)
+        super().__init__(**{k: v for k, v in kwargs.items()
+                            if k not in self._samplers})
 
     def save(self) -> None:
         if self.model is not None:
             self._saved_model = copy.deepcopy(self.model.state_dict())
         if self.optimizer is not None:
             self._saved_opt = copy.deepcopy(self.optimizer.state_dict())
+        self._saved_samplers = {k: copy.deepcopy(s.state_dict())
+                                for k, s in self._samplers.items()}
         super().save()
 
     def restore(self) -> None:
@@ -38,6 +139,9 @@ class TorchState(ObjectState):
             self.model.load_state_dict(self._saved_model)
         if self.optimizer is not None and self._saved_opt is not None:
             self.optimizer.load_state_dict(self._saved_opt)
+        for k, s in self._samplers.items():
+            if k in self._saved_samplers:
+                s.load_state_dict(self._saved_samplers[k])
         super().restore()
 
     def sync(self) -> None:
@@ -45,6 +149,17 @@ class TorchState(ObjectState):
             broadcast_parameters(self.model.state_dict(), root_rank=0)
         if self.optimizer is not None:
             broadcast_optimizer_state(self.optimizer, root_rank=0)
+        for k, s in self._samplers.items():
+            # Every rank processed a different shard: the epoch's true
+            # progress is the union, agreed via allgather, then the
+            # merged state is broadcast so all ranks re-shard the same
+            # remainder (reference SamplerStateHandler.sync).
+            done = set().union(*allgather_object(
+                s.processed_indices, name=f"elastic.sampler.{k}"))
+            state = s.state_dict()
+            state["processed_indices"] = done
+            s.load_state_dict(broadcast_object(
+                state, root_rank=0, name=f"elastic.sampler.{k}.state"))
         super().sync()
 
     def _attrs(self):

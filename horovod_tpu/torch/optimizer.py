@@ -15,6 +15,7 @@ than NCCL/MPI.
 
 from __future__ import annotations
 
+from contextlib import contextmanager
 from typing import Iterator, Optional, Tuple
 
 import torch
@@ -24,17 +25,50 @@ from horovod_tpu.common.ops_enum import Average, ReduceOp
 from horovod_tpu.compression import Compression
 
 
+class _SparseGather:
+    """In-flight sparse-gradient reduction: every rank's COO entries are
+    allgathered (indices row-major, values) and summed by coalescing
+    (reference ``sparse_allreduce_async``, ``torch/mpi_ops.py``). Plays
+    the role of a handle in ``_handles``."""
+
+    def __init__(self, grad: torch.Tensor, name: str, op: ReduceOp):
+        if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+            raise NotImplementedError(
+                f"sparse gradients support Sum/Average, not {op}")
+        self._op = op
+        self._shape = tuple(grad.shape)
+        self._dtype = grad.dtype
+        g = grad.coalesce()
+        # nnz varies per rank; allgather concatenates along dim 0, so
+        # ship indices as (nnz, sparse_dim).
+        self._h_idx = api.allgather_async(
+            g.indices().t().contiguous(), name=f"{name}.indices")
+        self._h_val = api.allgather_async(
+            g.values().contiguous(), name=f"{name}.values")
+
+    def finish(self) -> torch.Tensor:
+        idx = api.synchronize(self._h_idx)
+        val = api.synchronize(self._h_val)
+        out = torch.sparse_coo_tensor(
+            idx.t(), val, self._shape, dtype=self._dtype).coalesce()
+        if self._op == ReduceOp.AVERAGE:
+            out = out / api.size()
+        return out
+
+
 class _DistributedOptimizer(torch.optim.Optimizer):
     # Body grafted onto a dynamic subclass of the wrapped optimizer
     # class (reference pattern), so isinstance checks and LR schedulers
     # keep working.
 
     def __init__(self, params, named_parameters, compression,
-                 backward_passes_per_step, op, gradient_predivide_factor):
+                 backward_passes_per_step, op, gradient_predivide_factor,
+                 sparse_as_dense=False):
         super(self.__class__, self).__init__(params)
         self._compression = compression
         self._reduce_op = op
         self._gradient_predivide_factor = gradient_predivide_factor
+        self.sparse_as_dense = sparse_as_dense
         self.backward_passes_per_step = backward_passes_per_step
 
         if named_parameters is not None:
@@ -62,6 +96,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                 f"optimizer parameters ({len(unnamed)} missing)")
 
         self._parameter_names = {v: k for k, v in named_parameters}
+        self._sparse_layout = {}    # param -> (sparse_dim, ) once seen
         self._handles = {}          # param -> (Handle, compression ctx)
         self._allreduce_delay = {}  # param -> remaining backward passes
         self._requires_update = set()
@@ -101,9 +136,28 @@ class _DistributedOptimizer(torch.optim.Optimizer):
     def _allreduce_grad_async(self, p) -> Tuple[object, object]:
         if p.grad is None:
             # Unused this step on this rank; contribute zeros so every
-            # rank still launches the same collective.
-            p.grad = p.data.new(p.size()).zero_()
+            # rank still launches the same collective. A parameter that
+            # has produced sparse gradients before must contribute an
+            # *empty sparse* gradient — other ranks launch the sparse
+            # allgather pair, and a dense zero allreduce here would
+            # leave the ranks waiting on different collectives.
+            sd = self._sparse_layout.get(p)
+            if sd is not None and not self.sparse_as_dense:
+                p.grad = torch.sparse_coo_tensor(
+                    torch.zeros((sd, 0), dtype=torch.long),
+                    torch.zeros((0, *p.shape[sd:]), dtype=p.dtype),
+                    p.shape, dtype=p.dtype)
+            else:
+                p.grad = p.data.new(p.size()).zero_()
         name = self._parameter_names[p]
+        grad = p.grad
+        if grad.is_sparse:
+            self._sparse_layout[p] = grad.sparse_dim()
+            if self.sparse_as_dense:
+                grad = grad.to_dense()
+            else:
+                return (_SparseGather(grad, f"allreduce.{name}",
+                                      self._reduce_op), None)
         prescale, postscale = 1.0, 1.0
         op = self._reduce_op
         if self._gradient_predivide_factor != 1.0:
@@ -113,7 +167,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             prescale = 1.0 / self._gradient_predivide_factor
             postscale = self._gradient_predivide_factor / api.size()
             op = ReduceOp.SUM
-        tensor_compressed, ctx = self._compression.compress(p.grad)
+        tensor_compressed, ctx = self._compression.compress(grad)
         handle = api.allreduce_async(
             tensor_compressed, name=f"allreduce.{name}", op=op,
             prescale_factor=prescale, postscale_factor=postscale)
@@ -138,12 +192,33 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         for p, (handle, ctx) in sorted(
                 self._handles.items(),
                 key=lambda kv: self._parameter_names[kv[0]]):
-            output = api.synchronize(handle)
             self._allreduce_delay[p] = self.backward_passes_per_step
+            if isinstance(handle, _SparseGather):
+                p.grad = handle.finish()
+                continue
+            output = api.synchronize(handle)
             grad = self._compression.decompress(output, ctx)
-            p.grad.copy_(grad.view(p.grad.shape))
+            if p.grad.is_sparse:
+                # sparse_as_dense rode the wire dense; hand back a
+                # sparse gradient as sparse-aware optimizers expect.
+                p.grad = grad.view(p.grad.shape).to_sparse()
+            else:
+                p.grad.copy_(grad.view(p.grad.shape))
         self._handles.clear()
         self._synchronized = True
+
+    @contextmanager
+    def skip_synchronize(self):
+        """Make the next ``step()`` skip its implicit ``synchronize()``
+        — for the ``optimizer.synchronize(); with
+        optimizer.skip_synchronize(): optimizer.step()`` pattern
+        (e.g. gradient clipping between the two; reference
+        ``torch/optimizer.py`` ``skip_synchronize``)."""
+        self._should_synchronize = False
+        try:
+            yield
+        finally:
+            self._should_synchronize = True
 
     def step(self, closure=None):
         if self._should_synchronize:
@@ -174,15 +249,22 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          compression=Compression.none,
                          backward_passes_per_step: int = 1,
                          op: ReduceOp = Average,
-                         gradient_predivide_factor: float = 1.0
+                         gradient_predivide_factor: float = 1.0,
+                         sparse_as_dense: bool = False
                          ) -> torch.optim.Optimizer:
     """Wrap ``optimizer`` so gradients are averaged across ranks before
     each ``step()`` (reference factory, ``torch/optimizer.py:599+``
-    semantics; usage identical: pass ``model.named_parameters()``)."""
+    semantics; usage identical: pass ``model.named_parameters()``).
+
+    Sparse gradients (e.g. ``nn.Embedding(sparse=True)``) ride an
+    entry allgather + coalesce; ``sparse_as_dense=True`` densifies
+    them before the wire instead (cheaper for mostly-dense updates).
+    """
     if gradient_predivide_factor != 1.0 and op != Average:
         raise ValueError(
             "gradient_predivide_factor not supported with op != Average")
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
                dict(_DistributedOptimizer.__dict__))
     return cls(optimizer.param_groups, named_parameters, compression,
-               backward_passes_per_step, op, gradient_predivide_factor)
+               backward_passes_per_step, op, gradient_predivide_factor,
+               sparse_as_dense)

@@ -18,13 +18,14 @@ import horovod_tpu.elastic as elastic
 from horovod_tpu.runner.elastic_driver import (
     FixedHostDiscovery, assign_order, slots_for_order,
 )
+from horovod_tpu.runner import run
 from horovod_tpu.runner.launch import LaunchSettings, launch_elastic
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(ROOT, "tests", "_elastic_worker.py")
 _WORKER_ENV = {
     "PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
-    "PYTHONPATH": ROOT,
+    "PYTHONPATH": os.pathsep.join([ROOT, os.path.join(ROOT, "tests")]),
     # Fast discovery reaction + commit cadence for tests.
     "HOROVOD_CYCLE_TIME": "1",
 }
@@ -102,6 +103,78 @@ def test_torch_state_roundtrip():
     for k in before:
         assert torch.equal(before[k], after[k])
     assert st.epoch == 1
+
+
+class _TinyDataset:
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+
+def test_elastic_sampler_partition_and_resume(monkeypatch):
+    from horovod_tpu.torch.elastic import ElasticSampler
+
+    hvd.init()
+    s = ElasticSampler(_TinyDataset(10), shuffle=False)
+    assert len(s) == 10 and list(s) == list(range(10))
+    # Record two batches of 3; the re-shard excludes them.
+    s.record_batch(0, 3)
+    s.record_batch(1, 3)
+    s.reset()
+    assert len(s) == 4 and sorted(s) == [6, 7, 8, 9]
+    # state_dict round-trip carries epoch + progress.
+    clone = ElasticSampler(_TinyDataset(10), shuffle=False)
+    clone.load_state_dict(s.state_dict())
+    assert sorted(clone) == [6, 7, 8, 9]
+    # End of epoch: progress clears, next epoch reshuffles everything.
+    s.set_epoch(1)
+    assert len(s) == 10 and not s.processed_indices
+
+    # Simulated resize 1 -> 2: the two ranks' shards partition the
+    # remainder (shuffle on; same seed/epoch => same permutation).
+    import horovod_tpu.api as api
+    s2 = ElasticSampler(_TinyDataset(10), seed=7)
+    s2.record_indices({0, 1})
+    monkeypatch.setattr(api, "size", lambda: 2)
+    shards = []
+    for r in (0, 1):
+        monkeypatch.setattr(api, "rank", lambda r=r: r)
+        s2.reset()
+        shards.append(list(s2))
+    assert len(shards[0]) == len(shards[1]) == 4
+    assert sorted(shards[0] + shards[1]) == list(range(2, 10))
+
+
+def _sampler_sync_worker():
+    import horovod_tpu.torch as hvd
+    from horovod_tpu.torch.elastic import ElasticSampler, TorchState
+
+    class _Eight:  # local class: cloudpickle ships it by value
+        def __len__(self):
+            return 8
+
+    hvd.init()
+    sampler = ElasticSampler(_Eight(), shuffle=False)
+    st = TorchState(sampler=sampler, batch=0)
+    it = iter(sampler)
+    # Each rank consumes its first batch of 2 from its own shard.
+    sampler.record_batch(0, 2)
+    st.sync()  # union of both ranks' progress, then common re-shard
+    del it
+    remaining = sorted(sampler.remaining)
+    hvd.shutdown()
+    return remaining, len(sampler.processed_indices)
+
+
+def test_elastic_sampler_sync_unions_progress():
+    results = run(_sampler_sync_worker, np=2, env=_WORKER_ENV,
+                  start_timeout=90)
+    # rank 0 processed {0, 2}, rank 1 {1, 3} (strided shards of 8).
+    for remaining, n_done in results:
+        assert n_done == 4
+        assert remaining == [4, 5, 6, 7]
 
 
 # ---------------------------------------------------------------------------
@@ -216,3 +289,21 @@ def test_elastic_scale_up_mid_training(tmp_path, capfd):
     joiner = os.path.join(str(tmp_path), "localhost_1.log")
     joiner_first = int(open(joiner).readline().split()[0])
     assert joiner_first > 1, "new worker restarted from scratch"
+
+
+def test_elastic_sampler_pad_smaller_than_world(monkeypatch):
+    """Epoch tail: 1 unprocessed sample across 4 ranks — every rank
+    must still yield exactly num_samples entries (repeat-padding), or
+    ranks run unequal step counts and deadlock."""
+    import horovod_tpu.api as api
+    from horovod_tpu.torch.elastic import ElasticSampler
+
+    hvd.init()
+    s = ElasticSampler(_TinyDataset(9), shuffle=False)
+    s.record_indices(range(8))  # one sample left
+    monkeypatch.setattr(api, "size", lambda: 4)
+    for r in range(4):
+        monkeypatch.setattr(api, "rank", lambda r=r: r)
+        s.reset()
+        assert len(s) == 1
+        assert list(s) == [8]

@@ -228,3 +228,82 @@ def test_zero_grad_between_backward_and_step_raises():
     results = run(_zero_grad_guard_worker, np=2, env=_WORKER_ENV,
                   start_timeout=90)
     assert results == [True, True]
+
+
+# ---------------------------------------------------------------------------
+# sparse gradients (reference torch/optimizer.py:215 sparse->allgather)
+# ---------------------------------------------------------------------------
+
+def _sparse_worker(sparse_as_dense):
+    import torch
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    torch.manual_seed(0)
+    emb = torch.nn.Embedding(6, 3, sparse=True)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(emb.parameters(), lr=0.0),
+        named_parameters=emb.named_parameters(),
+        sparse_as_dense=sparse_as_dense)
+    idx = torch.tensor([0, 2]) if hvd.rank() == 0 else torch.tensor([2, 5])
+    emb(idx).sum().backward()
+    opt.synchronize()
+    with opt.skip_synchronize():
+        opt.step()
+    g = emb.weight.grad
+    dense = g.to_dense() if g.is_sparse else g
+    was_sparse = g.is_sparse
+    hvd.shutdown()
+    return dense.detach().numpy(), was_sparse
+
+
+@pytest.mark.parametrize("sparse_as_dense", [False, True])
+def test_sparse_gradients_average(sparse_as_dense):
+    from functools import partial
+
+    results = run(partial(_sparse_worker, sparse_as_dense), np=2,
+                  env=_WORKER_ENV, start_timeout=90)
+    expected = np.zeros((6, 3), np.float32)
+    expected[0], expected[2], expected[5] = 0.5, 1.0, 0.5
+    for dense, was_sparse in results:
+        assert was_sparse  # reduced grad handed back sparse either way
+        np.testing.assert_allclose(dense, expected, rtol=1e-6)
+
+
+def _sparse_skip_worker():
+    """Step 2 skips the embedding on rank 0 only: the missing-grad
+    fill-in must launch the *sparse* collective pair, not dense zeros."""
+    import torch
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    torch.manual_seed(0)
+    emb = torch.nn.Embedding(4, 2, sparse=True)
+    lin = torch.nn.Linear(2, 1)
+    params = ([("emb." + k, v) for k, v in emb.named_parameters()]
+              + [("lin." + k, v) for k, v in lin.named_parameters()])
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD([p for _, p in params], lr=0.0),
+        named_parameters=params)
+    # step 1: both ranks touch the embedding (sparse layout learned)
+    (emb(torch.tensor([hvd.rank()])).sum() + lin(torch.ones(2))).backward()
+    opt.step()
+    opt.zero_grad()
+    # step 2: rank 0 skips the embedding entirely (grad None)
+    if hvd.rank() == 0:
+        lin(torch.ones(2)).sum().backward()
+    else:
+        (emb(torch.tensor([3])).sum() + lin(torch.ones(2))).backward()
+    opt.step()
+    g = emb.weight.grad.to_dense().detach().numpy()
+    hvd.shutdown()
+    return g
+
+
+def test_sparse_missing_grad_launches_sparse_collective():
+    results = run(_sparse_skip_worker, np=2, env=_WORKER_ENV,
+                  start_timeout=90)
+    expected = np.zeros((4, 2), np.float32)
+    expected[3] = 0.5  # rank 1's row-3 ones, averaged over 2 ranks
+    for g in results:
+        np.testing.assert_allclose(g, expected, rtol=1e-6)
