@@ -263,6 +263,9 @@ def build_parser() -> argparse.ArgumentParser:
     elastic.add_argument("--reset-limit", type=int, default=None,
                          help="max elastic resets before a worker aborts")
     p.add_argument("-p", "--ssh-port", type=int, dest="ssh_port")
+    p.add_argument("--config-file", default=None,
+                   help="YAML file of defaults for the tuning/elastic "
+                        "options; explicit CLI flags win over the file")
     p.add_argument("--start-timeout", type=float, default=120.0,
                    help="seconds to wait for all ranks to rendezvous")
     p.add_argument("--xla-exec", action="store_true",
@@ -301,6 +304,63 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+# YAML section -> (key, args attribute) for --config-file (the
+# reference's config_parser.set_args_from_config layout, trimmed to
+# the knobs this runner has).
+_CONFIG_SCHEMA = {
+    "params": [("fusion_threshold_mb", "fusion_threshold_mb"),
+               ("cycle_time_ms", "cycle_time_ms"),
+               ("cache_capacity", "cache_capacity"),
+               ("hierarchical_allreduce", "hierarchical_allreduce")],
+    "autotune": [("enabled", "autotune"),
+                 ("log_file", "autotune_log_file")],
+    "timeline": [("filename", "timeline_filename")],
+    "stall_check": [("warning_time_seconds", "stall_check_time"),
+                    ("shutdown_time_seconds", "stall_shutdown_time")],
+    "logging": [("level", "log_level")],
+    "elastic": [("min_np", "min_np"), ("max_np", "max_np"),
+                ("slots", "slots"), ("reset_limit", "reset_limit")],
+    None: [("verbose", "verbose"), ("xla_exec", "xla_exec"),
+           ("start_timeout", "start_timeout")],
+}
+
+
+def _explicit_dests(parser: argparse.ArgumentParser,
+                    argv: Sequence[str]) -> set:
+    """Which parser dests were named on the command line (only those may
+    NOT be overridden by the config file). Re-parses with every default
+    replaced by a sentinel, so argparse itself decides what counts as
+    given — trainee-command flags in the REMAINDER and ``--cycle-time``
+    style prefix abbreviations are attributed correctly (token-scanning
+    argv would get both wrong)."""
+    sentinel = object()
+    probe = build_parser()
+    probe.set_defaults(**{a.dest: sentinel for a in probe._actions
+                          if a.dest not in ("help", "command")})
+    ns = probe.parse_args(list(argv))
+    return {d for d, v in vars(ns).items()
+            if d != "command" and v is not sentinel}
+
+
+def apply_config_file(args: argparse.Namespace, path: str,
+                      explicit: set) -> None:
+    """Fill ``args`` from a YAML config file; CLI-provided flags keep
+    their value (reference ``config_parser.set_args_from_config``)."""
+    try:
+        import yaml
+    except ImportError as e:
+        raise RuntimeError(
+            "--config-file requires PyYAML (pip install pyyaml)") from e
+
+    with open(path) as f:
+        config = yaml.safe_load(f) or {}
+    for section, pairs in _CONFIG_SCHEMA.items():
+        table = config if section is None else config.get(section) or {}
+        for key, dest in pairs:
+            if key in table and dest not in explicit:
+                setattr(args, dest, table[key])
+
+
 def args_to_env(args: argparse.Namespace) -> Dict[str, str]:
     """Map CLI tunables onto the HOROVOD_* env contract (the reference's
     ``config_parser.set_env_from_args``)."""
@@ -333,7 +393,12 @@ def args_to_env(args: argparse.Namespace) -> Dict[str, str]:
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.config_file:
+        apply_config_file(args, args.config_file,
+                          _explicit_dests(parser, argv if argv is not None
+                                          else sys.argv[1:]))
     command = list(args.command)
     if command and command[0] == "--":
         command = command[1:]

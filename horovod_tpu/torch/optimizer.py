@@ -140,9 +140,14 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             # has produced sparse gradients before must contribute an
             # *empty sparse* gradient — other ranks launch the sparse
             # allgather pair, and a dense zero allreduce here would
-            # leave the ranks waiting on different collectives.
+            # leave the ranks waiting on different collectives (with
+            # sparse_as_dense the empty sparse grad is densified below,
+            # keeping the sparse hand-back in synchronize()). Known
+            # limit, shared with the reference: sparseness is learned
+            # from the first observed gradient, so a rank that skips a
+            # sparse layer on its very first step still mismatches.
             sd = self._sparse_layout.get(p)
-            if sd is not None and not self.sparse_as_dense:
+            if sd is not None:
                 p.grad = torch.sparse_coo_tensor(
                     torch.zeros((sd, 0), dtype=torch.long),
                     torch.zeros((0, *p.shape[sd:]), dtype=p.dtype),
@@ -223,6 +228,12 @@ class _DistributedOptimizer(torch.optim.Optimizer):
     def step(self, closure=None):
         if self._should_synchronize:
             self.synchronize()
+        elif self._handles and not self._synchronized:
+            import warnings
+            warnings.warn(
+                "step() inside skip_synchronize() without a prior "
+                "synchronize(): applying un-reduced local gradients "
+                "(ranks will diverge)")
         self._synchronized = False
         return super(self.__class__, self).step(closure)
 

@@ -34,7 +34,7 @@ setup(
     python_requires=">=3.10",
     packages=find_packages(include=["horovod_tpu", "horovod_tpu.*"]),
     package_data={"horovod_tpu.common": ["libhorovod_tpu_core.so"]},
-    install_requires=["numpy", "cloudpickle"],
+    install_requires=["numpy", "cloudpickle", "pyyaml"],
     extras_require={
         # >=0.6: lax.pcast + shard_map axis_names (pinned APIs — the
         # attention islands use them unconditionally).

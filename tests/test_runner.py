@@ -202,3 +202,40 @@ def test_autotune_and_hierarchical_flags():
         ["-np", "2", "python", "train.py"]))
     assert "HOROVOD_AUTOTUNE" not in env2
     assert "HOROVOD_HIERARCHICAL_ALLREDUCE" not in env2
+
+
+def test_config_file_defaults_and_cli_override(tmp_path):
+    from horovod_tpu.runner.launch import _explicit_dests, apply_config_file
+
+    cfg = tmp_path / "hvd.yaml"
+    cfg.write_text(
+        "verbose: true\n"
+        "params:\n"
+        "  fusion_threshold_mb: 48\n"
+        "  cycle_time_ms: 7.5\n"
+        "  hierarchical_allreduce: true\n"
+        "autotune:\n"
+        "  enabled: true\n"
+        "  log_file: /tmp/at.csv\n"
+        "stall_check:\n"
+        "  warning_time_seconds: 11\n"
+        "logging:\n"
+        "  level: debug\n"
+        "elastic:\n"
+        "  reset_limit: 4\n")
+    parser = build_parser()
+    argv = ["-np", "2", "--cycle-time-ms", "2.0",
+            "--config-file", str(cfg), "--", "python", "x.py"]
+    args = parser.parse_args(argv)
+    apply_config_file(args, str(cfg), _explicit_dests(parser, argv))
+    env = args_to_env(args)
+    # Config fills unset knobs...
+    assert env["HOROVOD_FUSION_THRESHOLD"] == str(48 * 1024 * 1024)
+    assert env["HOROVOD_AUTOTUNE"] == "1"
+    assert env["HOROVOD_AUTOTUNE_LOG"] == "/tmp/at.csv"
+    assert env["HOROVOD_HIERARCHICAL_ALLREDUCE"] == "1"
+    assert env["HOROVOD_STALL_CHECK_TIME_SECONDS"] == "11"
+    assert env["HOROVOD_LOG_LEVEL"] == "debug"
+    assert args.verbose is True and args.reset_limit == 4
+    # ...but an explicit CLI flag beats the file.
+    assert env["HOROVOD_CYCLE_TIME"] == "2.0"
