@@ -79,6 +79,13 @@ class Controller {
   // Data-plane access for the ops layer (TcpController only).
   virtual TcpConn* DataConn(int peer_rank) { return nullptr; }
 
+  // One-shot init-time AND-agreement (rank 0 collects, broadcasts the
+  // verdict). Used for job-wide data-plane choices that every rank
+  // must make identically (e.g. "is the shm arena up everywhere?").
+  // Only valid before the background cycle starts — it rides the
+  // quiet control links, like the param sync.
+  virtual bool AgreeAll(bool mine) { return mine; }
+
  protected:
   // ----- shared coordinator logic (used by rank 0 and LocalController)
   struct PendingTensor {
@@ -115,6 +122,7 @@ class Controller {
   // value to all workers — env divergence cannot split the job.
   int64_t ring_threshold_bytes_ = 64 * 1024;
   bool hierarchical_ = false;
+  bool shm_enabled_ = false;
 
  public:
   void SetFusionThreshold(int64_t bytes) { fusion_threshold_bytes_ = bytes; }
@@ -127,6 +135,12 @@ class Controller {
   // decision would deadlock the exchange).
   void SetHierarchical(bool on) { hierarchical_ = on; }
   bool hierarchical() const { return hierarchical_; }
+  // Shared-memory data plane: rank 0's env wish, downgraded to the
+  // synced verdict during Initialize (single-host on EVERY rank).
+  // Coordinator-decided so a per-rank HOROVOD_SHM_DISABLE can never
+  // desync the data-plane choice (or the AgreeAll framing).
+  void SetShmEnabled(bool on) { shm_enabled_ = on; }
+  bool shm_enabled() const { return shm_enabled_; }
   // Autotune (rank 0): stage new tunables for the next broadcast
   // ResponseList so every rank applies them on the same cycle.
   void StageTunedParams(int64_t fusion, double cycle_ms) {
@@ -156,6 +170,7 @@ class TcpController : public Controller {
   Status Initialize() override;
   ResponseList ComputeResponseList(bool shutdown_requested) override;
   TcpConn* DataConn(int peer_rank) override;
+  bool AgreeAll(bool mine) override;
 
  private:
   ResponseList CoordinatorCycle(RequestList my_list, bool shutdown);

@@ -25,6 +25,7 @@
 #include "hvd/controller.h"
 #include "hvd/fusion_buffer.h"
 #include "hvd/message.h"
+#include "hvd/shm.h"
 #include "hvd/timeline.h"
 
 namespace hvd {
@@ -98,9 +99,16 @@ class TcpOps : public OpExecutor {
   Status AdasumAllreduce(uint8_t* buf, DataType dtype,
                          const std::vector<int64_t>& tensor_elems,
                          const std::vector<int>& ranks, int p);
+  // Single-host jobs: reduce through the shared-memory arena instead
+  // of loopback TCP (slot copy -> per-rank chunk reduction -> copy
+  // out; three barriers). In place on the fusion buffer.
+  Status ShmAllreduce(uint8_t* buf, int64_t elems, DataType dtype,
+                      ReduceOp op);
 
   int64_t ring_threshold_bytes_;  // below: recursive doubling
   bool hierarchical_ = false;     // HOROVOD_HIERARCHICAL_ALLREDUCE
+  std::unique_ptr<ShmArena> shm_;
+  double shm_timeout_secs_ = 60.0;
 };
 
 // Accumulate src into dst elementwise on the host ("SUM"/"MIN"/...),

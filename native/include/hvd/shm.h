@@ -1,0 +1,59 @@
+// Shared-memory intra-host data plane.
+//
+// Role analog: the reference's intra-node fast transports (gloo's shm
+// transport / NCCL's intra-node path). When every rank of the job
+// lives on this host, allreduce through one mmap'd POSIX shm segment
+// beats the loopback-TCP peer mesh: no kernel socket copies, no
+// syscalls per chunk — just memcpy + reduce in place.
+//
+// Liveness: unlike a TCP socket, shared memory cannot report a dead
+// peer, so every rendezvous uses a deadline-bounded generation
+// barrier; a timeout poisons the arena and the caller falls back to
+// the TCP path (whose socket errors then surface the failure through
+// the normal error-agreement protocol).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <memory>
+#include <string>
+
+#include "hvd/common.h"
+
+namespace hvd {
+
+class ShmArena {
+ public:
+  // Maps (creating if local_rank==0) the per-job segment. Returns
+  // nullptr when shm is unavailable (create/map failure) — callers
+  // fall back to TCP. `tag` must be identical on every rank of the
+  // job and unique per job instance (controller addr + elastic epoch).
+  static std::unique_ptr<ShmArena> Create(const std::string& tag, int rank,
+                                          int nranks, int64_t slot_bytes);
+  ~ShmArena();
+
+  int64_t slot_bytes() const { return slot_bytes_; }
+  bool poisoned() const { return poisoned_; }
+  uint8_t* slot(int r);
+
+  // Sense-reversing barrier over all nranks; false on deadline or
+  // dead peer (poisons the arena permanently — the counters can no
+  // longer be trusted).
+  bool Barrier(double timeout_secs);
+
+ private:
+  ShmArena() = default;
+  bool PeersAlive();
+  struct Control;
+  Control* ctrl_ = nullptr;
+  std::atomic<int32_t>* pids_ = nullptr;
+  void* base_ = nullptr;
+  int64_t map_bytes_ = 0;
+  int64_t slot_bytes_ = 0;
+  int64_t slots_off_ = 0;
+  int rank_ = 0;
+  int nranks_ = 0;
+  bool poisoned_ = false;
+};
+
+}  // namespace hvd

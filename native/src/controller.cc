@@ -469,27 +469,36 @@ Status TcpController::Initialize() {
       local_size_ > 1 && size_ % local_size_ == 0 &&
       local_rank_ == rank_ % local_size_ &&
       cross_rank_ == rank_ / local_size_;
+  const bool my_single_host = local_size_ == size_;
   if (rank_ == 0) {
     bool all_fit = my_hier_fit;
+    bool all_single = my_single_host;
     for (int peer = 1; peer < size_; ++peer) {
       std::string fit;
       ctrl_conns_[peer].SetRecvTimeout(timeout_ms);
       bool ok = ctrl_conns_[peer].RecvFrame(&fit);
       ctrl_conns_[peer].SetRecvTimeout(0);
       if (!ok) return Status::UnknownError("param sync: lost control link");
-      all_fit = all_fit && fit == ("fit:" + std::to_string(local_size_));
+      auto bar = fit.find('|');
+      all_single = all_single && bar != std::string::npos &&
+                   fit.substr(bar + 1) == "sh:1";
+      all_fit = all_fit && fit.substr(0, bar) ==
+                               ("fit:" + std::to_string(local_size_));
     }
     hierarchical_ = hierarchical_ && all_fit;
+    shm_enabled_ = shm_enabled_ && all_single;
     std::string params = std::to_string(fusion_threshold_bytes_) + ":" +
                          std::to_string(ring_threshold_bytes_) + ":" +
-                         (hierarchical_ ? "1" : "0");
+                         (hierarchical_ ? "1" : "0") + ":" +
+                         (shm_enabled_ ? "1" : "0");
     for (int peer = 1; peer < size_; ++peer) {
       if (!ctrl_conns_[peer].SendFrame(params))
         return Status::UnknownError("param sync: lost control link");
     }
   } else {
-    std::string fit = my_hier_fit ? "fit:" + std::to_string(local_size_)
-                                  : "unfit";
+    std::string fit = (my_hier_fit ? "fit:" + std::to_string(local_size_)
+                                   : "unfit") +
+                      (my_single_host ? "|sh:1" : "|sh:0");
     if (!ctrl_conns_[0].SendFrame(fit))
       return Status::UnknownError("param sync: lost control link");
     std::string params;
@@ -498,13 +507,40 @@ Status TcpController::Initialize() {
     ctrl_conns_[0].SetRecvTimeout(0);
     auto c1 = params.find(':');
     auto c2 = c1 == std::string::npos ? c1 : params.find(':', c1 + 1);
-    if (!ok || c2 == std::string::npos)
+    auto c3 = c2 == std::string::npos ? c2 : params.find(':', c2 + 1);
+    if (!ok || c3 == std::string::npos)
       return Status::UnknownError("param sync: lost control link");
     fusion_threshold_bytes_ = std::atoll(params.c_str());
     ring_threshold_bytes_ = std::atoll(params.c_str() + c1 + 1);
     hierarchical_ = params[c2 + 1] == '1';
+    shm_enabled_ = params[c3 + 1] == '1';
   }
   return Status::OK();
+}
+
+bool TcpController::AgreeAll(bool mine) {
+  // Pre-cycle only: exactly one frame each way per worker, so the
+  // control links stay framed (same discipline as the param sync).
+  const int timeout_ms = 30000;
+  if (rank_ == 0) {
+    bool all = mine;
+    for (int peer = 1; peer < size_; ++peer) {
+      std::string vote;
+      ctrl_conns_[peer].SetRecvTimeout(timeout_ms);
+      bool ok = ctrl_conns_[peer].RecvFrame(&vote);
+      ctrl_conns_[peer].SetRecvTimeout(0);
+      all = all && ok && vote == "agree:1";
+    }
+    for (int peer = 1; peer < size_; ++peer)
+      ctrl_conns_[peer].SendFrame(all ? "verdict:1" : "verdict:0");
+    return all;
+  }
+  if (!ctrl_conns_[0].SendFrame(mine ? "agree:1" : "agree:0")) return false;
+  std::string verdict;
+  ctrl_conns_[0].SetRecvTimeout(timeout_ms);
+  bool ok = ctrl_conns_[0].RecvFrame(&verdict);
+  ctrl_conns_[0].SetRecvTimeout(0);
+  return ok && verdict == "verdict:1";
 }
 
 Status TcpController::InitializeMesh(int timeout_ms) {

@@ -428,6 +428,8 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   st.controller->SetTopology(local_rank, local_size, cross_rank, cross_size);
   st.controller->SetHierarchical(
       hvd::EnvInt64("HOROVOD_HIERARCHICAL_ALLREDUCE", 0) != 0);
+  st.controller->SetShmEnabled(
+      size > 1 && std::getenv("HOROVOD_SHM_DISABLE") == nullptr);
   hvd::Status s = st.controller->Initialize();
   if (!s.ok()) {
     LOG_ERROR << "controller init failed: " << s.reason();

@@ -319,6 +319,40 @@ TcpOps::TcpOps(Controller* controller, FusionBufferManager* fusion,
   // (a per-rank algorithm choice would deadlock the exchange).
   ring_threshold_bytes_ = controller->ring_threshold();
   hierarchical_ = controller->hierarchical();
+  // Single-host jobs get a shared-memory arena (the reference's
+  // intra-node transport analog). shm_enabled() is the COORDINATOR'S
+  // post-sync verdict (rank 0's env wish ANDed with every rank's
+  // single-host claim), so all ranks enter — or skip — this block
+  // together and the AgreeAll framing can never desync.
+  if (controller->shm_enabled()) {
+    const char* addr = std::getenv("HOROVOD_CONTROLLER_ADDR");
+    const char* epoch = std::getenv("HOROVOD_ELASTIC_EPOCH");
+    // Tag by the controller PORT only: the host part differs per rank
+    // (rank 0 binds "0.0.0.0", workers dial the published host), and
+    // a mismatched tag would silently split the arena.
+    std::string a = addr ? addr : "local";
+    auto colon = a.rfind(':');
+    std::string tag = (colon == std::string::npos ? a : a.substr(colon + 1)) +
+                      "|" + (epoch ? epoch : "0");
+    int64_t slot = std::max<int64_t>(controller->fusion_threshold(),
+                                     64 * 1024 * 1024);
+    shm_ = ShmArena::Create(tag, controller->rank(), controller->size(),
+                            slot);
+    // The arena's own attach confirmation is best-effort (wall-clock
+    // deadlines); the authoritative all-or-none verdict rides the
+    // controller — if ANY rank failed to map, every rank drops to TCP.
+    if (!controller->AgreeAll(shm_ != nullptr)) shm_.reset();
+  }
+  if (const char* t = std::getenv("HOROVOD_SHM_TIMEOUT_SECONDS")) {
+    double v = std::atof(t);
+    if (v > 0) {
+      shm_timeout_secs_ = v;
+    } else {
+      // atof's 0.0 for garbage would make every barrier "time out"
+      // instantly and poison the arena on the first op.
+      LOG_WARNING << "ignoring invalid HOROVOD_SHM_TIMEOUT_SECONDS=" << t;
+    }
+  }
 }
 
 Status TcpOps::Execute(const Response& response,
@@ -374,9 +408,28 @@ Status TcpOps::Allreduce(const Response& r,
   }
   const int64_t total_bytes = total_elems * DataTypeSize(dtype);
   const std::string tname = entries.front().name;
-  uint8_t* buf = static_cast<uint8_t*>(fusion_->GetBuffer(0, total_bytes));
 
-  // Pack into the fusion buffer, applying prescale.
+  // All ranks contributing on one host: shared memory beats the TCP
+  // mesh. Join-active ops (contributor subset) must not take this
+  // path — non-contributors skip Execute entirely and would never
+  // reach the barrier. The shm path packs straight into this rank's
+  // arena slot and unpacks straight from the reduced slot 0, saving
+  // two full-buffer copies over staging through the fusion buffer.
+  const bool use_shm = shm_ && static_cast<int>(ranks.size()) == size &&
+                       total_bytes <= shm_->slot_bytes() &&
+                       r.reduce_op != ReduceOp::ADASUM && size > 1;
+  // A poisoned arena must FAIL shm-eligible ops, not fall back to
+  // TCP: the path choice is job-wide (peers with healthy arenas would
+  // sit in the barrier while this rank rings over sockets they never
+  // service). The error reaches the app as HorovodInternalError; the
+  // peers' own barriers poison on our inactivity or process death.
+  if (use_shm && shm_->poisoned())
+    return Status::UnknownError("shm arena poisoned by an earlier failure");
+  uint8_t* buf = use_shm
+                     ? shm_->slot(rank)
+                     : static_cast<uint8_t*>(fusion_->GetBuffer(0, total_bytes));
+
+  // Pack, applying prescale.
   if (timeline_) timeline_->ActivityStart(tname, ACT_MEMCPY_IN_FUSION_BUFFER);
   int64_t off = 0;
   for (auto& e : entries) {
@@ -388,10 +441,16 @@ Status TcpOps::Allreduce(const Response& r,
   }
   if (timeline_) timeline_->ActivityEnd(tname);
 
-  if (timeline_) timeline_->ActivityStart(tname, ACT_TCP_ALLREDUCE);
+  if (timeline_)
+    timeline_->ActivityStart(tname,
+                             use_shm ? ACT_SHM_ALLREDUCE : ACT_TCP_ALLREDUCE);
   Status st = Status::OK();
+  const uint8_t* src = buf;  // where the reduced result lives
   if (ranks.size() > 1) {
-    if (r.reduce_op == ReduceOp::ADASUM) {
+    if (use_shm) {
+      st = ShmAllreduce(buf, total_elems, dtype, r.reduce_op);
+      src = shm_->slot(0);
+    } else if (r.reduce_op == ReduceOp::ADASUM) {
       st = AdasumAllreduce(buf, dtype, tensor_elems, ranks, p);
     } else if (HierarchicalApplicable(ranks) &&
                total_bytes >= ring_threshold_bytes_) {
@@ -414,7 +473,7 @@ Status TcpOps::Allreduce(const Response& r,
     int64_t n = e.shape.num_elements();
     int64_t bytes = n * DataTypeSize(e.dtype);
     if (e.output) {
-      std::memcpy(e.output, buf + off, bytes);
+      std::memcpy(e.output, src + off, bytes);
       double factor = e.postscale_factor;
       if (e.reduce_op == ReduceOp::AVERAGE) factor /= size;
       if (factor != 1.0) HostScale(e.dtype, e.output, n, factor);
@@ -422,6 +481,10 @@ Status TcpOps::Allreduce(const Response& r,
     off += bytes;
   }
   if (timeline_) timeline_->ActivityEnd(tname);
+  // Slot 0 stays readable until the slowest rank unpacked; only then
+  // may anyone's next op overwrite the arena.
+  if (use_shm && ranks.size() > 1 && !shm_->Barrier(shm_timeout_secs_))
+    return Status::UnknownError("shm allreduce: peer lost or stalled");
   return Status::OK();
 }
 
@@ -467,6 +530,34 @@ Status TcpOps::RingAllgatherPhase(uint8_t* buf,
                   buf + offs[cr] * esize, (offs[cr + 1] - offs[cr]) * esize))
       return Status::UnknownError("ring allreduce: lost data connection");
   }
+  return Status::OK();
+}
+
+Status TcpOps::ShmAllreduce(uint8_t* buf, int64_t elems, DataType dtype,
+                            ReduceOp op) {
+  const int P = controller_->size();
+  const int p = controller_->rank();
+  const int64_t esize = DataTypeSize(dtype);
+
+  // Publish my contribution (no-op when the caller packed directly
+  // into this rank's slot — the fused-allreduce fast path).
+  if (buf != shm_->slot(p))
+    std::memcpy(shm_->slot(p), buf, elems * esize);
+  if (!shm_->Barrier(shm_timeout_secs_))
+    return Status::UnknownError("shm allreduce: peer lost or stalled");
+
+  // Reduce-scatter by chunk ownership — rank p folds every peer's
+  // chunk p into slot 0 (disjoint chunk writes, no contention).
+  const int64_t lo = elems * p / P, hi = elems * (p + 1) / P;
+  uint8_t* acc = shm_->slot(0) + lo * esize;
+  for (int r = 1; r < P; ++r)
+    HostAccumulate(op, dtype, shm_->slot(r) + lo * esize, acc, hi - lo);
+  if (!shm_->Barrier(shm_timeout_secs_))
+    return Status::UnknownError("shm allreduce: peer lost or stalled");
+
+  // The reduced result now lives in slot 0; the caller reads it from
+  // there and runs the release barrier once done (keeping slot 0
+  // intact until the slowest rank finishes).
   return Status::OK();
 }
 

@@ -1,0 +1,244 @@
+#include "hvd/shm.h"
+
+#include <fcntl.h>
+#include <sched.h>
+#include <signal.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <functional>
+
+#include "hvd/logging.h"
+
+namespace hvd {
+
+namespace {
+double NowSecs() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+int64_t RoundUp64(int64_t v) { return (v + 63) & ~int64_t(63); }
+}  // namespace
+
+// Control block at the head of the segment, then a pid per rank (for
+// liveness checks), then nranks data slots; all 64-byte aligned.
+struct ShmArena::Control {
+  std::atomic<uint32_t> magic;      // set last by the creator (release)
+  std::atomic<uint32_t> attached;   // ranks mapped so far
+  std::atomic<uint32_t> confirmed;  // creator saw ALL ranks attached
+  std::atomic<uint32_t> arrived;    // barrier arrivals this generation
+  std::atomic<uint32_t> generation;
+};
+
+static constexpr uint32_t kMagic = 0x68766453;  // "hvdS"
+static constexpr int64_t kCtrlBytes = 64;
+
+std::unique_ptr<ShmArena> ShmArena::Create(const std::string& tag, int rank,
+                                           int nranks, int64_t slot_bytes) {
+  // Name must be identical across ranks and unique per job; hash the
+  // tag to stay under NAME_MAX and avoid '/' from "host:port".
+  char name[64];
+  std::snprintf(name, sizeof(name), "/hvd_%zx",
+                std::hash<std::string>{}(tag));
+  const int64_t pids_off = kCtrlBytes;
+  const int64_t slots_off = pids_off + RoundUp64(int64_t(nranks) * 4);
+  const int64_t map_bytes = slots_off + int64_t(nranks) * slot_bytes;
+
+  void* base = MAP_FAILED;
+  if (rank == 0) {
+    int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd < 0 && errno == EEXIST) {
+      // Stale segment from a crashed earlier job with the same tag
+      // hash: reclaim the name once.
+      shm_unlink(name);
+      fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+    }
+    if (fd < 0 || ftruncate(fd, map_bytes) != 0) {
+      LOG_WARNING << "shm: create " << name << " failed, using TCP ("
+                   << std::strerror(errno) << ")";
+      if (fd >= 0) close(fd);
+      return nullptr;
+    }
+    base = mmap(nullptr, map_bytes, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    close(fd);
+    if (base == MAP_FAILED) {
+      LOG_WARNING << "shm: mmap failed, using TCP (" << std::strerror(errno)
+                  << ")";
+      shm_unlink(name);
+      return nullptr;
+    }
+  } else {
+    // Workers retry until they hold a FRESH, initialized segment: the
+    // creator may not have gotten there yet, and the name may briefly
+    // resolve to a crashed prior job's leftover (which the creator
+    // unlinks and recreates). A leftover is recognizable by
+    // confirmed==1 before this rank ever attached — a fresh segment
+    // cannot be confirmed until every rank of THIS job has attached.
+    double deadline = NowSecs() + 20.0;
+    for (;;) {
+      if (NowSecs() > deadline) {
+        LOG_WARNING << "shm: no fresh segment within deadline, using TCP";
+        if (base != MAP_FAILED) munmap(base, map_bytes);
+        return nullptr;
+      }
+      if (base != MAP_FAILED) munmap(base, map_bytes);
+      base = MAP_FAILED;
+      int fd = shm_open(name, O_RDWR, 0600);
+      if (fd >= 0) {
+        struct stat st;
+        if (fstat(fd, &st) != 0 || st.st_size < map_bytes) {
+          close(fd);  // opened mid-ftruncate; retry
+          fd = -1;
+        }
+      }
+      if (fd < 0) {
+        usleep(2000);
+        continue;
+      }
+      base = mmap(nullptr, map_bytes, PROT_READ | PROT_WRITE, MAP_SHARED, fd,
+                  0);
+      close(fd);
+      if (base == MAP_FAILED) {
+        LOG_WARNING << "shm: mmap failed, using TCP ("
+                    << std::strerror(errno) << ")";
+        return nullptr;
+      }
+      auto* ctrl = static_cast<Control*>(base);
+      while (ctrl->magic.load(std::memory_order_acquire) != kMagic &&
+             NowSecs() < deadline)
+        usleep(1000);
+      if (ctrl->magic.load(std::memory_order_acquire) != kMagic)
+        continue;  // deadline check at loop head reports the timeout
+      if (ctrl->confirmed.load(std::memory_order_acquire) == 1) {
+        usleep(2000);  // stale leftover; wait for the creator's recreate
+        continue;
+      }
+      break;
+    }
+  }
+
+  auto arena = std::unique_ptr<ShmArena>(new ShmArena());
+  arena->base_ = base;
+  arena->map_bytes_ = map_bytes;
+  arena->slot_bytes_ = slot_bytes;
+  arena->slots_off_ = slots_off;
+  arena->rank_ = rank;
+  arena->nranks_ = nranks;
+  arena->ctrl_ = static_cast<Control*>(base);
+  arena->pids_ = reinterpret_cast<std::atomic<int32_t>*>(
+      static_cast<uint8_t*>(base) + pids_off);
+
+  if (rank == 0) {
+    new (arena->ctrl_) Control();
+    arena->ctrl_->attached.store(0, std::memory_order_relaxed);
+    arena->ctrl_->confirmed.store(0, std::memory_order_relaxed);
+    arena->ctrl_->arrived.store(0, std::memory_order_relaxed);
+    arena->ctrl_->generation.store(0, std::memory_order_relaxed);
+    arena->ctrl_->magic.store(kMagic, std::memory_order_release);
+  }
+
+  // Attach protocol: every rank publishes its pid and bumps the
+  // counter; the creator waits for ALL ranks, unlinks the name (the
+  // mappings keep the memory alive — nothing leaks past the job), and
+  // sets `confirmed`; non-creators wait for `confirmed`. A rank that
+  // failed to map therefore flips EVERY rank to the TCP path — the
+  // data-plane algorithm choice must agree job-wide or ops deadlock.
+  arena->pids_[rank].store(static_cast<int32_t>(getpid()),
+                           std::memory_order_relaxed);
+  arena->ctrl_->attached.fetch_add(1, std::memory_order_acq_rel);
+  double deadline = NowSecs() + 20.0;
+  if (rank == 0) {
+    while (arena->ctrl_->attached.load(std::memory_order_acquire) <
+           static_cast<uint32_t>(nranks)) {
+      if (NowSecs() > deadline) {
+        LOG_WARNING << "shm: peers never attached, using TCP";
+        shm_unlink(name);
+        return nullptr;
+      }
+      usleep(1000);
+    }
+    shm_unlink(name);
+    arena->ctrl_->confirmed.store(1, std::memory_order_release);
+  } else {
+    while (arena->ctrl_->confirmed.load(std::memory_order_acquire) != 1) {
+      if (NowSecs() > deadline) {
+        LOG_WARNING << "shm: attach never confirmed, using TCP";
+        return nullptr;
+      }
+      usleep(1000);
+    }
+  }
+  LOG_DEBUG << "shm: arena " << name << " up, " << nranks << " ranks x "
+             << slot_bytes << " bytes";
+  return arena;
+}
+
+ShmArena::~ShmArena() {
+  if (base_ != nullptr && base_ != MAP_FAILED) munmap(base_, map_bytes_);
+}
+
+uint8_t* ShmArena::slot(int r) {
+  return static_cast<uint8_t*>(base_) + slots_off_ + int64_t(r) * slot_bytes_;
+}
+
+namespace {
+// Dead = gone (ESRCH) or a zombie: an unreaped child still answers
+// kill(pid, 0), but it will never arrive at a barrier.
+bool ProcessRunning(int32_t pid) {
+  if (kill(pid, 0) != 0) return errno != ESRCH;
+  char path[48], st[128];
+  std::snprintf(path, sizeof(path), "/proc/%d/stat", pid);
+  FILE* f = std::fopen(path, "r");
+  if (f == nullptr) return false;
+  size_t n = std::fread(st, 1, sizeof(st) - 1, f);
+  std::fclose(f);
+  st[n] = '\0';
+  // State is the first field after the parenthesized comm.
+  const char* paren = std::strrchr(st, ')');
+  return paren == nullptr || paren[2] != 'Z';
+}
+}  // namespace
+
+bool ShmArena::PeersAlive() {
+  for (int r = 0; r < nranks_; ++r) {
+    if (r == rank_) continue;
+    int32_t pid = pids_[r].load(std::memory_order_relaxed);
+    if (pid > 0 && !ProcessRunning(pid)) return false;
+  }
+  return true;
+}
+
+bool ShmArena::Barrier(double timeout_secs) {
+  if (poisoned_) return false;
+  uint32_t gen = ctrl_->generation.load(std::memory_order_acquire);
+  uint32_t n = ctrl_->arrived.fetch_add(1, std::memory_order_acq_rel) + 1;
+  if (n == static_cast<uint32_t>(nranks_)) {
+    ctrl_->arrived.store(0, std::memory_order_relaxed);
+    ctrl_->generation.fetch_add(1, std::memory_order_release);
+    return true;
+  }
+  double deadline = NowSecs() + timeout_secs;
+  double next_liveness = NowSecs() + 0.2;
+  while (ctrl_->generation.load(std::memory_order_acquire) == gen) {
+    double now = NowSecs();
+    // A dead peer can never arrive, and shared memory (unlike a TCP
+    // socket) raises no error — poison fast via pid liveness instead
+    // of waiting out the full deadline.
+    if (now > deadline || (now > next_liveness && !PeersAlive())) {
+      poisoned_ = true;
+      return false;
+    }
+    if (now > next_liveness) next_liveness = now + 0.2;
+    sched_yield();  // single-core boxes: let the peers run
+  }
+  return true;
+}
+
+}  // namespace hvd

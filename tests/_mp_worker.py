@@ -457,6 +457,26 @@ def main():
             np.testing.assert_allclose(np.asarray(out), want)
             hvd.join()
 
+    elif scenario == "shm_die":
+        # The last rank dies without warning mid-stream; survivors must
+        # surface an error within seconds (TCP link error or shm pid
+        # liveness poison), never hang out a long timeout.
+        import time as _t
+
+        hvd.allreduce(np.ones(4, np.float32), name="warm")  # arena warm
+        if r == s - 1:
+            os._exit(17)
+        t0 = _t.monotonic()
+        try:
+            for i in range(1000):
+                hvd.allreduce(np.ones(4, np.float32), name=f"d.{i}")
+            raise SystemExit("survivor never saw the failure")
+        except hvd.HorovodInternalError:
+            dt = _t.monotonic() - t0
+            assert dt < 30.0, f"death took {dt:.1f}s to surface"
+        print(f"OK rank={r}")
+        os._exit(0)  # shutdown would hang: the job is already broken
+
     else:
         raise SystemExit(f"unknown scenario {scenario}")
 

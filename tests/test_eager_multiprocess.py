@@ -19,7 +19,10 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def run_job(scenario: str, np_: int, timeout: int = 120, extra_env=None):
+def run_job(scenario: str, np_: int, timeout: int = 120, extra_env=None,
+            expected_rc=None):
+    """Launch np_ ranks of the worker; expected_rc maps rank -> allowed
+    nonzero exit code (default: every rank must exit 0)."""
     port = _free_port()
     procs = []
     for r in range(np_):
@@ -50,7 +53,7 @@ def run_job(scenario: str, np_: int, timeout: int = 120, extra_env=None):
                 q.kill()
             raise AssertionError(f"rank {r} timed out; output so far unknown")
         outs.append(out)
-        if p.returncode != 0:
+        if p.returncode != (expected_rc or {}).get(r, 0):
             failed.append((r, p.returncode, out))
     assert not failed, "\n".join(
         f"--- rank {r} rc={rc}\n{out}" for r, rc, out in failed)
@@ -58,8 +61,13 @@ def run_job(scenario: str, np_: int, timeout: int = 120, extra_env=None):
 
 
 @pytest.mark.parametrize("np_", [2, 4])
-def test_full_matrix(np_):
-    outs = run_job("matrix", np_)
+@pytest.mark.parametrize("plane", ["shm", "tcp"])
+def test_full_matrix(np_, plane):
+    # Both host data planes stay covered: shm is the single-host
+    # default; HOROVOD_SHM_DISABLE forces the TCP peer-mesh algorithms
+    # multi-host jobs use.
+    env = {"HOROVOD_SHM_DISABLE": "1"} if plane == "tcp" else {}
+    outs = run_job("matrix", np_, extra_env=env)
     for r, out in enumerate(outs):
         assert f"OK rank={r}" in out
 
@@ -128,3 +136,22 @@ def test_fused_allgather(np_):
 
 def test_xla_fused_allgather():
     run_job("xla_fused_allgather", 2, timeout=240, extra_env=_xla_env(2))
+
+
+def test_shm_arena_active_single_host():
+    """Single-host jobs must actually take the shared-memory data
+    plane: the debug log announces the arena on every rank."""
+    outs = run_job("matrix", 2, extra_env={"HOROVOD_LOG_LEVEL": "debug"})
+    for r, out in enumerate(outs):
+        assert f"OK rank={r}" in out
+        assert "shm: arena" in out, "shm data plane never came up"
+
+
+def test_shm_peer_death_surfaces_fast():
+    """A rank dying mid-stream must error the survivors within seconds
+    (shm has no socket to break — pid liveness poisons the arena)."""
+    np_ = 3
+    outs = run_job("shm_die", np_, timeout=90,
+                   expected_rc={np_ - 1: 17})  # the deliberate hard exit
+    for r in range(np_ - 1):
+        assert f"OK rank={r}" in outs[r], f"rank {r}: {outs[r]}"
