@@ -1,0 +1,97 @@
+"""Decoder-LM pretraining on the in-jit SPMD tier — the idiomatic
+TPU path: ONE process drives the whole device mesh, parallelism is
+declared as mesh axes, and XLA inserts every collective.
+
+This is the tier the eager examples point at for performance; it has
+no reference analog (the reference is process-per-rank only, this is
+the TPU-first redesign). Shows: mesh construction (dp/fsdp/tp/sp),
+``make_train_step`` (scan-over-layers Llama-family model, remat,
+sharded optimizer state), synthetic token stream, loss logging, and a
+final-checkpoint save via ``orbax`` when available.
+
+Run (any device count; axes auto-fold to what exists):
+  python examples/lm_pretrain.py --steps 20 --dp 2 --tp 2
+CPU smoke (8 virtual devices):
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/lm_pretrain.py --platform cpu --steps 2 --tiny
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=8, help="global batch")
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--dp", type=int, default=-1)
+    ap.add_argument("--fsdp", type=int, default=1)
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--sp", type=int, default=1)
+    ap.add_argument("--tiny", action="store_true",
+                    help="2-layer d=64 model (CI smoke)")
+    ap.add_argument("--platform", default=None, choices=[None, "cpu", "tpu"])
+    ap.add_argument("--out", default=None,
+                    help="orbax checkpoint dir (optional)")
+    args = ap.parse_args()
+
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.models import TransformerConfig, make_train_step
+    from horovod_tpu.parallel import build_mesh
+
+    mesh = build_mesh(dp=args.dp, fsdp=args.fsdp, tp=args.tp, sp=args.sp)
+    if args.tiny:
+        cfg = TransformerConfig.tiny(max_seq=args.seq)
+    else:
+        cfg = TransformerConfig(
+            vocab_size=8192, d_model=512, n_layers=4, n_heads=8,
+            n_kv_heads=8, d_ff=1376, max_seq=args.seq,
+            dtype=jnp.bfloat16,
+            sp_attention="ring" if args.sp > 1 else "local")
+
+    init_state, step, _ = make_train_step(cfg, mesh)
+    state = jax.jit(init_state)(jax.random.PRNGKey(0))
+    n_params = sum(int(x.size) for x in jax.tree.leaves(state["params"]))
+    print(f"mesh={dict(zip(mesh.axis_names, mesh.devices.shape))} "
+          f"params={n_params:,}")
+
+    # Synthetic token stream: a fixed random corpus sampled per step
+    # (hermetic; swap in a real tokenized dataset loader here).
+    data_sharding = NamedSharding(mesh, P(("dp", "fsdp"), None))
+    corpus = jax.random.randint(jax.random.PRNGKey(1),
+                                (64, args.seq + 1), 0, cfg.vocab_size)
+
+    loss = float("nan")  # --steps 0 still reaches the DONE line
+    for i in range(args.steps):
+        idx = jax.random.randint(jax.random.PRNGKey(100 + i),
+                                 (args.batch,), 0, corpus.shape[0])
+        batch = {"tokens": jax.device_put(corpus[idx], data_sharding)}
+        state, loss = step(state, batch)
+        if i % 5 == 0 or i == args.steps - 1:
+            print(f"step {i:4d}  loss {float(loss):.4f}")
+
+    if args.out:
+        try:
+            import orbax.checkpoint as ocp
+            ckptr = ocp.StandardCheckpointer()
+            ckptr.save(os.path.abspath(args.out),
+                       jax.device_get(state["params"]), force=True)
+            ckptr.wait_until_finished()
+            print(f"saved params to {args.out}")
+        except ImportError:
+            print("orbax not installed; skipping checkpoint", file=sys.stderr)
+
+    print(f"DONE loss={float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -79,8 +79,10 @@ class TransformerConfig:
 
     @classmethod
     def tiny(cls, **kw):
-        return cls(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
-                   n_kv_heads=2, d_ff=128, max_seq=128, **kw)
+        base = dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                    n_kv_heads=2, d_ff=128, max_seq=128)
+        base.update(kw)  # any field overridable (llama3_8b-style presets
+        return cls(**base)  # hard-pin theirs; tiny is a CI scaffold)
 
     @property
     def moe(self) -> Optional[moe_lib.MoEConfig]:

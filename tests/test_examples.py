@@ -106,3 +106,23 @@ def test_elastic_example_with_discovery(tmp_path):
         env=env, capture_output=True, text=True, timeout=150)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "FINAL err=" in proc.stdout
+
+
+def test_lm_pretrain_example_spmd_mesh(tmp_path):
+    """The in-jit SPMD example drives a 2x2x2 virtual mesh in one
+    process (with an orbax checkpoint when available)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env.update(_WORKER_ENV)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+    out_dir = str(tmp_path / "ckpt")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "examples", "lm_pretrain.py"),
+         "--platform", "cpu", "--steps", "2", "--tiny",
+         "--dp", "2", "--fsdp", "2", "--tp", "2", "--out", out_dir],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "DONE loss=" in proc.stdout
+    assert "'dp': 2" in proc.stdout and "'tp': 2" in proc.stdout
