@@ -161,6 +161,8 @@ constexpr const char* ACT_MEMCPY_IN_FUSION_BUFFER = "MEMCPY_IN_FUSION_BUFFER";
 constexpr const char* ACT_MEMCPY_OUT_FUSION_BUFFER = "MEMCPY_OUT_FUSION_BUFFER";
 constexpr const char* ACT_TCP_ALLREDUCE = "TCP_ALLREDUCE";
 constexpr const char* ACT_SHM_ALLREDUCE = "SHM_ALLREDUCE";
+constexpr const char* ACT_SHM_ALLGATHER = "SHM_ALLGATHER";
+constexpr const char* ACT_SHM_BROADCAST = "SHM_BROADCAST";
 constexpr const char* ACT_TCP_ALLGATHER = "TCP_ALLGATHER";
 constexpr const char* ACT_TCP_BROADCAST = "TCP_BROADCAST";
 constexpr const char* ACT_TCP_ALLTOALL = "TCP_ALLTOALL";

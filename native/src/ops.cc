@@ -718,7 +718,6 @@ Status TcpOps::Allgather(const Response& r,
   const int size = controller_->size();
   const int nt = static_cast<int>(entries.size());
   const std::string tname = entries.front().name;
-  if (timeline_) timeline_->ActivityStart(tname, ACT_TCP_ALLGATHER);
 
   // Fused ring allgather (the reference fuses allgathers too,
   // controller.cc:826-848): r.tensor_sizes holds per-tensor blocks of
@@ -747,6 +746,48 @@ Status TcpOps::Allgather(const Response& r,
   }
   std::vector<int> all_ranks(size);
   for (int k = 0; k < size; ++k) all_ranks[k] = k;
+
+  // Single-host: every rank writes its (disjoint) block straight into
+  // arena slot 0 and unpacks the gathered whole from it — one barrier
+  // pair, no ring forwarding. Allgather is rejected under Join, so
+  // all ranks participate by construction.
+  const bool use_shm = shm_ && size > 1 && offs[size] <= shm_->slot_bytes();
+  if (use_shm && shm_->poisoned())
+    return Status::UnknownError("shm arena poisoned by an earlier failure");
+  if (timeline_)
+    timeline_->ActivityStart(tname,
+                             use_shm ? ACT_SHM_ALLGATHER : ACT_TCP_ALLGATHER);
+  // Unpack a gathered buffer (rank-major blocks, tensor order inside
+  // each block) into the per-tensor outputs. Shared by both planes.
+  auto unpack = [&](const uint8_t* src_base) {
+    std::vector<int64_t> out_off(nt, 0);
+    for (int k = 0; k < size; ++k) {
+      int64_t src = offs[k];
+      for (int t = 0; t < nt; ++t) {
+        int64_t bytes = rows(t, k) * row_bytes[t];
+        std::memcpy(static_cast<uint8_t*>(entries[t].output) + out_off[t],
+                    src_base + src, bytes);
+        src += bytes;
+        out_off[t] += bytes;
+      }
+    }
+  };
+  if (use_shm) {
+    uint8_t* base = shm_->slot(0);
+    int64_t off = offs[rank];
+    for (int t = 0; t < nt; ++t) {
+      int64_t bytes = rows(t, rank) * row_bytes[t];
+      std::memcpy(base + off, entries[t].data, bytes);
+      off += bytes;
+    }
+    if (!shm_->Barrier(shm_timeout_secs_))
+      return Status::UnknownError("shm allgather: peer lost or stalled");
+    unpack(base);
+    if (!shm_->Barrier(shm_timeout_secs_))
+      return Status::UnknownError("shm allgather: peer lost or stalled");
+    if (timeline_) timeline_->ActivityEnd(tname);
+    return Status::OK();
+  }
 
   if (nt == 1) {
     // Single tensor: ring in place in the output buffer — no staging
@@ -784,17 +825,7 @@ Status TcpOps::Allgather(const Response& r,
   // Unpack: rank k's block holds its rows of each tensor in order.
   if (timeline_) timeline_->ActivityStart(tname,
                                           ACT_MEMCPY_OUT_FUSION_BUFFER);
-  std::vector<int64_t> out_off(nt, 0);
-  for (int k = 0; k < size; ++k) {
-    int64_t src = offs[k];
-    for (int t = 0; t < nt; ++t) {
-      int64_t bytes = rows(t, k) * row_bytes[t];
-      std::memcpy(static_cast<uint8_t*>(entries[t].output) + out_off[t],
-                  buf + src, bytes);
-      src += bytes;
-      out_off[t] += bytes;
-    }
-  }
+  unpack(buf);
   if (timeline_) timeline_->ActivityEnd(tname);
   if (timeline_) timeline_->ActivityEnd(tname);  // closes TCP_ALLGATHER
   return Status::OK();
@@ -805,11 +836,31 @@ Status TcpOps::Broadcast(const Response& r,
   const int rank = controller_->rank();
   const int size = controller_->size();
   auto& e = entries.front();
-  if (timeline_) timeline_->ActivityStart(e.name, ACT_TCP_BROADCAST);
   int64_t bytes = e.shape.num_elements() * DataTypeSize(e.dtype);
   // Output buffer: root writes its input through to output too.
   uint8_t* out = static_cast<uint8_t*>(e.output ? e.output
                                                 : const_cast<void*>(e.data));
+  // Single-host: root publishes through arena slot 0. Broadcast is
+  // rejected under Join, so all ranks participate.
+  const bool use_shm = shm_ && size > 1 && bytes <= shm_->slot_bytes();
+  if (use_shm && shm_->poisoned())
+    return Status::UnknownError("shm arena poisoned by an earlier failure");
+  if (timeline_)
+    timeline_->ActivityStart(e.name,
+                             use_shm ? ACT_SHM_BROADCAST : ACT_TCP_BROADCAST);
+  if (use_shm) {
+    if (rank == e.root_rank) {
+      std::memcpy(shm_->slot(0), e.data, bytes);
+      if (out != e.data) std::memcpy(out, e.data, bytes);
+    }
+    if (!shm_->Barrier(shm_timeout_secs_))
+      return Status::UnknownError("shm broadcast: peer lost or stalled");
+    if (rank != e.root_rank) std::memcpy(out, shm_->slot(0), bytes);
+    if (!shm_->Barrier(shm_timeout_secs_))
+      return Status::UnknownError("shm broadcast: peer lost or stalled");
+    if (timeline_) timeline_->ActivityEnd(e.name);
+    return Status::OK();
+  }
   // Binomial tree rooted at root_rank: log2(size) rounds instead of the
   // hub's size−1 serialized sends from one socket. Virtual rank 0 is
   // the root; a node receives from vr − lowbit(vr) and forwards to
