@@ -163,6 +163,9 @@ constexpr const char* ACT_TCP_ALLREDUCE = "TCP_ALLREDUCE";
 constexpr const char* ACT_SHM_ALLREDUCE = "SHM_ALLREDUCE";
 constexpr const char* ACT_SHM_ALLGATHER = "SHM_ALLGATHER";
 constexpr const char* ACT_SHM_BROADCAST = "SHM_BROADCAST";
+constexpr const char* ACT_SHM_ALLTOALL = "SHM_ALLTOALL";
+constexpr const char* ACT_SHM_REDUCESCATTER = "SHM_REDUCESCATTER";
+constexpr const char* ACT_TCP_REDUCESCATTER = "TCP_REDUCESCATTER";
 constexpr const char* ACT_TCP_ALLGATHER = "TCP_ALLGATHER";
 constexpr const char* ACT_TCP_BROADCAST = "TCP_BROADCAST";
 constexpr const char* ACT_TCP_ALLTOALL = "TCP_ALLTOALL";

@@ -104,6 +104,12 @@ class TcpOps : public OpExecutor {
   // out; three barriers). In place on the fusion buffer.
   Status ShmAllreduce(uint8_t* buf, int64_t elems, DataType dtype,
                       ReduceOp op);
+  // Uniform shm eligibility gate: true when the arena exists and the
+  // (response-derived, hence rank-identical) payload fits a slot.
+  // Sets *err when the op is eligible but the arena is poisoned —
+  // eligible ops must FAIL rather than diverge onto TCP (peers with
+  // healthy arenas would wait in the barrier forever).
+  bool ShmEligible(int64_t payload_bytes, Status* err);
 
   int64_t ring_threshold_bytes_;  // below: recursive doubling
   bool hierarchical_ = false;     // HOROVOD_HIERARCHICAL_ALLREDUCE

@@ -415,16 +415,11 @@ Status TcpOps::Allreduce(const Response& r,
   // reach the barrier. The shm path packs straight into this rank's
   // arena slot and unpacks straight from the reduced slot 0, saving
   // two full-buffer copies over staging through the fusion buffer.
-  const bool use_shm = shm_ && static_cast<int>(ranks.size()) == size &&
-                       total_bytes <= shm_->slot_bytes() &&
-                       r.reduce_op != ReduceOp::ADASUM && size > 1;
-  // A poisoned arena must FAIL shm-eligible ops, not fall back to
-  // TCP: the path choice is job-wide (peers with healthy arenas would
-  // sit in the barrier while this rank rings over sockets they never
-  // service). The error reaches the app as HorovodInternalError; the
-  // peers' own barriers poison on our inactivity or process death.
-  if (use_shm && shm_->poisoned())
-    return Status::UnknownError("shm arena poisoned by an earlier failure");
+  Status shm_err = Status::OK();
+  const bool use_shm = static_cast<int>(ranks.size()) == size &&
+                       r.reduce_op != ReduceOp::ADASUM &&
+                       ShmEligible(total_bytes, &shm_err);
+  if (!shm_err.ok()) return shm_err;
   uint8_t* buf = use_shm
                      ? shm_->slot(rank)
                      : static_cast<uint8_t*>(fusion_->GetBuffer(0, total_bytes));
@@ -531,6 +526,17 @@ Status TcpOps::RingAllgatherPhase(uint8_t* buf,
       return Status::UnknownError("ring allreduce: lost data connection");
   }
   return Status::OK();
+}
+
+bool TcpOps::ShmEligible(int64_t payload_bytes, Status* err) {
+  if (!shm_ || controller_->size() <= 1 ||
+      payload_bytes > shm_->slot_bytes())
+    return false;
+  if (shm_->poisoned()) {
+    *err = Status::UnknownError("shm arena poisoned by an earlier failure");
+    return true;  // eligible — the caller must fail, not divert to TCP
+  }
+  return true;
 }
 
 Status TcpOps::ShmAllreduce(uint8_t* buf, int64_t elems, DataType dtype,
@@ -751,9 +757,9 @@ Status TcpOps::Allgather(const Response& r,
   // arena slot 0 and unpacks the gathered whole from it — one barrier
   // pair, no ring forwarding. Allgather is rejected under Join, so
   // all ranks participate by construction.
-  const bool use_shm = shm_ && size > 1 && offs[size] <= shm_->slot_bytes();
-  if (use_shm && shm_->poisoned())
-    return Status::UnknownError("shm arena poisoned by an earlier failure");
+  Status shm_err = Status::OK();
+  const bool use_shm = ShmEligible(offs[size], &shm_err);
+  if (!shm_err.ok()) return shm_err;
   if (timeline_)
     timeline_->ActivityStart(tname,
                              use_shm ? ACT_SHM_ALLGATHER : ACT_TCP_ALLGATHER);
@@ -842,9 +848,9 @@ Status TcpOps::Broadcast(const Response& r,
                                                 : const_cast<void*>(e.data));
   // Single-host: root publishes through arena slot 0. Broadcast is
   // rejected under Join, so all ranks participate.
-  const bool use_shm = shm_ && size > 1 && bytes <= shm_->slot_bytes();
-  if (use_shm && shm_->poisoned())
-    return Status::UnknownError("shm arena poisoned by an earlier failure");
+  Status shm_err = Status::OK();
+  const bool use_shm = ShmEligible(bytes, &shm_err);
+  if (!shm_err.ok()) return shm_err;
   if (timeline_)
     timeline_->ActivityStart(e.name,
                              use_shm ? ACT_SHM_BROADCAST : ACT_TCP_BROADCAST);
@@ -894,7 +900,6 @@ Status TcpOps::Alltoall(const Response& r,
   const int rank = controller_->rank();
   const int size = controller_->size();
   auto& e = entries.front();
-  if (timeline_) timeline_->ActivityStart(e.name, ACT_TCP_ALLTOALL);
   int64_t row_bytes = DataTypeSize(e.dtype);
   for (int d = 1; d < e.shape.ndim(); ++d) row_bytes *= e.shape.dim_size(d);
 
@@ -907,6 +912,47 @@ Status TcpOps::Alltoall(const Response& r,
   uint8_t* out = static_cast<uint8_t*>(e.output);
   if (out == nullptr)
     return Status::PreconditionError("alltoall output not allocated");
+
+  // Single-host: each rank publishes its whole (split-ordered) input
+  // in its own slot; every rank then picks its incoming block out of
+  // each peer's slot directly. Eligibility must be identical on every
+  // rank, so it is judged on the LARGEST per-rank input (all derivable
+  // from the synced recvsplits matrix). Rejected under Join.
+  int64_t max_in_bytes = 0;
+  for (int k = 0; k < size; ++k) {
+    int64_t in_k = 0;
+    for (int r0 = 0; r0 < size; ++r0) in_k += recv_rows(r0, k);
+    max_in_bytes = std::max(max_in_bytes, in_k * row_bytes);
+  }
+  Status shm_err = Status::OK();
+  const bool use_shm = ShmEligible(max_in_bytes, &shm_err);
+  if (!shm_err.ok()) return shm_err;
+  if (timeline_)
+    timeline_->ActivityStart(e.name,
+                             use_shm ? ACT_SHM_ALLTOALL : ACT_TCP_ALLTOALL);
+  if (use_shm) {
+    // This rank's TOTAL input rows (its slot holds the whole
+    // split-ordered input; readers index into it per source).
+    int64_t my_in_rows = 0;
+    for (int r0 = 0; r0 < size; ++r0) my_in_rows += recv_rows(r0, rank);
+    std::memcpy(shm_->slot(rank), e.data, my_in_rows * row_bytes);
+    if (!shm_->Barrier(shm_timeout_secs_))
+      return Status::UnknownError("shm alltoall: peer lost or stalled");
+    int64_t out_off = 0;
+    for (int k = 0; k < size; ++k) {
+      // Offset of my block inside source k's input: rows k routes to
+      // ranks below me.
+      int64_t src_off = 0;
+      for (int d2 = 0; d2 < rank; ++d2) src_off += recv_rows(d2, k);
+      int64_t blk = recv_rows(rank, k) * row_bytes;
+      std::memcpy(out + out_off, shm_->slot(k) + src_off * row_bytes, blk);
+      out_off += blk;
+    }
+    if (!shm_->Barrier(shm_timeout_secs_))
+      return Status::UnknownError("shm alltoall: peer lost or stalled");
+    if (timeline_) timeline_->ActivityEnd(e.name);
+    return Status::OK();
+  }
 
   // Pairwise exchange over the peer mesh (the dense analog of
   // MPI_Alltoallv's pairwise algorithm): at step s each rank sends its
@@ -952,21 +998,50 @@ Status TcpOps::Reducescatter(const Response& r,
   if (e.reduce_op == ReduceOp::ADASUM)
     return Status::PreconditionError(
         "adasum reducescatter is not defined; use allreduce");
-  if (timeline_) timeline_->ActivityStart(e.name, ACT_TCP_ALLREDUCE);
   int64_t n = e.shape.num_elements();
   int64_t bytes = n * DataTypeSize(e.dtype);
   int64_t row_bytes = DataTypeSize(e.dtype);
   for (int d = 1; d < e.shape.ndim(); ++d) row_bytes *= e.shape.dim_size(d);
 
-  uint8_t* buf = static_cast<uint8_t*>(fusion_->GetBuffer(0, bytes));
-  std::memcpy(buf, e.data, bytes);
-  if (e.prescale_factor != 1.0)
-    HostScale(e.dtype, buf, n, e.prescale_factor);
-
   // Byte offset of each rank's shard (r.tensor_sizes = per-rank rows).
   std::vector<int64_t> offs(size + 1, 0);
   for (int k = 0; k < size; ++k)
     offs[k + 1] = offs[k] + r.tensor_sizes[k] * row_bytes;
+
+  // Single-host: publish inputs per slot, then each rank reduces only
+  // its own shard straight into its output (rejected under Join, so
+  // all ranks reach the barriers).
+  Status shm_err = Status::OK();
+  const bool use_shm = ShmEligible(bytes, &shm_err);
+  if (!shm_err.ok()) return shm_err;
+  if (timeline_)
+    timeline_->ActivityStart(
+        e.name, use_shm ? ACT_SHM_REDUCESCATTER : ACT_TCP_REDUCESCATTER);
+  if (use_shm) {
+    std::memcpy(shm_->slot(rank), e.data, bytes);
+    if (e.prescale_factor != 1.0)
+      HostScale(e.dtype, shm_->slot(rank), n, e.prescale_factor);
+    if (!shm_->Barrier(shm_timeout_secs_))
+      return Status::UnknownError("shm reducescatter: peer lost or stalled");
+    const int64_t lo = offs[rank], sh_bytes = offs[rank + 1] - lo;
+    const int64_t sh_n = sh_bytes / DataTypeSize(e.dtype);
+    std::memcpy(e.output, shm_->slot(0) + lo, sh_bytes);
+    for (int k = 1; k < size; ++k)
+      HostAccumulate(e.reduce_op, e.dtype, shm_->slot(k) + lo, e.output,
+                     sh_n);
+    double f = e.postscale_factor;
+    if (e.reduce_op == ReduceOp::AVERAGE) f /= size;
+    if (f != 1.0) HostScale(e.dtype, e.output, sh_n, f);
+    if (!shm_->Barrier(shm_timeout_secs_))
+      return Status::UnknownError("shm reducescatter: peer lost or stalled");
+    if (timeline_) timeline_->ActivityEnd(e.name);
+    return Status::OK();
+  }
+
+  uint8_t* buf = static_cast<uint8_t*>(fusion_->GetBuffer(0, bytes));
+  std::memcpy(buf, e.data, bytes);
+  if (e.prescale_factor != 1.0)
+    HostScale(e.dtype, buf, n, e.prescale_factor);
 
   // Ring reduce-scatter with the rank shards as the ring chunks: P-1
   // steps, each forwarding the partially-reduced chunk one hop; chunk k
