@@ -63,7 +63,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 
     def __init__(self, params, named_parameters, compression,
                  backward_passes_per_step, op, gradient_predivide_factor,
-                 sparse_as_dense=False):
+                 sparse_as_dense=False, groups=None):
         super(self.__class__, self).__init__(params)
         self._compression = compression
         self._reduce_op = op
@@ -103,6 +103,17 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         self._synchronized = False
         self._should_synchronize = True
         self._hook_handles = []
+        # Gradient grouping (reference `groups` arg): members of a group
+        # ride ONE grouped allreduce, launched when the whole group's
+        # gradients are ready (or force-completed at synchronize()).
+        self._group_members = []    # gid -> ordered param list
+        self._p_to_group = {}       # param -> gid
+        self._group_fired = []      # gid -> set of fired params
+        self._group_launched = set()
+        # Groups are validated even at size 1 (so a bad `groups` arg
+        # fails in local development, not first at scale-out); grouping
+        # only takes effect once hooks exist.
+        self._build_groups(groups)
         if api.size() > 1:
             self._register_hooks()
 
@@ -118,19 +129,98 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                         p.register_post_accumulate_grad_hook(
                             self._make_hook()))
 
+    def _build_groups(self, groups):
+        """Partition (dense-gradient) parameters into allreduce groups.
+        ``groups``: int = split the registration order into that many
+        contiguous even chunks (backward completes layers back-to-
+        front, so a contiguous tail chunk is ready — and its grouped
+        allreduce in flight — while earlier layers still compute);
+        list of lists of tensors = explicit members. Registration order
+        is model-definition order, identical on every rank, so group
+        identity needs no negotiation."""
+        if groups is None:
+            return
+        ordered = [p for g in self.param_groups for p in g["params"]
+                   if p.requires_grad]
+        if isinstance(groups, int):
+            if groups <= 0:
+                raise ValueError("groups must be a positive int or a "
+                                 "list of parameter lists")
+            n = min(groups, len(ordered))
+            k, m = divmod(len(ordered), n)
+            members = [ordered[i * k + min(i, m):(i + 1) * k + min(i + 1, m)]
+                       for i in range(n)]
+        else:
+            requires = set(ordered)
+            covered = set()
+            members = []
+            for lst in groups:
+                for q in lst:
+                    if q not in requires:
+                        raise ValueError(
+                            "groups contains a tensor that is not a "
+                            "gradient-requiring optimizer parameter")
+                    if q in covered:
+                        raise ValueError("a parameter appears in two groups")
+                    covered.add(q)
+                members.append(list(lst))
+        self._group_members = [m for m in members if m]
+        for gid, m in enumerate(self._group_members):
+            for q in m:
+                self._p_to_group[q] = gid
+        self._group_fired = [set() for _ in self._group_members]
+
+    def _launch_group(self, gid):
+        members = self._group_members[gid]
+        for q in members:
+            if q.grad is None:
+                q.grad = q.data.new(q.size()).zero_()
+            if q.grad.is_sparse:
+                raise ValueError(
+                    "sparse gradients cannot ride a grouped allreduce; "
+                    "leave the parameter out of `groups`")
+        prescale, postscale = 1.0, 1.0
+        op = self._reduce_op
+        if self._gradient_predivide_factor != 1.0:
+            prescale = 1.0 / self._gradient_predivide_factor
+            postscale = self._gradient_predivide_factor / api.size()
+            op = ReduceOp.SUM
+        compressed, ctxs = zip(
+            *[self._compression.compress(q.grad) for q in members])
+        handles = api.grouped_allreduce_async(
+            list(compressed), name=f"allreduce.group.{gid}", op=op,
+            prescale_factor=prescale, postscale_factor=postscale)
+        self._handles[tuple(members)] = (handles, ctxs)
+        self._group_fired[gid] = set()
+        self._group_launched.add(gid)
+
     def _make_hook(self):
         def hook(p):
-            if p in self._handles and self._handles[p][0] is not None:
-                if self._allreduce_delay[p] <= 0:
-                    raise AssertionError(
-                        "Gradients were computed more than "
-                        "backward_passes_per_step times before call to "
-                        "step(). Increase backward_passes_per_step.")
+            gid = self._p_to_group.get(p)
+            launched = ((p in self._handles
+                         and self._handles[p][0] is not None)
+                        or (gid is not None
+                            and gid in self._group_launched))
+            if launched and self._allreduce_delay[p] <= 0:
+                raise AssertionError(
+                    "Gradients were computed more than "
+                    "backward_passes_per_step times before call to "
+                    "step(). Increase backward_passes_per_step.")
             assert not p.grad.requires_grad
             assert self._allreduce_delay[p] > 0
             self._allreduce_delay[p] -= 1
             if self._allreduce_delay[p] == 0:
-                self._handles[p] = self._allreduce_grad_async(p)
+                gid = self._p_to_group.get(p)
+                if gid is not None:
+                    # Launch eagerly only when the WHOLE group is ready
+                    # (otherwise synchronize() force-completes it) so
+                    # every rank launches identical grouped collectives.
+                    self._group_fired[gid].add(p)
+                    if (len(self._group_fired[gid])
+                            == len(self._group_members[gid])):
+                        self._launch_group(gid)
+                else:
+                    self._handles[p] = self._allreduce_grad_async(p)
         return hook
 
     def _allreduce_grad_async(self, p) -> Tuple[object, object]:
@@ -187,16 +277,32 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         if api.size() == 1:
             self._synchronized = True
             return
-        # Parameters whose hook never fired this step (e.g. layer
-        # skipped in forward) still must reduce — all ranks launch the
-        # same set of collectives or negotiation stalls.
-        missing = self._requires_update - set(self._handles)
+        # Groups that never completed this step (a member's hook didn't
+        # fire) are force-launched whole, zero-filling missing grads —
+        # every rank thereby issues identical grouped collectives.
+        for gid in range(len(self._group_members)):
+            if gid not in self._group_launched:
+                self._launch_group(gid)
+        # Ungrouped parameters whose hook never fired still must reduce
+        # — all ranks launch the same set of collectives or negotiation
+        # stalls.
+        grouped = set(self._p_to_group)
+        missing = self._requires_update - set(self._handles) - grouped
         for p in missing:
             self._handles[p] = self._allreduce_grad_async(p)
             self._allreduce_delay[p] = 0
-        for p, (handle, ctx) in sorted(
+        for key, (handle, ctx) in sorted(
                 self._handles.items(),
-                key=lambda kv: self._parameter_names[kv[0]]):
+                key=lambda kv: self._parameter_names[
+                    kv[0][0] if isinstance(kv[0], tuple) else kv[0]]):
+            if isinstance(key, tuple):  # grouped: per-member handles
+                for q, h, c in zip(key, handle, ctx):
+                    out = api.synchronize(h)
+                    self._allreduce_delay[q] = self.backward_passes_per_step
+                    grad = self._compression.decompress(out, c)
+                    q.grad.copy_(grad.view(q.grad.shape))
+                continue
+            p = key
             self._allreduce_delay[p] = self.backward_passes_per_step
             if isinstance(handle, _SparseGather):
                 p.grad = handle.finish()
@@ -210,6 +316,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             else:
                 p.grad.copy_(grad.view(p.grad.shape))
         self._handles.clear()
+        self._group_launched.clear()
         self._synchronized = True
 
     @contextmanager
@@ -261,8 +368,8 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          backward_passes_per_step: int = 1,
                          op: ReduceOp = Average,
                          gradient_predivide_factor: float = 1.0,
-                         sparse_as_dense: bool = False
-                         ) -> torch.optim.Optimizer:
+                         sparse_as_dense: bool = False,
+                         groups=None) -> torch.optim.Optimizer:
     """Wrap ``optimizer`` so gradients are averaged across ranks before
     each ``step()`` (reference factory, ``torch/optimizer.py:599+``
     semantics; usage identical: pass ``model.named_parameters()``).
@@ -270,6 +377,11 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
     Sparse gradients (e.g. ``nn.Embedding(sparse=True)``) ride an
     entry allgather + coalesce; ``sparse_as_dense=True`` densifies
     them before the wire instead (cheaper for mostly-dense updates).
+
+    ``groups`` batches gradients into grouped allreduces (reference
+    ``groups`` arg): a positive int splits the parameters into that
+    many groups; a list of parameter lists picks members explicitly
+    (unlisted parameters reduce individually).
     """
     if gradient_predivide_factor != 1.0 and op != Average:
         raise ValueError(
@@ -278,4 +390,4 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                dict(_DistributedOptimizer.__dict__))
     return cls(optimizer.param_groups, named_parameters, compression,
                backward_passes_per_step, op, gradient_predivide_factor,
-               sparse_as_dense)
+               sparse_as_dense, groups)

@@ -307,3 +307,105 @@ def test_sparse_missing_grad_launches_sparse_collective():
     expected[3] = 0.5  # rank 1's row-3 ones, averaged over 2 ranks
     for g in results:
         np.testing.assert_allclose(g, expected, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# gradient grouping (reference `groups` arg)
+# ---------------------------------------------------------------------------
+
+def _groups_worker(groups_spec):
+    import torch
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    torch.manual_seed(1)
+    model = torch.nn.Sequential(
+        torch.nn.Linear(4, 8), torch.nn.ReLU(), torch.nn.Linear(8, 2))
+    if groups_spec == "explicit":
+        groups = [[model[0].weight, model[2].weight]]  # biases individual
+    else:
+        groups = groups_spec
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters(), groups=groups)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    for step in range(3):
+        x = torch.randn(4, 4, generator=torch.Generator().manual_seed(
+            100 + step * 2 + hvd.rank()))
+        opt.zero_grad()
+        model(x).pow(2).sum().backward()
+        opt.step()
+    out = [p.detach().clone().numpy() for p in model.parameters()]
+    hvd.shutdown()
+    return out
+
+
+@pytest.fixture(scope="module")
+def ungrouped_baseline():
+    from functools import partial
+    return run(partial(_groups_worker, None), np=2, env=_WORKER_ENV,
+               start_timeout=90)
+
+
+@pytest.mark.parametrize("groups_spec", [2, "explicit"])
+def test_groups_match_ungrouped(groups_spec, ungrouped_baseline):
+    from functools import partial
+
+    results = run(partial(_groups_worker, groups_spec), np=2,
+                  env=_WORKER_ENV, start_timeout=90)
+    # Both ranks identical, and grouping must not change the math:
+    # compare against the ungrouped reference run.
+    for a, b in zip(results[0], results[1]):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(results[0], ungrouped_baseline[0]):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_groups_validated_at_size_one():
+    hvd.init()
+    model = _model()
+    with pytest.raises(ValueError, match="positive int"):
+        hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters(), groups=-1)
+    with pytest.raises(ValueError, match="not a gradient-requiring"):
+        hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters(),
+            groups=[[torch.zeros(3)]])
+
+
+def _groups_skip_worker():
+    """Rank 0 skips the second linear on step 2: its group must be
+    force-completed at synchronize() with zero-filled grads, keeping
+    both ranks on identical grouped collectives."""
+    import torch
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    torch.manual_seed(1)
+    lin1, lin2 = torch.nn.Linear(4, 4), torch.nn.Linear(4, 4)
+    params = ([("l1." + k, v) for k, v in lin1.named_parameters()]
+              + [("l2." + k, v) for k, v in lin2.named_parameters()])
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD([p for _, p in params], lr=0.1),
+        named_parameters=params, groups=2)
+    hvd.broadcast_parameters(dict(params), root_rank=0)
+    x = torch.ones(2, 4)
+    for step in range(3):
+        opt.zero_grad()
+        y = lin1(x)
+        if not (step == 1 and hvd.rank() == 0):
+            y = lin2(y)
+        y.sum().backward()
+        opt.step()
+    out = [p.detach().clone().numpy() for _, p in params]
+    hvd.shutdown()
+    return out
+
+
+def test_groups_force_complete_on_skip():
+    results = run(_groups_skip_worker, np=2, env=_WORKER_ENV,
+                  start_timeout=90)
+    for a, b in zip(results[0], results[1]):
+        np.testing.assert_array_equal(a, b)
