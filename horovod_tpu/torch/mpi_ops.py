@@ -1,0 +1,138 @@
+"""Torch-flavored op layer: in-place variants and compression-aware
+convenience wrappers over the eager API (reference
+``torch/mpi_ops.py:233-265,444-512,696-739`` — the underscore ops
+write the result back into the argument tensor, the non-underscore
+convenience forms take a ``compression``).
+
+In-place semantics only exist at this layer: the runtime's wire path
+is out-of-place, so the "in-place" contract is a ``copy_`` into the
+argument at synchronize time — same observable behavior as the
+reference's output==input enqueue."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import horovod_tpu.api as api
+from horovod_tpu.common.ops_enum import ReduceOp
+from horovod_tpu.compression import Compression
+
+
+class _InPlaceHandle:
+    """Async handle whose synchronize() lands outputs back into the
+    original tensors (the reference's output==input enqueue)."""
+
+    def __init__(self, handles, tensors, single: bool):
+        self.handles = handles
+        self.tensors = tensors
+        self.single = single
+
+
+def synchronize(handle):
+    """Torch-aware synchronize: resolves in-place handles by copying
+    results into the original tensors; plain handles pass through."""
+    if isinstance(handle, _InPlaceHandle):
+        import torch
+
+        first_error = None
+        # Drain EVERY member handle even if one fails: an abandoned
+        # handle would leak its runtime entry and block reuse of the
+        # tensor name. The copy is data movement, not an autograd op —
+        # no_grad so nn.Parameters (requires_grad leaves) are writable,
+        # like the reference's C++ output==input enqueue.
+        with torch.no_grad():
+            for h, t in zip(handle.handles, handle.tensors):
+                try:
+                    out = api.synchronize(h)
+                except Exception as e:  # noqa: BLE001 — drain, then re-raise
+                    if first_error is None:
+                        first_error = e
+                    continue
+                if first_error is None:
+                    t.copy_(out.view(t.shape))
+        if first_error is not None:
+            raise first_error
+        return handle.tensors[0] if handle.single else list(handle.tensors)
+    return api.synchronize(handle)
+
+
+def poll(handle) -> bool:
+    if isinstance(handle, _InPlaceHandle):
+        return all(api.poll(h) for h in handle.handles)
+    return api.poll(handle)
+
+
+# -- allreduce --------------------------------------------------------------
+
+def allreduce(tensor, average: Optional[bool] = None,
+              name: Optional[str] = None,
+              compression=Compression.none, op: Optional[ReduceOp] = None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+    """Out-of-place allreduce with optional wire compression
+    (reference ``torch/mpi_ops.py:192``)."""
+    compressed, ctx = compression.compress(tensor)
+    out = api.allreduce(compressed, average, name, op,
+                        prescale_factor, postscale_factor)
+    return compression.decompress(out, ctx)
+
+
+def allreduce_async_(tensor, average: Optional[bool] = None,
+                     name: Optional[str] = None,
+                     op: Optional[ReduceOp] = None,
+                     prescale_factor: float = 1.0,
+                     postscale_factor: float = 1.0) -> _InPlaceHandle:
+    h = api.allreduce_async(tensor, average, name, op,
+                            prescale_factor, postscale_factor)
+    return _InPlaceHandle((h,), (tensor,), single=True)
+
+
+def allreduce_(tensor, average: Optional[bool] = None,
+               name: Optional[str] = None, op: Optional[ReduceOp] = None,
+               prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+    return synchronize(allreduce_async_(tensor, average, name, op,
+                                        prescale_factor, postscale_factor))
+
+
+def grouped_allreduce(tensors: Sequence, average: Optional[bool] = None,
+                      name: Optional[str] = None,
+                      compression=Compression.none,
+                      op: Optional[ReduceOp] = None,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0):
+    compressed, ctxs = zip(*[compression.compress(t) for t in tensors])
+    outs = api.grouped_allreduce(list(compressed), average, name, op,
+                                 prescale_factor, postscale_factor)
+    return [compression.decompress(o, c) for o, c in zip(outs, ctxs)]
+
+
+def grouped_allreduce_async_(tensors: Sequence,
+                             average: Optional[bool] = None,
+                             name: Optional[str] = None,
+                             op: Optional[ReduceOp] = None,
+                             prescale_factor: float = 1.0,
+                             postscale_factor: float = 1.0
+                             ) -> _InPlaceHandle:
+    handles = api.grouped_allreduce_async(list(tensors), average, name, op,
+                                          prescale_factor, postscale_factor)
+    return _InPlaceHandle(tuple(handles), tuple(tensors), single=False)
+
+
+def grouped_allreduce_(tensors: Sequence, average: Optional[bool] = None,
+                       name: Optional[str] = None,
+                       op: Optional[ReduceOp] = None,
+                       prescale_factor: float = 1.0,
+                       postscale_factor: float = 1.0):
+    return synchronize(grouped_allreduce_async_(
+        tensors, average, name, op, prescale_factor, postscale_factor))
+
+
+# -- broadcast --------------------------------------------------------------
+
+def broadcast_async_(tensor, root_rank: int,
+                     name: Optional[str] = None) -> _InPlaceHandle:
+    h = api.broadcast_async(tensor, root_rank, name)
+    return _InPlaceHandle((h,), (tensor,), single=True)
+
+
+def broadcast_(tensor, root_rank: int, name: Optional[str] = None):
+    return synchronize(broadcast_async_(tensor, root_rank, name))

@@ -409,3 +409,65 @@ def test_groups_force_complete_on_skip():
                   start_timeout=90)
     for a, b in zip(results[0], results[1]):
         np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# in-place op variants + compression kwarg (reference torch/mpi_ops.py)
+# ---------------------------------------------------------------------------
+
+def _inplace_ops_worker():
+    import torch
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    t = torch.full((3,), float(r + 1))
+    same = hvd.allreduce_(t, op=hvd.Sum, name="ip.ar")
+    assert same is t  # result landed in the argument
+    ar = t.clone()
+
+    b = torch.full((2,), float(r * 10))
+    hvd.broadcast_(b, root_rank=1, name="ip.bc")
+
+    g1, g2 = torch.full((2,), float(r)), torch.full((2,), float(r + 5))
+    outs = hvd.grouped_allreduce_([g1, g2], op=hvd.Sum, name="ip.gar")
+    assert outs[0] is g1 and outs[1] is g2
+
+    # compression kwarg on the convenience form
+    c = hvd.allreduce(torch.full((4,), float(r + 1)), op=hvd.Sum,
+                      compression=hvd.Compression.fp16, name="ip.comp")
+
+    out = (ar.numpy().tolist(), b.numpy().tolist(),
+           g1.numpy().tolist(), g2.numpy().tolist(), c.numpy().tolist())
+    hvd.shutdown()
+    return out
+
+
+def test_inplace_ops_and_compression():
+    results = run(_inplace_ops_worker, np=2, env=_WORKER_ENV,
+                  start_timeout=90)
+    for ar, b, g1, g2, c in results:
+        assert ar == [3.0, 3.0, 3.0]          # 1 + 2
+        assert b == [10.0, 10.0]              # rank 1's value
+        assert g1 == [1.0, 1.0]               # 0 + 1
+        assert g2 == [11.0, 11.0]             # 5 + 6
+        assert c == [3.0, 3.0, 3.0, 3.0]
+
+
+def _inplace_param_worker():
+    import torch
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    p = torch.nn.Parameter(torch.full((3,), float(hvd.rank() + 1)))
+    hvd.broadcast_(p, root_rank=0, name="ip.param")  # requires_grad leaf
+    out = p.detach().numpy().tolist()
+    hvd.shutdown()
+    return out
+
+
+def test_inplace_on_parameters():
+    results = run(_inplace_param_worker, np=2, env=_WORKER_ENV,
+                  start_timeout=90)
+    for out in results:
+        assert out == [1.0, 1.0, 1.0]  # rank 0's value everywhere
