@@ -23,6 +23,18 @@ FN_KEY = "fn"
 RESULT_SCOPE = "results"
 
 
+def prepend_package_pythonpath(env: Dict[str, str]) -> Dict[str, str]:
+    """Make `python -m horovod_tpu.runner.run_task` importable from any
+    worker cwd: prepend this package's root onto the env's PYTHONPATH."""
+    out = dict(env)
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    existing = out.get("PYTHONPATH", os.environ.get("PYTHONPATH"))
+    out["PYTHONPATH"] = (pkg_root if not existing
+                         else f"{pkg_root}{os.pathsep}{existing}")
+    return out
+
+
 def run_command(command, np: int, hosts: Optional[str] = None,
                 hostfile: Optional[str] = None,
                 env: Optional[Dict[str, str]] = None,
@@ -60,14 +72,7 @@ def run(fn, args: tuple = (), kwargs: Optional[dict] = None, *,
     server.start()
     try:
         payload = cloudpickle.dumps((fn, tuple(args), dict(kwargs or {})))
-        server_env = dict(env or {})
-        # Workers run `python -m horovod_tpu.runner.run_task`; make this
-        # package importable from any cwd.
-        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))))
-        existing = server_env.get("PYTHONPATH", os.environ.get("PYTHONPATH"))
-        server_env["PYTHONPATH"] = (pkg_root if not existing
-                                    else f"{pkg_root}{os.pathsep}{existing}")
+        server_env = prepend_package_pythonpath(env or {})
         command = [sys.executable, "-m", "horovod_tpu.runner.run_task"]
         settings = LaunchSettings(
             np=np, command=command, hosts=hosts, hostfile=hostfile,

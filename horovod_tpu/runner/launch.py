@@ -78,6 +78,12 @@ def _slot_env(slot: hosts_mod.SlotInfo, base: Dict[str, str],
             str(int(start_timeout * 1000)),
     })
     env.pop("HOROVOD_CONTROLLER_ADDR", None)  # always discovered
+    # A job launched from INSIDE an elastic worker must not inherit the
+    # parent's identity/epoch — run_task keys results by elastic id
+    # when present, and a shared inherited id would collide every rank.
+    # (launch_elastic's spawn_fn re-sets these per worker afterwards.)
+    env.pop("HOROVOD_ELASTIC_ID", None)
+    env.pop("HOROVOD_ELASTIC_EPOCH", None)
     if env.get("HOROVOD_TIMELINE"):
         env["HOROVOD_TIMELINE"] = f"{env['HOROVOD_TIMELINE']}.{slot.rank}"
     return env
@@ -172,7 +178,9 @@ def launch_static(settings: LaunchSettings,
 
 def launch_elastic(settings: LaunchSettings, discovery,
                    min_np: int = 1, max_np: int = 0,
-                   discovery_interval: float = 1.0) -> Dict[str, int]:
+                   discovery_interval: float = 1.0,
+                   kv_preload: Optional[Dict] = None,
+                   on_complete=None) -> Dict[str, int]:
     """Run an elastic job (reference ``launch_gloo_elastic``,
     ``runner/gloo_run.py:287-323``): the ElasticDriver owns worker
     processes and membership; this provides the spawn function with the
@@ -192,6 +200,10 @@ def launch_elastic(settings: LaunchSettings, discovery,
     server = KVServer(host="127.0.0.1" if initially_local else "0.0.0.0")
     server.start()
     try:
+        # Function-API payloads (run_elastic): published before any
+        # worker spawns so run_task's kv_wait never races the key.
+        for (scope, key), blob in (kv_preload or {}).items():
+            server.put_local(scope, key, blob)
         launcher_host = ("127.0.0.1" if initially_local
                          else socket.getfqdn())
         kv_addr = f"{launcher_host}:{server.port}"
@@ -225,9 +237,14 @@ def launch_elastic(settings: LaunchSettings, discovery,
             resolve_controller_host=resolve_controller_host)
         driver.start()
         try:
-            return driver.wait()
+            codes = driver.wait()
         finally:
             driver.shutdown()
+        if on_complete is not None:
+            # Runs while the KV server is still up — result collection
+            # for the function API (run_elastic).
+            on_complete(server, codes)
+        return codes
     finally:
         server.stop()
 

@@ -19,7 +19,10 @@ from horovod_tpu.runner.http_kv import kv_put, kv_wait
 
 def main() -> int:
     rdv = os.environ["HOROVOD_RENDEZVOUS_ADDR"]
-    rank = os.environ.get("HOROVOD_RANK", "0")
+    # Elastic workers key results by their stable identity (ranks can
+    # shift across membership epochs); static workers by rank.
+    key = (os.environ.get("HOROVOD_ELASTIC_ID")
+           or os.environ.get("HOROVOD_RANK", "0"))
     timeout = float(os.environ.get("HOROVOD_START_TIMEOUT", "120"))
     fn, args, kwargs = cloudpickle.loads(
         kv_wait(rdv, FN_SCOPE, FN_KEY, timeout))
@@ -27,7 +30,7 @@ def main() -> int:
         payload = (True, fn(*args, **kwargs))
     except BaseException:
         payload = (False, traceback.format_exc())
-    kv_put(rdv, RESULT_SCOPE, rank, cloudpickle.dumps(payload))
+    kv_put(rdv, RESULT_SCOPE, key, cloudpickle.dumps(payload))
     return 0 if payload[0] else 1
 
 

@@ -1,6 +1,9 @@
 """Spark integration (reference ``horovod/spark/runner.py:195``)."""
 
 from horovod_tpu.spark.runner import run  # noqa: F401
+from horovod_tpu.spark.elastic import (  # noqa: F401
+    SparkHostDiscovery, run_elastic,
+)
 from horovod_tpu.spark.estimator import (  # noqa: F401
     Store,
     TorchEstimator,

@@ -1,0 +1,129 @@
+"""Elastic training on Spark clusters: ``horovod_tpu.spark.run_elastic``.
+
+Rebuild of the reference ``horovod.spark.run_elastic``
+(``spark/runner.py:306``) on this repo's elastic stack: Spark supplies
+live cluster membership (executor hosts), and the elastic driver does
+everything else — rank assignment, worker spawn/respawn (ssh for
+remote hosts), blacklist, re-rendezvous. The training function rides
+the same KV transport as ``horovod_tpu.runner.run``; wrap its body
+with ``@hvd.elastic.run`` + a ``State`` for commit/restore exactly as
+under script-based discovery.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+from horovod_tpu.runner.api import (
+    FN_KEY, FN_SCOPE, RESULT_SCOPE, prepend_package_pythonpath,
+)
+from horovod_tpu.runner.elastic_driver import HostDiscovery
+from horovod_tpu.runner.launch import LaunchSettings, launch_elastic
+
+
+class SparkHostDiscovery(HostDiscovery):
+    """Host/slot table from live Spark executor state (the reference
+    derives membership from its executor registration the same way)."""
+
+    def __init__(self, spark_context=None, slots_per_host: int = 0):
+        self._sc = spark_context
+        self._slots = slots_per_host
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        sc = self._sc
+        if sc is None:
+            from pyspark.sql import SparkSession
+            sc = SparkSession.builder.getOrCreate().sparkContext
+        hosts: Dict[str, int] = {}
+        # Executor host:port keys from the JVM block-manager map. The
+        # map also carries ONE entry for the driver's own block manager
+        # (which runs no tasks): drop at most one entry matching the
+        # driver host, so co-located executors keep their slots; if
+        # that empties the table (driver-only view during startup),
+        # keep everything rather than report an empty cluster.
+        status = sc._jsc.sc().getExecutorMemoryStatus()
+        driver_host = sc._conf.get("spark.driver.host", None)
+        entries = [str(e).rsplit(":", 1)[0]
+                   for e in status.keySet().toArray()]
+        if driver_host is not None and driver_host in entries \
+                and len(entries) > 1:
+            entries.remove(driver_host)
+        for host in entries:
+            hosts[host] = hosts.get(host, 0) + (self._slots or 1)
+        return hosts
+
+
+def run_elastic(fn: Callable, args: tuple = (),
+                kwargs: Optional[dict] = None, *,
+                num_proc: Optional[int] = None,
+                min_np: int = 1, max_np: int = 0,
+                env: Optional[Dict[str, str]] = None,
+                start_timeout: float = 120.0,
+                discovery: Optional[HostDiscovery] = None,
+                discovery_interval: float = 1.0,
+                spark_context=None) -> List[Any]:
+    """Run ``fn(*args, **kwargs)`` elastically over the Spark cluster's
+    hosts; returns the per-worker results of the FINAL membership,
+    ordered by worker identity (reference ``horovod.spark.run_elastic``
+    semantics: results of the workers that finished).
+
+    ``fn`` must follow the elastic contract (``hvd.elastic.run`` +
+    ``State``) to survive membership changes; a plain ``hvd.init()``
+    function works while membership is stable.
+    """
+    if discovery is None:
+        discovery = SparkHostDiscovery(spark_context)
+    # num_proc is the reference's fixed-size convenience: it bounds the
+    # elastic window when min/max are not given explicitly.
+    if num_proc:
+        min_np = min_np if min_np > 1 else num_proc
+        max_np = max_np or num_proc
+    worker_env = prepend_package_pythonpath(env or {})
+    settings = LaunchSettings(
+        np=num_proc or 0,
+        command=[sys.executable, "-m", "horovod_tpu.runner.run_task"],
+        env=worker_env, start_timeout=start_timeout)
+    payload = cloudpickle.dumps((fn, tuple(args), dict(kwargs or {})))
+
+    collected: Dict[str, bytes] = {}
+
+    def on_complete(server, codes):
+        for ident in codes:
+            blob = server.get_local(RESULT_SCOPE, ident)
+            if blob is not None:
+                collected[ident] = blob
+
+    codes = launch_elastic(
+        settings, discovery, min_np=min_np, max_np=max_np,
+        discovery_interval=discovery_interval,
+        kv_preload={(FN_SCOPE, FN_KEY): payload}, on_complete=on_complete)
+
+    def ident_order(ident: str):
+        host, _, seq = ident.rpartition(":")
+        return (host, int(seq)) if seq.isdigit() else (ident, 0)
+
+    results: List[Any] = []
+    errors: Dict[str, str] = {}
+    for ident in sorted(codes, key=ident_order):
+        blob = collected.get(ident)
+        if blob is None:
+            # No result: the worker was replaced/removed mid-job (its
+            # successor carries the epoch's result) — only a problem if
+            # nobody finished, handled below.
+            continue
+        ok, value = cloudpickle.loads(blob)
+        if ok:
+            results.append(value)
+        else:
+            errors[ident] = value
+    if errors or not results:
+        for ident, code in sorted(codes.items()):
+            if code != 0 and ident not in errors \
+                    and ident not in collected:
+                errors[ident] = f"no result (exit code {code})"
+        detail = "\n".join(f"[{i}] {m}" for i, m in sorted(errors.items()))
+        raise RuntimeError(f"horovod_tpu.spark.run_elastic failed:\n{detail}")
+    return results

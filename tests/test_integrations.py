@@ -3,6 +3,7 @@ reference's single-process tier mocks its exec layer the same way
 (test/single/test_run.py); real-cluster behavior is covered by the
 shared slot/rendezvous machinery these executors delegate to."""
 
+import os
 import sys
 import types
 
@@ -59,7 +60,15 @@ def _make_fake_ray():
 def fake_ray(monkeypatch):
     ray = _make_fake_ray()
     monkeypatch.setitem(sys.modules, "ray", ray)
-    return ray
+    # Fake actors execute IN this process; their worker env mutations
+    # (HOROVOD_* incl. the rendezvous address of a KV server that dies
+    # with the test) must not leak into later tests' hvd/State init.
+    saved = {k: v for k, v in os.environ.items()
+             if k.startswith("HOROVOD_")}
+    yield ray
+    for k in [k for k in os.environ if k.startswith("HOROVOD_")]:
+        os.environ.pop(k, None)
+    os.environ.update(saved)
 
 
 def test_ray_executor_slot_model_and_run(fake_ray):
@@ -290,3 +299,62 @@ def test_torch_estimator_fit_predict(fake_pyspark, tmp_path):
         hvd.init()
     pred = model.predict(np.asarray([[1.0], [2.0]], np.float32))
     np.testing.assert_allclose(pred[:, 0], [2.0, 4.0], atol=0.2)
+
+
+# ---------------------------------------------------------------------------
+# spark elastic (reference spark/runner.py:306 run_elastic)
+# ---------------------------------------------------------------------------
+
+def _elastic_rank_fn():
+    import horovod_tpu as hvd
+    hvd.init()
+    out = (hvd.rank(), hvd.size())
+    hvd.shutdown()
+    return out
+
+
+def test_spark_run_elastic_stable_membership():
+    from horovod_tpu.runner.elastic_driver import FixedHostDiscovery
+    from horovod_tpu.spark import run_elastic
+
+    results = run_elastic(
+        _elastic_rank_fn, min_np=2, max_np=2,
+        discovery=FixedHostDiscovery({"localhost": 2}),
+        env={"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": os.path.dirname(os.path.abspath(__file__))},
+        start_timeout=90)
+    assert sorted(results) == [(0, 2), (1, 2)]
+
+
+def test_spark_host_discovery_parses_executor_map():
+    from horovod_tpu.spark import SparkHostDiscovery
+
+    class _JSet:
+        def toArray(self):
+            return ["exec1:7337", "exec1:7448", "exec2:7337",
+                    "driver-host:7077"]
+
+    class _JMap:
+        def keySet(self):
+            return _JSet()
+
+        def size(self):
+            return 4
+
+    class _JSC:
+        def sc(self):
+            return self
+
+        def getExecutorMemoryStatus(self):
+            return _JMap()
+
+    class _Conf:
+        def get(self, key, default=None):
+            return "driver-host" if key == "spark.driver.host" else default
+
+    class _SC:
+        _jsc = _JSC()
+        _conf = _Conf()
+
+    hosts = SparkHostDiscovery(_SC()).find_available_hosts_and_slots()
+    assert hosts == {"exec1": 2, "exec2": 1}
