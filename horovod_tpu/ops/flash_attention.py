@@ -136,6 +136,14 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret,
     return out[:, :t], lse[:, 0, :t]
 
 
+# Above this query length the backward recompute runs q-chunked: the
+# dense form materializes [B·H, Tq, Tk] f32 score/probability tensors
+# (O(T²) HBM — ~2 GB per B·H=8 at T=8192, OOM well before 32k); the
+# chunked form caps live intermediates at [B·H, chunk, Tk].
+_BWD_CHUNK_T = 4096
+_BWD_CHUNK = 1024
+
+
 def _bwd(scale, causal, residuals, g, g_lse=None, q_per_kv: int = 1):
     """Recompute-based backward from the saved logsumexp: exact same
     probabilities the kernel computed, expressed as XLA matmul chains
@@ -145,38 +153,97 @@ def _bwd(scale, causal, residuals, g, g_lse=None, q_per_kv: int = 1):
 
     GQA (``q_per_kv > 1``): q-side tensors reshape to a [B·Hkv, rep]
     grouping (consecutive query heads share a kv head under the
-    batch-major flattening) and dk/dv sum over the group."""
+    batch-major flattening) and dk/dv sum over the group.
+
+    Long sequences dispatch to the q-chunked form (same math, bounded
+    memory)."""
+    if residuals[0].shape[1] > _BWD_CHUNK_T:
+        return _bwd_chunked(scale, causal, residuals, g, g_lse, q_per_kv)
     q, k, v, out, lse = residuals
     rep = q_per_kv
     bkv = k.shape[0]
     t = q.shape[1]
     d = q.shape[2]
-    qf = q.astype(jnp.float32).reshape(bkv, rep, t, d)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    do = g.astype(jnp.float32).reshape(bkv, rep, t, d)
-    outf = out.astype(jnp.float32).reshape(bkv, rep, t, d)
-    lseg = lse.reshape(bkv, rep, t)
-
-    s = jnp.einsum("brqd,bkd->brqk", qf, kf) * scale
-    if causal:
-        q_pos = jnp.arange(t)[:, None]
-        k_pos = jnp.arange(t)[None, :]
-        s = jnp.where(k_pos > q_pos, NEG_INF, s)
-    p = jnp.exp(s - lseg[..., None])             # [bkv, rep, tq, tk]
-
-    dv = jnp.einsum("brqk,brqd->bkd", p, do)
-    dp = jnp.einsum("brqd,bkd->brqk", do, vf)
-    delta = jnp.sum(do * outf, axis=-1, keepdims=True)
-    ds = p * (dp - delta) * scale
-    dq = jnp.einsum("brqk,bkd->brqd", ds, kf)
-    dk = jnp.einsum("brqk,brqd->bkd", ds, qf)
-    if g_lse is not None:
-        gl = g_lse.astype(jnp.float32).reshape(bkv, rep, t)
-        dq = dq + gl[..., None] * jnp.einsum("brqk,bkd->brqd", p, kf) * scale
-        dk = dk + jnp.einsum("brq,brqk,brqd->bkd", gl, p, qf) * scale
+    as_grp = lambda x: x.astype(jnp.float32).reshape(bkv, rep, t, d)  # noqa: E731
+    gl = (None if g_lse is None
+          else g_lse.astype(jnp.float32).reshape(bkv, rep, t))
+    dq, dk, dv = _bwd_rows(
+        as_grp(q), as_grp(g), as_grp(out), lse.reshape(bkv, rep, t), gl,
+        k.astype(jnp.float32), v.astype(jnp.float32), 0, scale, causal)
     return (dq.reshape(q.shape).astype(q.dtype), dk.astype(k.dtype),
             dv.astype(v.dtype))
+
+
+def _bwd_rows(qc, doc, outc, lsec, glc, kf, vf, q_pos0, scale, causal):
+    """Gradient contributions of one block of query rows (f32 in/out):
+    the shared body of the dense and chunked backwards. ``q_pos0`` is
+    the block's global query offset for the causal mask."""
+    tk = kf.shape[1]
+    s = jnp.einsum("brqd,bkd->brqk", qc, kf) * scale
+    if causal:
+        q_pos = q_pos0 + jnp.arange(qc.shape[2])[:, None]
+        k_pos = jnp.arange(tk)[None, :]
+        s = jnp.where(k_pos > q_pos, NEG_INF, s)
+    p = jnp.exp(s - lsec[..., None])             # [bkv, rep, rows, tk]
+
+    dv = jnp.einsum("brqk,brqd->bkd", p, doc)
+    dp = jnp.einsum("brqd,bkd->brqk", doc, vf)
+    delta = jnp.sum(doc * outc, axis=-1, keepdims=True)
+    ds = p * (dp - delta) * scale
+    dq = jnp.einsum("brqk,bkd->brqd", ds, kf)
+    dk = jnp.einsum("brqk,brqd->bkd", ds, qc)
+    if glc is not None:
+        dq = dq + glc[..., None] * jnp.einsum("brqk,bkd->brqd", p, kf) * scale
+        dk = dk + jnp.einsum("brq,brqk,brqd->bkd", glc, p, qc) * scale
+    return dq, dk, dv
+
+
+def _bwd_chunked(scale, causal, residuals, g, g_lse, q_per_kv):
+    """The backward above with the query axis processed in
+    ``_BWD_CHUNK``-row slices under ``lax.scan``: per-step tensors are
+    [bkv, rep, chunk, tk] instead of [bkv, rep, tq, tk], so HBM stays
+    bounded for long sequences. Padding rows (q/do/out zeros, lse 0)
+    contribute exactly zero to every accumulated gradient."""
+    q, k, v, out, lse = residuals
+    rep = q_per_kv
+    bkv = k.shape[0]
+    t, d = q.shape[1], q.shape[2]
+    chunk = _BWD_CHUNK
+    pad = (-t) % chunk
+
+    def prep(x):  # [bkv*rep, t, d] -> padded [bkv, rep, T, d], own dtype
+        x = x.reshape(bkv, rep, t, d)
+        return jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
+
+    # Padded in the INPUT dtype: the f32 cast happens per chunk inside
+    # step(), keeping the f32 working set at O(chunk), not O(T).
+    qf, do, outf = prep(q), prep(g), prep(out)
+    lseg = jnp.pad(lse.reshape(bkv, rep, t), ((0, 0), (0, 0), (0, pad)))
+    gl = (None if g_lse is None else
+          jnp.pad(g_lse.astype(jnp.float32).reshape(bkv, rep, t),
+                  ((0, 0), (0, 0), (0, pad))))
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    n = (t + pad) // chunk
+
+    def step(carry, i):
+        dk_acc, dv_acc = carry
+        sl = functools.partial(jax.lax.dynamic_slice_in_dim,
+                               start_index=i * chunk, slice_size=chunk,
+                               axis=2)
+        f32 = lambda x: sl(x).astype(jnp.float32)  # noqa: E731
+        dq_c, dk_c, dv_c = _bwd_rows(
+            f32(qf), f32(do), f32(outf), sl(lseg),
+            None if gl is None else sl(gl), kf, vf, i * chunk, scale,
+            causal)
+        return (dk_acc + dk_c, dv_acc + dv_c), dq_c.astype(q.dtype)
+
+    (dk, dv), dq_chunks = jax.lax.scan(
+        step, (jnp.zeros_like(kf), jnp.zeros_like(vf)), jnp.arange(n))
+    # [n, bkv, rep, chunk, d] -> [bkv, rep, t, d] (pad rows dropped)
+    dq = jnp.moveaxis(dq_chunks, 0, 2).reshape(
+        bkv, rep, n * chunk, d)[:, :, :t, :]
+    return (dq.reshape(q.shape), dk.astype(k.dtype), dv.astype(v.dtype))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
