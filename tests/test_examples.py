@@ -126,3 +126,18 @@ def test_lm_pretrain_example_spmd_mesh(tmp_path):
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "DONE loss=" in proc.stdout
     assert "'dp': 2" in proc.stdout and "'tp': 2" in proc.stdout
+
+
+def test_torch_synthetic_benchmark_2proc(capfd):
+    """The reference's headline example protocol runs end-to-end under
+    the launcher (tiny model, shrunken iteration counts)."""
+    run_command(
+        [sys.executable,
+         os.path.join(ROOT, "examples", "torch_synthetic_benchmark.py"),
+         "--model", "tiny", "--batch-size", "4", "--image-size", "64",
+         "--num-warmup-batches", "1", "--num-batches-per-iter", "2",
+         "--num-iters", "2", "--fp16-allreduce"],
+        np=2, env=_WORKER_ENV, start_timeout=120)
+    out = capfd.readouterr().out
+    assert "Img/sec per process:" in out
+    assert "Total img/sec on 2 process(es):" in out
