@@ -313,6 +313,9 @@ def build_parser() -> argparse.ArgumentParser:
     tune.add_argument("--hierarchical-allreduce", action="store_true",
                       help="two-level intra-node/cross-node allreduce on "
                            "the host data plane")
+    tune.add_argument("--no-shm", action="store_true",
+                      help="disable the single-host shared-memory data "
+                           "plane (force the TCP peer mesh)")
     tune.add_argument("--log-level", default=None,
                       choices=["trace", "debug", "info", "warning", "error",
                                "fatal"])
@@ -328,7 +331,8 @@ _CONFIG_SCHEMA = {
     "params": [("fusion_threshold_mb", "fusion_threshold_mb"),
                ("cycle_time_ms", "cycle_time_ms"),
                ("cache_capacity", "cache_capacity"),
-               ("hierarchical_allreduce", "hierarchical_allreduce")],
+               ("hierarchical_allreduce", "hierarchical_allreduce"),
+               ("no_shm", "no_shm")],
     "autotune": [("enabled", "autotune"),
                  ("log_file", "autotune_log_file")],
     "timeline": [("filename", "timeline_filename")],
@@ -402,6 +406,8 @@ def args_to_env(args: argparse.Namespace) -> Dict[str, str]:
         env["HOROVOD_AUTOTUNE_LOG"] = args.autotune_log_file
     if args.hierarchical_allreduce:
         env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+    if args.no_shm:
+        env["HOROVOD_SHM_DISABLE"] = "1"
     if args.log_level is not None:
         env["HOROVOD_LOG_LEVEL"] = args.log_level
     if args.xla_exec:

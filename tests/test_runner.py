@@ -204,6 +204,12 @@ def test_autotune_and_hierarchical_flags():
     assert "HOROVOD_HIERARCHICAL_ALLREDUCE" not in env2
 
 
+def test_no_shm_flag_maps_to_env():
+    args = build_parser().parse_args(
+        ["-np", "2", "--no-shm", "--", "python", "x.py"])
+    assert args_to_env(args)["HOROVOD_SHM_DISABLE"] == "1"
+
+
 def test_config_file_defaults_and_cli_override(tmp_path):
     from horovod_tpu.runner.launch import _explicit_dests, apply_config_file
 
