@@ -219,7 +219,7 @@ def make_pp_train_step(cfg, mesh: Mesh, n_micro: int, optimizer=None):
         B, T = inp.shape
         if B % n_micro:
             raise ValueError(f"batch {B} not divisible by n_micro={n_micro}")
-        x = params["embed"].astype(cfg.dtype)[inp]
+        x = tr.embed_lookup(params["embed"], inp, cfg.dtype, mesh)
         x = constrain(x, ("dp", "fsdp"), None, None)
         mb = x.reshape(n_micro, B // n_micro, T, x.shape[-1])
         y, aux = pipeline_apply(stage_fn, params["layers"], mb, mesh=mesh,
