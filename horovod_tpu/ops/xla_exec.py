@@ -170,8 +170,11 @@ def _rank_mesh():
     if jax.local_device_count() != 1:
         raise NotImplementedError(
             "eager distributed XLA execution requires one device per "
-            "process (the Horovod process model); use the SPMD functional "
-            "API (horovod_tpu.ops) for multi-device processes")
+            "process (the Horovod process model). On multi-chip TPU "
+            "hosts launch with `horovodrun --tpu`, which carves each "
+            "host into single-chip processes (runner/tpu.py); or use "
+            "the SPMD functional API (horovod_tpu.ops) for multi-device "
+            "processes")
     return Mesh(np.asarray(jax.devices(), dtype=object), ("rank",))
 
 

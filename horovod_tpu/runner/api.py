@@ -39,12 +39,15 @@ def run_command(command, np: int, hosts: Optional[str] = None,
                 hostfile: Optional[str] = None,
                 env: Optional[Dict[str, str]] = None,
                 start_timeout: float = 120.0,
-                verbose: bool = False) -> None:
+                verbose: bool = False, tpu: bool = False,
+                tpu_topology: Optional[str] = None) -> None:
     """Launch an arbitrary command on every slot; raises RuntimeError if
-    any rank fails."""
+    any rank fails. ``tpu=True`` applies the pod-slice chip carve
+    (``horovodrun --tpu``, see :mod:`horovod_tpu.runner.tpu`)."""
     codes = launch_static(LaunchSettings(
         np=np, command=command, hosts=hosts, hostfile=hostfile, env=env,
-        start_timeout=start_timeout, verbose=verbose))
+        start_timeout=start_timeout, verbose=verbose, tpu=tpu,
+        tpu_topology=tpu_topology))
     failures = {r: c for r, c in codes.items() if c != 0}
     if failures:
         raise RuntimeError(f"horovodrun: ranks failed: {failures}")

@@ -44,6 +44,8 @@ class LaunchSettings:
     start_timeout: float = 120.0
     verbose: bool = False
     ssh_port: Optional[int] = None
+    tpu: bool = False                      # TPU pod slice: carve chips
+    tpu_topology: Optional[str] = None     # process grid, e.g. "4x4"
 
 
 def _resolve_hosts(settings: LaunchSettings) -> List[hosts_mod.HostInfo]:
@@ -97,8 +99,8 @@ def _ssh_command(slot: hosts_mod.SlotInfo, command: Sequence[str],
     shell provides the rest), run from the same working directory."""
     exports = " ".join(
         f"{k}={shlex.quote(v)}" for k, v in sorted(env.items())
-        if k.startswith("HOROVOD_") or k in forward_keys
-        or k in ("PYTHONPATH", "PATH"))
+        if k.startswith(("HOROVOD_", "TPU_")) or k in forward_keys
+        or k in ("PYTHONPATH", "PATH", "CLOUD_TPU_TASK_ID"))
     remote = (f"cd {shlex.quote(os.getcwd())} && "
               f"env {exports} {' '.join(shlex.quote(c) for c in command)}")
     cmd = ["ssh", "-o", "StrictHostKeyChecking=no", "-o", "BatchMode=yes"]
@@ -160,6 +162,10 @@ def launch_static(settings: LaunchSettings,
             for slot in slots:
                 env = _slot_env(slot, base_env, kv_addr, controller_host,
                                 settings.start_timeout, server.token)
+                if settings.tpu:
+                    from horovod_tpu.runner.tpu import tpu_slot_env
+                    env.update(tpu_slot_env(slots, slot,
+                                            settings.tpu_topology))
                 if settings.verbose:
                     print(f"horovodrun: starting rank {slot.rank} on "
                           f"{slot.hostname} (local_rank {slot.local_rank})",
@@ -187,6 +193,14 @@ def launch_elastic(settings: LaunchSettings, discovery,
     static launcher's env contract. Returns {identity: exit_code}."""
     from horovod_tpu.runner.elastic_driver import ElasticDriver
 
+    if settings.tpu:
+        # Enforced here (not just the CLI): an elastic TPU job would
+        # re-form at worlds libtpu cannot tile — slices only exist at
+        # fixed legal chip counts (see runner/tpu.py).
+        raise ValueError(
+            "elastic launch is incompatible with tpu=True: TPU slices "
+            "re-form at fixed legal sizes (v5e/v5p: 1,4,8,16,32,64,128,"
+            "256 chips); run static jobs per slice size instead")
     try:
         initial = discovery.find_available_hosts_and_slots()
     except Exception:
@@ -289,6 +303,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="bring up jax.distributed in every worker so "
                         "device tensors ride the XLA data plane instead "
                         "of host TCP")
+    p.add_argument("--tpu", action="store_true",
+                   help="TPU pod-slice launch: carve each host's chips "
+                        "into one single-chip process per slot (libtpu "
+                        "TPU_VISIBLE_DEVICES/TPU_PROCESS_* contract) and "
+                        "bring up jax.distributed (implies --xla-exec)")
+    p.add_argument("--tpu-topology", default=None,
+                   help="process grid XxY[xZ] tiling the slice's chip "
+                        "grid (default: the standard v5e/v5p 2-D grid "
+                        "for -np; v4's 3-D slices must pass this)")
     p.add_argument("--verbose", action="store_true")
 
     tune = p.add_argument_group("tuning")
@@ -431,11 +454,31 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     env = args_to_env(args)
     if args.reset_limit is not None:
         env["HOROVOD_ELASTIC_RESET_LIMIT"] = str(args.reset_limit)
+    if args.tpu:
+        if args.discovery_script:
+            # An elastic TPU job must re-form a LEGAL slice on every
+            # membership change (slices scale 4->8->16->... chips, not
+            # chip-by-chip); the driver cannot re-tile libtpu on the
+            # fly, so elastic + --tpu is rejected rather than launched
+            # into a world libtpu cannot tile. See runner/tpu.py.
+            print("horovodrun: --tpu is incompatible with elastic "
+                  "(--host-discovery-script): TPU slices re-form at "
+                  "fixed legal sizes (v5e/v5p: 1,4,8,16,32,64,128,256 "
+                  "chips); run static jobs per slice size instead",
+                  file=sys.stderr)
+            return 2
+        from horovod_tpu.runner.tpu import validate_slice_np
+        try:
+            validate_slice_np(args.np, args.tpu_topology)
+        except ValueError as e:
+            print(f"horovodrun: {e}", file=sys.stderr)
+            return 2
     settings = LaunchSettings(
         np=args.np, command=command, hosts=args.hosts,
         hostfile=args.hostfile, env=env,
         start_timeout=args.start_timeout, verbose=args.verbose,
-        ssh_port=args.ssh_port)
+        ssh_port=args.ssh_port, tpu=args.tpu,
+        tpu_topology=args.tpu_topology)
     if args.discovery_script:
         from horovod_tpu.runner.elastic_driver import HostDiscoveryScript
         codes = launch_elastic(

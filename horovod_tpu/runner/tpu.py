@@ -1,0 +1,116 @@
+"""TPU pod-slice launch support (``horovodrun --tpu``).
+
+The Horovod process model is one process per accelerator. On a TPU pod
+slice every host owns ``local_size`` chips, so the launcher must carve
+the host's chips into ``local_size`` single-chip processes — the TPU
+analog of the reference's per-slot GPU pinning
+(``runner/gloo_run.py:65-76`` exports ``HOROVOD_LOCAL_RANK`` and the
+framework picks ``cuda:local_rank``). On TPU the carve happens through
+the libtpu env contract *before* the runtime loads:
+
+* ``TPU_VISIBLE_DEVICES=<local_rank>`` — this process sees one chip;
+* ``TPU_CHIPS_PER_PROCESS_BOUNDS=1,1,1`` — a 1x1x1 chip sub-grid per
+  process (one chip, both TensorCores under megacore);
+* ``TPU_PROCESS_BOUNDS=x,y,z`` — how the job's processes tile the
+  slice's physical chip grid;
+* ``TPU_PROCESS_ADDRESSES=h0:p,h1:p,...`` + ``TPU_PROCESS_PORT`` —
+  every process's libtpu endpoint, rank-major;
+* ``CLOUD_TPU_TASK_ID=<rank>`` — this process's index in that list.
+
+``--tpu`` also implies ``--xla-exec``: workers bring up
+``jax.distributed`` (coordinator published through the launcher KV,
+``runtime.py:_init_jax_distributed``), after which
+``jax.local_device_count() == 1`` per process and the eager XLA data
+plane (``ops/xla_exec.py``) runs the full collective matrix over
+ICI/DCN.
+
+Slice-size legality (also the elastic ``--min-np``/``--max-np``
+constraint — a TPU slice cannot shrink or grow chip-by-chip, it must
+re-form as a legal smaller/larger slice):
+
+* v5e / v5p (2-D ICI per slice): 1, 4, 8, 16, 32, 64, 128, 256 chips —
+  the built-in ``_BOUNDS_2D`` table maps these to process grids.
+* v4 (3-D ICI): slices are x*y*z chip cuboids (e.g. ``2x2x2`` = v4-16
+  in core-naming); pass ``--tpu-topology`` explicitly.
+
+Elastic jobs should therefore pick ``min_np``/``max_np`` from the legal
+chip counts above; intermediate worlds would leave libtpu unable to
+tile the slice. (The host TCP data plane has no such constraint — only
+the XLA plane is slice-shaped.)
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from horovod_tpu.runner import hosts as hosts_mod
+
+#: chip-count -> process grid for 2-D ICI generations (v5e/v5p slices).
+_BOUNDS_2D: Dict[int, Tuple[int, int, int]] = {
+    1: (1, 1, 1), 4: (2, 2, 1), 8: (2, 4, 1), 16: (4, 4, 1),
+    32: (4, 8, 1), 64: (8, 8, 1), 128: (8, 16, 1), 256: (16, 16, 1),
+}
+
+#: libtpu's conventional base port for TPU_PROCESS_ADDRESSES.
+DEFAULT_PORT_BASE = 8476
+
+
+def parse_topology(spec: str) -> Tuple[int, int, int]:
+    """``"4x4"`` -> (4, 4, 1); ``"2x2x2"`` -> (2, 2, 2)."""
+    if not re.fullmatch(r"\d+x\d+(x\d+)?", spec):
+        raise ValueError(
+            f"invalid --tpu-topology {spec!r}; expected XxY or XxYxZ")
+    dims = [int(d) for d in spec.split("x")]
+    while len(dims) < 3:
+        dims.append(1)
+    return tuple(dims)  # type: ignore[return-value]
+
+
+def process_bounds(np_: int,
+                   topology: Optional[str] = None) -> Tuple[int, int, int]:
+    """Process grid for an ``np_``-chip job: explicit ``topology`` wins;
+    otherwise the 2-D table for legal v5e/v5p slice sizes."""
+    if topology:
+        t = parse_topology(topology)
+        if t[0] * t[1] * t[2] != np_:
+            raise ValueError(
+                f"--tpu-topology {topology} tiles {t[0] * t[1] * t[2]} "
+                f"processes but -np is {np_}")
+        return t
+    if np_ not in _BOUNDS_2D:
+        raise ValueError(
+            f"np={np_} is not a legal v5e/v5p slice size "
+            f"({sorted(_BOUNDS_2D)}); for v4 or exotic slices pass "
+            "--tpu-topology XxYxZ")
+    return _BOUNDS_2D[np_]
+
+
+def tpu_slot_env(slots: Sequence[hosts_mod.SlotInfo],
+                 slot: hosts_mod.SlotInfo,
+                 topology: Optional[str] = None,
+                 port_base: int = DEFAULT_PORT_BASE) -> Dict[str, str]:
+    """The libtpu pod env for one slot (see module docstring).
+
+    ``slots`` is the full rank-major assignment (needed for the
+    process-address list); ``slot`` is the one being spawned.
+    """
+    bx, by, bz = process_bounds(slot.size, topology)
+    addresses = ",".join(
+        f"{s.hostname}:{port_base + s.local_rank}" for s in slots)
+    return {
+        "TPU_VISIBLE_DEVICES": str(slot.local_rank),
+        "TPU_CHIPS_PER_PROCESS_BOUNDS": "1,1,1",
+        "TPU_PROCESS_BOUNDS": f"{bx},{by},{bz}",
+        "TPU_PROCESS_ADDRESSES": addresses,
+        "TPU_PROCESS_PORT": str(port_base + slot.local_rank),
+        "CLOUD_TPU_TASK_ID": str(slot.rank),
+        # One chip per process: the eager XLA plane's rank mesh
+        # (ops/xla_exec.py:_rank_mesh) requires local_device_count()==1.
+        "HOROVOD_XLA_EXEC": "1",
+    }
+
+
+def validate_slice_np(np_: int, topology: Optional[str] = None) -> None:
+    """Raise early (launcher side) if ``np_`` cannot tile a slice."""
+    process_bounds(np_, topology)

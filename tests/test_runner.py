@@ -245,3 +245,89 @@ def test_config_file_defaults_and_cli_override(tmp_path):
     assert args.verbose is True and args.reset_limit == 4
     # ...but an explicit CLI flag beats the file.
     assert env["HOROVOD_CYCLE_TIME"] == "2.0"
+
+
+# ---------------------------------------------------------------------------
+# TPU pod-slice launch (--tpu)
+# ---------------------------------------------------------------------------
+
+def test_tpu_process_bounds_table_and_topology():
+    from horovod_tpu.runner.tpu import parse_topology, process_bounds
+
+    assert parse_topology("4x4") == (4, 4, 1)
+    assert parse_topology("2x2x2") == (2, 2, 2)
+    with pytest.raises(ValueError, match="tpu-topology"):
+        parse_topology("4,4")
+    assert process_bounds(4) == (2, 2, 1)
+    assert process_bounds(16) == (4, 4, 1)
+    assert process_bounds(8, "2x2x2") == (2, 2, 2)
+    with pytest.raises(ValueError, match="tiles 8 processes"):
+        process_bounds(4, "2x2x2")
+    with pytest.raises(ValueError, match="not a legal"):
+        process_bounds(6)
+
+
+def test_tpu_slot_env_contract():
+    from horovod_tpu.runner import HostInfo, get_host_assignments
+    from horovod_tpu.runner.tpu import tpu_slot_env
+
+    slots = get_host_assignments(
+        [HostInfo("h0", 4), HostInfo("h1", 4)], 8)
+    env = tpu_slot_env(slots, slots[5])        # h1, local_rank 1
+    assert env["TPU_VISIBLE_DEVICES"] == "1"
+    assert env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "1,1,1"
+    assert env["TPU_PROCESS_BOUNDS"] == "2,4,1"
+    assert env["CLOUD_TPU_TASK_ID"] == "5"
+    assert env["TPU_PROCESS_PORT"] == "8477"
+    assert env["HOROVOD_XLA_EXEC"] == "1"
+    addrs = env["TPU_PROCESS_ADDRESSES"].split(",")
+    assert len(addrs) == 8                      # rank-major, all ranks
+    assert addrs[0] == "h0:8476" and addrs[5] == "h1:8477"
+
+
+def test_tpu_cli_rejects_illegal_worlds(capfd):
+    from horovod_tpu.runner.launch import main
+
+    assert main(["--tpu", "-np", "6", "--", "python", "x.py"]) == 2
+    assert "not a legal" in capfd.readouterr().err
+    assert main(["--tpu", "-np", "4", "--host-discovery-script", "d.sh",
+                 "--", "python", "x.py"]) == 2
+    assert "elastic" in capfd.readouterr().err
+
+
+_TPU_SNIPPET = """
+import os, sys
+sys.path.insert(0, {root!r})
+lr, r = os.environ["HOROVOD_LOCAL_RANK"], os.environ["HOROVOD_RANK"]
+assert os.environ["TPU_VISIBLE_DEVICES"] == lr, "chip carve wrong"
+assert os.environ["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "1,1,1"
+assert os.environ["TPU_PROCESS_BOUNDS"] == "2,2,1"
+assert os.environ["CLOUD_TPU_TASK_ID"] == r
+assert len(os.environ["TPU_PROCESS_ADDRESSES"].split(",")) == 4
+import jax
+import jax.numpy as jnp
+import horovod_tpu as hvd
+hvd.init()   # HOROVOD_XLA_EXEC=1 from the carve -> jax.distributed up
+assert jax.local_device_count() == 1, "one device per process"
+out = hvd.allreduce(jnp.ones(4, jnp.float32), name="t", op=hvd.Sum)
+assert float(out[0]) == 4.0, float(out[0])
+print(f"TPU_OK {{hvd.rank()}}/{{hvd.size()}}", flush=True)
+hvd.shutdown()
+"""
+
+
+def test_horovodrun_tpu_launches_xla_plane(capfd):
+    """--tpu end to end on the virtual CPU mesh: the chip-carve env
+    contract reaches every slot, hvd.init() brings up jax.distributed
+    through the launcher KV, and the eager XLA data plane runs a real
+    cross-process allreduce (one device per process)."""
+    env = dict(_WORKER_ENV)
+    # One CPU device per process: the conftest's 8-virtual-device
+    # XLA_FLAGS would break the one-chip-per-process model.
+    env["XLA_FLAGS"] = ""
+    run_command(
+        [sys.executable, "-c", _TPU_SNIPPET.format(root=ROOT)],
+        np=4, env=env, start_timeout=120, tpu=True)
+    out = capfd.readouterr().out
+    for r in range(4):
+        assert f"TPU_OK {r}/4" in out
