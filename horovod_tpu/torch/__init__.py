@@ -28,13 +28,16 @@ from horovod_tpu.functions import (  # noqa: F401
 from horovod_tpu.torch.functions import (  # noqa: F401
     broadcast_optimizer_state, broadcast_parameters,
 )
-# Torch-flavored overrides LAST: in-place variants and the
-# compression-aware allreduce/grouped_allreduce convenience forms
-# shadow the plain api re-exports above (reference torch/mpi_ops.py).
+# Torch-flavored overrides LAST: in-place variants, the
+# compression-aware allreduce/grouped_allreduce convenience forms, and
+# the DIFFERENTIABLE out-of-place collectives shadow the plain api
+# re-exports above (reference torch/mpi_ops.py — its public ops are
+# autograd.Function wrappers, so collectives inside a model backprop).
 from horovod_tpu.torch.mpi_ops import (  # noqa: F401,E402
-    allreduce, allreduce_, allreduce_async_, broadcast_, broadcast_async_,
-    grouped_allreduce, grouped_allreduce_, grouped_allreduce_async_,
-    poll, synchronize,
+    allgather, allreduce, allreduce_, allreduce_async_, alltoall,
+    broadcast, broadcast_, broadcast_async_, grouped_allreduce,
+    grouped_allreduce_, grouped_allreduce_async_, poll, reducescatter,
+    synchronize,
 )
 from horovod_tpu.torch.sync_batch_norm import SyncBatchNorm  # noqa: F401
 from horovod_tpu.torch.optimizer import DistributedOptimizer  # noqa: F401

@@ -11,6 +11,7 @@ reference's output==input enqueue."""
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import Optional, Sequence
 
 import horovod_tpu.api as api
@@ -62,6 +63,151 @@ def poll(handle) -> bool:
     return api.poll(handle)
 
 
+# -- autograd Functions -----------------------------------------------------
+#
+# The out-of-place collectives are thin wrappers around autograd
+# Functions (reference ``torch/mpi_ops.py:173,380,568,653,790``), so a
+# collective can sit INSIDE a model and backpropagate: the backward of
+# a linear collective is itself a collective over the cotangents. When
+# no input requires grad, the plain api path runs instead — the
+# optimizer hook path is unchanged.
+
+@lru_cache(maxsize=None)
+def _fns():
+    """Build the autograd Function classes on first use (torch stays an
+    optional import at module import time, like the rest of this tier)."""
+    import torch
+
+    class HorovodAllreduce(torch.autograd.Function):
+        @staticmethod
+        def forward(ctx, tensor, average, name, op, pre, post):
+            ctx.average, ctx.op, ctx.pre, ctx.post = average, op, pre, post
+            return api.allreduce(tensor, average, name, op, pre, post)
+
+        @staticmethod
+        def backward(ctx, grad):
+            # The gradient of allreduce is allreduce with the same
+            # op/scaling (reference mpi_ops.py:186).
+            return (api.allreduce(grad.contiguous(), ctx.average, None,
+                                  ctx.op, ctx.pre, ctx.post),
+                    None, None, None, None, None)
+
+    class HorovodGroupedAllreduce(torch.autograd.Function):
+        @staticmethod
+        def forward(ctx, average, name, op, pre, post, *tensors):
+            ctx.average, ctx.op, ctx.pre, ctx.post = average, op, pre, post
+            return tuple(api.grouped_allreduce(
+                list(tensors), average, name, op, pre, post))
+
+        @staticmethod
+        def backward(ctx, *grads):
+            gs = api.grouped_allreduce(
+                [g.contiguous() for g in grads], ctx.average, None,
+                ctx.op, ctx.pre, ctx.post)
+            return (None, None, None, None, None, *gs)
+
+    class HorovodAllgather(torch.autograd.Function):
+        @staticmethod
+        def forward(ctx, tensor, name):
+            ctx.dim = tensor.shape[0]
+            return api.allgather(tensor, name)
+
+        @staticmethod
+        def backward(ctx, grad):
+            # Averaged allreduce of the cotangent, then this rank's row
+            # slice (reference mpi_ops.py:578 — rows may be uneven, so
+            # offsets come from an allgather of per-rank row counts).
+            reduced = api.allreduce(grad.contiguous(), average=True)
+            dims = api.allgather(torch.tensor([ctx.dim],
+                                              dtype=torch.int64))
+            r = api.rank()
+            offset = int(dims[:r].sum()) if r else 0
+            return reduced.narrow(0, offset, ctx.dim), None
+
+    class HorovodBroadcast(torch.autograd.Function):
+        @staticmethod
+        def forward(ctx, tensor, root_rank, name):
+            ctx.root_rank = root_rank
+            return api.broadcast(tensor, root_rank, name)
+
+        @staticmethod
+        def backward(ctx, grad):
+            # All cotangents flow to the root (reference mpi_ops.py:
+            # 663): averaged allreduce, zeroed on non-root ranks.
+            reduced = api.allreduce(grad.contiguous(), average=True)
+            if api.rank() != ctx.root_rank:
+                reduced = reduced * 0
+            return reduced, None, None
+
+    class HorovodAlltoall(torch.autograd.Function):
+        @staticmethod
+        def forward(ctx, tensor, splits, name):
+            out, recvsplits = api.alltoall(tensor, splits, name)
+            ctx.recvsplits = [int(s) for s in recvsplits]
+            rs = torch.tensor(ctx.recvsplits, dtype=torch.int32)
+            ctx.mark_non_differentiable(rs)
+            return out, rs
+
+        @staticmethod
+        def backward(ctx, grad, _dead):
+            # Route each cotangent block back where it came from:
+            # alltoall with send splits = the forward's receive splits
+            # (reference mpi_ops.py:806).
+            back, _ = api.alltoall(grad.contiguous(),
+                                   splits=ctx.recvsplits)
+            return back, None, None
+
+    class HorovodReducescatter(torch.autograd.Function):
+        @staticmethod
+        def forward(ctx, tensor, op, name, pre, post):
+            ctx.op, ctx.pre, ctx.post = op, pre, post
+            return api.reducescatter(tensor, op, name, pre, post)
+
+        @staticmethod
+        def backward(ctx, grad):
+            # reducescatter hands each rank a reduced segment; its
+            # transpose gathers the segment cotangents back, with the
+            # same averaging/scaling applied (no-op for Sum at factor
+            # 1). No reference analog: the reference torch tier has no
+            # reducescatter at all.
+            g = api.allgather(grad.contiguous())
+            factor = ctx.pre * ctx.post
+            if ctx.op in (None, ReduceOp.AVERAGE):
+                factor /= api.size()
+            if factor != 1.0:
+                g = g * factor
+            return g, None, None, None, None
+
+    import types
+    return types.SimpleNamespace(
+        allreduce=HorovodAllreduce,
+        grouped_allreduce=HorovodGroupedAllreduce,
+        allgather=HorovodAllgather, broadcast=HorovodBroadcast,
+        alltoall=HorovodAlltoall, reducescatter=HorovodReducescatter)
+
+
+def _is_grad_tensor(t) -> bool:
+    import torch
+    return (torch.is_tensor(t) and t.requires_grad
+            and torch.is_grad_enabled())
+
+
+_NONLINEAR_OPS = (ReduceOp.MIN, ReduceOp.MAX, ReduceOp.PRODUCT)
+
+
+def _check_differentiable_op(op, what: str) -> None:
+    """Nonlinear reductions have no collective transpose: the backward
+    templates below (reissue the op over cotangents / allgather them)
+    are only correct for linear ops. Raise instead of silently
+    producing a wrong dense gradient. (Adasum passes through for
+    reference parity: its backward reissues Adasum, mpi_ops.py:186.)"""
+    if op in _NONLINEAR_OPS:
+        raise NotImplementedError(
+            f"{what} with op={ReduceOp(op).name} is not differentiable "
+            "(nonlinear reduction); detach() the input or use op=Sum/"
+            "Average")
+
+
 # -- allreduce --------------------------------------------------------------
 
 def allreduce(tensor, average: Optional[bool] = None,
@@ -69,10 +215,16 @@ def allreduce(tensor, average: Optional[bool] = None,
               compression=Compression.none, op: Optional[ReduceOp] = None,
               prescale_factor: float = 1.0, postscale_factor: float = 1.0):
     """Out-of-place allreduce with optional wire compression
-    (reference ``torch/mpi_ops.py:192``)."""
+    (reference ``torch/mpi_ops.py:192``). Differentiable: gradients
+    flow through as an allreduce of the cotangents."""
     compressed, ctx = compression.compress(tensor)
-    out = api.allreduce(compressed, average, name, op,
-                        prescale_factor, postscale_factor)
+    if _is_grad_tensor(compressed):
+        _check_differentiable_op(op, "allreduce")
+        out = _fns().allreduce.apply(compressed, average, name, op,
+                                     prescale_factor, postscale_factor)
+    else:
+        out = api.allreduce(compressed, average, name, op,
+                            prescale_factor, postscale_factor)
     return compression.decompress(out, ctx)
 
 
@@ -100,8 +252,14 @@ def grouped_allreduce(tensors: Sequence, average: Optional[bool] = None,
                       prescale_factor: float = 1.0,
                       postscale_factor: float = 1.0):
     compressed, ctxs = zip(*[compression.compress(t) for t in tensors])
-    outs = api.grouped_allreduce(list(compressed), average, name, op,
-                                 prescale_factor, postscale_factor)
+    if any(_is_grad_tensor(t) for t in compressed):
+        _check_differentiable_op(op, "grouped_allreduce")
+        outs = _fns().grouped_allreduce.apply(
+            average, name, op, prescale_factor, postscale_factor,
+            *compressed)
+    else:
+        outs = api.grouped_allreduce(list(compressed), average, name, op,
+                                     prescale_factor, postscale_factor)
     return [compression.decompress(o, c) for o, c in zip(outs, ctxs)]
 
 
@@ -136,3 +294,51 @@ def broadcast_async_(tensor, root_rank: int,
 
 def broadcast_(tensor, root_rank: int, name: Optional[str] = None):
     return synchronize(broadcast_async_(tensor, root_rank, name))
+
+
+# -- differentiable out-of-place forms --------------------------------------
+
+def allgather(tensor, name: Optional[str] = None):
+    """Row-concatenation over ranks (reference ``torch/mpi_ops.py:590``).
+    Differentiable: the backward averaged-allreduces the cotangent and
+    returns this rank's row slice."""
+    if _is_grad_tensor(tensor):
+        return _fns().allgather.apply(tensor, name)
+    return api.allgather(tensor, name)
+
+
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
+    """Out-of-place broadcast (reference ``torch/mpi_ops.py:670``).
+    Differentiable: cotangents flow to the root (averaged allreduce,
+    zeroed elsewhere)."""
+    if _is_grad_tensor(tensor):
+        return _fns().broadcast.apply(tensor, root_rank, name)
+    return api.broadcast(tensor, root_rank, name)
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None):
+    """Block exchange over ranks; returns ``(output, received_splits)``
+    (reference ``torch/mpi_ops.py:811``). Differentiable: the backward
+    alltoalls the cotangent with send splits = the forward's receive
+    splits."""
+    if _is_grad_tensor(tensor):
+        out, rs = _fns().alltoall.apply(tensor, splits, name)
+        return out, rs
+    out, rs = api.alltoall(tensor, splits, name)
+    import torch
+    return out, torch.as_tensor(list(rs), dtype=torch.int32)
+
+
+def reducescatter(tensor, op: Optional[ReduceOp] = None,
+                  name: Optional[str] = None, prescale_factor: float = 1.0,
+                  postscale_factor: float = 1.0):
+    """Reduce + scatter of row segments. Differentiable: the backward
+    allgathers the segment cotangents (scaled to match the forward's
+    averaging). The reference torch tier has no reducescatter; parity
+    target is its TF tier plus the autograd contract of the other ops."""
+    if _is_grad_tensor(tensor):
+        _check_differentiable_op(op, "reducescatter")
+        return _fns().reducescatter.apply(tensor, op, name,
+                                          prescale_factor, postscale_factor)
+    return api.reducescatter(tensor, op, name, prescale_factor,
+                             postscale_factor)

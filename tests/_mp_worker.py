@@ -401,6 +401,104 @@ def main():
             sbn(mine).detach().numpy(),
             ref(full)[r * 4:(r + 1) * 4].detach().numpy(), rtol=1e-9)
 
+    elif scenario == "torch_grads":
+        # Differentiable collectives: each op's backward must match the
+        # reference's autograd contract (torch/mpi_ops.py:186,393,578,
+        # 663,806) — checked analytically per rank.
+        import torch
+        import horovod_tpu.torch as thvd
+
+        # allreduce(Sum): dx = allreduce_sum(cotangent)
+        x = torch.zeros(4, dtype=torch.float64).requires_grad_(True)
+        y = thvd.allreduce(x, op=hvd.Sum, name="g.ar")
+        (y * float(r + 1)).sum().backward()
+        want = sum(range(1, s + 1))
+        np.testing.assert_allclose(x.grad.numpy(), np.full(4, want))
+
+        # allreduce(Average): dx = avg(cotangent)
+        x = torch.zeros(4, dtype=torch.float64).requires_grad_(True)
+        y = thvd.allreduce(x, op=hvd.Average, name="g.aravg")
+        (y * float(r + 1)).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   np.full(4, (s + 1) / 2.0))
+
+        # grouped allreduce: per-tensor gradients, one fused backward
+        xs = [torch.zeros(3, dtype=torch.float64).requires_grad_(True)
+              for _ in range(2)]
+        ys = thvd.grouped_allreduce(xs, op=hvd.Sum, name="g.gar")
+        (ys[0] * float(r + 1) + ys[1] * 2.0 * float(r + 1)).sum().backward()
+        np.testing.assert_allclose(xs[0].grad.numpy(), np.full(3, want))
+        np.testing.assert_allclose(xs[1].grad.numpy(), np.full(3, 2 * want))
+
+        # allgather with UNEVEN rows: dx = avg-allreduced cotangent,
+        # narrowed to this rank's row span (offset bookkeeping).
+        rows = r + 1
+        total = s * (s + 1) // 2
+        x = torch.zeros(rows, 2, dtype=torch.float64).requires_grad_(True)
+        y = thvd.allgather(x, name="g.ag")
+        assert y.shape == (total, 2), y.shape
+        W = torch.arange(total * 2, dtype=torch.float64).reshape(total, 2)
+        (y * W).sum().backward()
+        offset = r * (r + 1) // 2
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   W[offset:offset + rows].numpy())
+
+        # broadcast: cotangents flow to the root only (averaged)
+        root = s - 1
+        x = torch.full((3,), float(r), dtype=torch.float64,
+                       requires_grad=True)
+        y = thvd.broadcast(x, root_rank=root, name="g.bc")
+        np.testing.assert_allclose(y.detach().numpy(), np.full(3, root))
+        (y * float(r + 1)).sum().backward()
+        exp = np.full(3, (s + 1) / 2.0) if r == root else np.zeros(3)
+        np.testing.assert_allclose(x.grad.numpy(), exp)
+
+        # alltoall: backward routes each block back to its sender
+        x = torch.zeros(2 * s, dtype=torch.float64).requires_grad_(True)
+        y, rs = thvd.alltoall(x, name="g.a2a")
+        assert rs.tolist() == [2] * s
+        (y * float(r + 1)).sum().backward()
+        np.testing.assert_allclose(
+            x.grad.numpy(),
+            np.repeat(np.arange(1, s + 1, dtype=np.float64), 2))
+
+        # reducescatter(Sum): dx = allgather of segment cotangents
+        x = torch.zeros(2 * s, 3, dtype=torch.float64).requires_grad_(True)
+        y = thvd.reducescatter(x, op=hvd.Sum, name="g.rs")
+        assert y.shape == (2, 3)
+        (y * float(r + 1)).sum().backward()
+        np.testing.assert_allclose(
+            x.grad.numpy(),
+            np.repeat(np.arange(1, s + 1, dtype=np.float64), 2)[:, None]
+            * np.ones((1, 3)))
+
+        # reducescatter(Average): forward averages, backward scales
+        x = torch.zeros(2 * s, 3, dtype=torch.float64).requires_grad_(True)
+        y = thvd.reducescatter(x, op=hvd.Average, name="g.rsa")
+        (y * float(r + 1)).sum().backward()
+        np.testing.assert_allclose(
+            x.grad.numpy(),
+            np.repeat(np.arange(1, s + 1, dtype=np.float64), 2)[:, None]
+            * np.ones((1, 3)) / s)
+
+        # nonlinear reductions must refuse the grad path, not emit a
+        # silently-wrong dense gradient
+        x = torch.zeros(3, dtype=torch.float64).requires_grad_(True)
+        try:
+            thvd.allreduce(x, op=hvd.Max, name="g.max")
+            raise SystemExit("Max allreduce of a grad tensor must raise")
+        except NotImplementedError:
+            pass
+        thvd.allreduce(x.detach(), op=hvd.Max, name="g.maxd")  # ok
+
+        # a collective INSIDE a module backprops through to parameters
+        lin = torch.nn.Linear(4, 4).double()
+        inp = torch.randn(2, 4, dtype=torch.float64)
+        out = thvd.allreduce(lin(inp), op=hvd.Average, name="g.mod")
+        out.sum().backward()
+        assert lin.weight.grad is not None
+        assert float(lin.weight.grad.abs().sum()) > 0
+
     elif scenario == "callbacks":
         from horovod_tpu.callbacks import (MetricAverageCallback,
                                            average_metrics)

@@ -155,3 +155,13 @@ def test_shm_peer_death_surfaces_fast():
                    expected_rc={np_ - 1: 17})  # the deliberate hard exit
     for r in range(np_ - 1):
         assert f"OK rank={r}" in outs[r], f"rank {r}: {outs[r]}"
+
+
+@pytest.mark.parametrize("np_", [2, 4])
+def test_torch_differentiable_collectives(np_):
+    """Gradients through allreduce/grouped/allgather/broadcast/alltoall/
+    reducescatter match the reference autograd contract
+    (``torch/mpi_ops.py:186,393,578,663,806``)."""
+    outs = run_job("torch_grads", np_, timeout=180)
+    for r, out in enumerate(outs):
+        assert f"OK rank={r}" in out
