@@ -5,6 +5,9 @@ from horovod_tpu.spark.elastic import (  # noqa: F401
     SparkHostDiscovery, run_elastic,
 )
 from horovod_tpu.spark.estimator import (  # noqa: F401
+    FsspecStore,
+    JaxEstimator,
+    JaxModel,
     Store,
     TorchEstimator,
     TorchModel,

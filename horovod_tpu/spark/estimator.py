@@ -1,44 +1,91 @@
-"""Spark ML estimator for torch models.
+"""Spark ML estimators: torch and JAX.
 
-Compact rebuild of the reference ``TorchEstimator``
-(``horovod/spark/torch/estimator.py:91``): fit() materializes the
-DataFrame through a :class:`Store`, trains the model across Spark
-executors with :func:`horovod_tpu.spark.run` + ``DistributedOptimizer``
-(each rank reads its own shard), and returns a :class:`TorchModel`
-transformer for inference. The reference's Petastorm streaming reader
-and HDFS/S3 store drivers are out of scope — :class:`Store` is the
-pluggable seam where they would go (local-filesystem driver included).
+Rebuild of the reference estimator pair (``spark/torch/estimator.py:91``
+and ``spark/keras/estimator.py`` — a JAX/optax estimator is the honest
+TPU analog of the Keras one): ``fit(df)`` stages the DataFrame as
+per-partition shards written BY THE EXECUTORS through a pluggable
+:class:`~horovod_tpu.spark.store.Store` (``mapPartitionsWithIndex`` —
+only per-partition row counts travel to the driver), trains across
+Spark executors with :func:`horovod_tpu.spark.run` (each rank reads its
+assigned partitions from the store), and returns a model transformer
+for inference.
+
+The reference's Petastorm streaming reader is replaced by whole-shard
+reads (shards are partition-sized); its parquet staging by pickled
+float32 arrays — the Store seam (local FS / fsspec s3-gs-hdfs) is where
+a columnar format would slot in.
 """
 
 from __future__ import annotations
 
-import os
 import pickle
-from typing import Any, Callable, List, Optional
+from typing import Callable, List
+
+from horovod_tpu.spark.store import FsspecStore, Store, assign_partitions
+
+__all__ = ["Store", "FsspecStore", "TorchEstimator", "TorchModel",
+           "JaxEstimator", "JaxModel"]
 
 
-class Store:
-    """Shared-filesystem staging area for train shards + checkpoints
-    (reference ``spark/common/store.py``; this driver = LocalStore).
-    The path must be reachable from every executor (NFS etc.)."""
+def _stage_dataframe(df, cols: List[str], store: Store, num_proc: int):
+    """Executor-side staging: every partition writes its rows as a
+    float32 array shard into the store; only ``(partition, row_count)``
+    pairs come back to the driver. Returns the per-rank partition
+    assignment and the padded per-rank row target."""
+    n_cols = len(cols)
 
-    def __init__(self, prefix_path: str):
-        self.prefix_path = prefix_path
-        os.makedirs(prefix_path, exist_ok=True)
+    def stage(pid, rows_iter):
+        import numpy as np
+        rows = [[float(row[c]) for c in cols] for row in rows_iter]
+        arr = (np.asarray(rows, dtype=np.float32) if rows
+               else np.zeros((0, n_cols), dtype=np.float32))
+        if len(arr):
+            store.write_shard(f"part.{pid}", arr)
+        yield (pid, len(arr))
 
-    def shard_path(self, idx: int) -> str:
-        return os.path.join(self.prefix_path, f"shard.{idx}.pkl")
+    counts = dict(df.select(*cols).rdd
+                  .mapPartitionsWithIndex(stage).collect())
+    return assign_partitions(counts, num_proc)
 
-    def write_shard(self, idx: int, rows: Any) -> None:
-        with open(self.shard_path(idx), "wb") as f:
-            pickle.dump(rows, f)
 
-    def read_shard(self, idx: int) -> Any:
-        with open(self.shard_path(idx), "rb") as f:
-            return pickle.load(f)
+def _read_rank_rows(store: Store, parts: List[int], target: int):
+    """Worker side: concatenate this rank's staged partitions and wrap-
+    pad to ``target`` rows, so every rank runs the same number of
+    optimizer steps (the reference gets the equal-length property from
+    Petastorm's epoch semantics)."""
+    import numpy as np
+    arrs = [store.read_shard(f"part.{p}") for p in parts]
+    rows = np.concatenate(arrs, axis=0)
+    if len(rows) == target:
+        return rows
+    idx = np.arange(target) % len(rows)
+    return rows[idx]
 
-    def model_path(self) -> str:
-        return os.path.join(self.prefix_path, "model.pt")
+
+def _transform_df(df, predict_one: Callable, feature_cols: List[str],
+                  label_cols: List[str]):
+    """Shared transform body for both model classes: append
+    ``<label>__output`` prediction columns partition by partition.
+    ``predict_one(feats [1, n_feat] float32) -> [n_labels]`` must be
+    picklable into Spark tasks (cloudpickle carries the closures)."""
+    import cloudpickle
+    predict_pkl = cloudpickle.dumps(predict_one)
+
+    def map_partition(rows):
+        import cloudpickle as cp
+        import numpy as np
+        predict = cp.loads(predict_pkl)
+        for row in rows:
+            feats = np.asarray([[float(row[c]) for c in feature_cols]],
+                               np.float32)
+            pred = predict(feats)
+            out = row.asDict()
+            for i, c in enumerate(label_cols):
+                out[f"{c}__output"] = float(pred[i])
+            yield out
+
+    spark = df.sparkSession
+    return spark.createDataFrame(df.rdd.mapPartitions(map_partition))
 
 
 class TorchEstimator:
@@ -67,26 +114,11 @@ class TorchEstimator:
         self.compression = compression
 
     def fit(self, df) -> "TorchModel":
-        import numpy as np
-
         from horovod_tpu.spark.runner import run as spark_run
 
-        # Stage the dataset: one shard per rank, rank order = partition
-        # order (reference writes train/val parquet via the Store).
-        # Shards are padded to EQUAL length by wrapping — every rank
-        # must run the same number of optimizer steps or the gradient
-        # allreduces desynchronize and the job hangs (the reference
-        # gets the same property from Petastorm's equal-length epochs).
         cols = self.feature_cols + self.label_cols
-        rows = np.asarray([[float(row[c]) for c in cols]
-                           for row in df.select(*cols).collect()],
-                          dtype=np.float32)
-        if len(rows) == 0:
-            raise ValueError("fit() got an empty DataFrame")
-        per_rank = -(-len(rows) // self.num_proc)  # ceil
-        for i in range(self.num_proc):
-            idx = np.arange(i * per_rank, (i + 1) * per_rank) % len(rows)
-            self.store.write_shard(i, rows[idx])
+        assigned, target = _stage_dataframe(df, cols, self.store,
+                                            self.num_proc)
 
         n_feat = len(self.feature_cols)
         payload = pickle.dumps(self.model)
@@ -101,7 +133,7 @@ class TorchEstimator:
 
             hvd.init()
             model = pickle.loads(payload)
-            data = store.read_shard(hvd.rank())
+            data = _read_rank_rows(store, assigned[hvd.rank()], target)
             x = torch.as_tensor(data[:, :n_feat])
             y = torch.as_tensor(data[:, n_feat:])
             opt = opt_factory(model.parameters())
@@ -120,7 +152,8 @@ class TorchEstimator:
                     opt.step()
             state = None
             if hvd.rank() == 0:
-                torch.save(model.state_dict(), store.model_path())
+                with store.open(store.model_key(), "wb") as f:
+                    torch.save(model.state_dict(), f)
                 state = {k: v.numpy() for k, v in model.state_dict().items()}
             hvd.shutdown()
             return state
@@ -159,26 +192,143 @@ class TorchModel:
                 torch.as_tensor(features, dtype=torch.float32)).numpy()
 
     def transform(self, df):
-        n_feat = len(self.feature_cols)
         state, model_pkl = self.state, pickle.dumps(self.model)
-        feature_cols, label_cols = self.feature_cols, self.label_cols
 
-        def map_partition(rows):
-            import numpy as np
+        def predict_one(feats):
             import torch
             m = pickle.loads(model_pkl)
             m.load_state_dict({k: torch.as_tensor(v)
                                for k, v in state.items()})
             m.eval()
-            for row in rows:
-                feats = np.asarray([[float(row[c]) for c in feature_cols]],
-                                   np.float32)
-                with torch.no_grad():
-                    pred = m(torch.as_tensor(feats)).numpy()[0]
-                out = row.asDict()
-                for i, c in enumerate(label_cols):
-                    out[f"{c}__output"] = float(pred[i])
-                yield out
+            with torch.no_grad():
+                return m(torch.as_tensor(feats)).numpy()[0]
 
-        spark = df.sparkSession
-        return spark.createDataFrame(df.rdd.mapPartitions(map_partition))
+        return _transform_df(df, predict_one, self.feature_cols,
+                             self.label_cols)
+
+
+class JaxEstimator:
+    """Spark-ML-style estimator for functional JAX models — the second
+    estimator (the reference ships Keras alongside torch,
+    ``spark/keras/estimator.py``; on TPU the JAX/optax pair is the
+    product surface).
+
+    ``init_fn(rng) -> params`` builds the parameter pytree;
+    ``apply_fn(params, x) -> pred`` is the forward; ``loss(pred, y) ->
+    scalar`` in JAX ops. ``optimizer`` is an optax
+    ``GradientTransformation`` (default ``adam(1e-2)``); gradients are
+    averaged across ranks through the eager grouped-allreduce tier
+    (:func:`horovod_tpu.jax.distributed_optimizer`).
+    """
+
+    def __init__(self, *, init_fn: Callable, apply_fn: Callable,
+                 loss: Callable, feature_cols: List[str],
+                 label_cols: List[str], store: Store, num_proc: int = 2,
+                 epochs: int = 1, batch_size: int = 32, optimizer=None,
+                 seed: int = 0):
+        self.init_fn = init_fn
+        self.apply_fn = apply_fn
+        self.loss = loss
+        self.feature_cols = feature_cols
+        self.label_cols = label_cols
+        self.store = store
+        self.num_proc = num_proc
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.optimizer = optimizer
+        self.seed = seed
+
+    def fit(self, df) -> "JaxModel":
+        import cloudpickle
+
+        from horovod_tpu.spark.runner import run as spark_run
+
+        cols = self.feature_cols + self.label_cols
+        assigned, target = _stage_dataframe(df, cols, self.store,
+                                            self.num_proc)
+
+        n_feat = len(self.feature_cols)
+        payload = cloudpickle.dumps(
+            (self.init_fn, self.apply_fn, self.loss, self.optimizer))
+        store, epochs, bs = self.store, self.epochs, self.batch_size
+        seed = self.seed
+
+        def train_fn():
+            import jax
+            import jax.numpy as jnp
+            import optax
+
+            import horovod_tpu.jax as hvd
+
+            hvd.init()
+            init_fn, apply_fn, loss_fn, optimizer = (
+                cloudpickle.loads(payload))
+            if optimizer is None:
+                optimizer = optax.adam(1e-2)
+            data = _read_rank_rows(store, assigned[hvd.rank()], target)
+            x = jnp.asarray(data[:, :n_feat])
+            y = jnp.asarray(data[:, n_feat:])
+
+            params = init_fn(jax.random.PRNGKey(seed))
+            params = hvd.broadcast_parameters(params)
+            opt = hvd.distributed_optimizer(optimizer)
+            opt_state = opt.init(params)
+
+            # Local step is jitted; the cross-rank reduction runs in
+            # the eager grouped-allreduce tier between steps (one
+            # process per rank, the Horovod model).
+            grad_fn = jax.jit(jax.value_and_grad(
+                lambda p, xb, yb: loss_fn(apply_fn(p, xb), yb)))
+
+            for _ in range(epochs):
+                for off in range(0, max(len(x), 1), bs):
+                    xb, yb = x[off:off + bs], y[off:off + bs]
+                    if not len(xb):
+                        continue
+                    _, grads = grad_fn(params, xb, yb)
+                    updates, opt_state = opt.update(grads, opt_state,
+                                                    params)
+                    params = optax.apply_updates(params, updates)
+
+            state = None
+            if hvd.rank() == 0:
+                import numpy as np
+                state = jax.tree.map(np.asarray, params)
+                with store.open(store.model_key(), "wb") as f:
+                    pickle.dump(state, f)
+            hvd.shutdown()
+            return state
+
+        results = spark_run(train_fn, num_proc=self.num_proc)
+        params = next(r for r in results if r is not None)
+        return JaxModel(apply_fn=self.apply_fn, params=params,
+                        feature_cols=self.feature_cols,
+                        label_cols=self.label_cols)
+
+
+class JaxModel:
+    """Transformer returned by :meth:`JaxEstimator.fit`."""
+
+    def __init__(self, *, apply_fn, params, feature_cols, label_cols):
+        self.apply_fn = apply_fn
+        self.params = params
+        self.feature_cols = feature_cols
+        self.label_cols = label_cols
+
+    def predict(self, features):
+        import jax.numpy as jnp
+        import numpy as np
+        return np.asarray(self.apply_fn(self.params,
+                                        jnp.asarray(features,
+                                                    jnp.float32)))
+
+    def transform(self, df):
+        params, apply_fn = self.params, self.apply_fn
+
+        def predict_one(feats):
+            import jax.numpy as jnp
+            import numpy as np
+            return np.asarray(apply_fn(params, jnp.asarray(feats)))[0]
+
+        return _transform_df(df, predict_one, self.feature_cols,
+                             self.label_cols)

@@ -1,0 +1,163 @@
+"""Pluggable staging stores for the Spark estimators.
+
+Rebuild of the reference's ``Store`` seam (``spark/common/store.py`` —
+``Store.create`` picks LocalStore vs HDFSStore by URL): the estimators
+stage training shards *from the executors* through a store, and the
+trained model flows back the same way, so the driver never materializes
+the dataset.
+
+Two drivers:
+
+* :class:`Store` — shared-filesystem (NFS etc.; reference LocalStore).
+* :class:`FsspecStore` — any fsspec URL (``s3://``, ``gs://``,
+  ``hdfs://``, ``memory://``, ...); the fsspec filesystem is created
+  lazily per process so the store object pickles cleanly into Spark
+  tasks (the reference ships its HDFSStore the same way).
+
+``Store.create(path)`` dispatches by URL scheme like the reference.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, List
+
+
+class Store:
+    """Shared-filesystem staging area (base class + local driver).
+
+    Keys are slash-separated relative paths under ``prefix_path``; the
+    primitives (:meth:`open`, :meth:`exists`) are what subclasses
+    override — the array/shard helpers build on them.
+    """
+
+    def __init__(self, prefix_path: str):
+        self.prefix_path = prefix_path
+        os.makedirs(prefix_path, exist_ok=True)
+
+    @staticmethod
+    def create(path: str) -> "Store":
+        """Pick a driver by URL: plain paths -> local filesystem,
+        ``scheme://`` URLs -> fsspec (reference ``store.py``
+        ``Store.create``)."""
+        if "://" in path and not path.startswith("file://"):
+            return FsspecStore(path)
+        return Store(path.removeprefix("file://"))
+
+    # -- primitives --------------------------------------------------------
+
+    def path(self, key: str) -> str:
+        return os.path.join(self.prefix_path, key)
+
+    def open(self, key: str, mode: str = "rb"):
+        p = self.path(key)
+        if "w" in mode or "a" in mode:
+            os.makedirs(os.path.dirname(p) or ".", exist_ok=True)
+        return open(p, mode)
+
+    def exists(self, key: str) -> bool:
+        return os.path.exists(self.path(key))
+
+    # -- staging helpers (shared by all drivers) ---------------------------
+
+    def write_array(self, key: str, arr: Any) -> None:
+        with self.open(key, "wb") as f:
+            pickle.dump(arr, f)
+
+    def read_array(self, key: str) -> Any:
+        with self.open(key, "rb") as f:
+            return pickle.load(f)
+
+    def shard_key(self, idx) -> str:
+        return f"shard.{idx}.pkl"
+
+    def write_shard(self, idx, rows: Any) -> None:
+        self.write_array(self.shard_key(idx), rows)
+
+    def read_shard(self, idx) -> Any:
+        return self.read_array(self.shard_key(idx))
+
+    def model_key(self) -> str:
+        return "model.pt"
+
+    # Kept for callers that want a real filesystem path (local driver
+    # only; FsspecStore raises — use open(model_key()) instead).
+    def model_path(self) -> str:
+        return self.path(self.model_key())
+
+
+class FsspecStore(Store):
+    """fsspec-backed store for object stores and remote filesystems
+    (``s3://bucket/run1``, ``gs://...``, ``hdfs://...``; the reference's
+    HDFSStore, generalized). The filesystem handle is created lazily in
+    each process, so instances pickle into Spark tasks."""
+
+    def __init__(self, url: str):
+        try:
+            import fsspec  # noqa: F401
+        except ImportError as e:  # pragma: no cover - fsspec is baked in
+            raise RuntimeError(
+                f"FsspecStore({url!r}) requires fsspec") from e
+        self.url = url.rstrip("/")
+        self._fs = None
+        self._root = None
+
+    def __getstate__(self):
+        return {"url": self.url}
+
+    def __setstate__(self, state):
+        self.url = state["url"]
+        self._fs = None
+        self._root = None
+
+    @property
+    def fs(self):
+        if self._fs is None:
+            import fsspec
+            self._fs, self._root = fsspec.core.url_to_fs(self.url)
+        return self._fs
+
+    def path(self, key: str) -> str:
+        self.fs  # resolve _root
+        return f"{self._root}/{key}"
+
+    def open(self, key: str, mode: str = "rb"):
+        if "w" in mode or "a" in mode:
+            parent = self.path(key).rsplit("/", 1)[0]
+            try:
+                self.fs.makedirs(parent, exist_ok=True)
+            except Exception:
+                pass  # object stores have no directories
+        return self.fs.open(self.path(key), mode)
+
+    def exists(self, key: str) -> bool:
+        return self.fs.exists(self.path(key))
+
+    def model_path(self) -> str:
+        raise NotImplementedError(
+            "FsspecStore has no local filesystem path; use "
+            "store.open(store.model_key()) instead")
+
+
+def assign_partitions(counts, num_proc: int):
+    """Partition->rank assignment for training: partitions go to ranks
+    round-robin; a rank whose share is empty re-reads the largest
+    partition instead (every rank must hold data — collective training
+    steps are lockstep). Returns ``(per_rank_partition_lists,
+    target_rows)`` where ``target_rows`` is the row count every rank
+    pads (by wrapping) up to, so all ranks run the same number of
+    optimizer steps.
+    """
+    parts = sorted(counts)
+    if not parts or all(counts[p] == 0 for p in parts):
+        raise ValueError("fit() got an empty DataFrame")
+    assigned: List[List[int]] = [
+        [p for p in parts if p % num_proc == r and counts[p] > 0]
+        for r in range(num_proc)]
+    donor = max(parts, key=lambda p: counts[p])
+    for r in range(num_proc):
+        if not assigned[r]:
+            assigned[r] = [donor]
+    target = max(sum(counts[p] for p in a) for a in assigned)
+    return assigned, target

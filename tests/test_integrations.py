@@ -267,22 +267,54 @@ def test_spark_run_propagates_failures(fake_pyspark):
         run(boom, num_proc=2, spark_context=fake_pyspark())
 
 
+class _FakeStagedRDD:
+    """Result of mapPartitionsWithIndex: collect() runs the staging fn
+    per partition and returns only what it yields (the counts)."""
+
+    def __init__(self, chunks, fn):
+        self.chunks, self.fn = chunks, fn
+
+    def collect(self):
+        out = []
+        for pid, chunk in enumerate(self.chunks):
+            out.extend(self.fn(pid, iter(chunk)))
+        return out
+
+
+class _FakeRDDSurface:
+    def __init__(self, chunks):
+        self.chunks = chunks
+
+    def mapPartitionsWithIndex(self, fn):
+        return _FakeStagedRDD(self.chunks, fn)
+
+
+class _FakePartitionedDF:
+    """y = 2x linear data split over n partitions. Deliberately exposes
+    NO row-level collect(): fit() must stage through the executor-side
+    mapPartitionsWithIndex path, never materialize rows on the driver
+    (the round-3 verdict's estimator.py:81-83 finding)."""
+
+    def __init__(self, n_rows=64, n_parts=4):
+        rng = np.random.RandomState(0)
+        xs = rng.randn(n_rows).astype(np.float32)
+        rows = [_FakeRow({"x": float(v), "y": float(2.0 * v)})
+                for v in xs]
+        per = -(-len(rows) // n_parts)
+        self.chunks = [rows[i * per:(i + 1) * per] for i in range(n_parts)]
+
+    def select(self, *cols):
+        return self
+
+    @property
+    def rdd(self):
+        return _FakeRDDSurface(self.chunks)
+
+
 def test_torch_estimator_fit_predict(fake_pyspark, tmp_path):
     import torch
 
     from horovod_tpu.spark import Store, TorchEstimator
-
-    class FakeDF:
-        """y = 2x linear data with the select/collect surface fit uses."""
-
-        def select(self, *cols):
-            return self
-
-        def collect(self):
-            rng = np.random.RandomState(0)
-            xs = rng.randn(64).astype(np.float32)
-            return [_FakeRow({"x": float(v), "y": float(2.0 * v)})
-                    for v in xs]
 
     est = TorchEstimator(
         model=torch.nn.Linear(1, 1),
@@ -291,7 +323,7 @@ def test_torch_estimator_fit_predict(fake_pyspark, tmp_path):
         feature_cols=["x"], label_cols=["y"],
         store=Store(str(tmp_path)), num_proc=1, epochs=40, batch_size=16)
     try:
-        model = est.fit(FakeDF())
+        model = est.fit(_FakePartitionedDF())
     finally:
         # train_fn shut the in-process runtime down; restore for
         # whatever test runs next.
@@ -299,6 +331,67 @@ def test_torch_estimator_fit_predict(fake_pyspark, tmp_path):
         hvd.init()
     pred = model.predict(np.asarray([[1.0], [2.0]], np.float32))
     np.testing.assert_allclose(pred[:, 0], [2.0, 4.0], atol=0.2)
+    # shards were staged per partition by the "executors"
+    import os
+    assert os.path.exists(os.path.join(str(tmp_path), "shard.part.0.pkl"))
+
+
+def test_jax_estimator_fit_predict_fsspec_store(fake_pyspark):
+    """The second estimator (JAX/optax) end to end, through the fsspec
+    store driver (memory:// filesystem — in-process like the fake
+    barrier executors)."""
+    import uuid
+
+    from horovod_tpu.spark import FsspecStore, JaxEstimator, Store
+
+    store = Store.create(f"memory://jaxest-{uuid.uuid4().hex}")
+    assert isinstance(store, FsspecStore)
+    # survives the pickle into spark tasks
+    import pickle as pkl
+    assert pkl.loads(pkl.dumps(store)).url == store.url
+
+    def init_fn(rng):
+        import jax
+        k1, k2 = jax.random.split(rng)
+        return {"w": jax.random.normal(k1, (1, 1)) * 0.1,
+                "b": jax.random.normal(k2, (1,)) * 0.1}
+
+    def apply_fn(params, x):
+        return x @ params["w"] + params["b"]
+
+    def loss(pred, y):
+        return ((pred - y) ** 2).mean()
+
+    import optax
+    est = JaxEstimator(
+        init_fn=init_fn, apply_fn=apply_fn, loss=loss,
+        feature_cols=["x"], label_cols=["y"], store=store,
+        num_proc=1, epochs=60, batch_size=16, optimizer=optax.adam(0.05))
+    try:
+        model = est.fit(_FakePartitionedDF())
+    finally:
+        import horovod_tpu as hvd
+        hvd.init()
+    pred = model.predict(np.asarray([[1.0], [2.0]], np.float32))
+    np.testing.assert_allclose(pred[:, 0], [2.0, 4.0], atol=0.2)
+
+
+def test_assign_partitions_lockstep():
+    from horovod_tpu.spark.store import assign_partitions
+
+    # round-robin, target = max rank load
+    assigned, target = assign_partitions({0: 10, 1: 7, 2: 5, 3: 8}, 2)
+    assert assigned == [[0, 2], [1, 3]]
+    assert target == 15
+    # a rank with no partitions borrows the largest one
+    assigned, target = assign_partitions({0: 9}, 2)
+    assert assigned == [[0], [0]]
+    assert target == 9
+    # empty partitions are skipped; all-empty raises
+    assigned, _ = assign_partitions({0: 4, 1: 0}, 2)
+    assert assigned[0] == [0] and assigned[1] == [0]
+    with pytest.raises(ValueError, match="empty"):
+        assign_partitions({0: 0}, 1)
 
 
 # ---------------------------------------------------------------------------
