@@ -14,6 +14,7 @@ free port and publishes it through the KV store
 from __future__ import annotations
 
 import argparse
+import contextlib
 import dataclasses
 import os
 import shlex
@@ -124,6 +125,26 @@ def _spawn_worker(slot: hosts_mod.SlotInfo, env: Dict[str, str],
     return WorkerProcess(slot.rank, args, env, prefix=prefix)
 
 
+@contextlib.contextmanager
+def kv_scope(all_local: bool, kv_server: Optional[KVServer] = None):
+    """Launcher KV-server lifecycle shared by the static and mpirun
+    launchers: a caller-provided server is used as-is (the caller owns
+    it, e.g. ``run()`` collecting results); otherwise one is started
+    here and stopped on exit. Loopback-only unless the job actually
+    spans hosts — the exec scope carries pickles that workers execute,
+    so keep it off the network for all-local jobs."""
+    own = kv_server is None
+    server = kv_server or KVServer(
+        host="127.0.0.1" if all_local else "0.0.0.0")
+    if own:
+        server.start()
+    try:
+        yield server
+    finally:
+        if own:
+            server.stop()
+
+
 def launch_static(settings: LaunchSettings,
                   kv_server: Optional[KVServer] = None) -> Dict[int, int]:
     """Run the job; returns {rank: exit_code}. Caller owns a passed-in
@@ -133,14 +154,7 @@ def launch_static(settings: LaunchSettings,
     slots = hosts_mod.get_host_assignments(host_list, settings.np)
 
     all_local = all(is_local_host(s.hostname) for s in slots)
-    own_server = kv_server is None
-    # Loopback-only unless the job actually spans hosts (the exec scope
-    # carries pickles that workers execute — keep it off the network).
-    server = kv_server or KVServer(
-        host="127.0.0.1" if all_local else "0.0.0.0")
-    if own_server:
-        server.start()
-    try:
+    with kv_scope(all_local, kv_server) as server:
         launcher_host = "127.0.0.1" if all_local else socket.getfqdn()
         kv_addr = f"{launcher_host}:{server.port}"
         # The host every worker dials to reach rank 0's controller. In a
@@ -177,9 +191,6 @@ def launch_static(settings: LaunchSettings,
                 w.terminate()
             raise
         return wait_all(workers)
-    finally:
-        if own_server:
-            server.stop()
 
 
 def launch_elastic(settings: LaunchSettings, discovery,
@@ -303,6 +314,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="bring up jax.distributed in every worker so "
                         "device tensors ride the XLA data plane instead "
                         "of host TCP")
+    p.add_argument("--mpi", action="store_true",
+                   help="launch through the cluster's mpirun (OpenMPI/"
+                        "Spectrum/MPICH/Intel autodetected) instead of "
+                        "the built-in ssh launcher; ranks read "
+                        "OMPI_COMM_WORLD_* and rendezvous through the "
+                        "launcher KV as usual")
     p.add_argument("--tpu", action="store_true",
                    help="TPU pod-slice launch: carve each host's chips "
                         "into one single-chip process per slot (libtpu "
@@ -479,6 +496,28 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         start_timeout=args.start_timeout, verbose=args.verbose,
         ssh_port=args.ssh_port, tpu=args.tpu,
         tpu_topology=args.tpu_topology)
+    if args.mpi:
+        if args.discovery_script:
+            print("horovodrun: --mpi is incompatible with elastic mode "
+                  "(mpirun owns a fixed world)", file=sys.stderr)
+            return 2
+        if args.tpu:
+            print("horovodrun: --mpi does not apply the --tpu chip "
+                  "carve (per-slot env needs the built-in launcher); "
+                  "drop one of the flags", file=sys.stderr)
+            return 2
+        from horovod_tpu.runner.mpi_run import launch_mpi
+        try:
+            codes = launch_mpi(settings)
+        except RuntimeError as e:
+            print(f"horovodrun: {e}", file=sys.stderr)
+            return 2
+        rc = codes.get(0, 1)
+        if rc != 0:
+            print(f"horovodrun: mpirun exited with {rc}", file=sys.stderr)
+        # Signal deaths map to the shell convention (raw negatives
+        # would wrap mod 256) — same policy as the static path below.
+        return rc if rc >= 0 else 128 + abs(rc)
     if args.discovery_script:
         from horovod_tpu.runner.elastic_driver import HostDiscoveryScript
         codes = launch_elastic(

@@ -1,0 +1,174 @@
+"""mpirun passthrough launcher (``horovodrun --mpi``).
+
+Rebuild of the reference ``runner/mpi_run.py:60-131``: on MPI-managed
+clusters the cluster's own ``mpirun`` owns process placement; the
+launcher's job shrinks to (1) detecting the implementation
+(``mpirun --version`` → Open MPI / Spectrum / MPICH / Intel), (2)
+composing one mpirun command line with the right per-implementation
+flags and env forwarding (``-x`` for the OMPI family, ``-genvlist``
+for the Hydra family), and (3) running it once — rank identity then
+comes from ``OMPI_COMM_WORLD_*`` / ``PMI_*`` in each worker (the
+topology parser already reads those, ``common/topology.py:55-58``),
+while the rank-INDEPENDENT parts of the env contract (rendezvous KV
+address/token, controller host, timeouts) forward uniformly through
+the MPI environment plumbing.
+"""
+
+from __future__ import annotations
+
+import subprocess
+from typing import Dict, List, Optional, Sequence
+
+#: version-banner marker -> implementation id
+_IMPLS = (
+    ("Open MPI", "openmpi"), ("OpenRTE", "openmpi"),
+    ("IBM Spectrum MPI", "spectrum"), ("Intel(R) MPI", "intel"),
+    ("MPICH", "mpich"), ("HYDRA", "mpich"),
+)
+
+#: env prefixes forwarded to every rank besides explicit settings.env
+#: keys (the reference forwards everything "exportable"; we forward the
+#: framework's own namespaces plus the accelerator runtime's).
+_FORWARD_PREFIXES = ("HOROVOD_", "TPU_", "PALLAS_", "JAX_", "XLA_")
+
+MPI_NOT_FOUND_MSG = (
+    "horovodrun --mpi could not find a working mpirun.\n"
+    "Choose one of:\n"
+    "1. Install Open MPI 4.x / MPICH / Intel MPI so `mpirun --version` "
+    "works.\n"
+    "2. Launch through your cluster's own mpirun/srun/jsrun directly — "
+    "ranks are picked up from OMPI_COMM_WORLD_*.\n"
+    "3. Use the built-in ssh launcher (drop --mpi).")
+
+
+def detect_mpi_implementation(mpirun: str = "mpirun",
+                              env: Optional[Dict[str, str]] = None
+                              ) -> Optional[str]:
+    """Classify the installed MPI by its version banner; None if no
+    usable mpirun (reference ``_get_mpi_implementation``)."""
+    try:
+        res = subprocess.run([mpirun, "--version"], capture_output=True,
+                             text=True, env=env, timeout=15)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if res.returncode != 0:
+        return None
+    text = res.stdout + res.stderr
+    for marker, impl in _IMPLS:
+        if marker in text:
+            return impl
+    return "unknown"
+
+
+def forwarded_env_keys(env: Dict[str, str],
+                       extra_keys: Sequence[str] = ()) -> List[str]:
+    keys = {k for k in env
+            if k.startswith(_FORWARD_PREFIXES) or k in ("PYTHONPATH",)}
+    keys.update(k for k in extra_keys if k in env)
+    return sorted(keys)
+
+
+def build_mpi_command(*, np: int, impl: str, env: Dict[str, str],
+                      command: Sequence[str], hosts: Optional[str] = None,
+                      ssh_port: Optional[int] = None,
+                      extra_keys: Sequence[str] = (),
+                      extra_args: Sequence[str] = (),
+                      mpirun: str = "mpirun") -> List[str]:
+    """One mpirun invocation covering every rank (reference
+    ``mpi_run.py:135-236``, list-argv instead of a shell string)."""
+    keys = forwarded_env_keys(env, extra_keys)
+    cmd: List[str] = [mpirun]
+    if impl in ("openmpi", "spectrum"):
+        cmd += ["--allow-run-as-root", "--tag-output",
+                "-bind-to", "none", "-map-by", "slot"]
+        cmd += ["-np", str(np)]
+        if hosts:
+            cmd += ["-H", hosts]          # host:slots spec passes through
+        if ssh_port:
+            cmd += ["-mca", "plm_rsh_args", f"-p {ssh_port}"]
+        for k in keys:
+            cmd += ["-x", k]
+    elif impl in ("mpich", "intel", "unknown"):
+        # Hydra process manager family: -genvlist forwards by name.
+        cmd += ["-np", str(np)]
+        if hosts:
+            cmd += ["-hosts", ",".join(
+                h.split(":")[0] for h in hosts.split(","))]
+        if ssh_port:
+            if impl == "intel":
+                cmd += ["-bootstrap", "ssh",
+                        "-bootstrap-exec-args", f"-p {ssh_port}"]
+            else:
+                raise ValueError(
+                    f"--ssh-port is not supported for the {impl} "
+                    "launcher; configure the port in ~/.ssh/config or "
+                    "your Hydra launcher settings instead")
+        if keys:
+            cmd += ["-genvlist", ",".join(keys)]
+    else:
+        raise ValueError(f"unknown MPI implementation {impl!r}")
+    cmd += list(extra_args)
+    cmd += list(command)
+    return cmd
+
+
+def launch_mpi(settings, kv_server=None) -> Dict[int, int]:
+    """Run the job under the cluster's mpirun; returns {0: exit_code}
+    (mpirun aggregates rank failures into its own exit status).
+
+    The launcher still owns the rendezvous KV: rank 0 discovers a
+    controller port and publishes it exactly as under the ssh launcher
+    — only process PLACEMENT moves to MPI.
+    """
+    import os
+    import socket
+
+    from horovod_tpu.runner.launch import is_local_host, kv_scope
+    from horovod_tpu.runner.safe_exec import WorkerProcess, wait_all
+
+    impl = detect_mpi_implementation()
+    if impl is None:
+        raise RuntimeError(MPI_NOT_FOUND_MSG)
+
+    host_names = ([h.split(":")[0] for h in settings.hosts.split(",")]
+                  if settings.hosts else ["localhost"])
+    all_local = all(is_local_host(h) for h in host_names)
+    with kv_scope(all_local, kv_server) as server:
+        launcher_host = "127.0.0.1" if all_local else socket.getfqdn()
+        env = dict(os.environ)
+        # The env is UNIFORM across ranks under mpirun — strip every
+        # rank-scoped identity a parent job may have leaked (the per-
+        # slot launcher enforces the same invariant in _slot_env):
+        # topology.py prefers HOROVOD_RANK over OMPI_COMM_WORLD_RANK,
+        # so a forwarded stale rank would alias every process.
+        for k in ("HOROVOD_RANK", "HOROVOD_SIZE", "HOROVOD_LOCAL_RANK",
+                  "HOROVOD_LOCAL_SIZE", "HOROVOD_CROSS_RANK",
+                  "HOROVOD_CROSS_SIZE", "HOROVOD_ELASTIC_ID",
+                  "HOROVOD_ELASTIC_EPOCH", "HOROVOD_CONTROLLER_ADDR"):
+            env.pop(k, None)
+        env.update(settings.env or {})
+        env.update({
+            # Rank-independent contract; ranks come from the MPI env.
+            "HOROVOD_RENDEZVOUS_ADDR": f"{launcher_host}:{server.port}",
+            "HOROVOD_RENDEZVOUS_TOKEN": server.token,
+            "HOROVOD_START_TIMEOUT": str(settings.start_timeout),
+            "HOROVOD_CONTROLLER_TIMEOUT_MS":
+                str(int(settings.start_timeout * 1000)),
+        })
+        if all_local:
+            env["HOROVOD_CONTROLLER_HOST"] = "127.0.0.1"
+        else:
+            # mpirun owns placement — the launcher cannot know which
+            # node gets rank 0. Leave HOROVOD_CONTROLLER_HOST unset so
+            # rank 0 self-advertises its outbound IP (rendezvous.py).
+            env.pop("HOROVOD_CONTROLLER_HOST", None)
+        if env.get("HOROVOD_TIMELINE"):
+            # Per-slot launchers suffix the timeline path per rank; a
+            # uniform env cannot — the runtime does it at init instead.
+            env["HOROVOD_TIMELINE_RANK_SUFFIX"] = "1"
+        cmd = build_mpi_command(
+            np=settings.np, impl=impl, env=env, command=settings.command,
+            hosts=settings.hosts, ssh_port=settings.ssh_port,
+            extra_keys=tuple(settings.env or ()))
+        worker = WorkerProcess(0, cmd, env, prefix="[mpirun]")
+        return wait_all([worker])

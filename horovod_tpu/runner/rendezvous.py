@@ -41,7 +41,13 @@ def discover_controller_addr(rank: int, timeout: float,
     key = f"controller_addr.{epoch}"
     if rank == 0:
         port = free_port()
-        advertise = os.environ.get("HOROVOD_CONTROLLER_HOST", "127.0.0.1")
+        advertise = os.environ.get("HOROVOD_CONTROLLER_HOST")
+        if not advertise:
+            # No launcher-provided name (e.g. --mpi, where placement is
+            # mpirun's and the launcher cannot know rank 0's node):
+            # advertise this host's own outbound IP.
+            from horovod_tpu.runner.hosts import local_ip
+            advertise = local_ip()
         kv_put(rdv, CONTROLLER_SCOPE, key, f"{advertise}:{port}".encode())
         return f"0.0.0.0:{port}"
     return kv_wait(rdv, CONTROLLER_SCOPE, key, timeout).decode()

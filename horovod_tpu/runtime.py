@@ -104,6 +104,14 @@ class Runtime:
             os.environ["HOROVOD_CONTROLLER_ADDR"] = discover_controller_addr(
                 topo.rank, timeout, epoch=self._init_epoch)
             discovered = True
+        if (os.environ.get("HOROVOD_TIMELINE")
+                and os.environ.get("HOROVOD_TIMELINE_RANK_SUFFIX") == "1"):
+            # Uniform-env launchers (--mpi) cannot suffix the timeline
+            # path per slot the way _slot_env does; apply it here, once
+            # (the flag is cleared so an elastic re-init in the same
+            # process does not re-append).
+            os.environ["HOROVOD_TIMELINE"] += f".{topo.rank}"
+            os.environ["HOROVOD_TIMELINE_RANK_SUFFIX"] = "0"
         if topo.size > 1 and os.environ.get("HOROVOD_XLA_EXEC") == "1":
             self._init_jax_distributed(topo)
         self._exec_cb = basics.EXEC_CB_TYPE(self._on_exec)

@@ -331,3 +331,140 @@ def test_horovodrun_tpu_launches_xla_plane(capfd):
     out = capfd.readouterr().out
     for r in range(4):
         assert f"TPU_OK {r}/4" in out
+
+
+# ---------------------------------------------------------------------------
+# mpirun passthrough (--mpi)
+# ---------------------------------------------------------------------------
+
+_STUB_MPIRUN = """#!{python}
+import os, subprocess, sys
+args = sys.argv[1:]
+if "--version" in args:
+    print("mpirun (Open MPI) 4.1.5")
+    sys.exit(0)
+np = None
+cmd = None
+i = 0
+while i < len(args):
+    a = args[i]
+    if a == "-np":
+        np = int(args[i + 1]); i += 2
+    elif a in ("-H", "-mca", "-map-by", "-bind-to", "-x"):
+        i += 2
+    elif a in ("--allow-run-as-root", "--tag-output"):
+        i += 1
+    else:
+        cmd = args[i:]
+        break
+procs = []
+for r in range(np):
+    env = dict(os.environ)
+    env.update({{"OMPI_COMM_WORLD_RANK": str(r),
+                 "OMPI_COMM_WORLD_SIZE": str(np),
+                 "OMPI_COMM_WORLD_LOCAL_RANK": str(r),
+                 "OMPI_COMM_WORLD_LOCAL_SIZE": str(np)}})
+    procs.append(subprocess.Popen(cmd, env=env))
+sys.exit(max(p.wait() for p in procs))
+"""
+
+
+@pytest.fixture()
+def stub_mpirun(tmp_path, monkeypatch):
+    """A fake Open MPI mpirun on PATH: answers --version and spawns -np
+    local ranks with the OMPI_COMM_WORLD_* identity contract."""
+    path = tmp_path / "mpirun"
+    path.write_text(_STUB_MPIRUN.format(python=sys.executable))
+    path.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{tmp_path}{os.pathsep}{os.environ['PATH']}")
+    return str(path)
+
+
+def test_detect_mpi_implementation(stub_mpirun):
+    from horovod_tpu.runner.mpi_run import detect_mpi_implementation
+
+    assert detect_mpi_implementation() == "openmpi"
+    assert detect_mpi_implementation(mpirun="/nonexistent/mpirun") is None
+
+
+def test_build_mpi_command_flags():
+    from horovod_tpu.runner.mpi_run import build_mpi_command
+
+    env = {"HOROVOD_RENDEZVOUS_ADDR": "h:1", "PYTHONPATH": "/x",
+           "TPU_PROCESS_BOUNDS": "2,2,1", "HOME": "/root"}
+    cmd = build_mpi_command(np=4, impl="openmpi", env=env,
+                            command=["python", "t.py"], hosts="h1:2,h2:2",
+                            ssh_port=2222)
+    assert cmd[0] == "mpirun" and cmd[-2:] == ["python", "t.py"]
+    assert "-H" in cmd and cmd[cmd.index("-H") + 1] == "h1:2,h2:2"
+    # HOROVOD_*/TPU_*/PYTHONPATH forwarded via -x; HOME is not
+    xs = [cmd[i + 1] for i, a in enumerate(cmd) if a == "-x"]
+    assert set(xs) == {"HOROVOD_RENDEZVOUS_ADDR", "PYTHONPATH",
+                       "TPU_PROCESS_BOUNDS"}
+    assert cmd[cmd.index("-mca") + 1] == "plm_rsh_args"
+
+    # Hydra family forwards by -genvlist and strips slot counts
+    cmd = build_mpi_command(np=2, impl="mpich", env=env,
+                            command=["python", "t.py"], hosts="h1:2,h2:2")
+    assert cmd[cmd.index("-hosts") + 1] == "h1,h2"
+    gl = cmd[cmd.index("-genvlist") + 1].split(",")
+    assert "HOROVOD_RENDEZVOUS_ADDR" in gl and "HOME" not in gl
+
+
+_MPI_SNIPPET = """
+import os, sys
+sys.path.insert(0, {root!r})
+assert "HOROVOD_RANK" not in os.environ   # identity comes from MPI
+import numpy as np
+import horovod_tpu as hvd
+hvd.init()
+assert hvd.rank() == int(os.environ["OMPI_COMM_WORLD_RANK"])
+assert hvd.size() == int(os.environ["OMPI_COMM_WORLD_SIZE"])
+out = hvd.allreduce(np.full(3, float(hvd.rank() + 1), np.float32),
+                    name="m", op=hvd.Sum)
+assert out[0] == sum(range(1, hvd.size() + 1)), out
+print(f"MPI_OK {{hvd.rank()}}/{{hvd.size()}}", flush=True)
+hvd.shutdown()
+"""
+
+
+def test_horovodrun_mpi_end_to_end(stub_mpirun, capfd):
+    """--mpi end to end: one mpirun invocation, ranks from
+    OMPI_COMM_WORLD_*, controller discovered through the launcher KV."""
+    from horovod_tpu.runner.launch import main
+
+    env_backup = {k: os.environ.pop(k) for k in list(os.environ)
+                  if k.startswith("HOROVOD_")}
+    try:
+        for k, v in _WORKER_ENV.items():
+            os.environ[k] = v
+        rc = main(["--mpi", "-np", "2", "--",
+                   sys.executable, "-c", _MPI_SNIPPET.format(root=ROOT)])
+    finally:
+        for k in list(os.environ):
+            if k.startswith("HOROVOD_"):
+                os.environ.pop(k)
+        os.environ.update(env_backup)
+    assert rc == 0
+    out = capfd.readouterr().out
+    for r in range(2):
+        assert f"MPI_OK {r}/2" in out
+
+
+def test_horovodrun_mpi_rejects_tpu_and_elastic(stub_mpirun, capfd):
+    from horovod_tpu.runner.launch import main
+
+    assert main(["--mpi", "--tpu", "-np", "4", "--", "python", "x.py"]) == 2
+    assert "chip carve" in capfd.readouterr().err
+    assert main(["--mpi", "-np", "2", "--host-discovery-script", "d.sh",
+                 "--", "python", "x.py"]) == 2
+    assert "elastic" in capfd.readouterr().err
+
+
+def test_horovodrun_mpi_missing_mpirun(capfd, monkeypatch, tmp_path):
+    from horovod_tpu.runner.launch import main
+
+    monkeypatch.setenv("PATH", str(tmp_path))  # no mpirun anywhere
+    rc = main(["--mpi", "-np", "2", "--", "python", "x.py"])
+    assert rc == 2
+    assert "could not find a working mpirun" in capfd.readouterr().err
