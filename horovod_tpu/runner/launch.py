@@ -509,7 +509,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from horovod_tpu.runner.mpi_run import launch_mpi
         try:
             codes = launch_mpi(settings)
-        except RuntimeError as e:
+        except (RuntimeError, ValueError) as e:
             print(f"horovodrun: {e}", file=sys.stderr)
             return 2
         rc = codes.get(0, 1)

@@ -123,16 +123,20 @@ def launch_mpi(settings, kv_server=None) -> Dict[int, int]:
     import os
     import socket
 
-    from horovod_tpu.runner.launch import is_local_host, kv_scope
+    from horovod_tpu.runner.launch import (_resolve_hosts, is_local_host,
+                                           kv_scope)
     from horovod_tpu.runner.safe_exec import WorkerProcess, wait_all
 
     impl = detect_mpi_implementation()
     if impl is None:
         raise RuntimeError(MPI_NOT_FOUND_MSG)
 
-    host_names = ([h.split(":")[0] for h in settings.hosts.split(",")]
-                  if settings.hosts else ["localhost"])
-    all_local = all(is_local_host(h) for h in host_names)
+    # Honor -H and --hostfile alike; mpirun gets the host:slots spec in
+    # its -H/-hosts form rebuilt from the resolved list.
+    host_list = _resolve_hosts(settings)
+    hosts_spec = (",".join(f"{h.hostname}:{h.slots}" for h in host_list)
+                  if (settings.hosts or settings.hostfile) else None)
+    all_local = all(is_local_host(h.hostname) for h in host_list)
     with kv_scope(all_local, kv_server) as server:
         launcher_host = "127.0.0.1" if all_local else socket.getfqdn()
         env = dict(os.environ)
@@ -168,7 +172,7 @@ def launch_mpi(settings, kv_server=None) -> Dict[int, int]:
             env["HOROVOD_TIMELINE_RANK_SUFFIX"] = "1"
         cmd = build_mpi_command(
             np=settings.np, impl=impl, env=env, command=settings.command,
-            hosts=settings.hosts, ssh_port=settings.ssh_port,
+            hosts=hosts_spec, ssh_port=settings.ssh_port,
             extra_keys=tuple(settings.env or ()))
         worker = WorkerProcess(0, cmd, env, prefix="[mpirun]")
         return wait_all([worker])

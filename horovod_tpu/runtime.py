@@ -152,7 +152,12 @@ class Runtime:
             timeout = float(os.environ.get("HOROVOD_START_TIMEOUT", "120"))
             key = f"xla_coord_addr.{self._init_epoch}"
             if topo.rank == 0:
-                host = os.environ.get("HOROVOD_CONTROLLER_HOST", "127.0.0.1")
+                host = os.environ.get("HOROVOD_CONTROLLER_HOST")
+                if not host:
+                    # Uniform-env launchers (--mpi) cannot know which
+                    # node gets rank 0; advertise our own outbound IP.
+                    from horovod_tpu.runner.hosts import local_ip
+                    host = local_ip()
                 coord = f"{host}:{free_port()}"
                 kv_put(rdv, "global", key, coord.encode())
             else:

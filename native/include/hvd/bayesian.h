@@ -1,0 +1,72 @@
+// Gaussian-process Bayesian optimization for the autotuner.
+//
+// Rebuild of the reference's optimizer stack
+// (horovod/common/optim/bayesian_optimization.cc +
+// gaussian_process.cc, used by BayesianParameter,
+// parameter_manager.h:186): a GP surrogate with an RBF kernel models
+// score(params); the next sample point maximizes Expected Improvement.
+// Where the reference maximizes EI with L-BFGS restarts, this
+// implementation scores a deterministic cloud of random candidates
+// plus jitters of the incumbent — with 2-3 dims and ~20 samples the
+// argmax is equally good and needs no gradient machinery.
+//
+// Continuous dims live in [0,1]; categorical dims are binary {0,1}
+// coordinates (the kernel treats a flip as a fixed distance, which is
+// exactly the "different category = less correlated" behavior wanted).
+#pragma once
+
+#include <cstdint>
+#include <vector>
+
+namespace hvd {
+
+class GaussianProcess {
+ public:
+  // Fit on row-major X (n x d) and scores y (z-normalized internally).
+  void Fit(const std::vector<std::vector<double>>& X,
+           const std::vector<double>& y);
+  // Posterior mean/variance at x, in the z-normalized score space.
+  void Predict(const std::vector<double>& x, double* mean,
+               double* var) const;
+  double znorm(double y) const { return (y - y_mean_) / y_std_; }
+
+ private:
+  double Kernel(const std::vector<double>& a,
+                const std::vector<double>& b) const;
+
+  std::vector<std::vector<double>> X_;
+  std::vector<double> alpha_;  // K^-1 y  (via Cholesky)
+  std::vector<double> L_;      // lower Cholesky factor, row-major n x n
+  int n_ = 0;
+  double y_mean_ = 0.0, y_std_ = 1.0;
+  double lengthscale_ = 0.25;  // in normalized units
+  double noise_ = 1e-2;        // relative observation noise
+};
+
+class BayesianOptimizer {
+ public:
+  BayesianOptimizer(int n_cont, int n_cat, uint64_t seed = 0x9E3779B9ULL);
+
+  void AddSample(const std::vector<double>& x, double y);
+  // Next point to evaluate: quasi-random during warmup, argmax-EI after.
+  std::vector<double> NextCandidate();
+  // Best observed point (empty before any sample).
+  std::vector<double> Best(double* score) const;
+  int n_samples() const { return static_cast<int>(y_.size()); }
+
+ private:
+  double Rand();  // xorshift64*, deterministic per seed
+  std::vector<double> RandomPoint();
+  double ExpectedImprovement(const GaussianProcess& gp,
+                             const std::vector<double>& x,
+                             double best_z) const;
+
+  int n_cont_, n_cat_;
+  uint64_t rng_;
+  std::vector<std::vector<double>> X_;
+  std::vector<double> y_;
+  static constexpr int kWarmup = 6;
+  static constexpr int kCandidates = 512;
+};
+
+}  // namespace hvd

@@ -122,6 +122,7 @@ class Controller {
   // value to all workers — env divergence cannot split the job.
   int64_t ring_threshold_bytes_ = 64 * 1024;
   bool hierarchical_ = false;
+  bool hierarchical_fit_ = false;
   bool shm_enabled_ = false;
 
  public:
@@ -143,14 +144,21 @@ class Controller {
   bool shm_enabled() const { return shm_enabled_; }
   // Autotune (rank 0): stage new tunables for the next broadcast
   // ResponseList so every rank applies them on the same cycle.
-  void StageTunedParams(int64_t fusion, double cycle_ms) {
+  void StageTunedParams(int64_t fusion, double cycle_ms,
+                        int hierarchical = -1) {
     staged_fusion_ = fusion;
     staged_cycle_ms_ = cycle_ms;
+    staged_hier_ = hierarchical;
   }
+  // Init-time agreed layout fitness (rank 0 only): whether the
+  // hierarchical decomposition COULD run — the autotuner may then flip
+  // hierarchical() per cycle within that envelope.
+  bool hierarchical_fit() const { return hierarchical_fit_; }
 
  protected:
   int64_t staged_fusion_ = 0;
   double staged_cycle_ms_ = 0.0;
+  int staged_hier_ = -1;
 };
 
 class LocalController : public Controller {

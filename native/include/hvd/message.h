@@ -120,6 +120,7 @@ struct ResponseList {
   // rank 0's parameter manager stages new tunables here; 0 = no change.
   int64_t tuned_fusion_threshold = 0;
   double tuned_cycle_time_ms = 0.0;
+  int8_t tuned_hierarchical = -1;  // -1 = no change, 0/1 = new value
 
   void SerializeTo(std::string* out) const;
   static bool ParseFrom(const std::string& buf, ResponseList* out);

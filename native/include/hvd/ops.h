@@ -112,7 +112,6 @@ class TcpOps : public OpExecutor {
   bool ShmEligible(int64_t payload_bytes, Status* err);
 
   int64_t ring_threshold_bytes_;  // below: recursive doubling
-  bool hierarchical_ = false;     // HOROVOD_HIERARCHICAL_ALLREDUCE
   std::unique_ptr<ShmArena> shm_;
   double shm_timeout_secs_ = 60.0;
 };

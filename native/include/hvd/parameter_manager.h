@@ -2,53 +2,83 @@
 //
 // Rebuild of the reference's ParameterManager
 // (horovod/common/parameter_manager.h:42-246): score each parameter
-// setting by observed allreduce throughput (bytes/sec) and walk the
-// parameter space. The reference samples a Gaussian-process Bayesian
-// optimizer; here the space is two well-behaved log-scale knobs
-// (fusion threshold, cycle time), so a multiplicative coordinate
-// descent reaches the same plateaus with far less machinery: for each
-// knob try x2 / ÷2, keep moving while the score improves, converge
-// when a full pass over both knobs yields no gain. Rank 0 tunes and
-// stages the new values onto the broadcast ResponseList so every rank
-// applies them on the same cycle (the reference syncs through
-// Controller::SynchronizeParameters, controller.cc:39-53).
+// setting by observed allreduce throughput (bytes/sec) and search the
+// parameter space. Two search modes:
+//
+// * "bayes" (default; the reference's BayesianParameter,
+//   parameter_manager.h:186 + common/optim/) — a Gaussian-process
+//   surrogate over (log2 fusion, log2 cycle[, hierarchical]) with
+//   Expected-Improvement acquisition (hvd/bayesian.h). Global: reaches
+//   optima that are NOT x2-adjacent to the start, and explores the
+//   hierarchical-allreduce categorical when the topology fits.
+// * "climb" (HOROVOD_AUTOTUNE_MODE=climb; rounds r1-r3 behavior) — a
+//   multiplicative x2/÷2 coordinate descent.
+//
+// Rank 0 tunes and stages the new values onto the broadcast
+// ResponseList so every rank applies them on the same cycle (the
+// reference syncs through Controller::SynchronizeParameters,
+// controller.cc:39-53); workers apply the staged values BEFORE
+// executing the cycle's responses so data-plane algorithm choices
+// (hierarchical) never desync.
 #pragma once
 
 #include <cstdint>
 #include <fstream>
+#include <memory>
 #include <string>
+#include <vector>
 
 namespace hvd {
 
+class BayesianOptimizer;
+
 class ParameterManager {
  public:
+  ParameterManager();
+  ~ParameterManager();
+  ParameterManager(ParameterManager&&) noexcept;
+  ParameterManager& operator=(ParameterManager&&) noexcept;
+
   // `fusion` / `cycle_ms` are the starting (env-configured) values.
   void Initialize(int64_t fusion, double cycle_ms);
   void SetEnabled(bool on) { enabled_ = on; }
   bool enabled() const { return enabled_ && !converged_; }
   void SetLogPath(const std::string& path);
+  // Offer the hierarchical-allreduce switch as a tunable categorical
+  // (bayes mode only; call after the init-time fitness handshake —
+  // `fit` is the agreed layout fitness, `current` the starting value).
+  void SetHierarchicalTunable(bool fit, bool current);
 
   // Record traffic finished this cycle (coordinator side).
   void Record(int64_t bytes);
 
   // Advance the tuner; returns true when the tunables changed (read
-  // them back via fusion_threshold()/cycle_time_ms()).
+  // them back via fusion_threshold()/cycle_time_ms()/hierarchical()).
   bool Update(double now_secs);
 
   int64_t fusion_threshold() const { return fusion_; }
   double cycle_time_ms() const { return cycle_ms_; }
+  bool hierarchical() const { return hierarchical_ > 0; }
+  bool hierarchical_tunable() const { return hier_tunable_; }
   bool converged() const { return converged_; }
   double best_score() const { return best_score_; }
 
  private:
   void ApplyCandidate();
   void LogSample(double score);
+  bool UpdateClimb(double score);
+  bool UpdateBayes(double score);
+  std::vector<double> CurrentPoint() const;
+  void ApplyPoint(const std::vector<double>& x);
 
   bool enabled_ = false;
   bool converged_ = false;
+  bool bayes_ = true;
 
   int64_t fusion_ = 64 * 1024 * 1024;
   double cycle_ms_ = 1.0;
+  int hierarchical_ = 0;      // current value (bayes categorical)
+  bool hier_tunable_ = false;
 
   // Measurement window.
   double window_secs_ = 1.0;
@@ -56,7 +86,11 @@ class ParameterManager {
   int64_t window_bytes_ = 0;
   bool settling_ = true;  // discard the first window after a change
 
-  // Coordinate-descent state.
+  // Bayes state.
+  std::unique_ptr<BayesianOptimizer> opt_;
+  int max_samples_ = 20;
+
+  // Coordinate-descent state (climb mode).
   int dim_ = 0;          // 0 = fusion threshold, 1 = cycle time
   int direction_ = +1;   // +1 = grow (x2), -1 = shrink (÷2)
   bool tried_other_dir_ = false;
@@ -64,6 +98,7 @@ class ParameterManager {
   double best_score_ = 0.0;
   int64_t best_fusion_ = 0;
   double best_cycle_ms_ = 0.0;
+  int best_hier_ = 0;
 
   std::ofstream log_;
 };

@@ -1,0 +1,186 @@
+#include "hvd/bayesian.h"
+
+#include <algorithm>
+#include <cmath>
+
+namespace hvd {
+
+// ---------------------------------------------------------------------------
+// GaussianProcess
+// ---------------------------------------------------------------------------
+
+double GaussianProcess::Kernel(const std::vector<double>& a,
+                               const std::vector<double>& b) const {
+  double d2 = 0.0;
+  for (size_t i = 0; i < a.size(); ++i) {
+    double d = a[i] - b[i];
+    d2 += d * d;
+  }
+  return std::exp(-d2 / (2.0 * lengthscale_ * lengthscale_));
+}
+
+void GaussianProcess::Fit(const std::vector<std::vector<double>>& X,
+                          const std::vector<double>& y) {
+  n_ = static_cast<int>(X.size());
+  X_ = X;
+  y_mean_ = 0.0;
+  for (double v : y) y_mean_ += v;
+  y_mean_ /= n_;
+  double var = 0.0;
+  for (double v : y) var += (v - y_mean_) * (v - y_mean_);
+  y_std_ = n_ > 1 ? std::sqrt(var / (n_ - 1)) : 1.0;
+  if (y_std_ < 1e-12) y_std_ = 1.0;
+
+  // K = kernel matrix + noise on the diagonal; factor K = L L^T.
+  std::vector<double> K(n_ * n_);
+  for (int i = 0; i < n_; ++i)
+    for (int j = 0; j <= i; ++j)
+      K[i * n_ + j] = K[j * n_ + i] =
+          Kernel(X_[i], X_[j]) + (i == j ? noise_ : 0.0);
+  L_.assign(n_ * n_, 0.0);
+  for (int i = 0; i < n_; ++i) {
+    for (int j = 0; j <= i; ++j) {
+      double s = K[i * n_ + j];
+      for (int k = 0; k < j; ++k) s -= L_[i * n_ + k] * L_[j * n_ + k];
+      if (i == j) {
+        L_[i * n_ + i] = std::sqrt(std::max(s, 1e-12));
+      } else {
+        L_[i * n_ + j] = s / L_[j * n_ + j];
+      }
+    }
+  }
+  // alpha = K^-1 z  (z = normalized scores), two triangular solves.
+  std::vector<double> z(n_);
+  for (int i = 0; i < n_; ++i) z[i] = znorm(y[i]);
+  alpha_.assign(n_, 0.0);
+  for (int i = 0; i < n_; ++i) {  // L w = z
+    double s = z[i];
+    for (int k = 0; k < i; ++k) s -= L_[i * n_ + k] * alpha_[k];
+    alpha_[i] = s / L_[i * n_ + i];
+  }
+  for (int i = n_ - 1; i >= 0; --i) {  // L^T alpha = w
+    double s = alpha_[i];
+    for (int k = i + 1; k < n_; ++k) s -= L_[k * n_ + i] * alpha_[k];
+    alpha_[i] = s / L_[i * n_ + i];
+  }
+}
+
+void GaussianProcess::Predict(const std::vector<double>& x, double* mean,
+                              double* var) const {
+  std::vector<double> kx(n_);
+  for (int i = 0; i < n_; ++i) kx[i] = Kernel(x, X_[i]);
+  double m = 0.0;
+  for (int i = 0; i < n_; ++i) m += kx[i] * alpha_[i];
+  *mean = m;
+  // v = L^-1 kx; var = k(x,x) - v.v
+  std::vector<double> v(n_);
+  for (int i = 0; i < n_; ++i) {
+    double s = kx[i];
+    for (int k = 0; k < i; ++k) s -= L_[i * n_ + k] * v[k];
+    v[i] = s / L_[i * n_ + i];
+  }
+  double vv = 0.0;
+  for (int i = 0; i < n_; ++i) vv += v[i] * v[i];
+  *var = std::max(1.0 + noise_ - vv, 1e-12);
+}
+
+// ---------------------------------------------------------------------------
+// BayesianOptimizer
+// ---------------------------------------------------------------------------
+
+BayesianOptimizer::BayesianOptimizer(int n_cont, int n_cat, uint64_t seed)
+    : n_cont_(n_cont), n_cat_(n_cat), rng_(seed ? seed : 1) {}
+
+double BayesianOptimizer::Rand() {
+  // xorshift64* — deterministic across platforms, no <random> needed.
+  rng_ ^= rng_ >> 12;
+  rng_ ^= rng_ << 25;
+  rng_ ^= rng_ >> 27;
+  return static_cast<double>((rng_ * 0x2545F4914F6CDD1DULL) >> 11) /
+         static_cast<double>(1ULL << 53);
+}
+
+std::vector<double> BayesianOptimizer::RandomPoint() {
+  std::vector<double> x(n_cont_ + n_cat_);
+  for (int i = 0; i < n_cont_; ++i) x[i] = Rand();
+  for (int i = 0; i < n_cat_; ++i)
+    x[n_cont_ + i] = Rand() < 0.5 ? 0.0 : 1.0;
+  return x;
+}
+
+void BayesianOptimizer::AddSample(const std::vector<double>& x, double y) {
+  X_.push_back(x);
+  y_.push_back(y);
+}
+
+std::vector<double> BayesianOptimizer::Best(double* score) const {
+  if (y_.empty()) return {};
+  size_t bi = 0;
+  for (size_t i = 1; i < y_.size(); ++i)
+    if (y_[i] > y_[bi]) bi = i;
+  if (score) *score = y_[bi];
+  return X_[bi];
+}
+
+double BayesianOptimizer::ExpectedImprovement(
+    const GaussianProcess& gp, const std::vector<double>& x,
+    double best_z) const {
+  double mu, var;
+  gp.Predict(x, &mu, &var);
+  double sigma = std::sqrt(var);
+  constexpr double kXi = 0.01;  // exploration margin
+  double z = (mu - best_z - kXi) / sigma;
+  // Φ and φ of the standard normal.
+  double cdf = 0.5 * std::erfc(-z / std::sqrt(2.0));
+  double pdf = std::exp(-0.5 * z * z) / std::sqrt(2.0 * M_PI);
+  return (mu - best_z - kXi) * cdf + sigma * pdf;
+}
+
+std::vector<double> BayesianOptimizer::NextCandidate() {
+  if (n_samples() < kWarmup) {
+    // Warmup: stratified exploration — jittered midpoints of a coarse
+    // lattice walk so early samples spread over the space instead of
+    // clustering (the reference seeds its GP the same way).
+    std::vector<double> x(n_cont_ + n_cat_);
+    int s = n_samples();
+    for (int i = 0; i < n_cont_; ++i) {
+      double stratum = ((s * 2 + 1 + i * 3) % (2 * kWarmup)) /
+                       static_cast<double>(2 * kWarmup);
+      x[i] = std::min(1.0, std::max(0.0, stratum + (Rand() - 0.5) * 0.15));
+    }
+    for (int i = 0; i < n_cat_; ++i) x[n_cont_ + i] = (s + i) % 2;
+    return x;
+  }
+
+  GaussianProcess gp;
+  gp.Fit(X_, y_);
+  double best_y;
+  std::vector<double> best_x = Best(&best_y);
+  double best_z = gp.znorm(best_y);
+
+  std::vector<double> argmax = RandomPoint();
+  double ei_max = -1.0;
+  for (int c = 0; c < kCandidates; ++c) {
+    std::vector<double> x;
+    if (c % 4 == 0) {
+      // Local refinement: jitter the incumbent.
+      x = best_x;
+      for (int i = 0; i < n_cont_; ++i)
+        x[i] = std::min(1.0, std::max(0.0, x[i] + (Rand() - 0.5) * 0.2));
+      if (n_cat_ && Rand() < 0.25) {
+        int i = n_cont_ + static_cast<int>(Rand() * n_cat_);
+        x[i] = 1.0 - x[i];
+      }
+    } else {
+      x = RandomPoint();
+    }
+    double ei = ExpectedImprovement(gp, x, best_z);
+    if (ei > ei_max) {
+      ei_max = ei;
+      argmax = x;
+    }
+  }
+  return argmax;
+}
+
+}  // namespace hvd

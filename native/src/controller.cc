@@ -485,6 +485,7 @@ Status TcpController::Initialize() {
       all_fit = all_fit && fit.substr(0, bar) ==
                                ("fit:" + std::to_string(local_size_));
     }
+    hierarchical_fit_ = all_fit;
     hierarchical_ = hierarchical_ && all_fit;
     shm_enabled_ = shm_enabled_ && all_single;
     std::string params = std::to_string(fusion_threshold_bytes_) + ":" +
@@ -810,8 +811,10 @@ void TcpController::Broadcast(ResponseList& list) {
   if (staged_fusion_ > 0) {
     list.tuned_fusion_threshold = staged_fusion_;
     list.tuned_cycle_time_ms = staged_cycle_ms_;
+    list.tuned_hierarchical = static_cast<int8_t>(staged_hier_);
     staged_fusion_ = 0;
     staged_cycle_ms_ = 0.0;
+    staged_hier_ = -1;
   }
   std::string buf;
   list.SerializeTo(&buf);

@@ -206,11 +206,12 @@ bool Response::ParseFrom(const char** p, const char* end, Response* r) {
 }
 
 void ResponseList::SerializeTo(std::string* out) const {
-  WriteScalar<uint8_t>(out, 2);  // version
+  WriteScalar<uint8_t>(out, 3);  // version
   WriteScalar<uint8_t>(out, shutdown ? 1 : 0);
   WriteScalar<uint8_t>(out, purge_cache ? 1 : 0);
   WriteScalar<int64_t>(out, tuned_fusion_threshold);
   WriteScalar<double>(out, tuned_cycle_time_ms);
+  WriteScalar<int8_t>(out, tuned_hierarchical);
   WriteScalar<uint32_t>(out, static_cast<uint32_t>(responses.size()));
   for (const auto& r : responses) r.SerializeTo(out);
 }
@@ -219,13 +220,14 @@ bool ResponseList::ParseFrom(const std::string& buf, ResponseList* out) {
   const char* p = buf.data();
   const char* end = p + buf.size();
   uint8_t ver, sd, pc;
-  if (!ReadScalar(&p, end, &ver) || ver != 2) return false;
+  if (!ReadScalar(&p, end, &ver) || ver != 3) return false;
   if (!ReadScalar(&p, end, &sd)) return false;
   out->shutdown = sd != 0;
   if (!ReadScalar(&p, end, &pc)) return false;
   out->purge_cache = pc != 0;
   if (!ReadScalar(&p, end, &out->tuned_fusion_threshold)) return false;
   if (!ReadScalar(&p, end, &out->tuned_cycle_time_ms)) return false;
+  if (!ReadScalar(&p, end, &out->tuned_hierarchical)) return false;
   uint32_t n;
   if (!ReadScalar(&p, end, &n)) return false;
   out->responses.resize(n);

@@ -30,6 +30,7 @@
 #include "hvd/logging.h"
 #include "hvd/message.h"
 #include "hvd/ops.h"
+#include "hvd/bayesian.h"
 #include "hvd/parameter_manager.h"
 #include "hvd/response_cache.h"
 #include "hvd/stall_inspector.h"
@@ -297,6 +298,18 @@ void BackgroundThreadLoop(GlobalState& st) {
     st.timeline.MarkCycleStart();
     ResponseList list =
         st.controller->ComputeResponseList(st.shutdown_requested.load());
+    // Workers apply staged tunables BEFORE executing this cycle's
+    // responses: rank 0 already runs with the new values (it applied
+    // them at the end of the previous cycle), and hierarchical is a
+    // data-plane ALGORITHM choice — executing one cycle with mixed
+    // values would deadlock the exchange.
+    if (st.rank != 0 && list.tuned_fusion_threshold > 0) {
+      st.controller->SetFusionThreshold(list.tuned_fusion_threshold);
+      if (list.tuned_cycle_time_ms > 0)
+        st.cycle_time_ms = list.tuned_cycle_time_ms;
+      if (list.tuned_hierarchical >= 0)
+        st.controller->SetHierarchical(list.tuned_hierarchical != 0);
+    }
     for (const auto& resp : list.responses) PerformOperation(st, resp);
     if (list.shutdown) break;
     // Autotune: rank 0 scores the window by reduction traffic and, on
@@ -312,13 +325,16 @@ void BackgroundThreadLoop(GlobalState& st) {
       if (st.param_manager.Update(now)) {
         st.controller->SetFusionThreshold(st.param_manager.fusion_threshold());
         st.cycle_time_ms = st.param_manager.cycle_time_ms();
-        st.controller->StageTunedParams(st.param_manager.fusion_threshold(),
-                                        st.param_manager.cycle_time_ms());
+        st.controller->SetHierarchical(st.param_manager.hierarchical_tunable()
+                                           ? st.param_manager.hierarchical()
+                                           : st.controller->hierarchical());
+        st.controller->StageTunedParams(
+            st.param_manager.fusion_threshold(),
+            st.param_manager.cycle_time_ms(),
+            st.param_manager.hierarchical_tunable()
+                ? (st.param_manager.hierarchical() ? 1 : 0)
+                : -1);
       }
-    } else if (st.rank != 0 && list.tuned_fusion_threshold > 0) {
-      st.controller->SetFusionThreshold(list.tuned_fusion_threshold);
-      if (list.tuned_cycle_time_ms > 0)
-        st.cycle_time_ms = list.tuned_cycle_time_ms;
     }
     auto elapsed = std::chrono::steady_clock::now() - cycle_start;
     auto budget = std::chrono::duration<double, std::milli>(st.cycle_time_ms);
@@ -431,6 +447,10 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   st.controller->SetShmEnabled(
       size > 1 && std::getenv("HOROVOD_SHM_DISABLE") == nullptr);
   hvd::Status s = st.controller->Initialize();
+  if (s.ok() && rank == 0)
+    st.param_manager.SetHierarchicalTunable(
+        st.controller->hierarchical_fit() && size > 1,
+        st.controller->hierarchical());
   if (!s.ok()) {
     LOG_ERROR << "controller init failed: " << s.reason();
     return -1;
@@ -618,6 +638,32 @@ void hvd_stop_timeline() { hvd::State().timeline.Shutdown(); }
 // Test hook: number of tensors currently in flight.
 int64_t hvd_pending_count() {
   return static_cast<int64_t>(hvd::State().tensor_queue.size());
+}
+
+// Test hooks: drive the Bayesian autotune optimizer (hvd/bayesian.h)
+// against a caller-provided objective, so tests can assert global
+// convergence properties the x2 hill climb lacks.
+void* hvd_bayes_create(int n_cont, int n_cat, uint64_t seed) {
+  return new hvd::BayesianOptimizer(n_cont, n_cat, seed);
+}
+void hvd_bayes_add(void* h, const double* x, int n, double y) {
+  static_cast<hvd::BayesianOptimizer*>(h)->AddSample(
+      std::vector<double>(x, x + n), y);
+}
+void hvd_bayes_next(void* h, double* x_out, int n) {
+  auto x = static_cast<hvd::BayesianOptimizer*>(h)->NextCandidate();
+  for (int i = 0; i < n && i < static_cast<int>(x.size()); ++i)
+    x_out[i] = x[i];
+}
+double hvd_bayes_best(void* h, double* x_out, int n) {
+  double score = 0.0;
+  auto x = static_cast<hvd::BayesianOptimizer*>(h)->Best(&score);
+  for (int i = 0; i < n && i < static_cast<int>(x.size()); ++i)
+    x_out[i] = x[i];
+  return score;
+}
+void hvd_bayes_destroy(void* h) {
+  delete static_cast<hvd::BayesianOptimizer*>(h);
 }
 
 }  // extern "C"

@@ -318,7 +318,6 @@ TcpOps::TcpOps(Controller* controller, FusionBufferManager* fusion,
   // Post-sync value: rank 0's HOROVOD_RING_THRESHOLD for every rank
   // (a per-rank algorithm choice would deadlock the exchange).
   ring_threshold_bytes_ = controller->ring_threshold();
-  hierarchical_ = controller->hierarchical();
   // Single-host jobs get a shared-memory arena (the reference's
   // intra-node transport analog). shm_enabled() is the COORDINATOR'S
   // post-sync verdict (rank 0's env wish ANDed with every rank's
@@ -621,7 +620,9 @@ bool TcpOps::HierarchicalApplicable(const std::vector<int>& ranks) const {
   // here only the per-response condition remains: the full world must
   // contribute (join shrinks the set to something the two-level
   // decomposition no longer tiles).
-  return hierarchical_ &&
+  // Live read: the autotuner may flip the flag between cycles (all
+  // ranks apply the broadcast value before executing the cycle).
+  return controller_->hierarchical() &&
          static_cast<int>(ranks.size()) == controller_->size();
 }
 

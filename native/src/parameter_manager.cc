@@ -1,8 +1,11 @@
 #include "hvd/parameter_manager.h"
 
 #include <algorithm>
+#include <cmath>
 #include <cstdlib>
+#include <cstring>
 
+#include "hvd/bayesian.h"
 #include "hvd/logging.h"
 
 namespace hvd {
@@ -13,7 +16,21 @@ constexpr int64_t kMaxFusion = 256ll << 20;      // 256 MB
 constexpr double kMinCycleMs = 0.125;
 constexpr double kMaxCycleMs = 32.0;
 constexpr double kImprovement = 1.02;  // accept only >2% gains (noise floor)
+
+// Normalized-coordinate maps: x in [0,1] <-> log2-scaled knob range.
+constexpr double kLogFusionLo = 10.0, kLogFusionHi = 28.0;
+constexpr double kLogCycleLo = -3.0, kLogCycleHi = 5.0;
+
+double ToUnit(double v, double lo, double hi) {
+  return std::min(1.0, std::max(0.0, (v - lo) / (hi - lo)));
+}
 }  // namespace
+
+ParameterManager::ParameterManager() = default;
+ParameterManager::~ParameterManager() = default;
+ParameterManager::ParameterManager(ParameterManager&&) noexcept = default;
+ParameterManager& ParameterManager::operator=(ParameterManager&&) noexcept =
+    default;
 
 void ParameterManager::Initialize(int64_t fusion, double cycle_ms) {
   fusion_ = fusion;
@@ -22,12 +39,23 @@ void ParameterManager::Initialize(int64_t fusion, double cycle_ms) {
   best_cycle_ms_ = cycle_ms;
   if (const char* w = std::getenv("HOROVOD_AUTOTUNE_WINDOW_SECS"))
     window_secs_ = std::atof(w);
+  if (const char* m = std::getenv("HOROVOD_AUTOTUNE_MODE"))
+    bayes_ = std::strcmp(m, "climb") != 0;
+  if (const char* n = std::getenv("HOROVOD_AUTOTUNE_MAX_SAMPLES"))
+    max_samples_ = std::max(1, std::atoi(n));
+}
+
+void ParameterManager::SetHierarchicalTunable(bool fit, bool current) {
+  hier_tunable_ = fit && bayes_;
+  hierarchical_ = current ? 1 : 0;
+  best_hier_ = hierarchical_;
 }
 
 void ParameterManager::SetLogPath(const std::string& path) {
   log_.open(path, std::ios::out | std::ios::trunc);
   if (log_.is_open())
-    log_ << "time_secs,fusion_threshold_bytes,cycle_time_ms,score_bytes_per_sec\n";
+    log_ << "time_secs,fusion_threshold_bytes,cycle_time_ms,"
+            "score_bytes_per_sec\n";
 }
 
 void ParameterManager::Record(int64_t bytes) {
@@ -40,6 +68,24 @@ void ParameterManager::LogSample(double score) {
          << static_cast<int64_t>(score) << "\n";
     log_.flush();
   }
+}
+
+std::vector<double> ParameterManager::CurrentPoint() const {
+  std::vector<double> x = {
+      ToUnit(std::log2(static_cast<double>(fusion_)), kLogFusionLo,
+             kLogFusionHi),
+      ToUnit(std::log2(cycle_ms_), kLogCycleLo, kLogCycleHi)};
+  if (hier_tunable_) x.push_back(hierarchical_ ? 1.0 : 0.0);
+  return x;
+}
+
+void ParameterManager::ApplyPoint(const std::vector<double>& x) {
+  double lf = kLogFusionLo + x[0] * (kLogFusionHi - kLogFusionLo);
+  fusion_ = std::min(kMaxFusion, std::max(kMinFusion, static_cast<int64_t>(
+                                              std::exp2(lf))));
+  double lc = kLogCycleLo + x[1] * (kLogCycleHi - kLogCycleLo);
+  cycle_ms_ = std::min(kMaxCycleMs, std::max(kMinCycleMs, std::exp2(lc)));
+  if (hier_tunable_ && x.size() > 2) hierarchical_ = x[2] > 0.5 ? 1 : 0;
 }
 
 void ParameterManager::ApplyCandidate() {
@@ -72,7 +118,46 @@ bool ParameterManager::Update(double now_secs) {
     return false;
   }
   LogSample(score);
+  return bayes_ ? UpdateBayes(score) : UpdateClimb(score);
+}
 
+bool ParameterManager::UpdateBayes(double score) {
+  if (!opt_) {
+    opt_ = std::make_unique<BayesianOptimizer>(2, hier_tunable_ ? 1 : 0);
+  }
+  const int64_t old_fusion = fusion_;
+  const double old_cycle = cycle_ms_;
+  const int old_hier = hierarchical_;
+
+  opt_->AddSample(CurrentPoint(), score);
+  if (score > best_score_) {
+    best_score_ = score;
+    best_fusion_ = fusion_;
+    best_cycle_ms_ = cycle_ms_;
+    best_hier_ = hierarchical_;
+  }
+  if (opt_->n_samples() >= max_samples_) {
+    fusion_ = best_fusion_;
+    cycle_ms_ = best_cycle_ms_;
+    hierarchical_ = best_hier_;
+    converged_ = true;
+    LOG_INFO << "autotune (bayes) converged after " << opt_->n_samples()
+             << " samples: fusion_threshold=" << fusion_
+             << " cycle_time_ms=" << cycle_ms_
+             << (hier_tunable_
+                     ? std::string(" hierarchical=") +
+                           (hierarchical_ ? "1" : "0")
+                     : std::string())
+             << " (score " << static_cast<int64_t>(best_score_) << " B/s)";
+  } else {
+    ApplyPoint(opt_->NextCandidate());
+  }
+  settling_ = true;
+  return fusion_ != old_fusion || cycle_ms_ != old_cycle ||
+         hierarchical_ != old_hier || converged_;
+}
+
+bool ParameterManager::UpdateClimb(double score) {
   const int64_t old_fusion = fusion_;
   const double old_cycle = cycle_ms_;
 

@@ -75,3 +75,104 @@ def test_timeline_rank_ready_ticks(tmp_path):
              if e.get("ph") == "i" and str(e.get("name", "")) == "0"
              and str(e.get("tid", "")).startswith("tlr.")]
     assert ticks, [e for e in events if e.get("ph") == "i"][:5]
+
+
+# ---------------------------------------------------------------------------
+# Bayesian autotune (reference parameter_manager.h:186 BayesianParameter)
+# ---------------------------------------------------------------------------
+
+def _bayes_lib():
+    import ctypes
+    from horovod_tpu.common import basics
+    lib = basics.get_lib()
+    lib.hvd_bayes_create.restype = ctypes.c_void_p
+    lib.hvd_bayes_create.argtypes = [ctypes.c_int, ctypes.c_int,
+                                     ctypes.c_uint64]
+    lib.hvd_bayes_add.argtypes = [ctypes.c_void_p,
+                                  ctypes.POINTER(ctypes.c_double),
+                                  ctypes.c_int, ctypes.c_double]
+    lib.hvd_bayes_next.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.c_double),
+                                   ctypes.c_int]
+    lib.hvd_bayes_best.restype = ctypes.c_double
+    lib.hvd_bayes_best.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.c_double),
+                                   ctypes.c_int]
+    lib.hvd_bayes_destroy.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def _drive_bayes(lib, f, n_cont, n_cat, iters, seed=7):
+    import ctypes
+    d = n_cont + n_cat
+    h = lib.hvd_bayes_create(n_cont, n_cat, seed)
+    try:
+        buf = (ctypes.c_double * d)()
+        x = np.full(d, 0.5)  # start mid-space, like fusion/cycle defaults
+        x[n_cont:] = 0.0
+        for _ in range(iters):
+            lib.hvd_bayes_add(h, (ctypes.c_double * d)(*x), d, float(f(x)))
+            lib.hvd_bayes_next(h, buf, d)
+            x = np.asarray(buf[:d])
+        best = (ctypes.c_double * d)()
+        score = lib.hvd_bayes_best(h, best, d)
+        return np.asarray(best[:d]), score
+    finally:
+        lib.hvd_bayes_destroy(h)
+
+
+def test_bayes_reaches_nonadjacent_optimum():
+    """The landscape has a local peak exactly at the starting point and
+    a higher global peak far away. Every x2/÷2-adjacent move from the
+    start scores worse, so the multiplicative hill climber (accept only
+    >2% gains) converges AT the start by construction; the GP optimizer
+    must find the distant peak."""
+    start = np.array([0.5, 0.5])
+    opt = np.array([0.9, 0.1])
+
+    def f(x):
+        local = 1.0 * np.exp(-np.sum((x[:2] - start) ** 2) / 0.005)
+        glob = 2.0 * np.exp(-np.sum((x[:2] - opt) ** 2) / 0.01)
+        return local + glob
+
+    # x2/÷2 on the raw knobs = ±1/18 (fusion) / ±1/8 (cycle) in the
+    # normalized log2 coordinates — all adjacent moves score worse than
+    # the start, so the climber is pinned there.
+    f0 = f(start)
+    for d, step in ((0, 1 / 18), (0, -1 / 18), (1, 1 / 8), (1, -1 / 8)):
+        xa = start.copy()
+        xa[d] += step
+        assert f(xa) < f0 * 1.02, "landscape must pin the x2 climber"
+
+    best, score = _drive_bayes(_bayes_lib(), f, 2, 0, iters=24)
+    assert np.linalg.norm(best[:2] - opt) < 0.12, (best, score)
+    assert score > 1.5 * f0, (score, f0)
+
+
+def test_bayes_explores_categorical():
+    """A binary categorical dim (the hierarchical-allreduce switch):
+    cat=1 doubles the score everywhere; the optimizer must land on it."""
+    def f(x):
+        base = 1.0 + np.exp(-np.sum((x[:2] - 0.3) ** 2) / 0.05)
+        return base * (2.0 if x[2] > 0.5 else 1.0)
+
+    best, score = _drive_bayes(_bayes_lib(), f, 2, 1, iters=20)
+    assert best[2] > 0.5, best
+    assert score > 3.0, score
+
+
+def test_autotune_bayes_multiprocess_hierarchical_flip():
+    """np=4 as 2x2 virtual nodes with bayes autotune on a tiny window:
+    the tuner flips the hierarchical categorical mid-run through the
+    broadcast ResponseList; the job must stay protocol-correct (a
+    desynced flip would deadlock the data-plane exchange)."""
+    from test_hierarchical import run_two_node_job
+
+    run_two_node_job("matrix", local_size=2, n_nodes=2, timeout=180,
+                     extra_env={
+                         "HOROVOD_AUTOTUNE": "1",
+                         "HOROVOD_AUTOTUNE_WINDOW_SECS": "0.05",
+                         "HOROVOD_CYCLE_TIME": "0.5",
+                         # shm arena would mask the TCP hierarchical path
+                         "HOROVOD_SHM_DISABLE": "1",
+                     })
