@@ -112,26 +112,131 @@ def distributed_optimizer(optimizer, *,
                           axis_name: Optional[AxisName] = None,
                           op: ReduceOp = Average,
                           compression=Compression.none,
-                          name: str = "distributed_optimizer"):
+                          name: str = "distributed_optimizer",
+                          backward_passes_per_step: int = 1):
     """Wrap an optax ``GradientTransformation`` so incoming gradients
     are reduced across ranks before the inner update — the optax
     analog of ``hvd.DistributedOptimizer``.
 
     Use inside ``jit``/``shard_map`` with ``axis_name=...``, or eagerly
     (one process per rank) without.
+
+    ``backward_passes_per_step=N`` enables local gradient aggregation
+    (the JAX analog of the reference's
+    ``tensorflow/gradient_aggregation.py:16`` and the torch wrapper's
+    same-named knob): gradients are summed LOCALLY for N calls and
+    reduced across ranks only on every N-th — one collective per N
+    microbatches. Non-boundary calls emit zero updates (parameters and
+    inner optimizer state advance only on the boundary), so
+    ``optax.apply_updates`` can run unconditionally every microbatch.
+    The boundary update equals one big-batch update on the SUM of the
+    local microbatch gradients, matching the torch tier (average the
+    loss over the N passes, or scale the LR, exactly as with the
+    reference).
     """
     import optax
 
-    def init_fn(params):
-        return optimizer.init(params)
+    if backward_passes_per_step < 1:
+        raise ValueError("backward_passes_per_step must be >= 1")
 
-    def update_fn(updates, state, params=None, **extra):
-        updates = allreduce_gradients(
-            updates, axis_name=axis_name, op=op, compression=compression,
+    def reduce_grads(grads):
+        return allreduce_gradients(
+            grads, axis_name=axis_name, op=op, compression=compression,
             name=name)
-        return optimizer.update(updates, state, params, **extra)
 
-    return optax.GradientTransformation(init_fn, update_fn)
+    if backward_passes_per_step == 1:
+        def init_fn(params):
+            return optimizer.init(params)
+
+        def update_fn(updates, state, params=None, **extra):
+            return optimizer.update(reduce_grads(updates), state, params,
+                                    **extra)
+
+        return optax.GradientTransformation(init_fn, update_fn)
+
+    import jax
+    import jax.numpy as jnp
+
+    n = backward_passes_per_step
+
+    def _pvary_missing(t):
+        """Promote every leaf to device-varying over ``axis_name``
+        (no-op leaf-wise where already varying, or outside a manual-
+        axes trace). Keeps the accumulator's VMA type STABLE between
+        init and update so the canonical lax.scan-over-microbatches
+        carry typechecks."""
+        if axis_name is None:
+            return t
+        from jax import lax
+        axes = ((axis_name,) if isinstance(axis_name, str)
+                else tuple(axis_name))
+
+        def one(a):
+            vma = getattr(jax.typeof(a), "vma", None)
+            if vma is None:
+                return a
+            missing = tuple(ax for ax in axes if ax not in vma)
+            if not missing:
+                return a
+            try:
+                return lax.pvary(a, missing)
+            except Exception:  # outside shard_map: axis not in scope
+                return a
+        return jax.tree.map(one, t)
+
+    def init_acc(params):
+        return {"inner": optimizer.init(params),
+                "acc": _pvary_missing(
+                    jax.tree.map(jnp.zeros_like, params)),
+                "count": jnp.zeros((), jnp.int32)}
+
+    def boundary_update(acc, inner, params, extra):
+        new_updates, new_inner = optimizer.update(
+            reduce_grads(acc), inner, params, **extra)
+        zero_acc = jax.tree.map(jnp.zeros_like, acc)
+        return new_updates, zero_acc, new_inner
+
+    def update_acc(updates, state, params=None, **extra):
+        acc = _pvary_missing(
+            jax.tree.map(jnp.add, state["acc"], updates))
+        count = state["count"] + 1
+
+        if axis_name is None:
+            # Eager tier: concrete control flow (the native-runtime
+            # collective is a host call and cannot live under lax.cond).
+            if int(count) >= n:
+                out, acc, inner = boundary_update(acc, state["inner"],
+                                                  params, extra)
+                count = jnp.zeros((), jnp.int32)
+            else:
+                out = jax.tree.map(jnp.zeros_like, updates)
+                inner = state["inner"]
+        else:
+            # In-jit tier: both branches trace; `count` is replicated
+            # so every rank takes the same one and the collectives in
+            # the boundary branch stay SPMD-legal.
+            from jax import lax
+
+            def hold(acc, inner):
+                # FRESH-constant zeros, not zeros_like(acc): constants
+                # are replicated under VMA typing, matching the
+                # boundary branch's post-reduction updates — and the
+                # emitted zero updates keep params replicated, exactly
+                # like the N=1 path. (zeros_like would inherit acc's
+                # device-varying type and poison params' VMA.)
+                zeros = jax.tree.map(
+                    lambda a: jnp.zeros(a.shape, a.dtype), acc)
+                return zeros, acc, inner
+
+            out, acc, inner = lax.cond(
+                count >= n,
+                lambda a, i: boundary_update(a, i, params, extra),
+                hold, acc, state["inner"])
+            count = jnp.where(count >= n, 0, count)
+
+        return out, {"inner": inner, "acc": acc, "count": count}
+
+    return optax.GradientTransformation(init_acc, update_acc)
 
 
 def distributed_value_and_grad(fun: Callable, argnums=0, *,

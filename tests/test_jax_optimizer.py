@@ -145,3 +145,125 @@ def test_in_jit_adasum_gradient_reduction(mesh8):
                             out_specs=P()))(jnp.asarray(per_rank))
     want = adasum_fold_model(list(per_rank))
     np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# backward_passes_per_step (JAX-tier local gradient aggregation;
+# reference tensorflow/gradient_aggregation.py:16)
+# ---------------------------------------------------------------------------
+
+def test_in_jit_accumulation_matches_big_batch(mesh8):
+    """N=2 microbatch accumulation must produce exactly the update a
+    single step on the summed gradients would (inner state advances
+    once per boundary), with zero updates between boundaries."""
+    params = {"w": jnp.arange(8.0)}
+    opt_acc = hvd.distributed_optimizer(optax.adam(0.1), axis_name="dp",
+                                        backward_passes_per_step=2)
+    opt_ref = hvd.distributed_optimizer(optax.adam(0.1), axis_name="dp")
+
+    def grads_of(x, scale):
+        return {"w": jnp.full(8, x * scale)}
+
+    def acc_run(xs):
+        x = xs[0]
+        state = opt_acc.init(params)
+        p = params
+        for mb in (1.0, 2.0):          # two microbatches
+            updates, state = opt_acc.update(grads_of(x, mb), state, p)
+            p = optax.apply_updates(p, updates)
+        return p, state["count"]
+
+    def ref_run(xs):
+        x = xs[0]
+        state = opt_ref.init(params)
+        updates, _ = opt_ref.update(grads_of(x, 3.0), state, params)
+        return optax.apply_updates(params, updates)
+
+    xs = jnp.arange(8.0)
+    out, count = jax.jit(jax.shard_map(
+        acc_run, mesh=mesh8, in_specs=(P("dp"),), out_specs=(P(), P())))(xs)
+    ref = jax.jit(jax.shard_map(
+        ref_run, mesh=mesh8, in_specs=(P("dp"),), out_specs=P()))(xs)
+    np.testing.assert_allclose(np.asarray(out["w"]), np.asarray(ref["w"]),
+                               rtol=1e-6)
+    assert int(count) == 0  # boundary reset
+
+
+def test_in_jit_accumulation_holds_between_boundaries(mesh8):
+    params = {"w": jnp.zeros(8)}
+    opt = hvd.distributed_optimizer(optax.sgd(1.0), axis_name="dp",
+                                    backward_passes_per_step=3)
+
+    def step(xs):
+        state = opt.init(params)
+        updates, state = opt.update({"w": jnp.full(8, xs[0])}, state,
+                                    params)
+        return updates, state["count"]
+
+    updates, count = jax.jit(jax.shard_map(
+        step, mesh=mesh8, in_specs=(P("dp"),),
+        out_specs=(P(), P())))(jnp.arange(8.0))
+    np.testing.assert_allclose(np.asarray(updates["w"]), 0.0)
+    assert int(count) == 1
+
+
+def _accum_worker():
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    import horovod_tpu.jax as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    params = {"w": jnp.ones(4)}
+    opt = hvd.distributed_optimizer(optax.sgd(0.5),
+                                    backward_passes_per_step=2)
+    state = opt.init(params)
+    p = params
+    # Two microbatches; only the second triggers the collective.
+    for mb, scale in ((0, 1.0), (1, 2.0)):
+        grads = {"w": jnp.full(4, float(r + 1) * scale)}
+        updates, state = opt.update(grads, state, p)
+        p = optax.apply_updates(p, updates)
+        if mb == 0:
+            assert float(np.abs(np.asarray(updates["w"])).max()) == 0.0
+    result = np.asarray(p["w"]).tolist()
+    hvd.shutdown()
+    return result
+
+
+def test_eager_accumulation_two_process():
+    results = run(_accum_worker, np=2, env=_WORKER_ENV, start_timeout=90)
+    assert results[0] == results[1]
+    # local sums: rank0 1+2=3, rank1 2+4=6; averaged -> 4.5
+    assert np.allclose(results[0], 1.0 - 0.5 * 4.5)
+
+
+def test_in_jit_accumulation_under_scan(mesh8):
+    """The canonical microbatch pattern — lax.scan over microbatches
+    with (params, opt_state) as the carry — must typecheck: the
+    accumulator's VMA type is stable between init and update."""
+    from jax import lax
+
+    params = {"w": jnp.zeros(8)}
+    opt = hvd.distributed_optimizer(optax.sgd(1.0), axis_name="dp",
+                                    backward_passes_per_step=2)
+
+    def run(xs):
+        x = xs[0]
+
+        def body(carry, mb_scale):
+            p, s = carry
+            updates, s = opt.update({"w": jnp.full(8, x * mb_scale)}, s, p)
+            return (optax.apply_updates(p, updates), s), None
+
+        (p, _), _ = lax.scan(body, (params, opt.init(params)),
+                             jnp.asarray([1.0, 2.0, 1.0, 2.0]))
+        return p
+
+    out = jax.jit(jax.shard_map(run, mesh=mesh8, in_specs=(P("dp"),),
+                                out_specs=P()))(jnp.arange(8.0))
+    # two boundaries, each applying sum(1x+2x) averaged over dp
+    mean_x = float(jnp.arange(8.0).mean())
+    np.testing.assert_allclose(np.asarray(out["w"]),
+                               -2 * 3.0 * mean_x, rtol=1e-6)
