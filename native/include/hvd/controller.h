@@ -124,6 +124,7 @@ class Controller {
   bool hierarchical_ = false;
   bool hierarchical_fit_ = false;
   bool shm_enabled_ = false;
+  bool shm_wish_ = false;
 
  public:
   void SetFusionThreshold(int64_t bytes) { fusion_threshold_bytes_ = bytes; }
@@ -140,8 +141,12 @@ class Controller {
   // synced verdict during Initialize (single-host on EVERY rank).
   // Coordinator-decided so a per-rank HOROVOD_SHM_DISABLE can never
   // desync the data-plane choice (or the AgreeAll framing).
-  void SetShmEnabled(bool on) { shm_enabled_ = on; }
+  void SetShmEnabled(bool on) { shm_enabled_ = on; shm_wish_ = on; }
   bool shm_enabled() const { return shm_enabled_; }
+  // Rank 0's shm wish BEFORE the single-host downgrade (synced to all
+  // ranks): gates the per-NODE arenas of the hierarchical data plane,
+  // which exist exactly when the job is multi-host.
+  bool shm_wish() const { return shm_wish_; }
   // Autotune (rank 0): stage new tunables for the next broadcast
   // ResponseList so every rank applies them on the same cycle.
   void StageTunedParams(int64_t fusion, double cycle_ms,
@@ -150,9 +155,10 @@ class Controller {
     staged_cycle_ms_ = cycle_ms;
     staged_hier_ = hierarchical;
   }
-  // Init-time agreed layout fitness (rank 0 only): whether the
-  // hierarchical decomposition COULD run — the autotuner may then flip
-  // hierarchical() per cycle within that envelope.
+  // Init-time agreed layout fitness (synced to every rank): whether
+  // the hierarchical decomposition COULD run — the autotuner may then
+  // flip hierarchical() per cycle within that envelope, and the
+  // per-node shm arenas exist within it.
   bool hierarchical_fit() const { return hierarchical_fit_; }
 
  protected:

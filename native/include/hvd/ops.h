@@ -104,6 +104,14 @@ class TcpOps : public OpExecutor {
   // out; three barriers). In place on the fusion buffer.
   Status ShmAllreduce(uint8_t* buf, int64_t elems, DataType dtype,
                       ReduceOp op);
+  // Per-NODE arena eligibility (hierarchical allgather): arena exists,
+  // full world contributes, gathered payload fits a slot.
+  bool NodeShmEligible(int64_t payload_bytes, Status* err);
+  Status HierarchicalShmAllgather(
+      const std::vector<int64_t>& offs,
+      const std::function<void(uint8_t*)>& pack,
+      const std::function<void(const uint8_t*)>& unpack,
+      const std::string& tname);
   // Uniform shm eligibility gate: true when the arena exists and the
   // (response-derived, hence rank-identical) payload fits a slot.
   // Sets *err when the op is eligible but the arena is poisoned —
@@ -113,6 +121,10 @@ class TcpOps : public OpExecutor {
 
   int64_t ring_threshold_bytes_;  // below: recursive doubling
   std::unique_ptr<ShmArena> shm_;
+  // Per-node arena (multi-host jobs with a node-major layout): the
+  // intra-host stages of hierarchical collectives ride shared memory,
+  // the cross-host stage rides the leaders' TCP ring.
+  std::unique_ptr<ShmArena> node_shm_;
   double shm_timeout_secs_ = 60.0;
 };
 

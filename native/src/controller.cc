@@ -491,7 +491,9 @@ Status TcpController::Initialize() {
     std::string params = std::to_string(fusion_threshold_bytes_) + ":" +
                          std::to_string(ring_threshold_bytes_) + ":" +
                          (hierarchical_ ? "1" : "0") + ":" +
-                         (shm_enabled_ ? "1" : "0");
+                         (shm_enabled_ ? "1" : "0") + ":" +
+                         (hierarchical_fit_ ? "1" : "0") + ":" +
+                         (shm_wish_ ? "1" : "0");
     for (int peer = 1; peer < size_; ++peer) {
       if (!ctrl_conns_[peer].SendFrame(params))
         return Status::UnknownError("param sync: lost control link");
@@ -509,12 +511,16 @@ Status TcpController::Initialize() {
     auto c1 = params.find(':');
     auto c2 = c1 == std::string::npos ? c1 : params.find(':', c1 + 1);
     auto c3 = c2 == std::string::npos ? c2 : params.find(':', c2 + 1);
-    if (!ok || c3 == std::string::npos)
+    auto c4 = c3 == std::string::npos ? c3 : params.find(':', c3 + 1);
+    auto c5 = c4 == std::string::npos ? c4 : params.find(':', c4 + 1);
+    if (!ok || c5 == std::string::npos)
       return Status::UnknownError("param sync: lost control link");
     fusion_threshold_bytes_ = std::atoll(params.c_str());
     ring_threshold_bytes_ = std::atoll(params.c_str() + c1 + 1);
     hierarchical_ = params[c2 + 1] == '1';
     shm_enabled_ = params[c3 + 1] == '1';
+    hierarchical_fit_ = params[c4 + 1] == '1';
+    shm_wish_ = params[c5 + 1] == '1';
   }
   return Status::OK();
 }

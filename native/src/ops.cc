@@ -341,6 +341,31 @@ TcpOps::TcpOps(Controller* controller, FusionBufferManager* fusion,
     // deadlines); the authoritative all-or-none verdict rides the
     // controller — if ANY rank failed to map, every rank drops to TCP.
     if (!controller->AgreeAll(shm_ != nullptr)) shm_.reset();
+  } else if (controller->shm_wish() && controller->hierarchical_fit() &&
+             controller->local_size() > 1 &&
+             controller->local_size() < controller->size()) {
+    // Multi-host node-major job: per-NODE arena for the intra-host
+    // stages of hierarchical collectives (reference
+    // MPIHierarchicalAllgather's shm window, mpi_operations.cc:190).
+    // Every gating input is a synced value, so all ranks take this
+    // branch — and the AgreeAll count — together.
+    const char* addr = std::getenv("HOROVOD_CONTROLLER_ADDR");
+    const char* epoch = std::getenv("HOROVOD_ELASTIC_EPOCH");
+    std::string a = addr ? addr : "local";
+    auto colon = a.rfind(':');
+    const int node = controller->rank() / controller->local_size();
+    std::string tag = (colon == std::string::npos ? a : a.substr(colon + 1)) +
+                      "|" + (epoch ? epoch : "0") + "|n" +
+                      std::to_string(node);
+    int64_t slot = std::max<int64_t>(controller->fusion_threshold(),
+                                     64 * 1024 * 1024);
+    node_shm_ = ShmArena::Create(tag, controller->local_rank(),
+                                 controller->local_size(), slot);
+    if (!controller->AgreeAll(node_shm_ != nullptr)) node_shm_.reset();
+    if (node_shm_)
+      LOG_INFO << "shm: node arena up (node " << node << ", "
+               << controller->local_size() << " local ranks) — "
+               << "hierarchical allgather rides shared memory";
   }
   if (const char* t = std::getenv("HOROVOD_SHM_TIMEOUT_SECONDS")) {
     double v = std::atof(t);
@@ -536,6 +561,70 @@ bool TcpOps::ShmEligible(int64_t payload_bytes, Status* err) {
     return true;  // eligible — the caller must fail, not divert to TCP
   }
   return true;
+}
+
+bool TcpOps::NodeShmEligible(int64_t payload_bytes, Status* err) {
+  if (!node_shm_ || payload_bytes > node_shm_->slot_bytes()) return false;
+  if (node_shm_->poisoned()) {
+    *err = Status::UnknownError(
+        "node shm arena poisoned by an earlier failure");
+    return true;  // eligible — the caller must fail, not divert to TCP
+  }
+  return true;
+}
+
+Status TcpOps::HierarchicalShmAllgather(
+    const std::vector<int64_t>& offs,
+    const std::function<void(uint8_t*)>& pack,
+    const std::function<void(const uint8_t*)>& unpack,
+    const std::string& tname) {
+  // Two-level allgather with shared-memory intra-host stages
+  // (reference MPIHierarchicalAllgather, mpi_operations.cc:190):
+  //   1. every local rank writes its block into the node arena at its
+  //      GLOBAL byte offset (node-major ranks make each node's span
+  //      contiguous);
+  //   2. node leaders (local_rank 0) ring-allgather the node spans
+  //      over TCP, reading and writing the arena directly;
+  //   3. everyone unpacks the fully gathered arena.
+  // Barriers: one after the local writes (leader must not ring over
+  // half-written spans) and one after the ring (peers must not read
+  // before the leader lands the remote spans).
+  const int rank = controller_->rank();
+  const int size = controller_->size();
+  const int L = controller_->local_size();
+  const int node = rank / L, lr = rank % L, C = size / L;
+  uint8_t* base = node_shm_->slot(0);
+
+  pack(base);  // my block at offs[rank]
+  if (!node_shm_->Barrier(shm_timeout_secs_))
+    return Status::UnknownError("hier allgather: node peer lost (pack)");
+  if (lr == 0 && C > 1) {
+    std::vector<int64_t> node_offs(C + 1);
+    for (int c = 0; c <= C; ++c) node_offs[c] = offs[c * L];
+    std::vector<int> leaders(C);
+    for (int c = 0; c < C; ++c) leaders[c] = c * L;
+    // Deadline-bound the ring: poison is a PER-NODE fact — a remote
+    // node whose arena poisoned errors out before entering, and
+    // without a recv deadline this leader would block forever while
+    // its own local peers time out and poison the healthy arena too.
+    // SO_RCVTIMEO is per recv call, so a slow-but-flowing transfer
+    // never trips it; only a truly absent peer does.
+    TcpConn* prev = controller_->DataConn(leaders[(node - 1 + C) % C]);
+    const int tmo_ms =
+        std::max(1000, static_cast<int>(shm_timeout_secs_ * 2000));
+    if (prev) prev->SetRecvTimeout(tmo_ms);
+    Status st = RingAllgatherPhase(base, node_offs, DataType::UINT8,
+                                   leaders, node);
+    if (prev) prev->SetRecvTimeout(0);
+    if (!st.ok()) return st;
+  }
+  if (!node_shm_->Barrier(shm_timeout_secs_))
+    return Status::UnknownError("hier allgather: node peer lost (ring)");
+  unpack(base);
+  // Release the arena only after every local rank has copied out.
+  if (!node_shm_->Barrier(shm_timeout_secs_))
+    return Status::UnknownError("hier allgather: node peer lost (unpack)");
+  return Status::OK();
 }
 
 Status TcpOps::ShmAllreduce(uint8_t* buf, int64_t elems, DataType dtype,
@@ -761,9 +850,24 @@ Status TcpOps::Allgather(const Response& r,
   Status shm_err = Status::OK();
   const bool use_shm = ShmEligible(offs[size], &shm_err);
   if (!shm_err.ok()) return shm_err;
+  Status node_err = Status::OK();
+  const bool use_node = !use_shm && NodeShmEligible(offs[size], &node_err);
+  if (!node_err.ok()) return node_err;
   if (timeline_)
-    timeline_->ActivityStart(tname,
-                             use_shm ? ACT_SHM_ALLGATHER : ACT_TCP_ALLGATHER);
+    timeline_->ActivityStart(tname, (use_shm || use_node)
+                                        ? ACT_SHM_ALLGATHER
+                                        : ACT_TCP_ALLGATHER);
+  // Pack my block (my rows of every fused tensor, tensor order) at my
+  // global offset in `base` — shared by the shm, node-hierarchical and
+  // fusion-buffer paths.
+  auto pack = [&](uint8_t* base) {
+    int64_t poff = offs[rank];
+    for (int t = 0; t < nt; ++t) {
+      int64_t bytes = rows(t, rank) * row_bytes[t];
+      std::memcpy(base + poff, entries[t].data, bytes);
+      poff += bytes;
+    }
+  };
   // Unpack a gathered buffer (rank-major blocks, tensor order inside
   // each block) into the per-tensor outputs. Shared by both planes.
   auto unpack = [&](const uint8_t* src_base) {
@@ -781,12 +885,7 @@ Status TcpOps::Allgather(const Response& r,
   };
   if (use_shm) {
     uint8_t* base = shm_->slot(0);
-    int64_t off = offs[rank];
-    for (int t = 0; t < nt; ++t) {
-      int64_t bytes = rows(t, rank) * row_bytes[t];
-      std::memcpy(base + off, entries[t].data, bytes);
-      off += bytes;
-    }
+    pack(base);
     if (!shm_->Barrier(shm_timeout_secs_))
       return Status::UnknownError("shm allgather: peer lost or stalled");
     unpack(base);
@@ -796,12 +895,20 @@ Status TcpOps::Allgather(const Response& r,
     return Status::OK();
   }
 
+  // Multi-host node-major topology with a node arena: hierarchical
+  // allgather (intra-host shm stages + cross-host leader ring).
+  if (use_node) {
+    Status st = HierarchicalShmAllgather(offs, pack, unpack, tname);
+    if (st.ok() && timeline_) timeline_->ActivityEnd(tname);
+    return st;
+  }
+
   if (nt == 1) {
     // Single tensor: ring in place in the output buffer — no staging
     // copy, no fusion-buffer growth to the gathered size.
     auto& e = entries[0];
     uint8_t* out = static_cast<uint8_t*>(e.output);
-    std::memcpy(out + offs[rank], e.data, offs[rank + 1] - offs[rank]);
+    pack(out);
     if (size > 1) {
       Status st = RingAllgatherPhase(out, offs, DataType::UINT8, all_ranks,
                                      rank);
@@ -813,14 +920,8 @@ Status TcpOps::Allgather(const Response& r,
 
   uint8_t* buf = static_cast<uint8_t*>(fusion_->GetBuffer(0, offs[size]));
 
-  // Pack my block: my rows of every tensor, tensor order.
   if (timeline_) timeline_->ActivityStart(tname, ACT_MEMCPY_IN_FUSION_BUFFER);
-  int64_t off = offs[rank];
-  for (int t = 0; t < nt; ++t) {
-    int64_t bytes = rows(t, rank) * row_bytes[t];
-    std::memcpy(buf + off, entries[t].data, bytes);
-    off += bytes;
-  }
+  pack(buf);
   if (timeline_) timeline_->ActivityEnd(tname);
 
   if (size > 1) {

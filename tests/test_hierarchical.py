@@ -44,6 +44,7 @@ def run_two_node_job(scenario: str, local_size: int, n_nodes: int,
             [sys.executable, WORKER, scenario], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
     failed = []
+    outs = []
     for r, p in enumerate(procs):
         try:
             out, _ = p.communicate(timeout=timeout)
@@ -51,10 +52,12 @@ def run_two_node_job(scenario: str, local_size: int, n_nodes: int,
             for q in procs:
                 q.kill()
             raise AssertionError(f"rank {r} timed out")
+        outs.append(out)
         if p.returncode != 0:
             failed.append((r, p.returncode, out))
     assert not failed, "\n".join(
         f"--- rank {r} rc={rc}\n{out}" for r, rc, out in failed)
+    return outs
 
 
 HIER_ENV = {
@@ -106,3 +109,45 @@ def test_hierarchical_refused_on_bad_layout():
     for r, p in enumerate(procs):
         out, _ = p.communicate(timeout=120)
         assert p.returncode == 0, f"rank {r} rc={p.returncode}\n{out}"
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical allgather over the per-node shm arena (reference
+# MPIHierarchicalAllgather, mpi_operations.cc:190)
+# ---------------------------------------------------------------------------
+
+def _assert_node_arena_engaged(outs):
+    joined = "\n".join(outs)
+    assert "node arena up" in joined, (
+        "per-node shm arena did not engage:\n" + joined[:2000])
+
+
+def test_hierarchical_allgather_node_shm_2x2():
+    """Matrix (ragged allgather included) on 2 virtual nodes x 2 local
+    ranks: the per-node arena must come up and the intra-host stages of
+    allgather ride it (intra-host shm gather -> leader ring ->
+    intra-host shm unpack)."""
+    outs = run_two_node_job("matrix", local_size=2, n_nodes=2,
+                            extra_env={"HOROVOD_LOG_LEVEL": "info"})
+    _assert_node_arena_engaged(outs)
+
+
+def test_hierarchical_allgather_node_shm_2x3():
+    outs = run_two_node_job("matrix", local_size=3, n_nodes=2, timeout=180,
+                            extra_env={"HOROVOD_LOG_LEVEL": "info"})
+    _assert_node_arena_engaged(outs)
+
+
+def test_hierarchical_fused_allgather_node_shm():
+    """Fused async allgathers (one response, several ragged tensors)
+    through the node-arena path."""
+    outs = run_two_node_job("fused_allgather", local_size=2, n_nodes=2,
+                            extra_env={"HOROVOD_LOG_LEVEL": "info"})
+    _assert_node_arena_engaged(outs)
+
+
+def test_node_arena_respects_shm_disable():
+    outs = run_two_node_job("matrix", local_size=2, n_nodes=2,
+                            extra_env={"HOROVOD_LOG_LEVEL": "info",
+                                       "HOROVOD_SHM_DISABLE": "1"})
+    assert "node arena up" not in "\n".join(outs)
