@@ -110,8 +110,7 @@ class TcpOps : public OpExecutor {
   Status HierarchicalShmAllgather(
       const std::vector<int64_t>& offs,
       const std::function<void(uint8_t*)>& pack,
-      const std::function<void(const uint8_t*)>& unpack,
-      const std::string& tname);
+      const std::function<void(const uint8_t*)>& unpack);
   // Uniform shm eligibility gate: true when the arena exists and the
   // (response-derived, hence rank-identical) payload fits a slot.
   // Sets *err when the op is eligible but the arena is poisoned —

@@ -44,6 +44,14 @@ class TcpConn {
   int fd_ = -1;
 };
 
+// Dial the first reachable address of a multi-NIC candidate list,
+// verifying the acceptor's acked rank == `expect_rank` (candidate IPs
+// like bridge addresses can exist on several hosts; a constant ack
+// could wire the mesh to the wrong peer).
+bool TcpConnectAny(const std::vector<std::string>& addrs, int my_rank,
+                   int channel, int expect_rank, int timeout_ms,
+                   TcpConn* out);
+
 // Full-duplex exchange: send `sbytes` to `to` while receiving `rbytes`
 // from `from` (which may be the same connection). The concurrent send
 // keeps ring/pairwise exchange steps deadlock-free even when payloads
@@ -73,13 +81,13 @@ class TcpServer {
 
  private:
   bool AcceptOne(std::chrono::steady_clock::time_point deadline,
-                 int32_t hello[2], TcpConn* out);
+                 int my_rank, int32_t hello[2], TcpConn* out);
 
   int listen_fd_ = -1;
 };
 
 // Worker side: connect (with retry) and handshake (rank, channel).
 bool TcpConnect(const std::string& addr, int my_rank, int channel,
-                int timeout_ms, TcpConn* out);
+                int expect_rank, int timeout_ms, TcpConn* out);
 
 }  // namespace hvd

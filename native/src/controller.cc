@@ -1,5 +1,10 @@
 #include "hvd/controller.h"
 
+#include <arpa/inet.h>
+#include <ifaddrs.h>
+#include <net/if.h>
+#include <netinet/in.h>
+
 #include <algorithm>
 #include <cstdlib>
 
@@ -451,8 +456,10 @@ Status TcpController::Initialize() {
   } else {
     ctrl_conns_.resize(1);
     data_conns_.resize(1);
-    if (!TcpConnect(addr_, rank_, 0, timeout_ms, &ctrl_conns_[0]) ||
-        !TcpConnect(addr_, rank_, 1, timeout_ms, &data_conns_[0]))
+    if (!TcpConnect(addr_, rank_, 0, /*expect_rank=*/0, timeout_ms,
+                    &ctrl_conns_[0]) ||
+        !TcpConnect(addr_, rank_, 1, /*expect_rank=*/0, timeout_ms,
+                    &data_conns_[0]))
       return Status::UnknownError("worker failed to connect to controller at " +
                                   addr_);
   }
@@ -550,6 +557,61 @@ bool TcpController::AgreeAll(bool mine) {
   return ok && verdict == "verdict:1";
 }
 
+namespace {
+// Candidate advertise addresses for the peer mesh, most-preferred
+// first. HOROVOD_PEER_HOST forces a single address (explicit operator
+// override); HOROVOD_PEER_HOSTS supplies a comma-separated list (also
+// how tests simulate a multi-NIC host); otherwise: the IP this rank
+// reaches the coordinator with, then every other up, non-loopback
+// IPv4 interface (the reference driver's NIC-set exchange,
+// runner/driver/driver_service.py:266, done peer-to-peer at dial time
+// instead of by central intersection).
+std::vector<std::string> SplitCsv(const std::string& s) {
+  std::vector<std::string> out;
+  size_t pos = 0;
+  while (pos <= s.size()) {
+    size_t c = s.find(',', pos);
+    if (c == std::string::npos) c = s.size();
+    if (c > pos) out.push_back(s.substr(pos, c - pos));
+    pos = c + 1;
+  }
+  return out;
+}
+
+std::vector<std::string> CandidateHosts(const std::string& ctrl_local_ip) {
+  if (const char* h = std::getenv("HOROVOD_PEER_HOST")) return {h};
+  std::vector<std::string> hosts;
+  auto add = [&](const std::string& h) {
+    if (h.empty()) return;
+    for (const auto& e : hosts)
+      if (e == h) return;
+    hosts.push_back(h);
+  };
+  if (const char* hs = std::getenv("HOROVOD_PEER_HOSTS")) {
+    for (const auto& h : SplitCsv(hs)) add(h);
+    return hosts;
+  }
+  add(ctrl_local_ip);
+  ifaddrs* ifs = nullptr;
+  if (getifaddrs(&ifs) == 0) {
+    for (ifaddrs* it = ifs; it != nullptr; it = it->ifa_next) {
+      if (it->ifa_addr == nullptr || it->ifa_addr->sa_family != AF_INET)
+        continue;
+      if (!(it->ifa_flags & IFF_UP) || (it->ifa_flags & IFF_LOOPBACK))
+        continue;
+      char buf[INET_ADDRSTRLEN];
+      auto* sa = reinterpret_cast<sockaddr_in*>(it->ifa_addr);
+      if (inet_ntop(AF_INET, &sa->sin_addr, buf, sizeof(buf)))
+        add(buf);
+    }
+    freeifaddrs(ifs);
+  }
+  if (hosts.empty()) hosts.push_back("127.0.0.1");
+  return hosts;
+}
+
+}  // namespace
+
 Status TcpController::InitializeMesh(int timeout_ms) {
   if (size_ <= 2) return Status::OK();  // star links already form the mesh
   if (rank_ == 0) {
@@ -580,11 +642,12 @@ Status TcpController::InitializeMesh(int timeout_ms) {
   int port = mesh_server_.Listen("0.0.0.0:0");
   if (port < 0)
     return Status::UnknownError("mesh bootstrap: failed to listen");
-  std::string host;
-  if (const char* h = std::getenv("HOROVOD_PEER_HOST")) host = h;
-  if (host.empty()) host = ctrl_conns_[0].LocalIp();
-  if (host.empty()) host = "127.0.0.1";
-  if (!ctrl_conns_[0].SendFrame(host + ":" + std::to_string(port)))
+  std::string line;
+  for (const auto& h : CandidateHosts(ctrl_conns_[0].LocalIp())) {
+    if (!line.empty()) line += ',';
+    line += h + ":" + std::to_string(port);
+  }
+  if (!ctrl_conns_[0].SendFrame(line))
     return Status::UnknownError("mesh bootstrap: lost control link");
   std::string table;
   ctrl_conns_[0].SetRecvTimeout(timeout_ms);
@@ -609,7 +672,9 @@ Status TcpController::InitializeMesh(int timeout_ms) {
   mesh_conns_.clear();
   mesh_conns_.resize(size_);
   for (int peer = 1; peer < rank_; ++peer) {
-    if (!TcpConnect(addrs[peer], rank_, 2, timeout_ms, &mesh_conns_[peer]))
+    if (!TcpConnectAny(SplitCsv(addrs[peer]), rank_, 2,
+                       /*expect_rank=*/peer, timeout_ms,
+                       &mesh_conns_[peer]))
       return Status::UnknownError("mesh bootstrap: failed to reach rank " +
                                   std::to_string(peer) + " at " + addrs[peer]);
   }

@@ -323,20 +323,23 @@ TcpOps::TcpOps(Controller* controller, FusionBufferManager* fusion,
   // post-sync verdict (rank 0's env wish ANDed with every rank's
   // single-host claim), so all ranks enter — or skip — this block
   // together and the AgreeAll framing can never desync.
-  if (controller->shm_enabled()) {
+  // Arena identity: tag by the controller PORT only (the host part
+  // differs per rank — rank 0 binds "0.0.0.0", workers dial the
+  // published host; a mismatched tag would silently split the arena)
+  // plus the elastic epoch, plus an optional scope suffix.
+  auto arena_tag = [](const std::string& suffix) {
     const char* addr = std::getenv("HOROVOD_CONTROLLER_ADDR");
     const char* epoch = std::getenv("HOROVOD_ELASTIC_EPOCH");
-    // Tag by the controller PORT only: the host part differs per rank
-    // (rank 0 binds "0.0.0.0", workers dial the published host), and
-    // a mismatched tag would silently split the arena.
     std::string a = addr ? addr : "local";
     auto colon = a.rfind(':');
-    std::string tag = (colon == std::string::npos ? a : a.substr(colon + 1)) +
-                      "|" + (epoch ? epoch : "0");
-    int64_t slot = std::max<int64_t>(controller->fusion_threshold(),
-                                     64 * 1024 * 1024);
-    shm_ = ShmArena::Create(tag, controller->rank(), controller->size(),
-                            slot);
+    return (colon == std::string::npos ? a : a.substr(colon + 1)) + "|" +
+           (epoch ? epoch : "0") + suffix;
+  };
+  const int64_t arena_slot = std::max<int64_t>(
+      controller->fusion_threshold(), 64 * 1024 * 1024);
+  if (controller->shm_enabled()) {
+    shm_ = ShmArena::Create(arena_tag(""), controller->rank(),
+                            controller->size(), arena_slot);
     // The arena's own attach confirmation is best-effort (wall-clock
     // deadlines); the authoritative all-or-none verdict rides the
     // controller — if ANY rank failed to map, every rank drops to TCP.
@@ -349,18 +352,10 @@ TcpOps::TcpOps(Controller* controller, FusionBufferManager* fusion,
     // MPIHierarchicalAllgather's shm window, mpi_operations.cc:190).
     // Every gating input is a synced value, so all ranks take this
     // branch — and the AgreeAll count — together.
-    const char* addr = std::getenv("HOROVOD_CONTROLLER_ADDR");
-    const char* epoch = std::getenv("HOROVOD_ELASTIC_EPOCH");
-    std::string a = addr ? addr : "local";
-    auto colon = a.rfind(':');
     const int node = controller->rank() / controller->local_size();
-    std::string tag = (colon == std::string::npos ? a : a.substr(colon + 1)) +
-                      "|" + (epoch ? epoch : "0") + "|n" +
-                      std::to_string(node);
-    int64_t slot = std::max<int64_t>(controller->fusion_threshold(),
-                                     64 * 1024 * 1024);
-    node_shm_ = ShmArena::Create(tag, controller->local_rank(),
-                                 controller->local_size(), slot);
+    node_shm_ = ShmArena::Create(arena_tag("|n" + std::to_string(node)),
+                                 controller->local_rank(),
+                                 controller->local_size(), arena_slot);
     if (!controller->AgreeAll(node_shm_ != nullptr)) node_shm_.reset();
     if (node_shm_)
       LOG_INFO << "shm: node arena up (node " << node << ", "
@@ -576,8 +571,7 @@ bool TcpOps::NodeShmEligible(int64_t payload_bytes, Status* err) {
 Status TcpOps::HierarchicalShmAllgather(
     const std::vector<int64_t>& offs,
     const std::function<void(uint8_t*)>& pack,
-    const std::function<void(const uint8_t*)>& unpack,
-    const std::string& tname) {
+    const std::function<void(const uint8_t*)>& unpack) {
   // Two-level allgather with shared-memory intra-host stages
   // (reference MPIHierarchicalAllgather, mpi_operations.cc:190):
   //   1. every local rank writes its block into the node arena at its
@@ -611,14 +605,18 @@ Status TcpOps::HierarchicalShmAllgather(
     // never trips it; only a truly absent peer does.
     TcpConn* prev = controller_->DataConn(leaders[(node - 1 + C) % C]);
     const int tmo_ms =
-        std::max(1000, static_cast<int>(shm_timeout_secs_ * 2000));
+        std::max(1000, static_cast<int>(shm_timeout_secs_ * 1000));
     if (prev) prev->SetRecvTimeout(tmo_ms);
     Status st = RingAllgatherPhase(base, node_offs, DataType::UINT8,
                                    leaders, node);
     if (prev) prev->SetRecvTimeout(0);
     if (!st.ok()) return st;
   }
-  if (!node_shm_->Barrier(shm_timeout_secs_))
+  // Non-leaders wait out the WORST-CASE ring ((C-1) steps, each
+  // bounded by the leader's 1x recv deadline, plus margin): the
+  // leader's deadline must fire first, so a healthy-but-slow ring can
+  // never be poisoned by its own node's peers.
+  if (!node_shm_->Barrier(shm_timeout_secs_ * (C + 1)))
     return Status::UnknownError("hier allgather: node peer lost (ring)");
   unpack(base);
   // Release the arena only after every local rank has copied out.
@@ -898,7 +896,7 @@ Status TcpOps::Allgather(const Response& r,
   // Multi-host node-major topology with a node arena: hierarchical
   // allgather (intra-host shm stages + cross-host leader ring).
   if (use_node) {
-    Status st = HierarchicalShmAllgather(offs, pack, unpack, tname);
+    Status st = HierarchicalShmAllgather(offs, pack, unpack);
     if (st.ok() && timeline_) timeline_->ActivityEnd(tname);
     return st;
   }

@@ -4,6 +4,8 @@
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
+#include <fcntl.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -12,6 +14,17 @@
 #include <thread>
 
 #include "hvd/logging.h"
+
+namespace hvd {
+namespace {
+// Handshake ack word: proves the accepting socket is actually a peer
+// of THIS framework — a NAT catch-all or stray service that accepts
+// the TCP connection but never acks is rejected within the dial slice
+// instead of wedging the mesh bootstrap.
+constexpr int32_t kHelloAck = 0x48564441;  // "HVDA"
+}  // namespace
+}  // namespace hvd
+
 
 namespace hvd {
 
@@ -155,7 +168,7 @@ int TcpServer::Listen(const std::string& addr) {
 // Accept one connection with a shared deadline and read its (rank,
 // channel) handshake. Returns false on timeout/socket error.
 bool TcpServer::AcceptOne(std::chrono::steady_clock::time_point deadline,
-                          int32_t hello[2], TcpConn* out) {
+                          int my_rank, int32_t hello[2], TcpConn* out) {
   timeval tv{};
   auto remain = std::chrono::duration_cast<std::chrono::microseconds>(
                     deadline - std::chrono::steady_clock::now())
@@ -172,6 +185,11 @@ bool TcpServer::AcceptOne(std::chrono::steady_clock::time_point deadline,
   SetNoDelay(fd);
   TcpConn conn(fd);
   if (!conn.RecvAll(hello, sizeof(int32_t) * 2)) return false;
+  // Ack echoes OUR rank: candidate IPs (e.g. identical bridge
+  // addresses on several hosts) can reach the wrong host's listener;
+  // the dialer verifies it reached the rank it meant to.
+  const int32_t ack[2] = {kHelloAck, my_rank};
+  if (!conn.SendAll(ack, sizeof(ack))) return false;
   *out = std::move(conn);
   return true;
 }
@@ -185,16 +203,22 @@ bool TcpServer::AcceptPeers(int n, std::vector<TcpConn>* control_by_rank,
   data_by_rank->resize(n + 1);
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(timeout_ms);
-  for (int i = 0; i < 2 * n; ++i) {
+  // Count UNIQUE (rank, channel) arrivals, replacing duplicates with
+  // the newest connection: a dialer whose ack wait timed out abandons
+  // its connection and redials, and the stale one must not consume an
+  // accept slot (the peer closed it — the latest is the live one).
+  int filled = 0;
+  while (filled < 2 * n) {
     int32_t hello[2];
     TcpConn conn;
-    if (!AcceptOne(deadline, hello, &conn)) return false;
+    if (!AcceptOne(deadline, 0, hello, &conn)) return false;
     if (hello[0] < 1 || hello[0] > n || (hello[1] != 0 && hello[1] != 1)) {
       LOG_ERROR << "controller handshake: bad (rank, channel) = (" << hello[0]
                 << ", " << hello[1] << ")";
       return false;
     }
     auto* vec = hello[1] == 0 ? control_by_rank : data_by_rank;
+    if (!(*vec)[hello[0]].valid()) filled++;
     (*vec)[hello[0]] = std::move(conn);
   }
   return true;
@@ -204,16 +228,18 @@ bool TcpServer::AcceptMesh(int n, int my_rank, std::vector<TcpConn>* out_by_rank
                            int timeout_ms) {
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(timeout_ms);
-  for (int i = 0; i < n; ++i) {
+  int filled = 0;
+  while (filled < n) {  // unique ranks; duplicates replace (see AcceptPeers)
     int32_t hello[2];
     TcpConn conn;
-    if (!AcceptOne(deadline, hello, &conn)) return false;
+    if (!AcceptOne(deadline, my_rank, hello, &conn)) return false;
     if (hello[1] != 2 || hello[0] <= my_rank ||
         hello[0] >= static_cast<int32_t>(out_by_rank->size())) {
       LOG_ERROR << "mesh handshake: bad (rank, channel) = (" << hello[0]
                 << ", " << hello[1] << ") at rank " << my_rank;
       return false;
     }
+    if (!(*out_by_rank)[hello[0]].valid()) filled++;
     (*out_by_rank)[hello[0]] = std::move(conn);
   }
   return true;
@@ -226,34 +252,111 @@ void TcpServer::Close() {
   }
 }
 
+namespace {
+// connect() bounded by `timeout_ms` (non-blocking + poll): a candidate
+// address on an unreachable NIC must cost its slice, not the kernel's
+// multi-minute SYN retry budget.
+int ConnectWithTimeout(const sockaddr_in& sa, int timeout_ms) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int flags = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+  int rc = ::connect(fd, reinterpret_cast<const sockaddr*>(&sa), sizeof(sa));
+  if (rc != 0) {
+    if (errno != EINPROGRESS) {
+      ::close(fd);
+      return -1;
+    }
+    pollfd p{fd, POLLOUT, 0};
+    if (::poll(&p, 1, timeout_ms) <= 0) {
+      ::close(fd);
+      return -1;
+    }
+    int err = 0;
+    socklen_t len = sizeof(err);
+    if (getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len) != 0 || err != 0) {
+      ::close(fd);
+      return -1;
+    }
+  }
+  fcntl(fd, F_SETFL, flags);
+  return fd;
+}
+
+bool DialOnce(const std::string& host, int port, int my_rank, int channel,
+              int expect_rank, int timeout_ms, TcpConn* out) {
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons(static_cast<uint16_t>(port));
+  hostent* he = gethostbyname(host.c_str());
+  if (he != nullptr) {
+    std::memcpy(&sa.sin_addr, he->h_addr, he->h_length);
+  } else {
+    sa.sin_addr.s_addr = inet_addr(host.c_str());
+  }
+  int fd = ConnectWithTimeout(sa, timeout_ms);
+  if (fd < 0) return false;
+  SetNoDelay(fd);
+  TcpConn conn(fd);
+  int32_t hello[2] = {my_rank, channel};
+  if (!conn.SendAll(hello, sizeof(hello))) return false;
+  conn.SetRecvTimeout(std::max(1, timeout_ms));
+  int32_t ack[2] = {0, -1};
+  bool acked = conn.RecvAll(ack, sizeof(ack)) && ack[0] == kHelloAck &&
+               ack[1] == expect_rank;
+  conn.SetRecvTimeout(0);
+  if (!acked) return false;
+  *out = std::move(conn);
+  return true;
+}
+}  // namespace
+
 bool TcpConnect(const std::string& addr, int my_rank, int channel,
-                int timeout_ms, TcpConn* out) {
+                int expect_rank, int timeout_ms, TcpConn* out) {
   std::string host;
   int port;
   if (!SplitAddr(addr, &host, &port)) return false;
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(timeout_ms);
   while (std::chrono::steady_clock::now() < deadline) {
-    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
-    if (fd < 0) return false;
-    sockaddr_in sa{};
-    sa.sin_family = AF_INET;
-    sa.sin_port = htons(static_cast<uint16_t>(port));
-    hostent* he = gethostbyname(host.c_str());
-    if (he != nullptr) {
-      std::memcpy(&sa.sin_addr, he->h_addr, he->h_length);
-    } else {
-      sa.sin_addr.s_addr = inet_addr(host.c_str());
-    }
-    if (::connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) == 0) {
-      SetNoDelay(fd);
-      TcpConn conn(fd);
-      int32_t hello[2] = {my_rank, channel};
-      if (!conn.SendAll(hello, sizeof(hello))) return false;
-      *out = std::move(conn);
+    int left = static_cast<int>(
+        std::chrono::duration_cast<std::chrono::milliseconds>(
+            deadline - std::chrono::steady_clock::now())
+            .count());
+    if (DialOnce(host, port, my_rank, channel, expect_rank,
+                 std::max(1, left), out))
       return true;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+  return false;
+}
+
+bool TcpConnectAny(const std::vector<std::string>& addrs, int my_rank,
+                   int channel, int expect_rank, int timeout_ms,
+                   TcpConn* out) {
+  // Multi-NIC peers advertise every candidate address; dial them round
+  // robin with bounded per-candidate slices until one answers (the
+  // reachability ELECTION happens here, per peer pair — the analog of
+  // the reference driver's cross-host NIC intersection,
+  // runner/driver/driver_service.py:266).
+  if (addrs.empty()) return false;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  const int slice = std::max(
+      250, std::min(3000, timeout_ms / (2 * static_cast<int>(addrs.size()))));
+  while (std::chrono::steady_clock::now() < deadline) {
+    for (const auto& addr : addrs) {
+      std::string host;
+      int port;
+      if (!SplitAddr(addr, &host, &port)) continue;
+      if (DialOnce(host, port, my_rank, channel, expect_rank, slice,
+                   out)) {
+        LOG_DEBUG << "mesh dial: rank " << my_rank << " reached peer via "
+                  << addr;
+        return true;
+      }
+      LOG_DEBUG << "mesh dial: candidate " << addr << " not reachable";
     }
-    ::close(fd);
     std::this_thread::sleep_for(std::chrono::milliseconds(50));
   }
   return false;

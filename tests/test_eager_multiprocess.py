@@ -165,3 +165,36 @@ def test_torch_differentiable_collectives(np_):
     outs = run_job("torch_grads", np_, timeout=180)
     for r, out in enumerate(outs):
         assert f"OK rank={r}" in out
+
+
+# ---------------------------------------------------------------------------
+# Multi-NIC advertise-address election (reference driver NIC
+# intersection, runner/driver/driver_service.py:266)
+# ---------------------------------------------------------------------------
+
+def test_multi_nic_candidate_election():
+    """Two-NIC simulation: every rank advertises a blackhole address
+    first and loopback second (HOROVOD_PEER_HOSTS). The mesh dialer
+    must fall through the unreachable candidate within its bounded
+    slice and form the full peer mesh on the reachable one."""
+    outs = run_job("matrix", 3, timeout=120, extra_env={
+        "HOROVOD_PEER_HOSTS": "10.255.255.1,127.0.0.1",
+        # Force the TCP peer mesh (shm would bypass peer dialing).
+        "HOROVOD_SHM_DISABLE": "1",
+    })
+    for r, out in enumerate(outs):
+        assert f"OK rank={r}" in out
+
+
+def test_multi_nic_all_unreachable_fails_fast():
+    """Only unreachable candidates: init must surface a bounded error
+    (the non-blocking dialer), never hang on the kernel SYN backoff."""
+    import time
+    t0 = time.monotonic()
+    with pytest.raises(AssertionError):
+        run_job("matrix", 3, timeout=90, extra_env={
+            "HOROVOD_PEER_HOSTS": "10.255.255.1",
+            "HOROVOD_SHM_DISABLE": "1",
+            "HOROVOD_CONTROLLER_TIMEOUT_MS": "6000",
+        })
+    assert time.monotonic() - t0 < 80
