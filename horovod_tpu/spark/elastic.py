@@ -59,7 +59,7 @@ class SparkHostDiscovery(HostDiscovery):
 def run_elastic(fn: Callable, args: tuple = (),
                 kwargs: Optional[dict] = None, *,
                 num_proc: Optional[int] = None,
-                min_np: int = 1, max_np: int = 0,
+                min_np: Optional[int] = None, max_np: int = 0,
                 env: Optional[Dict[str, str]] = None,
                 start_timeout: float = 120.0,
                 discovery: Optional[HostDiscovery] = None,
@@ -79,8 +79,12 @@ def run_elastic(fn: Callable, args: tuple = (),
     # num_proc is the reference's fixed-size convenience: it bounds the
     # elastic window when min/max are not given explicitly.
     if num_proc:
-        min_np = min_np if min_np > 1 else num_proc
+        # None (unset) defaults to num_proc; an EXPLICIT min_np — 1
+        # included — is honored (reference uses None as the sentinel).
+        min_np = min_np or num_proc
         max_np = max_np or num_proc
+    elif min_np is None:
+        min_np = 1
     worker_env = prepend_package_pythonpath(env or {})
     settings = LaunchSettings(
         np=num_proc or 0,

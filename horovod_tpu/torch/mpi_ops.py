@@ -38,9 +38,12 @@ def synchronize(handle):
         first_error = None
         # Drain EVERY member handle even if one fails: an abandoned
         # handle would leak its runtime entry and block reuse of the
-        # tensor name. The copy is data movement, not an autograd op —
-        # no_grad so nn.Parameters (requires_grad leaves) are writable,
-        # like the reference's C++ output==input enqueue.
+        # tensor name. Every member that DID synchronize copies out —
+        # even after an earlier member failed — so grouped in-place
+        # tensors are never left in a mixed updated/stale state the
+        # caller cannot distinguish. The copy is data movement, not an
+        # autograd op — no_grad so nn.Parameters (requires_grad leaves)
+        # are writable, like the reference's C++ output==input enqueue.
         with torch.no_grad():
             for h, t in zip(handle.handles, handle.tensors):
                 try:
@@ -49,8 +52,7 @@ def synchronize(handle):
                     if first_error is None:
                         first_error = e
                     continue
-                if first_error is None:
-                    t.copy_(out.view(t.shape))
+                t.copy_(out.view(t.shape))
         if first_error is not None:
             raise first_error
         return handle.tensors[0] if handle.single else list(handle.tensors)

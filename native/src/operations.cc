@@ -447,6 +447,19 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   st.controller->SetShmEnabled(
       size > 1 && std::getenv("HOROVOD_SHM_DISABLE") == nullptr);
   hvd::Status s = st.controller->Initialize();
+  if (s.ok() && std::getenv("HOROVOD_SHM_DISABLE") != nullptr &&
+      (st.controller->shm_enabled() ||
+       (st.controller->shm_wish() && st.controller->hierarchical_fit() &&
+        st.controller->local_size() > 1 &&
+        st.controller->local_size() < st.controller->size()))) {
+    // Deliberate (controller.h: the data-plane choice must be job-
+    // wide), but silently ignoring a rank's env knob surprises people
+    // debugging one rank — say so.
+    LOG_WARNING << "HOROVOD_SHM_DISABLE is set on this rank but the "
+                   "coordinator's synced verdict enables shm; the knob "
+                   "must be set job-wide (rank 0 / --no-shm) to take "
+                   "effect";
+  }
   if (s.ok() && rank == 0)
     st.param_manager.SetHierarchicalTunable(
         st.controller->hierarchical_fit() && size > 1,

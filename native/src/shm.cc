@@ -35,10 +35,25 @@ struct ShmArena::Control {
   std::atomic<uint32_t> confirmed;  // creator saw ALL ranks attached
   std::atomic<uint32_t> arrived;    // barrier arrivals this generation
   std::atomic<uint32_t> generation;
+  // Full job tag (truncated): the shm NAME is a hash of the tag, so a
+  // hash collision (or a second job racing its attach window) can put
+  // a DIFFERENT job behind the same name — every mapper verifies this
+  // before trusting (or reclaiming) the segment. Written by the
+  // creator before the magic release-store.
+  char tag[96];
 };
 
 static constexpr uint32_t kMagic = 0x68766453;  // "hvdS"
-static constexpr int64_t kCtrlBytes = 64;
+static constexpr int64_t kCtrlBytes = 128;
+
+namespace {
+constexpr size_t kTagCap = 96;  // == sizeof(Control::tag)
+bool TagMatches(const char* have, const std::string& tag) {
+  char want[kTagCap] = {};
+  std::strncpy(want, tag.c_str(), kTagCap - 1);
+  return std::memcmp(have, want, kTagCap) == 0;
+}
+}  // namespace
 
 std::unique_ptr<ShmArena> ShmArena::Create(const std::string& tag, int rank,
                                            int nranks, int64_t slot_bytes) {
@@ -55,8 +70,52 @@ std::unique_ptr<ShmArena> ShmArena::Create(const std::string& tag, int rank,
   if (rank == 0) {
     int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
     if (fd < 0 && errno == EEXIST) {
-      // Stale segment from a crashed earlier job with the same tag
-      // hash: reclaim the name once.
+      // The name is taken. Map the existing control block and check
+      // WHOSE segment it is before touching it: only a leftover
+      // carrying OUR OWN tag (a crashed predecessor of this exact job
+      // instance) may be reclaimed — unlinking a live different-tag
+      // job's segment (name-hash collision, or a second job racing
+      // its short pre-attach window) would kill that job's data
+      // plane. A different-tag segment drops US to TCP instead.
+      bool reclaim = false;
+      int efd = shm_open(name, O_RDWR, 0600);
+      if (efd >= 0) {
+        // Bounded grace for a mid-create owner: it may still be before
+        // its ftruncate (size 0) or before its magic release-store —
+        // reclaiming in that window would unlink a LIVE job.
+        const double d2 = NowSecs() + 2.0;
+        struct stat est{};
+        while ((fstat(efd, &est) != 0 ||
+                est.st_size < static_cast<off_t>(kCtrlBytes)) &&
+               NowSecs() < d2)
+          usleep(1000);
+        if (est.st_size >= static_cast<off_t>(kCtrlBytes)) {
+          void* eb = mmap(nullptr, kCtrlBytes, PROT_READ, MAP_SHARED,
+                          efd, 0);
+          if (eb != MAP_FAILED) {
+            auto* ec = static_cast<Control*>(eb);
+            while (ec->magic.load(std::memory_order_acquire) != kMagic &&
+                   NowSecs() < d2)
+              usleep(1000);
+            if (ec->magic.load(std::memory_order_acquire) != kMagic) {
+              reclaim = true;  // never initialized: stale half-create
+            } else {
+              reclaim = TagMatches(ec->tag, tag);
+            }
+            munmap(eb, kCtrlBytes);
+          }
+        } else {
+          reclaim = true;  // still size-0 after the grace: stale
+        }
+        close(efd);
+      } else {
+        reclaim = true;  // vanished between EEXIST and open: gone
+      }
+      if (!reclaim) {
+        LOG_WARNING << "shm: name " << name << " belongs to a LIVE "
+                    << "different job (tag-hash collision); using TCP";
+        return nullptr;
+      }
       shm_unlink(name);
       fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
     }
@@ -116,6 +175,10 @@ std::unique_ptr<ShmArena> ShmArena::Create(const std::string& tag, int rank,
         usleep(1000);
       if (ctrl->magic.load(std::memory_order_acquire) != kMagic)
         continue;  // deadline check at loop head reports the timeout
+      if (!TagMatches(ctrl->tag, tag)) {
+        usleep(2000);  // another job's segment; wait for OUR creator
+        continue;
+      }
       if (ctrl->confirmed.load(std::memory_order_acquire) == 1) {
         usleep(2000);  // stale leftover; wait for the creator's recreate
         continue;
@@ -141,6 +204,9 @@ std::unique_ptr<ShmArena> ShmArena::Create(const std::string& tag, int rank,
     arena->ctrl_->confirmed.store(0, std::memory_order_relaxed);
     arena->ctrl_->arrived.store(0, std::memory_order_relaxed);
     arena->ctrl_->generation.store(0, std::memory_order_relaxed);
+    std::memset(arena->ctrl_->tag, 0, sizeof(arena->ctrl_->tag));
+    std::strncpy(arena->ctrl_->tag, tag.c_str(),
+                 sizeof(arena->ctrl_->tag) - 1);
     arena->ctrl_->magic.store(kMagic, std::memory_order_release);
   }
 
