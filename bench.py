@@ -138,46 +138,64 @@ def _transformer_worker():
 
     try:
         mesh = build_mesh(dp=-1)
-        # Shape chosen by on-chip sweep (d=512 is overhead-bound ~8%
-        # MFU; d=2048×8L sustains ~46%; this d=4096×4L shape hits ~56%
-        # on v5e — larger matmuls tile the MXU better. Bigger shapes
-        # (6+ layers, batch 16) exceed this environment's compile
-        # helper limits.)
-        cfg = TransformerConfig(
-            vocab_size=8192, d_model=4096, n_layers=4, n_heads=32,
-            n_kv_heads=8, d_ff=16384, max_seq=1024, dtype=jnp.bfloat16,
-            sp_attention="local")
-        batch, seq = 8 * mesh.devices.size, 1024
-        init_state, step, _ = make_train_step(cfg, mesh)
-        state = jax.jit(init_state)(jax.random.PRNGKey(0))
-        toks = jax.random.randint(jax.random.PRNGKey(1), (batch, seq + 1),
-                                  0, cfg.vocab_size)
-        b = {"tokens": jax.device_put(
-            toks, NamedSharding(mesh, P(("dp", "fsdp"), None)))}
-        for _ in range(3):
-            state, loss = step(state, b)
-        float(loss)
-        iters = 20
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            state, loss = step(state, b)
-        float(loss)
-        dt = time.perf_counter() - t0
-        tok_s = batch * seq * iters / dt
-
-        n_params = sum(int(x.size) for x in
-                       jax.tree.leaves(state["params"]))
-        flops_per_tok = 6 * n_params  # fwd+bwd dense-matmul approximation
         kind = jax.devices()[0].device_kind.lower()
         peak = {"v5 lite": 197e12, "v5litepod": 197e12,
                 "v4": 275e12, "v5p": 459e12}
         peak_flops = next((v for k, v in peak.items() if k in kind), None)
-        out = {"transformer_tokens_per_sec_per_chip":
-               round(tok_s / mesh.devices.size, 1)}
-        if peak_flops:
-            out["transformer_mfu_pct"] = round(
-                100 * flops_per_tok * tok_s / mesh.devices.size
-                / peak_flops, 1)
+
+        def measure(cfg, batch, seq, iters=20):
+            init_state, step, _ = make_train_step(cfg, mesh)
+            state = jax.jit(init_state)(jax.random.PRNGKey(0))
+            toks = jax.random.randint(jax.random.PRNGKey(1),
+                                      (batch, seq + 1), 0, cfg.vocab_size)
+            b = {"tokens": jax.device_put(
+                toks, NamedSharding(mesh, P(("dp", "fsdp"), None)))}
+            for _ in range(3):
+                state, loss = step(state, b)
+            float(loss)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                state, loss = step(state, b)
+            float(loss)
+            dt = time.perf_counter() - t0
+            tok_s = batch * seq * iters / dt / mesh.devices.size
+            n_params = sum(int(x.size) for x in
+                           jax.tree.leaves(state["params"]))
+            del state
+            mfu = (round(100 * 6 * n_params * tok_s / peak_flops, 1)
+                   if peak_flops else None)
+            return round(tok_s, 1), mfu
+
+        out = {}
+        # HEADLINE: a standard-proportioned 8-layer d=2048 GQA decoder
+        # (not a benchmark-friendly shallow/wide shape). Tuned by
+        # on-chip sweep: flash attention with sequence-spanning tiles
+        # (halves the attention FLOPs vs dense-causal and avoids the
+        # [T,T] score materialization), remat off, layer scan unrolled,
+        # checkpoint CSE allowed — 61.6% vs 46% for the round-3
+        # defaults. Bigger shapes (d4096 at 6+ layers, batch 16+)
+        # exceed this environment's compile-helper limits.
+        cfg_std = TransformerConfig(
+            vocab_size=8192, d_model=2048, n_layers=8, n_heads=16,
+            n_kv_heads=8, d_ff=8192, max_seq=1024, dtype=jnp.bfloat16,
+            sp_attention="flash", flash_block_q=1024, flash_block_k=1024,
+            remat=False, scan_unroll=8)
+        tok_s, mfu = measure(cfg_std, 8 * mesh.devices.size, 1024)
+        out["transformer_std_tokens_per_sec_per_chip"] = tok_s
+        if mfu is not None:
+            out["transformer_std_mfu_pct"] = mfu
+        print("TFEXTRA " + json.dumps(out), flush=True)
+
+        # Secondary: the round-3 d=4096x4L wide-shallow shape, kept for
+        # cross-round comparability.
+        cfg_wide = TransformerConfig(
+            vocab_size=8192, d_model=4096, n_layers=4, n_heads=32,
+            n_kv_heads=8, d_ff=16384, max_seq=1024, dtype=jnp.bfloat16,
+            sp_attention="local")
+        tok_s, mfu = measure(cfg_wide, 8 * mesh.devices.size, 1024)
+        out["transformer_tokens_per_sec_per_chip"] = tok_s
+        if mfu is not None:
+            out["transformer_mfu_pct"] = mfu
         print("TFEXTRA " + json.dumps(out), flush=True)
     except Exception:
         pass
@@ -197,12 +215,18 @@ def _transformer_extra(remaining_secs: float):
              "--transformer-worker"],
             capture_output=True, text=True, timeout=timeout,
             env=dict(os.environ))
-    except subprocess.TimeoutExpired:
-        return None
-    for line in proc.stdout.splitlines():
+        stdout = proc.stdout
+    except subprocess.TimeoutExpired as e:
+        # The headline metric may already have printed before the
+        # (secondary-config) overrun — keep what we got.
+        stdout = e.stdout or b""
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode(errors="replace")
+    found = None
+    for line in stdout.splitlines():
         if line.startswith("TFEXTRA "):
-            return json.loads(line[len("TFEXTRA "):])
-    return None
+            found = json.loads(line[len("TFEXTRA "):])
+    return found
 
 
 def main():

@@ -117,7 +117,9 @@ def ring_self_attention(q, k, v, *, axis_name: str = "sp",
 
 def ring_flash_attention(q, k, v, *, axis_name: str = "sp",
                          causal: bool = True,
-                         scale: Optional[float] = None):
+                         scale: Optional[float] = None,
+                         block_q: Optional[int] = None,
+                         block_k: Optional[int] = None):
     """Ring attention whose per-chunk block compute is the **flash
     Pallas kernel** (:mod:`horovod_tpu.ops.flash_attention`): each of
     the ``sp`` steps runs fused attention of the local queries against
@@ -149,13 +151,19 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "sp",
 
     # Chunk outputs stay f32 until the final merge so bf16 inputs round
     # exactly once, like ring_self_attention's f32 accumulator.
+    blocks = {kk: vv for kk, vv in
+              (("block_q", block_q), ("block_k", block_k))
+              if vv is not None}
+
     def full_chunk(qb, kb, vb):
         return flash_attention_with_lse(qb, kb, vb, causal=False,
-                                        scale=scale, out_dtype=jnp.float32)
+                                        scale=scale, out_dtype=jnp.float32,
+                                        **blocks)
 
     def diag_chunk(qb, kb, vb):
         return flash_attention_with_lse(qb, kb, vb, causal=True,
-                                        scale=scale, out_dtype=jnp.float32)
+                                        scale=scale, out_dtype=jnp.float32,
+                                        **blocks)
 
     def skip_chunk(qb, kb, vb):
         return (jnp.zeros((B * H, T, D), jnp.float32),
@@ -240,7 +248,8 @@ def ulysses_attention(q, k, v, *, axis_name: str = "sp",
 
 
 def make_sp_attention(mesh, *, axis_name: str = "sp", impl: str = "ring",
-                      causal: bool = True, spec=None):
+                      causal: bool = True, spec=None,
+                      block_q=None, block_k=None):
     """Build ``attend(q, k, v)``: ring/Ulysses attention as a
     partial-manual ``shard_map`` island inside an outer GSPMD program.
 
@@ -262,7 +271,10 @@ def make_sp_attention(mesh, *, axis_name: str = "sp", impl: str = "ring",
                 "impl='flash' is the sp=1 kernel; use impl='ring_flash' "
                 "for sequence parallelism with the Pallas block kernel")
         from horovod_tpu.ops.flash_attention import flash_attention
-        fa = functools.partial(flash_attention, causal=causal)
+        blocks = {k: v for k, v in
+                  (("block_q", block_q), ("block_k", block_k))
+                  if v is not None}
+        fa = functools.partial(flash_attention, causal=causal, **blocks)
         fa.handles_gqa = True  # native grouped K/V; no pre-tiling needed
         if mesh is None:
             return fa
@@ -300,8 +312,11 @@ def make_sp_attention(mesh, *, axis_name: str = "sp", impl: str = "ring",
         body = functools.partial(ring_self_attention, axis_name=axis_name,
                                  causal=causal)
     elif impl == "ring_flash":
+        blocks = {k: v for k, v in
+                  (("block_q", block_q), ("block_k", block_k))
+                  if v is not None}
         body = functools.partial(ring_flash_attention, axis_name=axis_name,
-                                 causal=causal)
+                                 causal=causal, **blocks)
     elif impl == "ulysses":
         body = functools.partial(ulysses_attention, axis_name=axis_name,
                                  causal=causal)

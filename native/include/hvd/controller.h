@@ -147,6 +147,13 @@ class Controller {
   // ranks): gates the per-NODE arenas of the hierarchical data plane,
   // which exist exactly when the job is multi-host.
   bool shm_wish() const { return shm_wish_; }
+  // Single source of the per-node arena gating (used by the data
+  // plane's arena setup AND the override-notice in operations.cc —
+  // duplicating the predicate would let the two drift).
+  bool node_shm_applicable() const {
+    return shm_wish_ && hierarchical_fit_ && local_size_ > 1 &&
+           local_size_ < size_;
+  }
   // Autotune (rank 0): stage new tunables for the next broadcast
   // ResponseList so every rank applies them on the same cycle.
   void StageTunedParams(int64_t fusion, double cycle_ms,

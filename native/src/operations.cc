@@ -449,9 +449,7 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   hvd::Status s = st.controller->Initialize();
   if (s.ok() && std::getenv("HOROVOD_SHM_DISABLE") != nullptr &&
       (st.controller->shm_enabled() ||
-       (st.controller->shm_wish() && st.controller->hierarchical_fit() &&
-        st.controller->local_size() > 1 &&
-        st.controller->local_size() < st.controller->size()))) {
+       st.controller->node_shm_applicable())) {
     // Deliberate (controller.h: the data-plane choice must be job-
     // wide), but silently ignoring a rank's env knob surprises people
     // debugging one rank — say so.

@@ -344,9 +344,7 @@ TcpOps::TcpOps(Controller* controller, FusionBufferManager* fusion,
     // deadlines); the authoritative all-or-none verdict rides the
     // controller — if ANY rank failed to map, every rank drops to TCP.
     if (!controller->AgreeAll(shm_ != nullptr)) shm_.reset();
-  } else if (controller->shm_wish() && controller->hierarchical_fit() &&
-             controller->local_size() > 1 &&
-             controller->local_size() < controller->size()) {
+  } else if (controller->node_shm_applicable()) {
     // Multi-host node-major job: per-NODE arena for the intra-host
     // stages of hierarchical collectives (reference
     // MPIHierarchicalAllgather's shm window, mpi_operations.cc:190).

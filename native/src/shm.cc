@@ -43,7 +43,11 @@ struct ShmArena::Control {
   char tag[96];
 };
 
-static constexpr uint32_t kMagic = 0x68766453;  // "hvdS"
+// "hvdT": bumped when the Control layout changes (the tag field grew
+// the block past the old 64-byte format) — a pre-upgrade leftover then
+// fails the magic check and takes the stale-reclaim path instead of
+// being misread as a live foreign job.
+static constexpr uint32_t kMagic = 0x68766454;
 static constexpr int64_t kCtrlBytes = 128;
 
 namespace {
@@ -57,6 +61,9 @@ bool TagMatches(const char* have, const std::string& tag) {
 
 std::unique_ptr<ShmArena> ShmArena::Create(const std::string& tag, int rank,
                                            int nranks, int64_t slot_bytes) {
+  static_assert(sizeof(Control) <= kCtrlBytes,
+                "Control grew past its reserved bytes; the pid array "
+                "would overlap");
   // Name must be identical across ranks and unique per job; hash the
   // tag to stay under NAME_MAX and avoid '/' from "host:port".
   char name[64];
