@@ -6,13 +6,18 @@ carries everything training needs to survive a membership change
 failures and host updates into state rollback + re-rendezvous instead
 of job death.
 
-Protocol differences from the reference are transport-level only: host
-updates arrive by polling the launcher's KV store at ``commit()`` /
-``check_host_updates()`` boundaries (the reference pushes them over a
-worker RPC service, but also only *applies* them at these same
-boundaries), and re-rendezvous asks the elastic driver's KV table for
-this worker's new coordinates instead of the Gloo
-``HOROVOD_GLOO_GET_RANK_AND_SIZE`` scope (``gloo_context.cc:154-200``).
+Protocol differences from the reference are transport-level only: a
+background watcher thread polls the launcher's KV store for the
+membership epoch (the analog of the reference's push notification RPC,
+``runner/elastic/worker.py``) so ``commit()`` /
+``check_host_updates()`` see pending updates WITHOUT paying a KV
+round-trip per call — updates are still *applied* only at those
+boundaries, exactly like the reference. Re-rendezvous asks the elastic
+driver's KV table for this worker's new coordinates instead of the
+Gloo ``HOROVOD_GLOO_GET_RANK_AND_SIZE`` scope
+(``gloo_context.cc:154-200``). Watcher cadence:
+``HOROVOD_ELASTIC_POLL_SECS`` (default 1 s) bounds how stale a long
+step window's view of membership can be.
 """
 
 from __future__ import annotations
@@ -50,6 +55,82 @@ def current_epoch() -> int:
     return int(raw) if raw else 0
 
 
+class _EpochWatcher:
+    """Daemon thread mirroring the driver-published epoch into this
+    process (the notification-RPC analog): ``latest()`` is a memory
+    read, so ``commit()`` costs no HTTP round-trip and a worker in a
+    long step window is at most one poll interval stale. When polls
+    keep FAILING, ``stale()`` turns true and the check boundaries fall
+    back to a direct (loud-failing) KV read — a dead launcher store
+    must not leave workers silently training on stale membership."""
+
+    def __init__(self, initial_epoch: int):
+        import threading
+        import time
+        self._lock = threading.Lock()
+        self._latest = initial_epoch
+        try:
+            iv = float(os.environ.get("HOROVOD_ELASTIC_POLL_SECS", "1.0"))
+        except ValueError:
+            iv = 1.0
+        # Lower bound: 0 would busy-spin HTTP GETs at the KV server.
+        self._interval = max(0.05, iv)
+        self._last_ok = time.monotonic()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="hvd-epoch-watcher")
+        self._thread.start()
+
+    def _run(self):
+        import time
+        warned = False
+        while not self._stop.wait(self._interval):
+            try:
+                e = current_epoch()
+            except Exception:
+                if not warned and self.stale():
+                    warned = True
+                    import logging
+                    logging.getLogger("horovod_tpu").warning(
+                        "elastic epoch watcher: launcher KV unreachable; "
+                        "membership checks fall back to direct reads")
+                continue
+            warned = False
+            self._last_ok = time.monotonic()
+            self.observe(e)
+
+    def observe(self, epoch: int) -> None:
+        """Advance the mirrored epoch (forward-only)."""
+        with self._lock:
+            if epoch > self._latest:
+                self._latest = epoch
+
+    def latest(self) -> int:
+        with self._lock:
+            return self._latest
+
+    def stale(self) -> bool:
+        """True when polling has failed for several intervals — the
+        mirror can no longer be trusted."""
+        import time
+        return time.monotonic() - self._last_ok > 5 * self._interval
+
+    def stop(self):
+        self._stop.set()
+
+
+_watcher: Optional[_EpochWatcher] = None
+
+
+def _epoch_watcher(initial_epoch: int = 0) -> Optional[_EpochWatcher]:
+    """Process-wide watcher, started lazily on first State creation
+    in an elastic job (None outside one)."""
+    global _watcher
+    if _watcher is None and _rdv():
+        _watcher = _EpochWatcher(initial_epoch)
+    return _watcher
+
+
 class State:
     """Base state: commit/restore/sync + host-update detection
     (reference ``common/elastic.py:26-96``)."""
@@ -57,6 +138,11 @@ class State:
     def __init__(self, **kwargs):
         self._reset_callbacks = []
         self._known_epoch = current_epoch()
+        # Seed (or advance) the watcher with the epoch just read — no
+        # second KV round-trip, and the mirror never runs backwards.
+        w = _epoch_watcher(self._known_epoch)
+        if w is not None:
+            w.observe(self._known_epoch)
 
     def register_reset_callbacks(self, callbacks) -> None:
         self._reset_callbacks.extend(callbacks)
@@ -72,7 +158,16 @@ class State:
         self.check_host_updates()
 
     def check_host_updates(self) -> None:
-        epoch = current_epoch()
+        w = _epoch_watcher()
+        if w is not None and not w.stale():
+            epoch = w.latest()
+        else:
+            # No watcher, or its polls keep failing: read directly so
+            # a dead KV store fails LOUDLY at the check boundary
+            # instead of silently freezing membership.
+            epoch = current_epoch()
+            if w is not None:
+                w.observe(epoch)
         if epoch > self._known_epoch:
             self._known_epoch = epoch
             raise HostsUpdatedInterrupt()

@@ -307,3 +307,56 @@ def test_elastic_sampler_pad_smaller_than_world(monkeypatch):
         s.reset()
         assert len(s) == 1
         assert list(s) == [8]
+
+
+def test_epoch_watcher_sees_updates_without_commit(monkeypatch):
+    """The background watcher (the notification-RPC analog) must mirror
+    a driver epoch bump into the process within a couple of poll
+    intervals, and check_host_updates must then interrupt WITHOUT its
+    own KV round-trip."""
+    import time as _time
+
+    import horovod_tpu.elastic as el
+    from horovod_tpu.common.exceptions import HostsUpdatedInterrupt
+    from horovod_tpu.runner.http_kv import KVServer, kv_put
+
+    server = KVServer(host="127.0.0.1")
+    server.start()
+    try:
+        addr = f"127.0.0.1:{server.port}"
+        monkeypatch.setenv("HOROVOD_RENDEZVOUS_ADDR", addr)
+        monkeypatch.setenv("HOROVOD_RENDEZVOUS_TOKEN", server.token)
+        monkeypatch.setenv("HOROVOD_ELASTIC_POLL_SECS", "0.1")
+        monkeypatch.setattr(el, "_watcher", None)
+        kv_put(addr, el.ASSIGN_SCOPE, "epoch", b"1")
+
+        class S(el.State):
+            def save(self):
+                pass
+
+            def restore(self):
+                pass
+
+            def sync(self):
+                pass
+
+        st = S()
+        kv_put(addr, el.ASSIGN_SCOPE, "epoch", b"2")
+        deadline = _time.monotonic() + 5.0
+        while _time.monotonic() < deadline:
+            if el._watcher.latest() >= 2:
+                break
+            _time.sleep(0.05)
+        assert el._watcher.latest() >= 2, "watcher never saw the bump"
+        # check_host_updates reads the mirrored value (no KV call) and
+        # interrupts.
+        monkeypatch.setattr(el, "current_epoch",
+                            lambda: (_ for _ in ()).throw(
+                                AssertionError("KV hit in check")))
+        with pytest.raises(HostsUpdatedInterrupt):
+            st.check_host_updates()
+    finally:
+        if el._watcher is not None:
+            el._watcher.stop()
+        el._watcher = None
+        server.stop()
