@@ -56,6 +56,13 @@ def _resolve_hosts(settings: LaunchSettings) -> List[hosts_mod.HostInfo]:
         return hosts_mod.parse_hostfile(settings.hostfile)
     if settings.hosts:
         return hosts_mod.parse_hosts(settings.hosts)
+    # No explicit hosts: inside a batch-scheduler allocation (LSF's
+    # LSB_MCPU_HOSTS, Slurm's SLURM_JOB_NODELIST) use the allocated
+    # nodes (reference runner/util/lsf.py role, generalized).
+    from horovod_tpu.runner.schedulers import detect_scheduler_hosts
+    sched = detect_scheduler_hosts()
+    if sched:
+        return sched
     return [hosts_mod.HostInfo("localhost", settings.np)]
 
 
@@ -531,7 +538,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                   file=sys.stderr)
             return 1
         return 0
-    codes = launch_static(settings)
+    try:
+        codes = launch_static(settings)
+    except ValueError as e:
+        # e.g. -np exceeding the (possibly scheduler-derived) slot
+        # count — a usage error, not a traceback.
+        print(f"horovodrun: {e}", file=sys.stderr)
+        return 2
     failures = {r: c for r, c in codes.items() if c != 0}
     if failures:
         print(f"horovodrun: ranks failed: {failures}", file=sys.stderr)

@@ -1,0 +1,153 @@
+"""Cluster-scheduler allocation detection (LSF, Slurm).
+
+Rebuild of the reference's LSF utilities (``runner/util/lsf.py`` —
+``LSFUtils.get_compute_hosts``/``get_num_processes``), generalized: the
+reference shells out to Summit's CSM tools; here the standard scheduler
+env contract is enough to derive the host:slots list, and Slurm (the
+common case on today's clusters) is covered alongside LSF.
+
+``horovodrun`` consults :func:`detect_scheduler_hosts` when neither
+``-H`` nor ``--hostfile`` is given, so inside a batch allocation
+(``bsub``/``sbatch``) the job lands on the allocated nodes without
+repeating them on the command line.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import List, Optional
+
+from horovod_tpu.runner.hosts import HostInfo
+
+
+def lsf_available() -> bool:
+    """True inside an LSF job (reference ``LSFUtils.using_lsf``)."""
+    return "LSB_JOBID" in os.environ
+
+
+def lsf_hosts() -> List[HostInfo]:
+    """Hosts from ``LSB_MCPU_HOSTS`` ("h1 n1 h2 n2 ..."), or
+    ``LSB_HOSTS`` (one token per slot) as the fallback. The batch/launch
+    node (LSF lists it first, with one slot) is excluded when compute
+    hosts follow — the reference's ``get_compute_hosts`` likewise
+    returns compute nodes only."""
+    mcpu = os.environ.get("LSB_MCPU_HOSTS", "").split()
+    if mcpu:
+        if len(mcpu) % 2:
+            raise ValueError(f"malformed LSB_MCPU_HOSTS: {mcpu!r}")
+        hosts = [HostInfo(mcpu[i], int(mcpu[i + 1]))
+                 for i in range(0, len(mcpu), 2)]
+        if len(hosts) > 1 and hosts[0].slots == 1:
+            hosts = hosts[1:]  # drop the launch node
+        return hosts
+    hosts = os.environ.get("LSB_HOSTS", "").split()
+    out: List[HostInfo] = []
+    for h in hosts:  # token per slot; preserve first-seen order
+        for i, hi in enumerate(out):
+            if hi.hostname == h:
+                out[i] = HostInfo(h, hi.slots + 1)
+                break
+        else:
+            out.append(HostInfo(h, 1))
+    return out
+
+
+def slurm_available() -> bool:
+    return "SLURM_JOB_NODELIST" in os.environ or "SLURM_NODELIST" in os.environ
+
+
+def expand_slurm_nodelist(nodelist: str) -> List[str]:
+    """Expand Slurm's compressed form: ``"n[01-03,07],gpu1"`` ->
+    ``["n01", "n02", "n03", "n07", "gpu1"]`` (zero padding kept)."""
+    out: List[str] = []
+    # Split on commas OUTSIDE brackets.
+    parts, depth, cur = [], 0, ""
+    for ch in nodelist:
+        if ch == "[":
+            depth += 1
+        elif ch == "]":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append(cur)
+            cur = ""
+        else:
+            cur += ch
+    if cur:
+        parts.append(cur)
+    def expand_one(name: str) -> List[str]:
+        # Expand the FIRST bracket group, then recurse on the rest —
+        # Slurm emits multi-dimensional names like "r[1-2]n[01-02]".
+        m = re.search(r"\[([^\]]+)\]", name)
+        if not m:
+            return [name]
+        prefix, body, suffix = name[:m.start()], m.group(1), name[m.end():]
+        expanded: List[str] = []
+        for item in body.split(","):
+            if "-" in item:
+                lo, hi = item.split("-", 1)
+                width = len(lo)
+                for v in range(int(lo), int(hi) + 1):
+                    expanded.append(f"{prefix}{v:0{width}d}{suffix}")
+            else:
+                expanded.append(f"{prefix}{item}{suffix}")
+        result: List[str] = []
+        for e in expanded:
+            result.extend(expand_one(e))
+        return result
+
+    for part in parts:
+        out.extend(expand_one(part))
+    return out
+
+
+def expand_slurm_tasks_per_node(spec: str, n_hosts: int) -> List[int]:
+    """``"2(x3),1"`` -> [2, 2, 2, 1]; a short spec repeats its last
+    entry (Slurm omits the tail when uniform)."""
+    counts: List[int] = []
+    for item in spec.split(","):
+        m = re.fullmatch(r"(\d+)(?:\(x(\d+)\))?", item.strip())
+        if not m:
+            raise ValueError(f"malformed SLURM tasks-per-node: {spec!r}")
+        n, rep = int(m.group(1)), int(m.group(2) or 1)
+        counts.extend([n] * rep)
+    while len(counts) < n_hosts:
+        counts.append(counts[-1] if counts else 1)
+    return counts[:n_hosts]
+
+
+def slurm_hosts() -> List[HostInfo]:
+    nodelist = (os.environ.get("SLURM_JOB_NODELIST")
+                or os.environ.get("SLURM_NODELIST", ""))
+    names = expand_slurm_nodelist(nodelist)
+    # Per-node slot counts, most specific first. SLURM_CPUS_ON_NODE is
+    # deliberately NOT used: it describes only the CURRENT node, and
+    # crediting it to every allocated node would block-pack ranks onto
+    # node 1 while the rest sit idle.
+    spec = (os.environ.get("SLURM_TASKS_PER_NODE")
+            or os.environ.get("SLURM_NTASKS_PER_NODE")
+            or os.environ.get("SLURM_JOB_CPUS_PER_NODE", ""))
+    counts = (expand_slurm_tasks_per_node(spec, len(names)) if spec
+              else [1] * len(names))
+    return [HostInfo(h, c) for h, c in zip(names, counts)]
+
+
+def detect_scheduler_hosts() -> Optional[List[HostInfo]]:
+    """The batch scheduler's allocation as a host list, or None when
+    not running under one (or the env is unusable)."""
+    try:
+        if lsf_available():
+            hosts = lsf_hosts()
+            if hosts:
+                return hosts
+        if slurm_available():
+            hosts = slurm_hosts()
+            if hosts:
+                return hosts
+    except ValueError as e:
+        import logging
+        logging.getLogger("horovod_tpu").warning(
+            "scheduler allocation env is malformed (%s); falling back "
+            "to localhost — pass -H/--hostfile to silence", e)
+        return None
+    return None

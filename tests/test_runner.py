@@ -468,3 +468,53 @@ def test_horovodrun_mpi_missing_mpirun(capfd, monkeypatch, tmp_path):
     rc = main(["--mpi", "-np", "2", "--", "python", "x.py"])
     assert rc == 2
     assert "could not find a working mpirun" in capfd.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# Scheduler allocation detection (reference runner/util/lsf.py role)
+# ---------------------------------------------------------------------------
+
+def test_lsf_hosts(monkeypatch):
+    from horovod_tpu.runner.schedulers import detect_scheduler_hosts
+
+    monkeypatch.setenv("LSB_JOBID", "123")
+    # The 1-slot launch node LSF lists first is excluded.
+    monkeypatch.setenv("LSB_MCPU_HOSTS", "batch 1 n01 4 n02 4")
+    assert detect_scheduler_hosts() == [
+        HostInfo("n01", 4), HostInfo("n02", 4)]
+    monkeypatch.delenv("LSB_MCPU_HOSTS")
+    monkeypatch.setenv("LSB_HOSTS", "n01 n01 n02")
+    assert detect_scheduler_hosts() == [HostInfo("n01", 2),
+                                        HostInfo("n02", 1)]
+
+
+def test_slurm_hosts(monkeypatch):
+    from horovod_tpu.runner.schedulers import (
+        detect_scheduler_hosts, expand_slurm_nodelist,
+        expand_slurm_tasks_per_node)
+
+    assert expand_slurm_nodelist("n[01-03,07],gpu1") == [
+        "n01", "n02", "n03", "n07", "gpu1"]
+    # multi-dimensional names expand every bracket group
+    assert expand_slurm_nodelist("r[1-2]n[01-02]") == [
+        "r1n01", "r1n02", "r2n01", "r2n02"]
+    assert expand_slurm_tasks_per_node("2(x3),1", 4) == [2, 2, 2, 1]
+    assert expand_slurm_tasks_per_node("4", 3) == [4, 4, 4]
+
+    monkeypatch.setenv("SLURM_JOB_NODELIST", "c[1-2]")
+    monkeypatch.setenv("SLURM_TASKS_PER_NODE", "8(x2)")
+    assert detect_scheduler_hosts() == [HostInfo("c1", 8),
+                                        HostInfo("c2", 8)]
+
+
+def test_resolve_hosts_uses_scheduler(monkeypatch):
+    from horovod_tpu.runner.launch import LaunchSettings, _resolve_hosts
+
+    monkeypatch.setenv("SLURM_JOB_NODELIST", "nd[1-2]")
+    monkeypatch.setenv("SLURM_TASKS_PER_NODE", "2(x2)")
+    hosts = _resolve_hosts(LaunchSettings(np=4, command=["x"]))
+    assert hosts == [HostInfo("nd1", 2), HostInfo("nd2", 2)]
+    # Explicit -H wins over the scheduler env.
+    hosts = _resolve_hosts(LaunchSettings(np=2, command=["x"],
+                                          hosts="h9:2"))
+    assert hosts == [HostInfo("h9", 2)]
