@@ -45,32 +45,42 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
         m_scr[:] = jnp.full_like(m_scr, NEG_INF)
         l_scr[:] = jnp.zeros_like(l_scr)
 
-    q = q_ref[0].astype(jnp.float32)            # [bq, d]
-    k = k_ref[0].astype(jnp.float32)            # [bk, d]
-    v = v_ref[0].astype(jnp.float32)
+    # Causal block skip: a kv block strictly above the diagonal
+    # (every k_pos > every q_pos) contributes nothing — masking it
+    # after the matmul would still pay the full MXU cost, which is
+    # HALF the causal grid at long sequence. Guarding the body keeps
+    # the skipped steps at grid-iteration cost only (measured ~1.7x
+    # forward throughput at seq 8192 on v5e).
+    visible = ((qi + 1) * block_q - 1 >= ki * block_k) if causal else True
 
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
+    @pl.when(visible)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)            # [bq, d]
+        k = k_ref[0].astype(jnp.float32)            # [bk, d]
+        v = v_ref[0].astype(jnp.float32)
 
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32,
-                                                    (block_q, block_k), 0)
-    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32,
-                                                    (block_q, block_k), 1)
-    mask = k_pos >= seq_len                     # padded kv rows
-    if causal:
-        mask = mask | (k_pos > q_pos)
-    s = jnp.where(mask, NEG_INF, s)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
 
-    m_prev = m_scr[:]                            # [bq, 1]
-    l_prev = l_scr[:]
-    m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
-    p = jnp.exp(s - m_new)
-    alpha = jnp.exp(m_prev - m_new)
-    l_new = alpha * l_prev + p.sum(axis=1, keepdims=True)
-    acc[:] = acc[:] * alpha + jax.lax.dot(
-        p.astype(v.dtype), v, preferred_element_type=jnp.float32)
-    m_scr[:] = m_new
-    l_scr[:] = l_new
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_pos >= seq_len                     # padded kv rows
+        if causal:
+            mask = mask | (k_pos > q_pos)
+        s = jnp.where(mask, NEG_INF, s)
+
+        m_prev = m_scr[:]                            # [bq, 1]
+        l_prev = l_scr[:]
+        m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + p.sum(axis=1, keepdims=True)
+        acc[:] = acc[:] * alpha + jax.lax.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+        l_scr[:] = l_new
 
     @pl.when(ki == pl.num_programs(2) - 1)
     def _finalize():
@@ -296,7 +306,7 @@ _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 def flash_attention_with_lse(q, k, v, *, causal: bool = False,
                              scale: Optional[float] = None,
-                             block_q: int = 128, block_k: int = 128,
+                             block_q: int = 512, block_k: int = 1024,
                              interpret: Optional[bool] = None,
                              out_dtype=None):
     """``[BH, T, D]``-layout flash attention returning ``(out, lse)``
@@ -315,7 +325,7 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = False,
 
 def flash_attention(q, k, v, *, causal: bool = True,
                     scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int = 512, block_k: int = 1024,
                     interpret: Optional[bool] = None):
     """Fused attention over ``[B, T, H, D]`` q with ``[B, T, Hkv, D]``
     k/v, ``H % Hkv == 0`` — **GQA runs natively**: grouped K/V are read
@@ -323,11 +333,13 @@ def flash_attention(q, k, v, *, causal: bool = True,
     (an Hkv=H/4 model moves 4× less K/V through HBM than pre-tiling).
     Differentiable via custom VJP.
 
-    Block-size guidance (measured on v5e at seq 8192): the training
-    defaults (128×128) are fastest for fwd+bwd; forward-ONLY callers
-    (decode/prefill scoring) gain ~20% from ``block_q=block_k=512``
-    — larger tiles amortize grid overhead, but the recompute-based
-    backward prefers the smaller forward tiles."""
+    Block-size guidance (measured on v5e at seq 8192, with the causal
+    block skip): the 512×1024 defaults are fastest for BOTH forward
+    and fwd+bwd (1.6× the old 128×128 tiles — small tiles pay grid
+    overhead that dwarfs their cache friendliness); at short sequence
+    a block spanning the whole sequence wins (see
+    ``TransformerConfig.flash_block_q``). Blocks ≥2048 exceed this
+    environment's compile limits."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if interpret is None:
