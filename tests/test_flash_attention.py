@@ -264,3 +264,26 @@ def test_chunked_backward_matches_dense_with_lse_cotangent(monkeypatch, t):
     for a, b in zip(dense, chunked):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("bq,bk", [(128, 128), (128, 256), (256, 128)])
+def test_causal_block_skip_multiblock_grid(bq, bk):
+    """The causal block-skip branch with a REAL multi-block kv grid
+    (every other test clamps to one sequence-spanning block): values
+    must match plain attention, including the on-diagonal boundary
+    blocks the skip condition must keep visible."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from horovod_tpu.ops.flash_attention import flash_attention
+    from horovod_tpu.parallel.ring_attention import local_attention
+
+    B, T, H, D = 1, 512, 2, 128  # T/bk in {2, 4}: ki grid > 1
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q, k, v = (jax.random.normal(kk, (B, T, H, D), jnp.float32)
+               for kk in ks)
+    out = flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk)
+    ref = local_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
