@@ -8,6 +8,7 @@ from horovod_tpu.parallel.mesh import (  # noqa: F401
 )
 from horovod_tpu.parallel.pipeline import (  # noqa: F401
     make_pp_train_step,
+    make_pp_train_step_1f1b,
     pipeline_apply,
     pp_param_specs,
     pp_reshape_layers,

@@ -167,6 +167,41 @@ def pp_param_specs(cfg, n_stages: int):
         respecs, base["layers"], is_leaf=lambda x: isinstance(x, P))}
 
 
+def _wire_train_step(cfg, mesh: Mesh, loss_fn, optimizer):
+    """Shared tail of both pp step factories: stage-reshaped params,
+    sharded init, value_and_grad step, donated jit."""
+    import optax
+
+    from horovod_tpu.models import transformer as tr
+
+    S = mesh.shape["pp"]
+    specs = pp_param_specs(cfg, S)
+
+    def init_state(key):
+        params = pp_reshape_layers(tr.init_params(cfg, key), S)
+        shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                                 is_leaf=lambda x: isinstance(x, P))
+        params = jax.device_put(params, shardings)
+        return {"params": params, "opt": optimizer.init(params),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def step(state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"], batch)
+        updates, new_opt = optimizer.update(grads, state["opt"],
+                                            state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return {"params": params, "opt": new_opt,
+                "step": state["step"] + 1}, loss
+
+    param_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                            is_leaf=lambda x: isinstance(x, P))
+    batch_sh = {"tokens": NamedSharding(mesh, P(("dp", "fsdp"), None))}
+    jit_step = jax.jit(step, donate_argnums=(0,),
+                       in_shardings=(None, batch_sh),
+                       out_shardings=(None, NamedSharding(mesh, P())))
+    return init_state, jit_step, param_sh
+
+
 def make_pp_train_step(cfg, mesh: Mesh, n_micro: int, optimizer=None):
     """GPipe training step for the transformer over a mesh with pp>1
     (compose with dp/fsdp/tp/ep as usual). sp inside a pipeline stage
@@ -237,28 +272,80 @@ def make_pp_train_step(cfg, mesh: Mesh, n_micro: int, optimizer=None):
         # microbatch-summed aux must be averaged back.
         return nll.mean() + aux / n_micro
 
-    specs = pp_param_specs(cfg, S)
+    return _wire_train_step(cfg, mesh, loss_fn, optimizer)
 
-    def init_state(key):
-        params = pp_reshape_layers(tr.init_params(cfg, key), S)
-        shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
-                                 is_leaf=lambda x: isinstance(x, P))
-        params = jax.device_put(params, shardings)
-        return {"params": params, "opt": optimizer.init(params),
-                "step": jnp.zeros((), jnp.int32)}
 
-    def step(state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(state["params"], batch)
-        updates, new_opt = optimizer.update(grads, state["opt"],
-                                            state["params"])
-        params = optax.apply_updates(state["params"], updates)
-        return {"params": params, "opt": new_opt,
-                "step": state["step"] + 1}, loss
+def make_pp_train_step_1f1b(cfg, mesh: Mesh, n_micro: int, optimizer=None):
+    """1F1B training step for the transformer over a mesh with pp>1 —
+    the memory-bounded alternative to :func:`~horovod_tpu.parallel.
+    pipeline.make_pp_train_step` (GPipe): per-stage residency is
+    ``O(pp)`` microbatch activations instead of ``O(n_micro)``, so deep
+    pipelines can raise ``n_micro`` to shrink the bubble without
+    scaling activation memory.
 
-    param_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
-                            is_leaf=lambda x: isinstance(x, P))
-    batch_sh = {"tokens": NamedSharding(mesh, P(("dp", "fsdp"), None))}
-    jit_step = jax.jit(step, donate_argnums=(0,),
-                       in_shardings=(None, batch_sh),
-                       out_shardings=(None, NamedSharding(mesh, P())))
-    return init_state, jit_step, param_sh
+    Same composition rules as the GPipe step: dp/fsdp/tp compose under
+    GSPMD; sp inside a stage is unsupported (nested manual islands);
+    MoE is unsupported in the 1F1B schedule (the aux loss would need
+    threading through the explicit backward) — use GPipe for pp+ep.
+
+    Returns ``(init_state, jit_step, param_shardings)``.
+    """
+    import optax
+
+    from horovod_tpu.models import transformer as tr
+    from horovod_tpu.parallel.pipeline_1f1b import make_1f1b_loss
+
+    if mesh.shape.get("sp", 1) > 1:
+        raise NotImplementedError("pp + sp composition is not supported")
+    if cfg.moe is not None:
+        raise NotImplementedError(
+            "MoE inside the 1F1B schedule is not supported; use the "
+            "GPipe step (make_pp_train_step) for pp+ep")
+    if optimizer is None:
+        optimizer = optax.adamw(3e-4, weight_decay=0.01)
+    S = mesh.shape["pp"]
+    constrain = tr._constrainer(mesh)
+    attend = tr._attention_island(
+        dataclasses.replace(cfg, sp_attention="local"), None)
+
+    def one_layer(x, lp):
+        return tr.decoder_layer(cfg, attend, lambda v, *s: v, x, lp)[0], None
+
+    layer = one_layer
+    if cfg.remat:
+        layer = jax.checkpoint(one_layer, policy=tr.remat_policy_fn(cfg),
+                               prevent_cse=cfg.remat_prevent_cse)
+
+    def stage_fn(stage_layers, x):
+        y, _ = lax.scan(layer, x, stage_layers)
+        return y
+
+    def loss_fn(params, batch):
+        tokens = batch["tokens"]
+        inp, tgt = tokens[:, :-1], tokens[:, 1:]
+        B, T = inp.shape
+        if B % n_micro:
+            raise ValueError(f"batch {B} not divisible by n_micro={n_micro}")
+        x = tr.embed_lookup(params["embed"], inp, cfg.dtype, mesh)
+        x = constrain(x, ("dp", "fsdp"), None, None)
+        mb = x.reshape(n_micro, B // n_micro, T, x.shape[-1])
+        tgt_mb = tgt.reshape(n_micro, B // n_micro, T)
+
+        def last_fn(lastp, y, m_idx):
+            h = tr._rmsnorm(y, lastp["final_norm"], cfg.norm_eps)
+            logits = (h @ lastp["lm_head"]).astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            t_m = lax.dynamic_index_in_dim(tgt_mb, m_idx, 0,
+                                           keepdims=False)
+            nll = -jnp.take_along_axis(logp, t_m[..., None],
+                                       axis=-1)[..., 0]
+            # Per-microbatch mean / n_micro: the schedule SUMS the
+            # microbatch losses, so the total is the full-batch mean.
+            return nll.mean() / n_micro
+
+        pl = make_1f1b_loss(stage_fn, last_fn, mesh)
+        lastp = {"final_norm": params["final_norm"],
+                 "lm_head": params["lm_head"]}
+        return pl(params["layers"], lastp, mb)
+
+    return _wire_train_step(cfg, mesh, loss_fn, optimizer)
