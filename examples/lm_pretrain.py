@@ -4,10 +4,11 @@ declared as mesh axes, and XLA inserts every collective.
 
 This is the tier the eager examples point at for performance; it has
 no reference analog (the reference is process-per-rank only, this is
-the TPU-first redesign). Shows: mesh construction (dp/fsdp/tp/sp),
+the TPU-first redesign). Shows: mesh construction (dp/fsdp/tp/sp/pp),
 ``make_train_step`` (scan-over-layers Llama-family model, remat,
-sharded optimizer state), synthetic token stream, loss logging, and a
-final-checkpoint save via ``orbax`` when available.
+sharded optimizer state) or the pipelined factories
+(``--pp N --pp-schedule gpipe|1f1b``), synthetic token stream, loss
+logging, and a final-checkpoint save via ``orbax`` when available.
 
 Run (any device count; axes auto-fold to what exists):
   python examples/lm_pretrain.py --steps 20 --dp 2 --tp 2
@@ -32,6 +33,14 @@ def main():
     ap.add_argument("--fsdp", type=int, default=1)
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--sp", type=int, default=1)
+    ap.add_argument("--pp", type=int, default=1,
+                    help="pipeline stages (composes with dp/fsdp/tp)")
+    ap.add_argument("--pp-schedule", default="gpipe",
+                    choices=["gpipe", "1f1b"],
+                    help="gpipe: AD-replayed; 1f1b: interleaved "
+                         "backward, O(pp) activation residency")
+    ap.add_argument("--n-micro", type=int, default=2,
+                    help="microbatches per step when --pp > 1")
     ap.add_argument("--tiny", action="store_true",
                     help="2-layer d=64 model (CI smoke)")
     ap.add_argument("--platform", default=None, choices=[None, "cpu", "tpu"])
@@ -46,9 +55,11 @@ def main():
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from horovod_tpu.models import TransformerConfig, make_train_step
-    from horovod_tpu.parallel import build_mesh
+    from horovod_tpu.parallel import (build_mesh, make_pp_train_step,
+                                      make_pp_train_step_1f1b)
 
-    mesh = build_mesh(dp=args.dp, fsdp=args.fsdp, tp=args.tp, sp=args.sp)
+    mesh = build_mesh(dp=args.dp, fsdp=args.fsdp, tp=args.tp, sp=args.sp,
+                      pp=args.pp)
     if args.tiny:
         cfg = TransformerConfig.tiny(max_seq=args.seq)
     else:
@@ -58,7 +69,12 @@ def main():
             dtype=jnp.bfloat16,
             sp_attention="ring" if args.sp > 1 else "local")
 
-    init_state, step, _ = make_train_step(cfg, mesh)
+    if args.pp > 1:
+        factory = (make_pp_train_step_1f1b
+                   if args.pp_schedule == "1f1b" else make_pp_train_step)
+        init_state, step, _ = factory(cfg, mesh, n_micro=args.n_micro)
+    else:
+        init_state, step, _ = make_train_step(cfg, mesh)
     state = jax.jit(init_state)(jax.random.PRNGKey(0))
     n_params = sum(int(x.size) for x in jax.tree.leaves(state["params"]))
     print(f"mesh={dict(zip(mesh.axis_names, mesh.devices.shape))} "
