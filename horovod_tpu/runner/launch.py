@@ -28,6 +28,13 @@ from horovod_tpu.runner.safe_exec import WorkerProcess, wait_all
 
 _LOCAL_NAMES = {"localhost", "127.0.0.1", "::1"}
 
+#: env prefixes forwarded to workers by BOTH launch transports (ssh and
+#: mpirun): the framework's own namespaces plus the accelerator
+#: runtime's. Keys outside these reach local workers via inheritance
+#: and remote ones via the login shell or settings.env.
+FORWARD_ENV_PREFIXES = ("HOROVOD_", "TPU_", "PALLAS_", "JAX_", "XLA_")
+FORWARD_ENV_KEYS = ("PYTHONPATH", "PATH", "CLOUD_TPU_TASK_ID")
+
 
 def is_local_host(hostname: str) -> bool:
     return (hostname in _LOCAL_NAMES
@@ -57,12 +64,21 @@ def _resolve_hosts(settings: LaunchSettings) -> List[hosts_mod.HostInfo]:
     if settings.hosts:
         return hosts_mod.parse_hosts(settings.hosts)
     # No explicit hosts: inside a batch-scheduler allocation (LSF's
-    # LSB_MCPU_HOSTS, Slurm's SLURM_JOB_NODELIST) use the allocated
-    # nodes (reference runner/util/lsf.py role, generalized).
+    # LSB_MCPU_HOSTS, Slurm's SLURM_JOB_NODELIST, PBS_NODEFILE) use the
+    # allocated nodes (reference runner/util/lsf.py role, generalized).
     from horovod_tpu.runner.schedulers import detect_scheduler_hosts
     sched = detect_scheduler_hosts()
     if sched:
-        return sched
+        if sum(h.slots for h in sched) >= settings.np:
+            return sched
+        # Allocation smaller than -np (e.g. sbatch -n1 -c8 running 8
+        # local ranks): keep the pre-scheduler behavior rather than
+        # fail a launch that used to work — loudly.
+        import logging
+        logging.getLogger("horovod_tpu").warning(
+            "batch allocation provides %d slots < -np %d; launching on "
+            "localhost instead (pass -H/--hostfile to silence)",
+            sum(h.slots for h in sched), settings.np)
     return [hosts_mod.HostInfo("localhost", settings.np)]
 
 
@@ -107,8 +123,8 @@ def _ssh_command(slot: hosts_mod.SlotInfo, command: Sequence[str],
     shell provides the rest), run from the same working directory."""
     exports = " ".join(
         f"{k}={shlex.quote(v)}" for k, v in sorted(env.items())
-        if k.startswith(("HOROVOD_", "TPU_")) or k in forward_keys
-        or k in ("PYTHONPATH", "PATH", "CLOUD_TPU_TASK_ID"))
+        if k.startswith(FORWARD_ENV_PREFIXES) or k in forward_keys
+        or k in FORWARD_ENV_KEYS)
     remote = (f"cd {shlex.quote(os.getcwd())} && "
               f"env {exports} {' '.join(shlex.quote(c) for c in command)}")
     cmd = ["ssh", "-o", "StrictHostKeyChecking=no", "-o", "BatchMode=yes"]

@@ -26,10 +26,6 @@ _IMPLS = (
     ("MPICH", "mpich"), ("HYDRA", "mpich"),
 )
 
-#: env prefixes forwarded to every rank besides explicit settings.env
-#: keys (the reference forwards everything "exportable"; we forward the
-#: framework's own namespaces plus the accelerator runtime's).
-_FORWARD_PREFIXES = ("HOROVOD_", "TPU_", "PALLAS_", "JAX_", "XLA_")
 
 MPI_NOT_FOUND_MSG = (
     "horovodrun --mpi could not find a working mpirun.\n"
@@ -62,9 +58,15 @@ def detect_mpi_implementation(mpirun: str = "mpirun",
 
 def forwarded_env_keys(env: Dict[str, str],
                        extra_keys: Sequence[str] = ()) -> List[str]:
+    # Same forwarding policy as the ssh launcher (one shared constant,
+    # so the two transports cannot drift).
+    from horovod_tpu.runner.launch import (FORWARD_ENV_KEYS,
+                                           FORWARD_ENV_PREFIXES)
     keys = {k for k in env
-            if k.startswith(_FORWARD_PREFIXES) or k in ("PYTHONPATH",)}
+            if k.startswith(FORWARD_ENV_PREFIXES)
+            or k in FORWARD_ENV_KEYS}
     keys.update(k for k in extra_keys if k in env)
+    keys.discard("PATH")  # mpirun must see its own PATH resolution
     return sorted(keys)
 
 
@@ -92,8 +94,18 @@ def build_mpi_command(*, np: int, impl: str, env: Dict[str, str],
         # Hydra process manager family: -genvlist forwards by name.
         cmd += ["-np", str(np)]
         if hosts:
-            cmd += ["-hosts", ",".join(
-                h.split(":")[0] for h in hosts.split(","))]
+            names, counts = [], []
+            for h in hosts.split(","):
+                name, _, cnt = h.partition(":")
+                names.append(name)
+                counts.append(int(cnt) if cnt else 1)
+            if len(set(counts)) > 1:
+                raise ValueError(
+                    "Hydra launchers (MPICH/Intel) take a uniform "
+                    "per-host process count; heterogeneous -H slot "
+                    f"counts {counts} need a machinefile — pass one "
+                    "through your mpirun config instead")
+            cmd += ["-hosts", ",".join(names), "-ppn", str(counts[0])]
         if ssh_port:
             if impl == "intel":
                 cmd += ["-bootstrap", "ssh",
@@ -118,7 +130,11 @@ def launch_mpi(settings, kv_server=None) -> Dict[int, int]:
 
     The launcher still owns the rendezvous KV: rank 0 discovers a
     controller port and publishes it exactly as under the ssh launcher
-    — only process PLACEMENT moves to MPI.
+    — only process PLACEMENT moves to MPI. The host list (for the KV
+    bind scope and the -H/-hosts spec) comes from -H/--hostfile or the
+    batch scheduler env (LSF/Slurm/PBS via runner/schedulers.py); under
+    a scheduler this launcher does not know about, pass -H explicitly —
+    otherwise the KV binds loopback while mpirun places ranks remotely.
     """
     import os
     import socket

@@ -1,4 +1,4 @@
-"""Cluster-scheduler allocation detection (LSF, Slurm).
+"""Cluster-scheduler allocation detection (LSF, Slurm, PBS).
 
 Rebuild of the reference's LSF utilities (``runner/util/lsf.py`` —
 ``LSFUtils.get_compute_hosts``/``get_num_processes``), generalized: the
@@ -38,8 +38,12 @@ def lsf_hosts() -> List[HostInfo]:
             raise ValueError(f"malformed LSB_MCPU_HOSTS: {mcpu!r}")
         hosts = [HostInfo(mcpu[i], int(mcpu[i + 1]))
                  for i in range(0, len(mcpu), 2)]
-        if len(hosts) > 1 and hosts[0].slots == 1:
-            hosts = hosts[1:]  # drop the launch node
+        # Drop the 1-slot launch node LSF lists first — but ONLY when
+        # larger compute hosts follow: in a span[ptile=1] allocation
+        # every host legitimately has one slot and all are compute.
+        if (len(hosts) > 1 and hosts[0].slots == 1
+                and any(h.slots > 1 for h in hosts[1:])):
+            hosts = hosts[1:]
         return hosts
     hosts = os.environ.get("LSB_HOSTS", "").split()
     out: List[HostInfo] = []
@@ -50,6 +54,28 @@ def lsf_hosts() -> List[HostInfo]:
                 break
         else:
             out.append(HostInfo(h, 1))
+    return out
+
+
+def pbs_available() -> bool:
+    return bool(os.environ.get("PBS_NODEFILE"))
+
+
+def pbs_hosts() -> List[HostInfo]:
+    """PBS/Torque: PBS_NODEFILE lists one hostname per allocated
+    slot."""
+    out: List[HostInfo] = []
+    with open(os.environ["PBS_NODEFILE"]) as f:
+        for line in f:
+            h = line.strip()
+            if not h:
+                continue
+            for i, hi in enumerate(out):
+                if hi.hostname == h:
+                    out[i] = HostInfo(h, hi.slots + 1)
+                    break
+            else:
+                out.append(HostInfo(h, 1))
     return out
 
 
@@ -138,6 +164,10 @@ def detect_scheduler_hosts() -> Optional[List[HostInfo]]:
     try:
         if lsf_available():
             hosts = lsf_hosts()
+            if hosts:
+                return hosts
+        if pbs_available():
+            hosts = pbs_hosts()
             if hosts:
                 return hosts
         if slurm_available():

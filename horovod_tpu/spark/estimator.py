@@ -62,19 +62,21 @@ def _read_rank_rows(store: Store, parts: List[int], target: int):
     return rows[idx]
 
 
-def _transform_df(df, predict_one: Callable, feature_cols: List[str],
+def _transform_df(df, make_predict: Callable, feature_cols: List[str],
                   label_cols: List[str]):
     """Shared transform body for both model classes: append
     ``<label>__output`` prediction columns partition by partition.
-    ``predict_one(feats [1, n_feat] float32) -> [n_labels]`` must be
-    picklable into Spark tasks (cloudpickle carries the closures)."""
+    ``make_predict()`` is called ONCE per partition (model
+    deserialization happens there, not per row) and returns
+    ``predict_one(feats [1, n_feat] float32) -> [n_labels]``; it must
+    be picklable into Spark tasks (cloudpickle carries closures)."""
     import cloudpickle
-    predict_pkl = cloudpickle.dumps(predict_one)
+    make_pkl = cloudpickle.dumps(make_predict)
 
     def map_partition(rows):
         import cloudpickle as cp
         import numpy as np
-        predict = cp.loads(predict_pkl)
+        predict = cp.loads(make_pkl)()
         for row in rows:
             feats = np.asarray([[float(row[c]) for c in feature_cols]],
                                np.float32)
@@ -194,16 +196,19 @@ class TorchModel:
     def transform(self, df):
         state, model_pkl = self.state, pickle.dumps(self.model)
 
-        def predict_one(feats):
+        def make_predict():
             import torch
             m = pickle.loads(model_pkl)
             m.load_state_dict({k: torch.as_tensor(v)
                                for k, v in state.items()})
             m.eval()
-            with torch.no_grad():
-                return m(torch.as_tensor(feats)).numpy()[0]
 
-        return _transform_df(df, predict_one, self.feature_cols,
+            def predict_one(feats):
+                with torch.no_grad():
+                    return m(torch.as_tensor(feats)).numpy()[0]
+            return predict_one
+
+        return _transform_df(df, make_predict, self.feature_cols,
                              self.label_cols)
 
 
@@ -325,10 +330,13 @@ class JaxModel:
     def transform(self, df):
         params, apply_fn = self.params, self.apply_fn
 
-        def predict_one(feats):
+        def make_predict():
             import jax.numpy as jnp
             import numpy as np
-            return np.asarray(apply_fn(params, jnp.asarray(feats)))[0]
 
-        return _transform_df(df, predict_one, self.feature_cols,
+            def predict_one(feats):
+                return np.asarray(apply_fn(params, jnp.asarray(feats)))[0]
+            return predict_one
+
+        return _transform_df(df, make_predict, self.feature_cols,
                              self.label_cols)

@@ -518,3 +518,43 @@ def test_resolve_hosts_uses_scheduler(monkeypatch):
     hosts = _resolve_hosts(LaunchSettings(np=2, command=["x"],
                                           hosts="h9:2"))
     assert hosts == [HostInfo("h9", 2)]
+
+
+def test_pbs_hosts(monkeypatch, tmp_path):
+    from horovod_tpu.runner.schedulers import detect_scheduler_hosts
+
+    nf = tmp_path / "nodes"
+    nf.write_text("n01\nn01\nn02\n")
+    monkeypatch.setenv("PBS_NODEFILE", str(nf))
+    assert detect_scheduler_hosts() == [HostInfo("n01", 2),
+                                        HostInfo("n02", 1)]
+
+
+def test_lsf_uniform_single_slot_hosts_kept(monkeypatch):
+    from horovod_tpu.runner.schedulers import detect_scheduler_hosts
+
+    monkeypatch.setenv("LSB_JOBID", "1")
+    # span[ptile=1]: every host legitimately has one slot — keep all.
+    monkeypatch.setenv("LSB_MCPU_HOSTS", "h1 1 h2 1")
+    assert detect_scheduler_hosts() == [HostInfo("h1", 1),
+                                        HostInfo("h2", 1)]
+
+
+def test_resolve_hosts_underallocation_falls_back(monkeypatch):
+    from horovod_tpu.runner.launch import LaunchSettings, _resolve_hosts
+
+    monkeypatch.setenv("SLURM_JOB_NODELIST", "n1")
+    monkeypatch.setenv("SLURM_TASKS_PER_NODE", "1")
+    hosts = _resolve_hosts(LaunchSettings(np=8, command=["x"]))
+    assert hosts == [HostInfo("localhost", 8)]
+
+
+def test_hydra_uniform_slots_get_ppn():
+    from horovod_tpu.runner.mpi_run import build_mpi_command
+
+    cmd = build_mpi_command(np=4, impl="intel", env={},
+                            command=["python", "t.py"], hosts="h1:2,h2:2")
+    assert cmd[cmd.index("-ppn") + 1] == "2"
+    with pytest.raises(ValueError, match="uniform"):
+        build_mpi_command(np=4, impl="mpich", env={},
+                          command=["python", "t.py"], hosts="h1:3,h2:1")
