@@ -10,10 +10,12 @@ Spark executors with :func:`horovod_tpu.spark.run` (each rank reads its
 assigned partitions from the store), and returns a model transformer
 for inference.
 
-The reference's Petastorm streaming reader is replaced by whole-shard
-reads (shards are partition-sized); its parquet staging by pickled
-float32 arrays — the Store seam (local FS / fsspec s3-gs-hdfs) is where
-a columnar format would slot in.
+The reference's Petastorm streaming reader maps to chunked staging
+(``STAGE_CHUNK_ROWS``-row shard files written by the executors) plus
+the worker-side streaming batch iterator — memory stays bounded by one
+chunk regardless of partition size; its parquet format maps to pickled
+float32 arrays, with the Store seam (local FS / fsspec s3-gs-hdfs)
+where a columnar format would slot in.
 """
 
 from __future__ import annotations
@@ -27,39 +29,98 @@ __all__ = ["Store", "FsspecStore", "TorchEstimator", "TorchModel",
            "JaxEstimator", "JaxModel"]
 
 
-def _stage_dataframe(df, cols: List[str], store: Store, num_proc: int):
-    """Executor-side staging: every partition writes its rows as a
-    float32 array shard into the store; only ``(partition, row_count)``
-    pairs come back to the driver. Returns the per-rank partition
-    assignment and the padded per-rank row target."""
+#: rows per staged chunk file — bounds both the executor's staging
+#: buffer and the trainer's read working set (the streaming-reader
+#: property Petastorm provides in the reference).
+STAGE_CHUNK_ROWS = 65536
+
+
+def _stage_dataframe(df, cols: List[str], store: Store, num_proc: int,
+                     chunk_rows: int = STAGE_CHUNK_ROWS):
+    """Executor-side staging: every partition streams its rows into
+    CHUNKED float32 shards (``part.{pid}.c{k}``, each <= ``chunk_rows``
+    rows) so a partition larger than executor memory never
+    materializes whole; only ``(partition, row_count)`` pairs come back
+    to the driver. Returns the per-rank partition assignment and the
+    padded per-rank row target."""
     n_cols = len(cols)
 
     def stage(pid, rows_iter):
         import numpy as np
-        rows = [[float(row[c]) for c in cols] for row in rows_iter]
-        arr = (np.asarray(rows, dtype=np.float32) if rows
-               else np.zeros((0, n_cols), dtype=np.float32))
-        if len(arr):
-            store.write_shard(f"part.{pid}", arr)
-        yield (pid, len(arr))
+        total, k, buf = 0, 0, []
+        for row in rows_iter:
+            buf.append([float(row[c]) for c in cols])
+            if len(buf) >= chunk_rows:
+                store.write_shard(f"part.{pid}.c{k}",
+                                  np.asarray(buf, dtype=np.float32))
+                total += len(buf)
+                buf, k = [], k + 1
+        if buf:
+            store.write_shard(f"part.{pid}.c{k}",
+                              np.asarray(buf, dtype=np.float32))
+            total += len(buf)
+            k += 1
+        store.write_array(f"part.{pid}.meta", {"rows": total,
+                                               "chunks": k,
+                                               "cols": n_cols})
+        yield (pid, total)
 
     counts = dict(df.select(*cols).rdd
                   .mapPartitionsWithIndex(stage).collect())
     return assign_partitions(counts, num_proc)
 
 
-def _read_rank_rows(store: Store, parts: List[int], target: int):
-    """Worker side: concatenate this rank's staged partitions and wrap-
-    pad to ``target`` rows, so every rank runs the same number of
-    optimizer steps (the reference gets the equal-length property from
-    Petastorm's epoch semantics)."""
+def _iter_rank_batches(store: Store, parts: List[int], target: int,
+                       batch_size: int):
+    """Worker side: stream this rank's staged partitions chunk by
+    chunk, yielding fixed-size batches, wrap-padded to ``target`` rows
+    — every rank runs the SAME ``ceil(target/batch_size)`` optimizer
+    steps (the reference gets the equal-length property from
+    Petastorm's epoch semantics), with memory bounded by one chunk plus
+    one batch regardless of shard size."""
     import numpy as np
-    arrs = [store.read_shard(f"part.{p}") for p in parts]
-    rows = np.concatenate(arrs, axis=0)
-    if len(rows) == target:
-        return rows
-    idx = np.arange(target) % len(rows)
-    return rows[idx]
+
+    # Metas once, not per wrap; and a rank whose whole share fits one
+    # chunk budget is served from memory — the wrap-pad of a skewed
+    # small rank must not become O(target) store round-trips.
+    metas = {p: store.read_array(f"part.{p}.meta") for p in parts}
+    total_rows = sum(m["rows"] for m in metas.values())
+    if total_rows <= STAGE_CHUNK_ROWS:
+        rows = np.concatenate(
+            [store.read_shard(f"part.{p}.c{k}")
+             for p in parts for k in range(metas[p]["chunks"])])
+        for off in range(0, target, batch_size):
+            need = min(batch_size, target - off)
+            yield rows[(off + np.arange(need)) % len(rows)]
+        return
+
+    def chunks():
+        for p in parts:
+            for k in range(metas[p]["chunks"]):
+                yield store.read_shard(f"part.{p}.c{k}")
+
+    emitted = 0
+    carry = None
+    it = chunks()
+    while emitted < target:
+        need = min(batch_size, target - emitted)
+        pieces = [] if carry is None else [carry]
+        have = 0 if carry is None else len(carry)
+        carry = None
+        while have < need:
+            try:
+                c = next(it)
+            except StopIteration:
+                it = chunks()  # wrap-pad: restart the stream
+                c = next(it)
+            pieces.append(c)
+            have += len(c)
+        rows = pieces[0] if len(pieces) == 1 else np.concatenate(pieces)
+        batch, carry = rows[:need], rows[need:]
+        if len(carry) == 0:
+            carry = None
+        emitted += need
+        yield batch
 
 
 def _transform_df(df, make_predict: Callable, feature_cols: List[str],
@@ -135,9 +196,6 @@ class TorchEstimator:
 
             hvd.init()
             model = pickle.loads(payload)
-            data = _read_rank_rows(store, assigned[hvd.rank()], target)
-            x = torch.as_tensor(data[:, :n_feat])
-            y = torch.as_tensor(data[:, n_feat:])
             opt = opt_factory(model.parameters())
             extra = ({"compression": compression}
                      if compression is not None else {})
@@ -145,10 +203,11 @@ class TorchEstimator:
                 opt, named_parameters=model.named_parameters(), **extra)
             hvd.broadcast_parameters(model.state_dict(), root_rank=0)
             for _ in range(epochs):
-                for off in range(0, max(len(x), 1), bs):
-                    xb, yb = x[off:off + bs], y[off:off + bs]
-                    if not len(xb):
-                        continue
+                for rows in _iter_rank_batches(store,
+                                               assigned[hvd.rank()],
+                                               target, bs):
+                    xb = torch.as_tensor(rows[:, :n_feat])
+                    yb = torch.as_tensor(rows[:, n_feat:])
                     opt.zero_grad()
                     loss_fn(model(xb), yb).backward()
                     opt.step()
@@ -270,9 +329,6 @@ class JaxEstimator:
                 cloudpickle.loads(payload))
             if optimizer is None:
                 optimizer = optax.adam(1e-2)
-            data = _read_rank_rows(store, assigned[hvd.rank()], target)
-            x = jnp.asarray(data[:, :n_feat])
-            y = jnp.asarray(data[:, n_feat:])
 
             params = init_fn(jax.random.PRNGKey(seed))
             params = hvd.broadcast_parameters(params)
@@ -286,10 +342,11 @@ class JaxEstimator:
                 lambda p, xb, yb: loss_fn(apply_fn(p, xb), yb)))
 
             for _ in range(epochs):
-                for off in range(0, max(len(x), 1), bs):
-                    xb, yb = x[off:off + bs], y[off:off + bs]
-                    if not len(xb):
-                        continue
+                for rows in _iter_rank_batches(store,
+                                               assigned[hvd.rank()],
+                                               target, bs):
+                    xb = jnp.asarray(rows[:, :n_feat])
+                    yb = jnp.asarray(rows[:, n_feat:])
                     _, grads = grad_fn(params, xb, yb)
                     updates, opt_state = opt.update(grads, opt_state,
                                                     params)

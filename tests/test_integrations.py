@@ -331,9 +331,10 @@ def test_torch_estimator_fit_predict(fake_pyspark, tmp_path):
         hvd.init()
     pred = model.predict(np.asarray([[1.0], [2.0]], np.float32))
     np.testing.assert_allclose(pred[:, 0], [2.0, 4.0], atol=0.2)
-    # shards were staged per partition by the "executors"
+    # chunked shards were staged per partition by the "executors"
     import os
-    assert os.path.exists(os.path.join(str(tmp_path), "shard.part.0.pkl"))
+    assert os.path.exists(os.path.join(str(tmp_path), "shard.part.0.c0.pkl"))
+    assert os.path.exists(os.path.join(str(tmp_path), "part.0.meta"))
 
 
 def test_jax_estimator_fit_predict_fsspec_store(fake_pyspark):
@@ -374,6 +375,54 @@ def test_jax_estimator_fit_predict_fsspec_store(fake_pyspark):
         hvd.init()
     pred = model.predict(np.asarray([[1.0], [2.0]], np.float32))
     np.testing.assert_allclose(pred[:, 0], [2.0, 4.0], atol=0.2)
+
+
+def test_streaming_batch_iterator(tmp_path):
+    """The chunked reader: bounded chunks, fixed-size batches, wrap
+    padding to the lockstep target — memory never needs the full
+    shard."""
+    from horovod_tpu.spark import Store
+    from horovod_tpu.spark.estimator import _iter_rank_batches
+
+    store = Store(str(tmp_path))
+    rows = np.arange(50, dtype=np.float32).reshape(25, 2)
+    chunks = [rows[:10], rows[10:20], rows[20:]]
+    for k, c in enumerate(chunks):
+        store.write_shard(f"part.0.c{k}", c)
+    store.write_array("part.0.meta", {"rows": 25, "chunks": 3, "cols": 2})
+
+    batches = list(_iter_rank_batches(store, [0], target=30,
+                                      batch_size=8))
+    assert [len(b) for b in batches] == [8, 8, 8, 6]
+    got = np.concatenate(batches)
+    want = rows[np.arange(30) % 25]
+    np.testing.assert_array_equal(got, want)
+
+    # Force the STREAMING path too (rank share above the chunk budget).
+    import horovod_tpu.spark.estimator as est
+    orig = est.STAGE_CHUNK_ROWS
+    est.STAGE_CHUNK_ROWS = 4
+    try:
+        batches = list(_iter_rank_batches(store, [0], target=30,
+                                          batch_size=8))
+    finally:
+        est.STAGE_CHUNK_ROWS = orig
+    np.testing.assert_array_equal(np.concatenate(batches), want)
+
+
+def test_staging_writes_bounded_chunks(fake_pyspark, tmp_path):
+    from horovod_tpu.spark import Store
+    from horovod_tpu.spark.estimator import _stage_dataframe
+
+    store = Store(str(tmp_path))
+    df = _FakePartitionedDF(n_rows=64, n_parts=2)   # 32 rows/partition
+    assigned, target = _stage_dataframe(df, ["x", "y"], store, 1,
+                                        chunk_rows=10)
+    assert assigned == [[0, 1]] and target == 64
+    meta = store.read_array("part.0.meta")
+    assert meta == {"rows": 32, "chunks": 4, "cols": 2}
+    assert len(store.read_shard("part.0.c0")) == 10
+    assert len(store.read_shard("part.0.c3")) == 2
 
 
 def test_assign_partitions_lockstep():
