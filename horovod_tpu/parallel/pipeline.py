@@ -283,10 +283,10 @@ def make_pp_train_step_1f1b(cfg, mesh: Mesh, n_micro: int, optimizer=None):
     pipelines can raise ``n_micro`` to shrink the bubble without
     scaling activation memory.
 
-    Same composition rules as the GPipe step: dp/fsdp/tp compose under
-    GSPMD; sp inside a stage is unsupported (nested manual islands);
-    MoE is unsupported in the 1F1B schedule (the aux loss would need
-    threading through the explicit backward) — use GPipe for pp+ep.
+    Same composition rules as the GPipe step: dp/fsdp/tp/ep compose
+    under GSPMD (the MoE aux loss rides the per-stage scalar through
+    the explicit backward); sp inside a stage is unsupported (nested
+    manual islands).
 
     Returns ``(init_state, jit_step, param_shardings)``.
     """
@@ -297,10 +297,6 @@ def make_pp_train_step_1f1b(cfg, mesh: Mesh, n_micro: int, optimizer=None):
 
     if mesh.shape.get("sp", 1) > 1:
         raise NotImplementedError("pp + sp composition is not supported")
-    if cfg.moe is not None:
-        raise NotImplementedError(
-            "MoE inside the 1F1B schedule is not supported; use the "
-            "GPipe step (make_pp_train_step) for pp+ep")
     if optimizer is None:
         optimizer = optax.adamw(3e-4, weight_decay=0.01)
     S = mesh.shape["pp"]
@@ -309,7 +305,7 @@ def make_pp_train_step_1f1b(cfg, mesh: Mesh, n_micro: int, optimizer=None):
         dataclasses.replace(cfg, sp_attention="local"), None)
 
     def one_layer(x, lp):
-        return tr.decoder_layer(cfg, attend, lambda v, *s: v, x, lp)[0], None
+        return tr.decoder_layer(cfg, attend, lambda v, *s: v, x, lp)
 
     layer = one_layer
     if cfg.remat:
@@ -317,8 +313,11 @@ def make_pp_train_step_1f1b(cfg, mesh: Mesh, n_micro: int, optimizer=None):
                                prevent_cse=cfg.remat_prevent_cse)
 
     def stage_fn(stage_layers, x):
-        y, _ = lax.scan(layer, x, stage_layers)
-        return y
+        y, auxes = lax.scan(layer, x, stage_layers)
+        # Per-microbatch MoE aux is a mean over its microbatch; summed
+        # across the schedule's microbatches it must be averaged back
+        # (same normalization as the GPipe step's aux / n_micro).
+        return y, auxes.sum() / n_micro
 
     def loss_fn(params, batch):
         tokens = batch["tokens"]

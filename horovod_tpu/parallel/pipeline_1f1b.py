@@ -63,10 +63,14 @@ def pipeline_1f1b(stage_fn: Callable, last_fn: Callable, stage_params,
     """Run the 1F1B schedule; returns ``(loss_sum, stage_grads,
     last_grads, d_microbatches)`` — all PRIMAL values (f32 grads).
 
-    ``stage_fn(layer_slice, x) -> y`` is one stage's block (shape and
-    dtype preserving); ``last_fn(last_params, y, m_idx) -> scalar_loss``
-    is the last stage's head+loss applied AFTER its block (``m_idx`` is
-    the microbatch index, for targets closed over outside).
+    ``stage_fn(layer_slice, x) -> (y, aux)`` is one stage's block
+    (shape and dtype preserving) plus a scalar auxiliary loss (0.0 when
+    unused; the MoE load-balancing term otherwise — it is ADDED to the
+    stage scalar, so its gradient rides the same per-stage vjp and its
+    value is psum'd into the returned loss);
+    ``last_fn(last_params, y, m_idx) -> scalar_loss`` is the last
+    stage's head+loss applied AFTER its block (``m_idx`` is the
+    microbatch index, for targets closed over outside).
     ``stage_params`` leaves carry a leading stage dim ``S``;
     ``last_params`` is replicated over ``pp`` (only the last stage
     touches it — its grads come back masked-psum'd).
@@ -98,8 +102,13 @@ def pipeline_1f1b(stage_fn: Callable, last_fn: Callable, stage_params,
             lets the head index per-microbatch targets closed over in
             ``last_fn``), and <stage output, incoming cotangent>
             elsewhere (its gradient w.r.t. (params, x) IS
-            vjp-with-cotangent-``g_in``)."""
-            yy = stage_fn(lparams, x)
+            vjp-with-cotangent-``g_in``). The stage's auxiliary term
+            (MoE load balancing) adds to the scalar on EVERY stage —
+            the total objective is loss + sum of auxes, and addition
+            makes the vjp exact. Returns (scalar, aux) so the aux
+            VALUE can be accumulated without a second forward."""
+            yy, aux = stage_fn(lparams, x)
+            aux = aux.astype(jnp.float32)
 
             def last_branch(op):
                 lastp_, yy_ = op
@@ -111,7 +120,7 @@ def pipeline_1f1b(stage_fn: Callable, last_fn: Callable, stage_params,
                         * g_in.astype(jnp.float32)).sum()
 
             return lax.cond(s_idx == S - 1, last_branch, mid_branch,
-                            (lastp, yy))
+                            (lastp, yy)) + aux, aux
 
         def tick(carry, t):
             (acts_f, g_up, ring, grads, lgrads, dmb, loss_acc) = carry
@@ -124,7 +133,7 @@ def pipeline_1f1b(stage_fn: Callable, last_fn: Callable, stage_params,
             if f32_wire:
                 x0 = (x0 + vzero.astype(x0.dtype)).astype(dtype)
             x_in = jnp.where(s_idx == 0, x0, acts_f)
-            y = stage_fn(local, x_in)
+            y, _ = stage_fn(local, x_in)
             ring = jnp.where(
                 f_real,
                 lax.dynamic_update_index_in_dim(ring, x_in, mfc % R, 0),
@@ -136,9 +145,9 @@ def pipeline_1f1b(stage_fn: Callable, last_fn: Callable, stage_params,
             mbc = jnp.clip(mb_i, 0, M - 1)
             x_res = lax.dynamic_index_in_dim(ring, mbc % R, 0,
                                              keepdims=False)
-            loss_m, (dlp, dlast, dx) = jax.value_and_grad(
-                stage_loss, argnums=(0, 1, 2))(local, lp, x_res, g_up,
-                                               mbc)
+            (loss_m, aux_m), (dlp, dlast, dx) = jax.value_and_grad(
+                stage_loss, argnums=(0, 1, 2), has_aux=True)(
+                    local, lp, x_res, g_up, mbc)
             grads = jax.tree.map(
                 lambda acc, g: acc
                 + jnp.where(b_real, g.astype(jnp.float32), 0.0),
@@ -157,8 +166,11 @@ def pipeline_1f1b(stage_fn: Callable, last_fn: Callable, stage_params,
                 lax.dynamic_update_index_in_dim(
                     dmb, dx.astype(dtype), mbc, 0),
                 dmb)
-            loss_acc = loss_acc + jnp.where(b_real & (s_idx == S - 1),
-                                            loss_m, 0.0)
+            # Last stage: loss_m already includes its own aux; other
+            # stages contribute only their aux value (their scalar's
+            # dot term is a vjp artifact, not a loss).
+            loss_acc = loss_acc + jnp.where(
+                b_real, jnp.where(s_idx == S - 1, loss_m, aux_m), 0.0)
 
             # ---------------- shifts ----------------------
             # Forward activations flow DOWN (s -> s+1) ...
@@ -192,11 +204,10 @@ def pipeline_1f1b(stage_fn: Callable, last_fn: Callable, stage_params,
         (_, _, _, grads, lgrads, dmb, loss_acc), _ = lax.scan(
             tick, init, jnp.arange(n_ticks))
 
-        # Replicate the last stage's loss and head grads and stage 0's
-        # input cotangents to every pp rank (masked psums — exactly one
-        # stage holds nonzero values for each).
-        loss = lax.psum(jnp.where(s_idx == S - 1, loss_acc, 0.0),
-                        axis_name)
+        # Replicate the loss (every stage contributes: the last one
+        # its loss+aux, the rest their aux), the last stage's head
+        # grads, and stage 0's input cotangents to every pp rank.
+        loss = lax.psum(loss_acc, axis_name)
         lgrads = jax.tree.map(
             lambda g: lax.psum(
                 jnp.where(s_idx == S - 1, g, jnp.zeros_like(g)),
