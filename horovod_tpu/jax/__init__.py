@@ -179,7 +179,7 @@ def distributed_optimizer(optimizer, *,
             if not missing:
                 return a
             try:
-                return lax.pvary(a, missing)
+                return lax.pcast(a, missing, to="varying")
             except Exception:  # outside shard_map: axis not in scope
                 return a
         return jax.tree.map(one, t)
