@@ -254,6 +254,10 @@ def _rendezvous_new_topology(timeout: float,
     host, port = payload["controller_addr"].rsplit(":", 1)
     os.environ["HOROVOD_CONTROLLER_ADDR"] = (
         f"0.0.0.0:{port}" if slot.rank == 0 else f"{host}:{port}")
+    # The epoch's rank 0 may be a different worker than at spawn time;
+    # its advertised host (used for the jax.distributed coordinator
+    # under --xla-exec) must be the driver-chosen routable one.
+    os.environ["HOROVOD_CONTROLLER_HOST"] = host
     os.environ["HOROVOD_ELASTIC_EPOCH"] = str(epoch)
     return Topology(rank=slot.rank, size=slot.size,
                     local_rank=slot.local_rank, local_size=slot.local_size,

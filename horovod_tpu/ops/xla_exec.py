@@ -38,6 +38,17 @@ from horovod_tpu.common import basics
 from horovod_tpu.common.ops_enum import ReduceOp
 
 
+def invalidate_world() -> None:
+    """Drop every cached mesh and jitted program. Called when the
+    process-spanning XLA runtime is torn down (elastic re-formation,
+    ``Runtime._teardown_jax_distributed``): the cached programs bake in
+    the old world's mesh/devices, which no longer exist after
+    ``clear_backends``."""
+    for fn in (_rank_mesh, _scale_jit, _allreduce_prog, _allgather_prog,
+               _broadcast_prog, _alltoall_prog, _reducescatter_prog):
+        fn.cache_clear()
+
+
 def zeros_state(name: str, op: int, n_elems: int, dtype_id: int,
                 reduce_op: int):
     """Placeholder in-flight state for a rank with no local tensor (it

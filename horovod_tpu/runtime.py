@@ -82,6 +82,19 @@ class Runtime:
         self._exec_cb = None   # keep callbacks alive for the C core
         self._alloc_cb = None
         self._init_epoch = 0   # keys rendezvous rediscovery on re-init
+        self._jax_dist_up = False
+        self._exec_worker = None  # elastic device-program worker (watchdog)
+        self._exec_q = None
+        # Coordinator-address KV key coordinates: (elastic epoch, count
+        # of world formations within that epoch). Survivors and freshly
+        # respawned workers must derive the SAME key, so it cannot be
+        # keyed on the per-process _init_epoch — after a respawn the
+        # newcomer is at init 0 while survivors are at init k. The
+        # elastic epoch is driver-published and identical everywhere;
+        # the per-epoch sequence covers same-epoch re-inits (transient
+        # global errors roll no epoch but every process re-inits once).
+        self._xla_world_seq = 0
+        self._xla_world_epoch_tag: Optional[str] = None
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -112,8 +125,14 @@ class Runtime:
             # process does not re-append).
             os.environ["HOROVOD_TIMELINE"] += f".{topo.rank}"
             os.environ["HOROVOD_TIMELINE_RANK_SUFFIX"] = "0"
-        if topo.size > 1 and os.environ.get("HOROVOD_XLA_EXEC") == "1":
-            self._init_jax_distributed(topo)
+        if os.environ.get("HOROVOD_XLA_EXEC") == "1":
+            if topo.size > 1:
+                self._init_jax_distributed(topo)
+            elif self._jax_dist_up:
+                # The world shrank to one process (elastic scale-down):
+                # the old multi-process XLA runtime is stale; tear it
+                # down so jax sees only local devices again.
+                self._teardown_jax_distributed()
         self._exec_cb = basics.EXEC_CB_TYPE(self._on_exec)
         self._alloc_cb = basics.ALLOC_CB_TYPE(self._on_alloc)
         self.lib.hvd_set_exec_callback(self._exec_cb)
@@ -138,9 +157,32 @@ class Runtime:
         jax backend initializes."""
         import jax
 
-        if getattr(self, "_jax_dist_up", False):
-            return  # already up (elastic re-init keeps the old runtime)
+        if self._jax_dist_up:
+            # Elastic re-init: membership changed (or a peer died), so
+            # the live world is stale — its size may be wrong and its
+            # peer connections may be broken. Re-form it at the new
+            # membership, the way the reference re-creates its comm
+            # context on every rendezvous (``gloo/gloo_context.cc:
+            # 154-200``), instead of silently keeping the old one.
+            self._teardown_jax_distributed()
+        elif self._init_epoch > 0:
+            # Re-init after a size-1 interlude (shrink to one, then
+            # grow): the interlude's jax calls re-created the LOCAL
+            # backend, and ``jax.distributed.initialize`` refuses to
+            # run after any backend use — flush it exactly like a full
+            # teardown would (a no-op if nothing was initialized).
+            import jax.extend.backend as jax_backend
+            jax.clear_caches()
+            jax_backend.clear_backends()
+            from horovod_tpu.ops import xla_exec
+            xla_exec.invalidate_world()
         coord = os.environ.get("HOROVOD_XLA_COORD_ADDR")
+        if coord and os.environ.get("HOROVOD_ELASTIC_ID"):
+            # A static coordinator address cannot follow rank 0 across
+            # membership changes (the configured host may be the very
+            # one that died); elastic jobs always rendezvous the
+            # epoch's coordinator through the launcher KV.
+            coord = None
         if not coord:
             if not os.environ.get("HOROVOD_RENDEZVOUS_ADDR"):
                 raise HorovodInternalError(
@@ -150,7 +192,11 @@ class Runtime:
             from horovod_tpu.runner.rendezvous import free_port
             rdv = os.environ["HOROVOD_RENDEZVOUS_ADDR"]
             timeout = float(os.environ.get("HOROVOD_START_TIMEOUT", "120"))
-            key = f"xla_coord_addr.{self._init_epoch}"
+            tag = os.environ.get("HOROVOD_ELASTIC_EPOCH", "")
+            if tag != self._xla_world_epoch_tag:
+                self._xla_world_epoch_tag = tag
+                self._xla_world_seq = 0
+            key = f"xla_coord_addr.{tag or 0}.{self._xla_world_seq}"
             if topo.rank == 0:
                 host = os.environ.get("HOROVOD_CONTROLLER_HOST")
                 if not host:
@@ -170,14 +216,93 @@ class Runtime:
                                   "gloo")
             except Exception:
                 pass
-        jax.distributed.initialize(coordinator_address=coord,
-                                   num_processes=topo.size,
-                                   process_id=topo.rank)
+        start_timeout = float(os.environ.get("HOROVOD_START_TIMEOUT", "120"))
+        # Peers come up within the launcher's start timeout or not at
+        # all; jax's 300 s default would stall failure detection.
+        kwargs = {"initialization_timeout": max(10, int(start_timeout))}
+        if os.environ.get("HOROVOD_ELASTIC_ID"):
+            # Elastic job: peers can die at any time. Recoverable tasks
+            # skip the coordination service's shutdown barrier — without
+            # this, a survivor's teardown blocks on the dead peer for
+            # the full heartbeat timeout and then LOG(FATAL)s the
+            # process (xla client.h). Short timeouts bound how long the
+            # re-formation can lag behind the host-plane failure.
+            jax.config.update("jax_enable_recoverability", True)
+            kwargs.update(heartbeat_timeout_seconds=10,
+                          shutdown_timeout_seconds=10)
+        try:
+            jax.distributed.initialize(coordinator_address=coord,
+                                       num_processes=topo.size,
+                                       process_id=topo.rank, **kwargs)
+        except Exception as e:
+            # A half-formed runtime (service up, a peer never joined)
+            # must not poison the next attempt with jax's
+            # "should only be called once" guard.
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+            self._force_reset_jax_dist_state()
+            raise HorovodInternalError(
+                f"jax.distributed initialization failed: {e}") from e
+        finally:
+            # Advance on ATTEMPT, not success: formation outcomes can
+            # diverge (rank j times out while others connect), and a
+            # success-only increment would leave rank j deriving the
+            # previous key — and reading its stale coordinator address
+            # — on the next same-epoch attempt.
+            self._xla_world_seq += 1
         self._jax_dist_up = True
+
+    def _teardown_jax_distributed(self) -> None:
+        """Tear down the process-spanning XLA runtime so a later init
+        can form a fresh one. Backends must be cleared too — they hold
+        the old distributed client — and with them every cached mesh
+        and jitted program that baked in the old device set. Live jax
+        arrays stay readable (their buffers outlive the backend cache),
+        so committed elastic state survives the re-formation."""
+        import jax
+
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            # A dead peer (the very thing that triggered the reset) can
+            # break the coordination service's teardown handshake; the
+            # client is discarded either way.
+            self._force_reset_jax_dist_state()
+        jax.clear_caches()
+        import jax.extend.backend as jax_backend
+        jax_backend.clear_backends()
+        from horovod_tpu.ops import xla_exec
+        xla_exec.invalidate_world()
+        self._jax_dist_up = False
+
+    @staticmethod
+    def _force_reset_jax_dist_state() -> None:
+        """Failure-path fallback when the public shutdown cannot run to
+        completion: drop the distributed client state directly so a
+        later ``initialize`` doesn't refuse with "should only be called
+        once". Private-API touch, used only after a failed shutdown or
+        a failed initialize."""
+        try:
+            from jax._src import distributed as jax_dist
+            st = jax_dist.global_state
+            st.client = None
+            st.service = None
+            st.preemption_sync_manager = None
+            st.coordinator_address = None
+            st.process_id = 0
+            st.num_processes = 1
+        except Exception:
+            pass
 
     def shutdown(self) -> None:
         if self.lib is not None and self.initialized():
             self.lib.hvd_shutdown()
+        if self._exec_q is not None:
+            self._exec_q.put(None)  # end the idle watchdog worker
+            self._exec_worker = None
+            self._exec_q = None
         with self._lock:
             self._inflight.clear()
             self._name_to_handle.clear()
@@ -444,10 +569,89 @@ class Runtime:
                         f"no in-flight state for tensor {nm!r} (op {op}, "
                         f"contributes={contributes}); a contributing rank "
                         "must hold a live handle for every response tensor")
-        outs = xla_exec.execute(op, states, sizes, self.size(), self.rank())
+        outs = self._run_device_program(op, states, sizes)
         with self._lock:
             for st, out in zip(states, outs):
                 st.output = out
+
+    def _run_device_program(self, op: int, states, sizes: List[int]):
+        """Run one XLA device program, guarding elastic jobs against a
+        peer dying mid-program: the CPU-collective rendezvous has no
+        timeout, so a dead peer leaves the program blocked forever and
+        with it the whole background thread (and the job — synchronize
+        never returns, so the elastic reset never starts). Run the
+        program on a helper thread and abandon the wait when the driver
+        rolls the membership epoch; the reset that follows tears the
+        world down, which cancels the stuck program's pending RPCs."""
+        from horovod_tpu.ops import xla_exec
+
+        if not (os.environ.get("HOROVOD_ELASTIC_ID") and self.size() > 1):
+            return xla_exec.execute(op, states, sizes, self.size(),
+                                    self.rank())
+
+        box: Dict[str, Any] = {}
+        done = threading.Event()
+
+        def _run():
+            try:
+                box["outs"] = xla_exec.execute(op, states, sizes,
+                                               self.size(), self.rank())
+            except Exception as e:  # noqa: BLE001 — re-raised below
+                box["err"] = e
+            finally:
+                done.set()
+
+        if self._exec_worker is None:
+            # Persistent DAEMON worker (not ThreadPoolExecutor, whose
+            # non-daemon thread would be joined at interpreter exit —
+            # a wedged program would then block process exit forever).
+            import queue
+            self._exec_q = queue.SimpleQueue()
+            q = self._exec_q
+
+            def _loop():
+                while True:
+                    fn = q.get()
+                    if fn is None:
+                        return
+                    fn()
+
+            self._exec_worker = threading.Thread(
+                target=_loop, daemon=True, name="hvd-xla-exec")
+            self._exec_worker.start()
+        self._exec_q.put(_run)
+        from horovod_tpu import elastic as _elastic
+        start_epoch = int(os.environ.get("HOROVOD_ELASTIC_EPOCH", "0") or 0)
+        while not done.wait(0.5):
+            try:
+                w = _elastic._watcher
+                cur = (w.latest() if w is not None and not w.stale()
+                       else _elastic.current_epoch())
+            except Exception:
+                continue
+            if cur > start_epoch:
+                # The roll may be a healthy scale-UP (all current peers
+                # alive, program about to complete): grant a grace
+                # window so growth doesn't cost a rollback to the last
+                # commit. A dead-peer program never completes, so after
+                # the grace the world is known doomed.
+                grace = float(os.environ.get(
+                    "HOROVOD_XLA_EXEC_GRACE_SECS", "5"))
+                if done.wait(grace):
+                    break
+                # The stuck op wedges the worker thread until the
+                # teardown cancels its RPCs; do not queue future
+                # programs behind it (the daemon thread leaks at
+                # worst, never blocking exit).
+                self._exec_worker = None
+                self._exec_q = None
+                raise HorovodInternalError(
+                    f"membership epoch rolled {start_epoch} -> {cur} while "
+                    "a device collective was in flight; abandoning the "
+                    "stale world's program")
+        if "err" in box:
+            raise box["err"]
+        return box["outs"]
 
     # ------------------------------------------------------------------
     # misc

@@ -8,6 +8,11 @@ via env:
 * ``ELASTIC_DIE_AT``  — batch at which the identity in
   ``ELASTIC_DIE_ID`` hard-exits(1), only in epoch 0
 * ``ELASTIC_LOG_DIR`` — directory for per-identity batch logs
+* ``ELASTIC_JAX``     — allreduce a jax array instead of numpy (the
+  --xla-exec data plane when HOROVOD_XLA_EXEC=1), log
+  ``jax.process_count()`` per batch, and verify the reduced value
+  against the CURRENT world size — a stale jax.distributed world
+  after a membership change either hangs or fails this check
 """
 
 import os
@@ -34,18 +39,32 @@ def main():
     hvd.init()
     state = elastic.ObjectState(batch=0, weight=0.0)
 
+    use_jax = os.environ.get("ELASTIC_JAX") == "1"
+
     @elastic.run
     def train(state):
         while state.batch < total:
-            g = hvd.allreduce(np.ones(2) * (hvd.rank() + 1.0),
-                              op=hvd.Average, name="g")
+            if use_jax:
+                import jax
+                import jax.numpy as jnp
+                g = hvd.allreduce(jnp.ones(2) * (hvd.rank() + 1.0),
+                                  op=hvd.Average, name="g")
+                expected = (hvd.size() + 1.0) / 2.0
+                assert abs(float(np.asarray(g)[0]) - expected) < 1e-6, (
+                    f"allreduce value {np.asarray(g)[0]} != {expected} "
+                    f"at size {hvd.size()} — stale XLA world?")
+                jtag = f" jprocs={jax.process_count()}"
+            else:
+                g = hvd.allreduce(np.ones(2) * (hvd.rank() + 1.0),
+                                  op=hvd.Average, name="g")
+                jtag = ""
             state.weight = state.weight + float(np.asarray(g)[0])
             state.batch += 1
             if (state.batch == die_at and ident == die_id
                     and os.environ.get("HOROVOD_ELASTIC_EPOCH") == "0"):
                 os._exit(1)  # hard failure, no cleanup
             with open(log_path, "a") as f:
-                f.write(f"{state.batch} size={hvd.size()}\n")
+                f.write(f"{state.batch} size={hvd.size()}{jtag}\n")
             time.sleep(pause)
             state.commit()
         return state.batch, state.weight

@@ -291,6 +291,84 @@ def test_elastic_scale_up_mid_training(tmp_path, capfd):
     assert joiner_first > 1, "new worker restarted from scratch"
 
 
+def test_elastic_xla_exec_reforms_world(tmp_path, capfd):
+    """--xla-exec elastic (round-4 verdict #1): after a worker death
+    the survivor must tear down the old ``jax.distributed`` world and
+    re-form it with the respawned peer at the new epoch. A kept stale
+    world cannot complete a device collective with the newcomer (it
+    rendezvouses a FRESH world), so finishing with correct per-size
+    allreduce values is the proof of re-formation."""
+    total = 16
+    discovery = FixedHostDiscovery({"localhost": 2})
+    codes = _run_elastic_job(
+        tmp_path, total,
+        {"ELASTIC_DIE_AT": "5", "ELASTIC_DIE_ID": "localhost:1",
+         "ELASTIC_SLEEP": "0.05", "ELASTIC_JAX": "1",
+         "HOROVOD_XLA_EXEC": "1",
+         # conftest's 8-device flag would break the one-device-per-
+         # process model the eager device plane requires.
+         "XLA_FLAGS": ""},
+        discovery, timeout=240)
+    out = capfd.readouterr().out
+    results = [ln for ln in out.splitlines() if "RESULT" in ln]
+    assert sum(f"batch={total}" in ln for ln in results) >= 2, out
+    assert all(c == 0 for c in codes.values()), codes
+    surv = os.path.join(str(tmp_path), "localhost_0.log")
+    jprocs = [int(ln.split("jprocs=")[1]) for ln in open(surv)]
+    # Device plane active both before the failure and after the reset.
+    assert jprocs[0] == 2 and jprocs[-1] == 2, jprocs
+
+
+def test_elastic_xla_exec_scale_down_then_regrow(tmp_path, capfd):
+    """--xla-exec elastic shrink 2 -> 1 -> 2: the survivor's re-init at
+    size one must tear the multi-process XLA runtime down (a kept world
+    still routes device collectives at a dead peer), and the growth
+    back to two must re-form it — the size-1 interlude re-creates the
+    local jax backend, which the re-formation has to flush first."""
+    total = 80
+    discovery = FixedHostDiscovery({"localhost": 2})
+    surv = os.path.join(str(tmp_path), "localhost_0.log")
+
+    def _wait_for(pattern, deadline_s=90):
+        deadline = time.time() + deadline_s
+        while time.time() < deadline:
+            if os.path.exists(surv) and pattern in open(surv).read():
+                return True
+            time.sleep(0.2)
+        return False
+
+    def mutate():
+        # Shrink only once the 2-process world is live (batches logged)
+        # so the test exercises teardown of a FORMED world, not the
+        # startup race (a shrink mid-formation resolves by worker
+        # death + respawn, bounded by the init timeout). Then grow
+        # back once size-1 batches prove the interlude ran jax ops.
+        assert _wait_for("size=2")
+        discovery.set_hosts({"localhost": 1})
+        assert _wait_for("size=1")
+        discovery.set_hosts({"localhost": 2})
+
+    codes = _run_elastic_job(
+        tmp_path, total,
+        {"ELASTIC_SLEEP": "0.05", "ELASTIC_JAX": "1",
+         "HOROVOD_XLA_EXEC": "1", "XLA_FLAGS": ""},
+        discovery, max_np=2, mutate=mutate, timeout=240)
+    out = capfd.readouterr().out
+    results = [ln for ln in out.splitlines() if "RESULT" in ln]
+    assert sum(f"batch={total}" in ln for ln in results) >= 1, out
+    assert all(c == 0 for c in codes.values()), codes
+    lines = open(surv).read().splitlines()
+    sizes = [ln.split("size=")[1].split()[0] for ln in lines]
+    jprocs = [int(ln.split("jprocs=")[1]) for ln in lines]
+    assert "2" in sizes and "1" in sizes, sizes[:10]
+    # Teardown at the shrink: single-process jax while size is 1.
+    assert any(s == "1" and j == 1 for s, j in zip(sizes, jprocs)), (
+        list(zip(sizes, jprocs))[:20])
+    # Re-formation at the growth: the tail runs at size 2 with a
+    # 2-process world again.
+    assert sizes[-1] == "2" and jprocs[-1] == 2, (sizes[-5:], jprocs[-5:])
+
+
 def test_elastic_sampler_pad_smaller_than_world(monkeypatch):
     """Epoch tail: 1 unprocessed sample across 4 ranks — every rank
     must still yield exactly num_samples entries (repeat-padding), or
