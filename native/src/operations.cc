@@ -441,6 +441,9 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
       hvd::EnvInt64("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024));
   st.controller->SetRingThreshold(
       hvd::EnvInt64("HOROVOD_RING_THRESHOLD", 64 * 1024));
+  st.controller->SetShmSegmentBytes(std::max<int64_t>(
+      4096,
+      hvd::EnvInt64("HOROVOD_SHM_SEGMENT_BYTES", 8 * 1024 * 1024)));
   st.controller->SetTopology(local_rank, local_size, cross_rank, cross_size);
   st.controller->SetHierarchical(
       hvd::EnvInt64("HOROVOD_HIERARCHICAL_ALLREDUCE", 0) != 0);

@@ -555,6 +555,26 @@ def main():
             np.testing.assert_allclose(np.asarray(out), want)
             hvd.join()
 
+    elif scenario == "shm_segmented":
+        # Multi-segment shm allreduce (HOROVOD_SHM_SEGMENT_BYTES forced
+        # tiny by the test): odd payload lengths so segment boundaries
+        # land mid-entry, plus a fused group spanning segments, plus
+        # prescale/postscale riding the per-segment pack/unpack.
+        rng = np.random.RandomState(7 + r)
+        x = rng.randn(100003).astype(np.float32)
+        out = hvd.allreduce(x, op=hvd.Sum, name="seg")
+        want = sum(np.random.RandomState(7 + k).randn(100003)
+                   .astype(np.float32) for k in range(s))
+        np.testing.assert_allclose(np.asarray(out), want, atol=1e-4)
+        ys = [np.full(n, float(r + 1), np.float32) for n in (17, 4099, 1)]
+        outs = hvd.grouped_allreduce(ys, op=hvd.Average, name="segg",
+                                     prescale_factor=2.0)
+        expect = 2.0 * sum(range(1, s + 1)) / s
+        for o, y in zip(outs, ys):
+            np.testing.assert_allclose(np.asarray(o),
+                                       np.full_like(y, expect), atol=1e-5)
+        print(f"OK rank={r}")
+
     elif scenario == "shm_die":
         # The last rank dies without warning mid-stream; survivors must
         # surface an error within seconds (TCP link error or shm pid

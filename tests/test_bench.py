@@ -1,0 +1,53 @@
+"""bench.py round-over-round regression gate (round-4 verdict #2: the
+host-plane drop rode in silently because nothing compared rounds)."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+
+
+def test_find_regressions_flags_nested_drop():
+    prev = {"value": 2658.5, "vs_baseline": 12.8,
+            "extra": {"host_allreduce_busbw_gbps_np4": {"1MB": 0.431},
+                      "transformer_mfu_pct": 56.1}}
+    cur = {"value": 2613.8, "vs_baseline": 12.6,
+           "extra": {"host_allreduce_busbw_gbps_np4": {"1MB": 0.217},
+                     "transformer_mfu_pct": 56.3}}
+    regs = bench.find_regressions(prev, cur)
+    # The halved busbw is flagged; the 1.7% primary drift is not.
+    assert "extra.host_allreduce_busbw_gbps_np4.1MB" in regs
+    flagged = regs["extra.host_allreduce_busbw_gbps_np4.1MB"]
+    assert flagged["prev"] == 0.431 and flagged["cur"] == 0.217
+    assert flagged["drop_pct"] > 45
+    assert "value" not in regs
+
+
+def test_find_regressions_ignores_improvements_and_new_metrics():
+    prev = {"value": 100.0, "extra": {"old_only": 5.0}}
+    cur = {"value": 150.0, "extra": {"new_only": 1.0}}
+    # Improvement and non-shared keys never trip the gate.
+    assert bench.find_regressions(prev, cur) == {}
+
+
+def test_find_regressions_threshold_boundary():
+    prev = {"value": 100.0}
+    assert bench.find_regressions(prev, {"value": 91.0}) == {}
+    assert "value" in bench.find_regressions(prev, {"value": 89.0})
+
+
+def test_previous_bench_picks_newest_round(tmp_path):
+    for n, v in ((3, 10.0), (4, 20.0)):
+        (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps(
+            {"n": n, "rc": 0, "parsed": {"value": v}}))
+    prev = bench._previous_bench(str(tmp_path))
+    assert prev == {"value": 20.0}
+
+
+def test_previous_bench_absent_or_corrupt(tmp_path):
+    assert bench._previous_bench(str(tmp_path)) is None
+    (tmp_path / "BENCH_r01.json").write_text("{not json")
+    assert bench._previous_bench(str(tmp_path)) is None

@@ -138,6 +138,17 @@ def test_xla_fused_allgather():
     run_job("xla_fused_allgather", 2, timeout=240, extra_env=_xla_env(2))
 
 
+def test_shm_segmented_allreduce():
+    """A 4 KB segment cap forces ~100 segments per op: boundaries land
+    mid-entry, the fused group spans segments, and scale factors ride
+    the per-segment pack/unpack (the production default is 8 MB; the
+    cap also lets payloads larger than an arena slot use shm)."""
+    outs = run_job("shm_segmented", 4,
+                   extra_env={"HOROVOD_SHM_SEGMENT_BYTES": "4096"})
+    for r, out in enumerate(outs):
+        assert f"OK rank={r}" in out
+
+
 def test_shm_arena_active_single_host():
     """Single-host jobs must actually take the shared-memory data
     plane: the debug log announces the arena on every rank."""
