@@ -186,20 +186,26 @@ def _transformer_worker():
         cfg_std = TransformerConfig(
             vocab_size=8192, d_model=2048, n_layers=8, n_heads=16,
             n_kv_heads=8, d_ff=8192, max_seq=1024, dtype=jnp.bfloat16,
-            sp_attention="flash", flash_block_q=1024, flash_block_k=1024,
-            remat=False, scan_unroll=8)
+            sp_attention="flash", remat=False, scan_unroll=8)
         tok_s, mfu = measure(cfg_std, 8 * mesh.devices.size, 1024)
         out["transformer_std_tokens_per_sec_per_chip"] = tok_s
         if mfu is not None:
             out["transformer_std_mfu_pct"] = mfu
         print("TFEXTRA " + json.dumps(out), flush=True)
 
-        # Secondary: the round-3 d=4096x4L wide-shallow shape, kept for
-        # cross-round comparability.
+        # Secondary: the same d=4096x4L wide-shallow 1.04B SHAPE as
+        # rounds 3-4, but the measured CONFIG changed in round 5 —
+        # sp_attention local->flash (shape-derived blocks), remat off,
+        # scan_unroll=4: 69.1% MFU vs 56.3% for the old settings on
+        # v5e. Cross-round deltas on these keys before/after round 5
+        # therefore mix tuning with real speedups (the regression gate
+        # only trips on drops, so the jump itself cannot false-alarm).
+        # remat=False at scan_unroll=1 exceeds HBM on this shape; the
+        # unroll is what lets XLA schedule it under 16 GB.
         cfg_wide = TransformerConfig(
             vocab_size=8192, d_model=4096, n_layers=4, n_heads=32,
             n_kv_heads=8, d_ff=16384, max_seq=1024, dtype=jnp.bfloat16,
-            sp_attention="local")
+            sp_attention="flash", remat=False, scan_unroll=4)
         tok_s, mfu = measure(cfg_wide, 8 * mesh.devices.size, 1024)
         out["transformer_tokens_per_sec_per_chip"] = tok_s
         if mfu is not None:

@@ -59,11 +59,10 @@ class TransformerConfig:
     sp_attention: str = "ring"   # "ring" | "ulysses" | "local" |
                                  # "flash" (Pallas kernel, sp=1) |
                                  # "ring_flash" (Pallas blocks, sp>1)
-    # Pallas flash tile sizes (None = kernel defaults, 512x1024 —
-    # measured fastest at seq >= 8k on v5e). At short-to-medium seq a
-    # block spanning the whole sequence wins: 1024x1024 at seq 1024
-    # measures 61.6% vs 53.3% MFU at 128x128 on v5e (d=2048x8L) —
-    # grid overhead dominates small tiles.
+    # Pallas flash tile sizes (None = derived from the sequence
+    # length: sequence-spanning up to 1024 through seq 4096, 512x1024
+    # beyond — see ops/flash_attention._default_blocks for the
+    # measurements). Explicit values override the derivation.
     flash_block_q: Optional[int] = None
     flash_block_k: Optional[int] = None
     # Layer-scan unroll factor: unrolling lets XLA overlap across layer

@@ -151,9 +151,13 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "sp",
 
     # Chunk outputs stay f32 until the final merge so bf16 inputs round
     # exactly once, like ring_self_attention's f32 accumulator.
-    blocks = {kk: vv for kk, vv in
-              (("block_q", block_q), ("block_k", block_k))
-              if vv is not None}
+    # Unset blocks pin to 512x1024 (the tier measured on THIS path)
+    # rather than the kernel's shape-derived defaults, which were
+    # measured on the sp=1 causal path — per-chunk calls here are
+    # causal=False over T/sp-length chunks, a different regime.
+    blocks = {kk: (vv if vv is not None else dflt) for (kk, vv), dflt in
+              zip((("block_q", block_q), ("block_k", block_k)),
+                  (512, 1024))}
 
     def full_chunk(qb, kb, vb):
         return flash_attention_with_lse(qb, kb, vb, causal=False,
