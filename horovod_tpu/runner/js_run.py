@@ -1,0 +1,177 @@
+"""jsrun launcher (``horovodrun --jsrun``) for LSF machines where
+neither inter-node ssh nor a generic ``mpirun`` is available
+(Summit-class systems) — the cluster's ``jsrun`` owns placement.
+
+Rebuild of the reference ``runner/js_run.py:32-146`` +
+``runner/util/lsf.py``: one jsrun invocation with an ERF (explicit
+resource file) binding one rank per slot with an even share of the
+host's cores, Spectrum-MPI flags riding ``--smpiargs``. Differences
+from the reference are TPU-era deliberate:
+
+* host/slot discovery comes from the LSF env contract
+  (``LSB_MCPU_HOSTS``, parsed by ``runner/schedulers.py``) or
+  ``-H``/``--hostfile``, not from CSM allocation-database queries —
+  the CSM tools exist only on CORAL systems, while the env contract
+  is universal LSF;
+* cores-per-host comes from ``HOROVOD_JSRUN_CORES_PER_HOST`` (or the
+  launch node's own cpu count — LSF launch nodes are compute-class),
+  not a remote ``lscpu`` over ssh (there is no ssh here by premise);
+* rank identity comes from ``OMPI_COMM_WORLD_*`` (Spectrum MPI is
+  OpenMPI-derived; ``common/topology.py`` already reads it), and the
+  controller bootstraps through the launcher KV exactly like the
+  ``--mpi`` path.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Dict, List, Optional, Sequence
+
+JSRUN_NOT_FOUND_MSG = (
+    "horovodrun --jsrun could not find jsrun on PATH.\n"
+    "Run inside an LSF allocation on a cluster with the IBM Job Step "
+    "Manager installed, or use --mpi / the built-in ssh launcher.")
+
+
+def is_jsrun_installed() -> bool:
+    return shutil.which("jsrun") is not None
+
+
+def _cores_per_host() -> int:
+    """Core count used to split cpu ranges among a host's slots. LSF
+    launch nodes are compute-class, so the local count is the right
+    default; heterogeneous clusters override via env."""
+    env = os.environ.get("HOROVOD_JSRUN_CORES_PER_HOST")
+    if env:
+        n = int(env)
+        if n <= 0:
+            raise ValueError(
+                f"HOROVOD_JSRUN_CORES_PER_HOST must be positive, got {n}")
+        return n
+    return os.cpu_count() or 1
+
+
+def generate_jsrun_rankfile(hosts, np: int, path: str,
+                            cores_per_host: Optional[int] = None) -> str:
+    """Write the ERF: one rank per slot, consecutive ranks walking the
+    host list in order (matching ``get_host_assignments``' node-major
+    layout, so local/cross coordinates derived from the MPI env agree
+    with the ERF placement), each rank owning an even share of the
+    host's logical cpus (reference ``generate_jsrun_rankfile``:
+    core-splitting measured fastest there)."""
+    cores = cores_per_host or _cores_per_host()
+    total = sum(h.slots for h in hosts)
+    if total < np:
+        raise ValueError(
+            f"hosts provide {total} slots < -np {np}")
+    with open(path, "w") as f:
+        f.write("overlapping_rs: allow\n")
+        f.write("cpu_index_using: logical\n")
+        rank = 0
+        for h in hosts:
+            if rank >= np:
+                break
+            slots = min(h.slots, np - rank)
+            per = max(1, cores // max(1, h.slots))
+            f.write("\n")
+            for s in range(slots):
+                # Oversubscribed hosts (slots > cores) wrap around —
+                # overlapping_rs is set to allow exactly this; indices
+                # past the host's last core would be rejected.
+                lo = (s * per) % cores
+                hi = min(lo + per - 1, cores - 1)
+                f.write(f"rank: {rank}: {{ hostname: {h.hostname}; "
+                        f"cpu: {{{lo}-{hi}}} ; gpu: * ; "
+                        "mem: * }\n")
+                rank += 1
+    return path
+
+
+def build_jsrun_command(*, rankfile: str, env: Dict[str, str],
+                        command: Sequence[str],
+                        extra_keys: Sequence[str] = (),
+                        smpiargs: Optional[str] = None) -> List[str]:
+    """One jsrun invocation covering every rank (reference
+    ``js_run.py:104-115``, list-argv instead of a shell string).
+    Spectrum MPI flags ride ``--smpiargs``; the env contract is
+    forwarded explicitly with ``-E`` so task environments don't depend
+    on the site's jsrun propagation defaults."""
+    from horovod_tpu.runner.mpi_run import forwarded_env_keys
+
+    cmd: List[str] = ["jsrun", "--erf_input", rankfile]
+    if smpiargs:
+        # Spectrum-MPI option string, passed through verbatim (e.g.
+        # "-gpu"). No default: mpirun-style flags are not valid
+        # smpiargs tokens, and jsrun needs none to run.
+        cmd += ["--smpiargs", smpiargs]
+    for k in forwarded_env_keys(env, extra_keys):
+        # Name-only forwarding: jsrun reads the value from ITS
+        # environment (WorkerProcess launches it with `env`). Values
+        # on the argv would expose the rendezvous token to `ps` on a
+        # shared launch node.
+        cmd += ["-E", k]
+    cmd += list(command)
+    return cmd
+
+
+def launch_jsrun(settings, kv_server=None) -> Dict[int, int]:
+    """Run the job under jsrun; returns {0: exit_code} (jsrun
+    aggregates task failures into its own exit status). Mirrors
+    ``launch_mpi``: the launcher owns the rendezvous KV and the
+    uniform env contract; only process placement moves to jsrun."""
+    import socket
+    import tempfile
+
+    from horovod_tpu.runner.launch import (_resolve_hosts, is_local_host,
+                                           kv_scope)
+    from horovod_tpu.runner.safe_exec import WorkerProcess, wait_all
+
+    if not is_jsrun_installed():
+        raise RuntimeError(JSRUN_NOT_FOUND_MSG)
+
+    host_list = _resolve_hosts(settings)
+    all_local = all(is_local_host(h.hostname) for h in host_list)
+    with kv_scope(all_local, kv_server) as server:
+        launcher_host = "127.0.0.1" if all_local else socket.getfqdn()
+        env = dict(os.environ)
+        # Uniform env: strip every rank-scoped identity a parent job
+        # may have leaked (same invariant as launch_mpi).
+        for k in ("HOROVOD_RANK", "HOROVOD_SIZE", "HOROVOD_LOCAL_RANK",
+                  "HOROVOD_LOCAL_SIZE", "HOROVOD_CROSS_RANK",
+                  "HOROVOD_CROSS_SIZE", "HOROVOD_ELASTIC_ID",
+                  "HOROVOD_ELASTIC_EPOCH", "HOROVOD_CONTROLLER_ADDR"):
+            env.pop(k, None)
+        env.update(settings.env or {})
+        env.update({
+            "HOROVOD_RENDEZVOUS_ADDR": f"{launcher_host}:{server.port}",
+            "HOROVOD_RENDEZVOUS_TOKEN": server.token,
+            "HOROVOD_START_TIMEOUT": str(settings.start_timeout),
+            "HOROVOD_CONTROLLER_TIMEOUT_MS":
+                str(int(settings.start_timeout * 1000)),
+        })
+        if all_local:
+            env["HOROVOD_CONTROLLER_HOST"] = "127.0.0.1"
+        else:
+            # jsrun owns placement; rank 0 self-advertises (see
+            # launch_mpi for the rationale).
+            env.pop("HOROVOD_CONTROLLER_HOST", None)
+        if env.get("HOROVOD_TIMELINE"):
+            env["HOROVOD_TIMELINE_RANK_SUFFIX"] = "1"
+        fd, rankfile = tempfile.mkstemp(prefix="hvd_jsrun_", suffix=".erf")
+        os.close(fd)
+        try:
+            generate_jsrun_rankfile(host_list, settings.np, rankfile)
+            if settings.verbose:
+                with open(rankfile) as f:
+                    print(f"[jsrun] ERF:\n{f.read()}")
+            cmd = build_jsrun_command(
+                rankfile=rankfile, env=env, command=settings.command,
+                extra_keys=tuple(settings.env or ()))
+            worker = WorkerProcess(0, cmd, env, prefix="[jsrun]")
+            return wait_all([worker])
+        finally:
+            try:
+                os.unlink(rankfile)
+            except OSError:
+                pass

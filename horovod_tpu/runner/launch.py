@@ -343,6 +343,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "the built-in ssh launcher; ranks read "
                         "OMPI_COMM_WORLD_* and rendezvous through the "
                         "launcher KV as usual")
+    p.add_argument("--jsrun", action="store_true",
+                   help="launch through LSF's jsrun (Summit-class "
+                        "machines without inter-node ssh or generic "
+                        "mpirun): one invocation with an ERF rankfile "
+                        "built from the LSF allocation; auto-selected "
+                        "inside an LSF job when jsrun is on PATH")
     p.add_argument("--tpu", action="store_true",
                    help="TPU pod-slice launch: carve each host's chips "
                         "into one single-chip process per slot (libtpu "
@@ -519,25 +525,46 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         start_timeout=args.start_timeout, verbose=args.verbose,
         ssh_port=args.ssh_port, tpu=args.tpu,
         tpu_topology=args.tpu_topology)
-    if args.mpi:
+    use_jsrun = args.jsrun
+    if (not use_jsrun and not args.mpi and not args.tpu
+            and not args.discovery_script
+            and not args.hosts and not args.hostfile
+            and os.environ.get("LSB_JOBID")):
+        # Inside an LSF job the built-in ssh launcher usually cannot
+        # reach the compute nodes; prefer jsrun when the site has it
+        # (reference: jsrun is the LSF default launcher).
+        from horovod_tpu.runner.js_run import is_jsrun_installed
+        use_jsrun = is_jsrun_installed()
+        if use_jsrun and args.verbose:
+            print("horovodrun: LSF allocation detected, launching "
+                  "via jsrun (pass --mpi or -H to override)")
+    if use_jsrun or args.mpi:
+        launcher = "jsrun" if use_jsrun else "mpirun"
         if args.discovery_script:
-            print("horovodrun: --mpi is incompatible with elastic mode "
-                  "(mpirun owns a fixed world)", file=sys.stderr)
+            print(f"horovodrun: --{'jsrun' if use_jsrun else 'mpi'} is "
+                  "incompatible with elastic mode "
+                  f"({launcher} owns a fixed world)", file=sys.stderr)
             return 2
         if args.tpu:
-            print("horovodrun: --mpi does not apply the --tpu chip "
+            print(f"horovodrun: --{'jsrun' if use_jsrun else 'mpi'} does "
+                  "not apply the --tpu chip "
                   "carve (per-slot env needs the built-in launcher); "
                   "drop one of the flags", file=sys.stderr)
             return 2
-        from horovod_tpu.runner.mpi_run import launch_mpi
         try:
-            codes = launch_mpi(settings)
+            if use_jsrun:
+                from horovod_tpu.runner.js_run import launch_jsrun
+                codes = launch_jsrun(settings)
+            else:
+                from horovod_tpu.runner.mpi_run import launch_mpi
+                codes = launch_mpi(settings)
         except (RuntimeError, ValueError) as e:
             print(f"horovodrun: {e}", file=sys.stderr)
             return 2
         rc = codes.get(0, 1)
         if rc != 0:
-            print(f"horovodrun: mpirun exited with {rc}", file=sys.stderr)
+            print(f"horovodrun: {launcher} exited with {rc}",
+                  file=sys.stderr)
         # Signal deaths map to the shell convention (raw negatives
         # would wrap mod 256) — same policy as the static path below.
         return rc if rc >= 0 else 128 + abs(rc)

@@ -471,6 +471,162 @@ def test_horovodrun_mpi_missing_mpirun(capfd, monkeypatch, tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# jsrun passthrough (reference runner/js_run.py tier)
+# ---------------------------------------------------------------------------
+
+_STUB_JSRUN = """#!{python}
+import os, subprocess, sys
+args = sys.argv[1:]
+erf = None; smpiargs = None; envs = []; cmd = None
+i = 0
+while i < len(args):
+    a = args[i]
+    if a == "--erf_input":
+        erf = args[i + 1]; i += 2
+    elif a == "--smpiargs":
+        smpiargs = args[i + 1]; i += 2
+    elif a == "-E":
+        envs.append(args[i + 1]); i += 2
+    else:
+        cmd = args[i:]
+        break
+assert erf and cmd, (erf, cmd)
+ranks = []
+for line in open(erf):
+    line = line.strip()
+    if line.startswith("rank:"):
+        # rank: N: ... hostname, cpu range, gpu, mem (ERF line)
+        n = int(line.split(":")[1].strip())
+        host = line.split("hostname:")[1].split(";")[0].strip()
+        ranks.append((n, host))
+procs = []
+for n, host in sorted(ranks):
+    env = dict(os.environ)
+    for kv in envs:
+        # name-only -E: jsrun forwards the value from its own env
+        assert "=" not in kv, "token must not ride the argv: " + kv
+        assert kv in os.environ, "forwarded var missing from env: " + kv
+    local = sum(1 for m, h in ranks if h == host and m < n)
+    lsize = sum(1 for m, h in ranks if h == host)
+    env.update({{"OMPI_COMM_WORLD_RANK": str(n),
+                 "OMPI_COMM_WORLD_SIZE": str(len(ranks)),
+                 "OMPI_COMM_WORLD_LOCAL_RANK": str(local),
+                 "OMPI_COMM_WORLD_LOCAL_SIZE": str(lsize)}})
+    procs.append(subprocess.Popen(cmd, env=env))
+sys.exit(max(p.wait() for p in procs))
+"""
+
+
+@pytest.fixture()
+def stub_jsrun(tmp_path, monkeypatch):
+    """A fake jsrun on PATH: parses --erf_input/--smpiargs/-E and
+    spawns one local process per ERF rank with the OMPI_COMM_WORLD_*
+    identity contract (Spectrum MPI is OpenMPI-derived)."""
+    path = tmp_path / "jsrun"
+    path.write_text(_STUB_JSRUN.format(python=sys.executable))
+    path.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{tmp_path}{os.pathsep}{os.environ['PATH']}")
+    return str(path)
+
+
+def test_jsrun_rankfile_layout(tmp_path, monkeypatch):
+    from horovod_tpu.runner.js_run import generate_jsrun_rankfile
+
+    monkeypatch.setenv("HOROVOD_JSRUN_CORES_PER_HOST", "8")
+    rf = str(tmp_path / "r.erf")
+    generate_jsrun_rankfile([HostInfo("h1", 2), HostInfo("h2", 2)], 3, rf)
+    text = open(rf).read()
+    assert "overlapping_rs: allow" in text
+    assert "cpu_index_using: logical" in text
+    # 3 of the 4 slots used; node-major rank order; even core split.
+    assert "rank: 0: { hostname: h1; cpu: {0-3}" in text
+    assert "rank: 1: { hostname: h1; cpu: {4-7}" in text
+    assert "rank: 2: { hostname: h2; cpu: {0-3}" in text
+    assert "rank: 3" not in text
+
+    with pytest.raises(ValueError, match="2 slots < -np 4"):
+        generate_jsrun_rankfile([HostInfo("h1", 2)], 4, rf)
+
+    # Oversubscription (slots > cores) wraps cpu indices instead of
+    # emitting cores the host doesn't have.
+    monkeypatch.setenv("HOROVOD_JSRUN_CORES_PER_HOST", "2")
+    generate_jsrun_rankfile([HostInfo("h1", 4)], 4, rf)
+    text = open(rf).read()
+    assert "rank: 2: { hostname: h1; cpu: {0-0}" in text
+    assert "cpu: {2-" not in text and "cpu: {3-" not in text
+
+
+def test_horovodrun_jsrun_end_to_end(stub_jsrun, capfd):
+    """--jsrun end to end: one jsrun invocation, ERF placement, ranks
+    from OMPI_COMM_WORLD_*, controller discovered via the launcher
+    KV (mirrors test_horovodrun_mpi_end_to_end)."""
+    from horovod_tpu.runner.launch import main
+
+    env_backup = {k: os.environ.pop(k) for k in list(os.environ)
+                  if k.startswith("HOROVOD_")}
+    try:
+        for k, v in _WORKER_ENV.items():
+            os.environ[k] = v
+        rc = main(["--jsrun", "-np", "2", "--",
+                   sys.executable, "-c", _MPI_SNIPPET.format(root=ROOT)])
+    finally:
+        for k in list(os.environ):
+            if k.startswith("HOROVOD_"):
+                os.environ.pop(k)
+        os.environ.update(env_backup)
+    assert rc == 0
+    out = capfd.readouterr().out
+    for r in range(2):
+        assert f"MPI_OK {r}/2" in out
+
+
+def test_horovodrun_jsrun_autoselected_under_lsf(stub_jsrun, capfd,
+                                                 monkeypatch):
+    """Inside an LSF allocation with jsrun on PATH and no explicit
+    launcher flag, horovodrun launches through jsrun (the reference's
+    LSF default)."""
+    from horovod_tpu.runner.launch import main
+
+    env_backup = {k: os.environ.pop(k) for k in list(os.environ)
+                  if k.startswith("HOROVOD_")}
+    monkeypatch.setenv("LSB_JOBID", "123")
+    monkeypatch.setenv("LSB_MCPU_HOSTS", "localhost 2")
+    try:
+        for k, v in _WORKER_ENV.items():
+            os.environ[k] = v
+        rc = main(["-np", "2", "--",
+                   sys.executable, "-c", _MPI_SNIPPET.format(root=ROOT)])
+    finally:
+        for k in list(os.environ):
+            if k.startswith("HOROVOD_"):
+                os.environ.pop(k)
+        os.environ.update(env_backup)
+    assert rc == 0
+    out = capfd.readouterr().out
+    assert "MPI_OK 0/2" in out and "MPI_OK 1/2" in out
+
+
+def test_horovodrun_jsrun_rejects_tpu_and_elastic(stub_jsrun, capfd):
+    from horovod_tpu.runner.launch import main
+
+    assert main(["--jsrun", "--tpu", "-np", "4", "--", "python",
+                 "x.py"]) == 2
+    assert "chip carve" in capfd.readouterr().err
+    assert main(["--jsrun", "-np", "2", "--host-discovery-script", "d.sh",
+                 "--", "python", "x.py"]) == 2
+    assert "elastic" in capfd.readouterr().err
+
+
+def test_horovodrun_jsrun_missing(capfd, monkeypatch, tmp_path):
+    from horovod_tpu.runner.launch import main
+
+    monkeypatch.setenv("PATH", str(tmp_path))  # no jsrun anywhere
+    rc = main(["--jsrun", "-np", "2", "--", "python", "x.py"])
+    assert rc == 2
+    assert "could not find jsrun" in capfd.readouterr().err
+
+
+# ---------------------------------------------------------------------------
 # Scheduler allocation detection (reference runner/util/lsf.py role)
 # ---------------------------------------------------------------------------
 
