@@ -120,11 +120,11 @@ def launch_jsrun(settings, kv_server=None) -> Dict[int, int]:
     aggregates task failures into its own exit status). Mirrors
     ``launch_mpi``: the launcher owns the rendezvous KV and the
     uniform env contract; only process placement moves to jsrun."""
-    import socket
     import tempfile
 
     from horovod_tpu.runner.launch import (_resolve_hosts, is_local_host,
                                            kv_scope)
+    from horovod_tpu.runner.mpi_run import build_passthrough_env
     from horovod_tpu.runner.safe_exec import WorkerProcess, wait_all
 
     if not is_jsrun_installed():
@@ -133,31 +133,7 @@ def launch_jsrun(settings, kv_server=None) -> Dict[int, int]:
     host_list = _resolve_hosts(settings)
     all_local = all(is_local_host(h.hostname) for h in host_list)
     with kv_scope(all_local, kv_server) as server:
-        launcher_host = "127.0.0.1" if all_local else socket.getfqdn()
-        env = dict(os.environ)
-        # Uniform env: strip every rank-scoped identity a parent job
-        # may have leaked (same invariant as launch_mpi).
-        for k in ("HOROVOD_RANK", "HOROVOD_SIZE", "HOROVOD_LOCAL_RANK",
-                  "HOROVOD_LOCAL_SIZE", "HOROVOD_CROSS_RANK",
-                  "HOROVOD_CROSS_SIZE", "HOROVOD_ELASTIC_ID",
-                  "HOROVOD_ELASTIC_EPOCH", "HOROVOD_CONTROLLER_ADDR"):
-            env.pop(k, None)
-        env.update(settings.env or {})
-        env.update({
-            "HOROVOD_RENDEZVOUS_ADDR": f"{launcher_host}:{server.port}",
-            "HOROVOD_RENDEZVOUS_TOKEN": server.token,
-            "HOROVOD_START_TIMEOUT": str(settings.start_timeout),
-            "HOROVOD_CONTROLLER_TIMEOUT_MS":
-                str(int(settings.start_timeout * 1000)),
-        })
-        if all_local:
-            env["HOROVOD_CONTROLLER_HOST"] = "127.0.0.1"
-        else:
-            # jsrun owns placement; rank 0 self-advertises (see
-            # launch_mpi for the rationale).
-            env.pop("HOROVOD_CONTROLLER_HOST", None)
-        if env.get("HOROVOD_TIMELINE"):
-            env["HOROVOD_TIMELINE_RANK_SUFFIX"] = "1"
+        env = build_passthrough_env(settings, server, all_local)
         fd, rankfile = tempfile.mkstemp(prefix="hvd_jsrun_", suffix=".erf")
         os.close(fd)
         try:

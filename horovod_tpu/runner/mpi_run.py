@@ -124,6 +124,49 @@ def build_mpi_command(*, np: int, impl: str, env: Dict[str, str],
     return cmd
 
 
+def build_passthrough_env(settings, server, all_local: bool
+                          ) -> Dict[str, str]:
+    """The uniform worker-env contract shared by every passthrough
+    launcher (mpirun, jsrun): rank identity comes from the MPI env, so
+    every rank-scoped HOROVOD_* key a parent job may have leaked is
+    stripped, and the rank-independent contract (rendezvous KV,
+    timeouts, controller-host policy, timeline suffixing) is applied.
+    One function so the transports cannot drift."""
+    import os
+
+    env = dict(os.environ)
+    # topology.py prefers HOROVOD_RANK over OMPI_COMM_WORLD_RANK, so a
+    # forwarded stale rank would alias every process (the per-slot
+    # launcher enforces the same invariant in _slot_env).
+    for k in ("HOROVOD_RANK", "HOROVOD_SIZE", "HOROVOD_LOCAL_RANK",
+              "HOROVOD_LOCAL_SIZE", "HOROVOD_CROSS_RANK",
+              "HOROVOD_CROSS_SIZE", "HOROVOD_ELASTIC_ID",
+              "HOROVOD_ELASTIC_EPOCH", "HOROVOD_CONTROLLER_ADDR"):
+        env.pop(k, None)
+    env.update(settings.env or {})
+    launcher_host = "127.0.0.1" if all_local else __import__(
+        "socket").getfqdn()
+    env.update({
+        "HOROVOD_RENDEZVOUS_ADDR": f"{launcher_host}:{server.port}",
+        "HOROVOD_RENDEZVOUS_TOKEN": server.token,
+        "HOROVOD_START_TIMEOUT": str(settings.start_timeout),
+        "HOROVOD_CONTROLLER_TIMEOUT_MS":
+            str(int(settings.start_timeout * 1000)),
+    })
+    if all_local:
+        env["HOROVOD_CONTROLLER_HOST"] = "127.0.0.1"
+    else:
+        # The passthrough launcher owns placement — it cannot know
+        # which node gets rank 0. Leave HOROVOD_CONTROLLER_HOST unset
+        # so rank 0 self-advertises its outbound IP (rendezvous.py).
+        env.pop("HOROVOD_CONTROLLER_HOST", None)
+    if env.get("HOROVOD_TIMELINE"):
+        # Per-slot launchers suffix the timeline path per rank; a
+        # uniform env cannot — the runtime does it at init instead.
+        env["HOROVOD_TIMELINE_RANK_SUFFIX"] = "1"
+    return env
+
+
 def launch_mpi(settings, kv_server=None) -> Dict[int, int]:
     """Run the job under the cluster's mpirun; returns {0: exit_code}
     (mpirun aggregates rank failures into its own exit status).
@@ -136,9 +179,6 @@ def launch_mpi(settings, kv_server=None) -> Dict[int, int]:
     a scheduler this launcher does not know about, pass -H explicitly —
     otherwise the KV binds loopback while mpirun places ranks remotely.
     """
-    import os
-    import socket
-
     from horovod_tpu.runner.launch import (_resolve_hosts, is_local_host,
                                            kv_scope)
     from horovod_tpu.runner.safe_exec import WorkerProcess, wait_all
@@ -154,38 +194,7 @@ def launch_mpi(settings, kv_server=None) -> Dict[int, int]:
                   if (settings.hosts or settings.hostfile) else None)
     all_local = all(is_local_host(h.hostname) for h in host_list)
     with kv_scope(all_local, kv_server) as server:
-        launcher_host = "127.0.0.1" if all_local else socket.getfqdn()
-        env = dict(os.environ)
-        # The env is UNIFORM across ranks under mpirun — strip every
-        # rank-scoped identity a parent job may have leaked (the per-
-        # slot launcher enforces the same invariant in _slot_env):
-        # topology.py prefers HOROVOD_RANK over OMPI_COMM_WORLD_RANK,
-        # so a forwarded stale rank would alias every process.
-        for k in ("HOROVOD_RANK", "HOROVOD_SIZE", "HOROVOD_LOCAL_RANK",
-                  "HOROVOD_LOCAL_SIZE", "HOROVOD_CROSS_RANK",
-                  "HOROVOD_CROSS_SIZE", "HOROVOD_ELASTIC_ID",
-                  "HOROVOD_ELASTIC_EPOCH", "HOROVOD_CONTROLLER_ADDR"):
-            env.pop(k, None)
-        env.update(settings.env or {})
-        env.update({
-            # Rank-independent contract; ranks come from the MPI env.
-            "HOROVOD_RENDEZVOUS_ADDR": f"{launcher_host}:{server.port}",
-            "HOROVOD_RENDEZVOUS_TOKEN": server.token,
-            "HOROVOD_START_TIMEOUT": str(settings.start_timeout),
-            "HOROVOD_CONTROLLER_TIMEOUT_MS":
-                str(int(settings.start_timeout * 1000)),
-        })
-        if all_local:
-            env["HOROVOD_CONTROLLER_HOST"] = "127.0.0.1"
-        else:
-            # mpirun owns placement — the launcher cannot know which
-            # node gets rank 0. Leave HOROVOD_CONTROLLER_HOST unset so
-            # rank 0 self-advertises its outbound IP (rendezvous.py).
-            env.pop("HOROVOD_CONTROLLER_HOST", None)
-        if env.get("HOROVOD_TIMELINE"):
-            # Per-slot launchers suffix the timeline path per rank; a
-            # uniform env cannot — the runtime does it at init instead.
-            env["HOROVOD_TIMELINE_RANK_SUFFIX"] = "1"
+        env = build_passthrough_env(settings, server, all_local)
         cmd = build_mpi_command(
             np=settings.np, impl=impl, env=env, command=settings.command,
             hosts=hosts_spec, ssh_port=settings.ssh_port,

@@ -21,7 +21,8 @@ where a columnar format would slot in.
 from __future__ import annotations
 
 import pickle
-from typing import Callable, List
+import uuid
+from typing import Callable, List, Optional
 
 from horovod_tpu.spark.store import FsspecStore, Store, assign_partitions
 
@@ -34,60 +35,119 @@ __all__ = ["Store", "FsspecStore", "TorchEstimator", "TorchModel",
 #: property Petastorm provides in the reference).
 STAGE_CHUNK_ROWS = 65536
 
+#: per-epoch checkpoint key inside a run's namespace (resume=True
+#: continues from it; reference resume-from-checkpoint,
+#: ``spark/common/estimator.py``). Carries model weights, OPTIMIZER
+#: state, the epoch index, and the metrics history — a resumed run is
+#: equivalent to an uninterrupted one.
+CKPT_KEY = "checkpoint.pkl"
+
+
+def _mean_across_ranks(hvd, total: float, n: int, name: str) -> float:
+    """Average a per-rank mean (``total/n``) across all ranks — the
+    per-epoch metric reduction shared by both estimators."""
+    import numpy as np
+
+    local = total / max(n, 1)
+    return float(np.asarray(hvd.allreduce(
+        np.asarray([local], np.float32), op=hvd.Average, name=name))[0])
+
 
 def _stage_dataframe(df, cols: List[str], store: Store, num_proc: int,
-                     chunk_rows: int = STAGE_CHUNK_ROWS):
+                     chunk_rows: int = STAGE_CHUNK_ROWS,
+                     validation: float = 0.0):
     """Executor-side staging: every partition streams its rows into
     CHUNKED float32 shards (``part.{pid}.c{k}``, each <= ``chunk_rows``
     rows) so a partition larger than executor memory never
     materializes whole; only ``(partition, row_count)`` pairs come back
-    to the driver. Returns the per-rank partition assignment and the
-    padded per-rank row target."""
+    to the driver.
+
+    ``validation`` in (0, 1) holds out roughly that fraction of each
+    partition's rows into ``val.{pid}.c{k}`` shards (deterministic
+    every-k-th-row split, so re-staging the same DataFrame reproduces
+    the same split — the reference's validation-percent mode,
+    ``spark/common/estimator.py``).
+
+    Returns ``(assigned, target, val_assigned, val_target)`` — the
+    per-rank partition assignments and wrap-padded row targets for the
+    train and validation sets (validation pair is ``(None, 0)`` when
+    no split was requested or the holdout came up empty)."""
     n_cols = len(cols)
+    if validation and not 0.0 < validation < 0.5:
+        raise ValueError(f"validation={validation} must be in (0, 0.5) "
+                         "(the larger side is the training set)")
+    every = int(round(1.0 / validation)) if validation else 0
 
     def stage(pid, rows_iter):
         import numpy as np
-        total, k, buf = 0, 0, []
-        for row in rows_iter:
-            buf.append([float(row[c]) for c in cols])
-            if len(buf) >= chunk_rows:
-                store.write_shard(f"part.{pid}.c{k}",
-                                  np.asarray(buf, dtype=np.float32))
-                total += len(buf)
-                buf, k = [], k + 1
-        if buf:
-            store.write_shard(f"part.{pid}.c{k}",
-                              np.asarray(buf, dtype=np.float32))
-            total += len(buf)
-            k += 1
-        store.write_array(f"part.{pid}.meta", {"rows": total,
-                                               "chunks": k,
-                                               "cols": n_cols})
-        yield (pid, total)
+
+        class _Split:
+            def __init__(self, prefix):
+                self.prefix = prefix
+                self.total = self.k = 0
+                self.buf = []
+
+            def add(self, vals):
+                self.buf.append(vals)
+                if len(self.buf) >= chunk_rows:
+                    self.flush()
+
+            def flush(self):
+                if self.buf:
+                    store.write_shard(
+                        f"{self.prefix}.{pid}.c{self.k}",
+                        np.asarray(self.buf, dtype=np.float32))
+                    self.total += len(self.buf)
+                    self.buf, self.k = [], self.k + 1
+
+            def finish(self):
+                self.flush()
+                store.write_array(f"{self.prefix}.{pid}.meta",
+                                  {"rows": self.total, "chunks": self.k,
+                                   "cols": n_cols})
+
+        train, val = _Split("part"), _Split("val")
+        for i, row in enumerate(rows_iter):
+            vals = [float(row[c]) for c in cols]
+            if every and i % every == every - 1:
+                val.add(vals)
+            else:
+                train.add(vals)
+        train.finish()
+        if every:
+            val.finish()
+        yield (pid, (train.total, val.total))
 
     counts = dict(df.select(*cols).rdd
                   .mapPartitionsWithIndex(stage).collect())
-    return assign_partitions(counts, num_proc)
+    assigned, target = assign_partitions(
+        {p: c[0] for p, c in counts.items()}, num_proc)
+    val_counts = {p: c[1] for p, c in counts.items()}
+    if not every or all(v == 0 for v in val_counts.values()):
+        return assigned, target, None, 0
+    val_assigned, val_target = assign_partitions(val_counts, num_proc)
+    return assigned, target, val_assigned, val_target
 
 
 def _iter_rank_batches(store: Store, parts: List[int], target: int,
-                       batch_size: int):
+                       batch_size: int, prefix: str = "part"):
     """Worker side: stream this rank's staged partitions chunk by
     chunk, yielding fixed-size batches, wrap-padded to ``target`` rows
     — every rank runs the SAME ``ceil(target/batch_size)`` optimizer
     steps (the reference gets the equal-length property from
     Petastorm's epoch semantics), with memory bounded by one chunk plus
-    one batch regardless of shard size."""
+    one batch regardless of shard size. ``prefix`` selects the staged
+    split ("part" = train, "val" = validation holdout)."""
     import numpy as np
 
     # Metas once, not per wrap; and a rank whose whole share fits one
     # chunk budget is served from memory — the wrap-pad of a skewed
     # small rank must not become O(target) store round-trips.
-    metas = {p: store.read_array(f"part.{p}.meta") for p in parts}
+    metas = {p: store.read_array(f"{prefix}.{p}.meta") for p in parts}
     total_rows = sum(m["rows"] for m in metas.values())
     if total_rows <= STAGE_CHUNK_ROWS:
         rows = np.concatenate(
-            [store.read_shard(f"part.{p}.c{k}")
+            [store.read_shard(f"{prefix}.{p}.c{k}")
              for p in parts for k in range(metas[p]["chunks"])])
         for off in range(0, target, batch_size):
             need = min(batch_size, target - off)
@@ -97,7 +157,7 @@ def _iter_rank_batches(store: Store, parts: List[int], target: int,
     def chunks():
         for p in parts:
             for k in range(metas[p]["chunks"]):
-                yield store.read_shard(f"part.{p}.c{k}")
+                yield store.read_shard(f"{prefix}.{p}.c{k}")
 
     emitted = 0
     carry = None
@@ -157,14 +217,25 @@ class TorchEstimator:
     Parameters mirror the reference's essentials: ``model`` (torch
     module), ``optimizer`` factory ``(params) -> torch.optim``, ``loss``
     ``(output, label) -> scalar``, feature/label columns, epochs,
-    batch_size, ``num_proc`` ranks.
+    batch_size, ``num_proc`` ranks. Productionization tier (reference
+    ``spark/common/estimator.py`` / ``spark/torch/estimator.py:91``):
+
+    * ``validation`` — fraction in (0, 0.5) held out at staging time;
+      per-epoch train AND validation loss land in the returned model's
+      ``history``;
+    * ``run_id`` — per-run staging namespace under the store
+      (auto-generated when absent, readable as ``last_run_id`` after
+      ``fit``); concurrent fits sharing a store never collide;
+    * ``resume`` — with a stable ``run_id``, continue from the run's
+      last per-epoch checkpoint instead of epoch 0.
     """
 
     def __init__(self, *, model, optimizer: Callable, loss: Callable,
                  feature_cols: List[str], label_cols: List[str],
                  store: Store, num_proc: int = 2, epochs: int = 1,
-                 batch_size: int = 32,
-                 compression=None):
+                 batch_size: int = 32, compression=None,
+                 validation: float = 0.0, run_id: Optional[str] = None,
+                 resume: bool = False):
         self.model = model
         self.optimizer = optimizer
         self.loss = loss
@@ -175,19 +246,29 @@ class TorchEstimator:
         self.epochs = epochs
         self.batch_size = batch_size
         self.compression = compression
+        self.validation = validation
+        self.run_id = run_id
+        self.resume = resume
+        self.last_run_id: Optional[str] = None
+        if resume and not run_id:
+            raise ValueError("resume=True needs a stable run_id (the "
+                             "checkpoint lives in that run's namespace)")
 
     def fit(self, df) -> "TorchModel":
         from horovod_tpu.spark.runner import run as spark_run
 
+        run_id = self.run_id or uuid.uuid4().hex[:12]
+        self.last_run_id = run_id
+        store = self.store.run(run_id)
         cols = self.feature_cols + self.label_cols
-        assigned, target = _stage_dataframe(df, cols, self.store,
-                                            self.num_proc)
+        assigned, target, val_assigned, val_target = _stage_dataframe(
+            df, cols, store, self.num_proc, validation=self.validation)
 
         n_feat = len(self.feature_cols)
         payload = pickle.dumps(self.model)
         opt_factory, loss_fn = self.optimizer, self.loss
-        store, epochs, bs = self.store, self.epochs, self.batch_size
-        compression = self.compression
+        epochs, bs = self.epochs, self.batch_size
+        compression, resume = self.compression, self.resume
 
         def train_fn():
             import torch
@@ -196,47 +277,98 @@ class TorchEstimator:
 
             hvd.init()
             model = pickle.loads(payload)
+            start_epoch, history, ck = 0, [], None
+            if resume and store.exists(CKPT_KEY):
+                # Every rank reads the same checkpoint file — the
+                # store is shared by contract, and a uniform load
+                # avoids needing a second broadcast for opt state.
+                ck = store.read_array(CKPT_KEY)
+                model.load_state_dict({k: torch.as_tensor(v)
+                                       for k, v in ck["state"].items()})
+                start_epoch, history = ck["epoch"], ck["history"]
             opt = opt_factory(model.parameters())
+            if ck is not None and "opt_state" in ck:
+                # Optimizer moments/step counts resume too — without
+                # them the first post-resume epochs re-warm Adam-class
+                # optimizers and loss spikes.
+                opt.load_state_dict(ck["opt_state"])
             extra = ({"compression": compression}
                      if compression is not None else {})
             opt = hvd.DistributedOptimizer(
                 opt, named_parameters=model.named_parameters(), **extra)
             hvd.broadcast_parameters(model.state_dict(), root_rank=0)
-            for _ in range(epochs):
+
+            def mean_across_ranks(total, n, name):
+                return _mean_across_ranks(hvd, total, n, name)
+
+            for epoch in range(start_epoch, epochs):
+                tot, nb = 0.0, 0
                 for rows in _iter_rank_batches(store,
                                                assigned[hvd.rank()],
                                                target, bs):
                     xb = torch.as_tensor(rows[:, :n_feat])
                     yb = torch.as_tensor(rows[:, n_feat:])
                     opt.zero_grad()
-                    loss_fn(model(xb), yb).backward()
+                    loss = loss_fn(model(xb), yb)
+                    loss.backward()
                     opt.step()
+                    tot, nb = tot + float(loss.detach()), nb + 1
+                metrics = {"epoch": epoch + 1,
+                           "train_loss": mean_across_ranks(
+                               tot, nb, f"metric.train.{epoch}")}
+                if val_assigned is not None:
+                    vtot, vnb = 0.0, 0
+                    with torch.no_grad():
+                        for rows in _iter_rank_batches(
+                                store, val_assigned[hvd.rank()],
+                                val_target, bs, prefix="val"):
+                            xb = torch.as_tensor(rows[:, :n_feat])
+                            yb = torch.as_tensor(rows[:, n_feat:])
+                            vtot += float(loss_fn(model(xb), yb))
+                            vnb += 1
+                    metrics["val_loss"] = mean_across_ranks(
+                        vtot, vnb, f"metric.val.{epoch}")
+                history.append(metrics)
+                if hvd.rank() == 0:
+                    # Per-epoch checkpoint: a killed job resumes here
+                    # (resume=True with the same run_id).
+                    store.write_array(CKPT_KEY, {
+                        "epoch": epoch + 1,
+                        "state": {k: v.numpy()
+                                  for k, v in model.state_dict().items()},
+                        "opt_state": opt.state_dict(),
+                        "history": history})
             state = None
             if hvd.rank() == 0:
                 with store.open(store.model_key(), "wb") as f:
                     torch.save(model.state_dict(), f)
                 state = {k: v.numpy() for k, v in model.state_dict().items()}
             hvd.shutdown()
-            return state
+            return state, history
 
         results = spark_run(train_fn, num_proc=self.num_proc)
-        state = next(r for r in results if r is not None)
+        state, history = next(r for r in results if r[0] is not None)
         return TorchModel(model=self.model, state=state,
                           feature_cols=self.feature_cols,
-                          label_cols=self.label_cols)
+                          label_cols=self.label_cols, history=history,
+                          run_id=run_id)
 
 
 class TorchModel:
     """Transformer returned by fit(): appends prediction columns
     (reference returns a Spark ML Transformer; this one exposes both
     ``transform(df)`` for DataFrames and ``predict(features)`` for
-    local numpy use)."""
+    local numpy use). ``history`` is the per-epoch metrics list
+    (``[{"epoch", "train_loss"[, "val_loss"]}, ...]``)."""
 
-    def __init__(self, *, model, state, feature_cols, label_cols):
+    def __init__(self, *, model, state, feature_cols, label_cols,
+                 history=None, run_id=None):
         self.model = model
         self.state = state
         self.feature_cols = feature_cols
         self.label_cols = label_cols
+        self.history = history or []
+        self.run_id = run_id
 
     def _torch_model(self):
         import torch
@@ -289,7 +421,8 @@ class JaxEstimator:
                  loss: Callable, feature_cols: List[str],
                  label_cols: List[str], store: Store, num_proc: int = 2,
                  epochs: int = 1, batch_size: int = 32, optimizer=None,
-                 seed: int = 0):
+                 seed: int = 0, validation: float = 0.0,
+                 run_id: Optional[str] = None, resume: bool = False):
         self.init_fn = init_fn
         self.apply_fn = apply_fn
         self.loss = loss
@@ -301,25 +434,36 @@ class JaxEstimator:
         self.batch_size = batch_size
         self.optimizer = optimizer
         self.seed = seed
+        self.validation = validation
+        self.run_id = run_id
+        self.resume = resume
+        self.last_run_id: Optional[str] = None
+        if resume and not run_id:
+            raise ValueError("resume=True needs a stable run_id (the "
+                             "checkpoint lives in that run's namespace)")
 
     def fit(self, df) -> "JaxModel":
         import cloudpickle
 
         from horovod_tpu.spark.runner import run as spark_run
 
+        run_id = self.run_id or uuid.uuid4().hex[:12]
+        self.last_run_id = run_id
+        store = self.store.run(run_id)
         cols = self.feature_cols + self.label_cols
-        assigned, target = _stage_dataframe(df, cols, self.store,
-                                            self.num_proc)
+        assigned, target, val_assigned, val_target = _stage_dataframe(
+            df, cols, store, self.num_proc, validation=self.validation)
 
         n_feat = len(self.feature_cols)
         payload = cloudpickle.dumps(
             (self.init_fn, self.apply_fn, self.loss, self.optimizer))
-        store, epochs, bs = self.store, self.epochs, self.batch_size
-        seed = self.seed
+        epochs, bs = self.epochs, self.batch_size
+        seed, resume = self.seed, self.resume
 
         def train_fn():
             import jax
             import jax.numpy as jnp
+            import numpy as np
             import optax
 
             import horovod_tpu.jax as hvd
@@ -330,52 +474,100 @@ class JaxEstimator:
             if optimizer is None:
                 optimizer = optax.adam(1e-2)
 
+            start_epoch, history, ck = 0, [], None
             params = init_fn(jax.random.PRNGKey(seed))
+            if resume and store.exists(CKPT_KEY):
+                # Uniform load on every rank (shared store by
+                # contract); see the torch estimator for rationale.
+                ck = store.read_array(CKPT_KEY)
+                params = jax.tree.map(jnp.asarray, ck["state"])
+                start_epoch, history = ck["epoch"], ck["history"]
             params = hvd.broadcast_parameters(params)
             opt = hvd.distributed_optimizer(optimizer)
             opt_state = opt.init(params)
+            if ck is not None and "opt_state" in ck:
+                # Restore moments/step counts into the freshly-built
+                # state's structure (counts stage as numpy arrays).
+                opt_state = jax.tree.unflatten(
+                    jax.tree.structure(opt_state),
+                    [jnp.asarray(x) for x in
+                     jax.tree.leaves(ck["opt_state"])])
 
             # Local step is jitted; the cross-rank reduction runs in
             # the eager grouped-allreduce tier between steps (one
             # process per rank, the Horovod model).
             grad_fn = jax.jit(jax.value_and_grad(
                 lambda p, xb, yb: loss_fn(apply_fn(p, xb), yb)))
+            eval_fn = jax.jit(
+                lambda p, xb, yb: loss_fn(apply_fn(p, xb), yb))
 
-            for _ in range(epochs):
+            def mean_across_ranks(total, n, name):
+                return _mean_across_ranks(hvd, total, n, name)
+
+            for epoch in range(start_epoch, epochs):
+                # Accumulate the loss as a device scalar: a float()
+                # per batch would sync host<->device every step.
+                tot, nb = jnp.zeros(()), 0
                 for rows in _iter_rank_batches(store,
                                                assigned[hvd.rank()],
                                                target, bs):
                     xb = jnp.asarray(rows[:, :n_feat])
                     yb = jnp.asarray(rows[:, n_feat:])
-                    _, grads = grad_fn(params, xb, yb)
+                    loss, grads = grad_fn(params, xb, yb)
                     updates, opt_state = opt.update(grads, opt_state,
                                                     params)
                     params = optax.apply_updates(params, updates)
+                    tot, nb = tot + loss, nb + 1
+                metrics = {"epoch": epoch + 1,
+                           "train_loss": mean_across_ranks(
+                               float(tot), nb, f"metric.train.{epoch}")}
+                if val_assigned is not None:
+                    vtot, vnb = jnp.zeros(()), 0
+                    for rows in _iter_rank_batches(
+                            store, val_assigned[hvd.rank()],
+                            val_target, bs, prefix="val"):
+                        vtot = vtot + eval_fn(
+                            params, jnp.asarray(rows[:, :n_feat]),
+                            jnp.asarray(rows[:, n_feat:]))
+                        vnb += 1
+                    metrics["val_loss"] = mean_across_ranks(
+                        float(vtot), vnb, f"metric.val.{epoch}")
+                history.append(metrics)
+                if hvd.rank() == 0:
+                    store.write_array(CKPT_KEY, {
+                        "epoch": epoch + 1,
+                        "state": jax.tree.map(np.asarray, params),
+                        "opt_state": jax.tree.map(np.asarray, opt_state),
+                        "history": history})
 
             state = None
             if hvd.rank() == 0:
-                import numpy as np
                 state = jax.tree.map(np.asarray, params)
                 with store.open(store.model_key(), "wb") as f:
                     pickle.dump(state, f)
             hvd.shutdown()
-            return state
+            return state, history
 
         results = spark_run(train_fn, num_proc=self.num_proc)
-        params = next(r for r in results if r is not None)
+        params, history = next(r for r in results if r[0] is not None)
         return JaxModel(apply_fn=self.apply_fn, params=params,
                         feature_cols=self.feature_cols,
-                        label_cols=self.label_cols)
+                        label_cols=self.label_cols, history=history,
+                        run_id=run_id)
 
 
 class JaxModel:
-    """Transformer returned by :meth:`JaxEstimator.fit`."""
+    """Transformer returned by :meth:`JaxEstimator.fit`. ``history``
+    carries the per-epoch train/validation metrics."""
 
-    def __init__(self, *, apply_fn, params, feature_cols, label_cols):
+    def __init__(self, *, apply_fn, params, feature_cols, label_cols,
+                 history=None, run_id=None):
         self.apply_fn = apply_fn
         self.params = params
         self.feature_cols = feature_cols
         self.label_cols = label_cols
+        self.history = history or []
+        self.run_id = run_id
 
     def predict(self, features):
         import jax.numpy as jnp

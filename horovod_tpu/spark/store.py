@@ -59,6 +59,13 @@ class Store:
     def exists(self, key: str) -> bool:
         return os.path.exists(self.path(key))
 
+    def run(self, run_id: str) -> "Store":
+        """A store rooted at this store's per-run namespace
+        (``runs/{run_id}``) — the reference's ``get_run_path``
+        (``spark/common/store.py``): concurrent fits sharing one store
+        prefix must never read each other's shards."""
+        return Store(os.path.join(self.prefix_path, "runs", run_id))
+
     # -- staging helpers (shared by all drivers) ---------------------------
 
     def write_array(self, key: str, arr: Any) -> None:
@@ -139,6 +146,9 @@ class FsspecStore(Store):
             "FsspecStore has no local filesystem path; use "
             "store.open(store.model_key()) instead")
 
+    def run(self, run_id: str) -> "FsspecStore":
+        return FsspecStore(f"{self.url}/runs/{run_id}")
+
 
 def assign_partitions(counts, num_proc: int):
     """Partition->rank assignment for training: partitions go to ranks
@@ -160,4 +170,15 @@ def assign_partitions(counts, num_proc: int):
         if not assigned[r]:
             assigned[r] = [donor]
     target = max(sum(counts[p] for p in a) for a in assigned)
+    # Wrap-padding keeps ranks lockstep, but with skewed partitions it
+    # silently re-trains rows — say so instead of letting the user
+    # believe every rank ran one clean epoch.
+    worst = min(sum(counts[p] for p in a) for a in assigned)
+    if worst and target / worst > 1.5:
+        import logging
+        logging.getLogger("horovod_tpu").warning(
+            "spark: skewed partition sizes — the smallest rank share is "
+            "%d rows, padded by wrapping to %d (%.1fx); those rows "
+            "repeat within the epoch. Repartition the DataFrame evenly "
+            "to avoid it", worst, target, target / worst)
     return assigned, target
