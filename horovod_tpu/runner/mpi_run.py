@@ -133,6 +133,7 @@ def build_passthrough_env(settings, server, all_local: bool
     timeouts, controller-host policy, timeline suffixing) is applied.
     One function so the transports cannot drift."""
     import os
+    import socket
 
     env = dict(os.environ)
     # topology.py prefers HOROVOD_RANK over OMPI_COMM_WORLD_RANK, so a
@@ -144,8 +145,7 @@ def build_passthrough_env(settings, server, all_local: bool
               "HOROVOD_ELASTIC_EPOCH", "HOROVOD_CONTROLLER_ADDR"):
         env.pop(k, None)
     env.update(settings.env or {})
-    launcher_host = "127.0.0.1" if all_local else __import__(
-        "socket").getfqdn()
+    launcher_host = "127.0.0.1" if all_local else socket.getfqdn()
     env.update({
         "HOROVOD_RENDEZVOUS_ADDR": f"{launcher_host}:{server.port}",
         "HOROVOD_RENDEZVOUS_TOKEN": server.token,

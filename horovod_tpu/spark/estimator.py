@@ -20,6 +20,7 @@ where a columnar format would slot in.
 
 from __future__ import annotations
 
+import math
 import pickle
 import uuid
 from typing import Callable, List, Optional
@@ -76,7 +77,10 @@ def _stage_dataframe(df, cols: List[str], store: Store, num_proc: int,
     if validation and not 0.0 < validation < 0.5:
         raise ValueError(f"validation={validation} must be in (0, 0.5) "
                          "(the larger side is the training set)")
-    every = int(round(1.0 / validation)) if validation else 0
+    # ceil, not round: the realized holdout 1/every never EXCEEDS the
+    # requested fraction (round(1/0.4) == 2 would deliver the 50/50
+    # split the bound above promises to exclude).
+    every = int(math.ceil(1.0 / validation)) if validation else 0
 
     def stage(pid, rows_iter):
         import numpy as np
@@ -287,15 +291,18 @@ class TorchEstimator:
                                        for k, v in ck["state"].items()})
                 start_epoch, history = ck["epoch"], ck["history"]
             opt = opt_factory(model.parameters())
-            if ck is not None and "opt_state" in ck:
-                # Optimizer moments/step counts resume too — without
-                # them the first post-resume epochs re-warm Adam-class
-                # optimizers and loss spikes.
-                opt.load_state_dict(ck["opt_state"])
             extra = ({"compression": compression}
                      if compression is not None else {})
             opt = hvd.DistributedOptimizer(
                 opt, named_parameters=model.named_parameters(), **extra)
+            if ck is not None and "opt_state" in ck:
+                # Optimizer moments/step counts resume too — without
+                # them the first post-resume epochs re-warm Adam-class
+                # optimizers and loss spikes. Load AFTER the wrap: the
+                # DistributedOptimizer factory rebuilds from
+                # param_groups only, so state loaded into the raw
+                # optimizer would be discarded.
+                opt.load_state_dict(ck["opt_state"])
             hvd.broadcast_parameters(model.state_dict(), root_rank=0)
 
             def mean_across_ranks(total, n, name):
@@ -318,6 +325,10 @@ class TorchEstimator:
                                tot, nb, f"metric.train.{epoch}")}
                 if val_assigned is not None:
                     vtot, vnb = 0.0, 0
+                    # eval mode: train mode would update BatchNorm
+                    # running stats from the holdout (leak) and leave
+                    # Dropout active (noisy val loss).
+                    model.eval()
                     with torch.no_grad():
                         for rows in _iter_rank_batches(
                                 store, val_assigned[hvd.rank()],
@@ -326,6 +337,7 @@ class TorchEstimator:
                             yb = torch.as_tensor(rows[:, n_feat:])
                             vtot += float(loss_fn(model(xb), yb))
                             vnb += 1
+                    model.train()
                     metrics["val_loss"] = mean_across_ranks(
                         vtot, vnb, f"metric.val.{epoch}")
                 history.append(metrics)

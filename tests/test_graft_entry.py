@@ -18,3 +18,24 @@ def test_dryrun_multichip_8(devices):
     sys.path.insert(0, "/root/repo")
     import __graft_entry__ as g
     g.dryrun_multichip(8)
+
+
+def test_dryrun_wide_axes_via_driver_path():
+    """The driver's exact invocation (fresh interpreter, no jax state):
+    the child self-provisions 16 virtual devices and must run the
+    wide-axis configs — tp=4 and sp=4 — on top of the base five (axis
+    size >= 4 catches ring-order/GQA-split bugs that all-2s meshes
+    cannot). ~2-3 min of CPU compiles; this is the multichip gate."""
+    import os
+    import subprocess
+
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env.update({"JAX_PLATFORMS": "", "PALLAS_AXON_POOL_IPS": ""})
+    proc = subprocess.run(
+        [sys.executable, "/root/repo/__graft_entry__.py", "8"],
+        env=env, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    for tag in ("dense dp/fsdp/sp/tp", "pp", "ep/moe", "pp+ep/moe",
+                "pp-1f1b", "tp4", "sp4"):
+        assert f"dryrun[{tag}]" in proc.stdout, (tag, proc.stdout)
+    assert "'tp': 4" in proc.stdout and "'sp': 4" in proc.stdout

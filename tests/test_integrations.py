@@ -475,6 +475,12 @@ def test_estimator_resume_from_checkpoint(fake_pyspark, tmp_path):
         import horovod_tpu as hvd
         hvd.init()
     assert len(model_a.history) == 10
+    # The checkpoint carries REAL optimizer state (Adam moments), not
+    # just weights — resume loads it into the wrapped optimizer.
+    from horovod_tpu.spark.estimator import CKPT_KEY
+    ck = store.run("runA").read_array(CKPT_KEY)
+    assert ck["opt_state"]["state"], "optimizer state missing"
+    assert any("exp_avg" in s for s in ck["opt_state"]["state"].values())
     # Resumed fit: 10 inherited epochs + 20 new ones, numbered
     # continuously, and the prefix is the first fit's history verbatim.
     assert len(model_b.history) == 30
