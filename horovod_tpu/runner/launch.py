@@ -115,6 +115,16 @@ def _slot_env(slot: hosts_mod.SlotInfo, base: Dict[str, str],
     return env
 
 
+def _ssh_base(ssh_port: Optional[int]) -> List[str]:
+    """The ssh option contract shared by worker spawns and the
+    preflight probe — one copy, so the probe can never pass options
+    the real spawn doesn't (or vice versa)."""
+    cmd = ["ssh", "-o", "StrictHostKeyChecking=no", "-o", "BatchMode=yes"]
+    if ssh_port:
+        cmd += ["-p", str(ssh_port)]
+    return cmd
+
+
 def _ssh_command(slot: hosts_mod.SlotInfo, command: Sequence[str],
                  env: Dict[str, str], ssh_port: Optional[int],
                  forward_keys: frozenset = frozenset()) -> List[str]:
@@ -127,11 +137,88 @@ def _ssh_command(slot: hosts_mod.SlotInfo, command: Sequence[str],
         or k in FORWARD_ENV_KEYS)
     remote = (f"cd {shlex.quote(os.getcwd())} && "
               f"env {exports} {' '.join(shlex.quote(c) for c in command)}")
-    cmd = ["ssh", "-o", "StrictHostKeyChecking=no", "-o", "BatchMode=yes"]
-    if ssh_port:
-        cmd += ["-p", str(ssh_port)]
-    cmd += [slot.hostname, remote]
-    return cmd
+    return _ssh_base(ssh_port) + [slot.hostname, remote]
+
+
+#: successful ssh probes are cached this long (reference
+#: CACHE_STALENESS_THRESHOLD_MINUTES = 60, ``runner/launch.py:49``).
+SSH_CHECK_STALENESS_SECS = 3600.0
+
+
+def preflight_ssh(hostnames, ssh_port: Optional[int] = None,
+                  timeout: float = 15.0,
+                  cache_file: Optional[str] = None) -> None:
+    """Batched ssh reachability check before any worker spawns
+    (reference ``_check_all_hosts_ssh_successful`` +
+    ``runner/util/cache.py``): every remote host is probed concurrently
+    with ``ssh host true``, and failures aggregate into ONE diagnostic
+    — a typo in a 32-host spec used to surface as 32 interleaved
+    per-slot spawn errors. Successful probes are cached (~1 h, keyed
+    by host:port) so back-to-back launches skip the round-trips."""
+    import json
+    import subprocess
+    import time
+    from concurrent.futures import ThreadPoolExecutor
+
+    hosts = sorted(set(hostnames))
+    if not hosts:
+        return
+    cache_file = cache_file or os.path.join(
+        os.path.expanduser("~"), ".cache", "horovod_tpu",
+        "ssh_check.json")
+    cache: Dict[str, float] = {}
+    try:
+        with open(cache_file) as f:
+            cache = {k: float(v) for k, v in json.load(f).items()}
+    except (OSError, ValueError, TypeError, AttributeError):
+        pass  # best-effort: any unreadable/foreign format means empty
+    now = time.time()
+
+    def key(h):
+        return f"{h}:{ssh_port or 22}"
+
+    pending = [h for h in hosts
+               if now - cache.get(key(h), 0.0) > SSH_CHECK_STALENESS_SECS]
+    if not pending:
+        return
+
+    def probe(h):
+        cmd = _ssh_base(ssh_port) + [
+            "-o", f"ConnectTimeout={max(1, int(timeout))}", h, "true"]
+        try:
+            res = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=timeout + 5)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            return h, str(e)
+        if res.returncode != 0:
+            tail = (res.stderr or res.stdout).strip().splitlines()
+            return h, (tail[-1] if tail
+                       else f"ssh exited with {res.returncode}")
+        return h, None
+
+    with ThreadPoolExecutor(max_workers=min(len(pending), 16)) as pool:
+        results = list(pool.map(probe, pending))
+    # Cache the hosts that DID answer even when others failed: after
+    # the user fixes the one typo in a 32-host spec, the relaunch
+    # re-probes only the fixed host.
+    for h, err in results:
+        if err is None:
+            cache[key(h)] = now
+    try:
+        os.makedirs(os.path.dirname(cache_file), exist_ok=True)
+        with open(cache_file, "w") as f:
+            json.dump(cache, f)
+    except OSError:
+        pass  # cache is best-effort; the probes themselves decided
+    failures = [(h, err) for h, err in results if err is not None]
+    if failures:
+        detail = "\n".join(f"  {h}: {err}" for h, err in failures)
+        raise RuntimeError(
+            f"ssh preflight failed for {len(failures)} of {len(hosts)} "
+            f"remote host(s) — no workers were started:\n{detail}\n"
+            "Fix passwordless ssh (BatchMode) to these hosts, or check "
+            "-H/--hostfile for typos. HOROVOD_SSH_PREFLIGHT=0 skips "
+            "the check.")
 
 
 def _spawn_worker(slot: hosts_mod.SlotInfo, env: Dict[str, str],
@@ -176,7 +263,12 @@ def launch_static(settings: LaunchSettings,
     host_list = _resolve_hosts(settings)
     slots = hosts_mod.get_host_assignments(host_list, settings.np)
 
-    all_local = all(is_local_host(s.hostname) for s in slots)
+    remote = {s.hostname for s in slots if not is_local_host(s.hostname)}
+    all_local = not remote
+    if remote and os.environ.get("HOROVOD_SSH_PREFLIGHT") != "0":
+        # One aggregated diagnostic beats np interleaved spawn errors.
+        preflight_ssh(remote, settings.ssh_port,
+                      timeout=min(15.0, settings.start_timeout))
     with kv_scope(all_local, kv_server) as server:
         launcher_host = "127.0.0.1" if all_local else socket.getfqdn()
         kv_addr = f"{launcher_host}:{server.port}"

@@ -471,6 +471,88 @@ def test_horovodrun_mpi_missing_mpirun(capfd, monkeypatch, tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# ssh preflight (reference runner/launch.py:575-595 + util/cache.py)
+# ---------------------------------------------------------------------------
+
+_STUB_SSH = """#!{python}
+import sys
+host = next(a for a in sys.argv[1:]
+            if not a.startswith("-") and a != "true"
+            and not a.startswith("StrictHostKeyChecking")
+            and not a.startswith("BatchMode")
+            and not a.startswith("ConnectTimeout"))
+# O_APPEND: concurrent probe processes must not clobber each other.
+with open({log!r}, "a") as f:
+    f.write(host + chr(10))
+if host.startswith("bad"):
+    print("ssh: Could not resolve hostname " + host, file=sys.stderr)
+    sys.exit(255)
+sys.exit(0)
+"""
+
+
+@pytest.fixture()
+def stub_ssh(tmp_path, monkeypatch):
+    """A fake ssh on PATH that logs probed hosts and fails for any
+    hostname starting with 'bad'."""
+    log = tmp_path / "ssh.log"
+    path = tmp_path / "ssh"
+    path.write_text(_STUB_SSH.format(python=sys.executable, log=str(log)))
+    path.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{tmp_path}{os.pathsep}{os.environ['PATH']}")
+    return log
+
+
+def test_preflight_ssh_aggregates_failures(stub_ssh, tmp_path):
+    """One bad host in a 4-host spec -> ONE diagnostic naming exactly
+    the unreachable host, before anything spawns."""
+    from horovod_tpu.runner.launch import preflight_ssh
+
+    cache = str(tmp_path / "cache.json")
+    with pytest.raises(RuntimeError) as ei:
+        preflight_ssh(["h1", "h2", "badhost", "h3"], cache_file=cache)
+    msg = str(ei.value)
+    assert "1 of 4" in msg and "badhost" in msg
+    assert "Could not resolve hostname" in msg
+    assert "no workers were started" in msg
+    # All four hosts were probed concurrently in the one batch.
+    assert sorted(stub_ssh.read_text().split()) == ["badhost", "h1",
+                                                    "h2", "h3"]
+
+
+def test_preflight_ssh_caches_successes(stub_ssh, tmp_path):
+    from horovod_tpu.runner.launch import preflight_ssh
+
+    cache = str(tmp_path / "cache.json")
+    preflight_ssh(["h1", "h2"], cache_file=cache)
+    assert sorted(stub_ssh.read_text().split()) == ["h1", "h2"]
+    # Second launch: both hosts cached -> zero new probes.
+    preflight_ssh(["h1", "h2"], cache_file=cache)
+    assert sorted(stub_ssh.read_text().split()) == ["h1", "h2"]
+    # A new host probes alone; cached ones stay skipped.
+    preflight_ssh(["h1", "h3"], cache_file=cache)
+    assert sorted(stub_ssh.read_text().split()) == ["h1", "h2", "h3"]
+
+
+def test_launch_static_preflights_before_spawn(stub_ssh, tmp_path,
+                                               monkeypatch):
+    """launch_static with an unreachable remote host fails with the
+    aggregated preflight error and never spawns a worker."""
+    from horovod_tpu.runner.launch import LaunchSettings, launch_static
+
+    monkeypatch.setenv("HOME", str(tmp_path))  # isolate the real cache
+    settings = LaunchSettings(
+        np=4, command=[sys.executable, "-c", "raise SystemExit(7)"],
+        hosts="badhost1:2,badhost2:2", start_timeout=10)
+    with pytest.raises(RuntimeError, match="2 of 2"):
+        launch_static(settings)
+    # Only the probes ran — the SystemExit(7) command never did (the
+    # stub logs every ssh invocation; two probe lines, no exec lines).
+    assert sorted(stub_ssh.read_text().split()) == ["badhost1",
+                                                    "badhost2"]
+
+
+# ---------------------------------------------------------------------------
 # jsrun passthrough (reference runner/js_run.py tier)
 # ---------------------------------------------------------------------------
 
