@@ -207,7 +207,11 @@ def preflight_ssh(hostnames, ssh_port: Optional[int] = None,
     try:
         os.makedirs(os.path.dirname(cache_file), exist_ok=True)
         with open(cache_file, "w") as f:
-            json.dump(cache, f)
+            # Prune expired entries on write: churning hostnames
+            # (ephemeral cloud instances) would otherwise grow the
+            # file without bound.
+            json.dump({k: t for k, t in cache.items()
+                       if now - t <= SSH_CHECK_STALENESS_SECS}, f)
     except OSError:
         pass  # cache is best-effort; the probes themselves decided
     failures = [(h, err) for h, err in results if err is not None]

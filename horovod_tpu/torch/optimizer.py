@@ -203,9 +203,11 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                             and gid in self._group_launched))
             if launched and self._allreduce_delay[p] <= 0:
                 raise AssertionError(
-                    "Gradients were computed more than "
-                    "backward_passes_per_step times before call to "
-                    "step(). Increase backward_passes_per_step.")
+                    "a parameter accumulated gradients past its "
+                    "backward_passes_per_step budget without an "
+                    "intervening step(); raise backward_passes_per_step "
+                    "or call step()/synchronize() between the extra "
+                    "backward passes")
             assert not p.grad.requires_grad
             assert self._allreduce_delay[p] > 0
             self._allreduce_delay[p] -= 1
@@ -347,9 +349,10 @@ class _DistributedOptimizer(torch.optim.Optimizer):
     def zero_grad(self, *args, **kwargs):
         if self._handles:
             raise AssertionError(
-                "optimizer.zero_grad() was called after loss.backward() "
-                "but before optimizer.step() or optimizer.synchronize(). "
-                "This is prohibited as it can cause a race condition.")
+                "zero_grad() would clear gradients that still have "
+                "in-flight allreduces (backward ran, but neither step() "
+                "nor synchronize() has drained them) — the async "
+                "reductions would race the zeroing; drain first")
         return super(self.__class__, self).zero_grad(*args, **kwargs)
 
 
