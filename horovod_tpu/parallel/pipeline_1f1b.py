@@ -3,11 +3,24 @@
 GPipe (:mod:`horovod_tpu.parallel.pipeline`) runs all forwards then
 lets reverse-mode AD replay the schedule backwards — simple, but every
 stage holds activations for ALL ``M`` in-flight microbatches. The 1F1B
-schedule (PipeDream-Flush; what Megatron-LM runs) starts each
-microbatch's backward as soon as the last stage finishes its forward,
-bounding the in-flight residuals per stage to ``O(S)`` regardless of
-``M`` — the memory headroom that lets deep pipelines raise ``n_micro``
-to amortize the bubble.
+schedule (PipeDream-Flush ordering) starts each microbatch's backward
+as soon as the last stage finishes its forward, bounding the in-flight
+residuals per stage to ``O(S)`` regardless of ``M`` — the memory
+headroom that lets deep pipelines raise ``n_micro`` to amortize the
+bubble.
+
+Cost model — stated, not implied (see docs/parallelism.md for the
+measurements): each backward unit RECOMPUTES its stage forward from
+the stored stage input (``jax.value_and_grad`` per tick), and both
+units run on every one of the ``M + 2S - 1`` ticks including the
+masked fill/drain ones, so the analytic per-device cost is
+``4(M + 2S - 1)`` stage-forward units vs the no-bubble ideal's
+``3M`` (an idealized non-recomputing 1F1B à la Megatron-LM would be
+``3M`` plus bubble). Measured on a real chip the trade lands well:
+at pp=1 the island runs ~1.26x FASTER than the flat step (XLA drops
+part of the masked work; the recompute matches what the default remat
+policy pays anyway) — but the recompute factor is real and this
+module chooses it deliberately for the O(S) activation bound.
 
 Reverse-mode AD cannot express interleaved forward/backward, so this
 module computes the backward EXPLICITLY inside the schedule
@@ -40,9 +53,10 @@ bwd unit per tick):
 * residual lifetime at stage ``s``: ``2(S - s) - 1 < 2S`` ticks — a
   ``2S``-slot ring buffer per stage holds the stage inputs.
 
-Total ticks: ``M + 2S - 1``. Same compute as GPipe + its AD replay;
-the difference is WHEN backward runs, hence the ``O(S)`` activation
-bound.
+Total ticks: ``M + 2S - 1`` (vs GPipe's ``M + S - 1`` forward ticks +
+AD replay); the recompute and the extra masked ticks are the price of
+the ``O(S)`` activation bound — see the module docstring's cost model
+and docs/parallelism.md for measured numbers.
 """
 
 from __future__ import annotations
