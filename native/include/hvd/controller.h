@@ -166,11 +166,23 @@ class Controller {
   // Autotune (rank 0): stage new tunables for the next broadcast
   // ResponseList so every rank applies them on the same cycle.
   void StageTunedParams(int64_t fusion, double cycle_ms,
-                        int hierarchical = -1) {
+                        int hierarchical = -1, int cache = -1,
+                        int shm = -1) {
     staged_fusion_ = fusion;
     staged_cycle_ms_ = cycle_ms;
     staged_hier_ = hierarchical;
+    staged_cache_ = cache;
+    staged_shm_ = shm;
   }
+  // Autotuned runtime switches consulted by the data plane / cache
+  // path each cycle (distinct from the INIT verdicts shm_enabled()
+  // and the cache's capacity): flipping them is cycle-safe because
+  // rank 0 applies at the end of the cycle it tuned and every worker
+  // applies from the broadcast list before using either path.
+  void SetCacheActive(bool on) { cache_active_ = on; }
+  bool cache_active() const { return cache_active_; }
+  void SetShmActive(bool on) { shm_active_ = on; }
+  bool shm_active() const { return shm_active_; }
   // Init-time agreed layout fitness (synced to every rank): whether
   // the hierarchical decomposition COULD run — the autotuner may then
   // flip hierarchical() per cycle within that envelope, and the
@@ -181,6 +193,10 @@ class Controller {
   int64_t staged_fusion_ = 0;
   double staged_cycle_ms_ = 0.0;
   int staged_hier_ = -1;
+  int staged_cache_ = -1;
+  int staged_shm_ = -1;
+  bool cache_active_ = true;
+  bool shm_active_ = true;
 };
 
 class LocalController : public Controller {

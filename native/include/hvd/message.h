@@ -121,6 +121,8 @@ struct ResponseList {
   int64_t tuned_fusion_threshold = 0;
   double tuned_cycle_time_ms = 0.0;
   int8_t tuned_hierarchical = -1;  // -1 = no change, 0/1 = new value
+  int8_t tuned_cache = -1;         // response-cache enablement flip
+  int8_t tuned_shm = -1;           // single-host shm data-plane flip
 
   void SerializeTo(std::string* out) const;
   static bool ParseFrom(const std::string& buf, ResponseList* out);

@@ -44,10 +44,25 @@ class ParameterManager {
   void SetEnabled(bool on) { enabled_ = on; }
   bool enabled() const { return enabled_ && !converged_; }
   void SetLogPath(const std::string& path);
-  // Offer the hierarchical-allreduce switch as a tunable categorical
-  // (bayes mode only; call after the init-time fitness handshake —
-  // `fit` is the agreed layout fitness, `current` the starting value).
-  void SetHierarchicalTunable(bool fit, bool current);
+
+  // Binary categorical tunables (bayes mode only; the reference tunes
+  // the same set, parameter_manager.h:80-108): hierarchical
+  // allreduce, response-cache enablement, and the single-host shm
+  // data plane. Offer each with SetCategoricalTunable AFTER the
+  // init-time handshakes (`available` = the job can actually flip it;
+  // `current` = the starting value).
+  enum Categorical { kCatHier = 0, kCatCache = 1, kCatShm = 2,
+                     kNumCategoricals = 3 };
+  void SetCategoricalTunable(Categorical cat, bool available,
+                             bool current);
+  bool categorical_tunable(Categorical cat) const {
+    return cat_tunable_[cat];
+  }
+  bool categorical(Categorical cat) const { return cat_[cat] > 0; }
+  // Back-compat wrappers for the hierarchical categorical.
+  void SetHierarchicalTunable(bool fit, bool current) {
+    SetCategoricalTunable(kCatHier, fit, current);
+  }
 
   // Record traffic finished this cycle (coordinator side).
   void Record(int64_t bytes);
@@ -58,8 +73,10 @@ class ParameterManager {
 
   int64_t fusion_threshold() const { return fusion_; }
   double cycle_time_ms() const { return cycle_ms_; }
-  bool hierarchical() const { return hierarchical_ > 0; }
-  bool hierarchical_tunable() const { return hier_tunable_; }
+  bool hierarchical() const { return categorical(kCatHier); }
+  bool hierarchical_tunable() const {
+    return categorical_tunable(kCatHier);
+  }
   bool converged() const { return converged_; }
   double best_score() const { return best_score_; }
 
@@ -77,8 +94,8 @@ class ParameterManager {
 
   int64_t fusion_ = 64 * 1024 * 1024;
   double cycle_ms_ = 1.0;
-  int hierarchical_ = 0;      // current value (bayes categorical)
-  bool hier_tunable_ = false;
+  int cat_[kNumCategoricals] = {0, 0, 0};   // current values
+  bool cat_tunable_[kNumCategoricals] = {false, false, false};
 
   // Measurement window.
   double window_secs_ = 1.0;
@@ -98,7 +115,7 @@ class ParameterManager {
   double best_score_ = 0.0;
   int64_t best_fusion_ = 0;
   double best_cycle_ms_ = 0.0;
-  int best_hier_ = 0;
+  int best_cat_[kNumCategoricals] = {0, 0, 0};
 
   std::ofstream log_;
 };

@@ -381,7 +381,11 @@ ResponseList Controller::CoordinatorStep(
 }
 
 void Controller::UpdateCacheFromResponses(const ResponseList& list) {
-  if (!deps_.response_cache || !deps_.tensor_queue) return;
+  // cache_active_ gates INSERTS too: every rank flips on the same
+  // cycle (workers apply the broadcast flag before this runs), so the
+  // XOR signatures stay lockstep while the flag is off.
+  if (!deps_.response_cache || !deps_.tensor_queue || !cache_active_)
+    return;
   for (const auto& resp : list.responses) {
     if (resp.response_type == ResponseType::ERROR ||
         resp.response_type == ResponseType::JOIN ||
@@ -711,7 +715,7 @@ RequestList TcpController::BuildRequestList(bool shutdown, bool* saw_join) {
       continue;  // conveyed via the joined flag
     }
     uint32_t bit = 0;
-    if (deps_.response_cache) {
+    if (deps_.response_cache && cache_active_) {
       auto state = deps_.response_cache->Lookup(req, &bit);
       if (state == ResponseCache::CacheState::HIT) {
         list.cache_hits.push_back(bit);
@@ -861,6 +865,14 @@ ResponseList TcpController::WorkerCycle(RequestList my_list) {
     out.shutdown = true;
     return out;
   }
+  // Apply autotuned runtime switches FIRST: rank 0 already runs this
+  // cycle with the new values (it flipped at the end of the cycle it
+  // tuned), so this cycle's cache inserts below and the data-plane
+  // algorithm choice during execution must use them too — a mixed
+  // cycle would desync the cache signatures (cache) or deadlock the
+  // arena barrier against TCP (shm).
+  if (out.tuned_cache >= 0) cache_active_ = out.tuned_cache != 0;
+  if (out.tuned_shm >= 0) shm_active_ = out.tuned_shm != 0;
   if (out.purge_cache) {
     if (deps_.response_cache) deps_.response_cache->Clear();
     // Re-announce everything unresolved as full requests next cycle.
@@ -886,9 +898,13 @@ void TcpController::Broadcast(ResponseList& list) {
     list.tuned_fusion_threshold = staged_fusion_;
     list.tuned_cycle_time_ms = staged_cycle_ms_;
     list.tuned_hierarchical = static_cast<int8_t>(staged_hier_);
+    list.tuned_cache = static_cast<int8_t>(staged_cache_);
+    list.tuned_shm = static_cast<int8_t>(staged_shm_);
     staged_fusion_ = 0;
     staged_cycle_ms_ = 0.0;
     staged_hier_ = -1;
+    staged_cache_ = -1;
+    staged_shm_ = -1;
   }
   std::string buf;
   list.SerializeTo(&buf);

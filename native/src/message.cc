@@ -212,6 +212,8 @@ void ResponseList::SerializeTo(std::string* out) const {
   WriteScalar<int64_t>(out, tuned_fusion_threshold);
   WriteScalar<double>(out, tuned_cycle_time_ms);
   WriteScalar<int8_t>(out, tuned_hierarchical);
+  WriteScalar<int8_t>(out, tuned_cache);
+  WriteScalar<int8_t>(out, tuned_shm);
   WriteScalar<uint32_t>(out, static_cast<uint32_t>(responses.size()));
   for (const auto& r : responses) r.SerializeTo(out);
 }
@@ -228,6 +230,8 @@ bool ResponseList::ParseFrom(const std::string& buf, ResponseList* out) {
   if (!ReadScalar(&p, end, &out->tuned_fusion_threshold)) return false;
   if (!ReadScalar(&p, end, &out->tuned_cycle_time_ms)) return false;
   if (!ReadScalar(&p, end, &out->tuned_hierarchical)) return false;
+  if (!ReadScalar(&p, end, &out->tuned_cache)) return false;
+  if (!ReadScalar(&p, end, &out->tuned_shm)) return false;
   uint32_t n;
   if (!ReadScalar(&p, end, &n)) return false;
   out->responses.resize(n);

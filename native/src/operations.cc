@@ -323,17 +323,27 @@ void BackgroundThreadLoop(GlobalState& st) {
                        std::chrono::steady_clock::now() - loop_epoch)
                        .count();
       if (st.param_manager.Update(now)) {
+        using PM = hvd::ParameterManager;
+        auto cat = [&](PM::Categorical c) {
+          return st.param_manager.categorical_tunable(c)
+                     ? (st.param_manager.categorical(c) ? 1 : 0)
+                     : -1;
+        };
         st.controller->SetFusionThreshold(st.param_manager.fusion_threshold());
         st.cycle_time_ms = st.param_manager.cycle_time_ms();
         st.controller->SetHierarchical(st.param_manager.hierarchical_tunable()
                                            ? st.param_manager.hierarchical()
                                            : st.controller->hierarchical());
+        if (st.param_manager.categorical_tunable(PM::kCatCache))
+          st.controller->SetCacheActive(
+              st.param_manager.categorical(PM::kCatCache));
+        if (st.param_manager.categorical_tunable(PM::kCatShm))
+          st.controller->SetShmActive(
+              st.param_manager.categorical(PM::kCatShm));
         st.controller->StageTunedParams(
             st.param_manager.fusion_threshold(),
-            st.param_manager.cycle_time_ms(),
-            st.param_manager.hierarchical_tunable()
-                ? (st.param_manager.hierarchical() ? 1 : 0)
-                : -1);
+            st.param_manager.cycle_time_ms(), cat(PM::kCatHier),
+            cat(PM::kCatCache), cat(PM::kCatShm));
       }
     }
     auto elapsed = std::chrono::steady_clock::now() - cycle_start;
@@ -461,10 +471,26 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
                    "must be set job-wide (rank 0 / --no-shm) to take "
                    "effect";
   }
-  if (s.ok() && rank == 0)
+  if (s.ok() && rank == 0) {
+    using PM = hvd::ParameterManager;
     st.param_manager.SetHierarchicalTunable(
         st.controller->hierarchical_fit() && size > 1,
         st.controller->hierarchical());
+    // Cache enablement and the shm data plane join the categorical
+    // set (reference tunes the same switches,
+    // parameter_manager.h:80-108). The flips ride the broadcast
+    // ResponseList cycle-safely. Seed each with its EFFECTIVE state
+    // (not the raw active flag, which defaults true even when the
+    // feature is absent) so the CSV log reports the truth on jobs
+    // where a switch is unavailable.
+    st.param_manager.SetCategoricalTunable(
+        PM::kCatCache, st.response_cache.capacity() > 0 && size > 1,
+        st.response_cache.capacity() > 0 && size > 1 &&
+            st.controller->cache_active());
+    st.param_manager.SetCategoricalTunable(
+        PM::kCatShm, st.controller->shm_enabled() && size > 1,
+        st.controller->shm_enabled() && st.controller->shm_active());
+  }
   if (!s.ok()) {
     LOG_ERROR << "controller init failed: " << s.reason();
     return -1;

@@ -631,7 +631,10 @@ Status TcpOps::RingAllgatherPhase(uint8_t* buf,
 }
 
 bool TcpOps::ShmEligible(int64_t payload_bytes, Status* err) {
-  if (!shm_ || controller_->size() <= 1 ||
+  // shm_active() is the autotuner's cycle-synced switch: every rank
+  // flips on the same cycle boundary, so all ranks pick the same
+  // plane per response (a split would strand the arena barrier).
+  if (!shm_ || !controller_->shm_active() || controller_->size() <= 1 ||
       payload_bytes > shm_->slot_bytes())
     return false;
   if (shm_->poisoned()) {

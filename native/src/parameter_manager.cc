@@ -45,17 +45,20 @@ void ParameterManager::Initialize(int64_t fusion, double cycle_ms) {
     max_samples_ = std::max(1, std::atoi(n));
 }
 
-void ParameterManager::SetHierarchicalTunable(bool fit, bool current) {
-  hier_tunable_ = fit && bayes_;
-  hierarchical_ = current ? 1 : 0;
-  best_hier_ = hierarchical_;
+void ParameterManager::SetCategoricalTunable(Categorical cat,
+                                             bool available,
+                                             bool current) {
+  cat_tunable_[cat] = available && bayes_;
+  cat_[cat] = current ? 1 : 0;
+  best_cat_[cat] = cat_[cat];
 }
 
 void ParameterManager::SetLogPath(const std::string& path) {
   log_.open(path, std::ios::out | std::ios::trunc);
   if (log_.is_open())
     log_ << "time_secs,fusion_threshold_bytes,cycle_time_ms,"
-            "score_bytes_per_sec\n";
+            "score_bytes_per_sec,hierarchical,cache_enabled,"
+            "shm_enabled\n";
 }
 
 void ParameterManager::Record(int64_t bytes) {
@@ -65,7 +68,8 @@ void ParameterManager::Record(int64_t bytes) {
 void ParameterManager::LogSample(double score) {
   if (log_.is_open()) {
     log_ << window_start_ << "," << fusion_ << "," << cycle_ms_ << ","
-         << static_cast<int64_t>(score) << "\n";
+         << static_cast<int64_t>(score) << "," << cat_[kCatHier] << ","
+         << cat_[kCatCache] << "," << cat_[kCatShm] << "\n";
     log_.flush();
   }
 }
@@ -75,7 +79,8 @@ std::vector<double> ParameterManager::CurrentPoint() const {
       ToUnit(std::log2(static_cast<double>(fusion_)), kLogFusionLo,
              kLogFusionHi),
       ToUnit(std::log2(cycle_ms_), kLogCycleLo, kLogCycleHi)};
-  if (hier_tunable_) x.push_back(hierarchical_ ? 1.0 : 0.0);
+  for (int c = 0; c < kNumCategoricals; ++c)
+    if (cat_tunable_[c]) x.push_back(cat_[c] ? 1.0 : 0.0);
   return x;
 }
 
@@ -85,7 +90,9 @@ void ParameterManager::ApplyPoint(const std::vector<double>& x) {
                                               std::exp2(lf))));
   double lc = kLogCycleLo + x[1] * (kLogCycleHi - kLogCycleLo);
   cycle_ms_ = std::min(kMaxCycleMs, std::max(kMinCycleMs, std::exp2(lc)));
-  if (hier_tunable_ && x.size() > 2) hierarchical_ = x[2] > 0.5 ? 1 : 0;
+  size_t i = 2;
+  for (int c = 0; c < kNumCategoricals; ++c)
+    if (cat_tunable_[c] && i < x.size()) cat_[c] = x[i++] > 0.5 ? 1 : 0;
 }
 
 void ParameterManager::ApplyCandidate() {
@@ -123,38 +130,44 @@ bool ParameterManager::Update(double now_secs) {
 
 bool ParameterManager::UpdateBayes(double score) {
   if (!opt_) {
-    opt_ = std::make_unique<BayesianOptimizer>(2, hier_tunable_ ? 1 : 0);
+    int n_cat = 0;
+    for (bool t : cat_tunable_) n_cat += t ? 1 : 0;
+    opt_ = std::make_unique<BayesianOptimizer>(2, n_cat);
   }
   const int64_t old_fusion = fusion_;
   const double old_cycle = cycle_ms_;
-  const int old_hier = hierarchical_;
+  int old_cat[kNumCategoricals];
+  std::memcpy(old_cat, cat_, sizeof(old_cat));
 
   opt_->AddSample(CurrentPoint(), score);
   if (score > best_score_) {
     best_score_ = score;
     best_fusion_ = fusion_;
     best_cycle_ms_ = cycle_ms_;
-    best_hier_ = hierarchical_;
+    std::memcpy(best_cat_, cat_, sizeof(best_cat_));
   }
   if (opt_->n_samples() >= max_samples_) {
     fusion_ = best_fusion_;
     cycle_ms_ = best_cycle_ms_;
-    hierarchical_ = best_hier_;
+    std::memcpy(cat_, best_cat_, sizeof(best_cat_));
     converged_ = true;
+    static constexpr const char* kCatNames[kNumCategoricals] = {
+        "hierarchical", "cache_enabled", "shm_enabled"};
+    std::string cats;
+    for (int c = 0; c < kNumCategoricals; ++c)
+      if (cat_tunable_[c])
+        cats += std::string(" ") + kCatNames[c] + "=" +
+                (cat_[c] ? "1" : "0");
     LOG_INFO << "autotune (bayes) converged after " << opt_->n_samples()
              << " samples: fusion_threshold=" << fusion_
-             << " cycle_time_ms=" << cycle_ms_
-             << (hier_tunable_
-                     ? std::string(" hierarchical=") +
-                           (hierarchical_ ? "1" : "0")
-                     : std::string())
+             << " cycle_time_ms=" << cycle_ms_ << cats
              << " (score " << static_cast<int64_t>(best_score_) << " B/s)";
   } else {
     ApplyPoint(opt_->NextCandidate());
   }
   settling_ = true;
   return fusion_ != old_fusion || cycle_ms_ != old_cycle ||
-         hierarchical_ != old_hier || converged_;
+         std::memcmp(cat_, old_cat, sizeof(old_cat)) != 0 || converged_;
 }
 
 bool ParameterManager::UpdateClimb(double score) {

@@ -555,6 +555,20 @@ def main():
             np.testing.assert_allclose(np.asarray(out), want)
             hvd.join()
 
+    elif scenario == "traffic":
+        # Sustained allreduce traffic over a FIXED iteration count
+        # (time-based loops desync ranks: the first finisher's
+        # shutdown kills everyone else's in-flight ops). Autotune
+        # tests: the tuner needs many measurement windows, and the
+        # results must stay correct through every parameter flip.
+        iters = int(os.environ.get("TRAFFIC_ITERS", "2000"))
+        want = float(s) * 1.0
+        for i in range(iters):
+            out = hvd.allreduce(np.ones(4096, np.float32), op=hvd.Sum,
+                                name=f"tr.{i % 4}")
+            assert abs(float(np.asarray(out)[0]) - want) < 1e-5
+        print(f"OK rank={r} iters={iters}")
+
     elif scenario == "shm_segmented":
         # Multi-segment shm allreduce (HOROVOD_SHM_SEGMENT_BYTES forced
         # tiny by the test): odd payload lengths so segment boundaries
