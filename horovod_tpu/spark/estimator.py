@@ -13,9 +13,11 @@ for inference.
 The reference's Petastorm streaming reader maps to chunked staging
 (``STAGE_CHUNK_ROWS``-row shard files written by the executors) plus
 the worker-side streaming batch iterator — memory stays bounded by one
-chunk regardless of partition size; its parquet format maps to pickled
-float32 arrays, with the Store seam (local FS / fsspec s3-gs-hdfs)
-where a columnar format would slot in.
+chunk regardless of partition size. Shards stage as real **parquet**
+files by default (one column per DataFrame column, the reference's
+columnar format — any parquet tool can read the staging area);
+``Store(..., shard_format="pickle")`` restores the plain pickled
+float32 format.
 """
 
 from __future__ import annotations
@@ -100,7 +102,8 @@ def _stage_dataframe(df, cols: List[str], store: Store, num_proc: int,
                 if self.buf:
                     store.write_shard(
                         f"{self.prefix}.{pid}.c{self.k}",
-                        np.asarray(self.buf, dtype=np.float32))
+                        np.asarray(self.buf, dtype=np.float32),
+                        columns=cols)
                     self.total += len(self.buf)
                     self.buf, self.k = [], self.k + 1
 

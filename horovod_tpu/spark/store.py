@@ -24,26 +24,64 @@ import pickle
 from typing import Any, List
 
 
+def _pick_shard_format(requested: str) -> str:
+    if requested not in ("parquet", "pickle"):
+        raise ValueError(
+            f"shard_format must be 'parquet' or 'pickle', got "
+            f"{requested!r}")
+    if requested == "parquet":
+        try:
+            import pyarrow  # noqa: F401
+        except ImportError:
+            return "pickle"
+    return requested
+
+
+def _pyarrow_or_raise():
+    """Shard I/O runs on EXECUTORS, which may lack the driver's
+    pyarrow — surface that as an actionable error, not a bare
+    ImportError mid-stage."""
+    try:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+    except ImportError as e:
+        raise RuntimeError(
+            "shard_format='parquet' needs pyarrow on every Spark "
+            "executor (the driver had it; this process does not). "
+            "Install pyarrow cluster-wide or construct the Store with "
+            "shard_format='pickle'") from e
+    return pa, pq
+
+
 class Store:
     """Shared-filesystem staging area (base class + local driver).
 
     Keys are slash-separated relative paths under ``prefix_path``; the
     primitives (:meth:`open`, :meth:`exists`) are what subclasses
     override — the array/shard helpers build on them.
+
+    ``shard_format`` selects how training shards are staged:
+    ``"parquet"`` (default — real columnar files, the reference's
+    Petastorm/Parquet staging format, readable by any parquet tool)
+    or ``"pickle"`` (the pre-round-5 format; automatic fallback when
+    pyarrow is unavailable). Metadata and models stay pickled either
+    way.
     """
 
-    def __init__(self, prefix_path: str):
+    def __init__(self, prefix_path: str, shard_format: str = "parquet"):
         self.prefix_path = prefix_path
+        self.shard_format = _pick_shard_format(shard_format)
         os.makedirs(prefix_path, exist_ok=True)
 
     @staticmethod
-    def create(path: str) -> "Store":
+    def create(path: str, shard_format: str = "parquet") -> "Store":
         """Pick a driver by URL: plain paths -> local filesystem,
         ``scheme://`` URLs -> fsspec (reference ``store.py``
         ``Store.create``)."""
         if "://" in path and not path.startswith("file://"):
-            return FsspecStore(path)
-        return Store(path.removeprefix("file://"))
+            return FsspecStore(path, shard_format=shard_format)
+        return Store(path.removeprefix("file://"),
+                     shard_format=shard_format)
 
     # -- primitives --------------------------------------------------------
 
@@ -64,7 +102,8 @@ class Store:
         (``runs/{run_id}``) — the reference's ``get_run_path``
         (``spark/common/store.py``): concurrent fits sharing one store
         prefix must never read each other's shards."""
-        return Store(os.path.join(self.prefix_path, "runs", run_id))
+        return Store(os.path.join(self.prefix_path, "runs", run_id),
+                     shard_format=self.shard_format)
 
     # -- staging helpers (shared by all drivers) ---------------------------
 
@@ -77,12 +116,46 @@ class Store:
             return pickle.load(f)
 
     def shard_key(self, idx) -> str:
-        return f"shard.{idx}.pkl"
+        ext = "parquet" if self.shard_format == "parquet" else "pkl"
+        return f"shard.{idx}.{ext}"
 
-    def write_shard(self, idx, rows: Any) -> None:
+    def write_shard(self, idx, rows: Any, columns=None) -> None:
+        """Stage one 2-D float32 shard. Under parquet, each DataFrame
+        column becomes a real parquet column (``columns`` names them;
+        ``c{i}`` fallback), so staged shards are plain columnar files
+        any parquet reader can open."""
+        if self.shard_format == "parquet":
+            import numpy as np
+
+            pa, pq = _pyarrow_or_raise()
+            arr = np.asarray(rows)
+            names = (list(columns) if columns
+                     else [f"c{i}" for i in range(arr.shape[1])])
+            # from_arrays, not pa.table(dict): a dict would silently
+            # DEDUP duplicate column names and drop columns (parquet
+            # itself allows duplicates; reads are positional).
+            table = pa.Table.from_arrays(
+                [pa.array(arr[:, i]) for i in range(arr.shape[1])],
+                names=names)
+            with self.open(self.shard_key(idx), "wb") as f:
+                pq.write_table(table, f)
+            return
         self.write_array(self.shard_key(idx), rows)
 
     def read_shard(self, idx) -> Any:
+        if self.shard_format == "parquet":
+            import numpy as np
+
+            _, pq = _pyarrow_or_raise()
+            with self.open(self.shard_key(idx), "rb") as f:
+                # Direct file reader, not pq.read_table: the dataset
+                # API resolves columns by FieldRef NAME and refuses
+                # duplicate column names, which parquet itself allows.
+                table = pq.ParquetFile(f).read()
+            return np.column_stack(
+                [table.column(i).to_numpy() for i in
+                 range(table.num_columns)]).astype(np.float32,
+                                                   copy=False)
         return self.read_array(self.shard_key(idx))
 
     def model_key(self) -> str:
@@ -100,21 +173,23 @@ class FsspecStore(Store):
     HDFSStore, generalized). The filesystem handle is created lazily in
     each process, so instances pickle into Spark tasks."""
 
-    def __init__(self, url: str):
+    def __init__(self, url: str, shard_format: str = "parquet"):
         try:
             import fsspec  # noqa: F401
         except ImportError as e:  # pragma: no cover - fsspec is baked in
             raise RuntimeError(
                 f"FsspecStore({url!r}) requires fsspec") from e
         self.url = url.rstrip("/")
+        self.shard_format = _pick_shard_format(shard_format)
         self._fs = None
         self._root = None
 
     def __getstate__(self):
-        return {"url": self.url}
+        return {"url": self.url, "shard_format": self.shard_format}
 
     def __setstate__(self, state):
         self.url = state["url"]
+        self.shard_format = state.get("shard_format", "parquet")
         self._fs = None
         self._root = None
 
@@ -147,7 +222,8 @@ class FsspecStore(Store):
             "store.open(store.model_key()) instead")
 
     def run(self, run_id: str) -> "FsspecStore":
-        return FsspecStore(f"{self.url}/runs/{run_id}")
+        return FsspecStore(f"{self.url}/runs/{run_id}",
+                           shard_format=self.shard_format)
 
 
 def assign_partitions(counts, num_proc: int):

@@ -336,7 +336,12 @@ def test_torch_estimator_fit_predict(fake_pyspark, tmp_path):
     import os
     run_dir = os.path.join(str(tmp_path), "runs", est.last_run_id)
     assert model.run_id == est.last_run_id
-    assert os.path.exists(os.path.join(run_dir, "shard.part.0.c0.pkl"))
+    # Shards are REAL parquet (columnar, named after the DataFrame
+    # columns) — readable by any parquet tool.
+    shard = os.path.join(run_dir, "shard.part.0.c0.parquet")
+    assert os.path.exists(shard)
+    import pyarrow.parquet as pq
+    assert pq.read_table(shard).column_names == ["x", "y"]
     assert os.path.exists(os.path.join(run_dir, "part.0.meta"))
     # fit() returns a per-epoch metrics history with falling loss.
     assert len(model.history) == 40
@@ -500,6 +505,36 @@ def test_estimator_resume_from_checkpoint(fake_pyspark, tmp_path):
         TorchEstimator(model=None, optimizer=None, loss=None,
                        feature_cols=[], label_cols=[], store=store,
                        resume=True)
+
+
+def test_store_shard_format_roundtrip(tmp_path):
+    """Both shard formats round-trip a float32 matrix; parquet names
+    its columns and the pickle fallback stays available."""
+    from horovod_tpu.spark import Store
+
+    rows = np.arange(12, dtype=np.float32).reshape(4, 3)
+    pq_store = Store(str(tmp_path / "pq"))
+    pq_store.write_shard("s0", rows, columns=["a", "b", "c"])
+    np.testing.assert_array_equal(pq_store.read_shard("s0"), rows)
+    assert (tmp_path / "pq" / "shard.s0.parquet").exists()
+    # Duplicate column names stay positional (a dict-built table would
+    # silently drop columns; the dataset-API reader would refuse).
+    pq_store.write_shard("dup", rows, columns=["x", "x", "y"])
+    np.testing.assert_array_equal(pq_store.read_shard("dup"), rows)
+    with pytest.raises(ValueError, match="shard_format"):
+        Store(str(tmp_path), shard_format="Parquet")
+
+    pk_store = Store(str(tmp_path / "pk"), shard_format="pickle")
+    pk_store.write_shard("s0", rows)
+    np.testing.assert_array_equal(pk_store.read_shard("s0"), rows)
+    assert (tmp_path / "pk" / "shard.s0.pkl").exists()
+
+    # The format survives pickling into Spark tasks and per-run
+    # namespacing (executors and trainers must agree on it).
+    import pickle as pkl
+    assert pkl.loads(pkl.dumps(pk_store)).shard_format == "pickle"
+    assert pk_store.run("r1").shard_format == "pickle"
+    assert pq_store.run("r1").shard_format == "parquet"
 
 
 def test_jax_estimator_resume(fake_pyspark, tmp_path):
