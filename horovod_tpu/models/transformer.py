@@ -310,15 +310,21 @@ def _constrainer(mesh: Optional[Mesh]):
     return constrain
 
 
-def decoder_layer(cfg: TransformerConfig, attend, constrain, x, lp):
+def decoder_layer(cfg: TransformerConfig, attend, constrain, x, lp,
+                  pos_offset=0):
     """One pre-norm decoder block (attention + FFN/MoE) on ``x``
     [B, T, D]; ``lp`` is this layer's param dict (no leading L dim).
     Returns (x, aux_loss) — aux is 0 for dense FFN, the load-balancing
     term for MoE. Module-level so both the layer scan and the pipeline
-    stage function build on it."""
+    stage function build on it.
+
+    ``pos_offset`` shifts the rotary positions: callers running this
+    layer INSIDE a manual island on a sequence SHARD (pp+sp) pass
+    ``axis_index("sp") * local_T`` so every shard embeds its global
+    positions; the flat path's T is already global and keeps 0."""
     H, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     B, T = x.shape[0], x.shape[1]
-    pos = jnp.arange(T)
+    pos = jnp.arange(T) + pos_offset
 
     h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
     q = (h @ lp["wq"]).reshape(B, T, H, Dh)

@@ -38,7 +38,9 @@ def _stage_specs(stage_params) -> Any:
 def pipeline_apply(stage_fn: Callable, stage_params, microbatches, *,
                    mesh: Mesh, axis_name: str = "pp",
                    remat_stage: bool = True, remat_policy=None,
-                   with_aux: bool = False, check_vma: bool = True):
+                   with_aux: bool = False, check_vma: bool = True,
+                   extra_axes: frozenset = frozenset(),
+                   mb_spec: Any = None):
     """Run ``microbatches [M, mb, ...]`` through ``S`` pipeline stages.
 
     ``stage_fn(params_slice, x) -> y`` must preserve ``x``'s
@@ -50,6 +52,13 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches, *,
     (e.g. the MoE load-balancing term); aux is accumulated over every
     REAL (non-bubble) tick and summed over stages — the return becomes
     ``(outputs, aux_total)``.
+
+    ``extra_axes``/``mb_spec`` extend the island's MANUAL axis set
+    beyond ``pp`` (pp+sp composition: Shardy cannot NEST a manual sp
+    island inside the pp island, but ONE island manual over both axes
+    is fine — ``stage_fn`` then sees sequence-LOCAL shards and runs
+    the ring attention body directly). ``mb_spec`` is the microbatch
+    in/out spec over the manual axes (default: replicated).
     """
     S = mesh.shape[axis_name]
     M = microbatches.shape[0]
@@ -129,10 +138,14 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches, *,
     # check_vma=False is needed when stage_fn contains a pallas_call
     # (its out_shape carries no VMA annotation — same limitation as the
     # ring_flash island in ring_attention.py).
+    if mb_spec is None:
+        mb_spec = P()
     outs, aux_total = shard_map(island, mesh=mesh,
-                                in_specs=(_stage_specs(stage_params), P()),
-                                out_specs=(P(), P()),
-                                axis_names={axis_name},
+                                in_specs=(_stage_specs(stage_params),
+                                          mb_spec),
+                                out_specs=(mb_spec, P()),
+                                axis_names=frozenset({axis_name})
+                                | extra_axes,
                                 check_vma=check_vma)(
                                     stage_params, microbatches)
     if with_aux:
@@ -202,13 +215,48 @@ def _wire_train_step(cfg, mesh: Mesh, loss_fn, optimizer):
     return init_state, jit_step, param_sh
 
 
+def _pp_stage_attention(cfg, mesh: Mesh):
+    """Per-stage attention for inside the pp island, plus the island
+    config it implies: ``(attend, sp_size, extra_axes, mb_spec)``.
+
+    sp == 1 — plain XLA attention on the stage's full sequence. The
+    flash Pallas kernel is NOT used: inside the pp island the batch/
+    head dims stay under GSPMD (auto axes), and the partitioner
+    replicates operands around a Mosaic call it cannot shard
+    (measured: 3x the all-gathers and +30% temp memory vs local
+    attention on a dp×pp×tp mesh) — XLA's fused attention is the
+    better per-stage choice until pallas calls carry sharding rules.
+
+    sp > 1 — **pp+sp composes in ONE island manual over both axes**:
+    Shardy cannot nest the sp island inside the pp island, but the
+    ring attention BODY (raw ppermute/axis_index code) runs directly
+    inside the combined island on sequence-local shards. The pure-XLA
+    ring is used regardless of ``cfg.sp_attention`` (the Pallas ring
+    blocks hit the same Mosaic auto-partitioning wall as flash here).
+    """
+    import functools
+
+    from horovod_tpu.models import transformer as tr
+    from horovod_tpu.parallel.ring_attention import ring_self_attention
+
+    sp_size = dict(mesh.shape).get("sp", 1)
+    if sp_size == 1:
+        attend = tr._attention_island(
+            dataclasses.replace(cfg, sp_attention="local"), None)
+        return attend, 1, frozenset(), None
+    attend = functools.partial(ring_self_attention, axis_name="sp",
+                               causal=True)
+    return attend, sp_size, frozenset({"sp"}), P(None, None, "sp", None)
+
+
 def make_pp_train_step(cfg, mesh: Mesh, n_micro: int, optimizer=None):
     """GPipe training step for the transformer over a mesh with pp>1
-    (compose with dp/fsdp/tp/ep as usual). sp inside a pipeline stage
-    is not supported — Shardy cannot nest a manual sp island inside
-    the manual pp island; for sequence parallelism use ring/ring_flash
-    without pp, and for long sequences inside a pipeline rely on remat
-    + the per-stage full-sequence attention.
+    (compose with dp/fsdp/tp/sp/ep as usual). Sequence parallelism
+    composes via a single island manual over {pp, sp}: per-stage
+    attention becomes the ring body over ``sp`` and rotary positions
+    are shard-offset (see :func:`_pp_stage_attention`). sp+MoE inside
+    a pipeline stays unsupported (the aux statistic would need its
+    own cross-shard reduction).
 
     MoE composes: the load-balancing aux term threads through the
     schedule, computed per microbatch (the natural statistic inside a
@@ -222,29 +270,24 @@ def make_pp_train_step(cfg, mesh: Mesh, n_micro: int, optimizer=None):
 
     from horovod_tpu.models import transformer as tr
 
-    if mesh.shape.get("sp", 1) > 1:
-        raise NotImplementedError(
-            "pp + sp composition is not supported (Shardy rejects "
-            "nesting a manual sp island inside the manual pp island); "
-            "use ring/ring_flash attention without pp, or pp with full "
-            "sequences per stage")
     if optimizer is None:
         optimizer = optax.adamw(3e-4, weight_decay=0.01)
     S = mesh.shape["pp"]
     constrain = tr._constrainer(mesh)
-    # Plain XLA attention on each stage's full sequence. The flash
-    # Pallas kernel is NOT used here: inside the pp island the batch/
-    # head dims stay under GSPMD (auto axes), and the partitioner
-    # replicates operands around a Mosaic call it cannot shard
-    # (measured: 3x the all-gathers and +30% temp memory vs local
-    # attention on a dp×pp×tp mesh) — XLA's fused attention is the
-    # better per-stage choice until pallas calls carry sharding rules.
-    attend = tr._attention_island(
-        dataclasses.replace(cfg, sp_attention="local"), None)
+    attend, sp_size, extra_axes, mb_spec = _pp_stage_attention(cfg, mesh)
+    if sp_size > 1 and cfg.n_experts > 0:
+        raise NotImplementedError(
+            "pp + sp + MoE is not supported (the per-shard aux "
+            "statistic needs its own cross-sp reduction)")
 
     def stage_fn(stage_layers, x):
+        # Inside the island x is sequence-LOCAL under sp; rotary
+        # positions must be the global ones for this shard.
+        off = (lax.axis_index("sp") * x.shape[1] if sp_size > 1 else 0)
+
         def one(x, lp):
-            return tr.decoder_layer(cfg, attend, lambda v, *s: v, x, lp)
+            return tr.decoder_layer(cfg, attend, lambda v, *s: v, x, lp,
+                                    pos_offset=off)
         y, auxes = lax.scan(one, x, stage_layers)
         return y, auxes.sum()
 
@@ -255,12 +298,14 @@ def make_pp_train_step(cfg, mesh: Mesh, n_micro: int, optimizer=None):
         if B % n_micro:
             raise ValueError(f"batch {B} not divisible by n_micro={n_micro}")
         x = tr.embed_lookup(params["embed"], inp, cfg.dtype, mesh)
-        x = constrain(x, ("dp", "fsdp"), None, None)
+        x = constrain(x, ("dp", "fsdp"), "sp" if sp_size > 1 else None,
+                      None)
         mb = x.reshape(n_micro, B // n_micro, T, x.shape[-1])
         y, aux = pipeline_apply(stage_fn, params["layers"], mb, mesh=mesh,
                                 remat_stage=cfg.remat,
                                 remat_policy=tr.remat_policy_fn(cfg),
-                                with_aux=True)
+                                with_aux=True, extra_axes=extra_axes,
+                                mb_spec=mb_spec)
         x = y.reshape(B, T, -1)
         x = tr._rmsnorm(x, params["final_norm"], cfg.norm_eps)
         logits = (x @ params["lm_head"]).astype(jnp.float32)
@@ -283,10 +328,10 @@ def make_pp_train_step_1f1b(cfg, mesh: Mesh, n_micro: int, optimizer=None):
     pipelines can raise ``n_micro`` to shrink the bubble without
     scaling activation memory.
 
-    Same composition rules as the GPipe step: dp/fsdp/tp/ep compose
-    under GSPMD (the MoE aux loss rides the per-stage scalar through
-    the explicit backward); sp inside a stage is unsupported (nested
-    manual islands).
+    Same composition rules as the GPipe step: dp/fsdp/tp/sp/ep compose
+    under GSPMD, with sp riding the combined {pp, sp} manual island
+    (the MoE aux loss rides the per-stage scalar through the explicit
+    backward; sp+MoE stays unsupported).
 
     Returns ``(init_state, jit_step, param_shardings)``.
     """
@@ -295,17 +340,20 @@ def make_pp_train_step_1f1b(cfg, mesh: Mesh, n_micro: int, optimizer=None):
     from horovod_tpu.models import transformer as tr
     from horovod_tpu.parallel.pipeline_1f1b import make_1f1b_loss
 
-    if mesh.shape.get("sp", 1) > 1:
-        raise NotImplementedError("pp + sp composition is not supported")
     if optimizer is None:
         optimizer = optax.adamw(3e-4, weight_decay=0.01)
     S = mesh.shape["pp"]
     constrain = tr._constrainer(mesh)
-    attend = tr._attention_island(
-        dataclasses.replace(cfg, sp_attention="local"), None)
+    attend, sp_size, extra_axes, mb_spec = _pp_stage_attention(cfg, mesh)
+    if sp_size > 1 and cfg.n_experts > 0:
+        raise NotImplementedError(
+            "pp + sp + MoE is not supported (the per-shard aux "
+            "statistic needs its own cross-sp reduction)")
 
     def one_layer(x, lp):
-        return tr.decoder_layer(cfg, attend, lambda v, *s: v, x, lp)
+        off = (lax.axis_index("sp") * x.shape[1] if sp_size > 1 else 0)
+        return tr.decoder_layer(cfg, attend, lambda v, *s: v, x, lp,
+                                pos_offset=off)
 
     layer = one_layer
     if cfg.remat:
@@ -326,7 +374,8 @@ def make_pp_train_step_1f1b(cfg, mesh: Mesh, n_micro: int, optimizer=None):
         if B % n_micro:
             raise ValueError(f"batch {B} not divisible by n_micro={n_micro}")
         x = tr.embed_lookup(params["embed"], inp, cfg.dtype, mesh)
-        x = constrain(x, ("dp", "fsdp"), None, None)
+        x = constrain(x, ("dp", "fsdp"), "sp" if sp_size > 1 else None,
+                      None)
         mb = x.reshape(n_micro, B // n_micro, T, x.shape[-1])
         tgt_mb = tgt.reshape(n_micro, B // n_micro, T)
 
@@ -336,13 +385,24 @@ def make_pp_train_step_1f1b(cfg, mesh: Mesh, n_micro: int, optimizer=None):
             logp = jax.nn.log_softmax(logits, axis=-1)
             t_m = lax.dynamic_index_in_dim(tgt_mb, m_idx, 0,
                                            keepdims=False)
+            if sp_size > 1:
+                # tgt_mb is a closure capture — replicated into the
+                # island — while y is this shard's sequence slice;
+                # take the matching target slice.
+                t_m = lax.dynamic_slice_in_dim(
+                    t_m, lax.axis_index("sp") * y.shape[1], y.shape[1],
+                    axis=1)
             nll = -jnp.take_along_axis(logp, t_m[..., None],
                                        axis=-1)[..., 0]
             # Per-microbatch mean / n_micro: the schedule SUMS the
             # microbatch losses, so the total is the full-batch mean.
-            return nll.mean() / n_micro
+            # Under sp the head sees only this shard's tokens and the
+            # schedule psums over sp too, so the local mean divides by
+            # the shard count to stay the GLOBAL token mean.
+            return nll.mean() / (n_micro * sp_size)
 
-        pl = make_1f1b_loss(stage_fn, last_fn, mesh)
+        pl = make_1f1b_loss(stage_fn, last_fn, mesh,
+                            extra_axes=extra_axes, mb_spec=mb_spec)
         lastp = {"final_norm": params["final_norm"],
                  "lm_head": params["lm_head"]}
         return pl(params["layers"], lastp, mb)

@@ -73,7 +73,9 @@ from horovod_tpu.parallel.pipeline import _stage_specs
 
 def pipeline_1f1b(stage_fn: Callable, last_fn: Callable, stage_params,
                   last_params, microbatches, *, mesh: Mesh,
-                  axis_name: str = "pp"):
+                  axis_name: str = "pp",
+                  extra_axes: frozenset = frozenset(),
+                  mb_spec=None):
     """Run the 1F1B schedule; returns ``(loss_sum, stage_grads,
     last_grads, d_microbatches)`` — all PRIMAL values (f32 grads).
 
@@ -221,11 +223,17 @@ def pipeline_1f1b(stage_fn: Callable, last_fn: Callable, stage_params,
         # Replicate the loss (every stage contributes: the last one
         # its loss+aux, the rest their aux), the last stage's head
         # grads, and stage 0's input cotangents to every pp rank.
-        loss = lax.psum(loss_acc, axis_name)
+        # Under pp+sp (extra_axes) the loss and the head/layer grads
+        # are additionally PARTIAL over the sequence shards — each sp
+        # shard computed its local-token share — so those reductions
+        # span the sp axis too; stage 0's input cotangents stay
+        # sp-LOCAL (the embedding outside is sequence-sharded).
+        repl_axes = (axis_name,) + tuple(extra_axes)
+        loss = lax.psum(loss_acc, repl_axes)
         lgrads = jax.tree.map(
             lambda g: lax.psum(
                 jnp.where(s_idx == S - 1, g, jnp.zeros_like(g)),
-                axis_name), lgrads)
+                repl_axes), lgrads)
         if f32_wire:
             dmb = lax.psum(
                 jnp.where(s_idx == 0, dmb.astype(jnp.float32),
@@ -235,22 +243,30 @@ def pipeline_1f1b(stage_fn: Callable, last_fn: Callable, stage_params,
             dmb = lax.psum(
                 jnp.where(s_idx == 0, dmb, jnp.zeros_like(dmb)),
                 axis_name)
+        if extra_axes:
+            # Layer grads: each sp shard holds its local-token share;
+            # the stage's true gradient sums over the sequence shards.
+            grads = jax.tree.map(
+                lambda g: lax.psum(g, tuple(extra_axes)), grads)
         grads = jax.tree.map(lambda g: g[None], grads)  # restage [1,..]
         return loss, grads, lgrads, dmb
 
     sspec = _stage_specs(stage_params)
     last_repl = jax.tree.map(lambda _: P(), last_params)
+    mspec = P() if mb_spec is None else mb_spec
     # check_vma=False: masked psums + pallas-containing stage_fns defeat
     # the VMA inference (same as the GPipe island).
     return shard_map(
         island, mesh=mesh,
-        in_specs=(sspec, last_repl, P()),
-        out_specs=(P(), sspec, last_repl, P()),
-        axis_names={axis_name}, check_vma=False)(
+        in_specs=(sspec, last_repl, mspec),
+        out_specs=(P(), sspec, last_repl, mspec),
+        axis_names=frozenset({axis_name}) | frozenset(extra_axes),
+        check_vma=False)(
             stage_params, last_params, microbatches)
 
 
-def make_1f1b_loss(stage_fn, last_fn, mesh, axis_name: str = "pp"):
+def make_1f1b_loss(stage_fn, last_fn, mesh, axis_name: str = "pp",
+                   extra_axes: frozenset = frozenset(), mb_spec=None):
     """Differentiable ``loss(stage_params, last_params, microbatches)``
     whose forward runs the 1F1B schedule and whose backward returns the
     schedule's own stashed gradients scaled by the loss cotangent."""
@@ -259,13 +275,15 @@ def make_1f1b_loss(stage_fn, last_fn, mesh, axis_name: str = "pp"):
     def loss_fn(stage_params, last_params, microbatches):
         loss, _, _, _ = pipeline_1f1b(
             stage_fn, last_fn, stage_params, last_params, microbatches,
-            mesh=mesh, axis_name=axis_name)
+            mesh=mesh, axis_name=axis_name, extra_axes=extra_axes,
+            mb_spec=mb_spec)
         return loss
 
     def fwd(stage_params, last_params, microbatches):
         loss, grads, lgrads, dmb = pipeline_1f1b(
             stage_fn, last_fn, stage_params, last_params, microbatches,
-            mesh=mesh, axis_name=axis_name)
+            mesh=mesh, axis_name=axis_name, extra_axes=extra_axes,
+            mb_spec=mb_spec)
         # Residuals must be arrays: cast the stashed f32 grads to the
         # primal dtypes now; bwd only scales them.
         grads = jax.tree.map(lambda g, a: g.astype(a.dtype), grads,

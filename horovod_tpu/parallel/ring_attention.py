@@ -31,6 +31,20 @@ from jax import lax
 _NEG_BIG = -1e30  # finite "-inf": keeps the online-softmax guards NaN-free
 
 
+def _varying_like(ts, ref, axis_name: str):
+    """Declare each accumulator in ``ts`` varying over the ring axis
+    AND every other manual axis ``ref`` (the query shard) is varying
+    over. Inside a combined manual island (pp+sp pipelining) the
+    fori_loop carry mixes in pp-varying activations, so declaring only
+    the ring axis would mismatch the carry's VMA types."""
+    want = jax.typeof(ref).vma | {axis_name}
+    out = []
+    for t in ts:
+        missing = tuple(want - jax.typeof(t).vma)
+        out.append(lax.pcast(t, missing, to="varying") if missing else t)
+    return out
+
+
 def _rotate(x, axis_name: str, shift: int = 1):
     """Pass shard-local ``x`` one hop around the ``axis_name`` ring."""
     n = lax.axis_size(axis_name)
@@ -90,8 +104,7 @@ def ring_self_attention(q, k, v, *, axis_name: str = "sp",
     # The accumulators become device-varying inside the loop (they mix
     # in axis_index-dependent masks); declare that up front so the scan
     # carry types line up under shard_map's VMA checking.
-    o, l, m = (lax.pcast(t, (axis_name,), to="varying")
-               for t in (o, l, m))
+    o, l, m = _varying_like((o, l, m), q, axis_name)
 
     qpos = my * T + jnp.arange(T)
 
@@ -180,8 +193,7 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "sp",
     o = jnp.zeros((B * H, T, D), jnp.float32)
     m = jnp.full((B * H, T), _NEG_BIG, jnp.float32)
     l = jnp.zeros((B * H, T), jnp.float32)
-    o, m, l = (lax.pcast(t, (axis_name,), to="varying")
-               for t in (o, m, l))
+    o, m, l = _varying_like((o, m, l), qb, axis_name)
 
     def step(i, carry):
         o, m, l, k_cur, v_cur = carry
