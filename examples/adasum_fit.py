@@ -1,0 +1,73 @@
+"""Adasum curve fitting — the reference's ``examples/adasum/
+adasum_small_model.py`` scenario in this package's idiom.
+
+Each rank draws differently-seeded noisy samples of the same cubic;
+``DistributedOptimizer(op=hvd.Adasum)`` combines the per-rank
+gradients with Adasum's orthogonality-aware weighting (the update
+keeps the components ranks AGREE on at full strength instead of
+averaging them down), so the fit converges on the shared curve.
+
+Run: ``horovodrun -np 2 python examples/adasum_fit.py``
+"""
+
+import argparse
+
+import numpy as np
+import torch
+
+import horovod_tpu.torch as hvd
+
+
+def target(x):
+    return 10 * x ** 3 + 5 * x ** 2 - 20 * x - 5
+
+
+class Cubic(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.coef = torch.nn.Parameter(torch.tensor([1.0, -1.0, 1.0]))
+
+    def forward(self, x):
+        return (10 * x ** 3 + self.coef[0] * x ** 2
+                + self.coef[1] * x + self.coef[2])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=120)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--samples", type=int, default=64)
+    args = ap.parse_args()
+
+    hvd.init()
+    torch.manual_seed(1234)               # identical initial model
+    rng = np.random.RandomState(1 + hvd.rank())  # per-rank data
+
+    model = Cubic()
+    opt = torch.optim.SGD(model.parameters(), lr=args.lr)
+    opt = hvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters(), op=hvd.Adasum)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+    x = torch.tensor(rng.uniform(-1, 1, args.samples), dtype=torch.float32)
+    y = torch.tensor(target(x.numpy())
+                     + rng.normal(0, 0.1, args.samples), dtype=torch.float32)
+
+    first = None
+    for step in range(args.steps):
+        opt.zero_grad()
+        loss = torch.nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+        if first is None:
+            first = float(loss)
+        if step % 20 == 0 and hvd.rank() == 0:
+            print(f"step {step:3d}  loss {float(loss):.4f}", flush=True)
+
+    print(f"RANK {hvd.rank()} first={first:.4f} final={float(loss):.4f} "
+          f"coef={model.coef.detach().numpy().round(2)}", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -141,3 +141,40 @@ def test_torch_synthetic_benchmark_2proc(capfd):
     out = capfd.readouterr().out
     assert "Img/sec per process:" in out
     assert "Total img/sec on 2 process(es):" in out
+
+
+def test_adasum_fit_example_3proc(capfd):
+    """The Adasum curve-fit example (reference examples/adasum tier):
+    three ranks with differently-seeded noise must converge on the
+    shared cubic through DistributedOptimizer(op=Adasum)."""
+    run_command(
+        [sys.executable, os.path.join(ROOT, "examples", "adasum_fit.py"),
+         "--steps", "120"],
+        np=3, env=_WORKER_ENV, start_timeout=120)
+    out = capfd.readouterr().out
+    for r in range(3):
+        line = next(ln for ln in out.splitlines()
+                    if f"RANK {r} " in ln)
+        first = float(line.split("first=")[1].split()[0])
+        final = float(line.split("final=")[1].split()[0])
+        assert final < first * 0.2, line
+
+
+def test_spark_estimator_example_degrades_without_pyspark():
+    """The Spark example must explain itself when pyspark is absent
+    (this container has none) instead of stack-tracing."""
+    import importlib.util
+    import subprocess
+
+    import pytest
+    if importlib.util.find_spec("pyspark") is not None:
+        pytest.skip("pyspark present: the no-pyspark path can't run "
+                    "(the estimator itself is covered by "
+                    "test_integrations.py)")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "examples", "spark_torch_estimator.py")],
+        capture_output=True, text=True, timeout=60,
+        env={**os.environ, **_WORKER_ENV})
+    assert proc.returncode == 0, proc.stderr
+    assert "pyspark is not installed" in proc.stdout
