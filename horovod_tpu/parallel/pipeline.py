@@ -229,23 +229,26 @@ def _pp_stage_attention(cfg, mesh: Mesh):
 
     sp > 1 — **pp+sp composes in ONE island manual over both axes**:
     Shardy cannot nest the sp island inside the pp island, but the
-    ring attention BODY (raw ppermute/axis_index code) runs directly
-    inside the combined island on sequence-local shards. The pure-XLA
-    ring is used regardless of ``cfg.sp_attention`` (the Pallas ring
+    pure-XLA attention BODIES (raw ppermute / all_to_all code) run
+    directly inside the combined island on sequence-local shards.
+    ``cfg.sp_attention="ulysses"`` keeps Ulysses (head-scatter
+    all-to-all); everything else maps to the ring (the Pallas ring
     blocks hit the same Mosaic auto-partitioning wall as flash here).
     """
     import functools
 
     from horovod_tpu.models import transformer as tr
-    from horovod_tpu.parallel.ring_attention import ring_self_attention
+    from horovod_tpu.parallel.ring_attention import (ring_self_attention,
+                                                     ulysses_attention)
 
     sp_size = dict(mesh.shape).get("sp", 1)
     if sp_size == 1:
         attend = tr._attention_island(
             dataclasses.replace(cfg, sp_attention="local"), None)
         return attend, 1, frozenset(), None
-    attend = functools.partial(ring_self_attention, axis_name="sp",
-                               causal=True)
+    body = (ulysses_attention if cfg.sp_attention == "ulysses"
+            else ring_self_attention)
+    attend = functools.partial(body, axis_name="sp", causal=True)
     return attend, sp_size, frozenset({"sp"}), P(None, None, "sp", None)
 
 

@@ -273,19 +273,21 @@ def test_1f1b_memory_flat_in_microbatches(devices):
     assert f8 < g8 / 3, (f8, g8)
 
 
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
 @pytest.mark.parametrize("factory_name", ["gpipe", "1f1b"])
-def test_pp_sp_matches_flat_ring(devices, factory_name):
+def test_pp_sp_matches_flat(devices, factory_name, impl):
     """pp+sp composition (ONE island manual over both axes — Shardy
     cannot nest the sp island inside pp): both schedules must track
-    the flat ring-attention model's training trajectory exactly,
-    proving the ring body, the shard-offset rotary positions, and the
-    cross-sp loss/grad reductions are all placed right."""
+    the flat sp model's training trajectory exactly for both pure-XLA
+    sp impls, proving the attention body, the shard-offset rotary
+    positions, and the cross-sp loss/grad reductions are all placed
+    right."""
     from horovod_tpu.models import make_train_step
     from horovod_tpu.parallel import (make_pp_train_step,
                                       make_pp_train_step_1f1b)
     from jax.sharding import NamedSharding
 
-    cfg = _cfg(sp_attention="ring", max_seq=64)
+    cfg = _cfg(sp_attention=impl, max_seq=64)
     mesh_pp = build_mesh(pp=2, sp=2, tp=2)
     mesh_fl = build_mesh(dp=2, sp=2, tp=2)
     factory = (make_pp_train_step if factory_name == "gpipe"
