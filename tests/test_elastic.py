@@ -308,7 +308,7 @@ def test_elastic_xla_exec_reforms_world(tmp_path, capfd):
          # conftest's 8-device flag would break the one-device-per-
          # process model the eager device plane requires.
          "XLA_FLAGS": ""},
-        discovery, timeout=240)
+        discovery, timeout=420)
     out = capfd.readouterr().out
     results = [ln for ln in out.splitlines() if "RESULT" in ln]
     assert sum(f"batch={total}" in ln for ln in results) >= 2, out
@@ -352,7 +352,7 @@ def test_elastic_xla_exec_scale_down_then_regrow(tmp_path, capfd):
         tmp_path, total,
         {"ELASTIC_SLEEP": "0.05", "ELASTIC_JAX": "1",
          "HOROVOD_XLA_EXEC": "1", "XLA_FLAGS": ""},
-        discovery, max_np=2, mutate=mutate, timeout=240)
+        discovery, max_np=2, mutate=mutate, timeout=420)
     out = capfd.readouterr().out
     results = [ln for ln in out.splitlines() if "RESULT" in ln]
     assert sum(f"batch={total}" in ln for ln in results) >= 1, out
