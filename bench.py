@@ -215,32 +215,61 @@ def _transformer_worker():
         pass
 
 
-def _transformer_extra(remaining_secs: float):
-    """Run the transformer metric in a killable subprocess: if its
-    (multi-minute, tunnel-dependent) compile overruns the remaining
-    budget the child is killed and the primary JSON line still
-    prints."""
+def _worker_extra(flag: str, tag: str, remaining_secs: float,
+                  cap_secs: float):
+    """Run one extra-metric worker (`bench.py <flag>`) in a killable
+    subprocess bounded by the remaining budget, and return the parsed
+    payload of its LAST "<tag> {json}" line (or None). If the child
+    overruns, whatever it printed before the kill is kept — the
+    headline may already be out before a secondary config hangs."""
     import subprocess
 
-    timeout = max(30.0, min(remaining_secs, 300.0))
+    timeout = max(30.0, min(remaining_secs, cap_secs))
     try:
         proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__),
-             "--transformer-worker"],
+            [sys.executable, os.path.abspath(__file__), flag],
             capture_output=True, text=True, timeout=timeout,
             env=dict(os.environ))
         stdout = proc.stdout
     except subprocess.TimeoutExpired as e:
-        # The headline metric may already have printed before the
-        # (secondary-config) overrun — keep what we got.
         stdout = e.stdout or b""
         if isinstance(stdout, bytes):
             stdout = stdout.decode(errors="replace")
     found = None
-    for line in stdout.splitlines():
-        if line.startswith("TFEXTRA "):
-            found = json.loads(line[len("TFEXTRA "):])
+    for line in (stdout or "").splitlines():
+        if line.startswith(tag + " "):
+            found = json.loads(line[len(tag) + 1:])
     return found
+
+
+def _transformer_extra(remaining_secs: float):
+    """Transformer tokens/sec + MFU extra (multi-minute,
+    tunnel-dependent compile — hence the killable subprocess)."""
+    return _worker_extra("--transformer-worker", "TFEXTRA",
+                         remaining_secs, 300.0)
+
+
+def _serve_worker():
+    """Serving metric: continuous-batching throughput + latency tails
+    on the mixed-length trace (horovod_tpu/serve/bench.py), run in its
+    own killable subprocess like the transformer extra. Prints
+    "SERVEEXTRA {json}"."""
+    try:
+        from horovod_tpu.serve.bench import run_serving_benchmark
+
+        out = run_serving_benchmark(n_requests=32)
+        # The benchmark's own contract: continuous batching must beat
+        # static on mixed lengths; ride the ratio into the payload so
+        # a scheduler regression is visible round-over-round.
+        print("SERVEEXTRA " + json.dumps(out), flush=True)
+    except Exception:
+        pass
+
+
+def _serve_extra(remaining_secs: float):
+    """Serving benchmark extra (continuous-batching engine)."""
+    return _worker_extra("--serve-worker", "SERVEEXTRA",
+                         remaining_secs, 240.0)
 
 
 def _previous_bench(bench_dir=None):
@@ -275,6 +304,11 @@ def find_regressions(prev, cur, threshold=0.10):
     def flatten(d, prefix=""):
         out = {}
         for k, v in (d or {}).items():
+            if not prefix and k == "regression":
+                # The previous payload's own gate output: flattening it
+                # would manufacture regression.<metric>.prev keys and
+                # spurious flags on back-to-back flagged rounds.
+                continue
             if isinstance(v, dict):
                 out.update(flatten(v, f"{prefix}{k}."))
             elif isinstance(v, (int, float)) and not isinstance(v, bool):
@@ -399,6 +433,15 @@ def main():
         tf = _transformer_extra(remaining)
         if tf is not None:
             extra.update(tf)
+    # Serving tier: tokens/sec + first-token tails from the
+    # continuous-batching engine (ISSUE 1's workload layer). Cheap on
+    # CPU (tiny model, ~10s) but still budget-gated.
+    remaining = budget - (time.perf_counter() - _T0)
+    if (extras_on and os.environ.get("BENCH_SKIP_SERVE") != "1"
+            and remaining > 30):
+        sv = _serve_extra(remaining)
+        if sv is not None:
+            extra.update(sv)
     payload = {
         "metric": "resnet50_images_per_sec_per_chip",
         "value": round(per_chip, 2),
@@ -423,5 +466,7 @@ if __name__ == "__main__":
         _bus_worker()
     elif "--transformer-worker" in sys.argv:
         _transformer_worker()
+    elif "--serve-worker" in sys.argv:
+        _serve_worker()
     else:
         main()

@@ -23,6 +23,11 @@ Two API tiers:
 2. The eager, named-tensor API on this module (``hvd.init()``,
    ``hvd.allreduce(t, name=...)``) with Horovod's process-rank
    semantics, negotiated by the native core.
+
+On top of the SPMD tier sits the **inference serving** layer,
+:mod:`horovod_tpu.serve` (imported on demand — it pulls in the model
+zoo): a continuous-batching engine with a paged KV cache driving the
+sharded transformer over the same mesh. See ``docs/serving.md``.
 """
 
 __version__ = "0.1.0"

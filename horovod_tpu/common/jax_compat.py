@@ -1,0 +1,96 @@
+"""Compatibility layer across JAX API generations.
+
+The framework is written against the modern surface (``jax.shard_map``
+with ``axis_names=``/``check_vma=``, ``lax.axis_size``, ``lax.pcast``),
+but deployment containers pin older jaxlibs where ``shard_map`` still
+lives in ``jax.experimental`` with the ``auto=``/``check_rep=``
+spelling and the VMA (varying-manual-axes) type system does not exist
+yet. Every module routes through this shim instead of feature-testing
+inline, so the mapping lives in exactly one place:
+
+==============================  =================================
+modern API                      legacy (<= 0.4.x) equivalent
+==============================  =================================
+``jax.shard_map``               ``jax.experimental.shard_map``
+``axis_names={...}``            ``auto = mesh.axis_names - {...}``
+``check_vma=b``                 ``check_rep=b``
+``lax.axis_size(name)``         ``lax.psum(1, name)`` (static)
+``lax.pcast(x, axes, ...)``     no-op (no VMA types to declare)
+==============================  =================================
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+from jax import lax as _lax
+
+try:
+    from jax import shard_map as _new_shard_map  # jax >= 0.6
+    HAS_NEW_SHARD_MAP = True
+except ImportError:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _old_shard_map
+    HAS_NEW_SHARD_MAP = False
+
+HAS_VMA = hasattr(_lax, "pcast")
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+              check_vma: bool = True):
+    """Drop-in for modern ``jax.shard_map`` keyword usage.
+
+    ``axis_names`` is the MANUAL axis subset (modern semantics); on
+    legacy jax it is translated to the complementary ``auto`` set.
+    ``check_vma`` maps to legacy ``check_rep``.
+    """
+    if HAS_NEW_SHARD_MAP:
+        kw = {"check_vma": check_vma}
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        return _new_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kw)
+    auto = frozenset()
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+    # check_rep stays OFF on legacy regardless of check_vma: the old
+    # replication checker predates the VMA type system and rejects
+    # valid programs (cond branches, psum-of-masked, grad-through-
+    # shard_map) — its own error message recommends check_rep=False.
+    # It is a static verifier only; numerics are unaffected.
+    return _old_shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False,
+                          auto=auto)
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a named mapped axis (modern ``lax.axis_size``).
+
+    Legacy fallback: ``lax.psum`` of a non-tracer constant folds to
+    the axis size at trace time — the historical idiom.
+    """
+    if hasattr(_lax, "axis_size"):
+        if isinstance(axis_name, (tuple, list)):
+            return math.prod(_lax.axis_size(a) for a in axis_name)
+        return _lax.axis_size(axis_name)
+    if isinstance(axis_name, (tuple, list)):
+        axis_name = tuple(axis_name)
+    return _lax.psum(1, axis_name)
+
+
+def pcast_varying(x, axes):
+    """Declare ``x`` varying over manual ``axes`` where the VMA type
+    system exists; identity on legacy jax (nothing to declare)."""
+    if not axes:
+        return x
+    if HAS_VMA:
+        return _lax.pcast(x, tuple(axes), to="varying")
+    return x
+
+
+def vma_of(x) -> frozenset:
+    """The varying-manual-axes set of ``x``'s type (empty on legacy
+    jax, where every shard_map value is implicitly varying)."""
+    if not HAS_VMA or not hasattr(jax, "typeof"):
+        return frozenset()
+    return frozenset(getattr(jax.typeof(x), "vma", frozenset()))

@@ -73,7 +73,14 @@ def allreduce_gradients(grads: Any, *, axis_name: Optional[AxisName] = None,
                 else set(axis_name))
 
         def reduce_leaf(g):
-            vma = getattr(jax.typeof(g), "vma", frozenset())
+            # Legacy jax (no VMA types): every shard_map value is
+            # implicitly varying, so always reduce. Keyed on the same
+            # HAS_VMA flag as distributed_value_and_grad — the two
+            # sites must agree or gradients silently go unreduced.
+            from horovod_tpu.common import jax_compat
+            vma = (getattr(jax.typeof(g), "vma", frozenset())
+                   if jax_compat.HAS_VMA and hasattr(jax, "typeof")
+                   else axes)
             if not (axes & set(vma)):
                 return g  # replicated or already-reduced cotangent
             # Compression casts around the collective (wire dtype); XLA
@@ -172,6 +179,8 @@ def distributed_optimizer(optimizer, *,
                 else tuple(axis_name))
 
         def one(a):
+            if not hasattr(jax, "typeof"):
+                return a  # legacy jax: no VMA types to stabilise
             vma = getattr(jax.typeof(a), "vma", None)
             if vma is None:
                 return a
@@ -263,6 +272,30 @@ def distributed_value_and_grad(fun: Callable, argnums=0, *,
         if op not in (Average, Sum):
             raise ValueError(
                 "in-jit distributed_value_and_grad supports Average/Sum")
+
+        from horovod_tpu.common import jax_compat
+
+        if not jax_compat.HAS_VMA:
+            # Legacy jax: without VMA-typed transposes, grad-of-pmean
+            # does not propagate the averaged cotangent back to
+            # replicated params. Take the explicit formulation —
+            # local grads, then reduce both loss and grads (the
+            # reduce_leaf legacy branch always psums).
+            lvg = jax.value_and_grad(fun, argnums=argnums,
+                                     has_aux=has_aux)
+
+            def legacy_wrapped(*args, **kwargs):
+                value, grads = lvg(*args, **kwargs)
+                loss = value[0] if has_aux else value
+                loss = (lax.pmean(loss, axis_name) if op == Average
+                        else lax.psum(loss, axis_name))
+                value = (loss, value[1]) if has_aux else loss
+                grads = allreduce_gradients(
+                    grads, axis_name=axis_name, op=op,
+                    compression=compression, name=name)
+                return value, grads
+
+            return legacy_wrapped
 
         def global_fun(*args, **kwargs):
             out = fun(*args, **kwargs)

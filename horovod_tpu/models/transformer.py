@@ -235,13 +235,24 @@ def embed_lookup(embed, tokens, dtype, mesh: Optional[Mesh]):
     Reference analog: none — the reference (torch DDP-style) replicates
     embeddings on every rank; vocab-parallelism is the TPU-first design.
     """
-    from jax import shard_map
+    from horovod_tpu.common import jax_compat
+    from horovod_tpu.common.jax_compat import shard_map
 
     V, D = embed.shape
     tp = mesh.shape.get("tp", 1) if mesh is not None else 1
     fsdp = mesh.shape.get("fsdp", 1) if mesh is not None else 1
     if tp * fsdp == 1:
         return embed.astype(dtype)[tokens]
+    if not jax_compat.HAS_NEW_SHARD_MAP:
+        # Legacy jax: the partial-manual island lowers axis_index to a
+        # PartitionId op the old SPMD partitioner rejects. Take the
+        # global-view gather — the table is replicated for the lookup
+        # (the cost this island exists to avoid), but EXPLICITLY so:
+        # an annotated reshard is a planned all-gather, not the
+        # partitioner's "involuntary full rematerialization" red flag.
+        replicated = lax.with_sharding_constraint(
+            embed, NamedSharding(mesh, P(None, None)))
+        return replicated.astype(dtype)[tokens]
     if V % tp or D % fsdp:
         import warnings
         warnings.warn(
@@ -439,7 +450,14 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh, optimizer=None):
                             is_leaf=lambda x: isinstance(x, P))
     batch_sh = {"tokens": NamedSharding(mesh, P(("dp", "fsdp"), None))}
 
-    jit_step = jax.jit(step, donate_argnums=(0,),
+    # Donating state needs the compiler to alias in/out buffers; with
+    # inferred out_shardings legacy XLA can pick a different output
+    # sharding and abort with an aliasing size mismatch (modern jax
+    # reshards around the alias). Skip donation there — compat mode
+    # pays one state copy per step, correctness first.
+    from horovod_tpu.common import jax_compat
+    donate = (0,) if jax_compat.HAS_NEW_SHARD_MAP else ()
+    jit_step = jax.jit(step, donate_argnums=donate,
                        in_shardings=(None, batch_sh),
                        out_shardings=(None, NamedSharding(mesh, P())))
     return init_state, jit_step, param_sh

@@ -19,7 +19,6 @@ axes to reduce over several at once) and must be called under
 
 from __future__ import annotations
 
-import math
 from typing import Optional, Sequence, Union
 
 import jax
@@ -38,9 +37,8 @@ def axis_rank(axis_name: AxisName = "dp"):
 
 def axis_size(axis_name: AxisName = "dp") -> int:
     """Static size of the named axis (cf. ``hvd.size()``)."""
-    if isinstance(axis_name, (tuple, list)):
-        return math.prod(lax.axis_size(a) for a in axis_name)
-    return lax.axis_size(axis_name)
+    from horovod_tpu.common.jax_compat import axis_size as _axis_size
+    return _axis_size(axis_name)
 
 
 def _scale(x, factor):

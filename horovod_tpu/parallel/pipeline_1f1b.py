@@ -65,7 +65,9 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from horovod_tpu.common.jax_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from horovod_tpu.parallel.pipeline import _stage_specs

@@ -679,9 +679,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 0
     try:
         codes = launch_static(settings)
-    except ValueError as e:
-        # e.g. -np exceeding the (possibly scheduler-derived) slot
-        # count — a usage error, not a traceback.
+    except (RuntimeError, ValueError) as e:
+        # ValueError: e.g. -np exceeding the (possibly scheduler-
+        # derived) slot count; RuntimeError: preflight_ssh's aggregated
+        # unreachable-host diagnostic. Both are usage/environment
+        # errors, not tracebacks.
         print(f"horovodrun: {e}", file=sys.stderr)
         return 2
     failures = {r: c for r, c in codes.items() if c != 0}

@@ -1,0 +1,45 @@
+"""Continuous-batching inference serving for the sharded transformer.
+
+The inference workload layer the training-only reference never had:
+an iteration-level scheduler (:class:`ServeEngine`) drives jitted
+prefill/decode step functions (:mod:`horovod_tpu.serve.decode`) over a
+paged KV cache (:mod:`horovod_tpu.serve.kv_cache`) on the same
+``jax.sharding.Mesh`` the trainers use, and reports throughput + tail
+latency through :mod:`horovod_tpu.serve.metrics`.
+
+Quick start::
+
+    from horovod_tpu.models import TransformerConfig, init_transformer
+    from horovod_tpu import serve
+
+    cfg = TransformerConfig.tiny()
+    params = init_transformer(cfg, jax.random.PRNGKey(0))
+    engine = serve.ServeEngine(cfg, params, serve.ServeConfig(max_batch=8))
+    rid = engine.submit(prompt_tokens, max_new_tokens=32)
+    while engine.pending:
+        engine.step()
+    print(engine.result(rid).tokens)
+
+See ``docs/serving.md`` for architecture and tuning.
+"""
+
+from horovod_tpu.serve.engine import (  # noqa: F401
+    QueueFull,
+    RequestResult,
+    ServeConfig,
+    ServeEngine,
+)
+from horovod_tpu.serve.kv_cache import (  # noqa: F401
+    BlockAllocator,
+    KVCache,
+    NULL_BLOCK,
+    OutOfBlocks,
+    init_kv_cache,
+    pick_bucket,
+)
+from horovod_tpu.serve.decode import make_serve_fns  # noqa: F401
+from horovod_tpu.serve.metrics import ServeMetrics, percentile  # noqa: F401
+from horovod_tpu.serve.bench import (  # noqa: F401
+    make_trace,
+    run_serving_benchmark,
+)

@@ -1,0 +1,206 @@
+"""jit'd prefill + decode step functions over the paged KV cache.
+
+Two compiled programs drive all serving traffic:
+
+* :func:`prefill` — run one prompt (padded to a length bucket) through
+  the transformer, write its K/V into the sequence's cache blocks, and
+  emit the first generated token from the last real position's logits.
+* :func:`decode` — one iteration-level step for the whole running
+  batch (padded to a batch bucket): embed each sequence's last token,
+  append its K/V at the sequence's current position through the block
+  table, attend against the gathered pages, and emit the next token
+  per sequence.
+
+Both are shape-bucketed (see ``kv_cache.pick_bucket``) so the jit
+cache holds a handful of programs total — batch membership, sequence
+lengths, and block placement all change per step without recompiling.
+
+Sharding: params arrive sharded by ``models.transformer.param_specs``
+(tp on heads/FFN-hidden, fsdp on the other matrix dim), the KV pool is
+tp-sharded on the KV-head dim (``kv_cache.init_kv_cache``), and GSPMD
+propagates — the attention-out and FFN-down matmuls end in the same
+in-jit tp ``psum`` pair as the training forward, so tensor-parallel
+decode exercises :mod:`horovod_tpu.ops.collectives`' data plane on the
+hot loop (the EQuARX property: collectives stay inside the XLA
+program, on ICI).
+
+Numerics match ``models.transformer`` deliberately: reused
+``_rmsnorm``/``embed_lookup``, the same unfused q/k/v/gate/up
+projections, f32 softmax and silu, ``local_attention``'s einsum
+order — so incremental decode tracks the full-context forward to
+float tolerance, and served decode is bit-identical to single-request
+decode (same programs, row-independent math).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.models import transformer as tf_lib
+from horovod_tpu.parallel.ring_attention import local_attention
+
+_NEG_BIG = -1e30  # matches ring_attention's finite "-inf"
+
+
+def _rope_at(x, pos, theta):
+    """Rotary embedding at explicit per-(batch, seq) positions.
+
+    ``x``: [B, T, H, D]; ``pos``: [B, T] int32. Unlike the training
+    forward's ``_rope`` (one shared position vector), every batch row
+    carries its own positions — in a decode batch each sequence is at
+    a different length.
+    """
+    d = x.shape[-1]
+    inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    ang = pos[..., None].astype(jnp.float32) * inv          # [B, T, D/2]
+    cos = jnp.cos(ang)[:, :, None, :]
+    sin = jnp.sin(ang)[:, :, None, :]
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    y1 = x1 * cos - x2 * sin
+    y2 = x2 * cos + x1 * sin
+    return jnp.stack([y1, y2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+def _qkv(cfg, lp, x, pos):
+    """Pre-norm + q/k/v projections + rope (same unfused matmuls and
+    dtype discipline as ``decoder_layer``). k/v keep Hkv heads — the
+    cache stores pre-GQA-repeat, post-rope K/V."""
+    H, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    B, T = x.shape[0], x.shape[1]
+    h = tf_lib._rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"]).reshape(B, T, H, Dh)
+    k = (h @ lp["wk"]).reshape(B, T, Hkv, Dh)
+    v = (h @ lp["wv"]).reshape(B, T, Hkv, Dh)
+    return (_rope_at(q, pos, cfg.rope_theta),
+            _rope_at(k, pos, cfg.rope_theta), v)
+
+
+def _ffn(cfg, lp, x):
+    """Post-attention FFN block, decoder_layer's exact math."""
+    h = tf_lib._rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+    g = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32))
+    u = (h @ lp["w_up"]).astype(jnp.float32)
+    return x + ((g * u).astype(cfg.dtype) @ lp["w_down"]).astype(cfg.dtype)
+
+
+def make_serve_fns(cfg, mesh: Optional[Any] = None, *, block_size: int,
+                   table_width: int):
+    """Build (prefill, decode) jitted closures for ``cfg`` over
+    ``mesh``. ``table_width`` is the static block-table row length
+    (blocks per sequence, worst case); caches are donated so steady-
+    state decode updates the pool in place.
+
+    Memoized: engines sharing (cfg, mesh, block geometry) — e.g. the
+    benchmark's continuous and static schedulers, or a fleet of
+    per-tenant engines — reuse one pair of jit closures and therefore
+    one compiled program per shape bucket."""
+    return _cached_serve_fns(cfg, mesh, block_size, table_width)
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_serve_fns(cfg, mesh, block_size: int, table_width: int):
+    if cfg.moe is not None:
+        raise NotImplementedError(
+            "serving the MoE FFN is not implemented yet; set n_experts=0")
+    H, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    rep = H // Hkv
+    scale = Dh ** -0.5
+
+    def prefill(params, kc, vc, tokens, length, block_table):
+        """tokens [Tp] (bucket-padded), length scalar i32 (real prompt
+        length), block_table [table_width] i32. Returns (kc, vc,
+        first_token)."""
+        Tp = tokens.shape[0]
+        n_blk = Tp // block_size
+        assert n_blk <= table_width, (
+            f"prompt bucket {Tp} needs {n_blk} blocks > table width "
+            f"{table_width}")
+        x = tf_lib.embed_lookup(params["embed"], tokens[None], cfg.dtype,
+                                mesh)                          # [1, Tp, D]
+        pos = jnp.arange(Tp, dtype=jnp.int32)[None]            # [1, Tp]
+
+        def body(x, per_layer):
+            lp, kc_l, vc_l = per_layer
+            q, k, v = _qkv(cfg, lp, x, pos)
+            # Pages: the padded prompt is block-aligned, so the write
+            # is a plain blockwise scatter. Bucket blocks past the
+            # allocation land on the null block (id 0) — written
+            # garbage there is never read (attention masks by length).
+            kc_l = kc_l.at[block_table[:n_blk]].set(
+                k[0].reshape(n_blk, block_size, Hkv, Dh).astype(kc_l.dtype))
+            vc_l = vc_l.at[block_table[:n_blk]].set(
+                v[0].reshape(n_blk, block_size, Hkv, Dh).astype(vc_l.dtype))
+            kk, vv = k, v
+            if rep > 1:
+                kk = jnp.repeat(kk, rep, axis=2)
+                vv = jnp.repeat(vv, rep, axis=2)
+            o = local_attention(q, kk, vv, causal=True)
+            x = x + (o.reshape(1, Tp, H * Dh) @ lp["wo"]).astype(cfg.dtype)
+            x = _ffn(cfg, lp, x)
+            return x, (kc_l, vc_l)
+
+        x, (kc, vc) = lax.scan(body, x, (params["layers"], kc, vc))
+        x = tf_lib._rmsnorm(x, params["final_norm"], cfg.norm_eps)
+        x_last = jnp.take(x[0], length - 1, axis=0)            # [D]
+        logits = (x_last @ params["lm_head"]).astype(jnp.float32)
+        return kc, vc, jnp.argmax(logits).astype(jnp.int32)
+
+    def decode(params, kc, vc, tokens, positions, block_tables):
+        """One continuous-batching step. tokens [B] (each sequence's
+        last token), positions [B] (its current cache length — where
+        the token's K/V lands), block_tables [B, table_width]. Padded
+        batch slots carry token 0 / position 0 / an all-null table;
+        their lane writes and reads only touch the null block and
+        their outputs are discarded by the engine. Returns (kc, vc,
+        next_tokens [B])."""
+        B = tokens.shape[0]
+        S = table_width * block_size
+        x = tf_lib.embed_lookup(params["embed"], tokens[:, None], cfg.dtype,
+                                mesh)                          # [B, 1, D]
+        pos = positions[:, None]
+
+        def body(x, per_layer):
+            lp, kc_l, vc_l = per_layer
+            q, k, v = _qkv(cfg, lp, x, pos)
+            blk = jnp.take_along_axis(
+                block_tables, (positions // block_size)[:, None], axis=1)[:, 0]
+            phys = blk * block_size + positions % block_size   # [B]
+            flat = (-1, Hkv, Dh)
+            kc_l = kc_l.reshape(flat).at[phys].set(
+                k[:, 0].astype(kc_l.dtype)).reshape(kc_l.shape)
+            vc_l = vc_l.reshape(flat).at[phys].set(
+                v[:, 0].astype(vc_l.dtype)).reshape(vc_l.shape)
+            # Gather this batch's pages through the block tables:
+            # [B, W, bs, Hkv, Dh] -> [B, S, Hkv, Dh].
+            kp = kc_l[block_tables].reshape(B, S, Hkv, Dh).astype(q.dtype)
+            vp = vc_l[block_tables].reshape(B, S, Hkv, Dh).astype(q.dtype)
+            if rep > 1:
+                kp = jnp.repeat(kp, rep, axis=2)
+                vp = jnp.repeat(vp, rep, axis=2)
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, kp,
+                           preferred_element_type=jnp.float32) * scale
+            mask = jnp.arange(S, dtype=jnp.int32)[None] <= positions[:, None]
+            s = jnp.where(mask[:, None, None, :], s, _NEG_BIG)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(vp.dtype), vp,
+                           preferred_element_type=jnp.float32).astype(q.dtype)
+            x = x + (o.reshape(B, 1, H * Dh) @ lp["wo"]).astype(cfg.dtype)
+            x = _ffn(cfg, lp, x)
+            return x, (kc_l, vc_l)
+
+        x, (kc, vc) = lax.scan(body, x, (params["layers"], kc, vc))
+        x = tf_lib._rmsnorm(x, params["final_norm"], cfg.norm_eps)
+        logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+        return kc, vc, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    # Donate the cache pool: steady-state decode rewrites it in place
+    # instead of allocating a fresh [L, n_blocks, bs, Hkv, Dh] copy
+    # per step. `length`/`positions` stay traced (they change every
+    # call); only array shapes key the jit cache.
+    return (jax.jit(prefill, donate_argnums=(1, 2)),
+            jax.jit(decode, donate_argnums=(1, 2)))

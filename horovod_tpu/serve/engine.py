@@ -1,0 +1,376 @@
+"""Continuous-batching inference engine.
+
+The serving analog of the training runtime: one process drives the
+whole mesh, and scheduling is **iteration-level** (Orca OSDI'22 /
+vLLM): every :meth:`ServeEngine.step` retires sequences that finished
+on the previous iteration, expires queued requests past their
+deadline, admits new requests into the running batch (one prefill
+each), then runs ONE decode iteration for everything active. New
+requests join the running batch mid-flight and finished sequences
+leave immediately — the batch never drains to admit, which is where
+the throughput win over static batching comes from on mixed-length
+traffic.
+
+Admission control is two-layered:
+
+* **queue backpressure** — :meth:`submit` raises :class:`QueueFull`
+  (503-style) once ``max_queue`` requests are waiting;
+* **KV backpressure** — a request is admitted only when the block
+  pool can reserve its worst case (prompt + max_new_tokens), so a
+  running sequence can never hit out-of-blocks mid-decode (no
+  preemption/swapping tier yet; the reservation is the simple-and-
+  safe policy and `high_water` tells you how much it costs).
+
+Deadlines are absolute engine-clock times by which a request must be
+*admitted* (first token scheduled); stale requests are rejected with a
+503-style result rather than burning prefill FLOPs on an answer
+nobody is waiting for. The clock is injectable for tests.
+
+Determinism: FIFO admission, stable batch-slot assignment, greedy
+argmax in-jit — the same submission order always yields bitwise the
+same tokens, which the parity test pins.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from horovod_tpu.serve import decode as decode_lib
+from horovod_tpu.serve.kv_cache import (
+    BlockAllocator, init_kv_cache, pick_bucket,
+)
+from horovod_tpu.serve.metrics import ServeMetrics
+
+
+class QueueFull(RuntimeError):
+    """Admission-queue backpressure — shed load upstream."""
+    http_status = 503
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Engine knobs (model shape lives in ``TransformerConfig``)."""
+
+    max_batch: int = 8           # decode batch slots
+    max_queue: int = 64          # admission queue depth (then 503)
+    block_size: int = 16         # KV tokens per block
+    n_blocks: Optional[int] = None   # pool size; default = worst case
+    max_prompt: int = 512        # longest admissible prompt
+    max_new_tokens: int = 128    # per-request generation cap
+    eos_id: Optional[int] = None
+    # Shape buckets (None = powers-of-two menus). Fewer buckets = fewer
+    # compiles; more buckets = less padding waste.
+    batch_buckets: Optional[Tuple[int, ...]] = None
+    prefill_buckets: Optional[Tuple[int, ...]] = None
+    # "continuous": iteration-level admission (the point of this
+    # engine). "static": admit only into an empty batch — the
+    # classical serve loop, kept as the benchmark baseline.
+    scheduling: str = "continuous"
+    cache_dtype: Any = None      # default: model dtype
+
+
+@dataclasses.dataclass
+class RequestResult:
+    rid: int
+    status: str                  # "ok" | "expired"
+    http_status: int             # 200 | 503
+    tokens: List[int]
+    n_prompt: int
+    submitted_at: float
+    first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+
+    @property
+    def first_token_latency_s(self) -> Optional[float]:
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.submitted_at
+
+
+@dataclasses.dataclass
+class _Queued:
+    rid: int
+    prompt: List[int]
+    max_new: int
+    deadline: Optional[float]
+    submitted_at: float
+
+
+@dataclasses.dataclass
+class _Seq:
+    rid: int
+    prompt: List[int]
+    max_new: int
+    blocks: List[int]
+    table: np.ndarray            # [table_width] int32 physical block ids
+    n_cached: int                # tokens currently in the KV cache
+    generated: List[int]
+    submitted_at: float
+    first_token_at: float
+
+    @property
+    def last_token(self) -> int:
+        return self.generated[-1]
+
+    def finished(self, eos_id: Optional[int]) -> bool:
+        return (len(self.generated) >= self.max_new
+                or (eos_id is not None and self.last_token == eos_id))
+
+
+def _pow2_menu(lo: int, hi: int) -> Tuple[int, ...]:
+    out = []
+    b = lo
+    while b < hi:
+        out.append(b)
+        b *= 2
+    out.append(hi)
+    return tuple(out)
+
+
+class ServeEngine:
+    def __init__(self, model_cfg, params, serve_cfg: Optional[ServeConfig]
+                 = None, mesh: Optional[Any] = None,
+                 clock=time.perf_counter):
+        cfg = serve_cfg or ServeConfig()
+        if cfg.scheduling not in ("continuous", "static"):
+            raise ValueError(f"unknown scheduling {cfg.scheduling!r}")
+        self.model_cfg = model_cfg
+        self.cfg = cfg
+        self.mesh = mesh
+        self._params = params
+        self._clock = clock
+
+        bs = cfg.block_size
+        # Prompt buckets are whole blocks (prefill writes pages).
+        max_prompt_padded = -(-cfg.max_prompt // bs) * bs
+        self._prefill_buckets = cfg.prefill_buckets or _pow2_menu(
+            bs, max_prompt_padded)
+        self._batch_buckets = cfg.batch_buckets or _pow2_menu(
+            1, cfg.max_batch)
+        self._table_width = -(-(max_prompt_padded + cfg.max_new_tokens) // bs)
+        # Fail at construction, not mid-step after blocks are already
+        # reserved: every admissible request must fit a bucket, and
+        # every bucket's pages must fit the block table.
+        if any(b % bs for b in self._prefill_buckets):
+            raise ValueError(
+                f"prefill_buckets {self._prefill_buckets} must be "
+                f"multiples of block_size {bs}")
+        if max(self._prefill_buckets) // bs > self._table_width:
+            raise ValueError(
+                f"largest prefill bucket {max(self._prefill_buckets)} "
+                f"needs {max(self._prefill_buckets) // bs} blocks but "
+                f"the block table holds {self._table_width}")
+        pick_bucket(cfg.max_prompt, self._prefill_buckets)
+        pick_bucket(cfg.max_batch, self._batch_buckets)
+
+        n_blocks = cfg.n_blocks
+        if n_blocks is None:
+            # Worst case: every batch slot holds a maximal sequence
+            # (+1 for the reserved null block).
+            n_blocks = cfg.max_batch * self._table_width + 1
+        self.allocator = BlockAllocator(n_blocks, bs)
+        self.cache = init_kv_cache(model_cfg, n_blocks, bs, mesh=mesh,
+                                   dtype=cfg.cache_dtype)
+        self._prefill_fn, self._decode_fn = decode_lib.make_serve_fns(
+            model_cfg, mesh, block_size=bs, table_width=self._table_width)
+
+        self.metrics = ServeMetrics(clock=clock)
+        self._queue: collections.deque[_Queued] = collections.deque()
+        self._active: List[_Seq] = []
+        self._results: Dict[int, RequestResult] = {}
+        self._rids = itertools.count()
+
+    # -- submission --------------------------------------------------
+
+    def submit(self, prompt: Sequence[int],
+               max_new_tokens: Optional[int] = None,
+               deadline: Optional[float] = None) -> int:
+        """Enqueue a request; returns its id. Raises :class:`QueueFull`
+        when the admission queue is at capacity (backpressure) and
+        ``ValueError`` on shapes the engine cannot ever serve."""
+        prompt = list(prompt)
+        max_new = (self.cfg.max_new_tokens if max_new_tokens is None
+                   else max_new_tokens)
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) > self.cfg.max_prompt:
+            raise ValueError(
+                f"prompt length {len(prompt)} > max_prompt "
+                f"{self.cfg.max_prompt}")
+        if not 1 <= max_new <= self.cfg.max_new_tokens:
+            raise ValueError(
+                f"max_new_tokens {max_new} outside [1, "
+                f"{self.cfg.max_new_tokens}]")
+        if len(prompt) + max_new > self.model_cfg.max_seq:
+            raise ValueError(
+                f"prompt+max_new {len(prompt) + max_new} > model max_seq "
+                f"{self.model_cfg.max_seq}")
+        need = self.allocator.blocks_for_tokens(len(prompt) + max_new)
+        if need > self.allocator.n_blocks - 1:
+            # Worst-case reservation exceeds the whole pool: admission
+            # could never succeed and FIFO would starve every request
+            # behind it — reject now, not never.
+            raise ValueError(
+                f"request needs {need} KV blocks worst-case but the pool "
+                f"holds {self.allocator.n_blocks - 1}; raise n_blocks or "
+                "lower max_new_tokens")
+        if len(self._queue) >= self.cfg.max_queue:
+            self.metrics.record_rejected()
+            raise QueueFull(
+                f"admission queue full ({self.cfg.max_queue} waiting)")
+        rid = next(self._rids)
+        self._queue.append(_Queued(rid, prompt, max_new, deadline,
+                                   self._clock()))
+        self.metrics.record_submitted()
+        self.metrics.record_queue_depth(len(self._queue))
+        return rid
+
+    # -- results -----------------------------------------------------
+
+    @property
+    def pending(self) -> bool:
+        return bool(self._queue or self._active)
+
+    def result(self, rid: int) -> Optional[RequestResult]:
+        return self._results.get(rid)
+
+    @property
+    def results(self) -> Dict[int, RequestResult]:
+        return dict(self._results)
+
+    # -- the scheduler iteration ------------------------------------
+
+    def step(self) -> None:
+        """One iteration: retire → expire → admit (prefill) → decode."""
+        now = self._clock()
+        self._retire_finished(now)
+        self._expire_queued(now)
+        self._admit(now)
+        self._decode_once()
+        self.metrics.record_queue_depth(len(self._queue))
+
+    def run_until_idle(self, max_steps: int = 1_000_000) -> None:
+        for _ in range(max_steps):
+            if not self.pending:
+                return
+            self.step()
+        raise RuntimeError(f"engine still busy after {max_steps} steps")
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 max_new_tokens: Optional[int] = None) -> List[List[int]]:
+        """Convenience batch API: serve ``prompts`` to completion and
+        return their generated token lists in order."""
+        rids = [self.submit(p, max_new_tokens) for p in prompts]
+        self.run_until_idle()
+        return [self._results[r].tokens for r in rids]
+
+    # -- internals ---------------------------------------------------
+
+    def _finish(self, seq: _Seq, now: float) -> None:
+        self.allocator.free(seq.blocks)
+        self._results[seq.rid] = RequestResult(
+            rid=seq.rid, status="ok", http_status=200,
+            tokens=list(seq.generated), n_prompt=len(seq.prompt),
+            submitted_at=seq.submitted_at,
+            first_token_at=seq.first_token_at, finished_at=now)
+        self.metrics.record_finished()
+
+    def _retire_finished(self, now: float) -> None:
+        still = []
+        for seq in self._active:
+            if seq.finished(self.cfg.eos_id):
+                self._finish(seq, now)
+            else:
+                still.append(seq)
+        self._active = still
+
+    def _expire_queued(self, now: float) -> None:
+        keep: collections.deque[_Queued] = collections.deque()
+        for req in self._queue:
+            if req.deadline is not None and now > req.deadline:
+                self._results[req.rid] = RequestResult(
+                    rid=req.rid, status="expired", http_status=503,
+                    tokens=[], n_prompt=len(req.prompt),
+                    submitted_at=req.submitted_at, finished_at=now)
+                self.metrics.record_expired()
+            else:
+                keep.append(req)
+        self._queue = keep
+
+    def _admit(self, now: float) -> None:
+        batch_was_empty = not self._active
+        while self._queue and len(self._active) < self.cfg.max_batch:
+            if self.cfg.scheduling == "static" and not batch_was_empty:
+                # Baseline scheduler: wait for the whole batch to
+                # drain before admitting again.
+                return
+            req = self._queue[0]
+            need = self.allocator.blocks_for_tokens(
+                len(req.prompt) + req.max_new)
+            if not self.allocator.can_alloc(need):
+                # KV backpressure (FIFO: no overtaking, so tail
+                # latency stays predictable under load).
+                return
+            self._queue.popleft()
+            self._prefill(req, self.allocator.alloc(need))
+
+    def _prefill(self, req: _Queued, blocks: List[int]) -> None:
+        import jax
+
+        plen = len(req.prompt)
+        bucket = pick_bucket(plen, self._prefill_buckets)
+        toks = np.zeros(bucket, np.int32)
+        toks[:plen] = req.prompt
+        table = np.zeros(self._table_width, np.int32)
+        table[:len(blocks)] = blocks
+        t0 = self._clock()
+        with jax.profiler.TraceAnnotation("serve:prefill"):
+            kc, vc, tok = self._prefill_fn(
+                self._params, self.cache.k, self.cache.v, toks,
+                np.int32(plen), table)
+            tok = int(tok)  # host sync — the step is done when this is
+        now = self._clock()
+        self.cache.k, self.cache.v = kc, vc
+        self.metrics.record_prefill(t0, now - t0, plen)
+        self.metrics.record_first_token(now - req.submitted_at)
+        seq = _Seq(rid=req.rid, prompt=req.prompt, max_new=req.max_new,
+                   blocks=blocks, table=table, n_cached=plen,
+                   generated=[tok], submitted_at=req.submitted_at,
+                   first_token_at=now)
+        if seq.finished(self.cfg.eos_id):
+            self._finish(seq, now)
+        else:
+            self._active.append(seq)
+
+    def _decode_once(self) -> None:
+        import jax
+
+        if not self._active:
+            return
+        n = len(self._active)
+        bucket = pick_bucket(n, self._batch_buckets)
+        tokens = np.zeros(bucket, np.int32)
+        positions = np.zeros(bucket, np.int32)
+        tables = np.zeros((bucket, self._table_width), np.int32)
+        for i, seq in enumerate(self._active):
+            tokens[i] = seq.last_token
+            positions[i] = seq.n_cached
+            tables[i] = seq.table
+        t0 = self._clock()
+        with jax.profiler.TraceAnnotation("serve:decode"):
+            kc, vc, out = self._decode_fn(
+                self._params, self.cache.k, self.cache.v, tokens,
+                positions, tables)
+            out = np.asarray(out)  # host sync
+        dur = self._clock() - t0
+        self.cache.k, self.cache.v = kc, vc
+        for i, seq in enumerate(self._active):
+            seq.n_cached += 1
+            seq.generated.append(int(out[i]))
+        self.metrics.record_decode(t0, dur, n, self.cfg.max_batch)

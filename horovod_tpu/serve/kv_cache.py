@@ -1,0 +1,156 @@
+"""Paged KV-cache management for continuous-batching inference.
+
+The decode batch packs variable-length sequences, so per-sequence
+contiguous caches would either waste HBM on worst-case ``max_seq``
+slots or force a recompile whenever the packing changes. Instead the
+cache is a pool of fixed-size **blocks** (vLLM's PagedAttention
+layout): device arrays shaped ``[L, n_blocks, block_size, Hkv, Dh]``
+plus a host-side :class:`BlockAllocator` handing out block ids. Each
+sequence owns a *block table* (row of physical block ids); the jitted
+decode step gathers K/V pages through the table, so batch membership
+can change every iteration without touching compiled code.
+
+Block 0 is reserved as the **null block**: padded batch slots and
+masked writes are routed there so the scatter in the decode step never
+needs a branch, and its contents are never read (attention masks by
+sequence length).
+
+Reference analog: none — the reference framework (training-only
+Horovod) has no inference path at all; this layout is the TPU-serving
+standard (PagedAttention, vLLM SOSP'23).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Tuple
+
+NULL_BLOCK = 0
+
+
+class OutOfBlocks(RuntimeError):
+    """Raised by :meth:`BlockAllocator.alloc` when the pool cannot
+    serve the request — the engine's admission backpressure signal."""
+
+
+class BlockAllocator:
+    """Host-side free-list over the device block pool.
+
+    Paged allocation has no external fragmentation: any free block can
+    serve any sequence, so ``can_alloc(n)`` is simply ``n <= n_free``.
+    The free list is LIFO so recently-retired blocks (likely still
+    warm in cache/HBM pages) are reused first, and allocation order is
+    deterministic for tests.
+    """
+
+    def __init__(self, n_blocks: int, block_size: int):
+        if n_blocks < 2:
+            raise ValueError(
+                f"need at least 2 blocks (1 usable + null), got {n_blocks}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.n_blocks = n_blocks
+        self.block_size = block_size
+        # Block 0 is the null sink — never handed out.
+        self._free: List[int] = list(range(n_blocks - 1, 0, -1))
+        self._used = 0
+        self._high_water = 0
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_used(self) -> int:
+        return self._used
+
+    @property
+    def high_water(self) -> int:
+        """Peak concurrent blocks in use (capacity-planning stat)."""
+        return self._high_water
+
+    def blocks_for_tokens(self, n_tokens: int) -> int:
+        """Blocks needed to hold ``n_tokens`` cache entries."""
+        return -(-max(n_tokens, 0) // self.block_size)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise OutOfBlocks(
+                f"requested {n} KV blocks, {len(self._free)} free "
+                f"(pool {self.n_blocks - 1} x {self.block_size} tokens)")
+        out = [self._free.pop() for _ in range(n)]
+        self._used += n
+        self._high_water = max(self._high_water, self._used)
+        return out
+
+    def free(self, blocks: List[int]) -> None:
+        for b in blocks:
+            if not 0 < b < self.n_blocks:
+                raise ValueError(f"freeing invalid block id {b}")
+            if b in self._free:
+                raise ValueError(f"double free of block {b}")
+        self._free.extend(blocks)
+        self._used -= len(blocks)
+
+
+@dataclasses.dataclass
+class KVCache:
+    """Device-side paged cache: one K and one V array per model,
+    layer-stacked on the leading dim to match the transformer's
+    scan-over-layers parameter layout."""
+
+    k: Any  # [L, n_blocks, block_size, Hkv, Dh]
+    v: Any  # [L, n_blocks, block_size, Hkv, Dh]
+    block_size: int
+    n_blocks: int
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        # Shapes are static per engine: table width is the worst case.
+        return self.n_blocks
+
+
+def init_kv_cache(cfg, n_blocks: int, block_size: int,
+                  mesh: Optional[Any] = None,
+                  dtype: Optional[Any] = None) -> KVCache:
+    """Allocate the zeroed block pool on device.
+
+    With a mesh, KV heads are sharded over ``tp`` (matching the
+    tp-sharded ``wk``/``wv`` projections so the decode step's cache
+    writes stay local to each tp shard — no resharding on the hot
+    loop, the EQuARX-motivated property of keeping collectives on ICI
+    inside the jitted step).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    dtype = dtype or cfg.dtype
+    shape = (cfg.n_layers, n_blocks, block_size, cfg.n_kv_heads,
+             cfg.head_dim)
+    sharding = None
+    if mesh is not None:
+        tp = mesh.shape.get("tp", 1)
+        if tp > 1 and cfg.n_kv_heads % tp == 0:
+            sharding = NamedSharding(mesh, P(None, None, None, "tp", None))
+    def zeros():
+        return jnp.zeros(shape, dtype)
+    if sharding is not None:
+        k = jax.jit(zeros, out_shardings=sharding)()
+        v = jax.jit(zeros, out_shardings=sharding)()
+    else:
+        k, v = zeros(), zeros()
+    return KVCache(k=k, v=v, block_size=block_size, n_blocks=n_blocks)
+
+
+def pick_bucket(n: int, buckets: Tuple[int, ...]) -> int:
+    """Smallest bucket >= n (buckets ascending). Bucketing pads batch
+    and prompt shapes to a short menu of sizes so the jit cache stays
+    small and hot — the no-per-request-recompilation invariant."""
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"{n} exceeds largest bucket {buckets[-1]}")

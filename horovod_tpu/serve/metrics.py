@@ -1,0 +1,149 @@
+"""Serving metrics: throughput, latency tails, and scheduler health.
+
+The MLPerf TPU-pod scaling writeup (PAPERS.md) motivates reporting
+throughput *and* tail latency as first-class serving metrics — a
+batch-packing change that raises tokens/sec while blowing p99
+first-token latency is a regression for interactive traffic, and
+neither number alone shows it.
+
+Surfaces:
+
+* :meth:`ServeMetrics.snapshot` — counters + percentiles as a flat
+  dict (what ``bench.py`` folds into the round payload).
+* :meth:`ServeMetrics.export_chrome_trace` — per-step spans in the
+  chrome-tracing JSON format, viewable in the same ``chrome://tracing``
+  / Perfetto UI as the host timeline (``hvd.start_timeline`` /
+  ``horovodrun --timeline-filename``). Engine steps additionally run
+  under ``jax.profiler.TraceAnnotation`` (see ``engine.py``) so device
+  traces show ``serve:prefill`` / ``serve:decode`` phases with the
+  same names — the convention :mod:`horovod_tpu.ops.xla_exec` uses for
+  collectives.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional
+
+#: Keep at most this many latency samples per series (drop-oldest);
+#: long-running engines must not grow without bound.
+MAX_SAMPLES = 100_000
+
+
+def percentile(samples: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile (q in [0, 100]); None on no samples."""
+    if not samples:
+        return None
+    xs = sorted(samples)
+    idx = min(len(xs) - 1, max(0, int(round(q / 100.0 * (len(xs) - 1)))))
+    return xs[idx]
+
+
+class ServeMetrics:
+    def __init__(self, clock=time.perf_counter):
+        self._clock = clock
+        self.reset()
+
+    def reset(self) -> None:
+        self.started_at = self._clock()
+        self.tokens_generated = 0
+        self.requests_submitted = 0
+        self.requests_finished = 0
+        self.requests_expired = 0
+        self.requests_rejected = 0
+        self.prefill_steps = 0
+        self.decode_steps = 0
+        self.queue_depth = 0
+        self.max_queue_depth = 0
+        self._occupancy_sum = 0.0
+        self.first_token_s: List[float] = []
+        self.per_token_s: List[float] = []
+        self._events: List[dict] = []
+
+    # -- recording ---------------------------------------------------
+
+    def _span(self, name: str, t0: float, dur: float, **args) -> None:
+        # chrome-tracing "complete" event; ts/dur in microseconds.
+        # Same cap as the latency series: a long-running engine must
+        # not grow host memory step by step.
+        if len(self._events) >= MAX_SAMPLES:
+            return
+        self._events.append({
+            "name": name, "ph": "X", "pid": 0, "tid": 0,
+            "ts": round((t0 - self.started_at) * 1e6, 1),
+            "dur": round(dur * 1e6, 1), "args": args})
+
+    def record_queue_depth(self, depth: int) -> None:
+        self.queue_depth = depth
+        self.max_queue_depth = max(self.max_queue_depth, depth)
+
+    def record_prefill(self, t0: float, dur_s: float, prompt_len: int) -> None:
+        self.prefill_steps += 1
+        self._span("serve:prefill", t0, dur_s, prompt_len=prompt_len)
+
+    def record_decode(self, t0: float, dur_s: float, n_active: int,
+                      max_batch: int) -> None:
+        self.decode_steps += 1
+        self.tokens_generated += n_active
+        self._occupancy_sum += n_active / max_batch
+        if len(self.per_token_s) < MAX_SAMPLES:
+            # Every active sequence advanced one token this step, so
+            # the step wall time IS the per-token latency sample.
+            self.per_token_s.append(dur_s)
+        self._span("serve:decode", t0, dur_s, n_active=n_active)
+
+    def record_first_token(self, latency_s: float) -> None:
+        # The first token comes out of prefill, not a decode step —
+        # count it here so tokens/sec covers all generated tokens.
+        self.tokens_generated += 1
+        if len(self.first_token_s) < MAX_SAMPLES:
+            self.first_token_s.append(latency_s)
+
+    def record_submitted(self) -> None:
+        self.requests_submitted += 1
+
+    def record_finished(self) -> None:
+        self.requests_finished += 1
+
+    def record_expired(self) -> None:
+        self.requests_expired += 1
+
+    def record_rejected(self) -> None:
+        self.requests_rejected += 1
+
+    # -- export ------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, float]:
+        elapsed = max(self._clock() - self.started_at, 1e-9)
+
+        def ms(x):
+            return None if x is None else round(x * 1e3, 3)
+
+        occ = (self._occupancy_sum / self.decode_steps
+               if self.decode_steps else 0.0)
+        return {
+            "elapsed_s": round(elapsed, 3),
+            "tokens_generated": self.tokens_generated,
+            "tokens_per_sec": round(self.tokens_generated / elapsed, 2),
+            "requests_submitted": self.requests_submitted,
+            "requests_finished": self.requests_finished,
+            "requests_expired": self.requests_expired,
+            "requests_rejected": self.requests_rejected,
+            "prefill_steps": self.prefill_steps,
+            "decode_steps": self.decode_steps,
+            "queue_depth": self.queue_depth,
+            "max_queue_depth": self.max_queue_depth,
+            "batch_occupancy": round(occ, 4),
+            "p50_first_token_ms": ms(percentile(self.first_token_s, 50)),
+            "p99_first_token_ms": ms(percentile(self.first_token_s, 99)),
+            "p50_per_token_ms": ms(percentile(self.per_token_s, 50)),
+            "p99_per_token_ms": ms(percentile(self.per_token_s, 99)),
+        }
+
+    def export_chrome_trace(self, path: str) -> None:
+        """Write recorded step spans as a chrome-tracing file (the
+        timeline format the rest of the framework emits)."""
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self._events,
+                       "displayTimeUnit": "ms"}, f)

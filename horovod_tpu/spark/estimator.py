@@ -362,7 +362,13 @@ class TorchEstimator:
             return state, history
 
         results = spark_run(train_fn, num_proc=self.num_proc)
-        state, history = next(r for r in results if r[0] is not None)
+        good = [r for r in results if r is not None and r[0] is not None]
+        if not good:
+            raise RuntimeError(
+                "no Spark task returned trained model state (all "
+                f"{len(results)} ranks yielded None) — check executor "
+                "logs for worker failures")
+        state, history = good[0]
         return TorchModel(model=self.model, state=state,
                           feature_cols=self.feature_cols,
                           label_cols=self.label_cols, history=history,
@@ -564,7 +570,13 @@ class JaxEstimator:
             return state, history
 
         results = spark_run(train_fn, num_proc=self.num_proc)
-        params, history = next(r for r in results if r[0] is not None)
+        good = [r for r in results if r is not None and r[0] is not None]
+        if not good:
+            raise RuntimeError(
+                "no Spark task returned trained params (all "
+                f"{len(results)} ranks yielded None) — check executor "
+                "logs for worker failures")
+        params, history = good[0]
         return JaxModel(apply_fn=self.apply_fn, params=params,
                         feature_cols=self.feature_cols,
                         label_cols=self.label_cols, history=history,

@@ -36,9 +36,11 @@ setup(
     package_data={"horovod_tpu.common": ["libhorovod_tpu_core.so"]},
     install_requires=["numpy", "cloudpickle", "pyyaml"],
     extras_require={
-        # >=0.6: lax.pcast + shard_map axis_names (pinned APIs — the
-        # attention islands use them unconditionally).
-        "jax": ["jax>=0.6", "optax"],
+        # >=0.6 has the modern surface (lax.pcast, shard_map
+        # axis_names); common/jax_compat.py translates down to 0.4.x
+        # (experimental shard_map, no VMA types) with reduced coverage
+        # for the Pallas and partial-manual island paths.
+        "jax": ["jax>=0.4.30", "optax"],
         "torch": ["torch"],
         "ray": ["ray"],
         "spark": ["pyspark"],

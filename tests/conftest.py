@@ -22,6 +22,15 @@ import pytest  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+def pytest_configure(config):
+    # Tier-1 runs `-m 'not slow'`; register the marker so long-running
+    # benchmarks (e.g. the serve mixed-trace comparison) can opt out
+    # without tripping --strict-markers or unknown-marker warnings.
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running benchmark/soak tests excluded from tier-1")
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

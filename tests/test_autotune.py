@@ -8,6 +8,7 @@ import csv
 import json
 import os
 import time
+import pytest
 
 import numpy as np
 
@@ -211,6 +212,8 @@ def test_autotune_csv_carries_categoricals(tmp_path):
         hvd.init()
 
 
+@pytest.mark.slow  # heavy multiprocess spawn; coverage overlaps the
+# fast tier — keeps tier-1 inside its wall-clock budget
 def test_autotune_bayes_multiprocess_cache_shm_flips(tmp_path):
     """np=4 single-host with bayes autotune on a tiny window: the
     tuner explores the cache and shm categoricals mid-run through the

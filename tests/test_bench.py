@@ -51,3 +51,21 @@ def test_previous_bench_absent_or_corrupt(tmp_path):
     assert bench._previous_bench(str(tmp_path)) is None
     (tmp_path / "BENCH_r01.json").write_text("{not json")
     assert bench._previous_bench(str(tmp_path)) is None
+
+
+def test_find_regressions_skips_persisted_regression_subtree():
+    """A round that was itself flagged persists its `regression` gate
+    output; the next round must not flatten it into spurious
+    regression.<metric>.prev comparisons (only real metrics compare)."""
+    prev = {"value": 100.0,
+            "regression": {"extra.busbw.1MB": {"prev": 0.4, "cur": 0.2,
+                                               "drop_pct": 50.0}}}
+    cur = {"value": 99.0,
+           "regression": {"extra.busbw.1MB": {"prev": 0.4, "cur": 0.05,
+                                              "drop_pct": 87.5}}}
+    assert bench.find_regressions(prev, cur) == {}
+    # Nested dicts named `regression` below top level are real metrics
+    # and still compare.
+    prev2 = {"extra": {"regression": {"m": 10.0}}}
+    cur2 = {"extra": {"regression": {"m": 5.0}}}
+    assert "extra.regression.m" in bench.find_regressions(prev2, cur2)

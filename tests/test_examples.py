@@ -6,6 +6,7 @@ import os
 import sys
 
 import numpy as np
+import pytest
 
 from horovod_tpu.runner import run, run_command
 
@@ -71,6 +72,8 @@ def _train_determinstic(n_steps=4):
     return out
 
 
+@pytest.mark.slow  # heavy multiprocess spawn; coverage overlaps the
+# fast tier — keeps tier-1 inside its wall-clock budget
 def test_train_identical_1proc_vs_2proc():
     """The core DistributedOptimizer contract (VERDICT done-criterion):
     the same global batch gives the same trained weights on 1 and N
@@ -128,6 +131,8 @@ def test_lm_pretrain_example_spmd_mesh(tmp_path):
     assert "'dp': 2" in proc.stdout and "'tp': 2" in proc.stdout
 
 
+@pytest.mark.slow  # heavy multiprocess spawn; coverage overlaps the
+# fast tier — keeps tier-1 inside its wall-clock budget
 def test_torch_synthetic_benchmark_2proc(capfd):
     """The reference's headline example protocol runs end-to-end under
     the launcher (tiny model, shrunken iteration counts)."""
@@ -143,6 +148,8 @@ def test_torch_synthetic_benchmark_2proc(capfd):
     assert "Total img/sec on 2 process(es):" in out
 
 
+@pytest.mark.slow  # heavy multiprocess spawn; coverage overlaps the
+# fast tier — keeps tier-1 inside its wall-clock budget
 def test_adasum_fit_example_3proc(capfd):
     """The Adasum curve-fit example (reference examples/adasum tier):
     three ranks with differently-seeded noise must converge on the

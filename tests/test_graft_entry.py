@@ -4,6 +4,7 @@ full sharded training step on the virtual CPU mesh."""
 import sys
 
 import jax
+import pytest
 
 
 def test_entry_jits():
@@ -20,6 +21,10 @@ def test_dryrun_multichip_8(devices):
     g.dryrun_multichip(8)
 
 
+@pytest.mark.slow  # ~2-4 min of CPU compiles; duplicates the
+# multichip_8 gate's configs plus the wide axes — the driver runs the
+# dryrun directly for its MULTICHIP record, so tier-1 keeps only the
+# 8-device gate.
 def test_dryrun_wide_axes_via_driver_path():
     """The driver's exact invocation (fresh interpreter, no jax state):
     the child self-provisions 16 virtual devices and must run the
@@ -35,7 +40,14 @@ def test_dryrun_wide_axes_via_driver_path():
         [sys.executable, "/root/repo/__graft_entry__.py", "8"],
         env=env, capture_output=True, text=True, timeout=900)
     assert proc.returncode == 0, proc.stdout + proc.stderr
-    for tag in ("dense dp/fsdp/sp/tp", "pp", "ep/moe", "pp+ep/moe",
-                "pp-1f1b", "tp4", "sp4"):
+    from horovod_tpu.common import jax_compat
+    tags = ["dense dp/fsdp/sp/tp", "ep/moe", "tp4", "sp4"]
+    if jax_compat.HAS_NEW_SHARD_MAP:
+        # pp islands need modern shard_map; on legacy jax the dryrun
+        # prints an explicit SKIPPED line instead.
+        tags += ["pp", "pp+ep/moe", "pp-1f1b"]
+    else:
+        assert "dryrun[pp*] SKIPPED" in proc.stdout, proc.stdout
+    for tag in tags:
         assert f"dryrun[{tag}]" in proc.stdout, (tag, proc.stdout)
     assert "'tp': 4" in proc.stdout and "'sp': 4" in proc.stdout

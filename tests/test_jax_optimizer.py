@@ -10,6 +10,8 @@ import optax
 import pytest
 from jax.sharding import PartitionSpec as P
 
+from horovod_tpu.common.jax_compat import shard_map
+
 import horovod_tpu.jax as hvd
 from horovod_tpu.runner import run
 
@@ -35,7 +37,7 @@ def test_in_jit_tier_matches_manual_pmean(mesh8):
         return optax.apply_updates(params, updates)
 
     xs = jnp.arange(8.0)
-    out = jax.jit(jax.shard_map(step, mesh=mesh8, in_specs=(P("dp"),),
+    out = jax.jit(shard_map(step, mesh=mesh8, in_specs=(P("dp"),),
                                 out_specs=P()))(xs)
     mean_x = float(xs.mean())
     assert np.allclose(out["w"], np.arange(8.0) - 0.1 * mean_x)
@@ -56,7 +58,7 @@ def test_in_jit_value_and_grad(mesh8):
         return loss, g
 
     xs = jnp.arange(8.0)
-    loss, g = jax.jit(jax.shard_map(
+    loss, g = jax.jit(shard_map(
         step, mesh=mesh8, in_specs=(P(), P("dp")),
         out_specs=(P(), P())))(jnp.float32(2.0), xs)
     assert np.allclose(g, np.asarray(xs).mean())
@@ -73,7 +75,7 @@ def test_in_jit_replicated_cotangent_not_double_counted(mesh8):
         return hvd.allreduce_gradients({"w": g}, axis_name="dp")["w"]
 
     xs = jnp.arange(8.0)
-    g = jax.jit(jax.shard_map(step, mesh=mesh8, in_specs=(P(), P("dp")),
+    g = jax.jit(shard_map(step, mesh=mesh8, in_specs=(P(), P("dp")),
                               out_specs=P()))(jnp.float32(2.0), xs)
     assert np.allclose(g, np.asarray(xs).mean())
 
@@ -130,7 +132,6 @@ def test_eager_compression_bf16():
 def test_in_jit_adasum_gradient_reduction(mesh8):
     """allreduce_gradients(op=Adasum) inside shard_map runs the
     distance-doubling tree per leaf."""
-    from jax import shard_map
 
     from _adasum_model import adasum_fold_model
 
@@ -180,9 +181,9 @@ def test_in_jit_accumulation_matches_big_batch(mesh8):
         return optax.apply_updates(params, updates)
 
     xs = jnp.arange(8.0)
-    out, count = jax.jit(jax.shard_map(
+    out, count = jax.jit(shard_map(
         acc_run, mesh=mesh8, in_specs=(P("dp"),), out_specs=(P(), P())))(xs)
-    ref = jax.jit(jax.shard_map(
+    ref = jax.jit(shard_map(
         ref_run, mesh=mesh8, in_specs=(P("dp"),), out_specs=P()))(xs)
     np.testing.assert_allclose(np.asarray(out["w"]), np.asarray(ref["w"]),
                                rtol=1e-6)
@@ -200,7 +201,7 @@ def test_in_jit_accumulation_holds_between_boundaries(mesh8):
                                     params)
         return updates, state["count"]
 
-    updates, count = jax.jit(jax.shard_map(
+    updates, count = jax.jit(shard_map(
         step, mesh=mesh8, in_specs=(P("dp"),),
         out_specs=(P(), P())))(jnp.arange(8.0))
     np.testing.assert_allclose(np.asarray(updates["w"]), 0.0)
@@ -261,7 +262,7 @@ def test_in_jit_accumulation_under_scan(mesh8):
                              jnp.asarray([1.0, 2.0, 1.0, 2.0]))
         return p
 
-    out = jax.jit(jax.shard_map(run, mesh=mesh8, in_specs=(P("dp"),),
+    out = jax.jit(shard_map(run, mesh=mesh8, in_specs=(P("dp"),),
                                 out_specs=P()))(jnp.arange(8.0))
     # two boundaries, each applying sum(1x+2x) averaged over dp
     mean_x = float(jnp.arange(8.0).mean())

@@ -6,6 +6,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from horovod_tpu.common.jax_compat import shard_map
+
 from horovod_tpu.models import (
     TransformerConfig, init_transformer, transformer_forward, lm_loss,
     make_train_step, resnet50,
@@ -24,7 +26,7 @@ def test_ring_attention_matches_local(devices):
     q, k, v = (jax.random.normal(kk, (B, T, H, D), jnp.float32) for kk in ks)
     ref = local_attention(q, k, v, causal=True)
     spec = P(None, "sp", None, None)
-    ring = jax.jit(jax.shard_map(
+    ring = jax.jit(shard_map(
         lambda a, b, c: ring_self_attention(a, b, c, axis_name="sp"),
         mesh=mesh, in_specs=(spec,) * 3, out_specs=spec))
     out = ring(q, k, v)
@@ -39,7 +41,7 @@ def test_ulysses_attention_matches_local(devices):
     q, k, v = (jax.random.normal(kk, (B, T, H, D), jnp.float32) for kk in ks)
     ref = local_attention(q, k, v, causal=True)
     spec = P(None, "sp", None, None)
-    uly = jax.jit(jax.shard_map(
+    uly = jax.jit(shard_map(
         lambda a, b, c: ulysses_attention(a, b, c, axis_name="sp"),
         mesh=mesh, in_specs=(spec,) * 3, out_specs=spec))
     out = uly(q, k, v)

@@ -89,7 +89,8 @@ def _two_rank_step(compression_name, backward_passes):
     return out
 
 
-@pytest.mark.parametrize("compression", ["none", "fp16"])
+@pytest.mark.parametrize("compression", [
+    "none", pytest.param("fp16", marks=pytest.mark.slow)])
 def test_two_rank_grad_average(compression):
     results = run(_two_rank_step, args=(compression, 1), np=2,
                   env=_WORKER_ENV, start_timeout=90)
@@ -224,6 +225,8 @@ def _zero_grad_guard_worker():
     return raised
 
 
+@pytest.mark.slow  # heavy multiprocess spawn; coverage overlaps the
+# fast tier — keeps tier-1 inside its wall-clock budget
 def test_zero_grad_between_backward_and_step_raises():
     results = run(_zero_grad_guard_worker, np=2, env=_WORKER_ENV,
                   start_timeout=90)
@@ -257,7 +260,8 @@ def _sparse_worker(sparse_as_dense):
     return dense.detach().numpy(), was_sparse
 
 
-@pytest.mark.parametrize("sparse_as_dense", [False, True])
+@pytest.mark.parametrize("sparse_as_dense", [
+    False, pytest.param(True, marks=pytest.mark.slow)])
 def test_sparse_gradients_average(sparse_as_dense):
     from functools import partial
 
@@ -300,6 +304,8 @@ def _sparse_skip_worker():
     return g
 
 
+@pytest.mark.slow  # heavy multiprocess spawn; a sibling variant in
+# the fast tier keeps this coverage — tier-1 wall-clock budget
 def test_sparse_missing_grad_launches_sparse_collective():
     results = run(_sparse_skip_worker, np=2, env=_WORKER_ENV,
                   start_timeout=90)
@@ -347,7 +353,8 @@ def ungrouped_baseline():
                start_timeout=90)
 
 
-@pytest.mark.parametrize("groups_spec", [2, "explicit"])
+@pytest.mark.parametrize("groups_spec", [
+    2, pytest.param("explicit", marks=pytest.mark.slow)])
 def test_groups_match_ungrouped(groups_spec, ungrouped_baseline):
     from functools import partial
 
@@ -404,6 +411,8 @@ def _groups_skip_worker():
     return out
 
 
+@pytest.mark.slow  # heavy multiprocess spawn; a sibling variant in
+# the fast tier keeps this coverage — tier-1 wall-clock budget
 def test_groups_force_complete_on_skip():
     results = run(_groups_skip_worker, np=2, env=_WORKER_ENV,
                   start_timeout=90)
