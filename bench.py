@@ -44,6 +44,12 @@ _T0 = time.perf_counter()
 
 BUS_SIZES_MB = (1, 16, 64)
 BUS_NP = 4
+# Fused-small-tensor case: many gradient-sized tensors enqueued in one
+# cycle, the shape tensor fusion exists for (the Horovod paper credits
+# most of its speedup to exactly this). Reported separately so fusion
+# regressions are visible next to the single-tensor sizes.
+BUS_FUSED_COUNT = 64
+BUS_FUSED_KB = 64
 
 
 def _bus_worker():
@@ -76,6 +82,24 @@ def _bus_worker():
             best_dt = dt if best_dt is None else min(best_dt, dt)
         algbw = (n * 4 * iters / best_dt) / 1e9
         results[f"{mb}MB"] = round(algbw * 2 * (s - 1) / s, 3)
+    # Fused small tensors: one grouped enqueue per iteration, so the
+    # whole batch negotiates in one cycle and packs into one fused
+    # response (64 x 64KB = 4MB, under the default fusion threshold).
+    n_small = BUS_FUSED_KB * 1024 // 4
+    xs = [np.ones(n_small, np.float32) for _ in range(BUS_FUSED_COUNT)]
+    for _ in range(2):
+        hvd.grouped_allreduce(xs, op=hvd.Sum, name="bwf")
+    total = BUS_FUSED_COUNT * n_small * 4
+    iters, best_dt = 10, None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            hvd.grouped_allreduce(xs, op=hvd.Sum, name="bwf")
+        dt = time.perf_counter() - t0
+        best_dt = dt if best_dt is None else min(best_dt, dt)
+    algbw = (total * iters / best_dt) / 1e9
+    results[f"fused_{BUS_FUSED_COUNT}x{BUS_FUSED_KB}KB"] = round(
+        algbw * 2 * (s - 1) / s, 3)
     if r == 0:
         print("BUSBW " + json.dumps(results), flush=True)
     hvd.shutdown()
@@ -423,6 +447,13 @@ def main():
             and budget - (time.perf_counter() - _T0) > 120):
         bus = _bus_bandwidth()
         if bus is not None:
+            # The fused-small-tensor case gets its own key so the
+            # fusion win/loss is legible in the perf trajectory next
+            # to the single-tensor sizes.
+            fused = {k: bus.pop(k) for k in list(bus)
+                     if k.startswith("fused_")}
+            if fused:
+                extra["host_allreduce_busbw_fused_gbps_np4"] = fused
             # Key versioned with the measurement protocol (round 5
             # switched to best-of-3 timing): the regression gate only
             # compares keys present in both rounds, so a protocol

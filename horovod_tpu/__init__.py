@@ -49,6 +49,8 @@ from horovod_tpu.api import (  # noqa: F401
     local_size,
     cross_rank,
     cross_size,
+    reduce_threads,
+    set_reduce_threads,
     allreduce,
     allreduce_async,
     grouped_allreduce,

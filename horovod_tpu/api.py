@@ -24,7 +24,8 @@ __all__ = [
     "allgather_async", "broadcast", "broadcast_async", "alltoall",
     "alltoall_async", "reducescatter", "reducescatter_async", "join",
     "barrier", "synchronize", "poll", "mpi_threads_supported",
-    "start_timeline", "stop_timeline",
+    "start_timeline", "stop_timeline", "reduce_threads",
+    "set_reduce_threads",
 ]
 
 
@@ -70,6 +71,19 @@ def cross_size() -> int:
 def mpi_threads_supported() -> bool:
     # No MPI underneath; the native controller is always thread-safe.
     return True
+
+
+def reduce_threads() -> int:
+    """Current host data-plane reduction thread budget (see
+    ``docs/perf_tuning.md``; set via ``HOROVOD_REDUCE_THREADS`` or the
+    autotuner)."""
+    return get_runtime().reduce_threads()
+
+
+def set_reduce_threads(n: int) -> None:
+    """Override this process's host-reduction thread budget at runtime
+    (bitwise-safe at any value; clamped to [1, 64])."""
+    get_runtime().set_reduce_threads(n)
 
 
 def _resolve_op(op: Optional[ReduceOp], average: Optional[bool]) -> ReduceOp:

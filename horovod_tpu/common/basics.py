@@ -117,7 +117,7 @@ def load_library() -> ctypes.CDLL:
                       "to build it from")
     lib = ctypes.CDLL(path)
 
-    ABI_VERSION = 3
+    ABI_VERSION = 4
     try:
         got = lib.hvd_abi_version()
     except AttributeError:
@@ -167,6 +167,19 @@ def load_library() -> ctypes.CDLL:
     lib.hvd_start_timeline.argtypes = [ctypes.c_char_p]
     lib.hvd_stop_timeline.restype = None
     lib.hvd_pending_count.restype = ctypes.c_int64
+    # Host reduction kernels + thread budget (perf_tuning.md): exercised
+    # directly by the dtype-coverage tests and exposed through
+    # hvd.set_reduce_threads / hvd.reduce_threads.
+    lib.hvd_host_accumulate.restype = None
+    lib.hvd_host_accumulate.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_int64]
+    lib.hvd_host_scale.restype = None
+    lib.hvd_host_scale.argtypes = [ctypes.c_int, ctypes.c_void_p,
+                                   ctypes.c_int64, ctypes.c_double]
+    lib.hvd_set_reduce_threads.restype = None
+    lib.hvd_set_reduce_threads.argtypes = [ctypes.c_int]
+    lib.hvd_reduce_threads.restype = ctypes.c_int
     return lib
 
 

@@ -335,6 +335,22 @@ class Runtime:
         self._check_init()
         return self.lib.hvd_cross_size()
 
+    def reduce_threads(self) -> int:
+        """Worker threads the host data plane currently spreads its
+        reductions and pack/unpack copies over (``docs/perf_tuning.md``).
+        Reflects the coordinator-synced ``HOROVOD_REDUCE_THREADS`` value
+        and any autotuned retarget."""
+        self._check_init()
+        return int(self.lib.hvd_reduce_threads())
+
+    def set_reduce_threads(self, n: int) -> None:
+        """Retarget the host-reduction thread budget of THIS process
+        (clamped to [1, 64]). Results are bitwise identical at any
+        setting, so a per-rank override is always safe — unlike the
+        protocol knobs, no cross-rank agreement is needed."""
+        self._check_init()
+        self.lib.hvd_set_reduce_threads(int(n))
+
     def _check_init(self) -> None:
         if not self.initialized():
             raise RuntimeError(

@@ -53,6 +53,10 @@ class BlockAllocator:
         self.block_size = block_size
         # Block 0 is the null sink — never handed out.
         self._free: List[int] = list(range(n_blocks - 1, 0, -1))
+        # Mirror of _free for O(1) double-free checks: retiring a long
+        # sequence against a mostly-free pool was O(freed x n_free)
+        # inside the engine's step loop with the list scan.
+        self._free_set = set(self._free)
         self._used = 0
         self._high_water = 0
 
@@ -82,17 +86,22 @@ class BlockAllocator:
                 f"requested {n} KV blocks, {len(self._free)} free "
                 f"(pool {self.n_blocks - 1} x {self.block_size} tokens)")
         out = [self._free.pop() for _ in range(n)]
+        self._free_set.difference_update(out)
         self._used += n
         self._high_water = max(self._high_water, self._used)
         return out
 
     def free(self, blocks: List[int]) -> None:
+        seen = set()
         for b in blocks:
             if not 0 < b < self.n_blocks:
                 raise ValueError(f"freeing invalid block id {b}")
-            if b in self._free:
+            if b in self._free_set or b in seen:
                 raise ValueError(f"double free of block {b}")
+            seen.add(b)
+        # Validate-all-then-mutate: the pool is untouched on error.
         self._free.extend(blocks)
+        self._free_set.update(blocks)
         self._used -= len(blocks)
 
 

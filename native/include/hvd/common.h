@@ -161,6 +161,11 @@ constexpr const char* ACT_MEMCPY_IN_FUSION_BUFFER = "MEMCPY_IN_FUSION_BUFFER";
 constexpr const char* ACT_MEMCPY_OUT_FUSION_BUFFER = "MEMCPY_OUT_FUSION_BUFFER";
 constexpr const char* ACT_TCP_ALLREDUCE = "TCP_ALLREDUCE";
 constexpr const char* ACT_SHM_ALLREDUCE = "SHM_ALLREDUCE";
+// Per-segment phases of the pipelined shm allreduce — distinct names
+// so a stalled pipeline stage is attributable from the timeline alone.
+constexpr const char* ACT_SHM_PACK = "SHM_PACK";
+constexpr const char* ACT_SHM_REDUCE = "SHM_REDUCE";
+constexpr const char* ACT_SHM_UNPACK = "SHM_UNPACK";
 constexpr const char* ACT_SHM_ALLGATHER = "SHM_ALLGATHER";
 constexpr const char* ACT_SHM_BROADCAST = "SHM_BROADCAST";
 constexpr const char* ACT_SHM_ALLTOALL = "SHM_ALLTOALL";

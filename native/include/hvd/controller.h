@@ -126,6 +126,8 @@ class Controller {
   bool shm_enabled_ = false;
   bool shm_wish_ = false;
   int64_t shm_segment_bytes_ = 8 * 1024 * 1024;
+  int shm_segment_depth_ = 2;
+  int reduce_threads_ = 1;
 
  public:
   void SetFusionThreshold(int64_t bytes) { fusion_threshold_bytes_ = bytes; }
@@ -140,6 +142,22 @@ class Controller {
   // arena deadlocks.
   void SetShmSegmentBytes(int64_t bytes) { shm_segment_bytes_ = bytes; }
   int64_t shm_segment_bytes() const { return shm_segment_bytes_; }
+  // Shm pipeline depth: in-flight segment regions per arena slot
+  // (1 = the pre-pipeline sequential schedule). Synced like the
+  // segment size — region indices and per-op barrier counts derive
+  // from it, so divergence deadlocks the arena.
+  void SetShmSegmentDepth(int depth) {
+    shm_segment_depth_ = depth < 1 ? 1 : (depth > 8 ? 8 : depth);
+  }
+  int shm_segment_depth() const { return shm_segment_depth_; }
+  // Host-reduction worker threads (HOROVOD_REDUCE_THREADS). A pure
+  // per-rank perf knob — no protocol agreement needed — but synced
+  // anyway so the autotuner's choice applies fleet-wide and the CSV
+  // log reflects what every rank actually ran.
+  void SetReduceThreads(int n) {
+    reduce_threads_ = n < 1 ? 1 : (n > 64 ? 64 : n);
+  }
+  int reduce_threads() const { return reduce_threads_; }
   // Hierarchical allreduce: rank 0's env decides the request; the
   // value is only TRUE after Initialize when every rank's topology
   // fits the node-major layout (the verdict is broadcast — a per-rank
@@ -167,12 +185,15 @@ class Controller {
   // ResponseList so every rank applies them on the same cycle.
   void StageTunedParams(int64_t fusion, double cycle_ms,
                         int hierarchical = -1, int cache = -1,
-                        int shm = -1) {
+                        int shm = -1, int reduce_threads = 0,
+                        int seg_depth = 0) {
     staged_fusion_ = fusion;
     staged_cycle_ms_ = cycle_ms;
     staged_hier_ = hierarchical;
     staged_cache_ = cache;
     staged_shm_ = shm;
+    staged_threads_ = reduce_threads;
+    staged_depth_ = seg_depth;
   }
   // Autotuned runtime switches consulted by the data plane / cache
   // path each cycle (distinct from the INIT verdicts shm_enabled()
@@ -195,6 +216,8 @@ class Controller {
   int staged_hier_ = -1;
   int staged_cache_ = -1;
   int staged_shm_ = -1;
+  int staged_threads_ = 0;  // 0 = no change
+  int staged_depth_ = 0;    // 0 = no change
   bool cache_active_ = true;
   bool shm_active_ = true;
 };

@@ -1,0 +1,61 @@
+// Sanitized environment-knob parsing.
+//
+// atoll/atof silently map garbage to 0, and several knobs treat 0 (or
+// negative) as a live value — HOROVOD_RING_THRESHOLD=garbage would
+// quietly route every payload onto the ring, and a malformed
+// HOROVOD_SHM_TIMEOUT_SECONDS would poison the arena on the first
+// barrier. These helpers validate the full string, clamp to the knob's
+// legal range, and warn ONCE per knob per process before falling back
+// to the default (an op-path caller must not re-warn every cycle).
+#pragma once
+
+#include <cstdlib>
+#include <mutex>
+#include <set>
+#include <string>
+
+#include "hvd/logging.h"
+
+namespace hvd {
+
+inline bool EnvWarnOnce(const std::string& name) {
+  static std::mutex mu;
+  static std::set<std::string>* warned = new std::set<std::string>();
+  std::lock_guard<std::mutex> lock(mu);
+  return warned->insert(name).second;
+}
+
+// Integer knob: the whole value must parse and land in [lo, hi].
+inline int64_t EnvInt64Sane(const char* name, int64_t dflt, int64_t lo,
+                            int64_t hi) {
+  const char* v = std::getenv(name);
+  if (v == nullptr || *v == '\0') return dflt;
+  char* end = nullptr;
+  long long parsed = std::strtoll(v, &end, 10);
+  if (end == v || *end != '\0' || parsed < lo || parsed > hi) {
+    if (EnvWarnOnce(name))
+      LOG_WARNING << "ignoring invalid " << name << "=" << v
+                  << " (want an integer in [" << lo << ", " << hi
+                  << "]); using default " << dflt;
+    return dflt;
+  }
+  return parsed;
+}
+
+// Float knob: must parse fully and be strictly positive (every double
+// knob here is a duration/period).
+inline double EnvDoubleSane(const char* name, double dflt) {
+  const char* v = std::getenv(name);
+  if (v == nullptr || *v == '\0') return dflt;
+  char* end = nullptr;
+  double parsed = std::strtod(v, &end);
+  if (end == v || *end != '\0' || !(parsed > 0)) {
+    if (EnvWarnOnce(name))
+      LOG_WARNING << "ignoring invalid " << name << "=" << v
+                  << " (want a positive number); using default " << dflt;
+    return dflt;
+  }
+  return parsed;
+}
+
+}  // namespace hvd

@@ -123,6 +123,8 @@ struct ResponseList {
   int8_t tuned_hierarchical = -1;  // -1 = no change, 0/1 = new value
   int8_t tuned_cache = -1;         // response-cache enablement flip
   int8_t tuned_shm = -1;           // single-host shm data-plane flip
+  int32_t tuned_reduce_threads = 0;   // host-reduction worker threads
+  int32_t tuned_seg_depth = 0;        // shm pipeline depth (regions/slot)
 
   void SerializeTo(std::string* out) const;
   static bool ParseFrom(const std::string& buf, ResponseList* out);

@@ -72,6 +72,9 @@ class TcpOps : public OpExecutor {
 
   // Allreduce algorithms over the contributor set `ranks` (my position
   // is `p`). All operate in place on the packed fusion buffer.
+  // The reduce-scatter phase pipelines its steps: the recv of chunk
+  // k+1 drains in a helper thread while chunk k accumulates (also the
+  // backbone of Reducescatter's ring).
   Status RingReduceScatterPhase(uint8_t* buf,
                                 const std::vector<int64_t>& offs,
                                 DataType dtype, ReduceOp op,
@@ -100,17 +103,15 @@ class TcpOps : public OpExecutor {
                          const std::vector<int64_t>& tensor_elems,
                          const std::vector<int>& ranks, int p);
   // Single-host jobs: reduce through the shared-memory arena instead
-  // of loopback TCP. ShmAllreduceFused drives the whole fused
-  // response SEGMENTED (pack -> ShmAllreduce -> unpack per segment,
-  // three barriers each, entry slices copied straight between user
-  // buffers and the arena — no fusion buffer); ShmAllreduce reduces
-  // one already-published region (slot copy -> per-rank chunk
-  // reduction into slot 0; two barriers, caller runs the release).
+  // of loopback TCP. Drives the whole fused response as a segmented,
+  // double-buffered pipeline (HOROVOD_SHM_SEGMENT_DEPTH regions per
+  // slot + a dedicated result slot at slot(size)): segment k+1 packs
+  // while k reduces and k-1 unpacks on slower ranks, one barrier per
+  // segment at depth >= 2. Entry slices are copied straight between
+  // user buffers and the arena — no fusion buffer.
   Status ShmAllreduceFused(const Response& r,
                            std::vector<TensorTableEntry>& entries,
                            int64_t total_elems, DataType dtype, int size);
-  Status ShmAllreduce(uint8_t* buf, int64_t elems, DataType dtype,
-                      ReduceOp op);
   // Per-NODE arena eligibility (hierarchical allgather): arena exists,
   // full world contributes, gathered payload fits a slot.
   bool NodeShmEligible(int64_t payload_bytes, Status* err);

@@ -64,6 +64,22 @@ class ParameterManager {
     SetCategoricalTunable(kCatHier, fit, current);
   }
 
+  // Host data-plane knobs (bayes mode): reduction worker threads
+  // (searched over [1, max_threads] in log2 space; fixed when the
+  // host has nothing to offer, max_threads <= 1) and the shm
+  // pipeline's segment depth ([1, 8]; offered only when the shm
+  // arena is up — `depth_available`). Call after Initialize.
+  void SetHostTunables(int threads, int max_threads, int depth,
+                       bool depth_available);
+  int reduce_threads() const { return threads_; }
+  int seg_depth() const { return depth_; }
+  // Whether the search actually owns each host knob: values are only
+  // staged onto the broadcast when true, so an untuned knob never
+  // clobbers a runtime override (hvd.set_reduce_threads) or a
+  // climb-mode job's env setting.
+  bool threads_tunable() const { return tune_threads_; }
+  bool depth_tunable() const { return tune_depth_; }
+
   // Record traffic finished this cycle (coordinator side).
   void Record(int64_t bytes);
 
@@ -97,6 +113,13 @@ class ParameterManager {
   int cat_[kNumCategoricals] = {0, 0, 0};   // current values
   bool cat_tunable_[kNumCategoricals] = {false, false, false};
 
+  // Host data-plane continuous knobs (log2-mapped like fusion/cycle).
+  int threads_ = 1;
+  int max_threads_ = 1;
+  int depth_ = 2;
+  bool tune_threads_ = false;
+  bool tune_depth_ = false;
+
   // Measurement window.
   double window_secs_ = 1.0;
   double window_start_ = -1.0;
@@ -116,6 +139,8 @@ class ParameterManager {
   int64_t best_fusion_ = 0;
   double best_cycle_ms_ = 0.0;
   int best_cat_[kNumCategoricals] = {0, 0, 0};
+  int best_threads_ = 1;
+  int best_depth_ = 2;
 
   std::ofstream log_;
 };

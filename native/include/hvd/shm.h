@@ -28,8 +28,12 @@ class ShmArena {
   // nullptr when shm is unavailable (create/map failure) — callers
   // fall back to TCP. `tag` must be identical on every rank of the
   // job and unique per job instance (controller addr + elastic epoch).
+  // `extra_slots` appends scratch slots past the per-rank ones (the
+  // allreduce pipeline's result slot lives at slot(nranks)); every
+  // rank must pass the same value or the mappings disagree on size.
   static std::unique_ptr<ShmArena> Create(const std::string& tag, int rank,
-                                          int nranks, int64_t slot_bytes);
+                                          int nranks, int64_t slot_bytes,
+                                          int extra_slots = 0);
   ~ShmArena();
 
   int64_t slot_bytes() const { return slot_bytes_; }

@@ -1,0 +1,88 @@
+// Intra-process worker pool for the host data plane.
+//
+// The reference offloads host reductions to MPI/Gloo's internals; this
+// rebuild runs them in-process, so the memory-bound inner kernels
+// (HostAccumulate / HostScale / the pack-unpack memcpys) need their own
+// parallelism to reach memcpy-class bandwidth. One process-wide pool
+// (the analog of Gloo's per-context worker threads) serves every op:
+// the background coordination thread is the only dispatcher, callers
+// block until their region completes, and an atomic part counter gives
+// work-stealing across the split so a preempted worker never idles the
+// rest (the bench hosts oversubscribe ranks onto few cores).
+//
+// The thread COUNT is a runtime knob (HOROVOD_REDUCE_THREADS, autotuned
+// alongside cycle time / fusion threshold): it is read per ParallelFor
+// call, so a tuned value applies from the next op onward without
+// recreating anything. Workers spawn lazily on first use and park on a
+// condition variable between jobs.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace hvd {
+
+class WorkerPool {
+ public:
+  // Process-wide pool (leaked singleton: workers park in cv-wait at
+  // exit, joining them during static teardown would deadlock).
+  static WorkerPool& Get();
+
+  // Runs fn(lo, hi) over [0, n) split into `parts` contiguous ranges
+  // executed by up to `parts` threads (the caller participates, so
+  // parts == 1 is a plain inline call with no locking). Blocks until
+  // every range completed. Ranges partition [0, n) exactly, so
+  // element-wise kernels produce bitwise-identical results at any
+  // thread count. Serializes concurrent callers (one job at a time).
+  void ParallelFor(int parts, int64_t n,
+                   const std::function<void(int64_t, int64_t)>& fn);
+
+ private:
+  WorkerPool() = default;
+  void EnsureWorkers(int n);
+  void WorkerLoop();
+  // Claims + runs one range of the job generation `seq`; false when
+  // none left or the live job is a different generation.
+  bool RunOnePart(uint32_t seq);
+
+  std::mutex caller_mu_;  // one ParallelFor at a time
+  std::mutex mu_;
+  std::condition_variable cv_work_;
+  std::condition_variable cv_done_;
+  std::vector<std::thread> workers_;
+  uint32_t job_seq_ = 0;  // bumped per job (guarded by mu_)
+  // Claim ticket: (job seq << 32) | next part index, and the matching
+  // generation-stamped part bound (job seq << 32 | parts). Stamping
+  // BOTH with the generation makes a stale worker's claim fail
+  // instead of racing the next job's publish (see RunOnePart).
+  std::atomic<uint64_t> ticket_{0};
+  std::atomic<uint64_t> bounds_{0};
+  std::atomic<int64_t> job_n_{0};
+  const std::function<void(int64_t, int64_t)>* job_fn_ = nullptr;
+  int done_parts_ = 0;  // guarded by mu_
+};
+
+// Process-wide host-reduction thread budget consulted by
+// HostAccumulate / HostScale / the data plane's bulk copies. Clamped
+// to [1, 64]. Set at init from HOROVOD_REDUCE_THREADS (default:
+// hardware threads / local_size, capped at 8) and retargeted by the
+// autotuner via the tuned-params broadcast.
+int HostReduceThreads();
+void SetHostReduceThreads(int n);
+
+// Splits a `bytes`-sized elementwise job into at most
+// HostReduceThreads() parts of >= kMinParallelBytes each; 1 means
+// "run inline" (small payloads never pay the fork-join handshake).
+constexpr int64_t kMinParallelBytes = 256 * 1024;
+int ParallelParts(int64_t bytes);
+
+// memcpy spread across the pool (large pack/unpack copies are the
+// other half of the host data plane's critical path).
+void ParallelMemcpy(void* dst, const void* src, int64_t bytes);
+
+}  // namespace hvd

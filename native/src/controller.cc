@@ -505,7 +505,9 @@ Status TcpController::Initialize() {
                          (shm_enabled_ ? "1" : "0") + ":" +
                          (hierarchical_fit_ ? "1" : "0") + ":" +
                          (shm_wish_ ? "1" : "0") + ":" +
-                         std::to_string(shm_segment_bytes_);
+                         std::to_string(shm_segment_bytes_) + ":" +
+                         std::to_string(shm_segment_depth_) + ":" +
+                         std::to_string(reduce_threads_);
     for (int peer = 1; peer < size_; ++peer) {
       if (!ctrl_conns_[peer].SendFrame(params))
         return Status::UnknownError("param sync: lost control link");
@@ -526,7 +528,9 @@ Status TcpController::Initialize() {
     auto c4 = c3 == std::string::npos ? c3 : params.find(':', c3 + 1);
     auto c5 = c4 == std::string::npos ? c4 : params.find(':', c4 + 1);
     auto c6 = c5 == std::string::npos ? c5 : params.find(':', c5 + 1);
-    if (!ok || c6 == std::string::npos)
+    auto c7 = c6 == std::string::npos ? c6 : params.find(':', c6 + 1);
+    auto c8 = c7 == std::string::npos ? c7 : params.find(':', c7 + 1);
+    if (!ok || c8 == std::string::npos)
       return Status::UnknownError("param sync: lost control link");
     fusion_threshold_bytes_ = std::atoll(params.c_str());
     ring_threshold_bytes_ = std::atoll(params.c_str() + c1 + 1);
@@ -535,6 +539,8 @@ Status TcpController::Initialize() {
     hierarchical_fit_ = params[c4 + 1] == '1';
     shm_wish_ = params[c5 + 1] == '1';
     shm_segment_bytes_ = std::atoll(params.c_str() + c6 + 1);
+    SetShmSegmentDepth(std::atoi(params.c_str() + c7 + 1));
+    SetReduceThreads(std::atoi(params.c_str() + c8 + 1));
   }
   return Status::OK();
 }
@@ -900,11 +906,15 @@ void TcpController::Broadcast(ResponseList& list) {
     list.tuned_hierarchical = static_cast<int8_t>(staged_hier_);
     list.tuned_cache = static_cast<int8_t>(staged_cache_);
     list.tuned_shm = static_cast<int8_t>(staged_shm_);
+    list.tuned_reduce_threads = staged_threads_;
+    list.tuned_seg_depth = staged_depth_;
     staged_fusion_ = 0;
     staged_cycle_ms_ = 0.0;
     staged_hier_ = -1;
     staged_cache_ = -1;
     staged_shm_ = -1;
+    staged_threads_ = 0;
+    staged_depth_ = 0;
   }
   std::string buf;
   list.SerializeTo(&buf);

@@ -206,7 +206,7 @@ bool Response::ParseFrom(const char** p, const char* end, Response* r) {
 }
 
 void ResponseList::SerializeTo(std::string* out) const {
-  WriteScalar<uint8_t>(out, 3);  // version
+  WriteScalar<uint8_t>(out, 4);  // version
   WriteScalar<uint8_t>(out, shutdown ? 1 : 0);
   WriteScalar<uint8_t>(out, purge_cache ? 1 : 0);
   WriteScalar<int64_t>(out, tuned_fusion_threshold);
@@ -214,6 +214,8 @@ void ResponseList::SerializeTo(std::string* out) const {
   WriteScalar<int8_t>(out, tuned_hierarchical);
   WriteScalar<int8_t>(out, tuned_cache);
   WriteScalar<int8_t>(out, tuned_shm);
+  WriteScalar<int32_t>(out, tuned_reduce_threads);
+  WriteScalar<int32_t>(out, tuned_seg_depth);
   WriteScalar<uint32_t>(out, static_cast<uint32_t>(responses.size()));
   for (const auto& r : responses) r.SerializeTo(out);
 }
@@ -222,7 +224,7 @@ bool ResponseList::ParseFrom(const std::string& buf, ResponseList* out) {
   const char* p = buf.data();
   const char* end = p + buf.size();
   uint8_t ver, sd, pc;
-  if (!ReadScalar(&p, end, &ver) || ver != 3) return false;
+  if (!ReadScalar(&p, end, &ver) || ver != 4) return false;
   if (!ReadScalar(&p, end, &sd)) return false;
   out->shutdown = sd != 0;
   if (!ReadScalar(&p, end, &pc)) return false;
@@ -232,6 +234,8 @@ bool ResponseList::ParseFrom(const std::string& buf, ResponseList* out) {
   if (!ReadScalar(&p, end, &out->tuned_hierarchical)) return false;
   if (!ReadScalar(&p, end, &out->tuned_cache)) return false;
   if (!ReadScalar(&p, end, &out->tuned_shm)) return false;
+  if (!ReadScalar(&p, end, &out->tuned_reduce_threads)) return false;
+  if (!ReadScalar(&p, end, &out->tuned_seg_depth)) return false;
   uint32_t n;
   if (!ReadScalar(&p, end, &n)) return false;
   out->responses.resize(n);

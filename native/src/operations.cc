@@ -26,6 +26,7 @@
 
 #include "hvd/common.h"
 #include "hvd/controller.h"
+#include "hvd/env.h"
 #include "hvd/fusion_buffer.h"
 #include "hvd/logging.h"
 #include "hvd/message.h"
@@ -35,6 +36,7 @@
 #include "hvd/response_cache.h"
 #include "hvd/stall_inspector.h"
 #include "hvd/tensor_queue.h"
+#include "hvd/thread_pool.h"
 #include "hvd/timeline.h"
 
 namespace hvd {
@@ -309,6 +311,15 @@ void BackgroundThreadLoop(GlobalState& st) {
         st.cycle_time_ms = list.tuned_cycle_time_ms;
       if (list.tuned_hierarchical >= 0)
         st.controller->SetHierarchical(list.tuned_hierarchical != 0);
+      if (list.tuned_reduce_threads > 0) {
+        st.controller->SetReduceThreads(list.tuned_reduce_threads);
+        SetHostReduceThreads(st.controller->reduce_threads());
+      }
+      // Depth changes region indices and barrier counts — like
+      // hierarchical, it must be live before this cycle's responses
+      // execute or the arena desyncs.
+      if (list.tuned_seg_depth > 0)
+        st.controller->SetShmSegmentDepth(list.tuned_seg_depth);
     }
     for (const auto& resp : list.responses) PerformOperation(st, resp);
     if (list.shutdown) break;
@@ -340,10 +351,25 @@ void BackgroundThreadLoop(GlobalState& st) {
         if (st.param_manager.categorical_tunable(PM::kCatShm))
           st.controller->SetShmActive(
               st.param_manager.categorical(PM::kCatShm));
+        // Stage host knobs only when the search owns them: an untuned
+        // knob staged every window would clobber runtime overrides
+        // (hvd.set_reduce_threads) with the stale init-time value.
+        int tuned_threads = 0, tuned_depth = 0;
+        if (st.param_manager.threads_tunable()) {
+          st.controller->SetReduceThreads(
+              st.param_manager.reduce_threads());
+          SetHostReduceThreads(st.controller->reduce_threads());
+          tuned_threads = st.controller->reduce_threads();
+        }
+        if (st.param_manager.depth_tunable()) {
+          st.controller->SetShmSegmentDepth(st.param_manager.seg_depth());
+          tuned_depth = st.controller->shm_segment_depth();
+        }
         st.controller->StageTunedParams(
             st.param_manager.fusion_threshold(),
             st.param_manager.cycle_time_ms(), cat(PM::kCatHier),
-            cat(PM::kCatCache), cat(PM::kCatShm));
+            cat(PM::kCatCache), cat(PM::kCatShm), tuned_threads,
+            tuned_depth);
       }
     }
     auto elapsed = std::chrono::steady_clock::now() - cycle_start;
@@ -449,17 +475,37 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   }
   st.controller->SetFusionThreshold(
       hvd::EnvInt64("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024));
-  st.controller->SetRingThreshold(
-      hvd::EnvInt64("HOROVOD_RING_THRESHOLD", 64 * 1024));
-  st.controller->SetShmSegmentBytes(std::max<int64_t>(
-      4096,
-      hvd::EnvInt64("HOROVOD_SHM_SEGMENT_BYTES", 8 * 1024 * 1024)));
+  // Sanitized parses (warn once + default): atoll's silent 0 for
+  // garbage would route every payload onto the ring / shrink the shm
+  // segment to its floor without a trace.
+  st.controller->SetRingThreshold(hvd::EnvInt64Sane(
+      "HOROVOD_RING_THRESHOLD", 64 * 1024, 0, int64_t(1) << 40));
+  st.controller->SetShmSegmentBytes(hvd::EnvInt64Sane(
+      "HOROVOD_SHM_SEGMENT_BYTES", 8 * 1024 * 1024, 4096,
+      int64_t(1) << 34));
+  st.controller->SetShmSegmentDepth(static_cast<int>(
+      hvd::EnvInt64Sane("HOROVOD_SHM_SEGMENT_DEPTH", 2, 1, 8)));
+  // Host-reduction worker threads: default leaves every co-located
+  // rank its fair share of the machine (cores / local_size, capped at
+  // 8) so the pool speeds reductions up instead of oversubscribing
+  // the box the ranks already timeshare.
+  {
+    const int hw = static_cast<int>(std::thread::hardware_concurrency());
+    const int dflt =
+        std::max(1, std::min(8, hw / std::max(1, local_size)));
+    st.controller->SetReduceThreads(static_cast<int>(
+        hvd::EnvInt64Sane("HOROVOD_REDUCE_THREADS", dflt, 1, 64)));
+  }
   st.controller->SetTopology(local_rank, local_size, cross_rank, cross_size);
   st.controller->SetHierarchical(
       hvd::EnvInt64("HOROVOD_HIERARCHICAL_ALLREDUCE", 0) != 0);
   st.controller->SetShmEnabled(
       size > 1 && std::getenv("HOROVOD_SHM_DISABLE") == nullptr);
   hvd::Status s = st.controller->Initialize();
+  // The pool's budget follows the controller's POST-SYNC value: rank
+  // 0's knob (env or default) reaches every rank through the param
+  // sync, the same discipline as the thresholds.
+  hvd::SetHostReduceThreads(st.controller->reduce_threads());
   if (s.ok() && std::getenv("HOROVOD_SHM_DISABLE") != nullptr &&
       (st.controller->shm_enabled() ||
        st.controller->node_shm_applicable())) {
@@ -490,6 +536,15 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
     st.param_manager.SetCategoricalTunable(
         PM::kCatShm, st.controller->shm_enabled() && size > 1,
         st.controller->shm_enabled() && st.controller->shm_active());
+    // Host data-plane knobs join the search: threads over [1, what
+    // the machine can offer], pipeline depth only when a shm arena
+    // is actually in play.
+    const int hw = static_cast<int>(std::thread::hardware_concurrency());
+    st.param_manager.SetHostTunables(
+        st.controller->reduce_threads(),
+        std::max(st.controller->reduce_threads(), std::min(16, hw)),
+        st.controller->shm_segment_depth(),
+        st.controller->shm_enabled() && size > 1);
   }
   if (!s.ok()) {
     LOG_ERROR << "controller init failed: " << s.reason();
@@ -521,7 +576,9 @@ void hvd_shutdown() {
 
 // Bump whenever the callback signatures or the wire format change; the
 // Python bridge refuses to load a library whose version disagrees.
-int hvd_abi_version() { return 3; }
+// v4: ResponseList tuned_reduce_threads/tuned_seg_depth + host kernel
+// entry points.
+int hvd_abi_version() { return 4; }
 
 int hvd_initialized() { return hvd::State().initialized.load() ? 1 : 0; }
 int hvd_rank() { return hvd::State().rank; }
@@ -679,6 +736,23 @@ void hvd_stop_timeline() { hvd::State().timeline.Shutdown(); }
 int64_t hvd_pending_count() {
   return static_cast<int64_t>(hvd::State().tensor_queue.size());
 }
+
+// Direct host-kernel entry points: the dtype/op matrix is verified
+// against numpy references through ctypes (tests/test_host_kernels.py)
+// — including the threaded chunked path, which must be bitwise
+// identical to single-threaded at every size.
+void hvd_host_accumulate(int op, int dtype, const void* src, void* dst,
+                         int64_t count) {
+  hvd::HostAccumulate(static_cast<hvd::ReduceOp>(op),
+                      static_cast<hvd::DataType>(dtype), src, dst, count);
+}
+
+void hvd_host_scale(int dtype, void* dst, int64_t count, double factor) {
+  hvd::HostScale(static_cast<hvd::DataType>(dtype), dst, count, factor);
+}
+
+void hvd_set_reduce_threads(int n) { hvd::SetHostReduceThreads(n); }
+int hvd_reduce_threads() { return hvd::HostReduceThreads(); }
 
 // Test hooks: drive the Bayesian autotune optimizer (hvd/bayesian.h)
 // against a caller-provided objective, so tests can assert global

@@ -1,12 +1,16 @@
 #include "hvd/ops.h"
 
 #include <algorithm>
+#include <atomic>
 #include <cstdlib>
 #include <cstring>
+#include <thread>
 #include <vector>
 
+#include "hvd/env.h"
 #include "hvd/half.h"
 #include "hvd/logging.h"
+#include "hvd/thread_pool.h"
 
 namespace hvd {
 
@@ -14,6 +18,8 @@ namespace {
 
 template <typename T>
 void AccumulateTyped(ReduceOp op, const T* src, T* dst, int64_t n) {
+  // One tight loop per op: the switch stays outside so the bodies are
+  // plain elementwise loops the compiler can vectorize.
   switch (op) {
     case ReduceOp::AVERAGE:
     case ReduceOp::SUM:
@@ -32,25 +38,38 @@ void AccumulateTyped(ReduceOp op, const T* src, T* dst, int64_t n) {
   }
 }
 
+// 16-bit floats reduce through f32. The combine is hoisted out of the
+// loop (per-op loops, not a per-element switch) so the bf16 path —
+// whose conversions are branch-free shifts — vectorizes.
+template <float (*ToF)(uint16_t), uint16_t (*FromF)(float), typename F>
+inline void Map16(const uint16_t* src, uint16_t* dst, int64_t n, F f) {
+  for (int64_t i = 0; i < n; ++i) dst[i] = FromF(f(ToF(dst[i]), ToF(src[i])));
+}
+
 template <float (*ToF)(uint16_t), uint16_t (*FromF)(float)>
 void Accumulate16(ReduceOp op, const uint16_t* src, uint16_t* dst, int64_t n) {
-  for (int64_t i = 0; i < n; ++i) {
-    float a = ToF(dst[i]), b = ToF(src[i]);
-    float r;
-    switch (op) {
-      case ReduceOp::MIN: r = std::min(a, b); break;
-      case ReduceOp::MAX: r = std::max(a, b); break;
-      case ReduceOp::PRODUCT: r = a * b; break;
-      default: r = a + b; break;
-    }
-    dst[i] = FromF(r);
+  switch (op) {
+    case ReduceOp::MIN:
+      Map16<ToF, FromF>(src, dst, n,
+                        [](float a, float b) { return std::min(a, b); });
+      break;
+    case ReduceOp::MAX:
+      Map16<ToF, FromF>(src, dst, n,
+                        [](float a, float b) { return std::max(a, b); });
+      break;
+    case ReduceOp::PRODUCT:
+      Map16<ToF, FromF>(src, dst, n,
+                        [](float a, float b) { return a * b; });
+      break;
+    default:
+      Map16<ToF, FromF>(src, dst, n,
+                        [](float a, float b) { return a + b; });
+      break;
   }
 }
 
-}  // namespace
-
-void HostAccumulate(ReduceOp op, DataType dtype, const void* src, void* dst,
-                    int64_t count) {
+void HostAccumulateSerial(ReduceOp op, DataType dtype, const void* src,
+                          void* dst, int64_t count) {
   switch (dtype) {
     case DataType::FLOAT32:
       AccumulateTyped(op, static_cast<const float*>(src),
@@ -108,8 +127,7 @@ void HostAccumulate(ReduceOp op, DataType dtype, const void* src, void* dst,
   }
 }
 
-void HostScale(DataType dtype, void* dst, int64_t count, double factor) {
-  if (factor == 1.0) return;
+void HostScaleSerial(DataType dtype, void* dst, int64_t count, double factor) {
   switch (dtype) {
     case DataType::FLOAT32: {
       auto* d = static_cast<float*>(dst);
@@ -137,6 +155,42 @@ void HostScale(DataType dtype, void* dst, int64_t count, double factor) {
       // Integer scaling is rejected at the Python layer.
       break;
   }
+}
+
+}  // namespace
+
+// Threaded fronts: chunk the elementwise kernels across the worker
+// pool. Every element depends only on its own (src, dst) pair, and the
+// part split is a pure function of (count, parts), so results are
+// bitwise identical at any thread count — the invariant the fused-vs-
+// unfused smoke tests pin down.
+void HostAccumulate(ReduceOp op, DataType dtype, const void* src, void* dst,
+                    int64_t count) {
+  const int64_t esize = DataTypeSize(dtype);
+  const int parts = ParallelParts(count * esize);
+  if (parts <= 1) {
+    HostAccumulateSerial(op, dtype, src, dst, count);
+    return;
+  }
+  const auto* s = static_cast<const uint8_t*>(src);
+  auto* d = static_cast<uint8_t*>(dst);
+  WorkerPool::Get().ParallelFor(parts, count, [&](int64_t lo, int64_t hi) {
+    HostAccumulateSerial(op, dtype, s + lo * esize, d + lo * esize, hi - lo);
+  });
+}
+
+void HostScale(DataType dtype, void* dst, int64_t count, double factor) {
+  if (factor == 1.0) return;
+  const int64_t esize = DataTypeSize(dtype);
+  const int parts = ParallelParts(count * esize);
+  if (parts <= 1) {
+    HostScaleSerial(dtype, dst, count, factor);
+    return;
+  }
+  auto* d = static_cast<uint8_t*>(dst);
+  WorkerPool::Get().ParallelFor(parts, count, [&](int64_t lo, int64_t hi) {
+    HostScaleSerial(dtype, d + lo * esize, hi - lo, factor);
+  });
 }
 
 // ---------------------------------------------------------------------------
@@ -338,8 +392,13 @@ TcpOps::TcpOps(Controller* controller, FusionBufferManager* fusion,
   const int64_t arena_slot = std::max<int64_t>(
       controller->fusion_threshold(), 64 * 1024 * 1024);
   if (controller->shm_enabled()) {
+    // One extra slot past the per-rank ones: the pipelined fused
+    // allreduce reduces into it (slot(size)) so no rank's input slot
+    // doubles as the result — the aliasing that would serialize the
+    // pack-ahead stage (see ShmAllreduceFused).
     shm_ = ShmArena::Create(arena_tag(""), controller->rank(),
-                            controller->size(), arena_slot);
+                            controller->size(), arena_slot,
+                            /*extra_slots=*/1);
     // The arena's own attach confirmation is best-effort (wall-clock
     // deadlines); the authoritative all-or-none verdict rides the
     // controller — if ANY rank failed to map, every rank drops to TCP.
@@ -360,16 +419,12 @@ TcpOps::TcpOps(Controller* controller, FusionBufferManager* fusion,
                << controller->local_size() << " local ranks) — "
                << "hierarchical allgather rides shared memory";
   }
-  if (const char* t = std::getenv("HOROVOD_SHM_TIMEOUT_SECONDS")) {
-    double v = std::atof(t);
-    if (v > 0) {
-      shm_timeout_secs_ = v;
-    } else {
-      // atof's 0.0 for garbage would make every barrier "time out"
-      // instantly and poison the arena on the first op.
-      LOG_WARNING << "ignoring invalid HOROVOD_SHM_TIMEOUT_SECONDS=" << t;
-    }
-  }
+  // Sanitized parse (warn once per process, not per TcpOps rebuild —
+  // elastic re-init constructs a fresh executor every epoch): atof's
+  // 0.0 for garbage would make every barrier "time out" instantly and
+  // poison the arena on the first op.
+  shm_timeout_secs_ = EnvDoubleSane("HOROVOD_SHM_TIMEOUT_SECONDS",
+                                    shm_timeout_secs_);
 }
 
 Status TcpOps::Execute(const Response& response,
@@ -500,31 +555,71 @@ Status TcpOps::ShmAllreduceFused(const Response& r,
                                  std::vector<TensorTableEntry>& entries,
                                  int64_t total_elems, DataType dtype,
                                  int size) {
-  // Segmented shm pipeline: pack -> reduce -> unpack per segment, the
-  // same arena region reused for every segment so the working set
-  // stays nranks x segment (cache-resident) regardless of payload.
-  // The unsegmented path fell off a cache cliff once
-  // nranks x payload outgrew L3 (round-4 bench: 0.6 GB/s at 64 MB vs
-  // 1.0 at 16 MB on a 260 MB-L3 box), and payloads larger than a slot
-  // had to fall back to TCP entirely.
+  // Segmented, double-buffered shm pipeline. Each slot holds D
+  // (HOROVOD_SHM_SEGMENT_DEPTH, synced + autotuned) segment-sized
+  // regions, and the reduction lands in a dedicated result slot
+  // (slot(size)) instead of aliasing rank 0's input slot. That layout
+  // lets segment k+1 PACK while segment k reduces and segment k-1 is
+  // still being unpacked by slower ranks: at D >= 2 the per-segment
+  // barrier count drops from 3 to 1 (one "all reduced k / all packed
+  // k+1" rendezvous; the old unpack-release barrier is subsumed by
+  // program order plus the NEXT segment's rendezvous), and the copy
+  // work of adjacent segments overlaps across ranks instead of
+  // lock-stepping. Segmentation itself still bounds the working set
+  // to nranks x D x segment (cache-resident regardless of payload;
+  // the unsegmented path fell off a cache cliff once nranks x payload
+  // outgrew L3 — round-4 bench: 0.6 GB/s at 64 MB vs 1.0 at 16 MB on
+  // a 260 MB-L3 box) and lets payloads larger than a slot ride shm.
+  // Empty payload: no segments, no barriers (every rank derives the
+  // same zero from the response, so skipping uniformly is safe — and
+  // nseg = 0 must not reach the depth clamp below).
+  if (total_elems <= 0) return Status::OK();
   const int rank = controller_->rank();
   const int64_t esize = DataTypeSize(dtype);
   const int64_t seg_elems =
       std::max<int64_t>(1, controller_->shm_segment_bytes() / esize);
+  const int64_t nseg = (total_elems + seg_elems - 1) / seg_elems;
+  // Every input to D is identical across ranks (depth and segment are
+  // controller-synced; slot_bytes was fixed at arena creation from the
+  // synced init-time fusion threshold), so region indices and barrier
+  // counts agree job-wide — a split here would deadlock the arena.
+  const int64_t max_regions =
+      std::max<int64_t>(1, shm_->slot_bytes() / (seg_elems * esize));
+  const int D = static_cast<int>(std::min<int64_t>(
+      std::min<int64_t>(controller_->shm_segment_depth(), max_regions),
+      nseg));
   const std::string tname = entries.front().name;
+  uint8_t* my_slot = shm_->slot(rank);
+  uint8_t* rslot = shm_->slot(size);  // pipeline result slot
 
   // Visit the entry slices covering fused element range
   // [off_e, off_e + n_e): fn(entry, entry_off, count, segment_off),
   // offsets in elements (entries share the response dtype, so entry
-  // boundaries are always element-aligned). Segments advance
-  // monotonically, so a cursor skips entries already consumed —
-  // without it the fused path would rescan every entry per segment
+  // boundaries are always element-aligned). Pack runs a segment ahead
+  // of unpack, so each phase keeps its own monotonic cursor — without
+  // one the fused path would rescan every entry per segment
   // (O(entries x segments) with many small gradients).
-  size_t ent_lo = 0;       // first entry overlapping the current segment
-  int64_t ent_lo_off = 0;  // its fused element offset
-  auto walk = [&](int64_t off_e, int64_t n_e, auto&& fn) {
-    int64_t cur = ent_lo_off;
-    for (size_t i = ent_lo; i < entries.size(); ++i) {
+  struct Cursor {
+    size_t ent = 0;    // first entry not fully before the last range
+    int64_t off = 0;   // its fused element offset
+  };
+  // Advance c past entries fully before fused element offset off_e.
+  auto advance = [&](Cursor& c, int64_t off_e) {
+    while (c.ent < entries.size()) {
+      const int64_t ne = entries[c.ent].shape.num_elements();
+      if (c.off + ne > off_e) break;
+      c.off += ne;
+      ++c.ent;
+    }
+  };
+  // Visit the entry slices covering [off_e, off_e + n_e). Takes the
+  // cursor BY VALUE (advanced to at most off_e): pack/unpack spread a
+  // segment's range over the worker pool, and each worker walks its
+  // own sub-range from a private copy.
+  auto visit = [&](Cursor c, int64_t off_e, int64_t n_e, auto&& fn) {
+    advance(c, off_e);
+    int64_t cur = c.off;
+    for (size_t i = c.ent; i < entries.size(); ++i) {
       auto& e = entries[i];
       const int64_t ne = e.shape.num_elements();
       const int64_t s = std::max(off_e, cur);
@@ -534,53 +629,116 @@ Status TcpOps::ShmAllreduceFused(const Response& r,
       if (cur >= off_e + n_e) break;
     }
   };
-  auto advance_cursor = [&](int64_t seg_end) {
-    while (ent_lo < entries.size()) {
-      const int64_t ne = entries[ent_lo].shape.num_elements();
-      if (ent_lo_off + ne > seg_end) break;
-      ent_lo_off += ne;
-      ++ent_lo;
-    }
+  Cursor pack_cur, unpack_cur;
+
+  auto seg_n = [&](int64_t k) {
+    return std::min(seg_elems, total_elems - k * seg_elems);
+  };
+  auto region = [&](uint8_t* base, int64_t k) {
+    return base + (k % D) * seg_elems * esize;
   };
 
-  for (int64_t s0 = 0; s0 < total_elems; s0 += seg_elems) {
-    const int64_t n = std::min(seg_elems, total_elems - s0);
-    uint8_t* slot = shm_->slot(rank);
-    if (timeline_)
-      timeline_->ActivityStart(tname, ACT_MEMCPY_IN_FUSION_BUFFER);
-    walk(s0, n,
-         [&](TensorTableEntry& e, int64_t eo, int64_t cnt, int64_t so) {
-           std::memcpy(slot + so * esize,
-                       static_cast<const uint8_t*>(e.data) + eo * esize,
-                       cnt * esize);
-           if (e.prescale_factor != 1.0)
-             HostScale(dtype, slot + so * esize, cnt, e.prescale_factor);
-         });
+  // Pack/unpack parallelize at SEGMENT granularity, not per entry
+  // slice: the fused many-small-gradient case — the workload fusion
+  // exists for — would otherwise stay single-threaded (every 64 KB
+  // slice is below the pool's grain). Each pool worker re-resolves
+  // entry slices for its sub-range from a private cursor copy, and
+  // the inner kernels are the SERIAL variants — a nested ParallelFor
+  // from inside a worker would deadlock on the pool's caller lock.
+  auto pack = [&](int64_t k) {
+    if (timeline_) timeline_->ActivityStart(tname, ACT_SHM_PACK);
+    uint8_t* dst = region(my_slot, k);
+    const int64_t base_e = k * seg_elems, n = seg_n(k);
+    advance(pack_cur, base_e);
+    auto copy = [&](int64_t lo, int64_t hi) {
+      visit(pack_cur, base_e + lo, hi - lo,
+            [&](TensorTableEntry& e, int64_t eo, int64_t cnt, int64_t so) {
+              uint8_t* d = dst + (lo + so) * esize;
+              std::memcpy(d,
+                          static_cast<const uint8_t*>(e.data) + eo * esize,
+                          cnt * esize);
+              if (e.prescale_factor != 1.0)
+                HostScaleSerial(dtype, d, cnt, e.prescale_factor);
+            });
+    };
+    const int parts = ParallelParts(n * esize);
+    if (parts <= 1) {
+      copy(0, n);
+    } else {
+      WorkerPool::Get().ParallelFor(parts, n, copy);
+    }
     if (timeline_) timeline_->ActivityEnd(tname);
+  };
+  // Reduce-scatter by chunk ownership: rank p folds every rank's chunk
+  // p of the segment into the result slot (disjoint writes, no
+  // contention). Source order 0..size-1 matches the pre-pipeline code,
+  // so the arithmetic — and therefore the bits — are unchanged.
+  auto reduce = [&](int64_t k) {
+    if (timeline_) timeline_->ActivityStart(tname, ACT_SHM_REDUCE);
+    const int64_t n = seg_n(k);
+    const int64_t lo = n * rank / size, hi = n * (rank + 1) / size;
+    uint8_t* out = region(rslot, k) + lo * esize;
+    ParallelMemcpy(out, region(shm_->slot(0), k) + lo * esize,
+                   (hi - lo) * esize);
+    for (int p = 1; p < size; ++p)
+      HostAccumulate(r.reduce_op, dtype,
+                     region(shm_->slot(p), k) + lo * esize, out, hi - lo);
+    if (timeline_) timeline_->ActivityEnd(tname);
+  };
+  auto unpack = [&](int64_t k) {
+    if (timeline_) timeline_->ActivityStart(tname, ACT_SHM_UNPACK);
+    const uint8_t* src = region(rslot, k);
+    const int64_t base_e = k * seg_elems, n = seg_n(k);
+    advance(unpack_cur, base_e);
+    auto copy = [&](int64_t lo, int64_t hi) {
+      visit(unpack_cur, base_e + lo, hi - lo,
+            [&](TensorTableEntry& e, int64_t eo, int64_t cnt, int64_t so) {
+              if (e.output == nullptr) return;
+              uint8_t* dst = static_cast<uint8_t*>(e.output) + eo * esize;
+              std::memcpy(dst, src + (lo + so) * esize, cnt * esize);
+              double factor = e.postscale_factor;
+              if (e.reduce_op == ReduceOp::AVERAGE) factor /= size;
+              if (factor != 1.0) HostScaleSerial(dtype, dst, cnt, factor);
+            });
+    };
+    const int parts = ParallelParts(n * esize);
+    if (parts <= 1) {
+      copy(0, n);
+    } else {
+      WorkerPool::Get().ParallelFor(parts, n, copy);
+    }
+    if (timeline_) timeline_->ActivityEnd(tname);
+  };
 
-    if (timeline_) timeline_->ActivityStart(tname, ACT_SHM_ALLREDUCE);
-    Status st = ShmAllreduce(slot, n, dtype, r.reduce_op);
-    if (timeline_) timeline_->ActivityEnd(tname);
-    if (!st.ok()) return st;
-
-    const uint8_t* src = shm_->slot(0);
-    if (timeline_)
-      timeline_->ActivityStart(tname, ACT_MEMCPY_OUT_FUSION_BUFFER);
-    walk(s0, n,
-         [&](TensorTableEntry& e, int64_t eo, int64_t cnt, int64_t so) {
-           if (e.output == nullptr) return;
-           uint8_t* dst = static_cast<uint8_t*>(e.output) + eo * esize;
-           std::memcpy(dst, src + so * esize, cnt * esize);
-           double factor = e.postscale_factor;
-           if (e.reduce_op == ReduceOp::AVERAGE) factor /= size;
-           if (factor != 1.0) HostScale(dtype, dst, cnt, factor);
-         });
-    if (timeline_) timeline_->ActivityEnd(tname);
-    // Slot 0 stays readable until the slowest rank unpacked; only
-    // then may the next segment (or the next op) overwrite the arena.
+  // Region-safety argument (depth D >= 2): pack(k+1) writes my slot
+  // region (k+1)%D, last read by peers during reduce(k+1-D) — which
+  // completed before barrier k+1-D, at least one barrier ago.
+  // reduce(k) writes result region k%D, last read by unpack(k-D) —
+  // complete on every rank by barrier k-D+1 <= barrier k-1. unpack(k)
+  // reads result region k%D written by reduce(k) before barrier k.
+  // At D == 1 there is only one region, so pack(k+1) must wait for
+  // "all reduced k" and reduce(k+1) for "all packed k+1": two
+  // rendezvous per segment (still one fewer than the pre-pipeline
+  // code's three). No trailing release barrier in either mode: every
+  // shm op writes only its own slot before its first barrier, so the
+  // next op's first rendezvous already orders it after every reader
+  // of this op's regions.
+  static constexpr const char* kPeerLost =
+      "shm allreduce: peer lost or stalled";
+  pack(0);
+  if (!shm_->Barrier(shm_timeout_secs_))
+    return Status::UnknownError(kPeerLost);
+  for (int64_t k = 0; k < nseg; ++k) {
+    if (D >= 2 && k + 1 < nseg) pack(k + 1);
+    reduce(k);
     if (!shm_->Barrier(shm_timeout_secs_))
-      return Status::UnknownError("shm allreduce: peer lost or stalled");
-    advance_cursor(s0 + n);
+      return Status::UnknownError(kPeerLost);
+    unpack(k);
+    if (D == 1 && k + 1 < nseg) {
+      pack(k + 1);
+      if (!shm_->Barrier(shm_timeout_secs_))
+        return Status::UnknownError(kPeerLost);
+    }
   }
   return Status::OK();
 }
@@ -591,6 +749,19 @@ Status TcpOps::RingReduceScatterPhase(uint8_t* buf,
                                       const std::vector<int>& ranks, int p) {
   // P-1 steps over element-offset chunks `offs`; chunk k starts at ring
   // position k+1 and lands fully reduced on position k.
+  //
+  // The steps are pipelined: the recv of step s drains in a background
+  // thread while this rank accumulates step s-1's chunk and sends it
+  // on — per step the wall clock is max(transfer, reduce) instead of
+  // transfer + reduce, which is what converts the ring from
+  // latency-sum to bandwidth-bound. Dependencies honored: step s sends
+  // chunk cs_s == cr_{s-1}, so the accumulate of s-1 strictly precedes
+  // the send of s (program order on this thread); the recv runs ahead
+  // because its payload is produced by the PREV peer's accumulate, not
+  // ours. Two scratch buffers alternate; scratch[(s-1)%2] is consumed
+  // (accumulated) before the join of recv s, one full step before
+  // recv s+1 rewrites it. Every rank posts its recv before blocking in
+  // send, so a send can never deadlock against an unposted reader.
   const int P = static_cast<int>(ranks.size());
   const int64_t esize = DataTypeSize(dtype);
   TcpConn* next = controller_->DataConn(ranks[(p + 1) % P]);
@@ -598,16 +769,51 @@ Status TcpOps::RingReduceScatterPhase(uint8_t* buf,
   int64_t max_chunk = 0;
   for (int k = 0; k < P; ++k)
     max_chunk = std::max(max_chunk, offs[k + 1] - offs[k]);
-  std::vector<uint8_t> scratch(max_chunk * esize);
-  for (int s = 0; s < P - 1; ++s) {
-    int cs = ((p - s - 1) % P + P) % P, cr = ((p - s - 2) % P + P) % P;
-    if (!SendRecv(next, buf + offs[cs] * esize,
-                  (offs[cs + 1] - offs[cs]) * esize, prev, scratch.data(),
-                  (offs[cr + 1] - offs[cr]) * esize))
-      return Status::UnknownError("ring allreduce: lost data connection");
-    HostAccumulate(op, dtype, scratch.data(), buf + offs[cr] * esize,
-                   offs[cr + 1] - offs[cr]);
+  // Chunks below the kernel's minimum socket buffer can't block in
+  // send() and the reduce is nanoseconds — the thread handshake would
+  // cost more than it overlaps. Same cutover as SendRecv's.
+  if (max_chunk * esize <= 8 * 1024) {
+    std::vector<uint8_t> scratch(max_chunk * esize);
+    for (int s = 0; s < P - 1; ++s) {
+      int cs = ((p - s - 1) % P + P) % P, cr = ((p - s - 2) % P + P) % P;
+      if (!SendRecv(next, buf + offs[cs] * esize,
+                    (offs[cs + 1] - offs[cs]) * esize, prev, scratch.data(),
+                    (offs[cr + 1] - offs[cr]) * esize))
+        return Status::UnknownError("ring allreduce: lost data connection");
+      HostAccumulate(op, dtype, scratch.data(), buf + offs[cr] * esize,
+                     offs[cr + 1] - offs[cr]);
+    }
+    return Status::OK();
   }
+  std::vector<uint8_t> scratch[2] = {
+      std::vector<uint8_t>(max_chunk * esize),
+      std::vector<uint8_t>(max_chunk * esize)};
+  int prev_cr = -1;  // chunk received (not yet accumulated) last step
+  for (int s = 0; s < P - 1; ++s) {
+    const int cs = ((p - s - 1) % P + P) % P;
+    const int cr = ((p - s - 2) % P + P) % P;
+    std::atomic<bool> recv_ok{true};
+    uint8_t* rbuf = scratch[s % 2].data();
+    const int64_t rbytes = (offs[cr + 1] - offs[cr]) * esize;
+    std::thread receiver([&, rbuf, rbytes] {
+      if (!prev->RecvAll(rbuf, rbytes))
+        recv_ok.store(false, std::memory_order_relaxed);
+    });
+    if (prev_cr >= 0)
+      HostAccumulate(op, dtype, scratch[(s - 1) % 2].data(),
+                     buf + offs[prev_cr] * esize,
+                     offs[prev_cr + 1] - offs[prev_cr]);
+    const bool send_ok = next->SendAll(buf + offs[cs] * esize,
+                                       (offs[cs + 1] - offs[cs]) * esize);
+    receiver.join();
+    if (!send_ok || !recv_ok.load(std::memory_order_relaxed))
+      return Status::UnknownError("ring allreduce: lost data connection");
+    prev_cr = cr;
+  }
+  if (prev_cr >= 0)
+    HostAccumulate(op, dtype, scratch[(P - 2) % 2].data(),
+                   buf + offs[prev_cr] * esize,
+                   offs[prev_cr + 1] - offs[prev_cr]);
   return Status::OK();
 }
 
@@ -708,34 +914,6 @@ Status TcpOps::HierarchicalShmAllgather(
   // Release the arena only after every local rank has copied out.
   if (!node_shm_->Barrier(shm_timeout_secs_))
     return Status::UnknownError("hier allgather: node peer lost (unpack)");
-  return Status::OK();
-}
-
-Status TcpOps::ShmAllreduce(uint8_t* buf, int64_t elems, DataType dtype,
-                            ReduceOp op) {
-  const int P = controller_->size();
-  const int p = controller_->rank();
-  const int64_t esize = DataTypeSize(dtype);
-
-  // Publish my contribution (no-op when the caller packed directly
-  // into this rank's slot — the fused-allreduce fast path).
-  if (buf != shm_->slot(p))
-    std::memcpy(shm_->slot(p), buf, elems * esize);
-  if (!shm_->Barrier(shm_timeout_secs_))
-    return Status::UnknownError("shm allreduce: peer lost or stalled");
-
-  // Reduce-scatter by chunk ownership — rank p folds every peer's
-  // chunk p into slot 0 (disjoint chunk writes, no contention).
-  const int64_t lo = elems * p / P, hi = elems * (p + 1) / P;
-  uint8_t* acc = shm_->slot(0) + lo * esize;
-  for (int r = 1; r < P; ++r)
-    HostAccumulate(op, dtype, shm_->slot(r) + lo * esize, acc, hi - lo);
-  if (!shm_->Barrier(shm_timeout_secs_))
-    return Status::UnknownError("shm allreduce: peer lost or stalled");
-
-  // The reduced result now lives in slot 0; the caller reads it from
-  // there and runs the release barrier once done (keeping slot 0
-  // intact until the slowest rank finishes).
   return Status::OK();
 }
 
@@ -1229,25 +1407,19 @@ Status TcpOps::Reducescatter(const Response& r,
   if (e.prescale_factor != 1.0)
     HostScale(e.dtype, buf, n, e.prescale_factor);
 
-  // Ring reduce-scatter with the rank shards as the ring chunks: P-1
-  // steps, each forwarding the partially-reduced chunk one hop; chunk k
-  // starts at rank k+1 and lands fully reduced on rank k.
+  // Ring reduce-scatter with the rank shards as the ring chunks —
+  // shared with the allreduce's overlapped phase (recv of chunk k+1
+  // drains while chunk k accumulates). Shard offsets are row-aligned,
+  // hence element-aligned, so the byte offsets convert exactly.
   if (size > 1) {
-    TcpConn* next = controller_->DataConn((rank + 1) % size);
-    TcpConn* prev = controller_->DataConn((rank - 1 + size) % size);
-    int64_t max_chunk = 0;
-    for (int k = 0; k < size; ++k)
-      max_chunk = std::max(max_chunk, offs[k + 1] - offs[k]);
-    std::vector<uint8_t> scratch(max_chunk);
-    for (int s = 0; s < size - 1; ++s) {
-      int cs = ((rank - s - 1) % size + size) % size;
-      int cr = ((rank - s - 2) % size + size) % size;
-      if (!SendRecv(next, buf + offs[cs], offs[cs + 1] - offs[cs], prev,
-                    scratch.data(), offs[cr + 1] - offs[cr]))
-        return Status::UnknownError("reducescatter: lost data connection");
-      HostAccumulate(e.reduce_op, e.dtype, scratch.data(), buf + offs[cr],
-                     (offs[cr + 1] - offs[cr]) / DataTypeSize(e.dtype));
-    }
+    const int64_t esize = DataTypeSize(e.dtype);
+    std::vector<int64_t> elem_offs(offs.size());
+    for (size_t k = 0; k < offs.size(); ++k) elem_offs[k] = offs[k] / esize;
+    std::vector<int> all_ranks(size);
+    for (int k = 0; k < size; ++k) all_ranks[k] = k;
+    Status st = RingReduceScatterPhase(buf, elem_offs, e.dtype, e.reduce_op,
+                                       all_ranks, rank);
+    if (!st.ok()) return st;
   }
   std::memcpy(e.output, buf + offs[rank], offs[rank + 1] - offs[rank]);
   int64_t out_n = r.tensor_sizes[rank] * row_bytes / DataTypeSize(e.dtype);

@@ -20,9 +20,17 @@ constexpr double kImprovement = 1.02;  // accept only >2% gains (noise floor)
 // Normalized-coordinate maps: x in [0,1] <-> log2-scaled knob range.
 constexpr double kLogFusionLo = 10.0, kLogFusionHi = 28.0;
 constexpr double kLogCycleLo = -3.0, kLogCycleHi = 5.0;
+constexpr int kMaxSegDepth = 8;  // log2 range [0, 3]
 
 double ToUnit(double v, double lo, double hi) {
   return std::min(1.0, std::max(0.0, (v - lo) / (hi - lo)));
+}
+
+// Round a log2-space coordinate back to an integer knob in [1, maxv].
+int FromUnitPow2(double x, int maxv) {
+  const double hi = std::log2(static_cast<double>(maxv));
+  const int v = static_cast<int>(std::lround(std::exp2(x * hi)));
+  return std::max(1, std::min(maxv, v));
 }
 }  // namespace
 
@@ -53,12 +61,25 @@ void ParameterManager::SetCategoricalTunable(Categorical cat,
   best_cat_[cat] = cat_[cat];
 }
 
+void ParameterManager::SetHostTunables(int threads, int max_threads,
+                                       int depth, bool depth_available) {
+  max_threads_ = std::max(1, max_threads);
+  threads_ = std::max(1, std::min(max_threads_, threads));
+  depth_ = std::max(1, std::min(kMaxSegDepth, depth));
+  // Like the categoricals, these only join the search in bayes mode —
+  // the x2 climb walks its fixed (fusion, cycle) pair.
+  tune_threads_ = bayes_ && max_threads_ > 1;
+  tune_depth_ = bayes_ && depth_available;
+  best_threads_ = threads_;
+  best_depth_ = depth_;
+}
+
 void ParameterManager::SetLogPath(const std::string& path) {
   log_.open(path, std::ios::out | std::ios::trunc);
   if (log_.is_open())
     log_ << "time_secs,fusion_threshold_bytes,cycle_time_ms,"
             "score_bytes_per_sec,hierarchical,cache_enabled,"
-            "shm_enabled\n";
+            "shm_enabled,reduce_threads,seg_depth\n";
 }
 
 void ParameterManager::Record(int64_t bytes) {
@@ -69,7 +90,8 @@ void ParameterManager::LogSample(double score) {
   if (log_.is_open()) {
     log_ << window_start_ << "," << fusion_ << "," << cycle_ms_ << ","
          << static_cast<int64_t>(score) << "," << cat_[kCatHier] << ","
-         << cat_[kCatCache] << "," << cat_[kCatShm] << "\n";
+         << cat_[kCatCache] << "," << cat_[kCatShm] << ","
+         << threads_ << "," << depth_ << "\n";
     log_.flush();
   }
 }
@@ -79,6 +101,12 @@ std::vector<double> ParameterManager::CurrentPoint() const {
       ToUnit(std::log2(static_cast<double>(fusion_)), kLogFusionLo,
              kLogFusionHi),
       ToUnit(std::log2(cycle_ms_), kLogCycleLo, kLogCycleHi)};
+  if (tune_threads_)
+    x.push_back(ToUnit(std::log2(static_cast<double>(threads_)), 0.0,
+                       std::log2(static_cast<double>(max_threads_))));
+  if (tune_depth_)
+    x.push_back(ToUnit(std::log2(static_cast<double>(depth_)), 0.0,
+                       std::log2(static_cast<double>(kMaxSegDepth))));
   for (int c = 0; c < kNumCategoricals; ++c)
     if (cat_tunable_[c]) x.push_back(cat_[c] ? 1.0 : 0.0);
   return x;
@@ -91,6 +119,10 @@ void ParameterManager::ApplyPoint(const std::vector<double>& x) {
   double lc = kLogCycleLo + x[1] * (kLogCycleHi - kLogCycleLo);
   cycle_ms_ = std::min(kMaxCycleMs, std::max(kMinCycleMs, std::exp2(lc)));
   size_t i = 2;
+  if (tune_threads_ && i < x.size())
+    threads_ = FromUnitPow2(x[i++], max_threads_);
+  if (tune_depth_ && i < x.size())
+    depth_ = FromUnitPow2(x[i++], kMaxSegDepth);
   for (int c = 0; c < kNumCategoricals; ++c)
     if (cat_tunable_[c] && i < x.size()) cat_[c] = x[i++] > 0.5 ? 1 : 0;
 }
@@ -132,10 +164,14 @@ bool ParameterManager::UpdateBayes(double score) {
   if (!opt_) {
     int n_cat = 0;
     for (bool t : cat_tunable_) n_cat += t ? 1 : 0;
-    opt_ = std::make_unique<BayesianOptimizer>(2, n_cat);
+    const int n_cont =
+        2 + (tune_threads_ ? 1 : 0) + (tune_depth_ ? 1 : 0);
+    opt_ = std::make_unique<BayesianOptimizer>(n_cont, n_cat);
   }
   const int64_t old_fusion = fusion_;
   const double old_cycle = cycle_ms_;
+  const int old_threads = threads_;
+  const int old_depth = depth_;
   int old_cat[kNumCategoricals];
   std::memcpy(old_cat, cat_, sizeof(old_cat));
 
@@ -144,11 +180,15 @@ bool ParameterManager::UpdateBayes(double score) {
     best_score_ = score;
     best_fusion_ = fusion_;
     best_cycle_ms_ = cycle_ms_;
+    best_threads_ = threads_;
+    best_depth_ = depth_;
     std::memcpy(best_cat_, cat_, sizeof(best_cat_));
   }
   if (opt_->n_samples() >= max_samples_) {
     fusion_ = best_fusion_;
     cycle_ms_ = best_cycle_ms_;
+    threads_ = best_threads_;
+    depth_ = best_depth_;
     std::memcpy(cat_, best_cat_, sizeof(best_cat_));
     converged_ = true;
     static constexpr const char* kCatNames[kNumCategoricals] = {
@@ -158,15 +198,20 @@ bool ParameterManager::UpdateBayes(double score) {
       if (cat_tunable_[c])
         cats += std::string(" ") + kCatNames[c] + "=" +
                 (cat_[c] ? "1" : "0");
+    std::string host;
+    if (tune_threads_)
+      host += " reduce_threads=" + std::to_string(threads_);
+    if (tune_depth_) host += " seg_depth=" + std::to_string(depth_);
     LOG_INFO << "autotune (bayes) converged after " << opt_->n_samples()
              << " samples: fusion_threshold=" << fusion_
-             << " cycle_time_ms=" << cycle_ms_ << cats
+             << " cycle_time_ms=" << cycle_ms_ << host << cats
              << " (score " << static_cast<int64_t>(best_score_) << " B/s)";
   } else {
     ApplyPoint(opt_->NextCandidate());
   }
   settling_ = true;
   return fusion_ != old_fusion || cycle_ms_ != old_cycle ||
+         threads_ != old_threads || depth_ != old_depth ||
          std::memcmp(cat_, old_cat, sizeof(old_cat)) != 0 || converged_;
 }
 

@@ -60,7 +60,8 @@ bool TagMatches(const char* have, const std::string& tag) {
 }  // namespace
 
 std::unique_ptr<ShmArena> ShmArena::Create(const std::string& tag, int rank,
-                                           int nranks, int64_t slot_bytes) {
+                                           int nranks, int64_t slot_bytes,
+                                           int extra_slots) {
   static_assert(sizeof(Control) <= kCtrlBytes,
                 "Control grew past its reserved bytes; the pid array "
                 "would overlap");
@@ -71,7 +72,8 @@ std::unique_ptr<ShmArena> ShmArena::Create(const std::string& tag, int rank,
                 std::hash<std::string>{}(tag));
   const int64_t pids_off = kCtrlBytes;
   const int64_t slots_off = pids_off + RoundUp64(int64_t(nranks) * 4);
-  const int64_t map_bytes = slots_off + int64_t(nranks) * slot_bytes;
+  const int64_t map_bytes =
+      slots_off + int64_t(nranks + extra_slots) * slot_bytes;
 
   void* base = MAP_FAILED;
   if (rank == 0) {

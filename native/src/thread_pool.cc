@@ -1,0 +1,160 @@
+#include "hvd/thread_pool.h"
+
+#include <algorithm>
+#include <cstring>
+
+namespace hvd {
+
+namespace {
+std::atomic<int> g_reduce_threads{1};
+}  // namespace
+
+int HostReduceThreads() {
+  return g_reduce_threads.load(std::memory_order_relaxed);
+}
+
+void SetHostReduceThreads(int n) {
+  g_reduce_threads.store(std::max(1, std::min(64, n)),
+                         std::memory_order_relaxed);
+}
+
+int ParallelParts(int64_t bytes) {
+  const int threads = HostReduceThreads();
+  if (threads <= 1 || bytes < 2 * kMinParallelBytes) return 1;
+  return static_cast<int>(
+      std::min<int64_t>(threads, bytes / kMinParallelBytes));
+}
+
+WorkerPool& WorkerPool::Get() {
+  static WorkerPool* pool = new WorkerPool();
+  return *pool;
+}
+
+void WorkerPool::EnsureWorkers(int n) {
+  while (static_cast<int>(workers_.size()) < n)
+    workers_.emplace_back([this] { WorkerLoop(); });
+}
+
+bool WorkerPool::RunOnePart(uint32_t seq) {
+  // Claims ride a single atomic packing (job seq << 32 | next part):
+  // the caller publishes a job by storing a fresh seq with part 0
+  // (release, AFTER the job fields are written), so a claim can only
+  // succeed against the generation the claimer was woken for. A worker
+  // that slept through a whole job — woken for A, preempted, A
+  // finished, B mid-publish — fails the seq check and goes back to
+  // wait; an unstamped fetch_add here could land between B's field
+  // writes and its counter reset, double-running a range (silent
+  // reduction corruption) or invoking A's dead std::function.
+  //
+  // The part BOUND is generation-stamped too (bounds_ = seq << 32 |
+  // parts): validating a stale seq-A ticket against a bare parts
+  // field already overwritten by job B would let "part == A.parts"
+  // pass a "< B.parts" check and claim a phantom part — B's crew
+  // would run that range as well (double accumulate), or the claim
+  // would dereference A's destroyed std::function.
+  uint64_t t = ticket_.load(std::memory_order_acquire);
+  uint32_t part, parts;
+  for (;;) {
+    if (static_cast<uint32_t>(t >> 32) != seq) return false;
+    const uint64_t b = bounds_.load(std::memory_order_acquire);
+    if (static_cast<uint32_t>(b >> 32) != seq) return false;
+    parts = static_cast<uint32_t>(b);
+    part = static_cast<uint32_t>(t);
+    if (part >= parts) return false;
+    if (ticket_.compare_exchange_weak(t, t + 1, std::memory_order_acq_rel,
+                                      std::memory_order_acquire))
+      break;
+  }
+  // A successful claim of a live part pins the job: the caller cannot
+  // return (and the next job cannot publish) until this part is
+  // reported, so the field reads below are race-free. A completed
+  // job's ticket sits exactly at part == parts (claims stop at the
+  // bound), so no same-generation claim can succeed after completion.
+  const int64_t n = job_n_.load(std::memory_order_relaxed);
+  // Same split as ChunkOffsets: remainders spread over leading parts,
+  // so the partition is a pure function of (n, parts) — determinism of
+  // the ranges is what keeps thread-count changes bitwise-invisible.
+  const int64_t base = n / parts, rem = n % parts;
+  const int64_t lo =
+      static_cast<int64_t>(part) * base + std::min<int64_t>(part, rem);
+  const int64_t hi = lo + base + (part < rem ? 1 : 0);
+  if (hi > lo) (*job_fn_)(lo, hi);
+  return true;
+}
+
+void WorkerPool::WorkerLoop() {
+  uint32_t seen = 0;
+  std::unique_lock<std::mutex> lock(mu_);
+  for (;;) {
+    cv_work_.wait(lock, [&] { return job_seq_ != seen; });
+    seen = job_seq_;
+    lock.unlock();
+    int ran = 0;
+    while (RunOnePart(seen)) ++ran;
+    lock.lock();
+    // ran > 0 with a changed seq is impossible (a successful claim
+    // pins the job until reported), so this guard only drops a
+    // zero-report from a worker that overslept an entire job.
+    if (job_seq_ == seen) {
+      done_parts_ += ran;
+      if (done_parts_ >=
+          static_cast<int>(static_cast<uint32_t>(
+              bounds_.load(std::memory_order_relaxed))))
+        cv_done_.notify_all();
+    }
+  }
+}
+
+void WorkerPool::ParallelFor(int parts, int64_t n,
+                             const std::function<void(int64_t, int64_t)>& fn) {
+  if (n <= 0) return;
+  if (parts <= 1) {
+    fn(0, n);
+    return;
+  }
+  std::lock_guard<std::mutex> caller(caller_mu_);
+  uint32_t seq;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    EnsureWorkers(parts - 1);
+    job_n_.store(n, std::memory_order_relaxed);
+    job_fn_ = &fn;
+    done_parts_ = 0;
+    seq = ++job_seq_;
+    // Publish bounds then ticket, both seq-stamped (release): a claim
+    // only proceeds when BOTH carry the claimer's generation, so no
+    // interleaving of a stale ticket with fresh fields can pass.
+    bounds_.store((static_cast<uint64_t>(seq) << 32) |
+                      static_cast<uint32_t>(parts),
+                  std::memory_order_release);
+    ticket_.store(static_cast<uint64_t>(seq) << 32,
+                  std::memory_order_release);
+  }
+  cv_work_.notify_all();
+  // The caller works too — with work-stealing part claims it finishes
+  // the tail even if every worker thread is preempted.
+  int ran = 0;
+  while (RunOnePart(seq)) ++ran;
+  std::unique_lock<std::mutex> lock(mu_);
+  done_parts_ += ran;
+  if (done_parts_ >= parts) {
+    cv_done_.notify_all();
+    return;
+  }
+  cv_done_.wait(lock, [&] { return done_parts_ >= parts; });
+}
+
+void ParallelMemcpy(void* dst, const void* src, int64_t bytes) {
+  const int parts = ParallelParts(bytes);
+  if (parts <= 1) {
+    std::memcpy(dst, src, bytes);
+    return;
+  }
+  auto* d = static_cast<uint8_t*>(dst);
+  const auto* s = static_cast<const uint8_t*>(src);
+  WorkerPool::Get().ParallelFor(parts, bytes, [&](int64_t lo, int64_t hi) {
+    std::memcpy(d + lo, s + lo, hi - lo);
+  });
+}
+
+}  // namespace hvd

@@ -569,6 +569,31 @@ def main():
             assert abs(float(np.asarray(out)[0]) - want) < 1e-5
         print(f"OK rank={r} iters={iters}")
 
+    elif scenario == "fused_bitwise":
+        # Fused multi-tensor allreduce must be BITWISE identical to the
+        # per-tensor path (same accumulate order per element on both),
+        # and the result bytes must not depend on HOROVOD_REDUCE_THREADS
+        # or the shm pipeline depth — the test runs this scenario under
+        # several knob settings and compares the printed digests.
+        # Sizes straddle the threading grain and (with the test's tiny
+        # HOROVOD_SHM_SEGMENT_BYTES) the shm segment boundaries.
+        import hashlib
+
+        rng = np.random.RandomState(100 + r)
+        xs = [rng.randn(n).astype(np.float32)
+              for n in (8191, 65536, 3, 100003)]
+        fused = hvd.grouped_allreduce([x.copy() for x in xs], op=hvd.Sum,
+                                      name="fb")
+        single = [hvd.allreduce(x.copy(), op=hvd.Sum, name=f"fb.{i}")
+                  for i, x in enumerate(xs)]
+        for i, (f, u) in enumerate(zip(fused, single)):
+            assert np.asarray(f).tobytes() == np.asarray(u).tobytes(), (
+                f"fused tensor {i} differs from per-tensor result")
+        digest = hashlib.sha1(
+            b"".join(np.asarray(o).tobytes() for o in fused)).hexdigest()
+        print(f"DIGEST {digest}")
+        print(f"OK rank={r}")
+
     elif scenario == "shm_segmented":
         # Multi-segment shm allreduce (HOROVOD_SHM_SEGMENT_BYTES forced
         # tiny by the test): odd payload lengths so segment boundaries

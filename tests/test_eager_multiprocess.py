@@ -140,6 +140,51 @@ def test_xla_fused_allgather():
     run_job("xla_fused_allgather", 2, timeout=240, extra_env=_xla_env(2))
 
 
+def _digests(outs):
+    ds = [l.split()[1] for out in outs for l in out.splitlines()
+          if l.startswith("DIGEST ")]
+    assert len(ds) == len(outs), outs
+    return set(ds)
+
+
+@pytest.mark.parametrize("plane", ["shm", "shm_depth1", "tcp"])
+def test_fused_bitwise_and_thread_invariance(plane):
+    """Fused multi-tensor allreduce must be bitwise identical to the
+    per-tensor path (asserted inside the worker), and the result bytes
+    must be invariant to HOROVOD_REDUCE_THREADS — on both host planes
+    and at both shm pipeline depths. The tiny segment cap forces the
+    fused group across many segments so the pipeline actually runs."""
+    base = {
+        "shm": {"HOROVOD_SHM_SEGMENT_BYTES": "65536"},
+        "shm_depth1": {"HOROVOD_SHM_SEGMENT_BYTES": "65536",
+                       "HOROVOD_SHM_SEGMENT_DEPTH": "1"},
+        "tcp": {"HOROVOD_SHM_DISABLE": "1"},
+    }[plane]
+    single = _digests(run_job(
+        "fused_bitwise", 2,
+        extra_env={**base, "HOROVOD_REDUCE_THREADS": "1"}))
+    threaded = _digests(run_job(
+        "fused_bitwise", 2,
+        extra_env={**base, "HOROVOD_REDUCE_THREADS": "4"}))
+    # All ranks agree (allreduce contract) and threads change nothing.
+    assert len(single) == 1 and single == threaded, (single, threaded)
+
+
+def test_timeline_carries_shm_pipeline_phases(tmp_path):
+    """HOROVOD_TIMELINE output must name the pack/reduce/unpack phases
+    of the pipelined shm allreduce so a stalled stage is diagnosable
+    from the trace alone."""
+    tl = str(tmp_path / "tl.json")
+    run_job("shm_segmented", 2, extra_env={
+        "HOROVOD_SHM_SEGMENT_BYTES": "65536",
+        "HOROVOD_TIMELINE": tl,
+        "HOROVOD_TIMELINE_RANK_SUFFIX": "1",
+    })
+    raw = open(tl + ".0").read()
+    for phase in ("SHM_PACK", "SHM_REDUCE", "SHM_UNPACK"):
+        assert phase in raw, f"timeline missing {phase}"
+
+
 def test_shm_segmented_allreduce():
     """A 4 KB segment cap forces ~100 segments per op: boundaries land
     mid-entry, the fused group spans segments, and scale factors ride

@@ -1,0 +1,158 @@
+"""Host reduction kernels (native HostAccumulate / HostScale) against
+numpy references, through the ctypes ABI on libhorovod_tpu_core.so.
+
+Covers the full dtype matrix — f32/f64/f16/bf16, the integer widths,
+and bool's AND/OR semantics — plus the threaded chunked path: sizes
+straddle the pool's parallel-grain boundaries and every case must be
+bitwise identical at 1 thread and many threads (the parallel split is
+elementwise, so thread count may never change a single bit)."""
+
+import ctypes
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common.basics import dtype_id, get_lib
+
+# native ReduceOp values (hvd/common.h).
+OP_AVERAGE, OP_SUM, OP_ADASUM, OP_MIN, OP_MAX, OP_PRODUCT = range(6)
+
+# Sizes around the threading grain (kMinParallelBytes = 256 KB): tiny
+# (inline path), just below / above the 2x-grain cutover for f32, and
+# a many-chunk size with a remainder so uneven splits get exercised.
+SIZES = [1, 7, 1023, 131071, 131073, 700001]
+
+
+def _threads(lib, n):
+    lib.hvd_set_reduce_threads(n)
+    assert lib.hvd_reduce_threads() == min(64, max(1, n))
+
+
+def _accumulate(lib, op, src, dst):
+    out = dst.copy()
+    lib.hvd_host_accumulate(
+        op, dtype_id(src.dtype),
+        src.ctypes.data if hasattr(src, "ctypes") else
+        ctypes.c_void_p(src.__array_interface__["data"][0]),
+        out.ctypes.data if hasattr(out, "ctypes") else
+        ctypes.c_void_p(out.__array_interface__["data"][0]),
+        src.size)
+    return out
+
+
+def _rand(dtype, n, rng):
+    if dtype == np.bool_:
+        return rng.rand(n) < 0.5
+    if np.issubdtype(np.dtype(dtype), np.integer):
+        info = np.iinfo(dtype)
+        # Small magnitudes so SUM/PRODUCT stay in range (overflow wraps
+        # identically in C and numpy for the unsigned types, but signed
+        # overflow is UB in C — avoid it).
+        lo, hi = max(info.min, -5), min(info.max, 11)
+        return rng.randint(lo, hi + 1, size=n).astype(dtype)
+    return rng.randn(n).astype(dtype)
+
+
+def _combine(op, dst, src):
+    """Expected result of dst <- dst (op) src, elementwise."""
+    if dst.dtype == np.bool_:
+        return (dst & src) if op in (OP_MIN, OP_PRODUCT) else (dst | src)
+    is16f = dst.dtype.itemsize == 2 and np.issubdtype(dst.dtype,
+                                                      np.floating)
+    wide = np.float32 if is16f else dst.dtype
+    a = dst.astype(wide)
+    b = src.astype(wide)
+    if op in (OP_AVERAGE, OP_SUM, OP_ADASUM):
+        r = a + b
+    elif op == OP_MIN:
+        r = np.minimum(a, b)
+    elif op == OP_MAX:
+        r = np.maximum(a, b)
+    else:
+        r = a * b
+    return r.astype(dst.dtype)
+
+
+def _dtypes():
+    import ml_dtypes
+    return [np.float32, np.float64, np.float16,
+            np.dtype(ml_dtypes.bfloat16), np.int32, np.int64, np.uint8,
+            np.int8, np.uint16, np.int16, np.bool_]
+
+
+@pytest.mark.parametrize("op", [OP_SUM, OP_MIN, OP_MAX, OP_PRODUCT])
+@pytest.mark.parametrize("dtype", _dtypes(), ids=lambda d: np.dtype(d).name)
+def test_accumulate_matches_numpy(op, dtype):
+    lib = get_lib()
+    rng = np.random.RandomState(42)
+    _threads(lib, 4)
+    try:
+        for n in SIZES:
+            src = _rand(dtype, n, rng)
+            dst = _rand(dtype, n, rng)
+            got = _accumulate(lib, op, src, dst)
+            want = _combine(op, dst, src)
+            np.testing.assert_array_equal(
+                np.asarray(got), np.asarray(want),
+                err_msg=f"dtype={np.dtype(dtype).name} op={op} n={n}")
+    finally:
+        _threads(lib, 1)
+
+
+@pytest.mark.parametrize("dtype", _dtypes(), ids=lambda d: np.dtype(d).name)
+def test_accumulate_thread_count_is_bitwise_invisible(dtype):
+    """The chunked parallel path must produce the exact bytes of the
+    single-threaded path at sizes that straddle chunk boundaries."""
+    lib = get_lib()
+    rng = np.random.RandomState(7)
+    for n in SIZES:
+        src = _rand(dtype, n, rng)
+        dst = _rand(dtype, n, rng)
+        _threads(lib, 1)
+        serial = _accumulate(lib, OP_SUM, src, dst)
+        for t in (2, 3, 8):
+            _threads(lib, t)
+            threaded = _accumulate(lib, OP_SUM, src, dst)
+            assert np.asarray(serial).tobytes() == \
+                np.asarray(threaded).tobytes(), (np.dtype(dtype).name, n, t)
+    _threads(lib, 1)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64, np.float16],
+                         ids=lambda d: np.dtype(d).name)
+def test_scale_matches_numpy(dtype):
+    lib = get_lib()
+    rng = np.random.RandomState(3)
+    _threads(lib, 4)
+    try:
+        for n in SIZES:
+            x = _rand(dtype, n, rng)
+            out = x.copy()
+            lib.hvd_host_scale(dtype_id(x.dtype), out.ctypes.data, n, 0.25)
+            # Native math: value -> f32/f64 -> * factor in double ->
+            # back. 0.25 is exact in binary so the roundings line up
+            # with numpy's.
+            if dtype == np.float16:
+                want = (x.astype(np.float32) * 0.25).astype(np.float16)
+            else:
+                want = (x * dtype(0.25)).astype(dtype)
+            np.testing.assert_array_equal(out, want)
+    finally:
+        _threads(lib, 1)
+
+
+def test_scale_bfloat16_threaded_matches_serial():
+    import ml_dtypes
+    lib = get_lib()
+    rng = np.random.RandomState(5)
+    x = rng.randn(700001).astype(ml_dtypes.bfloat16)
+    a, b = x.copy(), x.copy()
+    _threads(lib, 1)
+    lib.hvd_host_scale(dtype_id(a.dtype), a.ctypes.data, a.size, 1.0 / 3.0)
+    _threads(lib, 8)
+    lib.hvd_host_scale(dtype_id(b.dtype), b.ctypes.data, b.size, 1.0 / 3.0)
+    _threads(lib, 1)
+    assert a.tobytes() == b.tobytes()
+    want = (x.astype(np.float32).astype(np.float64) / 3.0).astype(
+        np.float32).astype(ml_dtypes.bfloat16)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(want))
