@@ -50,6 +50,15 @@ BUS_NP = 4
 # regressions are visible next to the single-tensor sizes.
 BUS_FUSED_COUNT = 64
 BUS_FUSED_KB = 64
+# Wire-compression case (perf_tuning.md HOROVOD_WIRE_COMPRESSION):
+# 16 MB payload on the TCP ring (shm disabled — compression only
+# touches the inter-process wire). Codec rounds are INTERLEAVED and
+# each codec keeps its best round: on a box whose ranks timeshare two
+# cores, sequential per-codec blocks drift ±30% between blocks and the
+# none/bf16 ratio is unmeasurable; round-robin sampling puts every
+# codec under the same interference.
+BUS_WIRE_MB = 16
+BUS_WIRE_ROUNDS = 8
 
 
 def _bus_worker():
@@ -105,9 +114,56 @@ def _bus_worker():
     hvd.shutdown()
 
 
-def _bus_bandwidth():
-    """Launch the np=4 host-plane bandwidth job; returns {size: GB/s}
-    or None on failure (the primary metric must still print)."""
+def _bus_wire_worker():
+    """Per-rank body of the WIRE-compression busbw case: one TCP-ring
+    payload, codecs round-robined so each round's host interference
+    hits every codec equally; each codec reports its best round. Also
+    prints the exact achieved compression ratio (payload bytes / wire
+    bytes) straight from the native codec's size accounting."""
+    import ctypes
+
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.common.basics import get_lib
+
+    hvd.init()
+    r, s = hvd.rank(), hvd.size()
+    n = BUS_WIRE_MB * (1 << 20) // 4
+    x = np.ones(n, np.float32)
+    codecs = [("none", hvd.Compression.none), ("bf16", hvd.Compression.bf16),
+              ("int8", hvd.Compression.int8)]
+    for name, comp in codecs:
+        for _ in range(2):
+            hvd.allreduce(x, op=hvd.Sum, name=f"bww.{name}", compression=comp)
+    iters, best = 3, {}
+    for _ in range(BUS_WIRE_ROUNDS):
+        for name, comp in codecs:
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                hvd.allreduce(x, op=hvd.Sum, name=f"bww.{name}",
+                              compression=comp)
+            dt = time.perf_counter() - t0
+            best[name] = min(best.get(name, dt), dt)
+    if r == 0:
+        lib = get_lib()
+        results = {}
+        for name, comp in codecs:
+            bw = (n * 4 * iters / best[name]) / 1e9 * 2 * (s - 1) / s
+            results[name] = round(bw, 3)
+        results["ratio"] = {
+            name: round(n * 4 / lib.hvd_wire_encoded_bytes(
+                comp.wire_codec, ctypes.c_int64(n)), 2)
+            for name, comp in codecs if name != "none"
+        }
+        print("BUSWIRE " + json.dumps(results), flush=True)
+    hvd.shutdown()
+
+
+def _bus_job(flag, tag, extra_env=None, timeout=120):
+    """Launch one np=4 host-plane microbenchmark job (`bench.py
+    <flag>`) and return rank 0's parsed "<tag> {json}" payload, or
+    None on failure (the primary metric must still print)."""
     import socket
     import subprocess
 
@@ -124,15 +180,16 @@ def _bus_bandwidth():
             "HOROVOD_CONTROLLER_ADDR": f"127.0.0.1:{port}",
             "PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
         })
+        env.update(extra_env or {})
         procs.append(subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__), "--bus-worker"],
+            [sys.executable, os.path.abspath(__file__), flag],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
             text=True))
     out0 = None
     # One overall deadline across all ranks (not per-communicate), so
-    # the whole microbenchmark is bounded by ~120s worst case — the
-    # headroom its budget gate in main() checks for.
-    deadline = time.perf_counter() + 120
+    # the whole microbenchmark is bounded — the headroom its budget
+    # gate in main() checks for.
+    deadline = time.perf_counter() + timeout
     try:
         for r, p in enumerate(procs):
             out, _ = p.communicate(
@@ -149,9 +206,21 @@ def _bus_bandwidth():
                 p.kill()
                 p.wait()
     for line in (out0 or "").splitlines():
-        if line.startswith("BUSBW "):
-            return json.loads(line[len("BUSBW "):])
+        if line.startswith(tag + " "):
+            return json.loads(line[len(tag) + 1:])
     return None
+
+
+def _bus_bandwidth():
+    """The np=4 host-plane bandwidth job; {size: GB/s} or None."""
+    return _bus_job("--bus-worker", "BUSBW")
+
+
+def _bus_wire_bandwidth():
+    """The np=4 TCP-ring wire-compression job (shm disabled so the
+    codecs actually touch the wire); {codec: GB/s, ratio: {...}}."""
+    return _bus_job("--bus-wire-worker", "BUSWIRE",
+                    extra_env={"HOROVOD_SHM_DISABLE": "1"}, timeout=150)
 
 
 def _transformer_worker():
@@ -459,6 +528,24 @@ def main():
             # compares keys present in both rounds, so a protocol
             # change never produces an apples-to-oranges flag.
             extra["host_allreduce_busbw_best3_gbps_np4"] = bus
+    # Wire-compression cases (HOROVOD_WIRE_COMPRESSION over the TCP
+    # ring): per-codec busbw + the achieved compression ratio, so the
+    # BENCH trajectory captures the on-the-wire win (and the none
+    # reference measured under the identical interleaved protocol).
+    if (extras_on and os.environ.get("BENCH_SKIP_BUS") != "1"
+            and budget - (time.perf_counter() - _T0) > 150):
+        wire = _bus_wire_bandwidth()
+        if wire is not None:
+            ratio = wire.pop("ratio", {})
+            extra["host_allreduce_busbw_wire_bf16_gbps_np4"] = {
+                f"{BUS_WIRE_MB}MB": wire.get("bf16"),
+                f"{BUS_WIRE_MB}MB_none_ref": wire.get("none"),
+            }
+            extra["host_allreduce_busbw_wire_int8_gbps_np4"] = {
+                f"{BUS_WIRE_MB}MB": wire.get("int8"),
+                f"{BUS_WIRE_MB}MB_none_ref": wire.get("none"),
+            }
+            extra["wire_compression_ratio"] = ratio
     remaining = budget - (time.perf_counter() - _T0)
     if extras_on and remaining > 30:
         tf = _transformer_extra(remaining)
@@ -495,6 +582,8 @@ def main():
 if __name__ == "__main__":
     if "--bus-worker" in sys.argv:
         _bus_worker()
+    elif "--bus-wire-worker" in sys.argv:
+        _bus_wire_worker()
     elif "--transformer-worker" in sys.argv:
         _transformer_worker()
     elif "--serve-worker" in sys.argv:

@@ -101,29 +101,41 @@ def _resolve_op(op: Optional[ReduceOp], average: Optional[bool]) -> ReduceOp:
 def allreduce_async(tensor, average: Optional[bool] = None,
                     name: Optional[str] = None, op: Optional[ReduceOp] = None,
                     prescale_factor: float = 1.0,
-                    postscale_factor: float = 1.0) -> Handle:
+                    postscale_factor: float = 1.0,
+                    compression=None) -> Handle:
+    """``compression`` (a ``hvd.Compression`` member) selects the
+    native TCP data plane's on-the-wire codec for this op — e.g.
+    ``hvd.Compression.int8`` ships blockwise-quantized bytes with
+    error feedback while the user-visible tensor stays full precision.
+    ``None`` follows the job-wide ``HOROVOD_WIRE_COMPRESSION`` knob;
+    see ``docs/perf_tuning.md``."""
     rt = get_runtime()
     return rt.enqueue(
         basics.OP_ALLREDUCE, tensor, rt.auto_name("allreduce", name),
         reduce_op=_resolve_op(op, average), prescale_factor=prescale_factor,
-        postscale_factor=postscale_factor)
+        postscale_factor=postscale_factor, compression=compression)
 
 
 def allreduce(tensor, average: Optional[bool] = None,
               name: Optional[str] = None, op: Optional[ReduceOp] = None,
-              prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              compression=None):
     return synchronize(allreduce_async(tensor, average, name, op,
-                                       prescale_factor, postscale_factor))
+                                       prescale_factor, postscale_factor,
+                                       compression))
 
 
 def grouped_allreduce_async(tensors: Sequence, average: Optional[bool] = None,
                             name: Optional[str] = None,
                             op: Optional[ReduceOp] = None,
                             prescale_factor: float = 1.0,
-                            postscale_factor: float = 1.0) -> List[Handle]:
+                            postscale_factor: float = 1.0,
+                            compression=None) -> List[Handle]:
     """Atomic multi-tensor allreduce (reference
     ``EnqueueTensorAllreduces``, ``operations.cc:943`` + GroupTable).
-    The member names are hashed into a rank-invariant group key."""
+    The member names are hashed into a rank-invariant group key.
+    ``compression`` rides every member (the coordinator only fuses
+    matching codecs, so the group stays one response)."""
     rt = get_runtime()
     reduce_op = _resolve_op(op, average)
     base = rt.auto_name("grouped_allreduce", name)
@@ -133,7 +145,8 @@ def grouped_allreduce_async(tensors: Sequence, average: Optional[bool] = None,
         rt.enqueue(basics.OP_ALLREDUCE, t, nm, reduce_op=reduce_op,
                    prescale_factor=prescale_factor,
                    postscale_factor=postscale_factor,
-                   group_key=key, group_size=len(tensors))
+                   group_key=key, group_size=len(tensors),
+                   compression=compression)
         for t, nm in zip(tensors, names)
     ]
 
@@ -142,9 +155,11 @@ def grouped_allreduce(tensors: Sequence, average: Optional[bool] = None,
                       name: Optional[str] = None,
                       op: Optional[ReduceOp] = None,
                       prescale_factor: float = 1.0,
-                      postscale_factor: float = 1.0) -> List:
+                      postscale_factor: float = 1.0,
+                      compression=None) -> List:
     handles = grouped_allreduce_async(tensors, average, name, op,
-                                      prescale_factor, postscale_factor)
+                                      prescale_factor, postscale_factor,
+                                      compression)
     return [synchronize(h) for h in handles]
 
 

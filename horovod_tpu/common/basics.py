@@ -33,6 +33,23 @@ OP_REDUCESCATTER = 6
 EXEC_HOST = 0
 EXEC_CALLBACK = 1
 
+# Native wire/ABI version pins. These MUST match the constants in
+# native/include/hvd/message.h (kAbiVersion / kWireVersion*) — the ABI
+# is enforced at library load below, and tests/test_wire_abi.py greps
+# the header so a native bump can't silently skew this shim even
+# before a rebuild happens.
+ABI_VERSION = 5
+WIRE_VERSION_REQUEST_LIST = 2
+WIRE_VERSION_RESPONSE_LIST = 5
+
+# Native WireCodec ids (native/include/hvd/codec.h); -1 = follow the
+# job-wide HOROVOD_WIRE_COMPRESSION default.
+WIRE_CODEC_DEFAULT = -1
+WIRE_CODEC_NONE = 0
+WIRE_CODEC_BF16 = 1
+WIRE_CODEC_FP16 = 2
+WIRE_CODEC_INT8 = 3
+
 # numpy dtype -> native DataType id (native/include/hvd/common.h).
 _DTYPE_MAP = {
     np.dtype(np.uint8): 0,
@@ -117,7 +134,6 @@ def load_library() -> ctypes.CDLL:
                       "to build it from")
     lib = ctypes.CDLL(path)
 
-    ABI_VERSION = 4
     try:
         got = lib.hvd_abi_version()
     except AttributeError:
@@ -140,7 +156,7 @@ def load_library() -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_void_p,
         ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_double,
         ctypes.c_double, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
-        ctypes.c_int, ctypes.c_int64, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
     ]
     lib.hvd_last_enqueue_error.restype = ctypes.c_char_p
     lib.hvd_join.restype = ctypes.c_int64
@@ -180,6 +196,20 @@ def load_library() -> ctypes.CDLL:
     lib.hvd_set_reduce_threads.restype = None
     lib.hvd_set_reduce_threads.argtypes = [ctypes.c_int]
     lib.hvd_reduce_threads.restype = ctypes.c_int
+    # Wire-codec kernels (perf_tuning.md HOROVOD_WIRE_COMPRESSION):
+    # exercised directly by the codec round-trip/error-feedback tests.
+    lib.hvd_wire_encoded_bytes.restype = ctypes.c_int64
+    lib.hvd_wire_encoded_bytes.argtypes = [ctypes.c_int, ctypes.c_int64]
+    lib.hvd_wire_encode.restype = None
+    lib.hvd_wire_encode.argtypes = [ctypes.c_int, ctypes.c_void_p,
+                                    ctypes.c_int64, ctypes.c_void_p,
+                                    ctypes.c_void_p]
+    lib.hvd_wire_decode.restype = None
+    lib.hvd_wire_decode.argtypes = [ctypes.c_int, ctypes.c_void_p,
+                                    ctypes.c_int64, ctypes.c_void_p]
+    lib.hvd_wire_decode_add.restype = None
+    lib.hvd_wire_decode_add.argtypes = [ctypes.c_int, ctypes.c_void_p,
+                                        ctypes.c_int64, ctypes.c_void_p]
     return lib
 
 

@@ -1,14 +1,35 @@
 """Gradient compression (reference ``horovod/torch/compression.py:20-74``
 and ``tensorflow/compression.py``): compress before the wire, decompress
 after. On TPU the interesting codec is bf16 (native MXU dtype); fp16 is
-kept for parity."""
+kept for parity.
+
+Two tiers share this namespace:
+
+* **Cast compression** (``compress``/``decompress``) — the reference's
+  framework-level API used by the optimizer wrappers: cast the tensor
+  down before the collective, cast back after.
+* **Wire compression** — the native TCP data plane's per-chunk codecs
+  (``native/src/codec.cc``). Passing a member of :class:`Compression`
+  as ``hvd.allreduce(..., compression=...)`` maps it onto the native
+  codec via ``wire_codec`` below: the payload stays full precision in
+  user memory and only the ring/doubling exchange bytes shrink (int8
+  additionally carries per-block scales and rank-local error-feedback
+  residuals, per EQuARX). See ``docs/perf_tuning.md``.
+"""
 
 from __future__ import annotations
 
 import numpy as np
 
+# Native WireCodec ids (native/include/hvd/codec.h).
+_WIRE_NONE, _WIRE_BF16, _WIRE_FP16, _WIRE_INT8 = 0, 1, 2, 3
+
 
 class Compressor:
+    #: native wire codec this compressor maps to when passed as
+    #: ``compression=`` on an eager collective (None = not wire-capable).
+    wire_codec = None
+
     @staticmethod
     def compress(tensor):
         """Returns (compressed_tensor, context)."""
@@ -20,6 +41,8 @@ class Compressor:
 
 
 class NoneCompressor(Compressor):
+    wire_codec = _WIRE_NONE
+
     @staticmethod
     def compress(tensor):
         return tensor, None
@@ -44,6 +67,8 @@ def _cast(tensor, dtype_name: str):
 
 
 class FP16Compressor(Compressor):
+    wire_codec = _WIRE_FP16
+
     @staticmethod
     def compress(tensor):
         dt = getattr(tensor, "dtype", None)
@@ -59,6 +84,8 @@ class FP16Compressor(Compressor):
 
 
 class BF16Compressor(Compressor):
+    wire_codec = _WIRE_BF16
+
     @staticmethod
     def compress(tensor):
         dt = getattr(tensor, "dtype", None)
@@ -73,10 +100,55 @@ class BF16Compressor(Compressor):
         return _cast(tensor, str(ctx).replace("torch.", ""))
 
 
+class Int8Compressor(Compressor):
+    """Blockwise-scaled int8 **wire** compression with error feedback.
+
+    Unlike the cast compressors above there is no meaningful int8
+    *tensor* representation to hand back to the framework (int8 values
+    cannot be summed by a collective without their scales), so the
+    cast API is an identity passthrough: the quantization lives
+    entirely inside the native TCP data plane, which keeps per-block
+    absmax scales on the wire and rank-local error-feedback residuals
+    so each step's rounding error is carried into the next
+    (``native/src/codec.cc``; EQuARX, arXiv:2506.17615). Use it as
+    ``hvd.allreduce(grad, compression=hvd.Compression.int8)`` or
+    job-wide via ``HOROVOD_WIRE_COMPRESSION=int8``.
+    """
+
+    wire_codec = _WIRE_INT8
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+def wire_codec_id(compression) -> int:
+    """Map a ``compression=`` argument to the native wire-codec id.
+
+    ``None`` means "follow the job-wide ``HOROVOD_WIRE_COMPRESSION``
+    default" (-1 on the wire); a :class:`Compressor` class or instance
+    maps through its ``wire_codec``. Anything else is a usage error —
+    better loud than a silently uncompressed wire.
+    """
+    if compression is None:
+        return -1
+    codec = getattr(compression, "wire_codec", None)
+    if codec is None:
+        raise ValueError(
+            f"compression must be None or a hvd.Compression member with a "
+            f"wire codec, got {compression!r}")
+    return int(codec)
+
+
 class Compression:
     """Namespace matching ``hvd.Compression.{none,fp16}`` + TPU-native
-    ``bf16``."""
+    ``bf16`` and the wire-level ``int8`` (error-feedback) codec."""
 
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+    int8 = Int8Compressor

@@ -26,6 +26,7 @@ from horovod_tpu.common import basics
 from horovod_tpu.common.exceptions import HorovodInternalError
 from horovod_tpu.common.ops_enum import ReduceOp
 from horovod_tpu.common.topology import Topology, topology_from_env
+from horovod_tpu.compression import wire_codec_id
 
 
 def _contig(a: np.ndarray) -> np.ndarray:
@@ -400,8 +401,13 @@ class Runtime:
                 postscale_factor: float = 1.0,
                 splits=None,
                 group_key: int = -1,
-                group_size: int = 0) -> Handle:
+                group_size: int = 0,
+                compression=None) -> Handle:
         self._check_init()
+        # Per-op wire codec for the host TCP data plane (-1 = follow
+        # HOROVOD_WIRE_COMPRESSION). CALLBACK (XLA) responses ignore it
+        # — device collectives ride ICI at their own dtype.
+        wire_codec = wire_codec_id(compression)
         kind, np_in, dev_in = self._classify(tensor)
 
         st = _InFlight()
@@ -469,7 +475,7 @@ class Runtime:
                 op, name.encode(), dt, shape_arr, len(shape), data_ptr,
                 out_ptr, root_rank, int(reduce_op), prescale_factor,
                 postscale_factor, splits_arr, nsplits, exec_mode,
-                group_key, group_size)
+                group_key, group_size, wire_codec)
             if handle < 0:
                 err = self.lib.hvd_last_enqueue_error().decode()
                 raise HorovodInternalError(err)

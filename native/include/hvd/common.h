@@ -153,6 +153,11 @@ struct TensorTableEntry {
   StatusCallback callback;
   int64_t group_key = -1;
   int32_t group_size = 0;
+  // Requested wire codec for the TCP data plane (hvd/codec.h values);
+  // -1 = follow the job-wide HOROVOD_WIRE_COMPRESSION knob. Resolved
+  // to a concrete codec by the coordinator so every rank encodes and
+  // decodes one response identically.
+  int8_t wire_codec = -1;
 };
 
 // Named timeline activities (reference common/common.h:33-64).

@@ -128,6 +128,7 @@ class Controller {
   int64_t shm_segment_bytes_ = 8 * 1024 * 1024;
   int shm_segment_depth_ = 2;
   int reduce_threads_ = 1;
+  int wire_codec_ = 0;  // hvd/codec.h WireCodec value (0 = none)
 
  public:
   void SetFusionThreshold(int64_t bytes) { fusion_threshold_bytes_ = bytes; }
@@ -158,6 +159,13 @@ class Controller {
     reduce_threads_ = n < 1 ? 1 : (n > 64 ? 64 : n);
   }
   int reduce_threads() const { return reduce_threads_; }
+  // Default wire codec for the TCP data plane
+  // (HOROVOD_WIRE_COMPRESSION; hvd/codec.h WireCodec values). Synced
+  // like the thresholds — the coordinator resolves it INTO each
+  // response, so this is the value "follow the default" requests get.
+  // Retargetable live by the autotuner through the tuned broadcast.
+  void SetWireCodec(int c) { wire_codec_ = c < 0 ? 0 : (c > 3 ? 3 : c); }
+  int wire_codec() const { return wire_codec_; }
   // Hierarchical allreduce: rank 0's env decides the request; the
   // value is only TRUE after Initialize when every rank's topology
   // fits the node-major layout (the verdict is broadcast — a per-rank
@@ -186,7 +194,7 @@ class Controller {
   void StageTunedParams(int64_t fusion, double cycle_ms,
                         int hierarchical = -1, int cache = -1,
                         int shm = -1, int reduce_threads = 0,
-                        int seg_depth = 0) {
+                        int seg_depth = 0, int wire_codec = -1) {
     staged_fusion_ = fusion;
     staged_cycle_ms_ = cycle_ms;
     staged_hier_ = hierarchical;
@@ -194,6 +202,7 @@ class Controller {
     staged_shm_ = shm;
     staged_threads_ = reduce_threads;
     staged_depth_ = seg_depth;
+    staged_wire_ = wire_codec;
   }
   // Autotuned runtime switches consulted by the data plane / cache
   // path each cycle (distinct from the INIT verdicts shm_enabled()
@@ -218,6 +227,7 @@ class Controller {
   int staged_shm_ = -1;
   int staged_threads_ = 0;  // 0 = no change
   int staged_depth_ = 0;    // 0 = no change
+  int staged_wire_ = -1;    // -1 = no change
   bool cache_active_ = true;
   bool shm_active_ = true;
 };

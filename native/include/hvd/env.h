@@ -42,6 +42,28 @@ inline int64_t EnvInt64Sane(const char* name, int64_t dflt, int64_t lo,
   return parsed;
 }
 
+// Choice knob: the value must match one of `choices` exactly (index
+// returned); anything else warns once and falls back to the default
+// index. Used for HOROVOD_WIRE_COMPRESSION, where a typo silently
+// meaning "no compression" — or worse, atoi'ing to codec 0 — would
+// make the operator believe the wire is compressed when it isn't.
+inline int EnvChoiceSane(const char* name, int dflt,
+                         const char* const* choices, int n_choices) {
+  const char* v = std::getenv(name);
+  if (v == nullptr || *v == '\0') return dflt;
+  for (int i = 0; i < n_choices; ++i) {
+    if (std::string(v) == choices[i]) return i;
+  }
+  if (EnvWarnOnce(name)) {
+    std::string opts;
+    for (int i = 0; i < n_choices; ++i)
+      opts += std::string(i ? "/" : "") + choices[i];
+    LOG_WARNING << "ignoring invalid " << name << "=" << v << " (want "
+                << opts << "); using default " << choices[dflt];
+  }
+  return dflt;
+}
+
 // Float knob: must parse fully and be strictly positive (every double
 // knob here is a duration/period).
 inline double EnvDoubleSane(const char* name, double dflt) {

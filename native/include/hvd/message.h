@@ -16,6 +16,17 @@
 
 namespace hvd {
 
+// Wire-format versions (the single byte leading each serialized list)
+// and the C ABI version the Python ctypes shim pins. Kept together
+// here so a bump is one edit — and guarded by tests/test_wire_abi.py,
+// which asserts the Python side expects the same numbers (a native
+// bump can't silently skew the shim).
+// v5: Request/Response carry wire_codec; ResponseList carries
+// tuned_wire_codec; hvd_enqueue gained the wire_codec argument.
+constexpr int kWireVersionRequestList = 2;
+constexpr int kWireVersionResponseList = 5;
+constexpr int kAbiVersion = 5;
+
 enum class RequestType : uint8_t {
   ALLREDUCE = 0,
   ALLGATHER = 1,
@@ -47,6 +58,10 @@ struct Request {
   // sorted member-name list. group_size = member count.
   int64_t group_key = -1;
   int32_t group_size = 0;
+  // Wire codec wish for the TCP data plane (hvd/codec.h): -1 = follow
+  // the coordinator's HOROVOD_WIRE_COMPRESSION value, 0-3 = explicit
+  // per-op override (hvd.allreduce(..., compression=...)).
+  int8_t wire_codec = -1;
 
   void SerializeTo(std::string* out) const;
   static bool ParseFrom(const char** p, const char* end, Request* out);
@@ -105,6 +120,13 @@ struct Response {
   // Cache bit positions this response (re)occupies, in tensor order;
   // kept in lockstep on every rank so hit indices agree.
   std::vector<uint32_t> cache_bits;
+  // RESOLVED wire codec for this response (never -1 here): the
+  // coordinator substitutes its synced HOROVOD_WIRE_COMPRESSION value
+  // for "follow the default" requests, so encoded chunk sizes agree on
+  // every rank by construction. Only the TCP ring/doubling exchanges
+  // consult it; shm and the intra-node phases of hierarchical mode
+  // stay full-precision.
+  int8_t wire_codec = 0;
 
   int64_t TotalByteSize() const;  // metadata-derived fused payload size
 
@@ -125,6 +147,7 @@ struct ResponseList {
   int8_t tuned_shm = -1;           // single-host shm data-plane flip
   int32_t tuned_reduce_threads = 0;   // host-reduction worker threads
   int32_t tuned_seg_depth = 0;        // shm pipeline depth (regions/slot)
+  int8_t tuned_wire_codec = -1;       // -1 = no change, 0-3 = new codec
 
   void SerializeTo(std::string* out) const;
   static bool ParseFrom(const std::string& buf, ResponseList* out);

@@ -19,8 +19,11 @@
 #pragma once
 
 #include <functional>
+#include <string>
+#include <unordered_map>
 #include <vector>
 
+#include "hvd/codec.h"
 #include "hvd/common.h"
 #include "hvd/controller.h"
 #include "hvd/fusion_buffer.h"
@@ -70,32 +73,66 @@ class TcpOps : public OpExecutor {
   Status Reducescatter(const Response& r,
                        std::vector<TensorTableEntry>& entries);
 
+  // Rank-local error-feedback residuals for the int8 wire codec, one
+  // slab per send-site class: `rs` indexes the ring reduce-scatter
+  // sends by fused element offset, `ag` the allgather-phase owner
+  // encodes, `dbl` the doubling exchange's per-round sends. Keyed per
+  // fused response (name + element count) so the same site's rounding
+  // error is carried into the next step of the SAME tensor (EF-SGD).
+  struct WireEfState {
+    std::vector<float> rs, ag, dbl;
+  };
+
   // Allreduce algorithms over the contributor set `ranks` (my position
   // is `p`). All operate in place on the packed fusion buffer.
   // The reduce-scatter phase pipelines its steps: the recv of chunk
   // k+1 drains in a helper thread while chunk k accumulates (also the
-  // backbone of Reducescatter's ring).
+  // backbone of Reducescatter's ring). With a non-NONE `codec` the
+  // wire payloads are encoded per chunk (f32 sum-class only; the
+  // caller guarantees it) and the encode overlaps the same recv
+  // pipeline; codec NONE keeps the PR 2 byte-for-byte behavior.
   Status RingReduceScatterPhase(uint8_t* buf,
                                 const std::vector<int64_t>& offs,
                                 DataType dtype, ReduceOp op,
-                                const std::vector<int>& ranks, int p);
+                                const std::vector<int>& ranks, int p,
+                                WireCodec codec = WireCodec::NONE,
+                                std::vector<float>* ef = nullptr);
   Status RingAllgatherPhase(uint8_t* buf, const std::vector<int64_t>& offs,
                             DataType dtype, const std::vector<int>& ranks,
-                            int p);
+                            int p, WireCodec codec = WireCodec::NONE,
+                            std::vector<float>* ef = nullptr);
   Status RingAllreduce(uint8_t* buf, int64_t elems, DataType dtype,
-                       ReduceOp op, const std::vector<int>& ranks, int p);
+                       ReduceOp op, const std::vector<int>& ranks, int p,
+                       WireCodec codec = WireCodec::NONE,
+                       WireEfState* ef = nullptr);
   // Two-level intra-node / cross-node decomposition (reference
-  // NCCLHierarchicalAllreduce, nccl_operations.cc:187-360).
+  // NCCLHierarchicalAllreduce, nccl_operations.cc:187-360). A non-NONE
+  // codec compresses ONLY the cross-node exchange — the intra-node
+  // phases ride fast local links where the bytes are cheap, and the
+  // inter-node hop is where quantized allreduce pays (EQuARX).
   Status HierarchicalAllreduce(uint8_t* buf, int64_t elems, DataType dtype,
-                               ReduceOp op);
+                               ReduceOp op,
+                               WireCodec codec = WireCodec::NONE,
+                               WireEfState* ef = nullptr);
   bool HierarchicalApplicable(const std::vector<int>& ranks) const;
   // Distance-doubling driver (fold/unfold for ragged P); `combine`
-  // folds a partner buffer into `buf` and must be symmetric.
+  // folds a partner buffer into `buf` and must be symmetric. With a
+  // codec, each exchange ships encoded buffers and BOTH partners
+  // combine the two decoded forms (own included), so results stay
+  // rank-identical; `ef` holds per-round residual slabs.
   Status DoublingExchange(uint8_t* buf, int64_t bytes,
                           const std::vector<int>& ranks, int p,
-                          const std::function<Status(const uint8_t*)>& combine);
+                          const std::function<Status(const uint8_t*)>& combine,
+                          WireCodec codec = WireCodec::NONE,
+                          std::vector<float>* ef = nullptr);
+  Status DoublingExchangeCompressed(
+      uint8_t* buf, int64_t bytes, const std::vector<int>& ranks, int p,
+      const std::function<Status(const uint8_t*)>& combine, WireCodec codec,
+      std::vector<float>* ef);
   Status RecursiveDoubling(uint8_t* buf, int64_t elems, DataType dtype,
-                           ReduceOp op, const std::vector<int>& ranks, int p);
+                           ReduceOp op, const std::vector<int>& ranks, int p,
+                           WireCodec codec = WireCodec::NONE,
+                           std::vector<float>* ef = nullptr);
   // Adasum recursive distance-doubling with per-tensor dot/norm
   // weighting (reference ops/adasum/adasum.h:166-330). `tensor_elems`
   // gives each fused tensor's element extent inside the buffer.
@@ -126,7 +163,21 @@ class TcpOps : public OpExecutor {
   // healthy arenas would wait in the barrier forever).
   bool ShmEligible(int64_t payload_bytes, Status* err);
 
+  // Create-or-get the EF residual state for one fused response
+  // identity (int8 wire only). Bounded: generated names could grow the
+  // map without limit, so it is cleared wholesale past a cap — losing
+  // residuals only costs one uncompensated step.
+  WireEfState* WireEf(const std::string& name, int64_t elems);
+
   int64_t ring_threshold_bytes_;  // below: recursive doubling
+  std::unordered_map<std::string, WireEfState> wire_ef_;
+  // Grow-only scratch for the compressed exchanges. A fresh
+  // std::vector per op would zero-fill and page-fault megabytes every
+  // allreduce — more CPU than the encode it stages. All ops run on the
+  // single background thread, and each phase finishes (receiver thread
+  // joined) before the next uses the pool, so reuse is race-free.
+  std::vector<uint8_t> wire_enc_a_, wire_enc_b_, wire_enc_c_;
+  std::vector<float> wire_dec_;
   std::unique_ptr<ShmArena> shm_;
   // Per-node arena (multi-host jobs with a node-major layout): the
   // intra-host stages of hierarchical collectives ride shared memory,

@@ -73,6 +73,16 @@ class ParameterManager {
                        bool depth_available);
   int reduce_threads() const { return threads_; }
   int seg_depth() const { return depth_; }
+
+  // Wire-compression codec (bayes mode): a LEVELED categorical over
+  // codec ids 0..max_level (hvd/codec.h order none < bf16 < fp16 <
+  // int8). max_level is the operator's HOROVOD_WIRE_COMPRESSION choice
+  // — the search may pick any codec AT OR BELOW that lossiness ceiling
+  // (it can back off to lossless, never exceed what the operator
+  // accepted). Offered only when max_level > 0.
+  void SetWireTunable(int max_level, int current);
+  int wire_codec() const { return wire_; }
+  bool wire_tunable() const { return tune_wire_; }
   // Whether the search actually owns each host knob: values are only
   // staged onto the broadcast when true, so an untuned knob never
   // clobbers a runtime override (hvd.set_reduce_threads) or a
@@ -120,6 +130,12 @@ class ParameterManager {
   bool tune_threads_ = false;
   bool tune_depth_ = false;
 
+  // Wire codec: one [0,1] search dimension quantized to the integer
+  // levels 0..wire_max_.
+  int wire_ = 0;
+  int wire_max_ = 0;
+  bool tune_wire_ = false;
+
   // Measurement window.
   double window_secs_ = 1.0;
   double window_start_ = -1.0;
@@ -141,6 +157,7 @@ class ParameterManager {
   int best_cat_[kNumCategoricals] = {0, 0, 0};
   int best_threads_ = 1;
   int best_depth_ = 2;
+  int best_wire_ = 0;
 
   std::ofstream log_;
 };

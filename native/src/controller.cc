@@ -119,11 +119,23 @@ Response Controller::ConstructResponse(const std::string& name,
             err = "mismatched grouping across ranks";
             break;
           }
+          if (r.wire_codec != first.wire_codec) {
+            err = "mismatched wire compression across ranks";
+            break;
+          }
         }
         if (err.empty() && first.request_type == RequestType::ALLREDUCE) {
           int64_t n = 1;
           for (auto d : first.tensor_shape) n *= d;
           resp.tensor_sizes.push_back(n);  // element count (hub sizing)
+          // Resolve "follow the default" (-1) to the coordinator's
+          // synced wire codec HERE so every rank sees one concrete
+          // codec per response — encoded chunk byte counts (and the
+          // whole exchange framing) derive from it, so a per-rank
+          // resolution could desync the ring.
+          resp.wire_codec = first.wire_codec >= 0
+                                ? first.wire_codec
+                                : static_cast<int8_t>(wire_codec_);
         }
         if (err.empty() && first.request_type == RequestType::REDUCESCATTER) {
           if (has_joined) {
@@ -354,6 +366,7 @@ ResponseList Controller::CoordinatorStep(
           continue;
         if (merged.response_type == ResponseType::ALLREDUCE &&
             (built[j].op_class != built[i].op_class ||
+             cand.wire_codec != merged.wire_codec ||
              cand.contributors != merged.contributors))
           continue;
         if (bytes + built[j].bytes > fusion_threshold_bytes_) continue;
@@ -408,6 +421,7 @@ void Controller::UpdateCacheFromResponses(const ResponseList& list) {
       req.exec_mode = entry.exec_mode;
       req.group_key = entry.group_key;
       req.group_size = entry.group_size;
+      req.wire_codec = entry.wire_codec;
       deps_.response_cache->Put(req);
     }
   }
@@ -507,7 +521,8 @@ Status TcpController::Initialize() {
                          (shm_wish_ ? "1" : "0") + ":" +
                          std::to_string(shm_segment_bytes_) + ":" +
                          std::to_string(shm_segment_depth_) + ":" +
-                         std::to_string(reduce_threads_);
+                         std::to_string(reduce_threads_) + ":" +
+                         std::to_string(wire_codec_);
     for (int peer = 1; peer < size_; ++peer) {
       if (!ctrl_conns_[peer].SendFrame(params))
         return Status::UnknownError("param sync: lost control link");
@@ -530,7 +545,8 @@ Status TcpController::Initialize() {
     auto c6 = c5 == std::string::npos ? c5 : params.find(':', c5 + 1);
     auto c7 = c6 == std::string::npos ? c6 : params.find(':', c6 + 1);
     auto c8 = c7 == std::string::npos ? c7 : params.find(':', c7 + 1);
-    if (!ok || c8 == std::string::npos)
+    auto c9 = c8 == std::string::npos ? c8 : params.find(':', c8 + 1);
+    if (!ok || c9 == std::string::npos)
       return Status::UnknownError("param sync: lost control link");
     fusion_threshold_bytes_ = std::atoll(params.c_str());
     ring_threshold_bytes_ = std::atoll(params.c_str() + c1 + 1);
@@ -541,6 +557,7 @@ Status TcpController::Initialize() {
     shm_segment_bytes_ = std::atoll(params.c_str() + c6 + 1);
     SetShmSegmentDepth(std::atoi(params.c_str() + c7 + 1));
     SetReduceThreads(std::atoi(params.c_str() + c8 + 1));
+    SetWireCodec(std::atoi(params.c_str() + c9 + 1));
   }
   return Status::OK();
 }
@@ -908,6 +925,7 @@ void TcpController::Broadcast(ResponseList& list) {
     list.tuned_shm = static_cast<int8_t>(staged_shm_);
     list.tuned_reduce_threads = staged_threads_;
     list.tuned_seg_depth = staged_depth_;
+    list.tuned_wire_codec = static_cast<int8_t>(staged_wire_);
     staged_fusion_ = 0;
     staged_cycle_ms_ = 0.0;
     staged_hier_ = -1;
@@ -915,6 +933,7 @@ void TcpController::Broadcast(ResponseList& list) {
     staged_shm_ = -1;
     staged_threads_ = 0;
     staged_depth_ = 0;
+    staged_wire_ = -1;
   }
   std::string buf;
   list.SerializeTo(&buf);

@@ -112,6 +112,7 @@ void Request::SerializeTo(std::string* out) const {
   WriteScalar<uint8_t>(out, static_cast<uint8_t>(exec_mode));
   WriteScalar<int64_t>(out, group_key);
   WriteScalar<int32_t>(out, group_size);
+  WriteScalar<int8_t>(out, wire_codec);
 }
 
 bool Request::ParseFrom(const char** p, const char* end, Request* r) {
@@ -124,7 +125,8 @@ bool Request::ParseFrom(const char** p, const char* end, Request* r) {
             ReadScalar(p, end, &r->postscale_factor) &&
             ReadVec(p, end, &r->splits) && ReadScalar(p, end, &em) &&
             ReadScalar(p, end, &r->group_key) &&
-            ReadScalar(p, end, &r->group_size);
+            ReadScalar(p, end, &r->group_size) &&
+            ReadScalar(p, end, &r->wire_codec);
   if (!ok) return false;
   r->request_type = static_cast<RequestType>(rt);
   r->tensor_type = static_cast<DataType>(tt);
@@ -134,7 +136,7 @@ bool Request::ParseFrom(const char** p, const char* end, Request* r) {
 }
 
 void RequestList::SerializeTo(std::string* out) const {
-  WriteScalar<uint8_t>(out, 1);  // version
+  WriteScalar<uint8_t>(out, kWireVersionRequestList);
   WriteScalar<uint8_t>(out, shutdown ? 1 : 0);
   WriteScalar<int32_t>(out, joined);
   WriteScalar<uint64_t>(out, cache_sig);
@@ -147,7 +149,8 @@ bool RequestList::ParseFrom(const std::string& buf, RequestList* out) {
   const char* p = buf.data();
   const char* end = p + buf.size();
   uint8_t ver, sd;
-  if (!ReadScalar(&p, end, &ver) || ver != 1) return false;
+  if (!ReadScalar(&p, end, &ver) || ver != kWireVersionRequestList)
+    return false;
   if (!ReadScalar(&p, end, &sd)) return false;
   out->shutdown = sd != 0;
   if (!ReadScalar(&p, end, &out->joined)) return false;
@@ -184,6 +187,7 @@ void Response::SerializeTo(std::string* out) const {
   WriteVec(out, recvsplits);
   WriteVec(out, cache_bits);
   WriteVec(out, contributors);
+  WriteScalar<int8_t>(out, wire_codec);
 }
 
 bool Response::ParseFrom(const char** p, const char* end, Response* r) {
@@ -202,11 +206,12 @@ bool Response::ParseFrom(const char** p, const char* end, Response* r) {
   for (uint32_t i = 0; i < n; ++i)
     if (!ReadString(p, end, &r->tensor_names[i])) return false;
   return ReadVec(p, end, &r->tensor_sizes) && ReadVec(p, end, &r->recvsplits) &&
-         ReadVec(p, end, &r->cache_bits) && ReadVec(p, end, &r->contributors);
+         ReadVec(p, end, &r->cache_bits) && ReadVec(p, end, &r->contributors) &&
+         ReadScalar(p, end, &r->wire_codec);
 }
 
 void ResponseList::SerializeTo(std::string* out) const {
-  WriteScalar<uint8_t>(out, 4);  // version
+  WriteScalar<uint8_t>(out, kWireVersionResponseList);
   WriteScalar<uint8_t>(out, shutdown ? 1 : 0);
   WriteScalar<uint8_t>(out, purge_cache ? 1 : 0);
   WriteScalar<int64_t>(out, tuned_fusion_threshold);
@@ -216,6 +221,7 @@ void ResponseList::SerializeTo(std::string* out) const {
   WriteScalar<int8_t>(out, tuned_shm);
   WriteScalar<int32_t>(out, tuned_reduce_threads);
   WriteScalar<int32_t>(out, tuned_seg_depth);
+  WriteScalar<int8_t>(out, tuned_wire_codec);
   WriteScalar<uint32_t>(out, static_cast<uint32_t>(responses.size()));
   for (const auto& r : responses) r.SerializeTo(out);
 }
@@ -224,7 +230,8 @@ bool ResponseList::ParseFrom(const std::string& buf, ResponseList* out) {
   const char* p = buf.data();
   const char* end = p + buf.size();
   uint8_t ver, sd, pc;
-  if (!ReadScalar(&p, end, &ver) || ver != 4) return false;
+  if (!ReadScalar(&p, end, &ver) || ver != kWireVersionResponseList)
+    return false;
   if (!ReadScalar(&p, end, &sd)) return false;
   out->shutdown = sd != 0;
   if (!ReadScalar(&p, end, &pc)) return false;
@@ -236,6 +243,7 @@ bool ResponseList::ParseFrom(const std::string& buf, ResponseList* out) {
   if (!ReadScalar(&p, end, &out->tuned_shm)) return false;
   if (!ReadScalar(&p, end, &out->tuned_reduce_threads)) return false;
   if (!ReadScalar(&p, end, &out->tuned_seg_depth)) return false;
+  if (!ReadScalar(&p, end, &out->tuned_wire_codec)) return false;
   uint32_t n;
   if (!ReadScalar(&p, end, &n)) return false;
   out->responses.resize(n);

@@ -24,6 +24,7 @@
 #include <unordered_map>
 #include <vector>
 
+#include "hvd/codec.h"
 #include "hvd/common.h"
 #include "hvd/controller.h"
 #include "hvd/env.h"
@@ -320,6 +321,13 @@ void BackgroundThreadLoop(GlobalState& st) {
       // execute or the arena desyncs.
       if (list.tuned_seg_depth > 0)
         st.controller->SetShmSegmentDepth(list.tuned_seg_depth);
+      // Wire codec agreement per response is already guaranteed (the
+      // coordinator resolves it into each Response); applying the
+      // tuned default here keeps this rank's introspected value — and
+      // any "follow the default" requests it originates as a future
+      // coordinator — truthful.
+      if (list.tuned_wire_codec >= 0)
+        st.controller->SetWireCodec(list.tuned_wire_codec);
     }
     for (const auto& resp : list.responses) PerformOperation(st, resp);
     if (list.shutdown) break;
@@ -354,7 +362,7 @@ void BackgroundThreadLoop(GlobalState& st) {
         // Stage host knobs only when the search owns them: an untuned
         // knob staged every window would clobber runtime overrides
         // (hvd.set_reduce_threads) with the stale init-time value.
-        int tuned_threads = 0, tuned_depth = 0;
+        int tuned_threads = 0, tuned_depth = 0, tuned_wire = -1;
         if (st.param_manager.threads_tunable()) {
           st.controller->SetReduceThreads(
               st.param_manager.reduce_threads());
@@ -365,11 +373,15 @@ void BackgroundThreadLoop(GlobalState& st) {
           st.controller->SetShmSegmentDepth(st.param_manager.seg_depth());
           tuned_depth = st.controller->shm_segment_depth();
         }
+        if (st.param_manager.wire_tunable()) {
+          st.controller->SetWireCodec(st.param_manager.wire_codec());
+          tuned_wire = st.controller->wire_codec();
+        }
         st.controller->StageTunedParams(
             st.param_manager.fusion_threshold(),
             st.param_manager.cycle_time_ms(), cat(PM::kCatHier),
             cat(PM::kCatCache), cat(PM::kCatShm), tuned_threads,
-            tuned_depth);
+            tuned_depth, tuned_wire);
       }
     }
     auto elapsed = std::chrono::steady_clock::now() - cycle_start;
@@ -404,6 +416,7 @@ Status EnqueueEntries(std::vector<TensorTableEntry> entries,
     req.exec_mode = e.exec_mode;
     req.group_key = e.group_key;
     req.group_size = e.group_size;
+    req.wire_codec = e.wire_codec;
     requests.push_back(std::move(req));
   }
   return st.tensor_queue.AddToTensorQueue(std::move(entries),
@@ -442,16 +455,19 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   st.cycle_time_ms = hvd::EnvDouble("HOROVOD_CYCLE_TIME", 1.0);
   st.response_cache.SetCapacity(static_cast<uint32_t>(
       hvd::EnvInt64("HOROVOD_CACHE_CAPACITY", 1024)));
-  st.fusion.SetInitialSize(
-      hvd::EnvInt64("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024));
+  // Single read of HOROVOD_FUSION_THRESHOLD: three subsystems consume
+  // it (fusion buffer sizing, autotune seed, controller threshold) and
+  // reading the environment three times would let them disagree if
+  // anything mutated the variable between reads.
+  const int64_t fusion_threshold =
+      hvd::EnvInt64("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024);
+  st.fusion.SetInitialSize(fusion_threshold);
   st.stall_inspector.SetWarningTime(
       hvd::EnvDouble("HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0));
   st.stall_inspector.SetShutdownTime(
       hvd::EnvDouble("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0));
   st.param_manager = hvd::ParameterManager();
-  st.param_manager.Initialize(
-      hvd::EnvInt64("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024),
-      st.cycle_time_ms);
+  st.param_manager.Initialize(fusion_threshold, st.cycle_time_ms);
   st.param_manager.SetEnabled(hvd::EnvInt64("HOROVOD_AUTOTUNE", 0) != 0);
   if (const char* lp = std::getenv("HOROVOD_AUTOTUNE_LOG"))
     st.param_manager.SetLogPath(lp);
@@ -473,8 +489,7 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   } else {
     st.controller = std::make_unique<hvd::LocalController>(deps);
   }
-  st.controller->SetFusionThreshold(
-      hvd::EnvInt64("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024));
+  st.controller->SetFusionThreshold(fusion_threshold);
   // Sanitized parses (warn once + default): atoll's silent 0 for
   // garbage would route every payload onto the ring / shrink the shm
   // segment to its floor without a trace.
@@ -496,6 +511,12 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
     st.controller->SetReduceThreads(static_cast<int>(
         hvd::EnvInt64Sane("HOROVOD_REDUCE_THREADS", dflt, 1, 64)));
   }
+  // Wire codec for the TCP data plane: a choice knob, not a number —
+  // garbage must not alias to "none" silently (the operator would
+  // believe the wire is compressed when it isn't).
+  st.controller->SetWireCodec(
+      hvd::EnvChoiceSane("HOROVOD_WIRE_COMPRESSION", 0,
+                         hvd::kWireCodecNames, hvd::kNumWireCodecs));
   st.controller->SetTopology(local_rank, local_size, cross_rank, cross_size);
   st.controller->SetHierarchical(
       hvd::EnvInt64("HOROVOD_HIERARCHICAL_ALLREDUCE", 0) != 0);
@@ -545,6 +566,12 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
         std::max(st.controller->reduce_threads(), std::min(16, hw)),
         st.controller->shm_segment_depth(),
         st.controller->shm_enabled() && size > 1);
+    // Wire codec joins the search only when the operator already opted
+    // into lossy wire via HOROVOD_WIRE_COMPRESSION (the ceiling): the
+    // tuner may back off toward lossless, never add loss on its own.
+    st.param_manager.SetWireTunable(
+        size > 1 ? st.controller->wire_codec() : 0,
+        st.controller->wire_codec());
   }
   if (!s.ok()) {
     LOG_ERROR << "controller init failed: " << s.reason();
@@ -576,9 +603,11 @@ void hvd_shutdown() {
 
 // Bump whenever the callback signatures or the wire format change; the
 // Python bridge refuses to load a library whose version disagrees.
-// v4: ResponseList tuned_reduce_threads/tuned_seg_depth + host kernel
-// entry points.
-int hvd_abi_version() { return 4; }
+// v5: hvd_enqueue gained wire_codec; wire codec kernel entry points;
+// Request/Response/ResponseList carry wire-compression fields. The
+// authoritative constant lives in message.h next to the wire versions
+// (tests/test_wire_abi.py pins all three against the Python shim).
+int hvd_abi_version() { return hvd::kAbiVersion; }
 
 int hvd_initialized() { return hvd::State().initialized.load() ? 1 : 0; }
 int hvd_rank() { return hvd::State().rank; }
@@ -608,7 +637,7 @@ int64_t hvd_enqueue(int op_type, const char* name, int dtype,
                     void* output, int root_rank, int reduce_op,
                     double prescale, double postscale, const int64_t* splits,
                     int nsplits, int exec_mode, int64_t group_key,
-                    int group_size) {
+                    int group_size, int wire_codec) {
   auto& st = hvd::State();
   hvd::TensorTableEntry e;
   e.name = name;
@@ -625,6 +654,8 @@ int64_t hvd_enqueue(int op_type, const char* name, int dtype,
   e.exec_mode = static_cast<hvd::ExecMode>(exec_mode);
   e.group_key = group_key;
   e.group_size = group_size;
+  e.wire_codec = static_cast<int8_t>(
+      wire_codec < -1 || wire_codec > 3 ? -1 : wire_codec);
   int64_t handle = st.handles.Allocate();
   e.handle = handle;
   e.callback = [&st, handle](const hvd::Status& s) {
@@ -645,13 +676,15 @@ const char* hvd_last_enqueue_error() { return g_last_enqueue_error.c_str(); }
 int64_t hvd_join() {
   return hvd_enqueue(static_cast<int>(hvd::RequestType::JOIN), "join",
                      static_cast<int>(hvd::DataType::UINT8), nullptr, 0,
-                     nullptr, nullptr, 0, 1, 1.0, 1.0, nullptr, 0, 0, -1, 0);
+                     nullptr, nullptr, 0, 1, 1.0, 1.0, nullptr, 0, 0, -1, 0,
+                     -1);
 }
 
 int64_t hvd_barrier() {
   return hvd_enqueue(static_cast<int>(hvd::RequestType::BARRIER), "barrier",
                      static_cast<int>(hvd::DataType::UINT8), nullptr, 0,
-                     nullptr, nullptr, 0, 1, 1.0, 1.0, nullptr, 0, 0, -1, 0);
+                     nullptr, nullptr, 0, 1, 1.0, 1.0, nullptr, 0, 0, -1, 0,
+                     -1);
 }
 
 int hvd_poll(int64_t handle) {
@@ -753,6 +786,26 @@ void hvd_host_scale(int dtype, void* dst, int64_t count, double factor) {
 
 void hvd_set_reduce_threads(int n) { hvd::SetHostReduceThreads(n); }
 int hvd_reduce_threads() { return hvd::HostReduceThreads(); }
+
+// Wire-codec kernel entry points (tests/test_host_kernels.py drives
+// the encode/decode matrix — incl. error feedback and thread-count
+// bitwise invariance — against numpy models through ctypes).
+int64_t hvd_wire_encoded_bytes(int codec, int64_t elems) {
+  return hvd::WireEncodedBytes(static_cast<hvd::WireCodec>(codec), elems);
+}
+void hvd_wire_encode(int codec, const float* src, int64_t elems,
+                     uint8_t* dst, float* residual) {
+  hvd::WireEncode(static_cast<hvd::WireCodec>(codec), src, elems, dst,
+                  residual);
+}
+void hvd_wire_decode(int codec, const uint8_t* src, int64_t elems,
+                     float* dst) {
+  hvd::WireDecode(static_cast<hvd::WireCodec>(codec), src, elems, dst);
+}
+void hvd_wire_decode_add(int codec, const uint8_t* src, int64_t elems,
+                         float* dst) {
+  hvd::WireDecodeAdd(static_cast<hvd::WireCodec>(codec), src, elems, dst);
+}
 
 // Test hooks: drive the Bayesian autotune optimizer (hvd/bayesian.h)
 // against a caller-provided objective, so tests can assert global

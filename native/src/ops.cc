@@ -7,6 +7,7 @@
 #include <thread>
 #include <vector>
 
+#include "hvd/codec.h"
 #include "hvd/env.h"
 #include "hvd/half.h"
 #include "hvd/logging.h"
@@ -499,19 +500,57 @@ Status TcpOps::Allreduce(const Response& r,
   if (!shm_err.ok()) return shm_err;
   if (use_shm)
     return ShmAllreduceFused(r, entries, total_elems, dtype, size);
-  uint8_t* buf = static_cast<uint8_t*>(fusion_->GetBuffer(0, total_bytes));
 
-  // Pack, applying prescale.
-  if (timeline_) timeline_->ActivityStart(tname, ACT_MEMCPY_IN_FUSION_BUFFER);
-  int64_t off = 0;
-  for (auto& e : entries) {
-    int64_t bytes = e.shape.num_elements() * DataTypeSize(e.dtype);
-    std::memcpy(buf + off, e.data, bytes);
+  // Single-tensor responses run the exchange IN PLACE on the output
+  // buffer: the fusion-buffer staging exists to concatenate many
+  // entries, and for one entry it costs a full pack + unpack memcpy
+  // pair (the dominant non-wire cost at MB sizes) for nothing. The
+  // algorithms only see a byte buffer, so the arithmetic — and the
+  // result bits — are unchanged.
+  const bool in_place =
+      entries.size() == 1 && entries.front().output != nullptr;
+  uint8_t* buf;
+  if (in_place) {
+    auto& e = entries.front();
+    if (timeline_)
+      timeline_->ActivityStart(tname, ACT_MEMCPY_IN_FUSION_BUFFER);
+    if (e.output != e.data)
+      ParallelMemcpy(e.output, e.data, total_bytes);
     if (e.prescale_factor != 1.0)
-      HostScale(e.dtype, buf + off, e.shape.num_elements(), e.prescale_factor);
-    off += bytes;
+      HostScale(dtype, e.output, total_elems, e.prescale_factor);
+    if (timeline_) timeline_->ActivityEnd(tname);
+    buf = static_cast<uint8_t*>(e.output);
+  } else {
+    buf = static_cast<uint8_t*>(fusion_->GetBuffer(0, total_bytes));
+
+    // Pack, applying prescale.
+    if (timeline_)
+      timeline_->ActivityStart(tname, ACT_MEMCPY_IN_FUSION_BUFFER);
+    int64_t off = 0;
+    for (auto& e : entries) {
+      int64_t bytes = e.shape.num_elements() * DataTypeSize(e.dtype);
+      std::memcpy(buf + off, e.data, bytes);
+      if (e.prescale_factor != 1.0)
+        HostScale(e.dtype, buf + off, e.shape.num_elements(),
+                  e.prescale_factor);
+      off += bytes;
+    }
+    if (timeline_) timeline_->ActivityEnd(tname);
   }
-  if (timeline_) timeline_->ActivityEnd(tname);
+
+  // Wire compression (coordinator-resolved per response): only float32
+  // sum-class payloads qualify — the codecs' accumulate/decode math is
+  // additive, Adasum's combine is not, and 16-bit dtypes already ride
+  // the wire at their storage width. Non-qualifying responses fall
+  // back to the uncompressed (PR 2 bitwise-identical) exchanges.
+  WireCodec codec = static_cast<WireCodec>(
+      r.wire_codec > 0 ? r.wire_codec : 0);
+  if (dtype != DataType::FLOAT32 ||
+      (r.reduce_op != ReduceOp::SUM && r.reduce_op != ReduceOp::AVERAGE))
+    codec = WireCodec::NONE;
+  WireEfState* ef = codec == WireCodec::INT8
+                        ? WireEf(tname, total_elems)
+                        : nullptr;
 
   if (timeline_) timeline_->ActivityStart(tname, ACT_TCP_ALLREDUCE);
   Status st = Status::OK();
@@ -521,12 +560,15 @@ Status TcpOps::Allreduce(const Response& r,
       st = AdasumAllreduce(buf, dtype, tensor_elems, ranks, p);
     } else if (HierarchicalApplicable(ranks) &&
                total_bytes >= ring_threshold_bytes_) {
-      st = HierarchicalAllreduce(buf, total_elems, dtype, r.reduce_op);
+      st = HierarchicalAllreduce(buf, total_elems, dtype, r.reduce_op,
+                                 codec, ef);
     } else if (total_bytes >= ring_threshold_bytes_ &&
                static_cast<int>(ranks.size()) >= 3) {
-      st = RingAllreduce(buf, total_elems, dtype, r.reduce_op, ranks, p);
+      st = RingAllreduce(buf, total_elems, dtype, r.reduce_op, ranks, p,
+                         codec, ef);
     } else {
-      st = RecursiveDoubling(buf, total_elems, dtype, r.reduce_op, ranks, p);
+      st = RecursiveDoubling(buf, total_elems, dtype, r.reduce_op, ranks, p,
+                             codec, ef ? &ef->dbl : nullptr);
     }
   }
   if (timeline_) timeline_->ActivityEnd(tname);
@@ -535,17 +577,24 @@ Status TcpOps::Allreduce(const Response& r,
   // Unpack with postscale (+ 1/size for AVERAGE; joined ranks count as
   // zero contributions, matching the reference's Join semantics).
   if (timeline_) timeline_->ActivityStart(tname, ACT_MEMCPY_OUT_FUSION_BUFFER);
-  off = 0;
-  for (auto& e : entries) {
-    int64_t n = e.shape.num_elements();
-    int64_t bytes = n * DataTypeSize(e.dtype);
-    if (e.output) {
-      std::memcpy(e.output, src + off, bytes);
-      double factor = e.postscale_factor;
-      if (e.reduce_op == ReduceOp::AVERAGE) factor /= size;
-      if (factor != 1.0) HostScale(e.dtype, e.output, n, factor);
+  if (in_place) {
+    auto& e = entries.front();
+    double factor = e.postscale_factor;
+    if (e.reduce_op == ReduceOp::AVERAGE) factor /= size;
+    if (factor != 1.0) HostScale(e.dtype, e.output, total_elems, factor);
+  } else {
+    int64_t off = 0;
+    for (auto& e : entries) {
+      int64_t n = e.shape.num_elements();
+      int64_t bytes = n * DataTypeSize(e.dtype);
+      if (e.output) {
+        std::memcpy(e.output, src + off, bytes);
+        double factor = e.postscale_factor;
+        if (e.reduce_op == ReduceOp::AVERAGE) factor /= size;
+        if (factor != 1.0) HostScale(e.dtype, e.output, n, factor);
+      }
+      off += bytes;
     }
-    off += bytes;
   }
   if (timeline_) timeline_->ActivityEnd(tname);
   return Status::OK();
@@ -746,7 +795,9 @@ Status TcpOps::ShmAllreduceFused(const Response& r,
 Status TcpOps::RingReduceScatterPhase(uint8_t* buf,
                                       const std::vector<int64_t>& offs,
                                       DataType dtype, ReduceOp op,
-                                      const std::vector<int>& ranks, int p) {
+                                      const std::vector<int>& ranks, int p,
+                                      WireCodec codec,
+                                      std::vector<float>* ef) {
   // P-1 steps over element-offset chunks `offs`; chunk k starts at ring
   // position k+1 and lands fully reduced on position k.
   //
@@ -769,6 +820,101 @@ Status TcpOps::RingReduceScatterPhase(uint8_t* buf,
   int64_t max_chunk = 0;
   for (int k = 0; k < P; ++k)
     max_chunk = std::max(max_chunk, offs[k + 1] - offs[k]);
+
+  // Compressed wire (f32 sum-class; Allreduce gates it): every chunk
+  // ships encoded — bf16/fp16 halve the bytes, int8 cuts ~3.9x with
+  // per-block scales — and each hop decode-accumulates. The codec path
+  // is a separate loop so `none` stays byte-for-byte the PR 2 code.
+  if (codec != WireCodec::NONE) {
+    float* fbuf = reinterpret_cast<float*>(buf);
+    float* efd = nullptr;
+    if (ef) {
+      // Residuals index by fused element offset, so a send site (this
+      // rank x chunk) reuses its slice every iteration of the same
+      // fused response; a composition change resets to zero.
+      if (static_cast<int64_t>(ef->size()) != offs[P])
+        ef->assign(static_cast<size_t>(offs[P]), 0.0f);
+      efd = ef->data();
+    }
+    const int64_t enc_max = WireEncodedBytes(codec, max_chunk);
+    if (static_cast<int64_t>(wire_enc_a_.size()) < enc_max)
+      wire_enc_a_.resize(enc_max);
+    if (static_cast<int64_t>(wire_enc_b_.size()) < enc_max)
+      wire_enc_b_.resize(enc_max);
+    if (static_cast<int64_t>(wire_enc_c_.size()) < enc_max)
+      wire_enc_c_.resize(enc_max);
+    uint8_t* enc_send = wire_enc_a_.data();
+    auto enc_bytes = [&](int64_t n) { return WireEncodedBytes(codec, n); };
+    // Relay fusion: step s forwards the chunk received at step s-1, so
+    // its fp32 accumulated form is dead the moment the encoded bytes
+    // leave — WireDecodeAddEncode folds my contribution straight from
+    // encoded-in to encoded-out and never stores the sum. Only the
+    // final chunk (the one this rank owns after the phase) lands in
+    // fbuf; the allgather phase overwrites every other chunk anyway.
+    if (max_chunk * esize <= 8 * 1024) {
+      uint8_t* enc_recv = wire_enc_b_.data();
+      int last_cr = -1;
+      for (int s = 0; s < P - 1; ++s) {
+        int cs = ((p - s - 1) % P + P) % P, cr = ((p - s - 2) % P + P) % P;
+        const int64_t cs_n = offs[cs + 1] - offs[cs];
+        const int64_t cr_n = offs[cr + 1] - offs[cr];
+        if (s == 0) {
+          WireEncode(codec, fbuf + offs[cs], cs_n, enc_send,
+                     efd ? efd + offs[cs] : nullptr);
+        } else {
+          WireDecodeAddEncode(codec, enc_recv, fbuf + offs[cs], cs_n,
+                              enc_send, efd ? efd + offs[cs] : nullptr);
+        }
+        if (!SendRecv(next, enc_send, enc_bytes(cs_n), prev, enc_recv,
+                      enc_bytes(cr_n)))
+          return Status::UnknownError("ring allreduce: lost data connection");
+        last_cr = cr;
+      }
+      if (last_cr >= 0)
+        WireDecodeAdd(codec, enc_recv, offs[last_cr + 1] - offs[last_cr],
+                      fbuf + offs[last_cr]);
+      return Status::OK();
+    }
+    // Pipelined schedule, same dependency argument as the raw path:
+    // step s's send chunk cs equals step s-1's received chunk, so the
+    // relay (decode prev bytes + add my contribution + re-encode)
+    // strictly precedes this step's send in program order, while the
+    // recv of chunk cr drains in the helper thread — the encode rides
+    // the overlap the PR 2 pipeline opened.
+    uint8_t* enc_scratch[2] = {wire_enc_b_.data(), wire_enc_c_.data()};
+    int last_cr = -1;
+    for (int s = 0; s < P - 1; ++s) {
+      const int cs = ((p - s - 1) % P + P) % P;
+      const int cr = ((p - s - 2) % P + P) % P;
+      const int64_t cs_n = offs[cs + 1] - offs[cs];
+      const int64_t cr_n = offs[cr + 1] - offs[cr];
+      std::atomic<bool> recv_ok{true};
+      uint8_t* rbuf = enc_scratch[s % 2];
+      const int64_t rbytes = enc_bytes(cr_n);
+      std::thread receiver([&, rbuf, rbytes] {
+        if (!prev->RecvAll(rbuf, rbytes))
+          recv_ok.store(false, std::memory_order_relaxed);
+      });
+      if (s == 0) {
+        WireEncode(codec, fbuf + offs[cs], cs_n, enc_send,
+                   efd ? efd + offs[cs] : nullptr);
+      } else {
+        WireDecodeAddEncode(codec, enc_scratch[(s - 1) % 2],
+                            fbuf + offs[cs], cs_n, enc_send,
+                            efd ? efd + offs[cs] : nullptr);
+      }
+      const bool send_ok = next->SendAll(enc_send, enc_bytes(cs_n));
+      receiver.join();
+      if (!send_ok || !recv_ok.load(std::memory_order_relaxed))
+        return Status::UnknownError("ring allreduce: lost data connection");
+      last_cr = cr;
+    }
+    if (last_cr >= 0)
+      WireDecodeAdd(codec, enc_scratch[(P - 2) % 2],
+                    offs[last_cr + 1] - offs[last_cr], fbuf + offs[last_cr]);
+    return Status::OK();
+  }
+
   // Chunks below the kernel's minimum socket buffer can't block in
   // send() and the reduce is nanoseconds — the thread handshake would
   // cost more than it overlaps. Same cutover as SendRecv's.
@@ -820,12 +966,80 @@ Status TcpOps::RingReduceScatterPhase(uint8_t* buf,
 Status TcpOps::RingAllgatherPhase(uint8_t* buf,
                                   const std::vector<int64_t>& offs,
                                   DataType dtype,
-                                  const std::vector<int>& ranks, int p) {
+                                  const std::vector<int>& ranks, int p,
+                                  WireCodec codec,
+                                  std::vector<float>* ef) {
   // P-1 forwarding steps; position p starts owning chunk p.
   const int P = static_cast<int>(ranks.size());
   const int64_t esize = DataTypeSize(dtype);
   TcpConn* next = controller_->DataConn(ranks[(p + 1) % P]);
   TcpConn* prev = controller_->DataConn(ranks[(p - 1 + P) % P]);
+
+  // Compressed wire: each chunk is encoded ONCE — by its owner, with
+  // error feedback on the owner's residual slice — and the encoded
+  // bytes are forwarded verbatim around the ring, so a chunk pays a
+  // single quantization no matter how many hops it rides. The owner
+  // also replaces its own copy with the decoded form, so every rank
+  // ends the phase holding the identical deQ(owner bytes) — the
+  // allreduce's all-ranks-agree contract survives compression.
+  if (codec != WireCodec::NONE) {
+    float* fbuf = reinterpret_cast<float*>(buf);
+    float* efd = nullptr;
+    if (ef) {
+      if (static_cast<int64_t>(ef->size()) != offs[P])
+        ef->assign(static_cast<size_t>(offs[P]), 0.0f);
+      efd = ef->data();
+    }
+    int64_t max_chunk = 0;
+    for (int k = 0; k < P; ++k)
+      max_chunk = std::max(max_chunk, offs[k + 1] - offs[k]);
+    const int64_t enc_max = WireEncodedBytes(codec, max_chunk);
+    if (static_cast<int64_t>(wire_enc_a_.size()) < enc_max)
+      wire_enc_a_.resize(enc_max);
+    if (static_cast<int64_t>(wire_enc_b_.size()) < enc_max)
+      wire_enc_b_.resize(enc_max);
+    uint8_t* send_enc = wire_enc_a_.data();
+    uint8_t* recv_enc = wire_enc_b_.data();
+    int last_cr = -1;
+    for (int s = 0; s < P - 1; ++s) {
+      const int cs = ((p - s) % P + P) % P;
+      const int cr = ((p - s - 1) % P + P) % P;
+      const int64_t cs_n = offs[cs + 1] - offs[cs];
+      const int64_t cr_n = offs[cr + 1] - offs[cr];
+      if (s == 0)
+        WireEncode(codec, fbuf + offs[cs], cs_n, send_enc,
+                   efd ? efd + offs[cs] : nullptr);
+      // Both socket directions drain in helper threads while the main
+      // thread decodes the chunk being forwarded (read-only against
+      // the concurrent sender): step 0 replaces my own chunk with its
+      // dequantized form (the all-ranks-agree guarantee), later steps
+      // land the previous hop's chunk. The last received chunk — never
+      // forwarded — decodes after the loop.
+      std::atomic<bool> io_ok{true};
+      std::thread sender([&] {
+        if (!next->SendAll(send_enc, WireEncodedBytes(codec, cs_n)))
+          io_ok.store(false, std::memory_order_relaxed);
+      });
+      std::thread receiver([&] {
+        if (!prev->RecvAll(recv_enc, WireEncodedBytes(codec, cr_n)))
+          io_ok.store(false, std::memory_order_relaxed);
+      });
+      WireDecode(codec, send_enc, cs_n, fbuf + offs[cs]);
+      sender.join();
+      receiver.join();
+      if (!io_ok.load(std::memory_order_relaxed))
+        return Status::UnknownError("ring allreduce: lost data connection");
+      // The chunk received this step is the one forwarded next step:
+      // swap so its encoded bytes go out untouched.
+      std::swap(send_enc, recv_enc);
+      last_cr = cr;
+    }
+    if (last_cr >= 0)
+      WireDecode(codec, send_enc, offs[last_cr + 1] - offs[last_cr],
+                 fbuf + offs[last_cr]);
+    return Status::OK();
+  }
+
   for (int s = 0; s < P - 1; ++s) {
     int cs = ((p - s) % P + P) % P, cr = ((p - s - 1) % P + P) % P;
     if (!SendRecv(next, buf + offs[cs] * esize,
@@ -919,18 +1133,24 @@ Status TcpOps::HierarchicalShmAllgather(
 
 Status TcpOps::RingAllreduce(uint8_t* buf, int64_t elems, DataType dtype,
                              ReduceOp op, const std::vector<int>& ranks,
-                             int p) {
+                             int p, WireCodec codec, WireEfState* ef) {
   // Bandwidth-optimal ring: P-1 reduce-scatter steps + P-1 allgather
   // steps, each moving 1/P of the payload — 2·(P-1)/P · bytes per rank
-  // total, vs. 2·bytes through one socket in the v1 hub.
+  // total, vs. 2·bytes through one socket in the v1 hub. A wire codec
+  // shrinks both phases' bytes; the two phases keep separate EF slabs
+  // because the same chunk offset carries different content in each
+  // (partial sums vs. the final reduction).
   auto offs = ChunkOffsets(elems, static_cast<int>(ranks.size()));
-  Status st = RingReduceScatterPhase(buf, offs, dtype, op, ranks, p);
+  Status st = RingReduceScatterPhase(buf, offs, dtype, op, ranks, p, codec,
+                                     ef ? &ef->rs : nullptr);
   if (!st.ok()) return st;
-  return RingAllgatherPhase(buf, offs, dtype, ranks, p);
+  return RingAllgatherPhase(buf, offs, dtype, ranks, p, codec,
+                            ef ? &ef->ag : nullptr);
 }
 
 Status TcpOps::HierarchicalAllreduce(uint8_t* buf, int64_t elems,
-                                     DataType dtype, ReduceOp op) {
+                                     DataType dtype, ReduceOp op,
+                                     WireCodec codec, WireEfState* ef) {
   // Two-level decomposition (reference NCCLHierarchicalAllreduce,
   // nccl_operations.cc:187-360: intra-node reduce-scatter → cross-node
   // allreduce → intra-node allgather). On TPU pods the analog is
@@ -949,7 +1169,11 @@ Status TcpOps::HierarchicalAllreduce(uint8_t* buf, int64_t elems,
   Status st = RingReduceScatterPhase(buf, offs, dtype, op, local, lr);
   if (!st.ok()) return st;
 
-  // Cross-node allreduce of my shard among same-local-rank peers.
+  // Cross-node allreduce of my shard among same-local-rank peers. This
+  // is the hop wire compression targets in hierarchical mode: the
+  // intra-node ring phases above/below ride loopback or node-local
+  // links at full precision, while the DCN-analog inter-node exchange
+  // ships encoded bytes (EQuARX's placement of the quantization win).
   const int C = controller_->size() / L;
   std::vector<int> cross(C);
   for (int k = 0; k < C; ++k) cross[k] = k * L + lr;
@@ -960,7 +1184,8 @@ Status TcpOps::HierarchicalAllreduce(uint8_t* buf, int64_t elems,
         HostAccumulate(op, dtype, theirs, buf + offs[lr] * esize,
                        offs[lr + 1] - offs[lr]);
         return Status::OK();
-      });
+      },
+      codec, ef ? &ef->dbl : nullptr);
   if (!st.ok()) return st;
 
   return RingAllgatherPhase(buf, offs, dtype, local, lr);
@@ -979,7 +1204,11 @@ bool TcpOps::HierarchicalApplicable(const std::vector<int>& ranks) const {
 
 Status TcpOps::DoublingExchange(
     uint8_t* buf, int64_t bytes, const std::vector<int>& ranks, int p,
-    const std::function<Status(const uint8_t*)>& combine) {
+    const std::function<Status(const uint8_t*)>& combine, WireCodec codec,
+    std::vector<float>* ef) {
+  if (codec != WireCodec::NONE)
+    return DoublingExchangeCompressed(buf, bytes, ranks, p, combine, codec,
+                                      ef);
   // Shared scaffolding for full-buffer recursive distance-doubling:
   // log2(P) exchanges with partners at doubling distances, `combine`
   // folding the partner's buffer into ours. Non-power-of-two counts use
@@ -1028,16 +1257,124 @@ Status TcpOps::DoublingExchange(
   return Status::OK();
 }
 
+Status TcpOps::DoublingExchangeCompressed(
+    uint8_t* buf, int64_t bytes, const std::vector<int>& ranks, int p,
+    const std::function<Status(const uint8_t*)>& combine, WireCodec codec,
+    std::vector<float>* ef) {
+  // Codec-bearing variant of DoublingExchange (f32 sum-class only; the
+  // Allreduce gate guarantees it). Each pairing ships encoded buffers
+  // both ways and BOTH partners combine the two DECODED forms — own
+  // included — so a pair ends bitwise identical (the elementwise
+  // combine is commutative), and by induction over rounds every rank
+  // lands on the same bytes. Error feedback keeps one residual slab
+  // PER ROUND: a round's send site always quantizes the same stage of
+  // the reduction, so its rounding error is carried into the next
+  // iteration's same-round send (and residual histories stay equal
+  // across ranks whose values agree, preserving the agreement
+  // argument). The fold/unfold legs of ragged P are point-to-point
+  // hand-offs, not persistent sites — they quantize without feedback,
+  // and the unfold sender self-decodes so the odd partner agrees.
+  const int P = static_cast<int>(ranks.size());
+  int q = 1;
+  while (q * 2 <= P) q *= 2;
+  const int t = P - q;
+  const int64_t elems = bytes / 4;
+  float* fbuf = reinterpret_cast<float*>(buf);
+  const int64_t eb = WireEncodedBytes(codec, elems);
+  if (static_cast<int64_t>(wire_enc_a_.size()) < eb) wire_enc_a_.resize(eb);
+  if (static_cast<int64_t>(wire_enc_b_.size()) < eb) wire_enc_b_.resize(eb);
+  if (static_cast<int64_t>(wire_dec_.size()) < elems)
+    wire_dec_.resize(elems);
+  uint8_t* enc_mine = wire_enc_a_.data();
+  uint8_t* enc_theirs = wire_enc_b_.data();
+  float* dec = wire_dec_.data();
+  int rounds = 0;
+  for (int d = 1; d < q; d *= 2) ++rounds;
+  float* efd = nullptr;
+  if (ef && rounds > 0 && elems > 0) {
+    if (static_cast<int64_t>(ef->size()) != rounds * elems)
+      ef->assign(static_cast<size_t>(rounds * elems), 0.0f);
+    efd = ef->data();
+  }
+
+  int v;  // my index within the q-member core
+  if (p < 2 * t) {
+    if (p % 2 == 1) {
+      WireEncode(codec, fbuf, elems, enc_mine, nullptr);
+      if (!controller_->DataConn(ranks[p - 1])->SendAll(enc_mine,
+                                                       eb) ||
+          !controller_->DataConn(ranks[p - 1])->RecvAll(enc_theirs,
+                                                        eb))
+        return Status::UnknownError("allreduce fold: lost data connection");
+      WireDecode(codec, enc_theirs, elems, fbuf);
+      return Status::OK();
+    }
+    if (!controller_->DataConn(ranks[p + 1])->RecvAll(enc_theirs, eb))
+      return Status::UnknownError("allreduce fold: lost data connection");
+    WireDecode(codec, enc_theirs, elems, dec);
+    Status st = combine(reinterpret_cast<const uint8_t*>(dec));
+    if (!st.ok()) return st;
+    v = p / 2;
+  } else {
+    v = p - t;
+  }
+  auto pos_of = [&](int vi) { return vi < t ? 2 * vi : vi + t; };
+  int ri = 0;
+  for (int d = 1; d < q; d *= 2, ++ri) {
+    int partner = pos_of(v ^ d);
+    TcpConn* conn = controller_->DataConn(ranks[partner]);
+    WireEncode(codec, fbuf, elems, enc_mine,
+               efd ? efd + ri * elems : nullptr);
+    if (!SendRecv(conn, enc_mine, eb, conn, enc_theirs, eb))
+      return Status::UnknownError("allreduce: lost data connection");
+    // Self-decode BEFORE combining: my buffer must hold the same
+    // quantized form of my contribution that the partner decoded, or
+    // the two sides drift apart by my rounding error.
+    WireDecode(codec, enc_mine, elems, fbuf);
+    WireDecode(codec, enc_theirs, elems, dec);
+    Status st = combine(reinterpret_cast<const uint8_t*>(dec));
+    if (!st.ok()) return st;
+  }
+  if (t > 0) {
+    // Ragged P republishes the result to the folded-out odd ranks in
+    // quantized form, so EVERY core rank — not just the fold pairs —
+    // must requantize its own copy: a solo core rank keeping the
+    // pre-quantization value would drift off the others by one
+    // rounding epsilon, the replica divergence allreduce exists to
+    // prevent. (Power-of-two worlds skip this: the rounds already end
+    // with every rank combining the same decoded byte strings.)
+    WireEncode(codec, fbuf, elems, enc_mine, nullptr);
+    WireDecode(codec, enc_mine, elems, fbuf);
+    if (p < 2 * t) {
+      if (!controller_->DataConn(ranks[p + 1])->SendAll(enc_mine, eb))
+        return Status::UnknownError("allreduce unfold: lost data connection");
+    }
+  }
+  return Status::OK();
+}
+
 Status TcpOps::RecursiveDoubling(uint8_t* buf, int64_t elems, DataType dtype,
                                  ReduceOp op, const std::vector<int>& ranks,
-                                 int p) {
+                                 int p, WireCodec codec,
+                                 std::vector<float>* ef) {
   // Latency-optimal path for small payloads.
   return DoublingExchange(
       buf, elems * DataTypeSize(dtype), ranks, p,
       [&](const uint8_t* theirs) {
         HostAccumulate(op, dtype, theirs, buf, elems);
         return Status::OK();
-      });
+      },
+      codec, ef);
+}
+
+TcpOps::WireEfState* TcpOps::WireEf(const std::string& name, int64_t elems) {
+  // One state per fused-response identity. Auto-generated tensor names
+  // could grow this without bound, so past a cap the whole map resets —
+  // residuals restart at zero, costing one uncompensated step.
+  const std::string key = name + "|" + std::to_string(elems);
+  if (wire_ef_.size() > 512 && wire_ef_.find(key) == wire_ef_.end())
+    wire_ef_.clear();
+  return &wire_ef_[key];
 }
 
 Status TcpOps::AdasumAllreduce(uint8_t* buf, DataType dtype,

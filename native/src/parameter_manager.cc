@@ -74,12 +74,23 @@ void ParameterManager::SetHostTunables(int threads, int max_threads,
   best_depth_ = depth_;
 }
 
+void ParameterManager::SetWireTunable(int max_level, int current) {
+  wire_max_ = std::max(0, std::min(3, max_level));
+  wire_ = std::max(0, std::min(wire_max_, current));
+  // Lossy codecs only join the search when the operator already opted
+  // into that lossiness via HOROVOD_WIRE_COMPRESSION (max_level is the
+  // chosen codec): the tuner may back off toward lossless, never
+  // silently make the wire lossier than the operator asked for.
+  tune_wire_ = bayes_ && wire_max_ > 0;
+  best_wire_ = wire_;
+}
+
 void ParameterManager::SetLogPath(const std::string& path) {
   log_.open(path, std::ios::out | std::ios::trunc);
   if (log_.is_open())
     log_ << "time_secs,fusion_threshold_bytes,cycle_time_ms,"
             "score_bytes_per_sec,hierarchical,cache_enabled,"
-            "shm_enabled,reduce_threads,seg_depth\n";
+            "shm_enabled,reduce_threads,seg_depth,wire_codec\n";
 }
 
 void ParameterManager::Record(int64_t bytes) {
@@ -91,7 +102,7 @@ void ParameterManager::LogSample(double score) {
     log_ << window_start_ << "," << fusion_ << "," << cycle_ms_ << ","
          << static_cast<int64_t>(score) << "," << cat_[kCatHier] << ","
          << cat_[kCatCache] << "," << cat_[kCatShm] << ","
-         << threads_ << "," << depth_ << "\n";
+         << threads_ << "," << depth_ << "," << wire_ << "\n";
     log_.flush();
   }
 }
@@ -107,6 +118,8 @@ std::vector<double> ParameterManager::CurrentPoint() const {
   if (tune_depth_)
     x.push_back(ToUnit(std::log2(static_cast<double>(depth_)), 0.0,
                        std::log2(static_cast<double>(kMaxSegDepth))));
+  if (tune_wire_)
+    x.push_back(static_cast<double>(wire_) / wire_max_);
   for (int c = 0; c < kNumCategoricals; ++c)
     if (cat_tunable_[c]) x.push_back(cat_[c] ? 1.0 : 0.0);
   return x;
@@ -123,6 +136,10 @@ void ParameterManager::ApplyPoint(const std::vector<double>& x) {
     threads_ = FromUnitPow2(x[i++], max_threads_);
   if (tune_depth_ && i < x.size())
     depth_ = FromUnitPow2(x[i++], kMaxSegDepth);
+  if (tune_wire_ && i < x.size()) {
+    const int lvl = static_cast<int>(std::lround(x[i++] * wire_max_));
+    wire_ = std::max(0, std::min(wire_max_, lvl));
+  }
   for (int c = 0; c < kNumCategoricals; ++c)
     if (cat_tunable_[c] && i < x.size()) cat_[c] = x[i++] > 0.5 ? 1 : 0;
 }
@@ -164,14 +181,15 @@ bool ParameterManager::UpdateBayes(double score) {
   if (!opt_) {
     int n_cat = 0;
     for (bool t : cat_tunable_) n_cat += t ? 1 : 0;
-    const int n_cont =
-        2 + (tune_threads_ ? 1 : 0) + (tune_depth_ ? 1 : 0);
+    const int n_cont = 2 + (tune_threads_ ? 1 : 0) +
+                       (tune_depth_ ? 1 : 0) + (tune_wire_ ? 1 : 0);
     opt_ = std::make_unique<BayesianOptimizer>(n_cont, n_cat);
   }
   const int64_t old_fusion = fusion_;
   const double old_cycle = cycle_ms_;
   const int old_threads = threads_;
   const int old_depth = depth_;
+  const int old_wire = wire_;
   int old_cat[kNumCategoricals];
   std::memcpy(old_cat, cat_, sizeof(old_cat));
 
@@ -182,6 +200,7 @@ bool ParameterManager::UpdateBayes(double score) {
     best_cycle_ms_ = cycle_ms_;
     best_threads_ = threads_;
     best_depth_ = depth_;
+    best_wire_ = wire_;
     std::memcpy(best_cat_, cat_, sizeof(best_cat_));
   }
   if (opt_->n_samples() >= max_samples_) {
@@ -189,6 +208,7 @@ bool ParameterManager::UpdateBayes(double score) {
     cycle_ms_ = best_cycle_ms_;
     threads_ = best_threads_;
     depth_ = best_depth_;
+    wire_ = best_wire_;
     std::memcpy(cat_, best_cat_, sizeof(best_cat_));
     converged_ = true;
     static constexpr const char* kCatNames[kNumCategoricals] = {
@@ -202,6 +222,7 @@ bool ParameterManager::UpdateBayes(double score) {
     if (tune_threads_)
       host += " reduce_threads=" + std::to_string(threads_);
     if (tune_depth_) host += " seg_depth=" + std::to_string(depth_);
+    if (tune_wire_) host += " wire_codec=" + std::to_string(wire_);
     LOG_INFO << "autotune (bayes) converged after " << opt_->n_samples()
              << " samples: fusion_threshold=" << fusion_
              << " cycle_time_ms=" << cycle_ms_ << host << cats
@@ -212,6 +233,7 @@ bool ParameterManager::UpdateBayes(double score) {
   settling_ = true;
   return fusion_ != old_fusion || cycle_ms_ != old_cycle ||
          threads_ != old_threads || depth_ != old_depth ||
+         wire_ != old_wire ||
          std::memcmp(cat_, old_cat, sizeof(old_cat)) != 0 || converged_;
 }
 

@@ -594,6 +594,111 @@ def main():
         print(f"DIGEST {digest}")
         print(f"OK rank={r}")
 
+    elif scenario == "wire_parity":
+        # Wire-compression parity over the TCP data plane (run with
+        # HOROVOD_SHM_DISABLE=1; np=2 exercises the doubling exchange,
+        # np>=3 with the payload above HOROVOD_RING_THRESHOLD the ring;
+        # node-major 2x2 + HIERARCHICAL the cross-node phase).
+        rng = np.random.RandomState(3 + r)
+        x = rng.randn(120000).astype(np.float32)
+        base = hvd.allreduce(x.copy(), op=hvd.Sum, name="wp.none",
+                             compression=hvd.Compression.none)
+        want = sum(np.random.RandomState(3 + k).randn(120000)
+                   .astype(np.float32) for k in range(s))
+        np.testing.assert_allclose(base, want, rtol=1e-4, atol=1e-4)
+
+        # bf16/fp16 wire stays within the wire dtype's tolerance of the
+        # uncompressed result (absolute slack covers near-zero sums,
+        # whose relative error a 2^-8-mantissa wire can't bound).
+        amax = float(np.abs(base).max())
+        bf = hvd.allreduce(x.copy(), op=hvd.Sum, name="wp.bf16",
+                           compression=hvd.Compression.bf16)
+        np.testing.assert_allclose(bf, base, atol=amax * 2**-6)
+        fp = hvd.allreduce(x.copy(), op=hvd.Sum, name="wp.fp16",
+                           compression=hvd.Compression.fp16)
+        np.testing.assert_allclose(fp, base, atol=amax * 2**-8)
+
+        # int8 + error feedback: a repeated allreduce of the SAME
+        # tensor must converge — residuals carry each step's rounding
+        # error into the next, so the time-average's error shrinks
+        # ~1/T while any single shot stays at quantization scale.
+        outs = [np.asarray(hvd.allreduce(x, op=hvd.Sum, name="wp.i8",
+                                         compression=hvd.Compression.int8))
+                for _ in range(48)]
+        single = float(np.abs(outs[0] - base).max())
+        mean_err = float(np.abs(np.mean(outs, axis=0) - base).max())
+        assert single > 1e-4, "int8 wire produced an exact result?"
+        assert mean_err < single / 8, (single, mean_err)
+
+        # Grouped allreduce rides the codec too (matching codecs fuse).
+        g = hvd.grouped_allreduce([x.copy(), np.ones(513, np.float32)],
+                                  op=hvd.Sum, name="wp.grp",
+                                  compression=hvd.Compression.bf16)
+        np.testing.assert_allclose(g[0], base, atol=amax * 2**-6)
+        np.testing.assert_allclose(g[1], float(s), atol=0.1)
+
+        # The `none` codec must be bitwise invariant to the reduction
+        # thread count (the PR 2 contract survives the codec layer).
+        hvd.set_reduce_threads(1)
+        t1 = hvd.allreduce(x.copy(), op=hvd.Sum, name="wp.t",
+                           compression=hvd.Compression.none)
+        hvd.set_reduce_threads(4)
+        t4 = hvd.allreduce(x.copy(), op=hvd.Sum, name="wp.t",
+                           compression=hvd.Compression.none)
+        hvd.set_reduce_threads(1)
+        assert np.asarray(t1).tobytes() == np.asarray(t4).tobytes()
+
+    elif scenario == "wire_env":
+        # Job-wide HOROVOD_WIRE_COMPRESSION knob: requests without a
+        # per-op compression= follow the coordinator's synced value.
+        rng = np.random.RandomState(17 + r)
+        x = rng.randn(100000).astype(np.float32)
+        exact = hvd.allreduce(x.copy(), op=hvd.Sum, name="we.none",
+                              compression=hvd.Compression.none)
+        dflt = hvd.allreduce(x.copy(), op=hvd.Sum, name="we.dflt")
+        env = os.environ.get("HOROVOD_WIRE_COMPRESSION", "")
+        amax = float(np.abs(np.asarray(exact)).max())
+        if env == "bf16":
+            # The default-codec op must actually have been quantized...
+            assert np.asarray(dflt).tobytes() != np.asarray(exact).tobytes()
+            # ...but stay within bf16 wire tolerance.
+            np.testing.assert_allclose(dflt, exact, atol=amax * 2**-6)
+        else:
+            # Unset or garbage (sanitized to none): bitwise identical.
+            assert np.asarray(dflt).tobytes() == np.asarray(exact).tobytes()
+
+    elif scenario == "wire_ring":
+        # np>=3 ring with every codec: all ranks must land on BITWISE
+        # identical results even under lossy compression (the allgather
+        # phase forwards each chunk's encoded bytes verbatim and the
+        # owner self-decodes, so every rank decodes the same bytes).
+        import hashlib
+
+        rng = np.random.RandomState(100 + r)
+        x = rng.randn(200003).astype(np.float32)
+        digests = []
+        for cname, comp in (("none", hvd.Compression.none),
+                            ("bf16", hvd.Compression.bf16),
+                            ("fp16", hvd.Compression.fp16),
+                            ("int8", hvd.Compression.int8)):
+            out = np.asarray(hvd.allreduce(x.copy(), op=hvd.Sum,
+                                           name=f"wr.{cname}",
+                                           compression=comp))
+            digests.append(f"{cname}:{hashlib.sha1(out.tobytes()).hexdigest()}")
+        base = np.asarray(hvd.allreduce(x.copy(), op=hvd.Sum, name="wr.ref",
+                                        compression=hvd.Compression.none))
+        amax = float(np.abs(base).max())
+        # Looser than the np=2 parity case: ring chunks re-quantize at
+        # every relay hop, so the worst case stacks P-1 roundings.
+        for cname, tol in (("bf16", 2**-5), ("fp16", 2**-7), ("int8", 0.05)):
+            out = np.asarray(hvd.allreduce(x.copy(), op=hvd.Sum,
+                                           name=f"wr2.{cname}",
+                                           compression=getattr(
+                                               hvd.Compression, cname)))
+            np.testing.assert_allclose(out, base, atol=amax * tol,
+                                       err_msg=cname)
+        print("DIGEST " + "|".join(digests))
+
     elif scenario == "shm_segmented":
         # Multi-segment shm allreduce (HOROVOD_SHM_SEGMENT_BYTES forced
         # tiny by the test): odd payload lengths so segment boundaries

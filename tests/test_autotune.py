@@ -205,11 +205,40 @@ def test_autotune_csv_carries_categoricals(tmp_path):
         assert len(rows) >= 2, rows
         for col in ("hierarchical", "cache_enabled", "shm_enabled"):
             assert all(r[col] in ("0", "1") for r in rows), rows[0]
+        # The wire-codec level rides every sample too (0..3; fixed at 0
+        # here — single process offers no wire to compress).
+        assert all(r["wire_codec"] in ("0", "1", "2", "3") for r in rows), \
+            rows[0]
     finally:
         for k in ("HOROVOD_AUTOTUNE", "HOROVOD_AUTOTUNE_WINDOW_SECS",
                   "HOROVOD_AUTOTUNE_LOG", "HOROVOD_CYCLE_TIME"):
             os.environ.pop(k, None)
         hvd.init()
+
+
+def test_autotune_explores_wire_codec(tmp_path):
+    """np=2 TCP with HOROVOD_WIRE_COMPRESSION=int8 and bayes autotune:
+    the wire level joins the search (ceiling = the operator's codec),
+    flips ride the tuned broadcast, and the job stays correct through
+    every sampled codec (the traffic tensors are constant vectors, so
+    every codec reproduces them exactly — the assert is protocol
+    correctness, not tolerance)."""
+    log = os.path.join(str(tmp_path), "wire_at.csv")
+    run_job("traffic", 2, timeout=150, extra_env={
+        "HOROVOD_AUTOTUNE": "1",
+        "HOROVOD_AUTOTUNE_WINDOW_SECS": "0.05",
+        "HOROVOD_AUTOTUNE_LOG": log,
+        "HOROVOD_CYCLE_TIME": "0.5",
+        "HOROVOD_WIRE_COMPRESSION": "int8",
+        "HOROVOD_SHM_DISABLE": "1",
+        "TRAFFIC_ITERS": "1500",
+    })
+    with open(log) as f:
+        rows = list(csv.DictReader(f))
+    assert len(rows) >= 2, rows
+    seen = {r["wire_codec"] for r in rows}
+    # Never above the operator's ceiling; starts AT the ceiling.
+    assert seen <= {"0", "1", "2", "3"} and "3" in seen, seen
 
 
 @pytest.mark.slow  # heavy multiprocess spawn; coverage overlaps the

@@ -1,0 +1,135 @@
+"""Direct unit tests for ``horovod_tpu/compression.py`` — until now it
+was only exercised indirectly through the optimizer wrappers. Covers
+the cast round-trip across the numpy/jax/torch dispatch paths, fp64
+context restore, NoneCompressor passthrough identity, the int8 marker's
+passthrough semantics, and the Compression -> native wire-codec map the
+eager API relies on."""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.compression import (
+    BF16Compressor,
+    Compression,
+    FP16Compressor,
+    Int8Compressor,
+    NoneCompressor,
+    wire_codec_id,
+)
+
+
+def _np_tensor(dtype):
+    return (np.arange(13, dtype=np.float64) / 7.0 - 0.9).astype(dtype)
+
+
+def _jax_tensor(dtype):
+    import jax.numpy as jnp
+    return jnp.asarray(_np_tensor(np.float64)).astype(dtype)
+
+
+def _torch_tensor(dtype):
+    import torch
+    return torch.from_numpy(_np_tensor(np.float64)).to(
+        getattr(torch, np.dtype(dtype).name if dtype != "bfloat16"
+                else "bfloat16"))
+
+
+# ---------------------------------------------------------------------------
+# NoneCompressor: passthrough identity (same object, no copies)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("make", [_np_tensor, _jax_tensor],
+                         ids=["numpy", "jax"])
+def test_none_compressor_identity(make):
+    x = make(np.float32)
+    c, ctx = NoneCompressor.compress(x)
+    assert c is x and ctx is None
+    assert NoneCompressor.decompress(c, ctx) is x
+
+
+def test_int8_marker_is_cast_passthrough():
+    """Int8 is a WIRE codec: there is no framework-level int8 tensor
+    representation, so the cast API must be an exact passthrough."""
+    x = _np_tensor(np.float32)
+    c, ctx = Int8Compressor.compress(x)
+    assert c is x and ctx is None
+    assert Int8Compressor.decompress(c, ctx) is x
+
+
+# ---------------------------------------------------------------------------
+# Cast round-trip matrix: fp16/bf16 across numpy/jax/torch, f32 + f64
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("comp,wire_name", [(FP16Compressor, "float16"),
+                                            (BF16Compressor, "bfloat16")])
+@pytest.mark.parametrize("src_dtype", [np.float32, np.float64],
+                         ids=["f32", "f64"])
+def test_numpy_roundtrip(comp, wire_name, src_dtype):
+    x = _np_tensor(src_dtype)
+    c, ctx = comp.compress(x)
+    assert str(c.dtype) == wire_name
+    out = comp.decompress(c, ctx)
+    # ctx restore: ORIGINAL dtype comes back, fp64 included.
+    assert out.dtype == np.dtype(src_dtype)
+    np.testing.assert_allclose(np.asarray(out, np.float64),
+                               np.asarray(x, np.float64),
+                               rtol=2**-7, atol=1e-2)
+
+
+@pytest.mark.parametrize("comp,wire_name", [(FP16Compressor, "float16"),
+                                            (BF16Compressor, "bfloat16")])
+def test_jax_roundtrip(comp, wire_name):
+    x = _jax_tensor("float32")
+    c, ctx = comp.compress(x)
+    assert wire_name in str(c.dtype)
+    out = comp.decompress(c, ctx)
+    assert "float32" in str(out.dtype)
+    np.testing.assert_allclose(np.asarray(out, np.float64),
+                               np.asarray(x, np.float64),
+                               rtol=2**-7, atol=1e-2)
+
+
+@pytest.mark.parametrize("comp,wire_name", [(FP16Compressor, "float16"),
+                                            (BF16Compressor, "bfloat16")])
+@pytest.mark.parametrize("src", ["float32", "float64"])
+def test_torch_roundtrip(comp, wire_name, src):
+    torch = pytest.importorskip("torch")
+    x = _torch_tensor(src)
+    c, ctx = comp.compress(x)
+    assert str(c.dtype) == f"torch.{wire_name}"
+    out = comp.decompress(c, ctx)
+    # torch ctx strings carry the "torch." prefix; restore must strip
+    # it and come back at the ORIGINAL precision (the fp64 case).
+    assert out.dtype == getattr(torch, src)
+    np.testing.assert_allclose(out.double().numpy(), x.double().numpy(),
+                               rtol=2**-7, atol=1e-2)
+
+
+@pytest.mark.parametrize("comp", [FP16Compressor, BF16Compressor])
+def test_non_float_input_passes_through(comp):
+    """Integer tensors are not cast (no meaningful low-precision float
+    form) — compress returns them untouched with a None context."""
+    x = np.arange(5, dtype=np.int32)
+    c, ctx = comp.compress(x)
+    assert c is x and ctx is None
+    assert comp.decompress(c, ctx) is x
+
+
+# ---------------------------------------------------------------------------
+# Wire-codec mapping (the eager compression= surface)
+# ---------------------------------------------------------------------------
+
+def test_wire_codec_ids_match_native_enum():
+    # native/include/hvd/codec.h WireCodec order.
+    assert wire_codec_id(None) == -1
+    assert wire_codec_id(Compression.none) == 0
+    assert wire_codec_id(Compression.bf16) == 1
+    assert wire_codec_id(Compression.fp16) == 2
+    assert wire_codec_id(Compression.int8) == 3
+    # Instances work like classes (torch optimizer style).
+    assert wire_codec_id(Compression.int8()) == 3
+
+
+def test_wire_codec_id_rejects_garbage():
+    with pytest.raises(ValueError, match="compression"):
+        wire_codec_id("int8")

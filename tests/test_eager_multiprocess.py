@@ -185,6 +185,81 @@ def test_timeline_carries_shm_pipeline_phases(tmp_path):
         assert phase in raw, f"timeline missing {phase}"
 
 
+# ---------------------------------------------------------------------------
+# On-the-wire gradient compression (HOROVOD_WIRE_COMPRESSION /
+# hvd.allreduce(..., compression=...); docs/perf_tuning.md)
+# ---------------------------------------------------------------------------
+
+def test_wire_parity_np2():
+    """np=2 TCP parity matrix on the doubling exchange: bf16/fp16 wire
+    within dtype tolerance of `none`, int8+error-feedback converging on
+    a repeated-allreduce loop, grouped compression, and bitwise
+    thread-count invariance of the `none` codec."""
+    outs = run_job("wire_parity", 2, timeout=180,
+                   extra_env={"HOROVOD_SHM_DISABLE": "1"})
+    for r, out in enumerate(outs):
+        assert f"OK rank={r}" in out
+
+
+def test_wire_ring_np4():
+    """np=4 ring with every codec: parity vs `none` AND bitwise
+    cross-rank agreement under lossy compression (each chunk's encoded
+    bytes are forwarded verbatim; the owner self-decodes)."""
+    outs = run_job("wire_ring", 4, timeout=180,
+                   extra_env={"HOROVOD_SHM_DISABLE": "1"})
+    digests = set()
+    for r, out in enumerate(outs):
+        assert f"OK rank={r}" in out
+        for line in out.splitlines():
+            if line.startswith("DIGEST "):
+                digests.add(line)
+    assert len(digests) == 1, digests
+
+
+def test_wire_ragged_doubling_np3_agrees():
+    """np=3 forced onto the doubling path (ring threshold above the
+    payload): the ragged fold/unfold republishes the result quantized,
+    and EVERY core rank — including the solo one that owns no fold
+    partner — must requantize its own copy, or ranks drift by one
+    rounding epsilon (regression: only fold-pair ranks self-decoded)."""
+    outs = run_job("wire_ring", 3, timeout=180, extra_env={
+        "HOROVOD_SHM_DISABLE": "1",
+        "HOROVOD_RING_THRESHOLD": "1000000000",
+    })
+    digests = set()
+    for r, out in enumerate(outs):
+        assert f"OK rank={r}" in out
+        for line in out.splitlines():
+            if line.startswith("DIGEST "):
+                digests.add(line)
+    assert len(digests) == 1, digests
+
+
+def test_wire_env_knob_applies_job_wide():
+    """HOROVOD_WIRE_COMPRESSION=bf16 on every rank: ops without a
+    per-op compression= must ride the codec (result differs bitwise
+    from `none` but stays within bf16 tolerance)."""
+    outs = run_job("wire_env", 2, timeout=120, extra_env={
+        "HOROVOD_SHM_DISABLE": "1",
+        "HOROVOD_WIRE_COMPRESSION": "bf16",
+    })
+    for r, out in enumerate(outs):
+        assert f"OK rank={r}" in out
+
+
+def test_wire_env_garbage_warns_and_falls_back():
+    """A typo'd codec name must warn (once) and run uncompressed —
+    never alias to a silently different codec."""
+    outs = run_job("wire_env", 2, timeout=120, extra_env={
+        "HOROVOD_SHM_DISABLE": "1",
+        "HOROVOD_WIRE_COMPRESSION": "bf17",
+    })
+    for r, out in enumerate(outs):
+        assert f"OK rank={r}" in out
+    assert any("HOROVOD_WIRE_COMPRESSION" in out for out in outs), \
+        "sanitized parse never warned about the bad codec name"
+
+
 def test_shm_segmented_allreduce():
     """A 4 KB segment cap forces ~100 segments per op: boundaries land
     mid-entry, the fused group spans segments, and scale factors ride

@@ -72,6 +72,15 @@ def test_hierarchical_full_matrix_2x2():
     run_two_node_job("matrix", local_size=2, n_nodes=2, extra_env=HIER_ENV)
 
 
+def test_hierarchical_wire_compression_2x2():
+    """Wire codecs under hierarchical mode compress only the
+    cross-node doubling exchange (the intra-node ring phases stay full
+    precision) — the parity/EF-convergence matrix must hold on the 2x2
+    node-major layout with shm arenas off so the TCP phases run."""
+    run_two_node_job("wire_parity", local_size=2, n_nodes=2, timeout=180,
+                     extra_env={**HIER_ENV, "HOROVOD_SHM_DISABLE": "1"})
+
+
 def test_hierarchical_2x3_ragged_local():
     """3 ranks per 'node' — ragged ring chunks + non-power-of-two cross
     group exercise the general shapes."""

@@ -141,6 +141,156 @@ def test_scale_matches_numpy(dtype):
         _threads(lib, 1)
 
 
+# ---------------------------------------------------------------------------
+# Wire-compression codec kernels (native/src/codec.cc): encode/decode
+# round trips vs numpy models, blockwise int8 scales, error-feedback
+# telescoping, and thread-count bitwise invariance.
+# ---------------------------------------------------------------------------
+
+W_NONE, W_BF16, W_FP16, W_INT8 = 0, 1, 2, 3
+INT8_BLOCK = 256
+
+# Straddle the worker pool's parallel grain and the int8 block size
+# (partial final block included).
+WIRE_SIZES = [1, 255, 257, 131073, 700001]
+
+
+def _encode(lib, codec, x, residual=None):
+    eb = lib.hvd_wire_encoded_bytes(codec, x.size)
+    enc = np.zeros(eb, np.uint8)
+    lib.hvd_wire_encode(codec, x.ctypes.data, x.size, enc.ctypes.data,
+                        residual.ctypes.data if residual is not None else None)
+    return enc
+
+
+def _decode(lib, codec, enc, n):
+    out = np.zeros(n, np.float32)
+    lib.hvd_wire_decode(codec, enc.ctypes.data, n, out.ctypes.data)
+    return out
+
+
+def test_wire_encoded_bytes():
+    lib = get_lib()
+    for n in WIRE_SIZES:
+        assert lib.hvd_wire_encoded_bytes(W_BF16, n) == 2 * n
+        assert lib.hvd_wire_encoded_bytes(W_FP16, n) == 2 * n
+        blocks = (n + INT8_BLOCK - 1) // INT8_BLOCK
+        assert lib.hvd_wire_encoded_bytes(W_INT8, n) == 4 * blocks + n
+
+
+@pytest.mark.parametrize("codec,np_cast", [
+    (W_BF16, "bfloat16"), (W_FP16, "float16")])
+def test_wire_16bit_encode_matches_numpy_cast(codec, np_cast):
+    """bf16/fp16 encode must be bit-identical to numpy's round-to-
+    nearest-even cast (ml_dtypes for bf16) — the wire dtype IS the
+    framework dtype, not an approximation of it."""
+    import ml_dtypes
+    lib = get_lib()
+    rng = np.random.RandomState(11)
+    for n in WIRE_SIZES:
+        x = rng.randn(n).astype(np.float32)
+        enc = _encode(lib, codec, x)
+        dt = np.float16 if np_cast == "float16" else ml_dtypes.bfloat16
+        want = x.astype(dt)
+        assert enc.tobytes() == np.asarray(want).tobytes(), (np_cast, n)
+        # decode = exact widening of the 16-bit value
+        got = _decode(lib, codec, enc, n)
+        np.testing.assert_array_equal(got, np.asarray(want, np.float32))
+
+
+def test_wire_int8_roundtrip_error_bound_and_scales():
+    """Blockwise int8: each block's scale is absmax/127 and the
+    round-trip error is bounded by scale/2 per element."""
+    lib = get_lib()
+    rng = np.random.RandomState(5)
+    for n in (255, 300, 131073):
+        x = rng.randn(n).astype(np.float32) * 3.0
+        enc = _encode(lib, W_INT8, x)
+        blocks = (n + INT8_BLOCK - 1) // INT8_BLOCK
+        scales = enc[:4 * blocks].view(np.float32)
+        for b in range(blocks):
+            blk = x[b * INT8_BLOCK:(b + 1) * INT8_BLOCK]
+            np.testing.assert_allclose(scales[b],
+                                       np.abs(blk).max() / 127.0, rtol=1e-6)
+        out = _decode(lib, W_INT8, enc, n)
+        err = np.abs(out - x)
+        bound = np.repeat(scales, INT8_BLOCK)[:n] / 2 * 1.0001
+        assert (err <= bound + 1e-12).all()
+
+
+def test_wire_int8_zero_block_is_exact():
+    lib = get_lib()
+    x = np.zeros(300, np.float32)
+    out = _decode(lib, W_INT8, _encode(lib, W_INT8, x), 300)
+    assert out.tobytes() == x.tobytes()
+
+
+def test_wire_int8_error_feedback_telescopes():
+    """Repeated encode of the same value with a persistent residual:
+    the mean of the decoded outputs converges ~1/T to the true value
+    (the EF contract the int8 wire convergence test relies on), while
+    any single decode stays at quantization scale."""
+    lib = get_lib()
+    rng = np.random.RandomState(9)
+    n, T = 4096, 32
+    x = rng.randn(n).astype(np.float32)
+    residual = np.zeros(n, np.float32)
+    outs = []
+    for _ in range(T):
+        enc = _encode(lib, W_INT8, x, residual)
+        outs.append(_decode(lib, W_INT8, enc, n))
+    single = np.abs(outs[0] - x).max()
+    mean_err = np.abs(np.mean(outs, axis=0) - x).max()
+    assert single > 1e-4  # quantization really happened
+    assert mean_err < single / 8, (single, mean_err)
+    # Telescoping identity: out_t = x + r_{t-1} - r_t, so the final
+    # residual equals the SUM of all per-step errors (modulo f32
+    # rounding of the per-step adds) — the carried error never leaks.
+    np.testing.assert_allclose(
+        residual, np.sum([x - o for o in outs], axis=0), atol=1e-5)
+
+
+def test_wire_decode_add_matches_decode_plus_add():
+    lib = get_lib()
+    rng = np.random.RandomState(13)
+    for codec in (W_BF16, W_FP16, W_INT8):
+        x = rng.randn(10007).astype(np.float32)
+        acc = rng.randn(10007).astype(np.float32)
+        enc = _encode(lib, codec, x)
+        want = acc + _decode(lib, codec, enc, x.size)
+        got = acc.copy()
+        lib.hvd_wire_decode_add(codec, enc.ctypes.data, x.size,
+                                got.ctypes.data)
+        assert got.tobytes() == want.tobytes()
+
+
+@pytest.mark.parametrize("codec", [W_BF16, W_FP16, W_INT8])
+def test_wire_thread_count_is_bitwise_invisible(codec):
+    """Encode/decode chunk over the worker pool at element/block
+    granularity with pure per-range splits — the produced bytes (and
+    EF residuals) must not depend on the thread count."""
+    lib = get_lib()
+    rng = np.random.RandomState(21)
+    for n in WIRE_SIZES:
+        x = rng.randn(n).astype(np.float32)
+        _threads(lib, 1)
+        res1 = np.zeros(n, np.float32)
+        enc1 = _encode(lib, codec, x,
+                       res1 if codec == W_INT8 else None)
+        dec1 = _decode(lib, codec, enc1, n)
+        for t in (2, 8):
+            _threads(lib, t)
+            rest = np.zeros(n, np.float32)
+            enct = _encode(lib, codec, x,
+                           rest if codec == W_INT8 else None)
+            dect = _decode(lib, codec, enct, n)
+            assert enc1.tobytes() == enct.tobytes(), (codec, n, t)
+            assert dec1.tobytes() == dect.tobytes(), (codec, n, t)
+            if codec == W_INT8:
+                assert res1.tobytes() == rest.tobytes(), (n, t)
+    _threads(lib, 1)
+
+
 def test_scale_bfloat16_threaded_matches_serial():
     import ml_dtypes
     lib = get_lib()

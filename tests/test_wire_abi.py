@@ -1,0 +1,52 @@
+"""Guard: the native wire/ABI version constants in
+``native/include/hvd/message.h`` must match what the Python ctypes shim
+expects (``horovod_tpu/common/basics.py``), and the loaded library must
+report the same ABI. A future native bump that forgets the Python side
+fails HERE with the two numbers in hand, instead of surfacing as a
+cryptic load error (or, for the wire constants the shim cannot check at
+runtime, not surfacing at all)."""
+
+import os
+import re
+
+from horovod_tpu.common import basics
+
+HEADER = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native", "include", "hvd", "message.h")
+
+
+def _header_constant(name: str) -> int:
+    src = open(HEADER).read()
+    m = re.search(rf"constexpr\s+int\s+{name}\s*=\s*(\d+)\s*;", src)
+    assert m, f"{name} not found in message.h — the guard needs it defined"
+    return int(m.group(1))
+
+
+def test_abi_version_pins_match():
+    assert _header_constant("kAbiVersion") == basics.ABI_VERSION
+
+
+def test_wire_version_pins_match():
+    assert (_header_constant("kWireVersionRequestList")
+            == basics.WIRE_VERSION_REQUEST_LIST)
+    assert (_header_constant("kWireVersionResponseList")
+            == basics.WIRE_VERSION_RESPONSE_LIST)
+
+
+def test_loaded_library_reports_pinned_abi():
+    """get_lib() hard-fails on a mismatch; assert the positive case
+    explicitly so this file documents the contract end to end."""
+    lib = basics.get_lib()
+    assert lib.hvd_abi_version() == basics.ABI_VERSION
+
+
+def test_operations_cc_has_no_second_abi_literal():
+    """hvd_abi_version() must RETURN the message.h constant, not a
+    duplicated literal that could skew (the bug class this guard
+    exists for)."""
+    src_path = os.path.join(os.path.dirname(HEADER), "..", "..", "src",
+                            "operations.cc")
+    src = open(os.path.normpath(src_path)).read()
+    m = re.search(r"int hvd_abi_version\(\)\s*{\s*return\s+([^;]+);", src)
+    assert m, "hvd_abi_version not found"
+    assert "kAbiVersion" in m.group(1), m.group(1)
