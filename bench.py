@@ -343,17 +343,25 @@ def _transformer_extra(remaining_secs: float):
 
 
 def _serve_worker():
-    """Serving metric: continuous-batching throughput + latency tails
-    on the mixed-length trace (horovod_tpu/serve/bench.py), run in its
-    own killable subprocess like the transformer extra. Prints
-    "SERVEEXTRA {json}"."""
+    """Serving metrics: continuous-batching throughput + latency tails
+    on the mixed-length trace, the chunked-prefill tail on the same
+    trace, and the prefix-cache win on the shared-system-prompt trace
+    (horovod_tpu/serve/bench.py), run in its own killable subprocess
+    like the transformer extra. Prints "SERVEEXTRA {json}" after each
+    benchmark so a kill mid-run keeps the finished part."""
     try:
-        from horovod_tpu.serve.bench import run_serving_benchmark
+        from horovod_tpu.serve.bench import (
+            run_prefix_benchmark, run_serving_benchmark,
+        )
 
-        out = run_serving_benchmark(n_requests=32)
         # The benchmark's own contract: continuous batching must beat
         # static on mixed lengths; ride the ratio into the payload so
         # a scheduler regression is visible round-over-round.
+        out = run_serving_benchmark(n_requests=32)
+        print("SERVEEXTRA " + json.dumps(out), flush=True)
+        # Prefix-cache tier: cache-on/off ratio + hit rate on the
+        # shared-prefix trace (the tokens-per-request lever).
+        out.update(run_prefix_benchmark(n_requests=32))
         print("SERVEEXTRA " + json.dumps(out), flush=True)
     except Exception:
         pass
@@ -387,10 +395,21 @@ def _previous_bench(bench_dir=None):
     return data.get("parsed", data) if isinstance(data, dict) else None
 
 
+# Metric direction by flattened-key leaf suffix. Latencies (the serve
+# tier's `serve_p50/p99_*_ms` keys) REGRESS when they RISE — comparing
+# them higher-is-better reported a latency blowup as an improvement
+# and a latency win as a drop. Counter-ish keys (step counts, eviction
+# totals, high-water gauges) have no better/worse direction at all and
+# are excluded from the gate.
+LOWER_IS_BETTER_SUFFIXES = ("_ms",)
+UNGATED_SUFFIXES = ("_steps", "_evictions", "_high_water")
+
+
 def find_regressions(prev, cur, threshold=0.10):
     """Compare this round's metrics against the previous round's and
-    return every metric that DROPPED by more than ``threshold``
-    (fraction). Every metric this bench emits is higher-is-better.
+    return every metric that REGRESSED by more than ``threshold``
+    (fraction): dropped, for the (default) higher-is-better metrics;
+    rose, for latency keys (leaf suffix in ``LOWER_IS_BETTER_SUFFIXES``).
     Both trees are flattened (nested extras become dotted keys); only
     keys present in both rounds are compared, so adding or removing a
     metric never trips the gate."""
@@ -412,7 +431,16 @@ def find_regressions(prev, cur, threshold=0.10):
     regs = {}
     for k, pv in prev_f.items():
         cv = cur_f.get(k)
-        if cv is not None and pv > 0 and (pv - cv) / pv > threshold:
+        if cv is None or pv <= 0:
+            continue
+        leaf = k.rsplit(".", 1)[-1]
+        if leaf.endswith(UNGATED_SUFFIXES):
+            continue
+        if leaf.endswith(LOWER_IS_BETTER_SUFFIXES):
+            if (cv - pv) / pv > threshold:
+                regs[k] = {"prev": pv, "cur": cv,
+                           "rise_pct": round(100 * (cv - pv) / pv, 1)}
+        elif (pv - cv) / pv > threshold:
             regs[k] = {"prev": pv, "cur": cv,
                        "drop_pct": round(100 * (pv - cv) / pv, 1)}
     return regs

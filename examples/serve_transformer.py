@@ -28,6 +28,15 @@ def main():
     ap.add_argument("--block-size", type=int, default=8)
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor-parallel mesh axis for serving")
+    ap.add_argument("--no-prefix-cache", action="store_true",
+                    help="disable content-addressed KV block reuse")
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    help="max prefill tokens per engine step (long "
+                         "prompts stream in chunks between decode "
+                         "iterations)")
+    ap.add_argument("--system-prompt", type=int, default=0,
+                    help="prepend this many shared tokens to every "
+                         "request (shows the prefix cache working)")
     ap.add_argument("--tiny", action="store_true",
                     help="2-layer d=64 model (CPU smoke)")
     ap.add_argument("--platform", default=None, choices=[None, "cpu", "tpu"])
@@ -57,16 +66,28 @@ def main():
         mesh = build_mesh(dp=-1, tp=args.tp)
     params = init_transformer(cfg, jax.random.PRNGKey(0), mesh)
 
-    max_prompt = min(32, cfg.max_seq - args.max_new - 1)
+    max_prompt = min(32 + args.system_prompt,
+                     cfg.max_seq - args.max_new - 1)
+    if args.system_prompt >= max_prompt:
+        ap.error(f"--system-prompt {args.system_prompt} leaves no room "
+                 f"for a request within this model's budget "
+                 f"(max prompt {max_prompt} at --max-new {args.max_new})")
     engine = ServeEngine(
         cfg, params,
         ServeConfig(max_batch=args.max_batch, block_size=args.block_size,
                     max_prompt=max_prompt, max_new_tokens=args.max_new,
-                    max_queue=max(args.requests, 8)),
+                    max_queue=max(args.requests, 8),
+                    prefix_caching=not args.no_prefix_cache,
+                    prefill_chunk=args.prefill_chunk),
         mesh=mesh)
 
-    trace = make_trace(args.requests, seed=0, max_prompt=max_prompt,
+    trace = make_trace(args.requests, seed=0,
+                       max_prompt=max_prompt - args.system_prompt,
                        max_new=args.max_new, vocab=cfg.vocab_size)
+    if args.system_prompt:
+        sys_tokens = np.random.RandomState(7).randint(
+            1, cfg.vocab_size, size=args.system_prompt).tolist()
+        trace = [(sys_tokens + p, n) for p, n in trace]
     import time
     rids = []
     for prompt, max_new in trace:
@@ -95,6 +116,12 @@ def main():
                                 "p50_first_token_ms", "p99_first_token_ms",
                                 "p50_per_token_ms", "p99_per_token_ms",
                                 "requests_finished")})
+    print("kv pool:",
+          {k: snap[k] for k in ("kv_blocks_in_use", "kv_blocks_cached",
+                                "kv_blocks_high_water",
+                                "prefix_cache_hit_rate",
+                                "prefix_block_hits",
+                                "prefix_block_evictions")})
     if args.trace_out:
         engine.metrics.export_chrome_trace(args.trace_out)
         print(f"chrome trace written to {args.trace_out}")

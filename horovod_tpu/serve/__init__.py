@@ -34,12 +34,15 @@ from horovod_tpu.serve.kv_cache import (  # noqa: F401
     KVCache,
     NULL_BLOCK,
     OutOfBlocks,
+    block_hash,
     init_kv_cache,
     pick_bucket,
 )
 from horovod_tpu.serve.decode import make_serve_fns  # noqa: F401
 from horovod_tpu.serve.metrics import ServeMetrics, percentile  # noqa: F401
 from horovod_tpu.serve.bench import (  # noqa: F401
+    make_shared_prefix_trace,
     make_trace,
+    run_prefix_benchmark,
     run_serving_benchmark,
 )

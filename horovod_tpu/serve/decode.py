@@ -5,6 +5,15 @@ Two compiled programs drive all serving traffic:
 * :func:`prefill` — run one prompt (padded to a length bucket) through
   the transformer, write its K/V into the sequence's cache blocks, and
   emit the first generated token from the last real position's logits.
+* :func:`prefill_resume` — the preemptible/suffix variant: run a
+  *chunk* of a prompt starting at a block-aligned token ``offset``,
+  attending over the pages already present in the sequence's blocks
+  (a prefix mapped in from the content-addressed cache, or earlier
+  chunks of the same prompt) and writing the chunk's new pages through
+  the block table. The chunk length is a new jit bucket dimension;
+  ``offset`` stays traced. This is what makes prefix-cache hits pay
+  only suffix FLOPs and lets the engine interleave long prefills with
+  decode iterations (chunked prefill).
 * :func:`decode` — one iteration-level step for the whole running
   batch (padded to a batch bucket): embed each sequence's last token,
   append its K/V at the sequence's current position through the block
@@ -43,6 +52,7 @@ from jax import lax
 
 from horovod_tpu.models import transformer as tf_lib
 from horovod_tpu.parallel.ring_attention import local_attention
+from horovod_tpu.serve.kv_cache import NULL_BLOCK
 
 _NEG_BIG = -1e30  # matches ring_attention's finite "-inf"
 
@@ -90,10 +100,10 @@ def _ffn(cfg, lp, x):
 
 def make_serve_fns(cfg, mesh: Optional[Any] = None, *, block_size: int,
                    table_width: int):
-    """Build (prefill, decode) jitted closures for ``cfg`` over
-    ``mesh``. ``table_width`` is the static block-table row length
-    (blocks per sequence, worst case); caches are donated so steady-
-    state decode updates the pool in place.
+    """Build (prefill, prefill_resume, decode) jitted closures for
+    ``cfg`` over ``mesh``. ``table_width`` is the static block-table
+    row length (blocks per sequence, worst case); caches are donated
+    so steady-state decode updates the pool in place.
 
     Memoized: engines sharing (cfg, mesh, block geometry) — e.g. the
     benchmark's continuous and static schedulers, or a fleet of
@@ -141,6 +151,79 @@ def _cached_serve_fns(cfg, mesh, block_size: int, table_width: int):
                 vv = jnp.repeat(vv, rep, axis=2)
             o = local_attention(q, kk, vv, causal=True)
             x = x + (o.reshape(1, Tp, H * Dh) @ lp["wo"]).astype(cfg.dtype)
+            x = _ffn(cfg, lp, x)
+            return x, (kc_l, vc_l)
+
+        x, (kc, vc) = lax.scan(body, x, (params["layers"], kc, vc))
+        x = tf_lib._rmsnorm(x, params["final_norm"], cfg.norm_eps)
+        x_last = jnp.take(x[0], length - 1, axis=0)            # [D]
+        logits = (x_last @ params["lm_head"]).astype(jnp.float32)
+        return kc, vc, jnp.argmax(logits).astype(jnp.int32)
+
+    def prefill_resume(params, kc, vc, tokens, offset, length, block_table):
+        """One prefill *chunk* starting at block-aligned token
+        ``offset``. tokens [Tc] (chunk bucket-padded), offset scalar
+        i32 (tokens already in the cache for this sequence: a mapped
+        prefix-cache hit and/or earlier chunks), length scalar i32
+        (real tokens in this chunk), block_table [table_width] i32.
+
+        Queries attend over ALL pages gathered through the table
+        (prefix pages written by whoever computed them + this chunk's
+        own pages, scattered first) under a global-position causal
+        mask, so the math per real token is position-dependent only —
+        identical whether the prefix was computed here, by an earlier
+        chunk, or by another sequence entirely (the bitwise
+        cache-on/off parity property).
+
+        Returns (kc, vc, tok) where tok is the argmax at the chunk's
+        last real position — the sequence's first generated token when
+        this is the final chunk; callers ignore it for earlier chunks
+        (it reads mid-prompt logits then).
+        """
+        Tc = tokens.shape[0]
+        n_blk = Tc // block_size
+        S = table_width * block_size
+        x = tf_lib.embed_lookup(params["embed"], tokens[None], cfg.dtype,
+                                mesh)                          # [1, Tc, D]
+        pos = offset + jnp.arange(Tc, dtype=jnp.int32)[None]   # [1, Tc]
+        # Chunk rows land in table slots off_blk..off_blk+n_blk. Rows
+        # whose slot falls past the table (bucket padding of the last
+        # chunk at high offsets) are routed to the null block — same
+        # never-read garbage contract as the monolithic prefill's
+        # padding blocks. A plain dynamic_slice would CLAMP the start
+        # instead and overwrite real prefix pages.
+        slot = offset // block_size + jnp.arange(n_blk, dtype=jnp.int32)
+        blks = jnp.where(
+            slot < table_width,
+            jnp.take(block_table, jnp.minimum(slot, table_width - 1)),
+            NULL_BLOCK)
+
+        def body(x, per_layer):
+            lp, kc_l, vc_l = per_layer
+            q, k, v = _qkv(cfg, lp, x, pos)
+            kc_l = kc_l.at[blks].set(
+                k[0].reshape(n_blk, block_size, Hkv, Dh).astype(kc_l.dtype))
+            vc_l = vc_l.at[blks].set(
+                v[0].reshape(n_blk, block_size, Hkv, Dh).astype(vc_l.dtype))
+            # Gather every page of this sequence (its table; unused
+            # entries hold the null block) and mask by global position:
+            # key j visible to query at global position p iff j <= p.
+            # All such keys are real — the prefix was written before
+            # this chunk ran, the chunk's own keys one line up.
+            kp = kc_l[block_table].reshape(1, S, Hkv, Dh).astype(q.dtype)
+            vp = vc_l[block_table].reshape(1, S, Hkv, Dh).astype(q.dtype)
+            if rep > 1:
+                kp = jnp.repeat(kp, rep, axis=2)
+                vp = jnp.repeat(vp, rep, axis=2)
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, kp,
+                           preferred_element_type=jnp.float32) * scale
+            kpos = jnp.arange(S, dtype=jnp.int32)
+            mask = kpos[None, :] <= pos[0][:, None]            # [Tc, S]
+            s = jnp.where(mask[None, None], s, _NEG_BIG)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(vp.dtype), vp,
+                           preferred_element_type=jnp.float32).astype(q.dtype)
+            x = x + (o.reshape(1, Tc, H * Dh) @ lp["wo"]).astype(cfg.dtype)
             x = _ffn(cfg, lp, x)
             return x, (kc_l, vc_l)
 
@@ -200,7 +283,8 @@ def _cached_serve_fns(cfg, mesh, block_size: int, table_width: int):
 
     # Donate the cache pool: steady-state decode rewrites it in place
     # instead of allocating a fresh [L, n_blocks, bs, Hkv, Dh] copy
-    # per step. `length`/`positions` stay traced (they change every
-    # call); only array shapes key the jit cache.
+    # per step. `length`/`offset`/`positions` stay traced (they change
+    # every call); only array shapes key the jit cache.
     return (jax.jit(prefill, donate_argnums=(1, 2)),
+            jax.jit(prefill_resume, donate_argnums=(1, 2)),
             jax.jit(decode, donate_argnums=(1, 2)))

@@ -21,6 +21,23 @@ Admission control is two-layered:
   preemption/swapping tier yet; the reservation is the simple-and-
   safe policy and `high_water` tells you how much it costs).
 
+Two throughput levers sit on top of the paged layout:
+
+* **prefix caching** (``ServeConfig.prefix_caching``) — admission
+  walks the prompt's chained block hashes against the allocator's
+  content index; every leading whole block already cached is mapped
+  straight into the new sequence's block table (one refcount, zero
+  FLOPs) and only the unmatched suffix is prefilled. Full prompt
+  blocks are published back to the index after they are written, so
+  a fleet of requests sharing a system prompt pays its prefill once.
+* **chunked prefill** (``ServeConfig.prefill_chunk``) — a long
+  suffix is split into block-aligned chunks processed across
+  successive :meth:`ServeEngine.step` iterations, interleaved with
+  decode, so one long prompt no longer monopolizes an iteration and
+  spikes every in-flight sequence's per-token latency. A chunking
+  sequence holds all its reserved blocks but does not enter the
+  decode batch until its prefill completes.
+
 Deadlines are absolute engine-clock times by which a request must be
 *admitted* (first token scheduled); stale requests are rejected with a
 503-style result rather than burning prefill FLOPs on an answer
@@ -43,7 +60,7 @@ import numpy as np
 
 from horovod_tpu.serve import decode as decode_lib
 from horovod_tpu.serve.kv_cache import (
-    BlockAllocator, init_kv_cache, pick_bucket,
+    BlockAllocator, block_hash, init_kv_cache, pick_bucket,
 )
 from horovod_tpu.serve.metrics import ServeMetrics
 
@@ -73,6 +90,17 @@ class ServeConfig:
     # classical serve loop, kept as the benchmark baseline.
     scheduling: str = "continuous"
     cache_dtype: Any = None      # default: model dtype
+    # Map whole-block prompt prefixes out of the content-addressed
+    # block cache instead of recomputing them (hit rate shows up in
+    # metrics as prefix_cache_hit_rate). Off = every prompt pays full
+    # prefill FLOPs, the pre-cache behavior.
+    prefix_caching: bool = True
+    # Max prefill tokens processed per engine step (block-aligned).
+    # None = unbounded: every admitted request's whole suffix
+    # prefills in its admission step (monolithic prefill). Set to
+    # bound the prefill work one step can absorb, so long prompts
+    # stream in across iterations interleaved with decode.
+    prefill_chunk: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -100,6 +128,8 @@ class _Queued:
     max_new: int
     deadline: Optional[float]
     submitted_at: float
+    chain: List[bytes]           # content-hash chain, hashed once at
+    #                              submit (not per admission retry)
 
 
 @dataclasses.dataclass
@@ -107,12 +137,19 @@ class _Seq:
     rid: int
     prompt: List[int]
     max_new: int
-    blocks: List[int]
+    blocks: List[int]            # refs held: shared prefix + private
     table: np.ndarray            # [table_width] int32 physical block ids
     n_cached: int                # tokens currently in the KV cache
     generated: List[int]
     submitted_at: float
-    first_token_at: float
+    chain: List[bytes]           # content-hash chain, one per full
+    #                              prompt block (empty: caching off)
+    registered: int              # prompt blocks published (or mapped
+    #                              from the cache) so far
+    first_token_at: Optional[float] = None
+    last_prefill_tok: int = 0    # argmax of the newest chunk's last
+    #                              real position; the first generated
+    #                              token once prefill completes
 
     @property
     def last_token(self) -> int:
@@ -168,6 +205,14 @@ class ServeEngine:
                 f"the block table holds {self._table_width}")
         pick_bucket(cfg.max_prompt, self._prefill_buckets)
         pick_bucket(cfg.max_batch, self._batch_buckets)
+        if cfg.prefill_chunk is not None:
+            # Chunks must start block-aligned (the resume fn's page
+            # writes are blockwise) and fit a bucket.
+            if cfg.prefill_chunk < bs or cfg.prefill_chunk % bs:
+                raise ValueError(
+                    f"prefill_chunk {cfg.prefill_chunk} must be a "
+                    f"positive multiple of block_size {bs}")
+            pick_bucket(cfg.prefill_chunk, self._prefill_buckets)
 
         n_blocks = cfg.n_blocks
         if n_blocks is None:
@@ -177,12 +222,19 @@ class ServeEngine:
         self.allocator = BlockAllocator(n_blocks, bs)
         self.cache = init_kv_cache(model_cfg, n_blocks, bs, mesh=mesh,
                                    dtype=cfg.cache_dtype)
-        self._prefill_fn, self._decode_fn = decode_lib.make_serve_fns(
-            model_cfg, mesh, block_size=bs, table_width=self._table_width)
+        self._prefill_fn, self._resume_fn, self._decode_fn = \
+            decode_lib.make_serve_fns(
+                model_cfg, mesh, block_size=bs,
+                table_width=self._table_width)
 
         self.metrics = ServeMetrics(clock=clock)
+        self.metrics.attach_allocator(self.allocator)
         self._queue: collections.deque[_Queued] = collections.deque()
         self._active: List[_Seq] = []
+        # Admitted sequences whose prefill has not completed: they
+        # hold their block reservation and consume a batch slot, but
+        # only join the decode batch once prefill finishes.
+        self._prefilling: List[_Seq] = []
         self._results: Dict[int, RequestResult] = {}
         self._rids = itertools.count()
 
@@ -225,8 +277,10 @@ class ServeEngine:
             raise QueueFull(
                 f"admission queue full ({self.cfg.max_queue} waiting)")
         rid = next(self._rids)
+        chain = (self._hash_chain(prompt) if self.cfg.prefix_caching
+                 else [])
         self._queue.append(_Queued(rid, prompt, max_new, deadline,
-                                   self._clock()))
+                                   self._clock(), chain))
         self.metrics.record_submitted()
         self.metrics.record_queue_depth(len(self._queue))
         return rid
@@ -235,7 +289,7 @@ class ServeEngine:
 
     @property
     def pending(self) -> bool:
-        return bool(self._queue or self._active)
+        return bool(self._queue or self._prefilling or self._active)
 
     def result(self, rid: int) -> Optional[RequestResult]:
         return self._results.get(rid)
@@ -247,11 +301,13 @@ class ServeEngine:
     # -- the scheduler iteration ------------------------------------
 
     def step(self) -> None:
-        """One iteration: retire → expire → admit (prefill) → decode."""
+        """One iteration: retire → expire → admit → prefill chunk(s)
+        → decode."""
         now = self._clock()
         self._retire_finished(now)
         self._expire_queued(now)
         self._admit(now)
+        self._advance_prefills()
         self._decode_once()
         self.metrics.record_queue_depth(len(self._queue))
 
@@ -303,46 +359,182 @@ class ServeEngine:
                 keep.append(req)
         self._queue = keep
 
+    def _hash_chain(self, prompt: List[int]) -> List[bytes]:
+        """Chained content hash per full prompt block (the partial
+        tail block, if any, stays private and unhashed)."""
+        bs = self.cfg.block_size
+        chain, h = [], b""
+        for i in range(len(prompt) // bs):
+            h = block_hash(h, prompt[i * bs:(i + 1) * bs])
+            chain.append(h)
+        return chain
+
     def _admit(self, now: float) -> None:
-        batch_was_empty = not self._active
-        while self._queue and len(self._active) < self.cfg.max_batch:
+        batch_was_empty = not self._active and not self._prefilling
+        while (self._queue and
+               len(self._active) + len(self._prefilling)
+               < self.cfg.max_batch):
             if self.cfg.scheduling == "static" and not batch_was_empty:
                 # Baseline scheduler: wait for the whole batch to
                 # drain before admitting again.
                 return
             req = self._queue[0]
-            need = self.allocator.blocks_for_tokens(
-                len(req.prompt) + req.max_new)
-            if not self.allocator.can_alloc(need):
+            plen = len(req.prompt)
+            need = self.allocator.blocks_for_tokens(plen + req.max_new)
+            # Walk the chain against the content index; every leading
+            # whole block already cached maps into this sequence's
+            # table with one refcount, zero FLOPs. Capped at plen-1
+            # tokens: the final prompt token must run through the
+            # model — its logits are the first generated token. The
+            # first walk is a non-mutating peek: a blocked request
+            # retries admission every step, and taking/releasing refs
+            # here would inflate the hit counters and churn the LRU
+            # order with reuse that never happened.
+            matchable = req.chain[:(plen - 1) // self.cfg.block_size]
+            n_match, n_revive = 0, 0
+            for h in matchable:
+                b = self.allocator.peek(h)
+                if b is None:
+                    break
+                n_match += 1
+                if self.allocator.refcount(b) == 0:
+                    # Reviving a refcount-0 cached block consumes a
+                    # unit of n_free just like a fresh allocation, so
+                    # it must count against capacity — or an
+                    # overcommitted pool passes this check and then
+                    # blows OutOfBlocks mid-admission.
+                    n_revive += 1
+            if not self.allocator.can_alloc(need - n_match + n_revive):
                 # KV backpressure (FIFO: no overtaking, so tail
                 # latency stays predictable under load).
                 return
             self._queue.popleft()
-            self._prefill(req, self.allocator.alloc(need))
+            # Commit: nothing mutated between peek and acquire, so
+            # the same blocks resolve — and hits (plus the one
+            # boundary miss) count once, for an admission that
+            # actually happened.
+            matched: List[int] = []
+            for h in matchable:
+                b = self.allocator.acquire_cached(h)
+                if b is None:
+                    break
+                matched.append(b)
+            assert len(matched) == n_match
+            blocks = matched + self.allocator.alloc(need - n_match)
+            table = np.zeros(self._table_width, np.int32)
+            table[:len(blocks)] = blocks
+            n_hit = len(matched) * self.cfg.block_size
+            self.metrics.record_prefix_lookup(n_hit, plen - n_hit)
+            self._prefilling.append(_Seq(
+                rid=req.rid, prompt=req.prompt, max_new=req.max_new,
+                blocks=blocks, table=table, n_cached=n_hit,
+                generated=[], submitted_at=req.submitted_at,
+                chain=req.chain, registered=len(matched)))
 
-    def _prefill(self, req: _Queued, blocks: List[int]) -> None:
+    def _advance_prefills(self) -> None:
+        """Run prefill chunks FIFO across admitted-but-incomplete
+        sequences, bounded per step by ``prefill_chunk`` tokens
+        (always at least one chunk, so progress is guaranteed). With
+        ``prefill_chunk=None`` every waiting suffix completes this
+        step — the monolithic behavior."""
+        budget = self.cfg.prefill_chunk
+        spent = 0
+        while self._prefilling and (budget is None or spent < budget):
+            seq = self._prefilling[0]
+            self._extend_prefix_match(seq)
+            remaining = len(seq.prompt) - seq.n_cached
+            if budget is None:
+                chunk = remaining
+            else:
+                # Cap by the UNSPENT budget, not the full chunk size:
+                # several queued suffixes could otherwise spend up to
+                # 2N-1 tokens in one step. Non-final chunks must end
+                # block-aligned (the next chunk's pages start there).
+                chunk = min(remaining, budget - spent)
+                if chunk < remaining:
+                    chunk -= chunk % self.cfg.block_size
+                    if chunk == 0:
+                        break
+            spent += self._run_prefill_chunk(seq, chunk)
+            if seq.n_cached >= len(seq.prompt):
+                self._prefilling.pop(0)
+                self._complete_prefill(seq)
+
+    def _extend_prefix_match(self, seq: _Seq) -> None:
+        """Retry the cache walk just before prefilling. Admission in a
+        burst step matches against a cache its same-step siblings
+        haven't populated yet (they register at prefill, after the
+        admission loop); by prefill time an identical prefix admitted
+        one slot earlier IS published, so a second walk converts those
+        would-be prefill tokens into hits. Safe whenever the cursor
+        sits on a whole-block boundary with every block up to it
+        published or mapped: the swapped slots hold no K/V yet, and
+        the displaced private blocks return to the pool."""
+        if (not self.cfg.prefix_caching
+                or seq.n_cached != seq.registered * self.cfg.block_size):
+            return
+        plen = len(seq.prompt)
+        extended = 0
+        for i in range(seq.registered,
+                       (plen - 1) // self.cfg.block_size):
+            # peek first: this walk reruns at every block-aligned
+            # chunk boundary, and a cold prompt would otherwise log
+            # one spurious miss per chunk.
+            if self.allocator.peek(seq.chain[i]) is None:
+                break
+            b = self.allocator.acquire_cached(seq.chain[i])
+            if b is None:
+                break
+            self.allocator.free([seq.blocks[i]])
+            seq.blocks[i] = b
+            seq.table[i] = b
+            seq.n_cached += self.cfg.block_size
+            seq.registered += 1
+            extended += self.cfg.block_size
+        if extended:
+            self.metrics.record_prefix_extend(extended)
+
+    def _run_prefill_chunk(self, seq: _Seq, chunk: int) -> int:
         import jax
 
-        plen = len(req.prompt)
-        bucket = pick_bucket(plen, self._prefill_buckets)
-        toks = np.zeros(bucket, np.int32)
-        toks[:plen] = req.prompt
-        table = np.zeros(self._table_width, np.int32)
-        table[:len(blocks)] = blocks
+        plen = len(seq.prompt)
+        offset = seq.n_cached
+        toks = np.zeros(pick_bucket(chunk, self._prefill_buckets), np.int32)
+        toks[:chunk] = seq.prompt[offset:offset + chunk]
         t0 = self._clock()
         with jax.profiler.TraceAnnotation("serve:prefill"):
-            kc, vc, tok = self._prefill_fn(
-                self._params, self.cache.k, self.cache.v, toks,
-                np.int32(plen), table)
+            if offset == 0 and chunk == plen:
+                # Whole cold prompt: the monolithic program (exactly
+                # the pre-cache code path, and the cheaper attention —
+                # prompt-local instead of a full table gather).
+                kc, vc, tok = self._prefill_fn(
+                    self._params, self.cache.k, self.cache.v, toks,
+                    np.int32(plen), seq.table)
+            else:
+                kc, vc, tok = self._resume_fn(
+                    self._params, self.cache.k, self.cache.v, toks,
+                    np.int32(offset), np.int32(chunk), seq.table)
             tok = int(tok)  # host sync — the step is done when this is
-        now = self._clock()
+        dur = self._clock() - t0
         self.cache.k, self.cache.v = kc, vc
-        self.metrics.record_prefill(t0, now - t0, plen)
-        self.metrics.record_first_token(now - req.submitted_at)
-        seq = _Seq(rid=req.rid, prompt=req.prompt, max_new=req.max_new,
-                   blocks=blocks, table=table, n_cached=plen,
-                   generated=[tok], submitted_at=req.submitted_at,
-                   first_token_at=now)
+        seq.n_cached = offset + chunk
+        seq.last_prefill_tok = tok
+        self.metrics.record_prefill(t0, dur, chunk, offset=offset)
+        if self.cfg.prefix_caching:
+            # Publish the prompt blocks this chunk filled. A losing
+            # race (hash already published by a concurrent twin) keeps
+            # the private copy anonymous — register() no-ops.
+            n_full = seq.n_cached // self.cfg.block_size
+            for i in range(seq.registered, n_full):
+                self.allocator.register(seq.blocks[i], seq.chain[i])
+            seq.registered = max(seq.registered, n_full)
+        return chunk
+
+    def _complete_prefill(self, seq: _Seq) -> None:
+        now = self._clock()
+        seq.generated.append(seq.last_prefill_tok)
+        seq.first_token_at = now
+        self.metrics.record_first_token(now - seq.submitted_at)
         if seq.finished(self.cfg.eos_id):
             self._finish(seq, now)
         else:

@@ -15,6 +15,17 @@ masked writes are routed there so the scatter in the decode step never
 needs a branch, and its contents are never read (attention masks by
 sequence length).
 
+Blocks are **refcounted and content-addressed**: a full (immutable)
+block can be published under a chained content hash
+(``block_hash(parent_hash, block_tokens)``) and later requests whose
+prompts share that whole-block prefix map the cached block straight
+into their block table instead of recomputing its K/V (prefix
+caching, the vLLM/SGLang "automatic prefix cache" design). A block
+whose refcount drops to zero keeps its contents and parks in an LRU
+pool; it is only *evicted* (contents forgotten) when a fresh
+allocation finds the plain free list empty — so "free" capacity
+usually means "still cached".
+
 Reference analog: none — the reference framework (training-only
 Horovod) has no inference path at all; this layout is the TPU-serving
 standard (PagedAttention, vLLM SOSP'23).
@@ -22,10 +33,23 @@ standard (PagedAttention, vLLM SOSP'23).
 
 from __future__ import annotations
 
+import collections
 import dataclasses
-from typing import Any, List, Optional, Tuple
+import hashlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
 
 NULL_BLOCK = 0
+
+
+def block_hash(parent: bytes, tokens) -> bytes:
+    """Chained content hash of one full block: the parent is the hash
+    of the preceding block (``b""`` for the first), so equal hashes
+    imply an equal whole-token prefix, not just an equal block."""
+    m = hashlib.blake2b(parent, digest_size=16)
+    m.update(np.asarray(tokens, np.int64).tobytes())
+    return m.digest()
 
 
 class OutOfBlocks(RuntimeError):
@@ -34,13 +58,28 @@ class OutOfBlocks(RuntimeError):
 
 
 class BlockAllocator:
-    """Host-side free-list over the device block pool.
+    """Host-side refcounted free-list over the device block pool.
 
     Paged allocation has no external fragmentation: any free block can
     serve any sequence, so ``can_alloc(n)`` is simply ``n <= n_free``.
-    The free list is LIFO so recently-retired blocks (likely still
-    warm in cache/HBM pages) are reused first, and allocation order is
-    deterministic for tests.
+    The plain free list is LIFO so recently-retired blocks (likely
+    still warm in cache/HBM pages) are reused first, and allocation
+    order is deterministic for tests.
+
+    Three disjoint states partition the non-null blocks:
+
+    * **live** — refcount >= 1 (``alloc`` hands out refcount-1 blocks;
+      :meth:`acquire_cached` revives or shares them). Counted by
+      ``n_used``.
+    * **cached** — refcount 0 but content-addressed: parked in an LRU
+      pool, still indexed by hash, revivable for free.
+    * **free** — refcount 0, no retained content.
+
+    ``n_free`` counts free + cached (both are allocatable); ``alloc``
+    drains the plain free list first and only then evicts the
+    least-recently-used cached blocks (``evictions`` counts those).
+    Eviction can never touch a block with live references — only
+    refcount-0 blocks enter the LRU pool.
     """
 
     def __init__(self, n_blocks: int, block_size: int):
@@ -53,56 +92,140 @@ class BlockAllocator:
         self.block_size = block_size
         # Block 0 is the null sink — never handed out.
         self._free: List[int] = list(range(n_blocks - 1, 0, -1))
-        # Mirror of _free for O(1) double-free checks: retiring a long
-        # sequence against a mostly-free pool was O(freed x n_free)
-        # inside the engine's step loop with the list scan.
-        self._free_set = set(self._free)
-        self._used = 0
+        self._refs: Dict[int, int] = {}          # live block -> refcount
+        # refcount-0 cached blocks, LRU order (oldest first = evicted
+        # first); value is the block's content hash.
+        self._lru: "collections.OrderedDict[int, bytes]" = \
+            collections.OrderedDict()
+        self._hash_of_block: Dict[int, bytes] = {}
+        self._block_of_hash: Dict[bytes, int] = {}
         self._high_water = 0
+        # Prefix-cache observability (block granularity; the engine
+        # layers token-granularity hit rate on top in ServeMetrics).
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.evictions = 0
 
     @property
     def n_free(self) -> int:
-        return len(self._free)
+        """Allocatable blocks: truly free + cached (refcount 0)."""
+        return len(self._free) + len(self._lru)
 
     @property
     def n_used(self) -> int:
-        return self._used
+        return len(self._refs)
+
+    @property
+    def n_cached(self) -> int:
+        """Refcount-0 blocks still holding indexed content (the LRU
+        pool a future prefix hit can revive for free)."""
+        return len(self._lru)
 
     @property
     def high_water(self) -> int:
         """Peak concurrent blocks in use (capacity-planning stat)."""
         return self._high_water
 
+    def refcount(self, block: int) -> int:
+        return self._refs.get(block, 0)
+
     def blocks_for_tokens(self, n_tokens: int) -> int:
         """Blocks needed to hold ``n_tokens`` cache entries."""
         return -(-max(n_tokens, 0) // self.block_size)
 
     def can_alloc(self, n: int) -> bool:
-        return n <= len(self._free)
+        return n <= self.n_free
 
     def alloc(self, n: int) -> List[int]:
-        if n > len(self._free):
+        if n > self.n_free:
             raise OutOfBlocks(
-                f"requested {n} KV blocks, {len(self._free)} free "
-                f"(pool {self.n_blocks - 1} x {self.block_size} tokens)")
-        out = [self._free.pop() for _ in range(n)]
-        self._free_set.difference_update(out)
-        self._used += n
-        self._high_water = max(self._high_water, self._used)
+                f"requested {n} KV blocks, {self.n_free} free "
+                f"({len(self._lru)} of them cached; pool "
+                f"{self.n_blocks - 1} x {self.block_size} tokens)")
+        out: List[int] = []
+        for _ in range(n):
+            if self._free:
+                b = self._free.pop()
+            else:
+                # Allocation pressure: forget the least-recently-used
+                # cached block. Only refcount-0 blocks live here, so
+                # eviction can never reclaim a referenced block.
+                b, h = self._lru.popitem(last=False)
+                del self._hash_of_block[b]
+                del self._block_of_hash[h]
+                self.evictions += 1
+            self._refs[b] = 1
+            out.append(b)
+        self._high_water = max(self._high_water, len(self._refs))
         return out
 
+    def peek(self, h: bytes) -> Optional[int]:
+        """Non-mutating lookup: the block published under ``h`` (live
+        or cached), or None. No refcount, no hit/miss counting, no
+        LRU reordering — what admission uses to size its reservation
+        before committing, so a backpressure retry loop doesn't
+        inflate the cache stats or churn eviction order."""
+        return self._block_of_hash.get(h)
+
+    def acquire_cached(self, h: bytes) -> Optional[int]:
+        """Prefix-cache lookup: if a block is published under ``h``,
+        take a reference on it (reviving it from the LRU pool if it
+        was refcount 0) and return its id; else record a miss and
+        return None."""
+        b = self._block_of_hash.get(h)
+        if b is None:
+            self.prefix_misses += 1
+            return None
+        if b in self._lru:
+            del self._lru[b]
+            self._refs[b] = 1
+            self._high_water = max(self._high_water, len(self._refs))
+        else:
+            self._refs[b] += 1
+        self.prefix_hits += 1
+        return b
+
+    def register(self, block: int, h: bytes) -> bool:
+        """Publish a live, full, immutable ``block`` under content hash
+        ``h``. Returns False (no-op) if ``h`` is already published —
+        two sequences racing to prefill the same prefix both keep
+        their private block; the first registration wins and the
+        loser's copy stays anonymous (returns to the plain free list
+        on release)."""
+        if block not in self._refs:
+            raise ValueError(
+                f"registering block {block} with no live reference")
+        if h in self._block_of_hash:
+            return False
+        if block in self._hash_of_block:
+            raise ValueError(f"block {block} already registered")
+        self._hash_of_block[block] = h
+        self._block_of_hash[h] = block
+        return True
+
     def free(self, blocks: List[int]) -> None:
+        """Drop one reference per listed block. A block whose refcount
+        reaches 0 parks in the LRU cache pool if it was registered
+        (revivable by a future prefix hit), else returns to the plain
+        free list."""
         seen = set()
         for b in blocks:
             if not 0 < b < self.n_blocks:
                 raise ValueError(f"freeing invalid block id {b}")
-            if b in self._free_set or b in seen:
+            if b not in self._refs or b in seen:
                 raise ValueError(f"double free of block {b}")
             seen.add(b)
         # Validate-all-then-mutate: the pool is untouched on error.
-        self._free.extend(blocks)
-        self._free_set.update(blocks)
-        self._used -= len(blocks)
+        for b in blocks:
+            self._refs[b] -= 1
+            if self._refs[b]:
+                continue
+            del self._refs[b]
+            h = self._hash_of_block.get(b)
+            if h is None:
+                self._free.append(b)
+            else:
+                self._lru[b] = h        # most-recently-released last
 
 
 @dataclasses.dataclass

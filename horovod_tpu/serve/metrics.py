@@ -43,6 +43,8 @@ def percentile(samples: List[float], q: float) -> Optional[float]:
 class ServeMetrics:
     def __init__(self, clock=time.perf_counter):
         self._clock = clock
+        self._allocator = None
+        self._alloc_base = (0, 0, 0)
         self.reset()
 
     def reset(self) -> None:
@@ -57,9 +59,31 @@ class ServeMetrics:
         self.queue_depth = 0
         self.max_queue_depth = 0
         self._occupancy_sum = 0.0
+        # Token-granularity prefix-cache accounting: per admission,
+        # how many prompt tokens were served out of the cache vs
+        # prefilled. The block-granularity counters (hits / misses /
+        # evictions) live on the attached BlockAllocator.
+        self.prefix_hit_tokens = 0
+        self.prefix_prefill_tokens = 0
         self.first_token_s: List[float] = []
         self.per_token_s: List[float] = []
         self._events: List[dict] = []
+        # Allocator counters are lifetime totals; baseline them here
+        # so snapshots report the same window as every other counter
+        # in this object (reset-to-now), not engine-lifetime numbers.
+        if self._allocator is not None:
+            a = self._allocator
+            self._alloc_base = (a.prefix_hits, a.prefix_misses,
+                                a.evictions)
+
+    def attach_allocator(self, allocator) -> None:
+        """Let snapshots/trace export read the block pool's gauges
+        (blocks in use, cached, high water) and prefix counters
+        without the engine copying them in per step."""
+        self._allocator = allocator
+        self._alloc_base = (allocator.prefix_hits,
+                            allocator.prefix_misses,
+                            allocator.evictions)
 
     # -- recording ---------------------------------------------------
 
@@ -69,18 +93,51 @@ class ServeMetrics:
         # not grow host memory step by step.
         if len(self._events) >= MAX_SAMPLES:
             return
+        ts = round((t0 - self.started_at) * 1e6, 1)
         self._events.append({
             "name": name, "ph": "X", "pid": 0, "tid": 0,
-            "ts": round((t0 - self.started_at) * 1e6, 1),
-            "dur": round(dur * 1e6, 1), "args": args})
+            "ts": ts, "dur": round(dur * 1e6, 1), "args": args})
+        if (self._allocator is not None
+                and len(self._events) < MAX_SAMPLES):
+            # Pool occupancy as a counter track next to the spans:
+            # live blocks vs warm (refcount-0 cached) blocks per step.
+            self._events.append({
+                "name": "kv_blocks", "ph": "C", "pid": 0, "tid": 0,
+                "ts": ts, "args": {"in_use": self._allocator.n_used,
+                                   "cached": self._allocator.n_cached}})
 
     def record_queue_depth(self, depth: int) -> None:
         self.queue_depth = depth
         self.max_queue_depth = max(self.max_queue_depth, depth)
 
-    def record_prefill(self, t0: float, dur_s: float, prompt_len: int) -> None:
+    def _pool_gauges(self) -> dict:
+        a = self._allocator
+        if a is None:
+            return {}
+        return {"blocks_in_use": a.n_used, "blocks_cached": a.n_cached}
+
+    def record_prefill(self, t0: float, dur_s: float, n_tokens: int,
+                       offset: int = 0) -> None:
+        """One prefill chunk of ``n_tokens`` starting at token
+        ``offset`` (0 + whole prompt = the monolithic case)."""
         self.prefill_steps += 1
-        self._span("serve:prefill", t0, dur_s, prompt_len=prompt_len)
+        self._span("serve:prefill", t0, dur_s, n_tokens=n_tokens,
+                   offset=offset, **self._pool_gauges())
+
+    def record_prefix_lookup(self, hit_tokens: int,
+                             suffix_tokens: int) -> None:
+        """One admission's cache outcome: ``hit_tokens`` prompt tokens
+        mapped from the prefix cache, ``suffix_tokens`` left to
+        prefill. Their running ratio is the hit rate."""
+        self.prefix_hit_tokens += hit_tokens
+        self.prefix_prefill_tokens += suffix_tokens
+
+    def record_prefix_extend(self, tokens: int) -> None:
+        """Tokens converted from pending-prefill to cache hits by the
+        engine's second cache walk at prefill time (same-step burst
+        siblings publish between admission and prefill)."""
+        self.prefix_hit_tokens += tokens
+        self.prefix_prefill_tokens -= tokens
 
     def record_decode(self, t0: float, dur_s: float, n_active: int,
                       max_batch: int) -> None:
@@ -91,7 +148,8 @@ class ServeMetrics:
             # Every active sequence advanced one token this step, so
             # the step wall time IS the per-token latency sample.
             self.per_token_s.append(dur_s)
-        self._span("serve:decode", t0, dur_s, n_active=n_active)
+        self._span("serve:decode", t0, dur_s, n_active=n_active,
+                   **self._pool_gauges())
 
     def record_first_token(self, latency_s: float) -> None:
         # The first token comes out of prefill, not a decode step —
@@ -122,7 +180,8 @@ class ServeMetrics:
 
         occ = (self._occupancy_sum / self.decode_steps
                if self.decode_steps else 0.0)
-        return {
+        looked_up = self.prefix_hit_tokens + self.prefix_prefill_tokens
+        out = {
             "elapsed_s": round(elapsed, 3),
             "tokens_generated": self.tokens_generated,
             "tokens_per_sec": round(self.tokens_generated / elapsed, 2),
@@ -135,11 +194,32 @@ class ServeMetrics:
             "queue_depth": self.queue_depth,
             "max_queue_depth": self.max_queue_depth,
             "batch_occupancy": round(occ, 4),
+            "prefix_cache_hit_rate": (
+                round(self.prefix_hit_tokens / looked_up, 4)
+                if looked_up else 0.0),
+            "prefix_hit_tokens": self.prefix_hit_tokens,
             "p50_first_token_ms": ms(percentile(self.first_token_s, 50)),
             "p99_first_token_ms": ms(percentile(self.first_token_s, 99)),
             "p50_per_token_ms": ms(percentile(self.per_token_s, 50)),
             "p99_per_token_ms": ms(percentile(self.per_token_s, 99)),
         }
+        if self._allocator is not None:
+            a = self._allocator
+            hits0, misses0, evict0 = self._alloc_base
+            out.update({
+                # Block-pool health: peak-vs-current reservation cost
+                # and how much "free" capacity is really warm cache.
+                # Counters are deltas since reset() (same window as
+                # the token counters above); the high-water gauge is
+                # engine-lifetime by design (capacity planning).
+                "kv_blocks_in_use": a.n_used,
+                "kv_blocks_cached": a.n_cached,
+                "kv_blocks_high_water": a.high_water,
+                "prefix_block_hits": a.prefix_hits - hits0,
+                "prefix_block_misses": a.prefix_misses - misses0,
+                "prefix_block_evictions": a.evictions - evict0,
+            })
+        return out
 
     def export_chrome_trace(self, path: str) -> None:
         """Write recorded step spans as a chrome-tracing file (the

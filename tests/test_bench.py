@@ -33,6 +33,42 @@ def test_find_regressions_ignores_improvements_and_new_metrics():
     assert bench.find_regressions(prev, cur) == {}
 
 
+def test_find_regressions_latency_keys_are_lower_is_better():
+    """`serve_p50/p99_*_ms` keys regress when they RISE: the old
+    higher-is-better comparison reported a latency blowup as an
+    improvement and a latency win as a drop."""
+    prev = {"extra": {"serve_p99_per_token_ms": 10.0,
+                      "serve_p50_first_token_ms": 40.0,
+                      "serve_tokens_per_sec_per_chip": 1000.0}}
+    # Latency rose 50% -> flagged (with rise_pct, not drop_pct).
+    cur = {"extra": {"serve_p99_per_token_ms": 15.0,
+                     "serve_p50_first_token_ms": 40.0,
+                     "serve_tokens_per_sec_per_chip": 1000.0}}
+    regs = bench.find_regressions(prev, cur)
+    assert set(regs) == {"extra.serve_p99_per_token_ms"}
+    assert regs["extra.serve_p99_per_token_ms"]["rise_pct"] == 50.0
+    # Latency halved -> a WIN, not a drop; throughput halved -> still
+    # flagged the usual way. Both directions in one payload.
+    cur2 = {"extra": {"serve_p99_per_token_ms": 5.0,
+                      "serve_p50_first_token_ms": 40.0,
+                      "serve_tokens_per_sec_per_chip": 500.0}}
+    regs2 = bench.find_regressions(prev, cur2)
+    assert "extra.serve_p99_per_token_ms" not in regs2
+    assert "extra.serve_tokens_per_sec_per_chip" in regs2
+
+
+def test_find_regressions_skips_directionless_counters():
+    # Step counts / eviction totals / high-water gauges have no
+    # better-or-worse direction; swings must not trip the gate.
+    prev = {"extra": {"serve_decode_steps": 290.0,
+                      "serve_prefix_block_evictions": 40.0,
+                      "serve_prefix_kv_high_water": 81.0}}
+    cur = {"extra": {"serve_decode_steps": 150.0,
+                     "serve_prefix_block_evictions": 0.0,
+                     "serve_prefix_kv_high_water": 120.0}}
+    assert bench.find_regressions(prev, cur) == {}
+
+
 def test_find_regressions_threshold_boundary():
     prev = {"value": 100.0}
     assert bench.find_regressions(prev, {"value": 91.0}) == {}

@@ -3,6 +3,8 @@ deadline expiry / mid-batch retirement / backpressure), and decode
 parity — served greedy decode must be bitwise-identical to the
 single-request reference and track the full-context forward."""
 
+import itertools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -13,7 +15,7 @@ from horovod_tpu.models import (
 )
 from horovod_tpu.serve import (
     BlockAllocator, OutOfBlocks, QueueFull, ServeConfig, ServeEngine,
-    pick_bucket,
+    block_hash, pick_bucket,
 )
 
 
@@ -64,6 +66,131 @@ def test_allocator_blocks_for_tokens():
     assert a.blocks_for_tokens(1) == 1
     assert a.blocks_for_tokens(8) == 1
     assert a.blocks_for_tokens(9) == 2
+
+
+def test_block_hash_is_chained():
+    h1 = block_hash(b"", [1, 2, 3, 4])
+    assert h1 == block_hash(b"", [1, 2, 3, 4])   # deterministic
+    assert h1 != block_hash(b"", [1, 2, 3, 5])   # content-sensitive
+    # Same block content under a different parent is a different
+    # prefix — the chain is what makes hash equality mean whole-prefix
+    # equality, not just block equality.
+    assert block_hash(h1, [9, 9]) != block_hash(b"", [9, 9])
+
+
+def test_allocator_register_share_release_cycle():
+    a = BlockAllocator(n_blocks=6, block_size=4)
+    (b,) = a.alloc(1)
+    h = block_hash(b"", [1, 2, 3, 4])
+    assert a.register(b, h)
+    # Second registration under the same hash loses (dedup): the
+    # first mapping survives.
+    (b2,) = a.alloc(1)
+    assert not a.register(b2, h)
+    a.free([b2])
+    assert a.n_cached == 0          # anonymous block -> plain free
+
+    # Sharing: a cache hit on a live block just bumps its refcount.
+    assert a.acquire_cached(h) == b
+    assert a.refcount(b) == 2
+    a.free([b])
+    assert a.refcount(b) == 1 and a.n_used == 1
+    a.free([b])
+    # Refcount 0 + registered -> parked in the LRU pool, not freed:
+    # still allocatable capacity, still a hit.
+    assert a.n_used == 0 and a.n_cached == 1 and a.n_free == 5
+    assert a.acquire_cached(h) == b
+    assert a.n_used == 1 and a.n_cached == 0
+    a.free([b])
+    with pytest.raises(ValueError):
+        a.free([b])                 # double free detected on cached too
+
+
+def test_allocator_lru_eviction_only_under_pressure():
+    a = BlockAllocator(n_blocks=5, block_size=4)
+    blocks = a.alloc(3)
+    hs = [block_hash(b"", [i]) for i in range(3)]
+    for b, h in zip(blocks, hs):
+        a.register(b, h)
+    a.free(blocks)                  # release order == LRU order
+    assert a.n_cached == 3 and a.n_free == 4
+    # One plain-free block remains: the first alloc must consume it
+    # and leave the cache intact.
+    (x,) = a.alloc(1)
+    assert a.n_cached == 3 and a.evictions == 0
+    # Pressure: the next alloc evicts the LEAST recently released.
+    (y,) = a.alloc(1)
+    assert y == blocks[0] and a.evictions == 1
+    assert a.acquire_cached(hs[0]) is None      # forgotten
+    assert a.acquire_cached(hs[1]) == blocks[1]  # survivors still hit
+    assert a.prefix_misses == 1 and a.prefix_hits == 1
+    a.free([x, y, blocks[1]])
+
+
+def test_allocator_randomized_stress():
+    """Randomized interleaving of alloc/register/share/free/evict
+    against a shadow model: no leaks, no double frees, ``n_used``
+    always equals the number of live-ref blocks, eviction never
+    reclaims a block that has references, and the three states
+    (live/cached/free) always partition the pool."""
+    rng = np.random.RandomState(1234)
+    n_blocks, bs = 33, 4
+    a = BlockAllocator(n_blocks, bs)
+    live = {}                       # block -> shadow refcount
+    next_tok = itertools.count()
+    registered = {}                 # block -> hash (live or cached)
+    for step in range(3000):
+        op = rng.randint(4)
+        if op == 0:                 # alloc 1-4 blocks
+            n = int(rng.randint(1, 5))
+            if a.can_alloc(n):
+                before_cached = a.n_cached
+                got = a.alloc(n)
+                assert len(set(got)) == n and 0 not in got
+                evicted = sum(1 for b in got if b in registered)
+                # alloc may shrink the cache (evictions) but never
+                # grow it, and every eviction is accounted.
+                assert a.n_cached == before_cached - evicted
+                for b in got:
+                    assert b not in live, "handed out a live block"
+                    # Eviction dropped the index entry if this block
+                    # came from the LRU pool.
+                    registered.pop(b, None)
+                    live[b] = 1
+            else:
+                with pytest.raises(OutOfBlocks):
+                    a.alloc(n)
+        elif op == 1 and live:      # register a live block
+            b = int(rng.choice(sorted(live)))
+            if b not in registered:
+                h = block_hash(b"", [next(next_tok)])
+                assert a.register(b, h)
+                registered[b] = h
+        elif op == 2 and registered:  # cache-hit / share
+            b = int(rng.choice(sorted(registered)))
+            got = a.acquire_cached(registered[b])
+            assert got == b, "hash must resolve to its block"
+            live[b] = live.get(b, 0) + 1
+        elif op == 3 and live:      # drop one ref
+            b = int(rng.choice(sorted(live)))
+            a.free([b])
+            live[b] -= 1
+            if not live[b]:
+                del live[b]
+                with pytest.raises(ValueError):
+                    a.free([b])     # double free always detected
+        # Invariants, every step.
+        assert a.n_used == len(live)
+        assert {b for b in live} == set(a._refs)
+        for b, r in live.items():
+            assert a.refcount(b) == r
+        assert a.n_used + a.n_free == n_blocks - 1
+        assert a.n_cached == len(set(registered) - set(live))
+    # Drain: every live ref released -> pool fully reclaimable.
+    for b, r in list(live.items()):
+        for _ in range(r):
+            a.free([b])
+    assert a.n_used == 0 and a.n_free == n_blocks - 1
 
 
 def test_pick_bucket():
@@ -270,11 +397,14 @@ def test_eos_stops_early(served_model):
 
 def test_tp_sharded_decode_matches(served_model, devices):
     """Tensor-parallel decode over the mesh (tp-sharded params + KV
-    pool, GSPMD psums on the hot loop) produces the same tokens."""
+    pool, GSPMD psums on the hot loop) produces the same tokens —
+    including through the prefix-cache suffix-resume path (the shared
+    8-token prefix makes request 2+ take it)."""
     from horovod_tpu.parallel import build_mesh
 
     cfg, params = served_model
-    prompts = _prompts(3, rng_seed=11)
+    shared = list(range(1, 9))       # one whole block at block_size 8
+    prompts = [shared + p for p in _prompts(3, rng_seed=11, lo=2, hi=6)]
     ref = _mk_engine(served_model).generate(prompts, 4)
     mesh = build_mesh(dp=4, tp=2)
     params_sh = init_transformer(cfg, jax.random.PRNGKey(0), mesh)
@@ -294,9 +424,152 @@ def test_metrics_snapshot_and_trace(served_model, tmp_path):
     assert snap["tokens_per_sec"] > 0
     assert snap["p99_first_token_ms"] >= snap["p50_first_token_ms"] >= 0
     assert 0 < snap["batch_occupancy"] <= 1
+    # Block-pool gauges ride every snapshot (high_water used to be
+    # computed but never reported anywhere).
+    assert snap["kv_blocks_high_water"] == eng.allocator.high_water > 0
+    assert snap["kv_blocks_in_use"] == 0          # all retired
+    assert snap["kv_blocks_cached"] == eng.allocator.n_cached
+    assert snap["prefix_block_evictions"] == 0
+    assert 0.0 <= snap["prefix_cache_hit_rate"] <= 1.0
     path = tmp_path / "serve_trace.json"
     eng.metrics.export_chrome_trace(str(path))
     import json
     events = json.loads(path.read_text())["traceEvents"]
     names = {e["name"] for e in events}
     assert {"serve:prefill", "serve:decode"} <= names
+    # Pool occupancy exported as a chrome counter track.
+    counters = [e for e in events if e["ph"] == "C"
+                and e["name"] == "kv_blocks"]
+    assert counters and all(
+        {"in_use", "cached"} <= set(e["args"]) for e in counters)
+    assert max(e["args"]["in_use"] for e in counters) > 0
+
+
+# ---------------------------------------------------------------------------
+# Prefix caching + chunked prefill
+# ---------------------------------------------------------------------------
+
+# One shared geometry for every engine below -> one compiled fn set
+# (make_serve_fns memoizes on it), keeping tier-1 compile cost flat.
+_PFX_KW = dict(max_batch=4, block_size=4, max_prompt=24,
+               max_new_tokens=6, batch_buckets=(4,),
+               prefill_buckets=(4, 8, 16, 24))
+
+
+def _shared_prefix_prompts(n=5, prefix_len=12, rng_seed=21):
+    rng = np.random.RandomState(rng_seed)
+    prefix = rng.randint(1, 256, size=prefix_len).tolist()
+    return [prefix + rng.randint(1, 256,
+                                 size=int(rng.randint(2, 6))).tolist()
+            for _ in range(n)]
+
+
+def test_prefix_cache_maps_shared_blocks(served_model):
+    prompts = _shared_prefix_prompts()
+    eng = _mk_engine(served_model, **_PFX_KW)
+    eng.generate(prompts, 4)
+    a = eng.allocator
+    # 12-token prefix = 3 whole blocks; every request after the first
+    # maps them instead of re-prefilling (the second walk at prefill
+    # time catches even same-step burst siblings).
+    assert a.prefix_hits >= 3 * (len(prompts) - 1)
+    snap = eng.metrics.snapshot()
+    assert snap["prefix_cache_hit_rate"] > 0.5
+    assert snap["prefix_hit_tokens"] >= 12 * (len(prompts) - 1)
+    # Retired sequences parked their registered blocks in the cache
+    # pool: capacity is free, content is warm.
+    assert a.n_used == 0 and a.n_cached > 0
+    # A fresh same-prefix request pays only its suffix.
+    before = a.prefix_hits
+    eng.generate([prompts[0]], 4)
+    assert a.prefix_hits >= before + 3
+
+
+def test_prefix_cache_sharing_holds_one_refcount_per_seq(served_model):
+    # Two same-prefix sequences decoding concurrently share physical
+    # prefix blocks: total blocks in use < 2x the solo footprint.
+    prompts = _shared_prefix_prompts(2)
+    eng = _mk_engine(served_model, **_PFX_KW)
+    r1 = eng.submit(prompts[0], 6)
+    r2 = eng.submit(prompts[1], 6)
+    eng.step()
+    assert eng.allocator.n_used < 2 * eng.allocator.blocks_for_tokens(
+        len(prompts[0]) + 6)
+    shared = [b for b in eng.allocator._refs
+              if eng.allocator.refcount(b) == 2]
+    assert len(shared) == 3          # the three whole prefix blocks
+    eng.run_until_idle()
+    assert (eng.result(r1).status == "ok"
+            and eng.result(r2).status == "ok")
+    assert eng.allocator.n_used == 0
+
+
+def test_admission_counts_cached_revivals_against_capacity(served_model):
+    """Overcommitted pool: admission's capacity check must count the
+    revival of refcount-0 cached matched blocks (they consume free
+    capacity exactly like fresh allocations). Miscounting popped the
+    request and then blew OutOfBlocks mid-admission instead of
+    applying backpressure."""
+    prompts = _shared_prefix_prompts(3)
+    need = -(-(len(max(prompts, key=len)) + 6) // 4)
+    # Pool sized so one sequence fits with almost nothing spare: the
+    # second same-prefix request's matched blocks are refcount-0
+    # cached (first retired), and its fresh-block need exceeds what
+    # remains once the revivals are accounted.
+    eng = _mk_engine(served_model, **_PFX_KW, n_blocks=need + 2)
+    outs = eng.generate(prompts, 6)      # serialized by backpressure
+    assert [len(o) for o in outs] == [6, 6, 6]
+    assert eng.allocator.n_used == 0
+    # Same prompts again through the now-warm (and repeatedly
+    # evicted) cache: still completes, never raises.
+    assert eng.generate(prompts, 6) == outs
+    """Acceptance: decoded token streams are bitwise identical with
+    the prefix cache on vs off, and with chunked prefill vs
+    monolithic, on a shared-prefix trace."""
+    prompts = _shared_prefix_prompts(6)
+    ref = _mk_engine(served_model, **_PFX_KW,
+                     prefix_caching=False).generate(prompts, 5)
+    cached = _mk_engine(served_model, **_PFX_KW).generate(prompts, 5)
+    chunked = _mk_engine(served_model, **_PFX_KW,
+                         prefill_chunk=4).generate(prompts, 5)
+    chunked_nocache = _mk_engine(
+        served_model, **_PFX_KW, prefix_caching=False,
+        prefill_chunk=4).generate(prompts, 5)
+    assert cached == ref
+    assert chunked == ref
+    assert chunked_nocache == ref
+
+
+def test_chunked_prefill_interleaves_with_decode(served_model):
+    """A long prompt streams in across steps while the running batch
+    keeps decoding; the chunking sequence holds its blocks but stays
+    out of the decode batch until prefill completes."""
+    eng = _mk_engine(served_model, **_PFX_KW, prefill_chunk=4,
+                     prefix_caching=False)
+    short = eng.submit([1, 2, 3], 6)
+    eng.step()                       # short prefills + first decode
+    rng = np.random.RandomState(3)
+    long_rid = eng.submit(rng.randint(1, 256, size=20).tolist(), 2)
+    eng.step()                       # long admitted + chunk 1 of 5
+    assert eng._prefilling and eng._prefilling[0].rid == long_rid
+    held = eng.allocator.blocks_for_tokens(20 + 2)
+    decode_before = eng.metrics.decode_steps
+    interleaved = 0
+    while eng._prefilling:
+        # Mid-prefill the sequence holds its whole reservation but is
+        # not in the decode batch and has no result yet.
+        assert eng.allocator.n_used >= held
+        assert all(s.rid != long_rid for s in eng._active)
+        assert eng.result(long_rid) is None
+        eng.step()
+        interleaved += 1
+    # 20 tokens at chunk 4 = 5 chunks: one at admission, the rest one
+    # per iteration interleaved with decode.
+    assert interleaved >= 4
+    # Decode kept running during those steps — the long prompt never
+    # monopolized an iteration (the chunking claim).
+    assert eng.metrics.decode_steps - decode_before >= 3
+    eng.run_until_idle()
+    assert len(eng.result(long_rid).tokens) == 2
+    assert len(eng.result(short).tokens) == 6
+    assert eng.allocator.n_used == 0
