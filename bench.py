@@ -98,6 +98,10 @@ def _bus_worker():
     xs = [np.ones(n_small, np.float32) for _ in range(BUS_FUSED_COUNT)]
     for _ in range(2):
         hvd.grouped_allreduce(xs, op=hvd.Sum, name="bwf")
+    # Telemetry window: the timed fused rounds only, so the derived
+    # efficiency keys (fusion fill, cycle p99) describe the workload
+    # tensor fusion exists for, not the single-tensor warmups above.
+    hvd.metrics_reset()
     total = BUS_FUSED_COUNT * n_small * 4
     iters, best_dt = 10, None
     for _ in range(3):
@@ -110,6 +114,20 @@ def _bus_worker():
     results[f"fused_{BUS_FUSED_COUNT}x{BUS_FUSED_KB}KB"] = round(
         algbw * 2 * (s - 1) / s, 3)
     if r == 0:
+        # Efficiency keys derived from the native metrics registry
+        # (docs/observability.md), scoped to the fused rounds by the
+        # reset above: how full the fusion batches ran against the live
+        # threshold, and the coordinator-cycle tail (log2-bucket upper
+        # bound, so a power of two).
+        m = hvd.metrics()
+        tele = {}
+        if m.get("fusion_fill_pct_count"):
+            tele["fusion_fill_pct"] = round(
+                m["fusion_fill_pct_sum"] / m["fusion_fill_pct_count"], 1)
+        if m.get("cycle_us_count"):
+            tele["cycle_us_p99"] = m["cycle_us_p99"]
+        if tele:
+            results["telemetry"] = tele
         print("BUSBW " + json.dumps(results), flush=True)
     hvd.shutdown()
 
@@ -151,6 +169,15 @@ def _bus_wire_worker():
         for name, comp in codecs:
             bw = (n * 4 * iters / best[name]) / 1e9 * 2 * (s - 1) / s
             results[name] = round(bw, 3)
+        # Bytes that actually skipped the wire, straight from the
+        # codec's encode-site accounting (pre = f32 payload presented
+        # to encode, post = encoded bytes sent) across the compressed
+        # rounds — measured savings, not the theoretical ratio below.
+        m = hvd.metrics()
+        if m.get("wire_pre_bytes_total"):
+            results["wire_bytes_saved_pct"] = round(
+                100.0 * (1 - m["wire_post_bytes_total"]
+                         / m["wire_pre_bytes_total"]), 1)
         results["ratio"] = {
             name: round(n * 4 / lib.hvd_wire_encoded_bytes(
                 comp.wire_codec, ctypes.c_int64(n)), 2)
@@ -402,7 +429,12 @@ def _previous_bench(bench_dir=None):
 # totals, high-water gauges) have no better/worse direction at all and
 # are excluded from the gate.
 LOWER_IS_BETTER_SUFFIXES = ("_ms",)
-UNGATED_SUFFIXES = ("_steps", "_evictions", "_high_water")
+# _us_p99 (coordinator-cycle tail) is a log2-bucket upper bound that
+# jumps in powers of two with scheduler noise; _fill_pct tracks the
+# autotuner's live fusion threshold. Neither has a stable enough
+# better/worse direction for a 10% gate — they are trajectory keys.
+UNGATED_SUFFIXES = ("_steps", "_evictions", "_high_water", "_us_p99",
+                    "_fill_pct")
 
 
 def find_regressions(prev, cur, threshold=0.10):
@@ -544,6 +576,15 @@ def main():
             and budget - (time.perf_counter() - _T0) > 120):
         bus = _bus_bandwidth()
         if bus is not None:
+            # Registry-derived efficiency keys (ISSUE 5): the perf
+            # trajectory captures fusion efficiency and coordinator
+            # tail, not just throughput.
+            tele = bus.pop("telemetry", {})
+            if tele.get("fusion_fill_pct") is not None:
+                extra["host_allreduce_fusion_fill_pct"] = (
+                    tele["fusion_fill_pct"])
+            if tele.get("cycle_us_p99") is not None:
+                extra["host_allreduce_cycle_us_p99"] = tele["cycle_us_p99"]
             # The fused-small-tensor case gets its own key so the
             # fusion win/loss is legible in the perf trajectory next
             # to the single-tensor sizes.
@@ -565,6 +606,13 @@ def main():
         wire = _bus_wire_bandwidth()
         if wire is not None:
             ratio = wire.pop("ratio", {})
+            saved = wire.pop("wire_bytes_saved_pct", None)
+            if saved is not None:
+                # Measured on-the-wire savings from the codec's own
+                # byte accounting (pre vs post encode) — a codec or
+                # plumbing regression shows here even when busbw noise
+                # hides it.
+                extra["wire_bytes_saved_pct"] = saved
             extra["host_allreduce_busbw_wire_bf16_gbps_np4"] = {
                 f"{BUS_WIRE_MB}MB": wire.get("bf16"),
                 f"{BUS_WIRE_MB}MB_none_ref": wire.get("none"),

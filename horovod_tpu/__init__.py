@@ -39,6 +39,13 @@ from horovod_tpu.common.exceptions import (  # noqa: F401
 from horovod_tpu.common.ops_enum import (  # noqa: F401
     Average, Sum, Min, Max, Product, Adasum, ReduceOp,
 )
+# Load the telemetry SUBMODULE before the api import below rebinds the
+# package attribute `metrics` to the accessor function: once loaded,
+# re-imports resolve through sys.modules and never clobber the
+# function. Internal code must import it by full path
+# (`from horovod_tpu.metrics import ...`), never `from horovod_tpu
+# import metrics` — that now names the function.
+import horovod_tpu.metrics  # noqa: F401  (see comment above)
 from horovod_tpu.api import (  # noqa: F401
     init,
     shutdown,
@@ -70,6 +77,12 @@ from horovod_tpu.api import (  # noqa: F401
     mpi_threads_supported,
     start_timeline,
     stop_timeline,
+    metrics,
+    metrics_prometheus,
+    metrics_aggregate,
+    metrics_reset,
+    stalled_tensors,
+    start_metrics_server,
 )
 from horovod_tpu.compression import Compression  # noqa: F401
 from horovod_tpu.functions import (  # noqa: F401

@@ -25,7 +25,9 @@ __all__ = [
     "alltoall_async", "reducescatter", "reducescatter_async", "join",
     "barrier", "synchronize", "poll", "mpi_threads_supported",
     "start_timeline", "stop_timeline", "reduce_threads",
-    "set_reduce_threads",
+    "set_reduce_threads", "metrics", "metrics_prometheus",
+    "metrics_aggregate", "metrics_reset", "stalled_tensors",
+    "start_metrics_server",
 ]
 
 
@@ -84,6 +86,57 @@ def set_reduce_threads(n: int) -> None:
     """Override this process's host-reduction thread budget at runtime
     (bitwise-safe at any value; clamped to [1, 64])."""
     get_runtime().set_reduce_threads(n)
+
+
+# ---------------------------------------------------------------------------
+# telemetry (docs/observability.md)
+# ---------------------------------------------------------------------------
+
+def metrics():
+    """Flat dict of the native registry's counters, gauges, and
+    per-histogram count/sum/avg/p50/p99 — the continuously queryable
+    counterpart of the chrome timeline. Works before ``init()`` (zeros)
+    and needs no collective."""
+    from horovod_tpu.metrics import metrics as _metrics_fn
+    return _metrics_fn()
+
+
+def metrics_prometheus() -> str:
+    """Prometheus text exposition of the whole process: native runtime
+    series plus any registered secondary exporter (the serving engine's
+    ``ServeMetrics``). Serve it with :func:`start_metrics_server`."""
+    from horovod_tpu.metrics import metrics_prometheus as _fn
+    return _fn()
+
+
+def metrics_aggregate():
+    """Cross-rank ``{series: {"min", "max", "sum"}}`` reduced over the
+    allreduce data plane. A COLLECTIVE — every rank must call it; the
+    min/max spread of the timing series is the straggler signal."""
+    from horovod_tpu.metrics import metrics_aggregate as _fn
+    return _fn()
+
+
+def metrics_reset() -> None:
+    """Zero every native counter/histogram (scopes a measurement
+    window, e.g. around a benchmark run)."""
+    from horovod_tpu.metrics import metrics_reset as _fn
+    _fn()
+
+
+def stalled_tensors():
+    """Coordinator-side stall findings as data (one ``{"name",
+    "age_secs", "missing_ranks"}`` per tensor past the warning age) —
+    the queryable form of the StallInspector's log warning."""
+    from horovod_tpu.metrics import stalled_tensors as _fn
+    return _fn()
+
+
+def start_metrics_server(port: int = 0, addr: str = "0.0.0.0"):
+    """Serve the Prometheus exposition over HTTP (typically rank 0);
+    returns the server — bound port at ``server.server_address[1]``."""
+    from horovod_tpu.metrics import start_metrics_server as _fn
+    return _fn(port, addr)
 
 
 def _resolve_op(op: Optional[ReduceOp], average: Optional[bool]) -> ReduceOp:

@@ -38,9 +38,15 @@ EXEC_CALLBACK = 1
 # is enforced at library load below, and tests/test_wire_abi.py greps
 # the header so a native bump can't silently skew this shim even
 # before a rebuild happens.
-ABI_VERSION = 5
+ABI_VERSION = 6
 WIRE_VERSION_REQUEST_LIST = 2
 WIRE_VERSION_RESPONSE_LIST = 5
+
+# Metrics snapshot layout version (native/include/hvd/metrics.h
+# kMetricsVersion): the packed int64 layout hvd_metrics_snapshot
+# writes. Checked at library load AND against the header by
+# tests/test_metrics_abi.py, the same two-sided pin as the ABI above.
+METRICS_VERSION = 1
 
 # Native WireCodec ids (native/include/hvd/codec.h); -1 = follow the
 # job-wide HOROVOD_WIRE_COMPRESSION default.
@@ -179,10 +185,45 @@ def load_library() -> ctypes.CDLL:
     lib.hvd_set_exec_callback.argtypes = [EXEC_CB_TYPE]
     lib.hvd_set_alloc_callback.restype = None
     lib.hvd_set_alloc_callback.argtypes = [ALLOC_CB_TYPE]
-    lib.hvd_start_timeline.restype = None
+    # Returns 0 on success, -1 when the timeline file cannot be opened
+    # (surfaced as a Python exception in runtime.start_timeline). A
+    # second call on a running timeline restarts it onto the new path.
+    lib.hvd_start_timeline.restype = ctypes.c_int
     lib.hvd_start_timeline.argtypes = [ctypes.c_char_p]
     lib.hvd_stop_timeline.restype = None
     lib.hvd_pending_count.restype = ctypes.c_int64
+    # Metrics registry (docs/observability.md): versioned packed
+    # snapshot + name/kind tables, consumed by horovod_tpu/metrics.py.
+    lib.hvd_metrics_snapshot.restype = ctypes.c_int64
+    lib.hvd_metrics_snapshot.argtypes = [ctypes.POINTER(ctypes.c_int64),
+                                         ctypes.c_int64]
+    for fn in ("hvd_metrics_version", "hvd_metrics_num_counters",
+               "hvd_metrics_num_hists", "hvd_metrics_hist_buckets",
+               "hvd_metrics_enabled"):
+        getattr(lib, fn).restype = ctypes.c_int
+    lib.hvd_metrics_counter_name.restype = ctypes.c_char_p
+    lib.hvd_metrics_counter_name.argtypes = [ctypes.c_int]
+    lib.hvd_metrics_counter_kind.restype = ctypes.c_int
+    lib.hvd_metrics_counter_kind.argtypes = [ctypes.c_int]
+    lib.hvd_metrics_hist_name.restype = ctypes.c_char_p
+    lib.hvd_metrics_hist_name.argtypes = [ctypes.c_int]
+    lib.hvd_metrics_reset.restype = None
+    lib.hvd_metrics_set_enabled.restype = None
+    lib.hvd_metrics_set_enabled.argtypes = [ctypes.c_int]
+    lib.hvd_metrics_test_add.restype = None
+    lib.hvd_metrics_test_add.argtypes = [ctypes.c_int, ctypes.c_int64]
+    lib.hvd_metrics_test_observe.restype = None
+    lib.hvd_metrics_test_observe.argtypes = [ctypes.c_int, ctypes.c_int64]
+    # Stall findings beyond the log (hvd.stalled_tensors()): returns the
+    # byte count needed including the NUL, copies at most len-1 bytes.
+    lib.hvd_stalled_tensors.restype = ctypes.c_int
+    lib.hvd_stalled_tensors.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    got_metrics = lib.hvd_metrics_version()
+    if got_metrics != METRICS_VERSION:
+        raise OSError(
+            f"horovod_tpu native core at {path} has metrics snapshot "
+            f"version {got_metrics}, expected {METRICS_VERSION}; rebuild "
+            "it (make -C native)")
     # Host reduction kernels + thread budget (perf_tuning.md): exercised
     # directly by the dtype-coverage tests and exposed through
     # hvd.set_reduce_threads / hvd.reduce_threads.

@@ -700,8 +700,13 @@ class Runtime:
         return Handle(h, self)
 
     def start_timeline(self, path: str) -> None:
+        """Start — or RESTART onto a new path — the host timeline.
+        Raises when the file cannot be opened (the native call used to
+        silently no-op on both failure and restart)."""
         self._check_init()
-        self.lib.hvd_start_timeline(path.encode())
+        if self.lib.hvd_start_timeline(path.encode()) != 0:
+            raise HorovodInternalError(
+                f"could not open timeline file {path!r}")
 
     def stop_timeline(self) -> None:
         self._check_init()

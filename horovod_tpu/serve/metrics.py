@@ -46,6 +46,12 @@ class ServeMetrics:
         self._allocator = None
         self._alloc_base = (0, 0, 0)
         self.reset()
+        # Export through the process-wide telemetry endpoint: a scrape
+        # of hvd.metrics_prometheus() (or the rank-0 metrics server)
+        # covers training AND serving in one text format. Weakly bound
+        # so an abandoned engine's metrics vanish with it.
+        from horovod_tpu.metrics import register_exporter_weak
+        register_exporter_weak(f"serve_{id(self)}", self, "prometheus")
 
     def reset(self) -> None:
         self.started_at = self._clock()
@@ -220,6 +226,15 @@ class ServeMetrics:
                 "prefix_block_evictions": a.evictions - evict0,
             })
         return out
+
+    def prometheus(self) -> str:
+        """This snapshot as Prometheus text, rendered through the SAME
+        exposition helper as the native registry
+        (``horovod_tpu.metrics.render_gauges``) under the ``serve_``
+        prefix — serving and training export one format, one endpoint
+        (docs/observability.md)."""
+        from horovod_tpu.metrics import render_gauges
+        return render_gauges("serve", self.snapshot())
 
     def export_chrome_trace(self, path: str) -> None:
         """Write recorded step spans as a chrome-tracing file (the

@@ -16,6 +16,8 @@ from horovod_tpu.api import (  # noqa: F401
     broadcast_async, alltoall, alltoall_async, reducescatter,
     reducescatter_async, join, barrier, synchronize, poll,
     mpi_threads_supported, start_timeline, stop_timeline,
+    metrics, metrics_prometheus, metrics_aggregate, metrics_reset,
+    stalled_tensors, start_metrics_server,
 )
 from horovod_tpu.common.exceptions import HorovodInternalError  # noqa: F401
 from horovod_tpu.common.ops_enum import (  # noqa: F401

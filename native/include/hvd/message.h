@@ -23,9 +23,12 @@ namespace hvd {
 // bump can't silently skew the shim).
 // v5: Request/Response carry wire_codec; ResponseList carries
 // tuned_wire_codec; hvd_enqueue gained the wire_codec argument.
+// ABI v6 (wire formats unchanged): metrics snapshot/name-table entry
+// points (hvd/metrics.h; snapshot layout versioned by kMetricsVersion),
+// hvd_stalled_tensors, and hvd_start_timeline returning an error code.
 constexpr int kWireVersionRequestList = 2;
 constexpr int kWireVersionResponseList = 5;
-constexpr int kAbiVersion = 5;
+constexpr int kAbiVersion = 6;
 
 enum class RequestType : uint8_t {
   ALLREDUCE = 0,

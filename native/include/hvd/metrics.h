@@ -1,0 +1,195 @@
+// Runtime metrics registry: continuously queryable per-rank counters.
+//
+// The chrome timeline answers "what happened" after the fact; at pod
+// scale the MLPerf TPU work (arXiv:1909.09756) shows operators also
+// need "what is happening NOW" — straggler spread, fusion efficiency,
+// codec savings — as cheap, always-on counters. This registry is the
+// native half of that story (Python exposition lives in
+// horovod_tpu/metrics.py; the serving engine exports through the same
+// helper so training and serving speak one format).
+//
+// Design constraints:
+//  * Lock-free hot path: every metric is a relaxed std::atomic<int64_t>
+//    add — no mutex, no allocation, nanoseconds per observation. A
+//    process-wide enable flag (hvd_metrics_set_enabled) short-circuits
+//    even that for the overhead-guard comparison.
+//  * Fixed identity: counters and histograms are enum-indexed with a
+//    compile-time name table, so the snapshot is a versioned packed
+//    int64 layout the Python shim pins (tests/test_metrics_abi.py,
+//    same discipline as the wire constants in message.h).
+//  * Histograms are fixed log2 buckets: bucket i counts values
+//    v <= 2^i (last bucket = +Inf), which is exactly the Prometheus
+//    cumulative-le shape after a prefix sum and gives p50/p99 within
+//    2x at any scale with zero per-observation branching beyond a clz.
+#pragma once
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+
+namespace hvd {
+
+// Snapshot layout version (bump on any enum/table/layout change) and
+// bucket count. Pinned by horovod_tpu/common/basics.py +
+// tests/test_metrics_abi.py.
+constexpr int kMetricsVersion = 1;
+constexpr int kMetricsHistBuckets = 28;  // le = 2^0 .. 2^26, then +Inf
+
+// Monotonic counters (suffix _total) and point-in-time gauges (filled
+// at snapshot time by hvd_metrics_snapshot; kind table in metrics.cc).
+enum MetricCounter : int {
+  // Coordinator / negotiation.
+  kCtrCycles = 0,             // background coordination cycles run
+  kCtrResponsesAllreduce,     // responses executed, by op type
+  kCtrResponsesAllgather,
+  kCtrResponsesBroadcast,
+  kCtrResponsesAlltoall,
+  kCtrResponsesReducescatter,
+  kCtrTensorsTotal,           // tensors completed (fused count each)
+  kCtrBytesAllreduce,         // payload bytes, by op type
+  kCtrBytesAllgather,
+  kCtrBytesBroadcast,
+  kCtrBytesAlltoall,
+  kCtrBytesReducescatter,
+  kCtrErrorResponses,
+  // Fusion.
+  kCtrFusedBatches,           // responses carrying > 1 tensor
+  kCtrFusedTensors,           // tensors that rode a fused response
+  kCtrFusionBufferGrows,      // fusion staging buffer reallocations
+  // Response cache (coordinator announce path; multi-process only).
+  kCtrCacheHits,
+  kCtrCacheMisses,
+  // Data planes.
+  kCtrShmOps,                 // fused responses executed via the arena
+  kCtrShmBytes,
+  kCtrTcpOps,                 // responses executed via the TCP mesh
+  kCtrTcpBytes,               // payload bytes through the TCP plane
+  kCtrTcpSendBytes,           // socket bytes out, ALL TcpConn links
+  kCtrTcpRecvBytes,           // socket bytes in (control + data; with a
+                              // wire codec the data share is encoded)
+  // Wire codec (codec.cc encode sites).
+  kCtrWireEncodes,
+  kCtrWirePreBytes,           // f32 payload bytes presented to encode
+  kCtrWirePostBytes,          // encoded bytes that hit the wire
+  // Worker pool.
+  kCtrPoolJobs,               // ParallelFor dispatches (parts > 1)
+  // Stall inspector.
+  kCtrStallEvents,            // warning-threshold stall detections
+  // ---- gauges (point-in-time, filled by hvd_metrics_snapshot) ----
+  kGaugePendingTensors,       // tensors currently in flight
+  kGaugeStalledTensors,       // tensors past the stall warning age
+  kGaugeReduceThreads,        // current host-reduction thread budget
+  kNumMetricCounters
+};
+
+enum MetricHistogram : int {
+  kHistCycleUs = 0,           // coordination cycle wall time
+  kHistNegotiateUs,           // first announce -> response fired
+  kHistQueueDepth,            // in-flight tensors, sampled per cycle
+  kHistFusionFillPct,         // fused allreduce bytes / threshold * 100
+  kHistFusedTensorsPerResponse,
+  kHistShmPackUs,             // segment pipeline phases
+  kHistShmReduceUs,
+  kHistShmUnpackUs,
+  kHistShmBarrierUs,          // arena barrier wait (straggler signal)
+  kHistTcpRingRsUs,           // ring reduce-scatter phase
+  kHistTcpRingAgUs,           // ring allgather phase
+  kHistTcpDoublingUs,         // recursive-doubling exchange
+  kHistPoolParts,             // parts per ParallelFor dispatch
+  kNumMetricHistograms
+};
+
+// Name/kind tables (metrics.cc). kind: 0 = counter, 1 = gauge.
+const char* MetricCounterName(int i);
+int MetricCounterKind(int i);
+const char* MetricHistogramName(int i);
+
+class MetricsRegistry {
+ public:
+  static MetricsRegistry& Get();
+
+  void SetEnabled(bool on) { enabled_.store(on, std::memory_order_relaxed); }
+  bool enabled() const { return enabled_.load(std::memory_order_relaxed); }
+
+  void Add(MetricCounter c, int64_t v) {
+    if (!enabled()) return;
+    counters_[c].fetch_add(v, std::memory_order_relaxed);
+  }
+  // Gauges: plain store (snapshot-time fill).
+  void Set(MetricCounter c, int64_t v) {
+    counters_[c].store(v, std::memory_order_relaxed);
+  }
+  void Observe(MetricHistogram h, int64_t v) {
+    if (!enabled()) return;
+    Hist& hh = hists_[h];
+    hh.count.fetch_add(1, std::memory_order_relaxed);
+    hh.sum.fetch_add(v < 0 ? 0 : v, std::memory_order_relaxed);
+    hh.buckets[Bucket(v)].fetch_add(1, std::memory_order_relaxed);
+  }
+
+  void Reset();
+
+  // Packed snapshot, int64 slots:
+  //   [version, n_counters, n_hists, n_buckets,
+  //    counters[n_counters],
+  //    per hist: count, sum, buckets[n_buckets]]
+  // Returns the slot count needed; writes min(needed, max_slots).
+  int64_t Snapshot(int64_t* out, int64_t max_slots) const;
+  static constexpr int64_t SnapshotSlots() {
+    return 4 + kNumMetricCounters +
+           static_cast<int64_t>(kNumMetricHistograms) *
+               (2 + kMetricsHistBuckets);
+  }
+
+  // Bucket index for value v: smallest i with v <= 2^i, clamped to the
+  // +Inf bucket. v <= 1 lands in bucket 0.
+  static int Bucket(int64_t v) {
+    if (v <= 1) return 0;
+    int b = 64 - __builtin_clzll(static_cast<uint64_t>(v - 1));
+    return b >= kMetricsHistBuckets ? kMetricsHistBuckets - 1 : b;
+  }
+
+ private:
+  struct Hist {
+    std::atomic<int64_t> count{0};
+    std::atomic<int64_t> sum{0};
+    std::atomic<int64_t> buckets[kMetricsHistBuckets] = {};
+  };
+  std::atomic<bool> enabled_{true};
+  std::atomic<int64_t> counters_[kNumMetricCounters] = {};
+  Hist hists_[kNumMetricHistograms];
+};
+
+// Hot-path shorthands.
+inline void MetricAdd(MetricCounter c, int64_t v = 1) {
+  MetricsRegistry::Get().Add(c, v);
+}
+inline void MetricObserve(MetricHistogram h, int64_t v) {
+  MetricsRegistry::Get().Observe(h, v);
+}
+
+// Scoped microsecond timer: records into `h` at destruction. Skips the
+// clock reads entirely when the registry is disabled, so the overhead
+// guard's "metrics off" arm measures the true baseline.
+class MetricTimer {
+ public:
+  explicit MetricTimer(MetricHistogram h)
+      : h_(h), armed_(MetricsRegistry::Get().enabled()) {
+    if (armed_) start_ = std::chrono::steady_clock::now();
+  }
+  ~MetricTimer() {
+    if (!armed_) return;
+    MetricObserve(h_, std::chrono::duration_cast<std::chrono::microseconds>(
+                          std::chrono::steady_clock::now() - start_)
+                          .count());
+  }
+  MetricTimer(const MetricTimer&) = delete;
+  MetricTimer& operator=(const MetricTimer&) = delete;
+
+ private:
+  MetricHistogram h_;
+  bool armed_;
+  std::chrono::steady_clock::time_point start_;
+};
+
+}  // namespace hvd

@@ -10,10 +10,17 @@
 // (controller.cc CoordinatorCycle), so a tensor stalled in the cached
 // steady state is tracked and reported through the exact same
 // RecordUncachedTensor bookkeeping as a first-time tensor.
+//
+// Beyond the log line, findings are queryable: hvd_stalled_tensors
+// (operations.cc) renders Report() into the Python-side
+// hvd.stalled_tensors() accessor and the metrics snapshot's
+// stalled_tensors gauge — which is why the table is mutex-guarded
+// (the coordinator cycle writes it, Python threads read it).
 #pragma once
 
 #include <chrono>
 #include <cstdint>
+#include <mutex>
 #include <string>
 #include <unordered_map>
 #include <vector>
@@ -28,21 +35,33 @@ class StallInspector {
 
   // Coordinator side: a rank announced readiness for a tensor.
   void RecordUncachedTensor(const std::string& name, int rank);
-  void RemoveUncachedTensor(const std::string& name);
+  // Removes the tensor (it fired) and returns its negotiation age in
+  // seconds (first announce -> ready), or -1 if it was not tracked.
+  double RemoveUncachedTensor(const std::string& name);
 
   // Returns true if the stall has exceeded the shutdown threshold.
   // Logs a warning listing stalled tensors + missing ranks.
   bool CheckForStalledTensors(int global_size);
 
- private:
-  struct Info {
-    std::chrono::steady_clock::time_point first_seen;
-    std::vector<int> ranks;
+  // One finding per tensor past the warning age (coordinator only —
+  // workers have no pending table).
+  struct Stalled {
+    std::string name;
+    double age_secs = 0.0;
+    std::vector<int> missing_ranks;
   };
+  std::vector<Stalled> Report(int global_size) const;
+
+ private:
   double warning_secs_ = 60.0;
   double shutdown_secs_ = 0.0;  // 0 = never shut down
   std::chrono::steady_clock::time_point last_check_ =
       std::chrono::steady_clock::now();
+  mutable std::mutex mu_;
+  struct Info {
+    std::chrono::steady_clock::time_point first_seen;
+    std::vector<int> ranks;
+  };
   std::unordered_map<std::string, Info> pending_;
 };
 

@@ -24,7 +24,12 @@ class Timeline {
  public:
   ~Timeline();
 
-  void Initialize(const std::string& path, int rank);
+  // Opens `path` and starts the writer. Re-initializing an ALREADY
+  // running timeline restarts it on the new path (the old file is
+  // closed first) instead of silently no-opping. Returns false when
+  // the file cannot be opened — surfaced through hvd_start_timeline
+  // as a Python exception.
+  bool Initialize(const std::string& path, int rank);
   void Shutdown();
   bool Initialized() const { return initialized_.load(); }
 
@@ -37,6 +42,11 @@ class Timeline {
   void ActivityEnd(const std::string& name);
   void End(const std::string& name, int64_t bytes);
   void MarkCycleStart();
+  // Counter track ('C' phase): chrome://tracing renders these as a
+  // stacked area chart under the spans — queue depth, fusion bytes,
+  // busbw, fed from the metrics registry each cycle (operations.cc)
+  // so traces and hvd.metrics() cannot disagree.
+  void Counter(const std::string& name, double value);
 
  private:
   struct Event {

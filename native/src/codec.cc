@@ -5,6 +5,7 @@
 #include <cstring>
 
 #include "hvd/half.h"
+#include "hvd/metrics.h"
 #include "hvd/thread_pool.h"
 
 #if defined(__x86_64__) && defined(__GNUC__)
@@ -239,9 +240,25 @@ int64_t WireEncodedBytes(WireCodec codec, int64_t elems) {
   return elems * 4;
 }
 
+namespace {
+
+// Pre/post wire byte accounting for every encode site (plain and
+// relay-fused): wire_bytes_saved_pct in bench.py derives straight from
+// these two counters, so the reported savings are the bytes that
+// actually skipped the wire, not a ratio recomputed from assumptions.
+inline void RecordEncodeMetrics(WireCodec codec, int64_t elems) {
+  if (codec == WireCodec::NONE) return;
+  MetricAdd(kCtrWireEncodes);
+  MetricAdd(kCtrWirePreBytes, elems * 4);
+  MetricAdd(kCtrWirePostBytes, WireEncodedBytes(codec, elems));
+}
+
+}  // namespace
+
 void WireEncode(WireCodec codec, const float* src, int64_t elems,
                 uint8_t* dst, float* residual) {
   if (elems <= 0) return;
+  RecordEncodeMetrics(codec, elems);
   switch (codec) {
     case WireCodec::NONE:
       std::memcpy(dst, src, elems * 4);
@@ -408,6 +425,7 @@ void WireDecodeAddEncode(WireCodec codec, const uint8_t* enc_in,
                          const float* add, int64_t elems, uint8_t* enc_out,
                          float* residual) {
   if (elems <= 0) return;
+  RecordEncodeMetrics(codec, elems);
   switch (codec) {
     case WireCodec::NONE: {
       const float* in = reinterpret_cast<const float*>(enc_in);

@@ -9,6 +9,7 @@
 #include <cstdlib>
 
 #include "hvd/logging.h"
+#include "hvd/metrics.h"
 
 namespace hvd {
 
@@ -336,8 +337,14 @@ ResponseList Controller::CoordinatorStep(
     }
     b.op_class = OpClass(it->second.requests.front().reduce_op);
     built.push_back(std::move(b));
-    if (deps_.stall_inspector)
-      deps_.stall_inspector->RemoveUncachedTensor(name);
+    if (deps_.stall_inspector) {
+      // Negotiation latency: first announce -> response fired. The
+      // stall inspector already holds first_seen, so readiness removal
+      // doubles as the latency probe (no second timestamp table).
+      double age = deps_.stall_inspector->RemoveUncachedTensor(name);
+      if (age >= 0)
+        MetricObserve(kHistNegotiateUs, static_cast<int64_t>(age * 1e6));
+    }
     table->erase(it);
   }
 
@@ -741,12 +748,16 @@ RequestList TcpController::BuildRequestList(bool shutdown, bool* saw_join) {
     if (deps_.response_cache && cache_active_) {
       auto state = deps_.response_cache->Lookup(req, &bit);
       if (state == ResponseCache::CacheState::HIT) {
+        MetricAdd(kCtrCacheHits);
         list.cache_hits.push_back(bit);
         if (deps_.timeline)
           deps_.timeline->NegotiateStart(req.tensor_name,
                                          RequestTypeName(req.request_type));
         continue;
       }
+      // Only a real lookup counts as a miss: with the cache absent or
+      // autotuned off, hits/(hits+misses) must read N/A, not 0%.
+      MetricAdd(kCtrCacheMisses);
     }
     list.requests.push_back(req);
   }

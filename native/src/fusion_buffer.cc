@@ -2,12 +2,21 @@
 
 #include <algorithm>
 
+#include "hvd/metrics.h"
+
 namespace hvd {
 
 void* FusionBufferManager::GetBuffer(int key, int64_t min_bytes) {
   auto& buf = buffers_[key];
   int64_t want = std::max<int64_t>(min_bytes, size_);
-  if (static_cast<int64_t>(buf.size()) < want) buf.resize(want);
+  if (static_cast<int64_t>(buf.size()) < want) {
+    // Steady state never grows (the threshold sizes the buffer once);
+    // a climbing counter here means responses keep outgrowing the
+    // configured fusion threshold — reallocation churn the operator
+    // can tune away.
+    MetricAdd(kCtrFusionBufferGrows);
+    buf.resize(want);
+  }
   return buf.data();
 }
 

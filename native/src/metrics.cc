@@ -1,0 +1,130 @@
+#include "hvd/metrics.h"
+
+namespace hvd {
+
+namespace {
+
+// Names follow Prometheus conventions: counters end in _total, gauges
+// and histograms are bare (units in the name). Order MUST match the
+// enums in metrics.h — the static_asserts below pin the lengths, and
+// tests/test_metrics_abi.py pins uniqueness + the snapshot layout.
+constexpr const char* kCounterNames[] = {
+    "cycles_total",
+    "responses_allreduce_total",
+    "responses_allgather_total",
+    "responses_broadcast_total",
+    "responses_alltoall_total",
+    "responses_reducescatter_total",
+    "tensors_total",
+    "bytes_allreduce_total",
+    "bytes_allgather_total",
+    "bytes_broadcast_total",
+    "bytes_alltoall_total",
+    "bytes_reducescatter_total",
+    "error_responses_total",
+    "fused_batches_total",
+    "fused_tensors_total",
+    "fusion_buffer_grows_total",
+    "cache_hits_total",
+    "cache_misses_total",
+    "shm_ops_total",
+    "shm_bytes_total",
+    "tcp_ops_total",
+    "tcp_bytes_total",
+    "tcp_send_bytes_total",
+    "tcp_recv_bytes_total",
+    "wire_encodes_total",
+    "wire_pre_bytes_total",
+    "wire_post_bytes_total",
+    "pool_jobs_total",
+    "stall_events_total",
+    "pending_tensors",
+    "stalled_tensors",
+    "reduce_threads",
+};
+
+constexpr int kCounterKinds[] = {
+    0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+    0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+    1, 1, 1,  // pending_tensors, stalled_tensors, reduce_threads
+};
+
+constexpr const char* kHistNames[] = {
+    "cycle_us",
+    "negotiate_us",
+    "queue_depth",
+    "fusion_fill_pct",
+    "fused_tensors_per_response",
+    "shm_pack_us",
+    "shm_reduce_us",
+    "shm_unpack_us",
+    "shm_barrier_us",
+    "tcp_ring_rs_us",
+    "tcp_ring_ag_us",
+    "tcp_doubling_us",
+    "pool_parts",
+};
+
+static_assert(sizeof(kCounterNames) / sizeof(kCounterNames[0]) ==
+                  kNumMetricCounters,
+              "counter name table out of sync with MetricCounter");
+static_assert(sizeof(kCounterKinds) / sizeof(kCounterKinds[0]) ==
+                  kNumMetricCounters,
+              "counter kind table out of sync with MetricCounter");
+static_assert(sizeof(kHistNames) / sizeof(kHistNames[0]) ==
+                  kNumMetricHistograms,
+              "histogram name table out of sync with MetricHistogram");
+
+}  // namespace
+
+const char* MetricCounterName(int i) {
+  return i >= 0 && i < kNumMetricCounters ? kCounterNames[i] : "";
+}
+
+int MetricCounterKind(int i) {
+  return i >= 0 && i < kNumMetricCounters ? kCounterKinds[i] : 0;
+}
+
+const char* MetricHistogramName(int i) {
+  return i >= 0 && i < kNumMetricHistograms ? kHistNames[i] : "";
+}
+
+MetricsRegistry& MetricsRegistry::Get() {
+  // Leaked singleton, same lifetime discipline as the WorkerPool:
+  // instrumented code (worker threads, the background cycle) may
+  // observe during static teardown.
+  static MetricsRegistry* reg = new MetricsRegistry();
+  return *reg;
+}
+
+void MetricsRegistry::Reset() {
+  for (auto& c : counters_) c.store(0, std::memory_order_relaxed);
+  for (auto& h : hists_) {
+    h.count.store(0, std::memory_order_relaxed);
+    h.sum.store(0, std::memory_order_relaxed);
+    for (auto& b : h.buckets) b.store(0, std::memory_order_relaxed);
+  }
+}
+
+int64_t MetricsRegistry::Snapshot(int64_t* out, int64_t max_slots) const {
+  const int64_t needed = SnapshotSlots();
+  if (out == nullptr || max_slots <= 0) return needed;
+  int64_t i = 0;
+  auto put = [&](int64_t v) {
+    if (i < max_slots) out[i] = v;
+    ++i;
+  };
+  put(kMetricsVersion);
+  put(kNumMetricCounters);
+  put(kNumMetricHistograms);
+  put(kMetricsHistBuckets);
+  for (const auto& c : counters_) put(c.load(std::memory_order_relaxed));
+  for (const auto& h : hists_) {
+    put(h.count.load(std::memory_order_relaxed));
+    put(h.sum.load(std::memory_order_relaxed));
+    for (const auto& b : h.buckets) put(b.load(std::memory_order_relaxed));
+  }
+  return needed;
+}
+
+}  // namespace hvd

@@ -31,6 +31,7 @@
 #include "hvd/fusion_buffer.h"
 #include "hvd/logging.h"
 #include "hvd/message.h"
+#include "hvd/metrics.h"
 #include "hvd/ops.h"
 #include "hvd/bayesian.h"
 #include "hvd/parameter_manager.h"
@@ -218,11 +219,59 @@ Status AllocateOutputs(GlobalState& st, const Response& resp,
   return Status::OK();
 }
 
+// Per-op-type counters for one response THIS rank executes (joined
+// ranks that skip execution don't count it): response count, payload
+// bytes, tensor count, and the fusion shape (batched-tensor count +
+// fill ratio against the live fusion threshold).
+void RecordResponseMetrics(GlobalState& st, const Response& response) {
+  MetricCounter ops, bytes;
+  switch (response.response_type) {
+    case ResponseType::ALLREDUCE:
+      ops = kCtrResponsesAllreduce;
+      bytes = kCtrBytesAllreduce;
+      break;
+    case ResponseType::ALLGATHER:
+      ops = kCtrResponsesAllgather;
+      bytes = kCtrBytesAllgather;
+      break;
+    case ResponseType::BROADCAST:
+      ops = kCtrResponsesBroadcast;
+      bytes = kCtrBytesBroadcast;
+      break;
+    case ResponseType::ALLTOALL:
+      ops = kCtrResponsesAlltoall;
+      bytes = kCtrBytesAlltoall;
+      break;
+    case ResponseType::REDUCESCATTER:
+      ops = kCtrResponsesReducescatter;
+      bytes = kCtrBytesReducescatter;
+      break;
+    default:
+      return;  // JOIN/BARRIER/ERROR carry no payload metrics
+  }
+  if (!MetricsRegistry::Get().enabled()) return;
+  const int64_t b = response.TotalByteSize();
+  const int64_t n = static_cast<int64_t>(response.tensor_names.size());
+  MetricAdd(ops);
+  MetricAdd(bytes, b);
+  MetricAdd(kCtrTensorsTotal, n);
+  if (n > 1) {
+    MetricAdd(kCtrFusedBatches);
+    MetricAdd(kCtrFusedTensors, n);
+    MetricObserve(kHistFusedTensorsPerResponse, n);
+  }
+  if (response.response_type == ResponseType::ALLREDUCE && st.controller) {
+    const int64_t thr = st.controller->fusion_threshold();
+    if (thr > 0) MetricObserve(kHistFusionFillPct, 100 * b / thr);
+  }
+}
+
 void PerformOperation(GlobalState& st, const Response& response) {
   std::vector<TensorTableEntry> entries;
   st.tensor_queue.GetTensorEntriesFromResponse(response, &entries);
 
   if (response.response_type == ResponseType::ERROR) {
+    MetricAdd(kCtrErrorResponses);
     Status err = Status::PreconditionError(response.error_message);
     for (auto& e : entries) CompleteEntry(st, e, err);
     return;
@@ -244,6 +293,7 @@ void PerformOperation(GlobalState& st, const Response& response) {
     // fall through to the CALLBACK launch below with empty entries
   }
 
+  RecordResponseMetrics(st, response);
   const std::string tname =
       entries.empty() ? response.tensor_names.front() : entries.front().name;
   st.timeline.Start(tname, ResponseTypeName(response.response_type));
@@ -385,6 +435,34 @@ void BackgroundThreadLoop(GlobalState& st) {
       }
     }
     auto elapsed = std::chrono::steady_clock::now() - cycle_start;
+    // Coordinator-cycle telemetry: wall time of negotiate + execute
+    // (the sleep to the cycle budget is idle time, not cycle cost) and
+    // the in-flight depth this cycle left behind.
+    if (MetricsRegistry::Get().enabled()) {
+      MetricAdd(kCtrCycles);
+      const int64_t cyc_us =
+          std::chrono::duration_cast<std::chrono::microseconds>(elapsed)
+              .count();
+      MetricObserve(kHistCycleUs, cyc_us);
+      MetricObserve(kHistQueueDepth,
+                    static_cast<int64_t>(st.tensor_queue.size()));
+      if (st.timeline.Initialized()) {
+        // Counter tracks next to the spans, fed from the same numbers
+        // the registry reports — traces and hvd.metrics() can't
+        // disagree. busbw uses the NCCL convention 2(P-1)/P.
+        int64_t cyc_bytes = 0;
+        for (const auto& r : list.responses) cyc_bytes += r.TotalByteSize();
+        st.timeline.Counter("queue_depth",
+                            static_cast<double>(st.tensor_queue.size()));
+        st.timeline.Counter("fusion_bytes", static_cast<double>(cyc_bytes));
+        const double secs = std::chrono::duration<double>(elapsed).count();
+        const double busbw =
+            (secs > 0 && st.size > 0)
+                ? cyc_bytes * 2.0 * (st.size - 1) / st.size / secs / 1e9
+                : 0.0;
+        st.timeline.Counter("busbw_gbps", busbw);
+      }
+    }
     auto budget = std::chrono::duration<double, std::milli>(st.cycle_time_ms);
     if (elapsed < budget)
       std::this_thread::sleep_for(budget - elapsed);
@@ -603,6 +681,9 @@ void hvd_shutdown() {
 
 // Bump whenever the callback signatures or the wire format change; the
 // Python bridge refuses to load a library whose version disagrees.
+// v6: metrics registry surface (hvd_metrics_snapshot + name tables,
+// layout versioned separately by kMetricsVersion), hvd_stalled_tensors,
+// and hvd_start_timeline now returns an error code (restart-capable).
 // v5: hvd_enqueue gained wire_codec; wire codec kernel entry points;
 // Request/Response/ResponseList carry wire-compression fields. The
 // authoritative constant lives in message.h next to the wire versions
@@ -758,9 +839,13 @@ void hvd_exec_done(int64_t exec_id, int status_code, const char* err) {
   for (auto& e : pe.entries) hvd::CompleteEntry(st, e, s);
 }
 
-void hvd_start_timeline(const char* path) {
+// Starts — or RESTARTS onto a new path — the host timeline. Returns 0
+// on success, -1 when the file cannot be opened (surfaced as a Python
+// exception; the silent void no-op this used to be left
+// start_timeline(new_path) on a running timeline doing nothing).
+int hvd_start_timeline(const char* path) {
   auto& st = hvd::State();
-  st.timeline.Initialize(path, st.rank);
+  return st.timeline.Initialize(path, st.rank) ? 0 : -1;
 }
 
 void hvd_stop_timeline() { hvd::State().timeline.Shutdown(); }
@@ -768,6 +853,95 @@ void hvd_stop_timeline() { hvd::State().timeline.Shutdown(); }
 // Test hook: number of tensors currently in flight.
 int64_t hvd_pending_count() {
   return static_cast<int64_t>(hvd::State().tensor_queue.size());
+}
+
+// ---------------------------------------------------------------------------
+// Metrics (hvd/metrics.h): versioned packed snapshot + name tables,
+// consumed by horovod_tpu/metrics.py. Layout pinned by
+// tests/test_metrics_abi.py (same discipline as the wire constants).
+// ---------------------------------------------------------------------------
+
+int64_t hvd_metrics_snapshot(int64_t* out, int64_t max_slots) {
+  auto& st = hvd::State();
+  auto& reg = hvd::MetricsRegistry::Get();
+  // Point-in-time gauges are filled fresh per snapshot; everything
+  // else in the registry is already live.
+  reg.Set(hvd::kGaugePendingTensors,
+          static_cast<int64_t>(st.tensor_queue.size()));
+  reg.Set(hvd::kGaugeStalledTensors,
+          static_cast<int64_t>(st.stall_inspector.Report(st.size).size()));
+  reg.Set(hvd::kGaugeReduceThreads, hvd::HostReduceThreads());
+  return reg.Snapshot(out, max_slots);
+}
+
+int hvd_metrics_version() { return hvd::kMetricsVersion; }
+int hvd_metrics_num_counters() { return hvd::kNumMetricCounters; }
+int hvd_metrics_num_hists() { return hvd::kNumMetricHistograms; }
+int hvd_metrics_hist_buckets() { return hvd::kMetricsHistBuckets; }
+const char* hvd_metrics_counter_name(int i) {
+  return hvd::MetricCounterName(i);
+}
+int hvd_metrics_counter_kind(int i) { return hvd::MetricCounterKind(i); }
+const char* hvd_metrics_hist_name(int i) {
+  return hvd::MetricHistogramName(i);
+}
+void hvd_metrics_reset() { hvd::MetricsRegistry::Get().Reset(); }
+// Runtime enable switch: lets the overhead guard time the identical
+// workload with observations on vs off (off short-circuits even the
+// timer clock reads).
+void hvd_metrics_set_enabled(int on) {
+  hvd::MetricsRegistry::Get().SetEnabled(on != 0);
+}
+int hvd_metrics_enabled() {
+  return hvd::MetricsRegistry::Get().enabled() ? 1 : 0;
+}
+// Test hooks: drive the registry directly so bucketing and
+// concurrent-increment behavior are unit-testable through ctypes.
+void hvd_metrics_test_add(int counter, int64_t v) {
+  if (counter >= 0 && counter < hvd::kNumMetricCounters)
+    hvd::MetricAdd(static_cast<hvd::MetricCounter>(counter), v);
+}
+void hvd_metrics_test_observe(int hist, int64_t v) {
+  if (hist >= 0 && hist < hvd::kNumMetricHistograms)
+    hvd::MetricObserve(static_cast<hvd::MetricHistogram>(hist), v);
+}
+
+// StallInspector findings beyond the log: tab-separated lines
+// "name\tage_secs\tmissing_rank,missing_rank,...\n" for every tensor
+// past the warning age. Tensor names are arbitrary user strings, so
+// backslash/tab/newline in the name are backslash-escaped — the Python
+// parser (horovod_tpu/metrics.py stalled_tensors) unescapes; a name
+// containing a separator must not break the very accessor used to
+// diagnose its stall. Coordinator-rank data (workers have no pending
+// table). Returns the byte count needed INCLUDING the NUL; copies at
+// most len-1 bytes.
+int hvd_stalled_tensors(char* buf, int len) {
+  auto& st = hvd::State();
+  auto report = st.stall_inspector.Report(st.size);
+  std::string out;
+  for (const auto& s : report) {
+    for (char c : s.name) {
+      switch (c) {
+        case '\\': out += "\\\\"; break;
+        case '\t': out += "\\t"; break;
+        case '\n': out += "\\n"; break;
+        default: out += c;
+      }
+    }
+    out += '\t';
+    out += std::to_string(s.age_secs);
+    out += '\t';
+    for (size_t i = 0; i < s.missing_ranks.size(); ++i) {
+      if (i) out += ',';
+      out += std::to_string(s.missing_ranks[i]);
+    }
+    out += '\n';
+  }
+  if (buf != nullptr && len > 0) {
+    std::strncpy(buf, out.c_str(), len - 1);
+    buf[len - 1] = '\0';
+  }
+  return static_cast<int>(out.size()) + 1;
 }
 
 // Direct host-kernel entry points: the dtype/op matrix is verified

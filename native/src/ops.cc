@@ -11,6 +11,7 @@
 #include "hvd/env.h"
 #include "hvd/half.h"
 #include "hvd/logging.h"
+#include "hvd/metrics.h"
 #include "hvd/thread_pool.h"
 
 namespace hvd {
@@ -498,8 +499,13 @@ Status TcpOps::Allreduce(const Response& r,
       ShmEligible(std::min(total_bytes, controller_->shm_segment_bytes()),
                   &shm_err);
   if (!shm_err.ok()) return shm_err;
-  if (use_shm)
+  if (use_shm) {
+    MetricAdd(kCtrShmOps);
+    MetricAdd(kCtrShmBytes, total_bytes);
     return ShmAllreduceFused(r, entries, total_elems, dtype, size);
+  }
+  MetricAdd(kCtrTcpOps);
+  MetricAdd(kCtrTcpBytes, total_bytes);
 
   // Single-tensor responses run the exchange IN PLACE on the output
   // buffer: the fusion-buffer staging exists to concatenate many
@@ -695,6 +701,7 @@ Status TcpOps::ShmAllreduceFused(const Response& r,
   // the inner kernels are the SERIAL variants — a nested ParallelFor
   // from inside a worker would deadlock on the pool's caller lock.
   auto pack = [&](int64_t k) {
+    MetricTimer mt(kHistShmPackUs);
     if (timeline_) timeline_->ActivityStart(tname, ACT_SHM_PACK);
     uint8_t* dst = region(my_slot, k);
     const int64_t base_e = k * seg_elems, n = seg_n(k);
@@ -723,6 +730,7 @@ Status TcpOps::ShmAllreduceFused(const Response& r,
   // contention). Source order 0..size-1 matches the pre-pipeline code,
   // so the arithmetic — and therefore the bits — are unchanged.
   auto reduce = [&](int64_t k) {
+    MetricTimer mt(kHistShmReduceUs);
     if (timeline_) timeline_->ActivityStart(tname, ACT_SHM_REDUCE);
     const int64_t n = seg_n(k);
     const int64_t lo = n * rank / size, hi = n * (rank + 1) / size;
@@ -735,6 +743,7 @@ Status TcpOps::ShmAllreduceFused(const Response& r,
     if (timeline_) timeline_->ActivityEnd(tname);
   };
   auto unpack = [&](int64_t k) {
+    MetricTimer mt(kHistShmUnpackUs);
     if (timeline_) timeline_->ActivityStart(tname, ACT_SHM_UNPACK);
     const uint8_t* src = region(rslot, k);
     const int64_t base_e = k * seg_elems, n = seg_n(k);
@@ -798,6 +807,7 @@ Status TcpOps::RingReduceScatterPhase(uint8_t* buf,
                                       const std::vector<int>& ranks, int p,
                                       WireCodec codec,
                                       std::vector<float>* ef) {
+  MetricTimer phase_timer(kHistTcpRingRsUs);
   // P-1 steps over element-offset chunks `offs`; chunk k starts at ring
   // position k+1 and lands fully reduced on position k.
   //
@@ -969,6 +979,7 @@ Status TcpOps::RingAllgatherPhase(uint8_t* buf,
                                   const std::vector<int>& ranks, int p,
                                   WireCodec codec,
                                   std::vector<float>* ef) {
+  MetricTimer phase_timer(kHistTcpRingAgUs);
   // P-1 forwarding steps; position p starts owning chunk p.
   const int P = static_cast<int>(ranks.size());
   const int64_t esize = DataTypeSize(dtype);
@@ -1206,6 +1217,7 @@ Status TcpOps::DoublingExchange(
     uint8_t* buf, int64_t bytes, const std::vector<int>& ranks, int p,
     const std::function<Status(const uint8_t*)>& combine, WireCodec codec,
     std::vector<float>* ef) {
+  MetricTimer phase_timer(kHistTcpDoublingUs);
   if (codec != WireCodec::NONE)
     return DoublingExchangeCompressed(buf, bytes, ranks, p, combine, codec,
                                       ef);

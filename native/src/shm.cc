@@ -14,6 +14,7 @@
 #include <functional>
 
 #include "hvd/logging.h"
+#include "hvd/metrics.h"
 
 namespace hvd {
 
@@ -292,6 +293,10 @@ bool ShmArena::PeersAlive() {
 
 bool ShmArena::Barrier(double timeout_secs) {
   if (poisoned_) return false;
+  // Barrier wait is the per-rank straggler signal: a rank whose
+  // shm_barrier_us tail is far above its peers' is the one everyone
+  // else waits for (cross-rank spread via hvd.metrics_aggregate()).
+  MetricTimer wait_timer(kHistShmBarrierUs);
   uint32_t gen = ctrl_->generation.load(std::memory_order_acquire);
   uint32_t n = ctrl_->arrived.fetch_add(1, std::memory_order_acq_rel) + 1;
   if (n == static_cast<uint32_t>(nranks_)) {

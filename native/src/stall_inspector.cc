@@ -4,10 +4,12 @@
 #include <sstream>
 
 #include "hvd/logging.h"
+#include "hvd/metrics.h"
 
 namespace hvd {
 
 void StallInspector::RecordUncachedTensor(const std::string& name, int rank) {
+  std::lock_guard<std::mutex> lock(mu_);
   auto it = pending_.find(name);
   if (it == pending_.end()) {
     Info info;
@@ -20,39 +22,70 @@ void StallInspector::RecordUncachedTensor(const std::string& name, int rank) {
   }
 }
 
-void StallInspector::RemoveUncachedTensor(const std::string& name) {
-  pending_.erase(name);
+double StallInspector::RemoveUncachedTensor(const std::string& name) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = pending_.find(name);
+  if (it == pending_.end()) return -1.0;
+  double age = std::chrono::duration<double>(std::chrono::steady_clock::now() -
+                                             it->second.first_seen)
+                   .count();
+  pending_.erase(it);
+  return age;
 }
 
-bool StallInspector::CheckForStalledTensors(int global_size) {
+std::vector<StallInspector::Stalled> StallInspector::Report(
+    int global_size) const {
+  std::vector<Stalled> out;
   auto now = std::chrono::steady_clock::now();
-  if (std::chrono::duration<double>(now - last_check_).count() <
-      warning_secs_ / 2)
-    return false;
-  last_check_ = now;
-
-  bool should_shutdown = false;
-  std::ostringstream warn;
-  int stalled = 0;
+  std::lock_guard<std::mutex> lock(mu_);
   for (const auto& kv : pending_) {
     double age =
         std::chrono::duration<double>(now - kv.second.first_seen).count();
     if (age < warning_secs_) continue;
+    Stalled s;
+    s.name = kv.first;
+    s.age_secs = age;
     std::vector<bool> ready(global_size, false);
     for (int r : kv.second.ranks) {
       if (r >= 0 && r < global_size) ready[r] = true;
     }
-    std::ostringstream missing;
     for (int r = 0; r < global_size; ++r) {
-      if (!ready[r]) missing << (missing.tellp() > 0 ? "," : "") << r;
+      if (!ready[r]) s.missing_ranks.push_back(r);
     }
+    out.push_back(std::move(s));
+  }
+  std::sort(out.begin(), out.end(),
+            [](const Stalled& a, const Stalled& b) { return a.name < b.name; });
+  return out;
+}
+
+bool StallInspector::CheckForStalledTensors(int global_size) {
+  {
+    auto now = std::chrono::steady_clock::now();
+    std::lock_guard<std::mutex> lock(mu_);
+    if (std::chrono::duration<double>(now - last_check_).count() <
+        warning_secs_ / 2)
+      return false;
+    last_check_ = now;
+  }
+
+  auto findings = Report(global_size);
+  bool should_shutdown = false;
+  std::ostringstream warn;
+  int stalled = 0;
+  for (const auto& f : findings) {
+    std::ostringstream missing;
+    for (size_t i = 0; i < f.missing_ranks.size(); ++i)
+      missing << (i ? "," : "") << f.missing_ranks[i];
     if (stalled++ < 5) {
-      warn << "\n  " << kv.first << " (" << static_cast<int>(age)
+      warn << "\n  " << f.name << " (" << static_cast<int>(f.age_secs)
            << "s, missing ranks: [" << missing.str() << "])";
     }
-    if (shutdown_secs_ > 0 && age > shutdown_secs_) should_shutdown = true;
+    if (shutdown_secs_ > 0 && f.age_secs > shutdown_secs_)
+      should_shutdown = true;
   }
   if (stalled > 0) {
+    MetricAdd(kCtrStallEvents);
     LOG_WARNING << "One or more tensors were submitted to be reduced/gathered "
                 << "but some ranks have not yet submitted them (" << stalled
                 << " stalled):" << warn.str()

@@ -14,6 +14,7 @@
 #include <thread>
 
 #include "hvd/logging.h"
+#include "hvd/metrics.h"
 
 namespace hvd {
 namespace {
@@ -65,6 +66,10 @@ void TcpConn::Close() {
 
 bool TcpConn::SendAll(const void* data, uint64_t len) {
   const char* p = static_cast<const char*>(data);
+  // Ground-truth on-the-wire accounting (one relaxed atomic add per
+  // call): with a wire codec active this counts the ENCODED bytes, so
+  // it is the denominator-of-record for effective-bandwidth math.
+  MetricAdd(kCtrTcpSendBytes, static_cast<int64_t>(len));
   while (len > 0) {
     ssize_t n = ::send(fd_, p, len, MSG_NOSIGNAL);
     if (n <= 0) {
@@ -79,6 +84,7 @@ bool TcpConn::SendAll(const void* data, uint64_t len) {
 
 bool TcpConn::RecvAll(void* data, uint64_t len) {
   char* p = static_cast<char*>(data);
+  MetricAdd(kCtrTcpRecvBytes, static_cast<int64_t>(len));
   while (len > 0) {
     ssize_t n = ::recv(fd_, p, len, 0);
     if (n <= 0) {

@@ -3,6 +3,8 @@
 #include <algorithm>
 #include <cstring>
 
+#include "hvd/metrics.h"
+
 namespace hvd {
 
 namespace {
@@ -112,6 +114,12 @@ void WorkerPool::ParallelFor(int parts, int64_t n,
     fn(0, n);
     return;
   }
+  // Pool occupancy: dispatches and their fan-out width (parts == the
+  // worker count a job keeps busy; the pool serializes jobs, so width
+  // IS occupancy). Inline parts==1 calls are deliberately uncounted —
+  // they never touch the pool.
+  MetricAdd(kCtrPoolJobs);
+  MetricObserve(kHistPoolParts, parts);
   std::lock_guard<std::mutex> caller(caller_mu_);
   uint32_t seq;
   {

@@ -12,14 +12,33 @@ int64_t Timeline::NowUs() const {
       .count();
 }
 
-void Timeline::Initialize(const std::string& path, int rank) {
-  if (initialized_.load()) return;
-  file_.open(path, std::ios::out | std::ios::trunc);
-  if (!file_.good()) {
+bool Timeline::Initialize(const std::string& path, int rank) {
+  // Restart semantics: a second Initialize retargets the timeline to
+  // the new path (the silent no-op here used to make
+  // hvd.start_timeline(new_path) on a running timeline do nothing,
+  // with no feedback). Shutdown() drains and joins the old writer, so
+  // the two files never interleave.
+  // Open the new file BEFORE shutting the old timeline down, so a
+  // failed restart (bad path) raises without killing a recording that
+  // was working fine.
+  std::ofstream next(path, std::ios::out | std::ios::trunc);
+  if (!next.good()) {
     LOG_ERROR << "Failed to open timeline file: " << path;
-    return;
+    return false;
   }
-  start_us_ = NowUs();
+  if (initialized_.load()) Shutdown();
+  file_ = std::move(next);
+  {
+    // Drop events queued between the old writer's exit and this
+    // restart — their timestamps are relative to the old epoch. The
+    // epoch resets under the same lock: a producer that passed the
+    // initialized_ check just before the restart computes its
+    // timestamp under mu_ against the new epoch, never a torn or
+    // stale start_us_ read.
+    std::lock_guard<std::mutex> lock(mu_);
+    events_.clear();
+    start_us_ = NowUs();
+  }
   shutdown_.store(false);
   file_ << "[\n";
   // Process metadata so chrome://tracing shows the rank.
@@ -28,6 +47,7 @@ void Timeline::Initialize(const std::string& path, int rank) {
   wrote_header_ = true;
   writer_ = std::thread([this] { WriterLoop(); });
   initialized_.store(true);
+  return true;
 }
 
 Timeline::~Timeline() { Shutdown(); }
@@ -101,5 +121,12 @@ void Timeline::End(const std::string& name, int64_t bytes) {
 }
 
 void Timeline::MarkCycleStart() { Enqueue('i', "cycle", "CYCLE_START"); }
+
+void Timeline::Counter(const std::string& name, double value) {
+  // chrome counter events carry the value in args; one series per
+  // event name, rendered as a track.
+  std::string v = std::to_string(value);
+  Enqueue('C', "counters", name, "{\"value\": " + v + "}");
+}
 
 }  // namespace hvd

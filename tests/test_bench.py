@@ -69,6 +69,23 @@ def test_find_regressions_skips_directionless_counters():
     assert bench.find_regressions(prev, cur) == {}
 
 
+def test_find_regressions_telemetry_key_directions():
+    """ISSUE 5 derived keys: the log2-bucket cycle tail and the
+    autotune-coupled fusion fill are trajectory-only (ungated — a
+    power-of-two jump or a threshold retune is not a regression), while
+    wire_bytes_saved_pct is a real higher-is-better efficiency metric
+    and stays gated."""
+    prev = {"extra": {"host_allreduce_cycle_us_p99": 2048.0,
+                      "host_allreduce_fusion_fill_pct": 12.0,
+                      "wire_bytes_saved_pct": 62.0}}
+    cur = {"extra": {"host_allreduce_cycle_us_p99": 8192.0,
+                     "host_allreduce_fusion_fill_pct": 3.0,
+                     "wire_bytes_saved_pct": 30.0}}
+    regs = bench.find_regressions(prev, cur)
+    assert set(regs) == {"extra.wire_bytes_saved_pct"}
+    assert regs["extra.wire_bytes_saved_pct"]["drop_pct"] > 50
+
+
 def test_find_regressions_threshold_boundary():
     prev = {"value": 100.0}
     assert bench.find_regressions(prev, {"value": 91.0}) == {}
