@@ -116,6 +116,21 @@ def _build_native() -> None:
 
 
 def load_library() -> ctypes.CDLL:
+    # HOROVOD_NATIVE_LIB points the loader at an alternate build of the
+    # core — the sanitizer variants (libhorovod_tpu_core.tsan.so, ...)
+    # from `make -C native SAN=...` — so the exact same Python test
+    # scenarios run against an instrumented library
+    # (docs/development.md, tests/test_sanitizers.py). The override is
+    # explicit opt-in: no rebuild is attempted (the harness that set it
+    # owns the build), but the ABI pin below still applies, so a stale
+    # instrumented .so cannot silently skew results.
+    override = os.environ.get("HOROVOD_NATIVE_LIB")
+    if override:
+        if not os.path.exists(override):
+            raise OSError(
+                f"HOROVOD_NATIVE_LIB={override} does not exist; build it "
+                "first (e.g. make -C native SAN=tsan)")
+        return _declare_abi(ctypes.CDLL(override), override)
     path = next((p for p in _LIB_CANDIDATES if os.path.exists(p)), None)
     # Always (re)run make when the source tree is present: make is a
     # no-op when the .so is current, and this keeps stale binaries from
@@ -138,8 +153,13 @@ def load_library() -> ctypes.CDLL:
     elif path is None:
         raise OSError("horovod_tpu native core not found and no source tree "
                       "to build it from")
-    lib = ctypes.CDLL(path)
+    return _declare_abi(ctypes.CDLL(path), path)
 
+
+def _declare_abi(lib: ctypes.CDLL, path: str) -> ctypes.CDLL:
+    """Declare the C ABI signatures and enforce the version pins on an
+    already-dlopen'd core (shared between the default candidate search
+    and the HOROVOD_NATIVE_LIB override path)."""
     try:
         got = lib.hvd_abi_version()
     except AttributeError:

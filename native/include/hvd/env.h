@@ -65,19 +65,36 @@ inline int EnvChoiceSane(const char* name, int dflt,
 }
 
 // Float knob: must parse fully and be strictly positive (every double
-// knob here is a duration/period).
-inline double EnvDoubleSane(const char* name, double dflt) {
+// knob here is a duration/period). allow_zero admits 0 for the knobs
+// where 0 is a live sentinel (HOROVOD_STALL_SHUTDOWN_TIME_SECONDS
+// means "never shut down" at 0).
+inline double EnvDoubleSane(const char* name, double dflt,
+                            bool allow_zero = false) {
   const char* v = std::getenv(name);
   if (v == nullptr || *v == '\0') return dflt;
   char* end = nullptr;
   double parsed = std::strtod(v, &end);
-  if (end == v || *end != '\0' || !(parsed > 0)) {
+  bool ok = allow_zero ? parsed >= 0 : parsed > 0;
+  if (end == v || *end != '\0' || !ok) {
     if (EnvWarnOnce(name))
-      LOG_WARNING << "ignoring invalid " << name << "=" << v
-                  << " (want a positive number); using default " << dflt;
+      LOG_WARNING << "ignoring invalid " << name << "=" << v << " (want a "
+                  << (allow_zero ? "non-negative" : "positive")
+                  << " number); using default " << dflt;
     return dflt;
   }
   return parsed;
 }
+
+// Free-form string knob (paths, host lists, addresses): nothing to
+// validate, but routing the read through here keeps std::getenv
+// confined to this header — tools/lint's getenv rule bans raw calls
+// everywhere else, so every knob read is greppable and every PARSED
+// knob has to opt into one of the sane helpers above.
+inline const char* EnvStr(const char* name) { return std::getenv(name); }
+
+// Presence flag (HOROVOD_SHM_DISABLE, HOROVOD_LOG_HIDE_TIME): set at
+// all — to anything, including "" or "0" — means ON, matching the
+// documented semantics these knobs always had.
+inline bool EnvFlag(const char* name) { return std::getenv(name) != nullptr; }
 
 }  // namespace hvd

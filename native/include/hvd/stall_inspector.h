@@ -20,10 +20,11 @@
 
 #include <chrono>
 #include <cstdint>
-#include <mutex>
 #include <string>
 #include <unordered_map>
 #include <vector>
+
+#include "hvd/thread_annotations.h"
 
 namespace hvd {
 
@@ -34,14 +35,15 @@ class StallInspector {
   double shutdown_time() const { return shutdown_secs_; }
 
   // Coordinator side: a rank announced readiness for a tensor.
-  void RecordUncachedTensor(const std::string& name, int rank);
+  void RecordUncachedTensor(const std::string& name, int rank)
+      HVD_EXCLUDES(mu_);
   // Removes the tensor (it fired) and returns its negotiation age in
   // seconds (first announce -> ready), or -1 if it was not tracked.
-  double RemoveUncachedTensor(const std::string& name);
+  double RemoveUncachedTensor(const std::string& name) HVD_EXCLUDES(mu_);
 
   // Returns true if the stall has exceeded the shutdown threshold.
   // Logs a warning listing stalled tensors + missing ranks.
-  bool CheckForStalledTensors(int global_size);
+  bool CheckForStalledTensors(int global_size) HVD_EXCLUDES(mu_);
 
   // One finding per tensor past the warning age (coordinator only —
   // workers have no pending table).
@@ -50,19 +52,24 @@ class StallInspector {
     double age_secs = 0.0;
     std::vector<int> missing_ranks;
   };
-  std::vector<Stalled> Report(int global_size) const;
+  std::vector<Stalled> Report(int global_size) const HVD_EXCLUDES(mu_);
 
  private:
+  // warning_secs_/shutdown_secs_ are set once at init before the
+  // background thread exists, then read-only — not guarded.
   double warning_secs_ = 60.0;
   double shutdown_secs_ = 0.0;  // 0 = never shut down
+  // Coordinator-thread-only (CheckForStalledTensors cadence limiter).
   std::chrono::steady_clock::time_point last_check_ =
       std::chrono::steady_clock::now();
-  mutable std::mutex mu_;
+  mutable Mutex mu_;
   struct Info {
     std::chrono::steady_clock::time_point first_seen;
     std::vector<int> ranks;
   };
-  std::unordered_map<std::string, Info> pending_;
+  // Written by the coordinator cycle, read by Python threads via
+  // hvd_stalled_tensors — the reason this table is mutex-guarded.
+  std::unordered_map<std::string, Info> pending_ HVD_GUARDED_BY(mu_);
 };
 
 }  // namespace hvd

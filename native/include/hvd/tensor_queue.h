@@ -5,12 +5,12 @@
 #pragma once
 
 #include <deque>
-#include <mutex>
 #include <unordered_map>
 #include <vector>
 
 #include "hvd/common.h"
 #include "hvd/message.h"
+#include "hvd/thread_annotations.h"
 
 namespace hvd {
 
@@ -18,25 +18,27 @@ class TensorQueue {
  public:
   // Atomically adds entries+requests; rejects duplicate in-flight names.
   Status AddToTensorQueue(std::vector<TensorTableEntry> entries,
-                          std::vector<Request> requests);
+                          std::vector<Request> requests) HVD_EXCLUDES(mu_);
 
   // Drains pending requests for one controller cycle.
-  void PopMessagesFromQueue(std::vector<Request>* out);
+  void PopMessagesFromQueue(std::vector<Request>* out) HVD_EXCLUDES(mu_);
 
   // Removes and returns the entries named by a response.
   void GetTensorEntriesFromResponse(const Response& response,
-                                    std::vector<TensorTableEntry>* entries);
+                                    std::vector<TensorTableEntry>* entries)
+      HVD_EXCLUDES(mu_);
 
   // Fails every in-flight entry (shutdown / fatal controller error).
-  void FailAll(const Status& status);
+  void FailAll(const Status& status) HVD_EXCLUDES(mu_);
 
-  size_t size() const;
-  bool Lookup(const std::string& name, TensorTableEntry* out) const;
+  size_t size() const HVD_EXCLUDES(mu_);
+  bool Lookup(const std::string& name, TensorTableEntry* out) const
+      HVD_EXCLUDES(mu_);
 
  private:
-  mutable std::mutex mu_;
-  std::unordered_map<std::string, TensorTableEntry> table_;
-  std::deque<Request> queue_;
+  mutable Mutex mu_;
+  std::unordered_map<std::string, TensorTableEntry> table_ HVD_GUARDED_BY(mu_);
+  std::deque<Request> queue_ HVD_GUARDED_BY(mu_);
 };
 
 }  // namespace hvd

@@ -1,0 +1,98 @@
+// Clang thread-safety annotations (no-ops under GCC).
+//
+// The runtime shares almost every struct between the Python enqueue
+// threads, the background coordination thread, and the data-plane
+// helper threads; the locking discipline lives in reviewers' heads
+// unless it is written down where a compiler can check it. These
+// macros attach that discipline to the code: HVD_GUARDED_BY on every
+// mutex-protected member, HVD_REQUIRES/HVD_EXCLUDES on functions with
+// locking preconditions. `make -C native tsa` compiles each TU with
+// clang -Wthread-safety -Werror when clang is installed (and skips
+// cleanly when it is not — this container ships GCC only, where the
+// attributes expand to nothing and cost nothing).
+//
+// Discipline for new code (docs/development.md#thread-safety):
+// annotate the member at the declaration, not the use sites — the
+// analysis propagates from there. State intentionally accessed without
+// the mutex must be std::atomic (annotating it GUARDED_BY would be a
+// lie the analyzer then enforces).
+#pragma once
+
+#include <mutex>
+
+#if defined(__clang__)
+#define HVD_THREAD_ANNOTATION(x) __attribute__((x))
+#else
+#define HVD_THREAD_ANNOTATION(x)  // no-op on GCC/MSVC
+#endif
+
+// Type is a lockable capability / scoped lock over one.
+#define HVD_CAPABILITY(x) HVD_THREAD_ANNOTATION(capability(x))
+#define HVD_SCOPED_CAPABILITY HVD_THREAD_ANNOTATION(scoped_lockable)
+#define HVD_TRY_ACQUIRE(...) \
+  HVD_THREAD_ANNOTATION(try_acquire_capability(__VA_ARGS__))
+
+// Member is only read/written with `x` held.
+#define HVD_GUARDED_BY(x) HVD_THREAD_ANNOTATION(guarded_by(x))
+// Pointer member whose POINTEE is protected by `x`.
+#define HVD_PT_GUARDED_BY(x) HVD_THREAD_ANNOTATION(pt_guarded_by(x))
+// Caller must hold `x` (exclusively) when calling.
+#define HVD_REQUIRES(...) \
+  HVD_THREAD_ANNOTATION(requires_capability(__VA_ARGS__))
+// Caller must NOT hold `x` (the function acquires it itself).
+#define HVD_EXCLUDES(...) HVD_THREAD_ANNOTATION(locks_excluded(__VA_ARGS__))
+// Function acquires/releases `x` (scoped-lock helpers, init/teardown).
+#define HVD_ACQUIRE(...) HVD_THREAD_ANNOTATION(acquire_capability(__VA_ARGS__))
+#define HVD_RELEASE(...) HVD_THREAD_ANNOTATION(release_capability(__VA_ARGS__))
+// Opt-out for functions whose safety the analyzer cannot see (e.g.
+// lock-free protocols verified by the tsan tier instead).
+#define HVD_NO_THREAD_SAFETY_ANALYSIS \
+  HVD_THREAD_ANNOTATION(no_thread_safety_analysis)
+
+namespace hvd {
+
+// std::mutex with the capability annotation clang's analysis needs
+// (libstdc++'s std::mutex carries none, so GUARDED_BY over a bare
+// std::mutex member trips -Wthread-safety-attributes). Drop-in: same
+// lock/unlock/try_lock surface, works with std::unique_lock; cv wait
+// loops use native() below. Zero overhead — the annotation is
+// compile-time only and the class is a plain wrapper.
+class HVD_CAPABILITY("mutex") Mutex {
+ public:
+  Mutex() = default;
+  Mutex(const Mutex&) = delete;
+  Mutex& operator=(const Mutex&) = delete;
+  void lock() HVD_ACQUIRE() { mu_.lock(); }
+  void unlock() HVD_RELEASE() { mu_.unlock(); }
+  bool try_lock() HVD_TRY_ACQUIRE(true) { return mu_.try_lock(); }
+
+  // Escape hatch for condition-variable wait loops: std::condition_
+  // variable is measurably cheaper than condition_variable_any (which
+  // carries its own internal mutex taken on every wait AND notify),
+  // and the data-plane hot paths (WorkerPool dispatch, timeline
+  // enqueue, per-op completion) sit exactly there. Those loops are
+  // HVD_NO_THREAD_SAFETY_ANALYSIS anyway — waiting on the underlying
+  // std::mutex loses no static coverage.
+  std::mutex& native() { return mu_; }
+
+ private:
+  std::mutex mu_;
+};
+
+// std::lock_guard equivalent the analysis can see (lock acquisition
+// through the std:: templates is invisible to it). Use this for plain
+// scoped sections; condition-variable wait loops keep
+// std::unique_lock + HVD_NO_THREAD_SAFETY_ANALYSIS (their lock flow
+// is dynamic — the tsan tier covers them at runtime instead).
+class HVD_SCOPED_CAPABILITY MutexLock {
+ public:
+  explicit MutexLock(Mutex& mu) HVD_ACQUIRE(mu) : mu_(mu) { mu_.lock(); }
+  ~MutexLock() HVD_RELEASE() { mu_.unlock(); }
+  MutexLock(const MutexLock&) = delete;
+  MutexLock& operator=(const MutexLock&) = delete;
+
+ private:
+  Mutex& mu_;
+};
+
+}  // namespace hvd

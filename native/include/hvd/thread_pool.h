@@ -21,9 +21,10 @@
 #include <condition_variable>
 #include <cstdint>
 #include <functional>
-#include <mutex>
 #include <thread>
 #include <vector>
+
+#include "hvd/thread_annotations.h"
 
 namespace hvd {
 
@@ -39,23 +40,30 @@ class WorkerPool {
   // every range completed. Ranges partition [0, n) exactly, so
   // element-wise kernels produce bitwise-identical results at any
   // thread count. Serializes concurrent callers (one job at a time).
+  // cv handshake + lock-free claim protocol: dynamic lock flow the
+  // static analysis cannot follow (see RunOnePart's generation stamps)
+  // — the tsan tier verifies this at runtime instead.
   void ParallelFor(int parts, int64_t n,
-                   const std::function<void(int64_t, int64_t)>& fn);
+                   const std::function<void(int64_t, int64_t)>& fn)
+      HVD_EXCLUDES(caller_mu_, mu_) HVD_NO_THREAD_SAFETY_ANALYSIS;
 
  private:
   WorkerPool() = default;
-  void EnsureWorkers(int n);
-  void WorkerLoop();
+  void EnsureWorkers(int n) HVD_REQUIRES(mu_);
+  void WorkerLoop() HVD_NO_THREAD_SAFETY_ANALYSIS;
   // Claims + runs one range of the job generation `seq`; false when
-  // none left or the live job is a different generation.
+  // none left or the live job is a different generation. Lock-free:
+  // everything it touches is atomic or pinned by a successful claim.
   bool RunOnePart(uint32_t seq);
 
-  std::mutex caller_mu_;  // one ParallelFor at a time
-  std::mutex mu_;
+  Mutex caller_mu_;  // one ParallelFor at a time
+  Mutex mu_;
+  // Plain condition_variable over mu_.native(): the _any variant's
+  // internal bookkeeping costs on every dispatch/report wait-notify.
   std::condition_variable cv_work_;
   std::condition_variable cv_done_;
-  std::vector<std::thread> workers_;
-  uint32_t job_seq_ = 0;  // bumped per job (guarded by mu_)
+  std::vector<std::thread> workers_ HVD_GUARDED_BY(mu_);
+  uint32_t job_seq_ HVD_GUARDED_BY(mu_) = 0;  // bumped per job
   // Claim ticket: (job seq << 32) | next part index, and the matching
   // generation-stamped part bound (job seq << 32 | parts). Stamping
   // BOTH with the generation makes a stale worker's claim fail
@@ -63,8 +71,11 @@ class WorkerPool {
   std::atomic<uint64_t> ticket_{0};
   std::atomic<uint64_t> bounds_{0};
   std::atomic<int64_t> job_n_{0};
+  // Written under mu_ at publish; read lock-free by claim holders (a
+  // successful generation-stamped claim pins the job, so the read is
+  // ordered by the ticket's release store, not by mu_).
   const std::function<void(int64_t, int64_t)>* job_fn_ = nullptr;
-  int done_parts_ = 0;  // guarded by mu_
+  int done_parts_ HVD_GUARDED_BY(mu_) = 0;
 };
 
 // Process-wide host-reduction thread budget consulted by

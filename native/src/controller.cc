@@ -8,6 +8,7 @@
 #include <algorithm>
 #include <cstdlib>
 
+#include "hvd/env.h"
 #include "hvd/logging.h"
 #include "hvd/metrics.h"
 
@@ -467,9 +468,8 @@ ResponseList LocalController::ComputeResponseList(bool shutdown_requested) {
 Status TcpController::Initialize() {
   joined_ranks_.assign(size_, false);
   if (size_ == 1) return Status::OK();
-  int timeout_ms = 120000;
-  if (const char* t = std::getenv("HOROVOD_CONTROLLER_TIMEOUT_MS"))
-    timeout_ms = std::atoi(t);
+  const int timeout_ms = static_cast<int>(EnvInt64Sane(
+      "HOROVOD_CONTROLLER_TIMEOUT_MS", 120000, 1, 1 << 30));
   if (rank_ == 0) {
     // addr may be "0.0.0.0:port"; the launcher guarantees the port.
     if (server_.Listen(addr_) < 0)
@@ -616,7 +616,7 @@ std::vector<std::string> SplitCsv(const std::string& s) {
 }
 
 std::vector<std::string> CandidateHosts(const std::string& ctrl_local_ip) {
-  if (const char* h = std::getenv("HOROVOD_PEER_HOST")) return {h};
+  if (const char* h = EnvStr("HOROVOD_PEER_HOST")) return {h};
   std::vector<std::string> hosts;
   auto add = [&](const std::string& h) {
     if (h.empty()) return;
@@ -624,7 +624,7 @@ std::vector<std::string> CandidateHosts(const std::string& ctrl_local_ip) {
       if (e == h) return;
     hosts.push_back(h);
   };
-  if (const char* hs = std::getenv("HOROVOD_PEER_HOSTS")) {
+  if (const char* hs = EnvStr("HOROVOD_PEER_HOSTS")) {
     for (const auto& h : SplitCsv(hs)) add(h);
     return hosts;
   }

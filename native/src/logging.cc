@@ -7,10 +7,16 @@
 #include <ctime>
 #include <mutex>
 
+#include "hvd/env.h"
+
 namespace hvd {
 
 static LogLevel ParseLevel() {
-  const char* env = std::getenv("HOROVOD_LOG_LEVEL");
+  // EnvStr, not EnvChoiceSane: this runs during the very first log
+  // call, and the choice helper's invalid-value warning would recurse
+  // into the logger whose level is still being resolved. The local
+  // parse below already falls back to WARNING on garbage.
+  const char* env = EnvStr("HOROVOD_LOG_LEVEL");
   if (env == nullptr) return LogLevel::WARNING;
   std::string s(env);
   for (auto& c : s) c = static_cast<char>(::tolower(c));
@@ -29,7 +35,7 @@ LogLevel MinLogLevelFromEnv() {
 }
 
 bool LogTimestampFromEnv() {
-  static bool hide = std::getenv("HOROVOD_LOG_HIDE_TIME") != nullptr;
+  static bool hide = EnvFlag("HOROVOD_LOG_HIDE_TIME");
   return !hide;
 }
 

@@ -48,13 +48,13 @@ namespace {
 class HandleManager {
  public:
   int64_t Allocate() {
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     int64_t h = next_++;
     results_.emplace(h, Result{});
     return h;
   }
   void MarkDone(int64_t h, const Status& s) {
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     auto it = results_.find(h);
     if (it == results_.end()) return;
     it->second.status = s;
@@ -62,13 +62,16 @@ class HandleManager {
     cv_.notify_all();
   }
   bool Poll(int64_t h) {
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     auto it = results_.find(h);
     return it == results_.end() || it->second.done;
   }
   // timeout_ms < 0: wait forever. Returns false on timeout.
-  bool Wait(int64_t h, int timeout_ms, Status* out) {
-    std::unique_lock<std::mutex> lock(mu_);
+  // cv wait: dynamic lock flow, opted out of static analysis (tsan
+  // tier covers it).
+  bool Wait(int64_t h, int timeout_ms, Status* out)
+      HVD_NO_THREAD_SAFETY_ANALYSIS {
+    std::unique_lock<std::mutex> lock(mu_.native());
     auto pred = [&] {
       auto it = results_.find(h);
       return it == results_.end() || it->second.done;
@@ -84,11 +87,11 @@ class HandleManager {
     return true;
   }
   void Release(int64_t h) {
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     results_.erase(h);
   }
   void GetStatus(int64_t h, Status* out) {
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     auto it = results_.find(h);
     *out = it == results_.end() ? Status::OK() : it->second.status;
   }
@@ -98,10 +101,12 @@ class HandleManager {
     bool done = false;
     Status status;
   };
-  std::mutex mu_;
+  Mutex mu_;
+  // Plain condition_variable over mu_.native(): notify_all fires per
+  // completed op — a hot path under small-tensor traffic.
   std::condition_variable cv_;
-  int64_t next_ = 0;
-  std::unordered_map<int64_t, Result> results_;
+  int64_t next_ HVD_GUARDED_BY(mu_) = 0;
+  std::unordered_map<int64_t, Result> results_ HVD_GUARDED_BY(mu_);
 };
 
 // Python-side hooks (set before hvd_init).
@@ -149,12 +154,18 @@ struct GlobalState {
   ExecCallback exec_cb = nullptr;
   AllocCallback alloc_cb = nullptr;
 
-  std::mutex exec_mu;
-  int64_t next_exec_id = 0;
-  std::unordered_map<int64_t, PendingExec> pending_execs;
+  // Python executor handoff: the coordinator publishes a pending exec,
+  // arbitrary Python threads complete it via hvd_exec_done.
+  Mutex exec_mu;
+  int64_t next_exec_id HVD_GUARDED_BY(exec_mu) = 0;
+  std::unordered_map<int64_t, PendingExec> pending_execs
+      HVD_GUARDED_BY(exec_mu);
 
-  std::mutex recvsplits_mu;
-  std::unordered_map<int64_t, std::vector<int64_t>> recvsplits;  // by handle
+  // Written by the data plane at completion, read by hvd_get_recvsplits
+  // from Python threads.
+  Mutex recvsplits_mu;
+  std::unordered_map<int64_t, std::vector<int64_t>> recvsplits
+      HVD_GUARDED_BY(recvsplits_mu);  // by handle
 };
 
 GlobalState& State() {
@@ -162,19 +173,9 @@ GlobalState& State() {
   return *state;
 }
 
-int64_t EnvInt64(const char* name, int64_t dflt) {
-  const char* v = std::getenv(name);
-  return v ? std::atoll(v) : dflt;
-}
-
-double EnvDouble(const char* name, double dflt) {
-  const char* v = std::getenv(name);
-  return v ? std::atof(v) : dflt;
-}
-
 void CompleteEntry(GlobalState& st, TensorTableEntry& e, const Status& s) {
   if (!e.recvsplits.empty()) {
-    std::lock_guard<std::mutex> lock(st.recvsplits_mu);
+    MutexLock lock(st.recvsplits_mu);
     st.recvsplits[e.handle] = e.recvsplits;
   }
   if (e.callback) e.callback(s);
@@ -311,7 +312,7 @@ void PerformOperation(GlobalState& st, const Response& response) {
         int64_t exec_id;
         std::vector<const char*> names;
         {
-          std::lock_guard<std::mutex> lock(st.exec_mu);
+          MutexLock lock(st.exec_mu);
           exec_id = st.next_exec_id++;
           auto& pe = st.pending_execs[exec_id];
           pe.response = response;
@@ -530,24 +531,34 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   st.cross_rank = cross_rank;
   st.cross_size = cross_size;
 
-  st.cycle_time_ms = hvd::EnvDouble("HOROVOD_CYCLE_TIME", 1.0);
+  // Sanitized env parsing throughout (env.h, warn-once): atoll/atof's
+  // silent 0 for garbage would set a live value on several of these.
+  st.cycle_time_ms = hvd::EnvDoubleSane("HOROVOD_CYCLE_TIME", 1.0);
+  // Bound is a sanity ceiling well above any real deployment, not a
+  // policy: values past it fall back to the default WITH a warning,
+  // so the bound must never bite a legitimate operator.
   st.response_cache.SetCapacity(static_cast<uint32_t>(
-      hvd::EnvInt64("HOROVOD_CACHE_CAPACITY", 1024)));
+      hvd::EnvInt64Sane("HOROVOD_CACHE_CAPACITY", 1024, 0, 1 << 24)));
   // Single read of HOROVOD_FUSION_THRESHOLD: three subsystems consume
   // it (fusion buffer sizing, autotune seed, controller threshold) and
   // reading the environment three times would let them disagree if
   // anything mutated the variable between reads.
-  const int64_t fusion_threshold =
-      hvd::EnvInt64("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024);
+  const int64_t fusion_threshold = hvd::EnvInt64Sane(
+      "HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024, 0, int64_t(1) << 40);
   st.fusion.SetInitialSize(fusion_threshold);
-  st.stall_inspector.SetWarningTime(
-      hvd::EnvDouble("HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0));
-  st.stall_inspector.SetShutdownTime(
-      hvd::EnvDouble("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0));
+  // 0 is live for both stall knobs (0 shutdown = never shut down).
+  st.stall_inspector.SetWarningTime(hvd::EnvDoubleSane(
+      "HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0, /*allow_zero=*/true));
+  st.stall_inspector.SetShutdownTime(hvd::EnvDoubleSane(
+      "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0, /*allow_zero=*/true));
   st.param_manager = hvd::ParameterManager();
   st.param_manager.Initialize(fusion_threshold, st.cycle_time_ms);
-  st.param_manager.SetEnabled(hvd::EnvInt64("HOROVOD_AUTOTUNE", 0) != 0);
-  if (const char* lp = std::getenv("HOROVOD_AUTOTUNE_LOG"))
+  // Any nonzero enables (historic semantics: `EnvInt64(...) != 0`) —
+  // a [0,1] bound here would silently DISABLE the feature for an
+  // operator launching with AUTOTUNE=2, the opposite of their intent.
+  st.param_manager.SetEnabled(
+      hvd::EnvInt64Sane("HOROVOD_AUTOTUNE", 0, 0, 1 << 30) != 0);
+  if (const char* lp = hvd::EnvStr("HOROVOD_AUTOTUNE_LOG"))
     st.param_manager.SetLogPath(lp);
 
   hvd::ControllerDeps deps;
@@ -556,7 +567,7 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   deps.stall_inspector = &st.stall_inspector;
   deps.timeline = &st.timeline;
 
-  const char* addr = std::getenv("HOROVOD_CONTROLLER_ADDR");
+  const char* addr = hvd::EnvStr("HOROVOD_CONTROLLER_ADDR");
   if (size > 1 && addr == nullptr) {
     LOG_ERROR << "multi-process init requires HOROVOD_CONTROLLER_ADDR";
     return -1;
@@ -596,16 +607,17 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
       hvd::EnvChoiceSane("HOROVOD_WIRE_COMPRESSION", 0,
                          hvd::kWireCodecNames, hvd::kNumWireCodecs));
   st.controller->SetTopology(local_rank, local_size, cross_rank, cross_size);
-  st.controller->SetHierarchical(
-      hvd::EnvInt64("HOROVOD_HIERARCHICAL_ALLREDUCE", 0) != 0);
+  st.controller->SetHierarchical(   // any nonzero enables (see above)
+      hvd::EnvInt64Sane("HOROVOD_HIERARCHICAL_ALLREDUCE", 0, 0, 1 << 30)
+      != 0);
   st.controller->SetShmEnabled(
-      size > 1 && std::getenv("HOROVOD_SHM_DISABLE") == nullptr);
+      size > 1 && !hvd::EnvFlag("HOROVOD_SHM_DISABLE"));
   hvd::Status s = st.controller->Initialize();
   // The pool's budget follows the controller's POST-SYNC value: rank
   // 0's knob (env or default) reaches every rank through the param
   // sync, the same discipline as the thresholds.
   hvd::SetHostReduceThreads(st.controller->reduce_threads());
-  if (s.ok() && std::getenv("HOROVOD_SHM_DISABLE") != nullptr &&
+  if (s.ok() && hvd::EnvFlag("HOROVOD_SHM_DISABLE") &&
       (st.controller->shm_enabled() ||
        st.controller->node_shm_applicable())) {
     // Deliberate (controller.h: the data-plane choice must be job-
@@ -662,7 +674,7 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
     st.host_ops = std::make_unique<hvd::LocalOps>(st.controller.get(),
                                                   &st.fusion, &st.timeline);
   }
-  if (const char* tl = std::getenv("HOROVOD_TIMELINE"))
+  if (const char* tl = hvd::EnvStr("HOROVOD_TIMELINE"))
     st.timeline.Initialize(tl, rank);
 
   st.background_thread = std::thread([&st] { hvd::BackgroundThreadLoop(st); });
@@ -787,14 +799,14 @@ int hvd_wait(int64_t handle, int timeout_ms, char* err_buf, int err_len) {
 void hvd_release_handle(int64_t handle) {
   auto& st = hvd::State();
   st.handles.Release(handle);
-  std::lock_guard<std::mutex> lock(st.recvsplits_mu);
+  hvd::MutexLock lock(st.recvsplits_mu);
   st.recvsplits.erase(handle);
 }
 
 // Copies the alltoall recv splits recorded for `handle`; returns count.
 int hvd_get_recvsplits(int64_t handle, int64_t* out, int max_n) {
   auto& st = hvd::State();
-  std::lock_guard<std::mutex> lock(st.recvsplits_mu);
+  hvd::MutexLock lock(st.recvsplits_mu);
   auto it = st.recvsplits.find(handle);
   if (it == st.recvsplits.end()) return 0;
   int n = static_cast<int>(it->second.size());
@@ -809,7 +821,7 @@ void hvd_exec_done(int64_t exec_id, int status_code, const char* err) {
   auto& st = hvd::State();
   hvd::PendingExec pe;
   {
-    std::lock_guard<std::mutex> lock(st.exec_mu);
+    hvd::MutexLock lock(st.exec_mu);
     auto it = st.pending_execs.find(exec_id);
     if (it == st.pending_execs.end()) return;
     pe = std::move(it->second);

@@ -384,8 +384,8 @@ TcpOps::TcpOps(Controller* controller, FusionBufferManager* fusion,
   // published host; a mismatched tag would silently split the arena)
   // plus the elastic epoch, plus an optional scope suffix.
   auto arena_tag = [](const std::string& suffix) {
-    const char* addr = std::getenv("HOROVOD_CONTROLLER_ADDR");
-    const char* epoch = std::getenv("HOROVOD_ELASTIC_EPOCH");
+    const char* addr = EnvStr("HOROVOD_CONTROLLER_ADDR");
+    const char* epoch = EnvStr("HOROVOD_ELASTIC_EPOCH");
     std::string a = addr ? addr : "local";
     auto colon = a.rfind(':');
     return (colon == std::string::npos ? a : a.substr(colon + 1)) + "|" +

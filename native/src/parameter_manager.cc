@@ -6,6 +6,7 @@
 #include <cstring>
 
 #include "hvd/bayesian.h"
+#include "hvd/env.h"
 #include "hvd/logging.h"
 
 namespace hvd {
@@ -45,12 +46,14 @@ void ParameterManager::Initialize(int64_t fusion, double cycle_ms) {
   cycle_ms_ = cycle_ms;
   best_fusion_ = fusion;
   best_cycle_ms_ = cycle_ms;
-  if (const char* w = std::getenv("HOROVOD_AUTOTUNE_WINDOW_SECS"))
-    window_secs_ = std::atof(w);
-  if (const char* m = std::getenv("HOROVOD_AUTOTUNE_MODE"))
-    bayes_ = std::strcmp(m, "climb") != 0;
-  if (const char* n = std::getenv("HOROVOD_AUTOTUNE_MAX_SAMPLES"))
-    max_samples_ = std::max(1, std::atoi(n));
+  window_secs_ = EnvDoubleSane("HOROVOD_AUTOTUNE_WINDOW_SECS", window_secs_);
+  // Was strcmp(m, "climb"): any typo silently meant bayes. Now a typo
+  // warns once and keeps the default (still bayes) — same outcome,
+  // but visible.
+  static const char* const kModes[] = {"bayes", "climb"};
+  bayes_ = EnvChoiceSane("HOROVOD_AUTOTUNE_MODE", 0, kModes, 2) == 0;
+  max_samples_ = static_cast<int>(
+      EnvInt64Sane("HOROVOD_AUTOTUNE_MAX_SAMPLES", max_samples_, 1, 1 << 20));
 }
 
 void ParameterManager::SetCategoricalTunable(Categorical cat,

@@ -9,7 +9,7 @@
 namespace hvd {
 
 void StallInspector::RecordUncachedTensor(const std::string& name, int rank) {
-  std::lock_guard<std::mutex> lock(mu_);
+  MutexLock lock(mu_);
   auto it = pending_.find(name);
   if (it == pending_.end()) {
     Info info;
@@ -23,7 +23,7 @@ void StallInspector::RecordUncachedTensor(const std::string& name, int rank) {
 }
 
 double StallInspector::RemoveUncachedTensor(const std::string& name) {
-  std::lock_guard<std::mutex> lock(mu_);
+  MutexLock lock(mu_);
   auto it = pending_.find(name);
   if (it == pending_.end()) return -1.0;
   double age = std::chrono::duration<double>(std::chrono::steady_clock::now() -
@@ -37,7 +37,7 @@ std::vector<StallInspector::Stalled> StallInspector::Report(
     int global_size) const {
   std::vector<Stalled> out;
   auto now = std::chrono::steady_clock::now();
-  std::lock_guard<std::mutex> lock(mu_);
+  MutexLock lock(mu_);
   for (const auto& kv : pending_) {
     double age =
         std::chrono::duration<double>(now - kv.second.first_seen).count();
@@ -62,7 +62,7 @@ std::vector<StallInspector::Stalled> StallInspector::Report(
 bool StallInspector::CheckForStalledTensors(int global_size) {
   {
     auto now = std::chrono::steady_clock::now();
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     if (std::chrono::duration<double>(now - last_check_).count() <
         warning_secs_ / 2)
       return false;

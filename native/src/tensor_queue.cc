@@ -4,7 +4,7 @@ namespace hvd {
 
 Status TensorQueue::AddToTensorQueue(std::vector<TensorTableEntry> entries,
                                      std::vector<Request> requests) {
-  std::lock_guard<std::mutex> lock(mu_);
+  MutexLock lock(mu_);
   for (const auto& e : entries) {
     if (table_.count(e.name)) {
       return Status::InvalidArgument(
@@ -19,7 +19,7 @@ Status TensorQueue::AddToTensorQueue(std::vector<TensorTableEntry> entries,
 }
 
 void TensorQueue::PopMessagesFromQueue(std::vector<Request>* out) {
-  std::lock_guard<std::mutex> lock(mu_);
+  MutexLock lock(mu_);
   out->insert(out->end(), std::make_move_iterator(queue_.begin()),
               std::make_move_iterator(queue_.end()));
   queue_.clear();
@@ -27,7 +27,7 @@ void TensorQueue::PopMessagesFromQueue(std::vector<Request>* out) {
 
 void TensorQueue::GetTensorEntriesFromResponse(
     const Response& response, std::vector<TensorTableEntry>* entries) {
-  std::lock_guard<std::mutex> lock(mu_);
+  MutexLock lock(mu_);
   for (const auto& name : response.tensor_names) {
     auto it = table_.find(name);
     if (it != table_.end()) {
@@ -40,7 +40,7 @@ void TensorQueue::GetTensorEntriesFromResponse(
 void TensorQueue::FailAll(const Status& status) {
   std::unordered_map<std::string, TensorTableEntry> table;
   {
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     table.swap(table_);
     queue_.clear();
   }
@@ -50,12 +50,12 @@ void TensorQueue::FailAll(const Status& status) {
 }
 
 size_t TensorQueue::size() const {
-  std::lock_guard<std::mutex> lock(mu_);
+  MutexLock lock(mu_);
   return table_.size();
 }
 
 bool TensorQueue::Lookup(const std::string& name, TensorTableEntry* out) const {
-  std::lock_guard<std::mutex> lock(mu_);
+  MutexLock lock(mu_);
   auto it = table_.find(name);
   if (it == table_.end()) return false;
   *out = it->second;

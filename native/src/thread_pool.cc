@@ -86,7 +86,7 @@ bool WorkerPool::RunOnePart(uint32_t seq) {
 
 void WorkerPool::WorkerLoop() {
   uint32_t seen = 0;
-  std::unique_lock<std::mutex> lock(mu_);
+  std::unique_lock<std::mutex> lock(mu_.native());
   for (;;) {
     cv_work_.wait(lock, [&] { return job_seq_ != seen; });
     seen = job_seq_;
@@ -120,10 +120,10 @@ void WorkerPool::ParallelFor(int parts, int64_t n,
   // they never touch the pool.
   MetricAdd(kCtrPoolJobs);
   MetricObserve(kHistPoolParts, parts);
-  std::lock_guard<std::mutex> caller(caller_mu_);
+  MutexLock caller(caller_mu_);
   uint32_t seq;
   {
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     EnsureWorkers(parts - 1);
     job_n_.store(n, std::memory_order_relaxed);
     job_fn_ = &fn;
@@ -143,7 +143,7 @@ void WorkerPool::ParallelFor(int parts, int64_t n,
   // the tail even if every worker thread is preempted.
   int ran = 0;
   while (RunOnePart(seq)) ++ran;
-  std::unique_lock<std::mutex> lock(mu_);
+  std::unique_lock<std::mutex> lock(mu_.native());
   done_parts_ += ran;
   if (done_parts_ >= parts) {
     cv_done_.notify_all();

@@ -35,7 +35,7 @@ bool Timeline::Initialize(const std::string& path, int rank) {
     // initialized_ check just before the restart computes its
     // timestamp under mu_ against the new epoch, never a torn or
     // stale start_us_ read.
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     events_.clear();
     start_us_ = NowUs();
   }
@@ -44,7 +44,6 @@ bool Timeline::Initialize(const std::string& path, int rank) {
   // Process metadata so chrome://tracing shows the rank.
   file_ << R"({"name": "process_name", "ph": "M", "pid": )" << rank
         << R"(, "args": {"name": "rank )" << rank << R"("}})" << ",\n";
-  wrote_header_ = true;
   writer_ = std::thread([this] { WriterLoop(); });
   initialized_.store(true);
   return true;
@@ -55,7 +54,7 @@ Timeline::~Timeline() { Shutdown(); }
 void Timeline::Shutdown() {
   if (!initialized_.load()) return;
   {
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     shutdown_.store(true);
   }
   cv_.notify_all();
@@ -68,14 +67,14 @@ void Timeline::Enqueue(char phase, const std::string& tid,
                        const std::string& name, std::string args) {
   if (!initialized_.load()) return;
   {
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     events_.push_back(Event{phase, tid, name, std::move(args), NowUs() - start_us_});
   }
   cv_.notify_one();
 }
 
 void Timeline::WriterLoop() {
-  std::unique_lock<std::mutex> lock(mu_);
+  std::unique_lock<std::mutex> lock(mu_.native());
   while (true) {
     cv_.wait(lock, [this] { return !events_.empty() || shutdown_.load(); });
     std::deque<Event> batch;

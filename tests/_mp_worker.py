@@ -9,6 +9,20 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
+# Under a sanitizer run (HOROVOD_NATIVE_LIB set by
+# tests/test_sanitizers.py), force numpy's lazy `numpy.testing` import
+# NOW, before hvd.init() spawns the runtime's threads: its module body
+# runs check_support_sve(), which forks a subprocess, and under
+# LD_PRELOADed libtsan a fork while other threads exist deadlocks in
+# the tsan runtime (docs/development.md#sanitizer-caveats). Every
+# scenario whose first np.testing touch came after init hung under
+# tsan through exactly this path. The import-time flavor of the same
+# deadlock — OpenBLAS's own thread pool is already up when this line
+# forks — is the harness's job: it sets OPENBLAS_NUM_THREADS=1.
+# Conditional because the import costs ~0.13s of lscpu probe per
+# worker spawn — real seconds across tier-1's many multiprocess tests.
+if os.environ.get("HOROVOD_NATIVE_LIB"):
+    import numpy.testing  # noqa: E402, F401
 
 import horovod_tpu as hvd  # noqa: E402
 from horovod_tpu.common.exceptions import HorovodInternalError  # noqa: E402

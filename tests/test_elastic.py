@@ -202,7 +202,10 @@ def _run_elastic_job(tmp_path, total, extra_env, discovery, min_np=1,
     t = threading.Thread(target=runner, daemon=True)
     t.start()
     if mutate:
-        mutate()
+        # The callback gets the runner thread so an event-driven
+        # trigger can bail out the moment the job dies instead of
+        # polling a dead job's log until its own deadline.
+        mutate(t)
     t.join(timeout)
     assert not t.is_alive(), "elastic job did not finish"
     return result["codes"]
@@ -248,13 +251,49 @@ def test_elastic_scale_down_mid_training(tmp_path, capfd):
     total = 60
     discovery = FixedHostDiscovery({"localhost": 2})
 
-    def mutate():
-        time.sleep(2.0)
-        discovery.set_hosts({"localhost": 1})
+    # Event-driven trigger, not a wall-clock sleep: shrink only after
+    # the survivor has COMMITTED a few size-2 batches. The old
+    # `sleep(2.0)` raced both ends under load — a contended box could
+    # still be importing jax when the shrink landed (job then starts
+    # directly at size 1, "2" never appears in the log), while an idle
+    # one could finish all 60 batches before discovery reacted ("1"
+    # never appears). Progress in the worker's own log is the only
+    # signal that is right on every box.
+    trigger_timed_out = []
+
+    def mutate(job=None):
+        first = os.path.join(str(tmp_path), "localhost_0.log")
+        # Generous deadline, just under _run_elastic_job's 180s join:
+        # a contended box occasionally stalls startup >60s (observed
+        # once in a 10x stress run), and a premature raise here is
+        # exactly the flake this trigger replaced. On timeout, RECORD
+        # and return instead of raising — mutate runs before the join,
+        # so a raise here would orphan the still-running job thread and
+        # its worker processes into the next test's lap; returning lets
+        # the job finish (at size 2) and the assert below fail cleanly
+        # after everything is joined.
+        deadline = time.monotonic() + 150
+        while time.monotonic() < deadline:
+            if job is not None and not job.is_alive():
+                # Job already over (crashed or finished without us):
+                # stop polling a dead job's log — the codes/results
+                # asserts below report the real cause immediately.
+                return
+            try:
+                with open(first) as f:
+                    committed = [ln for ln in f if " size=2" in ln]
+            except OSError:
+                committed = []
+            if len(committed) >= 3:
+                discovery.set_hosts({"localhost": 1})
+                return
+            time.sleep(0.05)
+        trigger_timed_out.append(True)
 
     codes = _run_elastic_job(
         tmp_path, total, {"ELASTIC_SLEEP": "0.05"}, discovery,
         max_np=2, mutate=mutate)
+    assert not trigger_timed_out, "no size=2 training progress within 150s"
     out = capfd.readouterr().out
     results = [ln for ln in out.splitlines() if "RESULT" in ln]
     assert sum(f"batch={total}" in ln for ln in results) >= 1, out
@@ -272,7 +311,7 @@ def test_elastic_scale_up_mid_training(tmp_path, capfd):
     total = 60
     discovery = FixedHostDiscovery({"localhost": 1})
 
-    def mutate():
+    def mutate(job=None):
         time.sleep(2.0)
         discovery.set_hosts({"localhost": 2})
 
@@ -347,7 +386,7 @@ def test_elastic_xla_exec_scale_down_then_regrow(tmp_path, capfd):
             time.sleep(0.2)
         return False
 
-    def mutate():
+    def mutate(job=None):
         # Shrink only once the 2-process world is live (batches logged)
         # so the test exercises teardown of a FORMED world, not the
         # startup race (a shrink mid-formation resolves by worker

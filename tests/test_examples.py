@@ -17,6 +17,11 @@ _WORKER_ENV = {
 }
 
 
+# ~21s on the current box; the DistributedOptimizer path this script
+# drives has direct tier-1 coverage across test_torch_optimizer.py —
+# the end-to-end script smoke rides the slow tier (the jax example
+# below stays tier-1).
+@pytest.mark.slow
 def test_torch_mnist_example_2proc(capfd):
     run_command(
         [sys.executable, os.path.join(ROOT, "examples", "torch_mnist.py"),
@@ -111,6 +116,11 @@ def test_elastic_example_with_discovery(tmp_path):
     assert "FINAL err=" in proc.stdout
 
 
+# ~26s of XLA compiles; the SPMD/mesh math it exercises is pinned by
+# test_models/test_pipeline in tier-1 and the script-level launch
+# mechanics by the jax mnist example — the full pretrain-example smoke
+# rides the slow tier (budget).
+@pytest.mark.slow
 def test_lm_pretrain_example_spmd_mesh(tmp_path):
     """The in-jit SPMD example drives a 2x2x2 virtual mesh in one
     process (with an orbax checkpoint when available)."""

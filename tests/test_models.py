@@ -86,6 +86,12 @@ def test_transformer_train_step_runs_sharded(devices):
     assert float(loss2) < float(loss1)  # overfits constant batch
 
 
+# ~48s of CPU compile on the current CI box — the single heaviest
+# tier-1 test. Conv/BN layer coverage stays via the sync-BN tests and
+# the transformer train-step test below; the full resnet smoke runs
+# with the slow tier (tier-1 budget discipline, same precedent as
+# PR 1's redundant-variant moves).
+@pytest.mark.slow
 def test_resnet50_forward_and_grad():
     model = resnet50(num_classes=10, dtype=jnp.float32)
     x = jnp.ones((2, 32, 32, 3))

@@ -23,7 +23,11 @@ def _hvd_init():
     yield
 
 
-@pytest.mark.parametrize("np_", [2, 4])
+# np=4 re-proves the same cross-rank-stats math the np=2 run pins, at
+# ~41s vs ~19s on the current box — slow tier keeps the redundant
+# width, tier-1 keeps the gate.
+@pytest.mark.parametrize(
+    "np_", [2, pytest.param(4, marks=pytest.mark.slow)])
 def test_torch_sync_bn_matches_full_batch(np_):
     run_job("sync_bn", np_)
 

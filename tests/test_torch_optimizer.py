@@ -475,6 +475,12 @@ def _inplace_param_worker():
     return out
 
 
+# In-place broadcast onto live parameters is already pinned from two
+# sides kept in tier-1: broadcast_parameters semantics by
+# test_broadcast_parameters_and_optimizer_state_nonzero_root and the
+# in-place op family by test_inplace_ops_and_compression — this
+# variant's 2x-torch-spawn cost rides the slow tier (budget).
+@pytest.mark.slow
 def test_inplace_on_parameters():
     results = run(_inplace_param_worker, np=2, env=_WORKER_ENV,
                   start_timeout=90)

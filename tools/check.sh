@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# One-shot pre-PR gate (docs/development.md): default native build,
+# repo-invariant lint, clang thread-safety analysis when clang is
+# installed, and the fast correctness tests that guard the same
+# invariants dynamically. Seconds, not minutes — the sanitizer tier
+# (pytest -m slow tests/test_sanitizers.py) stays separate because it
+# rebuilds the core per variant and runs the multiprocess scenarios
+# under 5-15x slowdown.
+#
+# Usage: tools/check.sh [--no-tests]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+fail=0
+step() { echo; echo "==== $1"; }
+
+step "native build (default)"
+make -C native -j"$(nproc)"
+
+step "lint (tools/lint)"
+python3 tools/lint/run.py || fail=1
+
+step "thread-safety analysis (clang, optional)"
+make -C native tsa || fail=1
+
+if [[ "${1:-}" != "--no-tests" ]]; then
+  step "fast invariant tests"
+  # The lint self-tests (incl. real-tree-clean + bug injection) and the
+  # two-sided ABI pins — the dynamic halves of what lint checks
+  # statically. Everything here is tier-1-fast.
+  python3 -m pytest -q -p no:cacheprovider \
+      tests/test_lint.py tests/test_wire_abi.py tests/test_metrics_abi.py \
+      || fail=1
+fi
+
+echo
+if [[ $fail -ne 0 ]]; then
+  echo "check.sh: FAILED (see above)"
+  exit 1
+fi
+echo "check.sh: all gates green"

@@ -1,0 +1,26 @@
+"""Repo-invariant lint suite (pure stdlib, no external deps).
+
+Five classes of review-caught bugs from past PRs, converted into
+machine-caught ones (docs/development.md#lint-rules):
+
+  getenv        raw ``std::getenv`` outside ``env.h`` (every knob read
+                must go through the sanitized warn-once helpers)
+  knob-docs     a ``HOROVOD_*`` knob referenced in C++/Python that no
+                file under ``docs/`` documents
+  abi-literal   ABI/wire-version constants defined anywhere but
+                ``message.h``/``metrics.h`` and the ``basics.py`` pins,
+                or the two sides of a pin disagreeing
+  metric-sync   the metric enum in ``metrics.h`` drifting from the
+                name/kind tables in ``metrics.cc`` or from
+                ``docs/observability.md``'s catalog
+  doc-links     a relative markdown link in ``docs/``/``README.md``
+                whose target file does not exist
+
+Run standalone via ``tools/check.sh``, ``make -C native lint`` or
+``python3 tools/lint/run.py [root]``; in tier-1 via
+``tests/test_lint.py`` (which also bug-injects each rule to prove it
+fires). Every rule takes the repo root as a parameter so the tests can
+point it at a synthetic tree.
+"""
+
+from tools.lint.rules import ALL_RULES, Finding, run_all  # noqa: F401

@@ -1,0 +1,349 @@
+"""The lint rules. Pure stdlib; every rule is a function
+``rule(root) -> list[Finding]`` registered in ALL_RULES, and every rule
+is bug-injection-verified by tests/test_lint.py (a rule that cannot be
+shown to fire is a rule that silently rotted).
+
+Speed matters: the suite runs inside tier-1 (tests/test_lint.py budget
+<5s for the whole module), so each rule does one pass over the files it
+needs and nothing spawns a subprocess.
+"""
+
+import os
+import re
+from typing import Callable, Dict, List, NamedTuple
+
+
+class Finding(NamedTuple):
+    rule: str
+    path: str       # repo-relative
+    line: int       # 1-based; 0 when the finding is file-scoped
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def _read(root: str, rel: str) -> str:
+    with open(os.path.join(root, rel), "r", encoding="utf-8",
+              errors="replace") as f:
+        return f.read()
+
+
+def _walk(root: str, subdir: str, exts) -> List[str]:
+    """Repo-relative paths under subdir with one of the extensions,
+    skipping build outputs and caches."""
+    out = []
+    top = os.path.join(root, subdir)
+    skip = {"build", "build-tsan", "build-asan", "build-ubsan",
+            "__pycache__", ".git", ".pytest_cache"}
+    for dirpath, dirnames, filenames in os.walk(top):
+        dirnames[:] = [d for d in dirnames if d not in skip]
+        for fn in filenames:
+            if os.path.splitext(fn)[1] in exts:
+                out.append(os.path.relpath(os.path.join(dirpath, fn), root))
+    return sorted(out)
+
+
+# ---------------------------------------------------------------- getenv
+
+GETENV_WHITELIST = "tools/lint/getenv_whitelist.txt"
+# The one sanctioned location: every env read funnels through the
+# sanitized warn-once helpers here (see env.h's header comment).
+_GETENV_HOME = "native/include/hvd/env.h"
+_GETENV_RE = re.compile(r"\bgetenv\s*\(")
+
+
+def _load_whitelist(root: str) -> Dict[str, str]:
+    """path -> justification. Format: one ``path  # why`` per line;
+    blank lines and full-line comments ignored. A justification is
+    REQUIRED — an unexplained entry is itself a finding."""
+    wl: Dict[str, str] = {}
+    p = os.path.join(root, GETENV_WHITELIST)
+    if not os.path.exists(p):
+        return wl
+    for ln in open(p, encoding="utf-8"):
+        ln = ln.strip()
+        if not ln or ln.startswith("#"):
+            continue
+        path, _, why = ln.partition("#")
+        wl[path.strip()] = why.strip()
+    return wl
+
+
+def rule_getenv(root: str) -> List[Finding]:
+    """No raw getenv outside env.h: atoi/atof on a raw read silently
+    maps garbage to 0 (a LIVE value for several knobs), and scattered
+    reads let consumers of one knob disagree. env.h's helpers parse
+    once, validate, and warn-once."""
+    out: List[Finding] = []
+    wl = _load_whitelist(root)
+    for path, why in wl.items():
+        if not why:
+            out.append(Finding("getenv", GETENV_WHITELIST, 0,
+                               f"whitelist entry {path!r} carries no "
+                               "justification comment"))
+    for rel in _walk(root, "native", {".cc", ".h"}):
+        if rel == _GETENV_HOME or rel in wl:
+            continue
+        for i, ln in enumerate(_read(root, rel).splitlines(), 1):
+            if _GETENV_RE.search(ln) and "//" not in ln.split("getenv")[0]:
+                out.append(Finding(
+                    "getenv", rel, i,
+                    "raw getenv outside env.h — use EnvInt64Sane/"
+                    "EnvDoubleSane/EnvChoiceSane/EnvStr/EnvFlag "
+                    f"(or whitelist in {GETENV_WHITELIST} with a reason)"))
+    return out
+
+
+# -------------------------------------------------------------- knob-docs
+
+_KNOB_RE = re.compile(r"""["'](HOROVOD_[A-Z0-9_]+)["']""")
+# Scanned surfaces: the operator-facing runtime. tests/ deliberately
+# excluded — every knob a test sets must already exist in one of these.
+_KNOB_DIRS = (("native", {".cc", ".h"}),
+              ("horovod_tpu", {".py"}),
+              ("bin", {".py", ""}),
+              ("examples", {".py"}))
+
+
+def rule_knob_docs(root: str) -> List[Finding]:
+    """Every HOROVOD_* knob referenced by the runtime is documented
+    somewhere under docs/ (or README.md). An undocumented knob is
+    invisible to operators and rots into folklore."""
+    documented = set()
+    for rel in _walk(root, "docs", {".md"}) + (
+            ["README.md"] if os.path.exists(
+                os.path.join(root, "README.md")) else []):
+        documented.update(
+            re.findall(r"HOROVOD_[A-Z0-9_]+", _read(root, rel)))
+    out: List[Finding] = []
+    seen = set()
+    for subdir, exts in _KNOB_DIRS:
+        if not os.path.isdir(os.path.join(root, subdir)):
+            continue
+        for rel in _walk(root, subdir, exts):
+            for i, ln in enumerate(_read(root, rel).splitlines(), 1):
+                for knob in _KNOB_RE.findall(ln):
+                    if knob in documented or knob in seen:
+                        continue
+                    seen.add(knob)
+                    out.append(Finding(
+                        "knob-docs", rel, i,
+                        f"{knob} referenced here but documented nowhere "
+                        "under docs/ — add it to the knob catalog "
+                        "(docs/development.md) or the feature's page"))
+    return out
+
+
+# ------------------------------------------------------------ abi-literal
+
+# (constant name, sole C++ definition site) — the single-source-of-truth
+# discipline test_wire_abi.py/test_metrics_abi.py enforce dynamically,
+# here as a static rule so a stray duplicate fails `make lint` too.
+_CC_PINS = {
+    "kAbiVersion": "native/include/hvd/message.h",
+    "kWireVersionRequestList": "native/include/hvd/message.h",
+    "kWireVersionResponseList": "native/include/hvd/message.h",
+    "kMetricsVersion": "native/include/hvd/metrics.h",
+}
+_PY_PINS = {
+    "ABI_VERSION": "horovod_tpu/common/basics.py",
+    "WIRE_VERSION_REQUEST_LIST": "horovod_tpu/common/basics.py",
+    "WIRE_VERSION_RESPONSE_LIST": "horovod_tpu/common/basics.py",
+    "METRICS_VERSION": "horovod_tpu/common/basics.py",
+}
+# C++ pin <-> Python pin value equality.
+_PIN_PAIRS = [("kAbiVersion", "ABI_VERSION"),
+              ("kWireVersionRequestList", "WIRE_VERSION_REQUEST_LIST"),
+              ("kWireVersionResponseList", "WIRE_VERSION_RESPONSE_LIST"),
+              ("kMetricsVersion", "METRICS_VERSION")]
+
+
+def _cc_def_re(name: str) -> re.Pattern:
+    return re.compile(
+        r"(?:constexpr|const|#define)\s+(?:int\s+)?" + name +
+        r"\s*=?\s*(\d+)")
+
+
+def _py_def_re(name: str) -> re.Pattern:
+    return re.compile(r"^\s*" + name + r"\s*=\s*(\d+)\b")
+
+
+def rule_abi_literal(root: str) -> List[Finding]:
+    """ABI/wire/metrics version constants are defined in exactly one
+    C++ header and pinned in exactly one Python module, and the two
+    sides agree. A duplicated literal is how a bump forks."""
+    out: List[Finding] = []
+    values: Dict[str, int] = {}
+    for name, home in _CC_PINS.items():
+        pat = _cc_def_re(name)
+        for rel in _walk(root, "native", {".cc", ".h"}):
+            for i, ln in enumerate(_read(root, rel).splitlines(), 1):
+                m = pat.search(ln)
+                if not m:
+                    continue
+                if rel != home:
+                    out.append(Finding(
+                        "abi-literal", rel, i,
+                        f"{name} defined outside its home {home} — "
+                        "reference the constant instead"))
+                else:
+                    values[name] = int(m.group(1))
+    for name, home in _PY_PINS.items():
+        pat = _py_def_re(name)
+        for subdir in ("horovod_tpu", "bin", "examples"):
+            if not os.path.isdir(os.path.join(root, subdir)):
+                continue
+            for rel in _walk(root, subdir, {".py"}):
+                for i, ln in enumerate(_read(root, rel).splitlines(), 1):
+                    m = pat.match(ln)
+                    if not m:
+                        continue
+                    if rel != home:
+                        out.append(Finding(
+                            "abi-literal", rel, i,
+                            f"{name} assigned outside its home {home} — "
+                            "import the pin instead"))
+                    else:
+                        values[name] = int(m.group(1))
+    for cc, py in _PIN_PAIRS:
+        if cc in values and py in values and values[cc] != values[py]:
+            out.append(Finding(
+                "abi-literal", _PY_PINS[py], 0,
+                f"pin mismatch: {cc}={values[cc]} ({_CC_PINS[cc]}) but "
+                f"{py}={values[py]}"))
+        elif cc not in values or py not in values:
+            missing = cc if cc not in values else py
+            out.append(Finding(
+                "abi-literal",
+                _CC_PINS.get(missing) or _PY_PINS.get(missing), 0,
+                f"expected pin {missing} not found at its home"))
+    return out
+
+
+# ------------------------------------------------------------ metric-sync
+
+_METRICS_H = "native/include/hvd/metrics.h"
+_METRICS_CC = "native/src/metrics.cc"
+_METRICS_DOC = "docs/observability.md"
+
+
+def _enum_idents(text: str, enum_name: str, terminator: str) -> List[str]:
+    body = text.split(f"enum {enum_name}", 1)[1]
+    body = body[:body.index("};")]  # the terminator line has no comma
+    idents = []
+    for m in re.finditer(r"^\s*(k[A-Za-z0-9]+)\s*(?:=\s*\d+\s*)?,", body,
+                         re.MULTILINE):
+        if m.group(1) == terminator:
+            break
+        idents.append(m.group(1))
+    return idents
+
+
+def _name_table(text: str, table: str) -> List[str]:
+    body = text.split(table, 1)[1]
+    body = body[:body.index("};")]
+    return re.findall(r'"([a-z0-9_]+)"', body)
+
+
+def _doc_metric_tokens(doc: str) -> set:
+    """Metric names the catalog documents, with one level of
+    ``prefix_{a,b,c}_suffix`` brace-family expansion (the catalog
+    documents op-type/phase families on one row)."""
+    toks = set(re.findall(r"[a-z][a-z0-9_]+", doc))
+    for m in re.finditer(r"([a-z0-9_]*)\{([a-z0-9_,]+)\}([a-z0-9_]*)", doc):
+        for alt in m.group(2).split(","):
+            toks.add(m.group(1) + alt + m.group(3))
+    return toks
+
+
+def rule_metric_sync(root: str) -> List[Finding]:
+    """The metric enums (metrics.h), the name tables (metrics.cc) and
+    the catalog (docs/observability.md) describe the same series. The
+    static_asserts catch length drift at compile time; this rule also
+    catches it before a compile, plus duplicate names and names missing
+    from the catalog (an undocumented series is invisible to the
+    operators the registry exists for)."""
+    out: List[Finding] = []
+    try:
+        h = _read(root, _METRICS_H)
+        cc = _read(root, _METRICS_CC)
+    except FileNotFoundError as e:
+        return [Finding("metric-sync", str(e.filename), 0,
+                        "metrics source missing")]
+    doc_exists = os.path.exists(os.path.join(root, _METRICS_DOC))
+    doc_toks = (_doc_metric_tokens(_read(root, _METRICS_DOC))
+                if doc_exists else set())
+    pairs = [("MetricCounter", "kNumMetricCounters", "kCounterNames"),
+             ("MetricHistogram", "kNumMetricHistograms", "kHistNames")]
+    for enum_name, term, table in pairs:
+        idents = _enum_idents(h, enum_name, term)
+        names = _name_table(cc, table)
+        if len(idents) != len(names):
+            out.append(Finding(
+                "metric-sync", _METRICS_CC, 0,
+                f"{table} has {len(names)} entries but enum {enum_name} "
+                f"has {len(idents)} — the tables must stay in lockstep"))
+        dupes = {n for n in names if names.count(n) > 1}
+        for d in sorted(dupes):
+            out.append(Finding(
+                "metric-sync", _METRICS_CC, 0,
+                f"duplicate metric name {d!r} in {table}"))
+        for n in names:
+            # Histogram series surface as <name>_count/_sum/... in the
+            # flat dict; the catalog documents the base name (possibly
+            # as a {a,b,c} family row).
+            if doc_exists and n not in doc_toks:
+                out.append(Finding(
+                    "metric-sync", _METRICS_DOC, 0,
+                    f"metric {n!r} ({table}) missing from the "
+                    "observability catalog"))
+    return out
+
+
+# -------------------------------------------------------------- doc-links
+
+_MD_LINK_RE = re.compile(r"\[[^\]]*\]\(([^)\s]+)\)")
+
+
+def rule_doc_links(root: str) -> List[Finding]:
+    """Relative markdown links under docs/ (and README.md) resolve to
+    real files. Every past doc refactor has orphaned at least one
+    cross-link; dead links in the docs we point users at are worse than
+    no link."""
+    out: List[Finding] = []
+    pages = _walk(root, "docs", {".md"})
+    if os.path.exists(os.path.join(root, "README.md")):
+        pages.append("README.md")
+    for rel in pages:
+        base = os.path.dirname(os.path.join(root, rel))
+        for i, ln in enumerate(_read(root, rel).splitlines(), 1):
+            for target in _MD_LINK_RE.findall(ln):
+                if re.match(r"[a-z]+:", target):    # http:, https:, mailto:
+                    continue
+                path = target.split("#", 1)[0]
+                if not path:                        # same-page anchor
+                    continue
+                if not os.path.exists(os.path.join(base, path)):
+                    out.append(Finding(
+                        "doc-links", rel, i,
+                        f"dead link: {target!r} does not resolve"))
+    return out
+
+
+ALL_RULES: Dict[str, Callable[[str], List[Finding]]] = {
+    "getenv": rule_getenv,
+    "knob-docs": rule_knob_docs,
+    "abi-literal": rule_abi_literal,
+    "metric-sync": rule_metric_sync,
+    "doc-links": rule_doc_links,
+}
+
+
+def run_all(root: str, only=None) -> List[Finding]:
+    findings: List[Finding] = []
+    for name, rule in ALL_RULES.items():
+        if only and name not in only:
+            continue
+        findings.extend(rule(root))
+    return findings

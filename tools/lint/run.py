@@ -1,0 +1,41 @@
+#!/usr/bin/env python3
+"""Standalone entry point: ``python3 tools/lint/run.py [root] [--only
+rule,rule]``. Exit 0 on a clean tree, 1 with one finding per line
+otherwise. Wired into ``make -C native lint`` and ``tools/check.sh``;
+tier-1 runs the same rules via tests/test_lint.py."""
+
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(_HERE)))
+
+from tools.lint.rules import run_all  # noqa: E402
+
+
+def main(argv) -> int:
+    root = os.path.dirname(os.path.dirname(_HERE))
+    only = None
+    args = [a for a in argv[1:]]
+    while args:
+        a = args.pop(0)
+        if a == "--only":
+            if not args:
+                print("usage: run.py [root] [--only rule,rule]",
+                      file=sys.stderr)
+                return 1
+            only = set(args.pop(0).split(","))
+        else:
+            root = a
+    findings = run_all(root, only=only)
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"lint: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    print("lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
