@@ -59,6 +59,15 @@ BUS_FUSED_KB = 64
 # codec under the same interference.
 BUS_WIRE_MB = 16
 BUS_WIRE_ROUNDS = 8
+# Collective-algorithm case (perf_tuning.md HOROVOD_COLLECTIVE_ALGO):
+# ring vs halving-doubling vs multi-ring striping on the TCP plane at
+# one latency-bound payload (64 KB — where hd's 2·log2 P steps beat the
+# ring's 2(P-1)) and one bandwidth-bound payload (16 MB). Algorithm
+# rounds are INTERLEAVED like the codec rounds: sequential per-arm
+# blocks drift ±30% on this timeshared box (docs/perf_tuning.md).
+BUS_ALGO_SIZES = ((64 * 1024, "64KB", 30), ((16 << 20), "16MB", 3))
+BUS_ALGO_ROUNDS = 6
+BUS_ALGO_ARMS = ("ring", "hd", "striped")
 
 
 def _bus_worker():
@@ -187,6 +196,60 @@ def _bus_wire_worker():
     hvd.shutdown()
 
 
+def _bus_algo_worker():
+    """Per-rank body of the algorithm-selection busbw case: one TCP
+    job, each payload size measured under every algorithm arm with the
+    arms round-robined (best round per arm). Rank 0 also dumps the
+    default selection table for this np so the bench record shows WHAT
+    the auto path would pick alongside how each arm measured."""
+    import ctypes
+
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.common.basics import get_lib
+
+    hvd.init()
+    r, s = hvd.rank(), hvd.size()
+    best = {}
+    for n_bytes, label, iters in BUS_ALGO_SIZES:
+        n = n_bytes // 4
+        x = np.ones(n, np.float32)
+        for a in BUS_ALGO_ARMS:
+            for _ in range(2):
+                hvd.allreduce(x, op=hvd.Sum, name=f"ba.{label}.{a}",
+                              algorithm=a)
+        for _ in range(BUS_ALGO_ROUNDS):
+            for a in BUS_ALGO_ARMS:
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    hvd.allreduce(x, op=hvd.Sum, name=f"ba.{label}.{a}",
+                                  algorithm=a)
+                dt = time.perf_counter() - t0
+                key = (label, a)
+                best[key] = min(best.get(key, dt), dt)
+    if r == 0:
+        lib = get_lib()
+        results = {a: {} for a in BUS_ALGO_ARMS}
+        for n_bytes, label, iters in BUS_ALGO_SIZES:
+            for a in BUS_ALGO_ARMS:
+                bw = (n_bytes * iters / best[(label, a)]) / 1e9
+                results[a][label] = round(bw * 2 * (s - 1) / s, 3)
+        # Default selection table for this np (the auto path's verdict
+        # per log2 payload bucket, at the default ring threshold).
+        table = {}
+        for lg in range(10, 27):
+            algo = lib.hvd_algo_select(ctypes.c_int64(1 << lg), s, 0,
+                                       ctypes.c_int64(256 * 1024))
+            table[f"{1 << lg}"] = lib.hvd_algo_name(algo).decode()
+        results["table"] = table
+        print("ALGO-TABLE np=%d: %s" % (
+            s, ", ".join(f"{int(k)//1024}KB={v}" for k, v in table.items())),
+            flush=True)
+        print("BUSALGO " + json.dumps(results), flush=True)
+    hvd.shutdown()
+
+
 def _bus_job(flag, tag, extra_env=None, timeout=120):
     """Launch one np=4 host-plane microbenchmark job (`bench.py
     <flag>`) and return rank 0's parsed "<tag> {json}" payload, or
@@ -248,6 +311,13 @@ def _bus_wire_bandwidth():
     codecs actually touch the wire); {codec: GB/s, ratio: {...}}."""
     return _bus_job("--bus-wire-worker", "BUSWIRE",
                     extra_env={"HOROVOD_SHM_DISABLE": "1"}, timeout=150)
+
+
+def _bus_algo_bandwidth():
+    """The np=4 TCP algorithm-selection job (shm disabled so the
+    algorithms actually run the mesh); {algo: {size: GB/s}, table}."""
+    return _bus_job("--bus-algo-worker", "BUSALGO",
+                    extra_env={"HOROVOD_SHM_DISABLE": "1"}, timeout=180)
 
 
 def _transformer_worker():
@@ -622,6 +692,21 @@ def main():
                 f"{BUS_WIRE_MB}MB_none_ref": wire.get("none"),
             }
             extra["wire_compression_ratio"] = ratio
+    # Collective-algorithm arms (HOROVOD_COLLECTIVE_ALGO / the
+    # selection table): per-algorithm busbw at a latency-bound and a
+    # bandwidth-bound payload, measured under the same interleaved
+    # protocol, plus the table's auto verdict per payload bucket.
+    if (extras_on and os.environ.get("BENCH_SKIP_BUS") != "1"
+            and budget - (time.perf_counter() - _T0) > 180):
+        algo = _bus_algo_bandwidth()
+        if algo is not None:
+            table = algo.pop("table", None)
+            for arm, vals in algo.items():
+                extra[f"host_allreduce_busbw_{arm}_gbps_np4"] = vals
+            if table:
+                # Strings, so the regression gate ignores them — the
+                # record simply shows what auto would pick per bucket.
+                extra["collective_algo_table_np4"] = table
     remaining = budget - (time.perf_counter() - _T0)
     if extras_on and remaining > 30:
         tf = _transformer_extra(remaining)
@@ -660,6 +745,8 @@ if __name__ == "__main__":
         _bus_worker()
     elif "--bus-wire-worker" in sys.argv:
         _bus_wire_worker()
+    elif "--bus-algo-worker" in sys.argv:
+        _bus_algo_worker()
     elif "--transformer-worker" in sys.argv:
         _transformer_worker()
     elif "--serve-worker" in sys.argv:

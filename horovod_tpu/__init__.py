@@ -58,6 +58,7 @@ from horovod_tpu.api import (  # noqa: F401
     cross_size,
     reduce_threads,
     set_reduce_threads,
+    collective_algo,
     allreduce,
     allreduce_async,
     grouped_allreduce,

@@ -155,27 +155,35 @@ def allreduce_async(tensor, average: Optional[bool] = None,
                     name: Optional[str] = None, op: Optional[ReduceOp] = None,
                     prescale_factor: float = 1.0,
                     postscale_factor: float = 1.0,
-                    compression=None) -> Handle:
+                    compression=None, algorithm=None) -> Handle:
     """``compression`` (a ``hvd.Compression`` member) selects the
     native TCP data plane's on-the-wire codec for this op — e.g.
     ``hvd.Compression.int8`` ships blockwise-quantized bytes with
     error feedback while the user-visible tensor stays full precision.
-    ``None`` follows the job-wide ``HOROVOD_WIRE_COMPRESSION`` knob;
-    see ``docs/perf_tuning.md``."""
+    ``None`` follows the job-wide ``HOROVOD_WIRE_COMPRESSION`` knob.
+
+    ``algorithm`` forces the TCP-plane exchange for this op: one of
+    ``"ring"``, ``"hd"`` (recursive halving-doubling), ``"striped"``
+    (multi-ring striping), ``"doubling"``, ``"hier"``. ``None`` follows
+    the coordinator's per-(payload, np, topology) selection table (or
+    the job-wide ``HOROVOD_COLLECTIVE_ALGO`` force). The coordinator
+    resolves the final algorithm into each response, so every rank
+    always runs the same exchange. See ``docs/perf_tuning.md``."""
     rt = get_runtime()
     return rt.enqueue(
         basics.OP_ALLREDUCE, tensor, rt.auto_name("allreduce", name),
         reduce_op=_resolve_op(op, average), prescale_factor=prescale_factor,
-        postscale_factor=postscale_factor, compression=compression)
+        postscale_factor=postscale_factor, compression=compression,
+        algorithm=algorithm)
 
 
 def allreduce(tensor, average: Optional[bool] = None,
               name: Optional[str] = None, op: Optional[ReduceOp] = None,
               prescale_factor: float = 1.0, postscale_factor: float = 1.0,
-              compression=None):
+              compression=None, algorithm=None):
     return synchronize(allreduce_async(tensor, average, name, op,
                                        prescale_factor, postscale_factor,
-                                       compression))
+                                       compression, algorithm))
 
 
 def grouped_allreduce_async(tensors: Sequence, average: Optional[bool] = None,
@@ -183,12 +191,14 @@ def grouped_allreduce_async(tensors: Sequence, average: Optional[bool] = None,
                             op: Optional[ReduceOp] = None,
                             prescale_factor: float = 1.0,
                             postscale_factor: float = 1.0,
-                            compression=None) -> List[Handle]:
+                            compression=None,
+                            algorithm=None) -> List[Handle]:
     """Atomic multi-tensor allreduce (reference
     ``EnqueueTensorAllreduces``, ``operations.cc:943`` + GroupTable).
     The member names are hashed into a rank-invariant group key.
-    ``compression`` rides every member (the coordinator only fuses
-    matching codecs, so the group stays one response)."""
+    ``compression`` and ``algorithm`` ride every member (the
+    coordinator only fuses matching settings, so the group stays one
+    response)."""
     rt = get_runtime()
     reduce_op = _resolve_op(op, average)
     base = rt.auto_name("grouped_allreduce", name)
@@ -199,7 +209,7 @@ def grouped_allreduce_async(tensors: Sequence, average: Optional[bool] = None,
                    prescale_factor=prescale_factor,
                    postscale_factor=postscale_factor,
                    group_key=key, group_size=len(tensors),
-                   compression=compression)
+                   compression=compression, algorithm=algorithm)
         for t, nm in zip(tensors, names)
     ]
 
@@ -209,11 +219,21 @@ def grouped_allreduce(tensors: Sequence, average: Optional[bool] = None,
                       op: Optional[ReduceOp] = None,
                       prescale_factor: float = 1.0,
                       postscale_factor: float = 1.0,
-                      compression=None) -> List:
+                      compression=None, algorithm=None) -> List:
     handles = grouped_allreduce_async(tensors, average, name, op,
                                       prescale_factor, postscale_factor,
-                                      compression)
+                                      compression, algorithm)
     return [synchronize(h) for h in handles]
+
+
+def collective_algo() -> str:
+    """The live job-wide collective-algorithm force for the TCP data
+    plane, as a name (``"auto"`` = the per-(payload, np, topology)
+    selection table decides per response). Reflects
+    ``HOROVOD_COLLECTIVE_ALGO`` after the coordinator param sync plus
+    any autotuner retarget."""
+    lib = basics.get_lib()
+    return lib.hvd_algo_name(lib.hvd_collective_algo()).decode()
 
 
 def _group_key(names: Sequence[str]) -> int:

@@ -38,15 +38,15 @@ EXEC_CALLBACK = 1
 # is enforced at library load below, and tests/test_wire_abi.py greps
 # the header so a native bump can't silently skew this shim even
 # before a rebuild happens.
-ABI_VERSION = 6
-WIRE_VERSION_REQUEST_LIST = 2
-WIRE_VERSION_RESPONSE_LIST = 5
+ABI_VERSION = 7
+WIRE_VERSION_REQUEST_LIST = 3
+WIRE_VERSION_RESPONSE_LIST = 6
 
 # Metrics snapshot layout version (native/include/hvd/metrics.h
 # kMetricsVersion): the packed int64 layout hvd_metrics_snapshot
 # writes. Checked at library load AND against the header by
 # tests/test_metrics_abi.py, the same two-sided pin as the ABI above.
-METRICS_VERSION = 1
+METRICS_VERSION = 2
 
 # Native WireCodec ids (native/include/hvd/codec.h); -1 = follow the
 # job-wide HOROVOD_WIRE_COMPRESSION default.
@@ -55,6 +55,36 @@ WIRE_CODEC_NONE = 0
 WIRE_CODEC_BF16 = 1
 WIRE_CODEC_FP16 = 2
 WIRE_CODEC_INT8 = 3
+
+# Native CollectiveAlgo ids (native/include/hvd/schedule.h); 0 = follow
+# the coordinator's selection table / HOROVOD_COLLECTIVE_ALGO. Name
+# order mirrors kCollectiveAlgoNames.
+COLLECTIVE_ALGOS = {
+    "auto": 0,
+    "ring": 1,
+    "hd": 2,
+    "striped": 3,
+    "doubling": 4,
+    "hier": 5,
+}
+
+
+def collective_algo_id(algorithm) -> int:
+    """Map an ``algorithm=`` kwarg (name string, native id, or None) to
+    the native CollectiveAlgo id."""
+    if algorithm is None:
+        return 0
+    if isinstance(algorithm, str):
+        try:
+            return COLLECTIVE_ALGOS[algorithm]
+        except KeyError:
+            raise ValueError(
+                f"unknown collective algorithm {algorithm!r}; want one of "
+                f"{sorted(COLLECTIVE_ALGOS)}") from None
+    a = int(algorithm)
+    if not 0 <= a < len(COLLECTIVE_ALGOS):
+        raise ValueError(f"collective algorithm id {a} out of range")
+    return a
 
 # numpy dtype -> native DataType id (native/include/hvd/common.h).
 _DTYPE_MAP = {
@@ -183,6 +213,7 @@ def _declare_abi(lib: ctypes.CDLL, path: str) -> ctypes.CDLL:
         ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_double,
         ctypes.c_double, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
         ctypes.c_int, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int,
     ]
     lib.hvd_last_enqueue_error.restype = ctypes.c_char_p
     lib.hvd_join.restype = ctypes.c_int64
@@ -271,6 +302,21 @@ def _declare_abi(lib: ctypes.CDLL, path: str) -> ctypes.CDLL:
     lib.hvd_wire_decode_add.restype = None
     lib.hvd_wire_decode_add.argtypes = [ctypes.c_int, ctypes.c_void_p,
                                         ctypes.c_int64, ctypes.c_void_p]
+    # Schedule-interpreter surface (docs/perf_tuning.md "Collective
+    # algorithm selection"): chunk-op table builder + the default
+    # selection table, both pure functions — the simulator tests and
+    # bench.py's table dump drive them without spawning ranks.
+    lib.hvd_build_schedule.restype = ctypes.c_int
+    lib.hvd_build_schedule.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int]
+    lib.hvd_algo_select.restype = ctypes.c_int
+    lib.hvd_algo_select.argtypes = [ctypes.c_int64, ctypes.c_int,
+                                    ctypes.c_int, ctypes.c_int64]
+    lib.hvd_algo_name.restype = ctypes.c_char_p
+    lib.hvd_algo_name.argtypes = [ctypes.c_int]
+    lib.hvd_collective_algo.restype = ctypes.c_int
     return lib
 
 

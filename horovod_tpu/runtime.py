@@ -402,12 +402,18 @@ class Runtime:
                 splits=None,
                 group_key: int = -1,
                 group_size: int = 0,
-                compression=None) -> Handle:
+                compression=None,
+                algorithm=None) -> Handle:
         self._check_init()
         # Per-op wire codec for the host TCP data plane (-1 = follow
         # HOROVOD_WIRE_COMPRESSION). CALLBACK (XLA) responses ignore it
         # — device collectives ride ICI at their own dtype.
         wire_codec = wire_codec_id(compression)
+        # Per-op allreduce algorithm (0 = follow the coordinator's
+        # selection table / HOROVOD_COLLECTIVE_ALGO); resolved into
+        # each response like the wire codec, so mixed per-rank settings
+        # are a coordinator error, never a desynced exchange.
+        collective_algo = basics.collective_algo_id(algorithm)
         kind, np_in, dev_in = self._classify(tensor)
 
         st = _InFlight()
@@ -475,7 +481,7 @@ class Runtime:
                 op, name.encode(), dt, shape_arr, len(shape), data_ptr,
                 out_ptr, root_rank, int(reduce_op), prescale_factor,
                 postscale_factor, splits_arr, nsplits, exec_mode,
-                group_key, group_size, wire_codec)
+                group_key, group_size, wire_codec, collective_algo)
             if handle < 0:
                 err = self.lib.hvd_last_enqueue_error().decode()
                 raise HorovodInternalError(err)

@@ -158,6 +158,11 @@ struct TensorTableEntry {
   // to a concrete codec by the coordinator so every rank encodes and
   // decodes one response identically.
   int8_t wire_codec = -1;
+  // Requested TCP-plane allreduce algorithm (hvd/schedule.h values);
+  // 0 = follow the coordinator's selection table /
+  // HOROVOD_COLLECTIVE_ALGO. Resolved into each Response like the
+  // wire codec.
+  int8_t collective_algo = 0;
 };
 
 // Named timeline activities (reference common/common.h:33-64).

@@ -116,11 +116,14 @@ class Controller {
   int cross_size_ = 1;
   ControllerDeps deps_;
   int64_t fusion_threshold_bytes_ = 64 * 1024 * 1024;
-  // Host data plane: payloads at/above this use ring allreduce, below
-  // it recursive doubling. The CHOICE must agree on every rank (mixed
-  // algorithms deadlock), so TcpController::Initialize syncs rank 0's
-  // value to all workers — env divergence cannot split the job.
-  int64_t ring_threshold_bytes_ = 64 * 1024;
+  // Host data plane: payloads at/above this ride the selection
+  // table's ring/hier (bandwidth) band; below it the hd/doubling
+  // latency band (hvd/schedule.h ResolveAlgoDefault). Default seeded
+  // from the np=4 interleaved calibration sweep: halving-doubling
+  // beats the ring up through ~512 KB on loopback, so the ring band
+  // starts at 256 KB (docs/perf_tuning.md). Synced rank 0 -> workers
+  // AND resolved per response, so env divergence cannot split the job.
+  int64_t ring_threshold_bytes_ = 256 * 1024;
   bool hierarchical_ = false;
   bool hierarchical_fit_ = false;
   bool shm_enabled_ = false;
@@ -129,6 +132,12 @@ class Controller {
   int shm_segment_depth_ = 2;
   int reduce_threads_ = 1;
   int wire_codec_ = 0;  // hvd/codec.h WireCodec value (0 = none)
+  // Job-wide allreduce-algorithm force (hvd/schedule.h CollectiveAlgo;
+  // 0 = auto, i.e. the per-(payload, np, topology) selection table
+  // decides per response). Seeded from HOROVOD_COLLECTIVE_ALGO, synced
+  // like the thresholds, retargetable live by the autotuner's
+  // algorithm dimension.
+  int collective_algo_ = 0;
 
  public:
   void SetFusionThreshold(int64_t bytes) { fusion_threshold_bytes_ = bytes; }
@@ -166,6 +175,19 @@ class Controller {
   // Retargetable live by the autotuner through the tuned broadcast.
   void SetWireCodec(int c) { wire_codec_ = c < 0 ? 0 : (c > 3 ? 3 : c); }
   int wire_codec() const { return wire_codec_; }
+  // Allreduce-algorithm force (0 = selection table). Synced like the
+  // wire codec; the coordinator resolves the effective algorithm INTO
+  // each Response, so a per-rank divergence of this knob can never
+  // split the exchange.
+  void SetCollectiveAlgo(int a) {
+    collective_algo_ = a < 0 ? 0 : (a > 5 ? 0 : a);
+  }
+  int collective_algo() const { return collective_algo_; }
+  // Resolve the algorithm for one ALLREDUCE response: request override
+  // > job-wide force (env / autotuner) > the default table — every
+  // input coordinator-side or synced, so the verdict is job-unique.
+  int ResolveCollectiveAlgo(int request_algo, int64_t payload_bytes,
+                            int ncontributors) const;
   // Hierarchical allreduce: rank 0's env decides the request; the
   // value is only TRUE after Initialize when every rank's topology
   // fits the node-major layout (the verdict is broadcast — a per-rank
@@ -194,7 +216,8 @@ class Controller {
   void StageTunedParams(int64_t fusion, double cycle_ms,
                         int hierarchical = -1, int cache = -1,
                         int shm = -1, int reduce_threads = 0,
-                        int seg_depth = 0, int wire_codec = -1) {
+                        int seg_depth = 0, int wire_codec = -1,
+                        int collective_algo = -1) {
     staged_fusion_ = fusion;
     staged_cycle_ms_ = cycle_ms;
     staged_hier_ = hierarchical;
@@ -203,6 +226,7 @@ class Controller {
     staged_threads_ = reduce_threads;
     staged_depth_ = seg_depth;
     staged_wire_ = wire_codec;
+    staged_algo_ = collective_algo;
   }
   // Autotuned runtime switches consulted by the data plane / cache
   // path each cycle (distinct from the INIT verdicts shm_enabled()
@@ -228,6 +252,7 @@ class Controller {
   int staged_threads_ = 0;  // 0 = no change
   int staged_depth_ = 0;    // 0 = no change
   int staged_wire_ = -1;    // -1 = no change
+  int staged_algo_ = -1;    // -1 = no change, 0 = back to the table
   bool cache_active_ = true;
   bool shm_active_ = true;
 };

@@ -21,14 +21,22 @@ namespace hvd {
 // here so a bump is one edit — and guarded by tests/test_wire_abi.py,
 // which asserts the Python side expects the same numbers (a native
 // bump can't silently skew the shim).
+// RequestList v3 / ResponseList v6: Request/Response carry
+// collective_algo (the TCP-plane allreduce algorithm — request wish /
+// coordinator-resolved verdict, hvd/schedule.h ids) and ResponseList
+// carries tuned_collective_algo for the autotuner's algorithm
+// dimension.
 // v5: Request/Response carry wire_codec; ResponseList carries
 // tuned_wire_codec; hvd_enqueue gained the wire_codec argument.
+// ABI v7: hvd_enqueue gained the collective_algo argument; schedule
+// builder/table entry points (hvd_build_schedule, hvd_algo_select,
+// hvd_algo_name, hvd_collective_algo).
 // ABI v6 (wire formats unchanged): metrics snapshot/name-table entry
 // points (hvd/metrics.h; snapshot layout versioned by kMetricsVersion),
 // hvd_stalled_tensors, and hvd_start_timeline returning an error code.
-constexpr int kWireVersionRequestList = 2;
-constexpr int kWireVersionResponseList = 5;
-constexpr int kAbiVersion = 6;
+constexpr int kWireVersionRequestList = 3;
+constexpr int kWireVersionResponseList = 6;
+constexpr int kAbiVersion = 7;
 
 enum class RequestType : uint8_t {
   ALLREDUCE = 0,
@@ -65,6 +73,11 @@ struct Request {
   // the coordinator's HOROVOD_WIRE_COMPRESSION value, 0-3 = explicit
   // per-op override (hvd.allreduce(..., compression=...)).
   int8_t wire_codec = -1;
+  // Collective-algorithm wish (hvd/schedule.h CollectiveAlgo): 0 =
+  // follow the coordinator's selection table / HOROVOD_COLLECTIVE_ALGO
+  // / autotuner, 1-5 = explicit per-op override
+  // (hvd.allreduce(..., algorithm=...)).
+  int8_t collective_algo = 0;
 
   void SerializeTo(std::string* out) const;
   static bool ParseFrom(const char** p, const char* end, Request* out);
@@ -130,6 +143,14 @@ struct Response {
   // consult it; shm and the intra-node phases of hierarchical mode
   // stay full-precision.
   int8_t wire_codec = 0;
+  // RESOLVED allreduce algorithm for this response (hvd/schedule.h;
+  // never kAlgoAuto on an ALLREDUCE the coordinator built): the
+  // coordinator runs the per-(payload, np, topology) selection table
+  // over the FUSED payload after fusion, so every rank dispatches the
+  // same exchange — the rank-0-env-wins coupling the old
+  // size-threshold check relied on is now an explicit per-response
+  // verdict, like wire_codec. Only the TCP allreduce consults it.
+  int8_t collective_algo = 0;
 
   int64_t TotalByteSize() const;  // metadata-derived fused payload size
 
@@ -151,6 +172,8 @@ struct ResponseList {
   int32_t tuned_reduce_threads = 0;   // host-reduction worker threads
   int32_t tuned_seg_depth = 0;        // shm pipeline depth (regions/slot)
   int8_t tuned_wire_codec = -1;       // -1 = no change, 0-3 = new codec
+  int8_t tuned_collective_algo = -1;  // -1 = no change, 0 = back to the
+                                      // table, 1+ = forced algorithm
 
   void SerializeTo(std::string* out) const;
   static bool ParseFrom(const std::string& buf, ResponseList* out);

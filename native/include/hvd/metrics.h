@@ -32,7 +32,9 @@ namespace hvd {
 // Snapshot layout version (bump on any enum/table/layout change) and
 // bucket count. Pinned by horovod_tpu/common/basics.py +
 // tests/test_metrics_abi.py.
-constexpr int kMetricsVersion = 1;
+// v2: per-algorithm TCP allreduce counters (tcp_algo_*_ops_total) and
+// the hd/striped schedule-interpreter phase histograms.
+constexpr int kMetricsVersion = 2;
 constexpr int kMetricsHistBuckets = 28;  // le = 2^0 .. 2^26, then +Inf
 
 // Monotonic counters (suffix _total) and point-in-time gauges (filled
@@ -71,6 +73,14 @@ enum MetricCounter : int {
   kCtrWireEncodes,
   kCtrWirePreBytes,           // f32 payload bytes presented to encode
   kCtrWirePostBytes,          // encoded bytes that hit the wire
+  // Per-algorithm TCP allreduce dispatch (hvd/schedule.h ids): which
+  // exchange each response actually rode — the observable face of the
+  // selection table and the autotuner's algorithm dimension.
+  kCtrAlgoRingOps,
+  kCtrAlgoHdOps,
+  kCtrAlgoStripedOps,
+  kCtrAlgoDoublingOps,
+  kCtrAlgoHierOps,
   // Worker pool.
   kCtrPoolJobs,               // ParallelFor dispatches (parts > 1)
   // Stall inspector.
@@ -95,6 +105,8 @@ enum MetricHistogram : int {
   kHistTcpRingRsUs,           // ring reduce-scatter phase
   kHistTcpRingAgUs,           // ring allgather phase
   kHistTcpDoublingUs,         // recursive-doubling exchange
+  kHistTcpHdUs,               // halving-doubling schedule (interpreter)
+  kHistTcpStripedUs,          // multi-ring striped schedule (interpreter)
   kHistPoolParts,             // parts per ParallelFor dispatch
   kNumMetricHistograms
 };

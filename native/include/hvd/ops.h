@@ -28,6 +28,7 @@
 #include "hvd/controller.h"
 #include "hvd/fusion_buffer.h"
 #include "hvd/message.h"
+#include "hvd/schedule.h"
 #include "hvd/shm.h"
 #include "hvd/timeline.h"
 
@@ -81,6 +82,11 @@ class TcpOps : public OpExecutor {
   // error is carried into the next step of the SAME tensor (EF-SGD).
   struct WireEfState {
     std::vector<float> rs, ag, dbl;
+    // Schedule-interpreter send sites: one slab indexed by fused
+    // element offset — every generated schedule fresh-encodes a given
+    // chunk at most once per collective (reduce-scatter ranges and the
+    // allgather owner encode are disjoint), so offsets identify sites.
+    std::vector<float> sched;
   };
 
   // Allreduce algorithms over the contributor set `ranks` (my position
@@ -133,6 +139,26 @@ class TcpOps : public OpExecutor {
                            ReduceOp op, const std::vector<int>& ranks, int p,
                            WireCodec codec = WireCodec::NONE,
                            std::vector<float>* ef = nullptr);
+  // The schedule interpreter (hvd/schedule.h): executes ANY per-step
+  // chunk-op table over the contributor set — halving-doubling and
+  // multi-ring striping are pure tables consumed here, with no
+  // algorithm-specific send/recv loop. Per step it posts one receiver
+  // thread per peer (the PR 2 overlap discipline), streams the sends
+  // from the calling thread, then applies RECV_REDUCE accumulates in
+  // table order (deterministic bits at any thread count). With a
+  // codec, received chunks keep their encoded bytes in a per-chunk
+  // cache and later forwards ship those bytes verbatim; fresh encodes
+  // self-decode the local copy — so every chunk is quantized exactly
+  // once by its owner and all ranks land on identical bytes, the same
+  // agreement argument as the ring allgather's. `ef` is the int8
+  // error-feedback slab for fresh (non-handoff) encode sites, indexed
+  // by element offset. `phase_hist` attributes the wall time to the
+  // algorithm's metrics series.
+  Status ExecuteSchedule(const ChunkSchedule& sched, uint8_t* buf,
+                         const std::vector<int64_t>& offs, DataType dtype,
+                         ReduceOp op, const std::vector<int>& ranks, int p,
+                         WireCodec codec, std::vector<float>* ef,
+                         int phase_hist);
   // Adasum recursive distance-doubling with per-tensor dot/norm
   // weighting (reference ops/adasum/adasum.h:166-330). `tensor_elems`
   // gives each fused tensor's element extent inside the buffer.
@@ -178,6 +204,10 @@ class TcpOps : public OpExecutor {
   // joined) before the next uses the pool, so reuse is race-free.
   std::vector<uint8_t> wire_enc_a_, wire_enc_b_, wire_enc_c_;
   std::vector<float> wire_dec_;
+  // Schedule-interpreter pools (same grow-only, single-consumer
+  // discipline as the wire scratch above): raw RECV_REDUCE staging and
+  // the per-chunk encoded-bytes cache the codec path forwards from.
+  std::vector<uint8_t> sched_scratch_, sched_cache_;
   std::unique_ptr<ShmArena> shm_;
   // Per-node arena (multi-host jobs with a node-major layout): the
   // intra-host stages of hierarchical collectives ride shared memory,

@@ -83,6 +83,20 @@ class ParameterManager {
   void SetWireTunable(int max_level, int current);
   int wire_codec() const { return wire_; }
   bool wire_tunable() const { return tune_wire_; }
+
+  // Collective-algorithm dimension (bayes mode): a LEVELED categorical
+  // over {0 = selection table, 1 = ring, 2 = hd, 3 = striped}
+  // (hvd/schedule.h ids; doubling/hier stay table-governed — doubling
+  // is the table's own small-payload floor and hier already rides the
+  // hierarchical categorical). Offered only when the job runs a real
+  // TCP plane AND the operator left HOROVOD_COLLECTIVE_ALGO on auto —
+  // an explicit force is never fought. The signal is the same
+  // throughput score as every other dimension, which the registry's
+  // tcp-phase histograms (tcp_{ring_rs,ring_ag,doubling,hd,striped}_us)
+  // break down per algorithm for the operator reading the CSV.
+  void SetAlgoTunable(bool available, int current);
+  int collective_algo() const { return algo_; }
+  bool algo_tunable() const { return tune_algo_; }
   // Whether the search actually owns each host knob: values are only
   // staged onto the broadcast when true, so an untuned knob never
   // clobbers a runtime override (hvd.set_reduce_threads) or a
@@ -136,6 +150,11 @@ class ParameterManager {
   int wire_max_ = 0;
   bool tune_wire_ = false;
 
+  // Collective algorithm: one [0,1] dimension quantized to the levels
+  // {auto, ring, hd, striped}.
+  int algo_ = 0;
+  bool tune_algo_ = false;
+
   // Measurement window.
   double window_secs_ = 1.0;
   double window_start_ = -1.0;
@@ -158,6 +177,7 @@ class ParameterManager {
   int best_threads_ = 1;
   int best_depth_ = 2;
   int best_wire_ = 0;
+  int best_algo_ = 0;
 
   std::ofstream log_;
 };

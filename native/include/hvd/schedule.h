@@ -1,0 +1,115 @@
+// Collective algorithms as data: chunk-schedule tables.
+//
+// TACCL (arXiv:2111.04867) represents a collective as a synthesized
+// per-step chunk schedule executed by a generic engine, so a new
+// algorithm is a new TABLE, not new C++. This header is the host-plane
+// rebuild of that idea: an allreduce over P ranks and a chunk grid
+// becomes a list of {step, peer, chunk, action} ops per rank, and one
+// interpreter (TcpOps::ExecuteSchedule, ops.cc) runs any table with
+// the existing overlap machinery (recv helper threads, WorkerPool
+// accumulates, wire codecs with verbatim encoded-byte forwarding).
+//
+// Built-in generators:
+//  * recursive halving-doubling (BuildHalvingDoubling) — the MLPerf
+//    TPU-pod recipe's small-tensor algorithm (arXiv:1909.09756):
+//    log2(P) reduce-scatter rounds at halving block sizes + log2(P)
+//    allgather rounds at doubling sizes, 2*(P-1)/P*bytes total like
+//    the ring but in 2*log2(P) latency steps instead of 2*(P-1).
+//    Non-power-of-two P uses the standard fold/unfold: the first
+//    2*(P-q) ranks pair up, odds fold into evens before the rounds
+//    and receive the finished result after them.
+//  * multi-ring striping (BuildStripedRing) — k ring instances over
+//    disjoint payload stripes, alternating direction so two stripes
+//    drive both duplex directions of every TCP link at once. stripes=1
+//    reproduces the classic ring as a table (used by the simulator
+//    tests; the production ring keeps its tuned dedicated path).
+//
+// Schedules agree across ranks by construction: every generator input
+// is response-derived or coordinator-synced, and per (step, src→dst)
+// pair both sides list the same chunks in the same order — the
+// framing contract tests/test_schedule.py verifies on a simulated
+// executor for every P.
+#pragma once
+
+#include <cstdint>
+#include <vector>
+
+namespace hvd {
+
+// Algorithm ids for the TCP-plane allreduce. Wire-stable: they ride
+// Request/Response (message.h) and the tuned-params broadcast, and
+// index kCollectiveAlgoNames (also the HOROVOD_COLLECTIVE_ALGO choice
+// list). kAlgoAuto resolves through the selection table and never
+// appears in a Response.
+enum CollectiveAlgo : int {
+  kAlgoAuto = 0,
+  kAlgoRing = 1,      // ring reduce-scatter + allgather (legacy path)
+  kAlgoHd = 2,        // recursive halving-doubling (schedule table)
+  kAlgoStriped = 3,   // multi-ring striping (schedule table)
+  kAlgoDoubling = 4,  // full-buffer recursive doubling (legacy path)
+  kAlgoHier = 5,      // two-level intra-node / cross-node composite
+  kNumCollectiveAlgos = 6,
+};
+
+// Canonical names, indexed by CollectiveAlgo — single source for the
+// env-choice parse, the autotune CSV, and hvd_algo_name.
+extern const char* const kCollectiveAlgoNames[kNumCollectiveAlgos];
+
+const char* CollectiveAlgoName(int algo);
+
+enum class ChunkAction : uint8_t {
+  SEND = 0,         // ship my chunk bytes to `peer`
+  RECV = 1,         // land the peer's chunk bytes (final value)
+  RECV_REDUCE = 2,  // land the peer's bytes and fold them into mine
+  COPY = 3,         // chunk is final with no traffic (P == 1 shapes)
+};
+
+// Flag bits on ChunkOp::flags. INFORMATIONAL: the interpreter treats
+// every fresh encode — hand-off included — as an error-feedback site
+// (the folded-out rank has no other send touching those offsets, and
+// compensating the fold is what makes the int8 time-average converge
+// at ragged P; see ExecuteSchedule). The flag records the structural
+// role for table consumers/tests.
+constexpr uint8_t kChunkFlagHandoff = 1;  // fold/unfold point-to-point
+                                          // republish, not a ring site
+
+struct ChunkOp {
+  int32_t step = 0;   // interpreter barrier-free phase index
+  int32_t peer = 0;   // position index into the contributor list
+  int32_t chunk = 0;  // index into the shared chunk grid
+  ChunkAction action = ChunkAction::SEND;
+  uint8_t flags = 0;
+};
+
+struct ChunkSchedule {
+  int nsteps = 0;
+  int nchunks = 0;              // chunk-grid size (element offsets are
+                                // the caller's ChunkOffsets split)
+  std::vector<ChunkOp> ops;     // this rank's ops, sorted by step
+};
+
+// Generators (pure functions of (P, position)). P >= 1; position in
+// [0, P). A P == 1 schedule is a single COPY covering the grid.
+ChunkSchedule BuildHalvingDoubling(int nranks, int pos);
+ChunkSchedule BuildStripedRing(int nranks, int pos, int stripes);
+
+// Dispatch by algorithm id (kAlgoHd / kAlgoStriped / kAlgoRing — ring
+// maps to BuildStripedRing(P, p, 1)). Other ids return an empty
+// schedule (they run on dedicated paths).
+ChunkSchedule BuildSchedule(int algo, int nranks, int pos);
+
+// Default per-(payload, np, topology) selection table: the algorithm
+// used when neither the request nor HOROVOD_COLLECTIVE_ALGO nor the
+// autotuner forces one. Seeded from the np=4 loopback calibration
+// sweep (docs/perf_tuning.md "Collective algorithm selection"):
+//  * np == 2            -> doubling (one full exchange is optimal)
+//  * bytes >= threshold -> hier when the two-level layout fits,
+//                          else ring (bandwidth regime)
+//  * bytes >= 4 KB      -> halving-doubling (latency regime where the
+//                          ring's 2(P-1) serialized steps dominate)
+//  * else               -> doubling (payload too small to chunk)
+// Never returns kAlgoAuto.
+int ResolveAlgoDefault(int64_t bytes, int np, bool hier_ok,
+                       int64_t ring_threshold_bytes);
+
+}  // namespace hvd

@@ -11,6 +11,7 @@
 #include "hvd/env.h"
 #include "hvd/logging.h"
 #include "hvd/metrics.h"
+#include "hvd/schedule.h"
 
 namespace hvd {
 
@@ -125,6 +126,10 @@ Response Controller::ConstructResponse(const std::string& name,
             err = "mismatched wire compression across ranks";
             break;
           }
+          if (r.collective_algo != first.collective_algo) {
+            err = "mismatched collective algorithm across ranks";
+            break;
+          }
         }
         if (err.empty() && first.request_type == RequestType::ALLREDUCE) {
           int64_t n = 1;
@@ -138,6 +143,11 @@ Response Controller::ConstructResponse(const std::string& name,
           resp.wire_codec = first.wire_codec >= 0
                                 ? first.wire_codec
                                 : static_cast<int8_t>(wire_codec_);
+          // Raw per-op algorithm wish (0 = follow the table); the
+          // final resolution happens in CoordinatorStep AFTER fusion,
+          // where the fused payload size — the quantity the selection
+          // table buckets on — is known.
+          resp.collective_algo = first.collective_algo;
         }
         if (err.empty() && first.request_type == RequestType::REDUCESCATTER) {
           if (has_joined) {
@@ -278,6 +288,24 @@ Response Controller::ConstructResponse(const std::string& name,
   return resp;
 }
 
+int Controller::ResolveCollectiveAlgo(int request_algo, int64_t payload_bytes,
+                                      int ncontributors) const {
+  int algo = (request_algo > kAlgoAuto && request_algo < kNumCollectiveAlgos)
+                 ? request_algo
+                 : collective_algo_;
+  if (algo == kAlgoAuto)
+    algo = ResolveAlgoDefault(payload_bytes, ncontributors,
+                              hierarchical_ && ncontributors == size_,
+                              ring_threshold_bytes_);
+  // A forced "hier" that the synced layout cannot run (ragged
+  // contributor set under Join, non-node-major topology) downgrades
+  // deterministically — the same rule the executor applies, computed
+  // from the same synced inputs.
+  if (algo == kAlgoHier && !(hierarchical_fit_ && ncontributors == size_))
+    algo = ncontributors >= 3 ? kAlgoRing : kAlgoDoubling;
+  return algo;
+}
+
 ResponseList Controller::CoordinatorStep(
     std::map<std::string, PendingTensor>* table,
     const std::vector<int>& active_ranks, bool shutdown) {
@@ -375,6 +403,7 @@ ResponseList Controller::CoordinatorStep(
         if (merged.response_type == ResponseType::ALLREDUCE &&
             (built[j].op_class != built[i].op_class ||
              cand.wire_codec != merged.wire_codec ||
+             cand.collective_algo != merged.collective_algo ||
              cand.contributors != merged.contributors))
           continue;
         if (bytes + built[j].bytes > fusion_threshold_bytes_) continue;
@@ -388,6 +417,18 @@ ResponseList Controller::CoordinatorStep(
         }
         bytes += built[j].bytes;
         used[j] = true;
+      }
+      if (merged.response_type == ResponseType::ALLREDUCE) {
+        // Resolve the algorithm over the FUSED payload: the selection
+        // table buckets on what the data plane will actually move.
+        // Every input (force, thresholds, topology verdicts, the
+        // contributor count) is coordinator-side, so one concrete
+        // verdict reaches all ranks in the broadcast response.
+        const int np = merged.contributors.empty()
+                           ? size_
+                           : static_cast<int>(merged.contributors.size());
+        merged.collective_algo = static_cast<int8_t>(
+            ResolveCollectiveAlgo(merged.collective_algo, bytes, np));
       }
     }
     out.responses.push_back(std::move(merged));
@@ -430,6 +471,7 @@ void Controller::UpdateCacheFromResponses(const ResponseList& list) {
       req.group_key = entry.group_key;
       req.group_size = entry.group_size;
       req.wire_codec = entry.wire_codec;
+      req.collective_algo = entry.collective_algo;
       deps_.response_cache->Put(req);
     }
   }
@@ -529,7 +571,8 @@ Status TcpController::Initialize() {
                          std::to_string(shm_segment_bytes_) + ":" +
                          std::to_string(shm_segment_depth_) + ":" +
                          std::to_string(reduce_threads_) + ":" +
-                         std::to_string(wire_codec_);
+                         std::to_string(wire_codec_) + ":" +
+                         std::to_string(collective_algo_);
     for (int peer = 1; peer < size_; ++peer) {
       if (!ctrl_conns_[peer].SendFrame(params))
         return Status::UnknownError("param sync: lost control link");
@@ -553,7 +596,8 @@ Status TcpController::Initialize() {
     auto c7 = c6 == std::string::npos ? c6 : params.find(':', c6 + 1);
     auto c8 = c7 == std::string::npos ? c7 : params.find(':', c7 + 1);
     auto c9 = c8 == std::string::npos ? c8 : params.find(':', c8 + 1);
-    if (!ok || c9 == std::string::npos)
+    auto c10 = c9 == std::string::npos ? c9 : params.find(':', c9 + 1);
+    if (!ok || c10 == std::string::npos)
       return Status::UnknownError("param sync: lost control link");
     fusion_threshold_bytes_ = std::atoll(params.c_str());
     ring_threshold_bytes_ = std::atoll(params.c_str() + c1 + 1);
@@ -565,6 +609,7 @@ Status TcpController::Initialize() {
     SetShmSegmentDepth(std::atoi(params.c_str() + c7 + 1));
     SetReduceThreads(std::atoi(params.c_str() + c8 + 1));
     SetWireCodec(std::atoi(params.c_str() + c9 + 1));
+    SetCollectiveAlgo(std::atoi(params.c_str() + c10 + 1));
   }
   return Status::OK();
 }
@@ -937,6 +982,7 @@ void TcpController::Broadcast(ResponseList& list) {
     list.tuned_reduce_threads = staged_threads_;
     list.tuned_seg_depth = staged_depth_;
     list.tuned_wire_codec = static_cast<int8_t>(staged_wire_);
+    list.tuned_collective_algo = static_cast<int8_t>(staged_algo_);
     staged_fusion_ = 0;
     staged_cycle_ms_ = 0.0;
     staged_hier_ = -1;
@@ -945,6 +991,7 @@ void TcpController::Broadcast(ResponseList& list) {
     staged_threads_ = 0;
     staged_depth_ = 0;
     staged_wire_ = -1;
+    staged_algo_ = -1;
   }
   std::string buf;
   list.SerializeTo(&buf);

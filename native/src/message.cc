@@ -113,6 +113,7 @@ void Request::SerializeTo(std::string* out) const {
   WriteScalar<int64_t>(out, group_key);
   WriteScalar<int32_t>(out, group_size);
   WriteScalar<int8_t>(out, wire_codec);
+  WriteScalar<int8_t>(out, collective_algo);
 }
 
 bool Request::ParseFrom(const char** p, const char* end, Request* r) {
@@ -126,7 +127,8 @@ bool Request::ParseFrom(const char** p, const char* end, Request* r) {
             ReadVec(p, end, &r->splits) && ReadScalar(p, end, &em) &&
             ReadScalar(p, end, &r->group_key) &&
             ReadScalar(p, end, &r->group_size) &&
-            ReadScalar(p, end, &r->wire_codec);
+            ReadScalar(p, end, &r->wire_codec) &&
+            ReadScalar(p, end, &r->collective_algo);
   if (!ok) return false;
   r->request_type = static_cast<RequestType>(rt);
   r->tensor_type = static_cast<DataType>(tt);
@@ -188,6 +190,7 @@ void Response::SerializeTo(std::string* out) const {
   WriteVec(out, cache_bits);
   WriteVec(out, contributors);
   WriteScalar<int8_t>(out, wire_codec);
+  WriteScalar<int8_t>(out, collective_algo);
 }
 
 bool Response::ParseFrom(const char** p, const char* end, Response* r) {
@@ -207,7 +210,8 @@ bool Response::ParseFrom(const char** p, const char* end, Response* r) {
     if (!ReadString(p, end, &r->tensor_names[i])) return false;
   return ReadVec(p, end, &r->tensor_sizes) && ReadVec(p, end, &r->recvsplits) &&
          ReadVec(p, end, &r->cache_bits) && ReadVec(p, end, &r->contributors) &&
-         ReadScalar(p, end, &r->wire_codec);
+         ReadScalar(p, end, &r->wire_codec) &&
+         ReadScalar(p, end, &r->collective_algo);
 }
 
 void ResponseList::SerializeTo(std::string* out) const {
@@ -222,6 +226,7 @@ void ResponseList::SerializeTo(std::string* out) const {
   WriteScalar<int32_t>(out, tuned_reduce_threads);
   WriteScalar<int32_t>(out, tuned_seg_depth);
   WriteScalar<int8_t>(out, tuned_wire_codec);
+  WriteScalar<int8_t>(out, tuned_collective_algo);
   WriteScalar<uint32_t>(out, static_cast<uint32_t>(responses.size()));
   for (const auto& r : responses) r.SerializeTo(out);
 }
@@ -244,6 +249,7 @@ bool ResponseList::ParseFrom(const std::string& buf, ResponseList* out) {
   if (!ReadScalar(&p, end, &out->tuned_reduce_threads)) return false;
   if (!ReadScalar(&p, end, &out->tuned_seg_depth)) return false;
   if (!ReadScalar(&p, end, &out->tuned_wire_codec)) return false;
+  if (!ReadScalar(&p, end, &out->tuned_collective_algo)) return false;
   uint32_t n;
   if (!ReadScalar(&p, end, &n)) return false;
   out->responses.resize(n);

@@ -36,6 +36,11 @@ constexpr const char* kCounterNames[] = {
     "wire_encodes_total",
     "wire_pre_bytes_total",
     "wire_post_bytes_total",
+    "tcp_algo_ring_ops_total",
+    "tcp_algo_hd_ops_total",
+    "tcp_algo_striped_ops_total",
+    "tcp_algo_doubling_ops_total",
+    "tcp_algo_hier_ops_total",
     "pool_jobs_total",
     "stall_events_total",
     "pending_tensors",
@@ -45,7 +50,7 @@ constexpr const char* kCounterNames[] = {
 
 constexpr int kCounterKinds[] = {
     0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
-    0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+    0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
     1, 1, 1,  // pending_tensors, stalled_tensors, reduce_threads
 };
 
@@ -62,6 +67,8 @@ constexpr const char* kHistNames[] = {
     "tcp_ring_rs_us",
     "tcp_ring_ag_us",
     "tcp_doubling_us",
+    "tcp_hd_us",
+    "tcp_striped_us",
     "pool_parts",
 };
 

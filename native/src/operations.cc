@@ -33,6 +33,7 @@
 #include "hvd/message.h"
 #include "hvd/metrics.h"
 #include "hvd/ops.h"
+#include "hvd/schedule.h"
 #include "hvd/bayesian.h"
 #include "hvd/parameter_manager.h"
 #include "hvd/response_cache.h"
@@ -379,6 +380,12 @@ void BackgroundThreadLoop(GlobalState& st) {
       // coordinator — truthful.
       if (list.tuned_wire_codec >= 0)
         st.controller->SetWireCodec(list.tuned_wire_codec);
+      // Algorithm agreement per response is already guaranteed (the
+      // coordinator resolves it into each Response); as with the wire
+      // codec, applying the tuned force here keeps this rank's
+      // introspected value truthful.
+      if (list.tuned_collective_algo >= 0)
+        st.controller->SetCollectiveAlgo(list.tuned_collective_algo);
     }
     for (const auto& resp : list.responses) PerformOperation(st, resp);
     if (list.shutdown) break;
@@ -414,6 +421,7 @@ void BackgroundThreadLoop(GlobalState& st) {
         // knob staged every window would clobber runtime overrides
         // (hvd.set_reduce_threads) with the stale init-time value.
         int tuned_threads = 0, tuned_depth = 0, tuned_wire = -1;
+        int tuned_algo = -1;
         if (st.param_manager.threads_tunable()) {
           st.controller->SetReduceThreads(
               st.param_manager.reduce_threads());
@@ -428,11 +436,16 @@ void BackgroundThreadLoop(GlobalState& st) {
           st.controller->SetWireCodec(st.param_manager.wire_codec());
           tuned_wire = st.controller->wire_codec();
         }
+        if (st.param_manager.algo_tunable()) {
+          st.controller->SetCollectiveAlgo(
+              st.param_manager.collective_algo());
+          tuned_algo = st.controller->collective_algo();
+        }
         st.controller->StageTunedParams(
             st.param_manager.fusion_threshold(),
             st.param_manager.cycle_time_ms(), cat(PM::kCatHier),
             cat(PM::kCatCache), cat(PM::kCatShm), tuned_threads,
-            tuned_depth, tuned_wire);
+            tuned_depth, tuned_wire, tuned_algo);
       }
     }
     auto elapsed = std::chrono::steady_clock::now() - cycle_start;
@@ -496,6 +509,7 @@ Status EnqueueEntries(std::vector<TensorTableEntry> entries,
     req.group_key = e.group_key;
     req.group_size = e.group_size;
     req.wire_codec = e.wire_codec;
+    req.collective_algo = e.collective_algo;
     requests.push_back(std::move(req));
   }
   return st.tensor_queue.AddToTensorQueue(std::move(entries),
@@ -582,8 +596,11 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   // Sanitized parses (warn once + default): atoll's silent 0 for
   // garbage would route every payload onto the ring / shrink the shm
   // segment to its floor without a trace.
+  // Default 256 KB: the calibration sweep (docs/perf_tuning.md,
+  // host_allreduce_busbw_{ring,hd}_* arms) shows halving-doubling
+  // beating the ring through the 64-512 KB latency band.
   st.controller->SetRingThreshold(hvd::EnvInt64Sane(
-      "HOROVOD_RING_THRESHOLD", 64 * 1024, 0, int64_t(1) << 40));
+      "HOROVOD_RING_THRESHOLD", 256 * 1024, 0, int64_t(1) << 40));
   st.controller->SetShmSegmentBytes(hvd::EnvInt64Sane(
       "HOROVOD_SHM_SEGMENT_BYTES", 8 * 1024 * 1024, 4096,
       int64_t(1) << 34));
@@ -606,6 +623,16 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   st.controller->SetWireCodec(
       hvd::EnvChoiceSane("HOROVOD_WIRE_COMPRESSION", 0,
                          hvd::kWireCodecNames, hvd::kNumWireCodecs));
+  // Collective-algorithm force for the TCP allreduce plane: a choice
+  // knob over the schedule.h names ("auto" = the per-(payload, np,
+  // topology) selection table decides per response). Coordinator-
+  // synced and resolved into each Response, so a per-rank divergence
+  // of this knob cannot split the exchange (rank 0's value wins, now
+  // explicitly rather than by the old post-sync threshold accident).
+  st.controller->SetCollectiveAlgo(
+      hvd::EnvChoiceSane("HOROVOD_COLLECTIVE_ALGO", 0,
+                         hvd::kCollectiveAlgoNames,
+                         hvd::kNumCollectiveAlgos));
   st.controller->SetTopology(local_rank, local_size, cross_rank, cross_size);
   st.controller->SetHierarchical(   // any nonzero enables (see above)
       hvd::EnvInt64Sane("HOROVOD_HIERARCHICAL_ALLREDUCE", 0, 0, 1 << 30)
@@ -662,6 +689,13 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
     st.param_manager.SetWireTunable(
         size > 1 ? st.controller->wire_codec() : 0,
         st.controller->wire_codec());
+    // The algorithm dimension joins the search only when the job runs
+    // a real TCP plane and the operator left HOROVOD_COLLECTIVE_ALGO
+    // on auto — the tuner explores the table's envelope, it never
+    // fights an explicit force.
+    st.param_manager.SetAlgoTunable(
+        size > 1 && st.controller->collective_algo() == 0,
+        st.controller->collective_algo());
   }
   if (!s.ok()) {
     LOG_ERROR << "controller init failed: " << s.reason();
@@ -691,6 +725,10 @@ void hvd_shutdown() {
   st.initialized.store(false);
 }
 
+// v7: hvd_enqueue gained collective_algo; schedule-interpreter surface
+// (hvd_build_schedule / hvd_algo_select / hvd_algo_name /
+// hvd_collective_algo); Request/Response/ResponseList carry the
+// collective-algorithm fields.
 // Bump whenever the callback signatures or the wire format change; the
 // Python bridge refuses to load a library whose version disagrees.
 // v6: metrics registry surface (hvd_metrics_snapshot + name tables,
@@ -730,7 +768,7 @@ int64_t hvd_enqueue(int op_type, const char* name, int dtype,
                     void* output, int root_rank, int reduce_op,
                     double prescale, double postscale, const int64_t* splits,
                     int nsplits, int exec_mode, int64_t group_key,
-                    int group_size, int wire_codec) {
+                    int group_size, int wire_codec, int collective_algo) {
   auto& st = hvd::State();
   hvd::TensorTableEntry e;
   e.name = name;
@@ -749,6 +787,10 @@ int64_t hvd_enqueue(int op_type, const char* name, int dtype,
   e.group_size = group_size;
   e.wire_codec = static_cast<int8_t>(
       wire_codec < -1 || wire_codec > 3 ? -1 : wire_codec);
+  e.collective_algo = static_cast<int8_t>(
+      collective_algo < 0 || collective_algo >= hvd::kNumCollectiveAlgos
+          ? 0
+          : collective_algo);
   int64_t handle = st.handles.Allocate();
   e.handle = handle;
   e.callback = [&st, handle](const hvd::Status& s) {
@@ -770,14 +812,14 @@ int64_t hvd_join() {
   return hvd_enqueue(static_cast<int>(hvd::RequestType::JOIN), "join",
                      static_cast<int>(hvd::DataType::UINT8), nullptr, 0,
                      nullptr, nullptr, 0, 1, 1.0, 1.0, nullptr, 0, 0, -1, 0,
-                     -1);
+                     -1, 0);
 }
 
 int64_t hvd_barrier() {
   return hvd_enqueue(static_cast<int>(hvd::RequestType::BARRIER), "barrier",
                      static_cast<int>(hvd::DataType::UINT8), nullptr, 0,
                      nullptr, nullptr, 0, 1, 1.0, 1.0, nullptr, 0, 0, -1, 0,
-                     -1);
+                     -1, 0);
 }
 
 int hvd_poll(int64_t handle) {
@@ -972,6 +1014,50 @@ void hvd_host_scale(int dtype, void* dst, int64_t count, double factor) {
 
 void hvd_set_reduce_threads(int n) { hvd::SetHostReduceThreads(n); }
 int hvd_reduce_threads() { return hvd::HostReduceThreads(); }
+
+// Schedule-interpreter surface (hvd/schedule.h): the chunk-op tables
+// and the default selection table are pure functions, exposed so the
+// Python simulator tests can verify every generated schedule
+// (complete, deadlock-free, chunk-conserving) without spawning ranks,
+// and so bench.py can dump the live selection table.
+
+// Fills out[] with int32 quintets (step, peer, chunk, action, flags)
+// for rank position `pos` of `nranks`. Returns the op count (callers
+// pass out=nullptr to size the buffer); writes *nsteps/*nchunks.
+int hvd_build_schedule(int algo, int nranks, int pos, int* nsteps,
+                       int* nchunks, int32_t* out, int max_ops) {
+  hvd::ChunkSchedule s = hvd::BuildSchedule(algo, nranks, pos);
+  if (nsteps) *nsteps = s.nsteps;
+  if (nchunks) *nchunks = s.nchunks;
+  if (out) {
+    int n = std::min<int>(max_ops, static_cast<int>(s.ops.size()));
+    for (int i = 0; i < n; ++i) {
+      out[i * 5 + 0] = s.ops[i].step;
+      out[i * 5 + 1] = s.ops[i].peer;
+      out[i * 5 + 2] = s.ops[i].chunk;
+      out[i * 5 + 3] = static_cast<int32_t>(s.ops[i].action);
+      out[i * 5 + 4] = s.ops[i].flags;
+    }
+  }
+  return static_cast<int>(s.ops.size());
+}
+
+// Default selection-table query (no controller state: callers pass the
+// synced inputs, so bench/tests can probe any (bytes, np, topology)
+// cell).
+int hvd_algo_select(int64_t bytes, int np, int hier_ok,
+                    int64_t ring_threshold) {
+  return hvd::ResolveAlgoDefault(bytes, np, hier_ok != 0, ring_threshold);
+}
+
+const char* hvd_algo_name(int algo) { return hvd::CollectiveAlgoName(algo); }
+
+// The live job-wide force (0 = auto/table) after env parse, param
+// sync, and any autotuner retarget.
+int hvd_collective_algo() {
+  auto& st = hvd::State();
+  return st.controller ? st.controller->collective_algo() : 0;
+}
 
 // Wire-codec kernel entry points (tests/test_host_kernels.py drives
 // the encode/decode matrix — incl. error feedback and thread-count

@@ -564,17 +564,56 @@ Status TcpOps::Allreduce(const Response& r,
   if (ranks.size() > 1) {
     if (r.reduce_op == ReduceOp::ADASUM) {
       st = AdasumAllreduce(buf, dtype, tensor_elems, ranks, p);
-    } else if (HierarchicalApplicable(ranks) &&
-               total_bytes >= ring_threshold_bytes_) {
-      st = HierarchicalAllreduce(buf, total_elems, dtype, r.reduce_op,
-                                 codec, ef);
-    } else if (total_bytes >= ring_threshold_bytes_ &&
-               static_cast<int>(ranks.size()) >= 3) {
-      st = RingAllreduce(buf, total_elems, dtype, r.reduce_op, ranks, p,
-                         codec, ef);
     } else {
-      st = RecursiveDoubling(buf, total_elems, dtype, r.reduce_op, ranks, p,
-                             codec, ef ? &ef->dbl : nullptr);
+      // Algorithm choice: the coordinator RESOLVED it into the
+      // response (selection table / HOROVOD_COLLECTIVE_ALGO /
+      // autotuner — all synced inputs), so every rank dispatches the
+      // same exchange by construction. The fallback for an unresolved
+      // response is the same pure function of synced values, so it
+      // cannot split the job either.
+      const int P = static_cast<int>(ranks.size());
+      int algo = r.collective_algo;
+      if (algo <= kAlgoAuto || algo >= kNumCollectiveAlgos)
+        algo = ResolveAlgoDefault(total_bytes, P,
+                                  HierarchicalApplicable(ranks),
+                                  ring_threshold_bytes_);
+      // Executor-side guard mirrors the coordinator's downgrade rule
+      // exactly (same synced inputs): a hier verdict only runs when
+      // the node-major layout fits and the full world contributes.
+      if (algo == kAlgoHier &&
+          !(controller_->hierarchical_fit() && P == controller_->size()))
+        algo = P >= 3 ? kAlgoRing : kAlgoDoubling;
+      switch (algo) {
+        case kAlgoHier:
+          MetricAdd(kCtrAlgoHierOps);
+          st = HierarchicalAllreduce(buf, total_elems, dtype, r.reduce_op,
+                                     codec, ef);
+          break;
+        case kAlgoRing:
+          MetricAdd(kCtrAlgoRingOps);
+          st = RingAllreduce(buf, total_elems, dtype, r.reduce_op, ranks, p,
+                             codec, ef);
+          break;
+        case kAlgoDoubling:
+          MetricAdd(kCtrAlgoDoublingOps);
+          st = RecursiveDoubling(buf, total_elems, dtype, r.reduce_op, ranks,
+                                 p, codec, ef ? &ef->dbl : nullptr);
+          break;
+        case kAlgoHd:
+        case kAlgoStriped:
+        default: {
+          // Algorithms-as-data: the collective is a chunk-op table
+          // consumed by the shared interpreter.
+          MetricAdd(algo == kAlgoHd ? kCtrAlgoHdOps : kCtrAlgoStripedOps);
+          ChunkSchedule sched = BuildSchedule(algo, P, p);
+          auto offs = ChunkOffsets(total_elems, sched.nchunks);
+          st = ExecuteSchedule(sched, buf, offs, dtype, r.reduce_op, ranks,
+                               p, codec, ef ? &ef->sched : nullptr,
+                               algo == kAlgoHd ? kHistTcpHdUs
+                                               : kHistTcpStripedUs);
+          break;
+        }
+      }
     }
   }
   if (timeline_) timeline_->ActivityEnd(tname);
@@ -1377,6 +1416,178 @@ Status TcpOps::RecursiveDoubling(uint8_t* buf, int64_t elems, DataType dtype,
         return Status::OK();
       },
       codec, ef);
+}
+
+Status TcpOps::ExecuteSchedule(const ChunkSchedule& sched, uint8_t* buf,
+                               const std::vector<int64_t>& offs,
+                               DataType dtype, ReduceOp op,
+                               const std::vector<int>& ranks, int p,
+                               WireCodec codec, std::vector<float>* ef,
+                               int phase_hist) {
+  // One engine for every table (hvd/schedule.h): per step, post one
+  // receiver thread per peer draining that peer's recv ops in table
+  // order, stream the send ops from this thread (every rank posts its
+  // recvs before blocking in a send, so matched per-step tables can
+  // never deadlock — the SendRecv discipline generalized), then fold
+  // RECV_REDUCE payloads in table order so the accumulate sequence —
+  // and therefore the bits — are a pure function of the table.
+  MetricTimer phase_timer(static_cast<MetricHistogram>(phase_hist));
+  const int64_t esize = DataTypeSize(dtype);
+  const auto& ops = sched.ops;
+  const int nchunks = sched.nchunks;
+  auto chunk_elems = [&](int c) { return offs[c + 1] - offs[c]; };
+
+  // Codec path state (f32 sum-class only; Allreduce gates it): the
+  // encoded form of every chunk that passed through this rank, so a
+  // forward ships the owner's bytes verbatim (one quantization per
+  // chunk job-wide). cache_off pre-lays the pool; valid[c] flips on
+  // when region c holds the encoded form of buf's chunk c and off when
+  // an accumulate changes the chunk under it.
+  float* fbuf = reinterpret_cast<float*>(buf);
+  std::vector<int64_t> cache_off;
+  std::vector<uint8_t> valid;
+  float* efd = nullptr;
+  if (codec != WireCodec::NONE) {
+    cache_off.resize(nchunks + 1, 0);
+    for (int c = 0; c < nchunks; ++c)
+      cache_off[c + 1] = cache_off[c] + WireEncodedBytes(codec,
+                                                         chunk_elems(c));
+    if (static_cast<int64_t>(sched_cache_.size()) < cache_off[nchunks])
+      sched_cache_.resize(cache_off[nchunks]);
+    valid.assign(nchunks, 0);
+    if (ef && offs[nchunks] > 0) {
+      if (static_cast<int64_t>(ef->size()) != offs[nchunks])
+        ef->assign(static_cast<size_t>(offs[nchunks]), 0.0f);
+      efd = ef->data();
+    }
+  }
+  auto enc_region = [&](int c) { return sched_cache_.data() + cache_off[c]; };
+  auto enc_bytes = [&](int c) { return WireEncodedBytes(codec,
+                                                        chunk_elems(c)); };
+
+  size_t idx = 0;
+  for (int step = 0; step < sched.nsteps; ++step) {
+    size_t lo = idx;
+    while (idx < ops.size() && ops[idx].step == step) ++idx;
+    if (idx == lo) continue;  // this rank idles this step
+
+    // Raw-path RECV_REDUCE staging: lay out one scratch region per
+    // recv-reduce op (codec recvs land in the encoded cache instead).
+    std::vector<int64_t> rr_off(idx - lo + 1, 0);
+    if (codec == WireCodec::NONE) {
+      for (size_t i = lo; i < idx; ++i) {
+        int64_t n = ops[i].action == ChunkAction::RECV_REDUCE
+                        ? chunk_elems(ops[i].chunk) * esize
+                        : 0;
+        rr_off[i - lo + 1] = rr_off[i - lo] + n;
+      }
+      if (static_cast<int64_t>(sched_scratch_.size()) < rr_off.back())
+        sched_scratch_.resize(rr_off.back());
+    }
+
+    // One receiver thread per peer, draining that peer's recv ops in
+    // table order (the sender streams the same chunks in the same
+    // order — the generator contract the simulator tests pin).
+    std::vector<int> recv_peers, send_peers;
+    for (size_t i = lo; i < idx; ++i) {
+      const auto& o = ops[i];
+      auto& list = o.action == ChunkAction::SEND ? send_peers : recv_peers;
+      if (o.action != ChunkAction::COPY &&
+          std::find(list.begin(), list.end(), o.peer) == list.end())
+        list.push_back(o.peer);
+    }
+    std::atomic<bool> io_ok{true};
+    std::vector<std::thread> receivers;
+    receivers.reserve(recv_peers.size());
+    for (int peer : recv_peers) {
+      receivers.emplace_back([&, peer] {
+        TcpConn* conn = controller_->DataConn(ranks[peer]);
+        for (size_t i = lo; i < idx; ++i) {
+          const auto& o = ops[i];
+          if (o.peer != peer || o.action == ChunkAction::SEND ||
+              o.action == ChunkAction::COPY)
+            continue;
+          void* dst;
+          uint64_t bytes;
+          if (codec != WireCodec::NONE) {
+            dst = enc_region(o.chunk);
+            bytes = static_cast<uint64_t>(enc_bytes(o.chunk));
+          } else if (o.action == ChunkAction::RECV) {
+            dst = buf + offs[o.chunk] * esize;
+            bytes = static_cast<uint64_t>(chunk_elems(o.chunk) * esize);
+          } else {
+            dst = sched_scratch_.data() + rr_off[i - lo];
+            bytes = static_cast<uint64_t>(chunk_elems(o.chunk) * esize);
+          }
+          if (bytes > 0 && (conn == nullptr || !conn->RecvAll(dst, bytes))) {
+            io_ok.store(false, std::memory_order_relaxed);
+            return;
+          }
+        }
+      });
+    }
+    // Sends, grouped by peer in table order, from this thread. With a
+    // codec: forward the cached encoded bytes when the chunk already
+    // passed through encoded; otherwise encode fresh (error feedback
+    // at persistent sites), ship, and SELF-DECODE the local copy so
+    // this rank holds exactly the bytes every receiver will decode.
+    bool send_ok = true;
+    for (int peer : send_peers) {
+      TcpConn* conn = controller_->DataConn(ranks[peer]);
+      for (size_t i = lo; i < idx && send_ok; ++i) {
+        const auto& o = ops[i];
+        if (o.peer != peer || o.action != ChunkAction::SEND) continue;
+        const int64_t n = chunk_elems(o.chunk);
+        if (n == 0) continue;
+        if (conn == nullptr) {
+          send_ok = false;
+          break;
+        }
+        if (codec != WireCodec::NONE) {
+          if (!valid[o.chunk]) {
+            // Every fresh encode is a persistent site and carries EF —
+            // including the ragged fold hand-off: the folded-out rank
+            // has no OTHER send site touching these offsets, so the
+            // slab cannot collide, and compensating the fold is what
+            // lets the int8 time-average converge at ragged P (the
+            // legacy doubling path's uncompensated fold left a
+            // systematic bias there).
+            WireEncode(codec, fbuf + offs[o.chunk], n, enc_region(o.chunk),
+                       efd ? efd + offs[o.chunk] : nullptr);
+            WireDecode(codec, enc_region(o.chunk), n, fbuf + offs[o.chunk]);
+            valid[o.chunk] = 1;
+          }
+          send_ok = conn->SendAll(enc_region(o.chunk), enc_bytes(o.chunk));
+        } else {
+          send_ok = conn->SendAll(buf + offs[o.chunk] * esize, n * esize);
+        }
+      }
+      if (!send_ok) break;
+    }
+    for (auto& th : receivers) th.join();
+    if (!send_ok || !io_ok.load(std::memory_order_relaxed))
+      return Status::UnknownError(
+          "schedule interpreter: lost data connection");
+    // Fold the received payloads, in table order.
+    for (size_t i = lo; i < idx; ++i) {
+      const auto& o = ops[i];
+      const int64_t n = chunk_elems(o.chunk);
+      if (n == 0) continue;
+      if (codec != WireCodec::NONE) {
+        if (o.action == ChunkAction::RECV) {
+          WireDecode(codec, enc_region(o.chunk), n, fbuf + offs[o.chunk]);
+          valid[o.chunk] = 1;
+        } else if (o.action == ChunkAction::RECV_REDUCE) {
+          WireDecodeAdd(codec, enc_region(o.chunk), n, fbuf + offs[o.chunk]);
+          valid[o.chunk] = 0;  // the cached bytes no longer match buf
+        }
+      } else if (o.action == ChunkAction::RECV_REDUCE) {
+        HostAccumulate(op, dtype, sched_scratch_.data() + rr_off[i - lo],
+                       buf + offs[o.chunk] * esize, n);
+      }
+    }
+  }
+  return Status::OK();
 }
 
 TcpOps::WireEfState* TcpOps::WireEf(const std::string& name, int64_t elems) {

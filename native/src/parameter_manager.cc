@@ -8,6 +8,7 @@
 #include "hvd/bayesian.h"
 #include "hvd/env.h"
 #include "hvd/logging.h"
+#include "hvd/schedule.h"
 
 namespace hvd {
 
@@ -22,6 +23,9 @@ constexpr double kImprovement = 1.02;  // accept only >2% gains (noise floor)
 constexpr double kLogFusionLo = 10.0, kLogFusionHi = 28.0;
 constexpr double kLogCycleLo = -3.0, kLogCycleHi = 5.0;
 constexpr int kMaxSegDepth = 8;  // log2 range [0, 3]
+// Collective-algorithm levels the search may force: 0 = selection
+// table, 1 = ring, 2 = hd, 3 = striped (hvd/schedule.h ids).
+constexpr int kMaxAlgoLevel = 3;
 
 double ToUnit(double v, double lo, double hi) {
   return std::min(1.0, std::max(0.0, (v - lo) / (hi - lo)));
@@ -88,12 +92,25 @@ void ParameterManager::SetWireTunable(int max_level, int current) {
   best_wire_ = wire_;
 }
 
+void ParameterManager::SetAlgoTunable(bool available, int current) {
+  // `current` is logged verbatim (the CSV must report the algorithm
+  // the job actually runs — a forced doubling/hier sits ABOVE the
+  // searchable levels and must not alias to striped). The search
+  // itself only runs when the force is auto (available), where
+  // current is 0 and the kMaxAlgoLevel quantization in ApplyPoint
+  // keeps every sampled value in range.
+  algo_ = std::max(0, std::min(kNumCollectiveAlgos - 1, current));
+  tune_algo_ = bayes_ && available;
+  best_algo_ = algo_;
+}
+
 void ParameterManager::SetLogPath(const std::string& path) {
   log_.open(path, std::ios::out | std::ios::trunc);
   if (log_.is_open())
     log_ << "time_secs,fusion_threshold_bytes,cycle_time_ms,"
             "score_bytes_per_sec,hierarchical,cache_enabled,"
-            "shm_enabled,reduce_threads,seg_depth,wire_codec\n";
+            "shm_enabled,reduce_threads,seg_depth,wire_codec,"
+            "collective_algo\n";
 }
 
 void ParameterManager::Record(int64_t bytes) {
@@ -105,7 +122,8 @@ void ParameterManager::LogSample(double score) {
     log_ << window_start_ << "," << fusion_ << "," << cycle_ms_ << ","
          << static_cast<int64_t>(score) << "," << cat_[kCatHier] << ","
          << cat_[kCatCache] << "," << cat_[kCatShm] << ","
-         << threads_ << "," << depth_ << "," << wire_ << "\n";
+         << threads_ << "," << depth_ << "," << wire_ << ","
+         << algo_ << "\n";
     log_.flush();
   }
 }
@@ -123,6 +141,8 @@ std::vector<double> ParameterManager::CurrentPoint() const {
                        std::log2(static_cast<double>(kMaxSegDepth))));
   if (tune_wire_)
     x.push_back(static_cast<double>(wire_) / wire_max_);
+  if (tune_algo_)
+    x.push_back(static_cast<double>(algo_) / kMaxAlgoLevel);
   for (int c = 0; c < kNumCategoricals; ++c)
     if (cat_tunable_[c]) x.push_back(cat_[c] ? 1.0 : 0.0);
   return x;
@@ -142,6 +162,10 @@ void ParameterManager::ApplyPoint(const std::vector<double>& x) {
   if (tune_wire_ && i < x.size()) {
     const int lvl = static_cast<int>(std::lround(x[i++] * wire_max_));
     wire_ = std::max(0, std::min(wire_max_, lvl));
+  }
+  if (tune_algo_ && i < x.size()) {
+    const int lvl = static_cast<int>(std::lround(x[i++] * kMaxAlgoLevel));
+    algo_ = std::max(0, std::min(kMaxAlgoLevel, lvl));
   }
   for (int c = 0; c < kNumCategoricals; ++c)
     if (cat_tunable_[c] && i < x.size()) cat_[c] = x[i++] > 0.5 ? 1 : 0;
@@ -185,7 +209,8 @@ bool ParameterManager::UpdateBayes(double score) {
     int n_cat = 0;
     for (bool t : cat_tunable_) n_cat += t ? 1 : 0;
     const int n_cont = 2 + (tune_threads_ ? 1 : 0) +
-                       (tune_depth_ ? 1 : 0) + (tune_wire_ ? 1 : 0);
+                       (tune_depth_ ? 1 : 0) + (tune_wire_ ? 1 : 0) +
+                       (tune_algo_ ? 1 : 0);
     opt_ = std::make_unique<BayesianOptimizer>(n_cont, n_cat);
   }
   const int64_t old_fusion = fusion_;
@@ -193,6 +218,7 @@ bool ParameterManager::UpdateBayes(double score) {
   const int old_threads = threads_;
   const int old_depth = depth_;
   const int old_wire = wire_;
+  const int old_algo = algo_;
   int old_cat[kNumCategoricals];
   std::memcpy(old_cat, cat_, sizeof(old_cat));
 
@@ -204,6 +230,7 @@ bool ParameterManager::UpdateBayes(double score) {
     best_threads_ = threads_;
     best_depth_ = depth_;
     best_wire_ = wire_;
+    best_algo_ = algo_;
     std::memcpy(best_cat_, cat_, sizeof(best_cat_));
   }
   if (opt_->n_samples() >= max_samples_) {
@@ -212,6 +239,7 @@ bool ParameterManager::UpdateBayes(double score) {
     threads_ = best_threads_;
     depth_ = best_depth_;
     wire_ = best_wire_;
+    algo_ = best_algo_;
     std::memcpy(cat_, best_cat_, sizeof(best_cat_));
     converged_ = true;
     static constexpr const char* kCatNames[kNumCategoricals] = {
@@ -226,6 +254,8 @@ bool ParameterManager::UpdateBayes(double score) {
       host += " reduce_threads=" + std::to_string(threads_);
     if (tune_depth_) host += " seg_depth=" + std::to_string(depth_);
     if (tune_wire_) host += " wire_codec=" + std::to_string(wire_);
+    if (tune_algo_)
+      host += " collective_algo=" + std::to_string(algo_);
     LOG_INFO << "autotune (bayes) converged after " << opt_->n_samples()
              << " samples: fusion_threshold=" << fusion_
              << " cycle_time_ms=" << cycle_ms_ << host << cats
@@ -236,7 +266,7 @@ bool ParameterManager::UpdateBayes(double score) {
   settling_ = true;
   return fusion_ != old_fusion || cycle_ms_ != old_cycle ||
          threads_ != old_threads || depth_ != old_depth ||
-         wire_ != old_wire ||
+         wire_ != old_wire || algo_ != old_algo ||
          std::memcmp(cat_, old_cat, sizeof(old_cat)) != 0 || converged_;
 }
 

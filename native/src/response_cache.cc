@@ -9,7 +9,8 @@ bool ResponseCache::SameParams(const Request& a, const Request& b) {
          a.prescale_factor == b.prescale_factor &&
          a.postscale_factor == b.postscale_factor && a.splits == b.splits &&
          a.exec_mode == b.exec_mode && a.group_key == b.group_key &&
-         a.group_size == b.group_size && a.wire_codec == b.wire_codec;
+         a.group_size == b.group_size && a.wire_codec == b.wire_codec &&
+         a.collective_algo == b.collective_algo;
 }
 
 uint64_t ResponseCache::EntryHash(const Request& req, uint32_t bit) {

@@ -1,0 +1,170 @@
+#include "hvd/schedule.h"
+
+namespace hvd {
+
+const char* const kCollectiveAlgoNames[kNumCollectiveAlgos] = {
+    "auto", "ring", "hd", "striped", "doubling", "hier"};
+
+const char* CollectiveAlgoName(int algo) {
+  return algo >= 0 && algo < kNumCollectiveAlgos ? kCollectiveAlgoNames[algo]
+                                                 : "?";
+}
+
+namespace {
+
+void Push(ChunkSchedule* s, int step, int peer, int chunk, ChunkAction a,
+          uint8_t flags = 0) {
+  ChunkOp op;
+  op.step = step;
+  op.peer = peer;
+  op.chunk = chunk;
+  op.action = a;
+  op.flags = flags;
+  s->ops.push_back(op);
+  if (step + 1 > s->nsteps) s->nsteps = step + 1;
+}
+
+ChunkSchedule Trivial(int nchunks) {
+  ChunkSchedule s;
+  s.nchunks = nchunks;
+  for (int c = 0; c < nchunks; ++c)
+    Push(&s, 0, 0, c, ChunkAction::COPY);
+  return s;
+}
+
+}  // namespace
+
+ChunkSchedule BuildHalvingDoubling(int P, int p) {
+  // Chunk grid: q = largest power of two <= P. Core ranks (q of them
+  // after the fold) run log2(q) halving reduce-scatter rounds — rank v
+  // ends owning the fully reduced chunk v — then log2(q) doubling
+  // allgather rounds. The fold/unfold legs carry the WHOLE grid as a
+  // point-to-point hand-off (kChunkFlagHandoff), exactly the ragged-P
+  // discipline of the legacy doubling exchange.
+  int q = 1;
+  while (q * 2 <= P) q *= 2;
+  const int t = P - q;
+  ChunkSchedule s;
+  s.nchunks = q;
+  if (P <= 1) return Trivial(q);
+
+  int rounds = 0;
+  for (int m = 1; m < q; m *= 2) ++rounds;
+  // Step layout (fixed so idle folded-out ranks stay in lockstep with
+  // their partner's table): [fold][R halving rounds][R doubling
+  // rounds][unfold], the fold/unfold steps existing only when t > 0.
+  const int fold_steps = t > 0 ? 1 : 0;
+  const int unfold_step = fold_steps + 2 * rounds;
+  if (t > 0 && p < 2 * t) {
+    if (p % 2 == 1) {
+      // Odd member of a fold pair: contribute everything, idle through
+      // the core rounds, receive the finished grid at the unfold.
+      for (int c = 0; c < q; ++c)
+        Push(&s, 0, p - 1, c, ChunkAction::SEND, kChunkFlagHandoff);
+      for (int c = 0; c < q; ++c)
+        Push(&s, unfold_step, p - 1, c, ChunkAction::RECV,
+             kChunkFlagHandoff);
+      s.nsteps = unfold_step + 1;
+      return s;
+    }
+    for (int c = 0; c < q; ++c)
+      Push(&s, 0, p + 1, c, ChunkAction::RECV_REDUCE, kChunkFlagHandoff);
+  }
+  const int v = p < 2 * t ? p / 2 : p - t;
+  auto pos_of = [&](int vi) { return vi < t ? 2 * vi : vi + t; };
+  int step = fold_steps;
+  // Reduce-scatter: halving block sizes, partner at halving distance;
+  // rank v ends owning the fully reduced chunk v.
+  for (int m = q / 2; m >= 1; m /= 2, ++step) {
+    const int w = pos_of(v ^ m);
+    const int base = v & ~(2 * m - 1);
+    const int keep = (v & m) ? base + m : base;
+    const int send = (v & m) ? base : base + m;
+    for (int c = send; c < send + m; ++c)
+      Push(&s, step, w, c, ChunkAction::SEND);
+    for (int c = keep; c < keep + m; ++c)
+      Push(&s, step, w, c, ChunkAction::RECV_REDUCE);
+  }
+  // Allgather: doubling block sizes, the mirror image of the rounds
+  // above. The interpreter forwards previously received chunks'
+  // encoded bytes verbatim, so under a wire codec every chunk is
+  // quantized exactly once, by its owner.
+  for (int m = 1; m < q; m *= 2, ++step) {
+    const int w = pos_of(v ^ m);
+    const int mine = v & ~(m - 1);
+    const int theirs = mine ^ m;
+    for (int c = mine; c < mine + m; ++c)
+      Push(&s, step, w, c, ChunkAction::SEND);
+    for (int c = theirs; c < theirs + m; ++c)
+      Push(&s, step, w, c, ChunkAction::RECV);
+  }
+  if (t > 0 && p < 2 * t) {
+    for (int c = 0; c < q; ++c)
+      Push(&s, unfold_step, p + 1, c, ChunkAction::SEND, kChunkFlagHandoff);
+  }
+  s.nsteps = t > 0 ? unfold_step + 1 : step;
+  return s;
+}
+
+ChunkSchedule BuildStripedRing(int P, int p, int stripes) {
+  // k independent ring instances over disjoint payload stripes; stripe
+  // j's chunk c is grid index j*P + c. Odd stripes rotate the OPPOSITE
+  // way, so with k >= 2 both duplex directions of each TCP link carry
+  // payload on every step — the classic bidirectional-ring bandwidth
+  // doubling. All stripes advance in lockstep per step, so the
+  // interpreter overlaps their transfers in one helper-thread wave.
+  if (stripes < 1) stripes = 1;
+  ChunkSchedule s;
+  s.nchunks = stripes * P;
+  if (P <= 1) return Trivial(s.nchunks);
+  auto mod = [&](int x) { return ((x % P) + P) % P; };
+  // Reduce-scatter: P-1 steps; stripe j's chunk mod(p - dir*(s+1))
+  // leaves this rank while mod(p - dir*(s+2)) arrives and folds in.
+  for (int st = 0; st < P - 1; ++st) {
+    for (int j = 0; j < stripes; ++j) {
+      const int dir = (j % 2 == 0) ? 1 : -1;
+      const int next = mod(p + dir), prev = mod(p - dir);
+      Push(&s, st, next, j * P + mod(p - dir * (st + 1)),
+           ChunkAction::SEND);
+      Push(&s, st, prev, j * P + mod(p - dir * (st + 2)),
+           ChunkAction::RECV_REDUCE);
+    }
+  }
+  // Allgather: P-1 forwarding steps; position p starts stripe j owning
+  // chunk p of that stripe.
+  for (int st = 0; st < P - 1; ++st) {
+    for (int j = 0; j < stripes; ++j) {
+      const int dir = (j % 2 == 0) ? 1 : -1;
+      const int next = mod(p + dir), prev = mod(p - dir);
+      Push(&s, (P - 1) + st, next, j * P + mod(p - dir * st),
+           ChunkAction::SEND);
+      Push(&s, (P - 1) + st, prev, j * P + mod(p - dir * (st + 1)),
+           ChunkAction::RECV);
+    }
+  }
+  return s;
+}
+
+ChunkSchedule BuildSchedule(int algo, int nranks, int pos) {
+  switch (algo) {
+    case kAlgoHd:
+      return BuildHalvingDoubling(nranks, pos);
+    case kAlgoStriped:
+      return BuildStripedRing(nranks, pos, 2);
+    case kAlgoRing:
+      return BuildStripedRing(nranks, pos, 1);
+    default:
+      return ChunkSchedule{};
+  }
+}
+
+int ResolveAlgoDefault(int64_t bytes, int np, bool hier_ok,
+                       int64_t ring_threshold_bytes) {
+  constexpr int64_t kHdMinBytes = 4 * 1024;
+  if (np <= 2) return kAlgoDoubling;
+  if (bytes >= ring_threshold_bytes) return hier_ok ? kAlgoHier : kAlgoRing;
+  if (bytes >= kHdMinBytes) return kAlgoHd;
+  return kAlgoDoubling;
+}
+
+}  // namespace hvd

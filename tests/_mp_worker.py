@@ -713,6 +713,107 @@ def main():
                                        err_msg=cname)
         print("DIGEST " + "|".join(digests))
 
+    elif scenario == "algo_parity":
+        # Every TCP-plane algorithm (ring / hd / striped / doubling and
+        # the coordinator's auto pick) must produce the PR 2 ring
+        # path's exact bits on integer-valued data — float sums of
+        # small integers are exact, so any ordering of the reduction
+        # agrees bitwise and the comparison is an equality, not a
+        # tolerance. Then, under every lossy codec, all ranks must land
+        # on BITWISE identical results for hd/striped (the interpreter
+        # forwards each chunk's encoded bytes verbatim and fresh
+        # encodes self-decode, so every chunk is quantized exactly once
+        # by its owner). Run with HOROVOD_SHM_DISABLE=1 so the TCP
+        # plane — not the arena — executes.
+        import hashlib
+
+        rng = np.random.RandomState(100 + r)
+        x = rng.randint(-50, 50, 120001).astype(np.float32)
+        want = sum(np.random.RandomState(100 + k)
+                   .randint(-50, 50, 120001).astype(np.float32)
+                   for k in range(s))
+        ref = np.asarray(hvd.allreduce(x.copy(), op=hvd.Sum, name="ap.ref",
+                                       algorithm="ring"))
+        assert (ref == want).all(), "ring reference wrong"
+        for algo in ("hd", "striped", "doubling", None):
+            out = np.asarray(hvd.allreduce(x.copy(), op=hvd.Sum,
+                                           name=f"ap.{algo}",
+                                           algorithm=algo))
+            assert out.tobytes() == ref.tobytes(), (
+                f"{algo} differs from the ring path on exact data")
+        # A payload in the latency band rides the table's hd pick at
+        # np>=3 and must still be exact.
+        small = np.asarray(hvd.allreduce(
+            np.full(8000, float(r + 1), np.float32), op=hvd.Sum,
+            name="ap.small"))
+        assert (small == sum(range(1, s + 1))).all()
+        # MIN/MAX ride the interpreter's HostAccumulate dispatch too.
+        mx = np.asarray(hvd.allreduce(x.copy(), op=hvd.Max, name="ap.max",
+                                      algorithm="hd"))
+        assert (mx == np.maximum.reduce(
+            [np.random.RandomState(100 + k).randint(-50, 50, 120001)
+             .astype(np.float32) for k in range(s)])).all()
+        # Lossy codecs: parity within wire tolerance + cross-rank
+        # bitwise agreement (digests compared by the test driver).
+        y = rng.randn(90007).astype(np.float32)
+        base = np.asarray(hvd.allreduce(y.copy(), op=hvd.Sum, name="ap.b",
+                                        algorithm="hd",
+                                        compression=hvd.Compression.none))
+        amax = float(np.abs(base).max())
+        digests = []
+        for algo in ("hd", "striped"):
+            for cname, tol in (("bf16", 2**-5), ("fp16", 2**-7),
+                               ("int8", 0.05)):
+                out = np.asarray(hvd.allreduce(
+                    y.copy(), op=hvd.Sum, name=f"ap.{algo}.{cname}",
+                    algorithm=algo,
+                    compression=getattr(hvd.Compression, cname)))
+                np.testing.assert_allclose(out, base, atol=amax * tol,
+                                           err_msg=f"{algo}/{cname}")
+                digests.append(
+                    f"{algo}.{cname}:"
+                    f"{hashlib.sha1(out.tobytes()).hexdigest()}")
+        print("DIGEST " + "|".join(digests))
+        print(f"OK rank={r}")
+
+    elif scenario == "algo_ef":
+        # int8 error feedback through the schedule interpreter: the
+        # residual slab must make a repeated allreduce's time-average
+        # converge — including at ragged np (the fold hand-off carries
+        # EF too; an uncompensated fold leaves a systematic bias the
+        # average can never shake).
+        rng = np.random.RandomState(7 + r)
+        x = rng.randn(60013).astype(np.float32)
+        base = np.asarray(hvd.allreduce(x.copy(), op=hvd.Sum, name="ae.b",
+                                        algorithm="hd",
+                                        compression=hvd.Compression.none))
+        outs = [np.asarray(hvd.allreduce(x, op=hvd.Sum, name="ae.i8",
+                                         algorithm="hd",
+                                         compression=hvd.Compression.int8))
+                for _ in range(48)]
+        single = float(np.abs(outs[0] - base).max())
+        mean_err = float(np.abs(np.mean(outs, axis=0) - base).max())
+        assert single > 1e-4, "int8 wire produced an exact result?"
+        assert mean_err < single / 8, (single, mean_err)
+        print(f"OK rank={r}")
+
+    elif scenario == "algo_env":
+        # Cross-rank algorithm agreement under CONFLICTING env knobs:
+        # the test launches each rank with a different
+        # HOROVOD_COLLECTIVE_ALGO and HOROVOD_RING_THRESHOLD. Rank 0's
+        # synced values win (param sync), and the coordinator resolves
+        # the concrete algorithm into every Response — so the job must
+        # complete with exact results instead of deadlocking two ranks
+        # into different exchanges (the failure mode the old post-sync
+        # threshold note in ops.cc merely documented).
+        for i, n in enumerate((1000, 40000, 300000)):
+            x = np.full(n, float(r + 1), np.float32)
+            out = np.asarray(hvd.allreduce(x, op=hvd.Sum, name=f"ae.{i}"))
+            assert (out == sum(range(1, s + 1))).all(), (i, out[:4])
+        # The introspected force is rank 0's, on every rank.
+        print(f"ALGO {hvd.collective_algo()}")
+        print(f"OK rank={r}")
+
     elif scenario == "shm_segmented":
         # Multi-segment shm allreduce (HOROVOD_SHM_SEGMENT_BYTES forced
         # tiny by the test): odd payload lengths so segment boundaries

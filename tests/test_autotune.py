@@ -209,6 +209,11 @@ def test_autotune_csv_carries_categoricals(tmp_path):
         # here — single process offers no wire to compress).
         assert all(r["wire_codec"] in ("0", "1", "2", "3") for r in rows), \
             rows[0]
+        # And the collective-algorithm level (0 = table, 1..3 = forced
+        # ring/hd/striped; fixed at 0 here — single process offers no
+        # TCP plane to pick algorithms on).
+        assert all(r["collective_algo"] in ("0", "1", "2", "3")
+                   for r in rows), rows[0]
     finally:
         for k in ("HOROVOD_AUTOTUNE", "HOROVOD_AUTOTUNE_WINDOW_SECS",
                   "HOROVOD_AUTOTUNE_LOG", "HOROVOD_CYCLE_TIME"):
@@ -239,6 +244,56 @@ def test_autotune_explores_wire_codec(tmp_path):
     seen = {r["wire_codec"] for r in rows}
     # Never above the operator's ceiling; starts AT the ceiling.
     assert seen <= {"0", "1", "2", "3"} and "3" in seen, seen
+
+
+def test_autotune_explores_collective_algo(tmp_path):
+    """np=2 TCP with bayes autotune and HOROVOD_COLLECTIVE_ALGO unset:
+    the algorithm dimension joins the search, forced picks ride the
+    tuned broadcast and the coordinator resolves them into every
+    response, and the job stays correct through every sampled
+    algorithm (constant traffic tensors — protocol correctness, not
+    tolerance). The CSV must show the search actually left the table
+    default at least once."""
+    log = os.path.join(str(tmp_path), "algo_at.csv")
+    run_job("traffic", 2, timeout=150, extra_env={
+        "HOROVOD_AUTOTUNE": "1",
+        "HOROVOD_AUTOTUNE_WINDOW_SECS": "0.05",
+        "HOROVOD_AUTOTUNE_LOG": log,
+        "HOROVOD_CYCLE_TIME": "0.5",
+        "HOROVOD_SHM_DISABLE": "1",
+        "TRAFFIC_ITERS": "1500",
+    })
+    with open(log) as f:
+        rows = list(csv.DictReader(f))
+    assert len(rows) >= 2, rows
+    seen = {r["collective_algo"] for r in rows}
+    assert seen <= {"0", "1", "2", "3"}, seen
+    # The GP must have sampled at least one forced algorithm level.
+    assert seen != {"0"}, seen
+
+
+def test_autotune_never_fights_an_explicit_algo_force(tmp_path):
+    """With HOROVOD_COLLECTIVE_ALGO set by the operator, the algorithm
+    dimension must NOT join the search: every sample logs the forced
+    level, analogous to the wire ceiling discipline. `doubling` (id 4)
+    sits ABOVE the searchable levels on purpose — the CSV must report
+    the algorithm the job actually runs, not a value clamped into the
+    search range (4 aliasing to 3 would log "striped" for a doubling
+    job)."""
+    log = os.path.join(str(tmp_path), "algo_forced.csv")
+    run_job("traffic", 2, timeout=150, extra_env={
+        "HOROVOD_AUTOTUNE": "1",
+        "HOROVOD_AUTOTUNE_WINDOW_SECS": "0.05",
+        "HOROVOD_AUTOTUNE_LOG": log,
+        "HOROVOD_CYCLE_TIME": "0.5",
+        "HOROVOD_SHM_DISABLE": "1",
+        "HOROVOD_COLLECTIVE_ALGO": "doubling",
+        "TRAFFIC_ITERS": "1000",
+    })
+    with open(log) as f:
+        rows = list(csv.DictReader(f))
+    assert len(rows) >= 2, rows
+    assert {r["collective_algo"] for r in rows} == {"4"}, rows[0]
 
 
 @pytest.mark.slow  # heavy multiprocess spawn; coverage overlaps the

@@ -26,6 +26,18 @@ def test_find_regressions_flags_nested_drop():
     assert "value" not in regs
 
 
+def test_find_regressions_algo_arm_keys():
+    """The per-algorithm busbw arms gate like any throughput key, and
+    the selection-table dump (strings) never participates."""
+    prev = {"extra": {"host_allreduce_busbw_hd_gbps_np4": {"64KB": 0.010},
+                      "collective_algo_table_np4": {"65536": "hd"}}}
+    cur = {"extra": {"host_allreduce_busbw_hd_gbps_np4": {"64KB": 0.005},
+                     "collective_algo_table_np4": {"65536": "ring"}}}
+    regs = bench.find_regressions(prev, cur)
+    assert "extra.host_allreduce_busbw_hd_gbps_np4.64KB" in regs
+    assert not any("collective_algo_table" in k for k in regs)
+
+
 def test_find_regressions_ignores_improvements_and_new_metrics():
     prev = {"value": 100.0, "extra": {"old_only": 5.0}}
     cur = {"value": 150.0, "extra": {"new_only": 1.0}}

@@ -1,0 +1,95 @@
+"""Topology-aware collective algorithm selection: multiprocess tests of
+the schedule interpreter and the coordinator-resolved algorithm table
+(native/include/hvd/schedule.h + ops.cc ExecuteSchedule).
+
+The simulator tier (tests/test_schedule.py) proves every generated
+table is complete/deadlock-free/chunk-conserving; this module proves
+the real engine — TCP sockets, helper threads, wire codecs — executes
+them correctly and that algorithm choice can never split the job."""
+
+import pytest
+
+from test_eager_multiprocess import run_job
+
+TCP = {"HOROVOD_SHM_DISABLE": "1"}
+
+
+def _digests_agree(outs):
+    digests = set()
+    for r, out in enumerate(outs):
+        assert f"OK rank={r}" in out
+        for line in out.splitlines():
+            if line.startswith("DIGEST "):
+                digests.add(line)
+    assert len(digests) == 1, digests
+
+
+def test_algo_parity_np2():
+    """np=2: every algorithm bitwise-matches the ring path on exact
+    data, and hd/striped agree across ranks under every lossy codec."""
+    _digests_agree(run_job("algo_parity", 2, timeout=180, extra_env=TCP))
+
+
+def test_algo_parity_np4():
+    """np=4: same contract with real multi-hop rings, 2-stripe
+    counter-rotation, and two halving/doubling rounds."""
+    _digests_agree(run_job("algo_parity", 4, timeout=240, extra_env=TCP))
+
+
+def test_algo_parity_np3_ragged():
+    """np=3 exercises the fold/unfold legs (q=2, one folded-out rank):
+    the ragged hand-off must preserve both exactness and cross-rank
+    byte agreement under lossy codecs."""
+    _digests_agree(run_job("algo_parity", 3, timeout=240, extra_env=TCP))
+
+
+def test_algo_int8_error_feedback_converges_ragged():
+    """int8 EF through the interpreter at ragged np=3: the fold
+    hand-off carries a residual too, so the repeated-allreduce
+    time-average converges instead of plateauing at the fold's
+    quantization bias."""
+    outs = run_job("algo_ef", 3, timeout=240, extra_env=TCP)
+    for r, out in enumerate(outs):
+        assert f"OK rank={r}" in out
+
+
+def test_conflicting_env_knobs_cannot_split_the_job():
+    """Each rank starts with a DIFFERENT HOROVOD_COLLECTIVE_ALGO and
+    HOROVOD_RING_THRESHOLD. Rank 0's values win through the param
+    sync, and the coordinator resolves one concrete algorithm into
+    every Response — the job completes with exact results and every
+    rank introspects rank 0's force (the old code merely documented
+    that divergence here would deadlock)."""
+    outs = run_job("algo_env", 2, timeout=180, extra_env=TCP,
+                   per_rank_env={
+                       0: {"HOROVOD_COLLECTIVE_ALGO": "hd",
+                           "HOROVOD_RING_THRESHOLD": "1000000000"},
+                       1: {"HOROVOD_COLLECTIVE_ALGO": "striped",
+                           "HOROVOD_RING_THRESHOLD": "1"},
+                   })
+    algos = set()
+    for r, out in enumerate(outs):
+        assert f"OK rank={r}" in out
+        for line in out.splitlines():
+            if line.startswith("ALGO "):
+                algos.add(line.split(" ", 1)[1])
+    assert algos == {"hd"}, algos
+
+
+def test_algo_env_garbage_warns_and_falls_back():
+    """A typo'd algorithm name must warn once and fall back to auto —
+    never silently alias to a different exchange."""
+    outs = run_job("algo_env", 2, timeout=180, extra_env=dict(
+        TCP, HOROVOD_COLLECTIVE_ALGO="rign"))
+    for r, out in enumerate(outs):
+        assert f"OK rank={r}" in out
+    assert any("HOROVOD_COLLECTIVE_ALGO" in out for out in outs), \
+        "sanitized parse never warned about the bad algorithm name"
+    assert any("ALGO auto" in out for out in outs)
+
+
+@pytest.mark.slow  # redundancy: np=4 parity above already drives the
+# interpreter multi-hop; this adds only the 8-rank grid shape on a
+# 2-core box (heavy spawn + timesharing), so it rides the slow tier.
+def test_algo_parity_np8():
+    _digests_agree(run_job("algo_parity", 8, timeout=360, extra_env=TCP))

@@ -20,9 +20,12 @@ def _free_port():
 
 
 def run_job(scenario: str, np_: int, timeout: int = 120, extra_env=None,
-            expected_rc=None):
+            expected_rc=None, per_rank_env=None):
     """Launch np_ ranks of the worker; expected_rc maps rank -> allowed
-    nonzero exit code (default: every rank must exit 0)."""
+    nonzero exit code (default: every rank must exit 0). per_rank_env
+    maps rank -> extra env applied to that rank ONLY — used to prove
+    coordinator-synced knobs survive deliberately conflicting
+    per-rank settings."""
     port = _free_port()
     procs = []
     for r in range(np_):
@@ -40,6 +43,7 @@ def run_job(scenario: str, np_: int, timeout: int = 120, extra_env=None,
             "JAX_PLATFORMS": "cpu",
         })
         env.update(extra_env or {})
+        env.update((per_rank_env or {}).get(r, {}))
         procs.append(subprocess.Popen(
             [sys.executable, WORKER, scenario], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
@@ -217,14 +221,16 @@ def test_wire_ring_np4():
 
 
 def test_wire_ragged_doubling_np3_agrees():
-    """np=3 forced onto the doubling path (ring threshold above the
-    payload): the ragged fold/unfold republishes the result quantized,
-    and EVERY core rank — including the solo one that owns no fold
-    partner — must requantize its own copy, or ranks drift by one
-    rounding epsilon (regression: only fold-pair ranks self-decoded)."""
+    """np=3 forced onto the doubling path (explicitly — the selection
+    table would otherwise route this latency-band payload to
+    halving-doubling): the ragged fold/unfold republishes the result
+    quantized, and EVERY core rank — including the solo one that owns
+    no fold partner — must requantize its own copy, or ranks drift by
+    one rounding epsilon (regression: only fold-pair ranks
+    self-decoded)."""
     outs = run_job("wire_ring", 3, timeout=180, extra_env={
         "HOROVOD_SHM_DISABLE": "1",
-        "HOROVOD_RING_THRESHOLD": "1000000000",
+        "HOROVOD_COLLECTIVE_ALGO": "doubling",
     })
     digests = set()
     for r, out in enumerate(outs):

@@ -170,6 +170,12 @@ def _sampler_sync_worker():
     return remaining, len(sampler.processed_indices)
 
 
+@pytest.mark.slow  # redundancy: the sampler's partition/record/resume
+# logic is pinned in-process every run by
+# test_elastic_sampler_partition_and_resume, and TorchState sync rides
+# the same state-broadcast path the other elastic tests drive — slow
+# tier keeps the np=2 union-sync composition without a ~20s tier-1
+# spawn.
 def test_elastic_sampler_sync_unions_progress():
     results = run(_sampler_sync_worker, np=2, env=_WORKER_ENV,
                   start_timeout=90)

@@ -32,6 +32,11 @@ def test_torch_mnist_example_2proc(capfd):
     assert "rank 0:" in out and "rank 1:" in out
 
 
+@pytest.mark.slow  # redundancy: the eager jax optimizer path this
+# example drives is pinned every run by test_jax_optimizer's
+# two-process tier and test_train_identical_1proc_vs_2proc; the
+# example-script smoke joins the torch mnist example in the slow tier
+# (PR 6 discipline) to keep tier-1 inside its wall-clock budget.
 def test_jax_mnist_example_2proc(capfd):
     run_command(
         [sys.executable, os.path.join(ROOT, "examples", "jax_mnist.py"),

@@ -11,8 +11,8 @@ slowdown. Measured wall time on the 2-core dev box (pytest totals,
 INCLUDING the one-off per-variant rebuild make amortizes away on
 reruns):
 
-    tsan half  (4 scenarios):          53s
-    asan+ubsan half (4 + 1 scenarios): 144s
+    tsan half  (5 scenarios):          ~60s
+    asan+ubsan half (5 + 1 scenarios): ~150s
 
 Wiring that is easy to get wrong (and why it is the way it is):
   * HOROVOD_NATIVE_LIB points the ctypes loader at the suffixed .so
@@ -60,6 +60,10 @@ SCENARIOS = [
     ("wire_ring", 4, {"HOROVOD_SHM_DISABLE": "1"}),
     ("metrics", 2, {}),
     ("stall", 2, {"HOROVOD_STALL_CHECK_TIME_SECONDS": "0.5"}),
+    # Schedule interpreter (ISSUE 7): per-step receiver-thread waves +
+    # the encoded-chunk cache, across hd/striped/doubling and every
+    # codec, at the ragged np that exercises fold/unfold.
+    ("algo_parity", 3, {"HOROVOD_SHM_DISABLE": "1"}),
 ]
 
 _RUNTIME_LIB = {"tsan": "libtsan.so", "asan": "libasan.so",

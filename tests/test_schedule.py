@@ -1,0 +1,190 @@
+"""Schedule-interpreter unit tests on a simulated in-process executor.
+
+The chunk-schedule tables (native/include/hvd/schedule.h) are pure
+functions of (algorithm, nranks, position), exposed through
+``hvd_build_schedule``. This module executes every generated table for
+np ∈ {2, 3, 4, 8} on a lockstep simulator and verifies the properties
+the real interpreter relies on:
+
+* **complete** — every rank ends holding the full allreduce result;
+* **deadlock-free** — per (step, src→dst) pair the sender's chunk list
+  and the receiver's chunk list match exactly, in order (the real
+  engine posts one receiver thread per peer and streams sends in table
+  order, so matched per-step tables cannot deadlock);
+* **chunk-conserving** — nothing is received that was not sent, and a
+  rank never sends and receives the same chunk in one step (the
+  interpreter's buffers would race).
+
+Integer-valued chunk data makes float summation exact, so completeness
+is an equality check, not a tolerance.
+"""
+
+import ctypes
+
+import pytest
+
+from horovod_tpu.common.basics import get_lib
+
+ALGO_RING, ALGO_HD, ALGO_STRIPED = 1, 2, 3
+SEND, RECV, RECV_REDUCE, COPY = 0, 1, 2, 3
+
+NPS = (2, 3, 4, 8)
+ALGOS = ((ALGO_RING, "ring"), (ALGO_HD, "hd"), (ALGO_STRIPED, "striped"))
+
+
+def build(algo, nranks, pos):
+    lib = get_lib()
+    ns, nc = ctypes.c_int(), ctypes.c_int()
+    n = lib.hvd_build_schedule(algo, nranks, pos, ctypes.byref(ns),
+                               ctypes.byref(nc), None, 0)
+    buf = (ctypes.c_int32 * (n * 5))()
+    lib.hvd_build_schedule(algo, nranks, pos, ctypes.byref(ns),
+                           ctypes.byref(nc), buf, n)
+    ops = [tuple(buf[i * 5:i * 5 + 5]) for i in range(n)]
+    return ns.value, nc.value, ops
+
+
+def simulate(algo, nranks):
+    """Run all ranks' tables in lockstep; returns per-rank final chunk
+    values. Raises AssertionError on any framing violation."""
+    scheds = [build(algo, nranks, p) for p in range(nranks)]
+    nsteps = max(s[0] for s in scheds)
+    nchunks = scheds[0][1]
+    assert all(s[1] == nchunks for s in scheds), "chunk grids disagree"
+    val = [[(r + 1) * 1000 + c for c in range(nchunks)]
+           for r in range(nranks)]
+    for step in range(nsteps):
+        sends = {}
+        for p in range(nranks):
+            touched_send, touched_recv = set(), set()
+            for (st, peer, chunk, act, _fl) in scheds[p][2]:
+                if st != step:
+                    continue
+                assert 0 <= chunk < nchunks
+                assert 0 <= peer < nranks and peer != p
+                if act == SEND:
+                    touched_send.add(chunk)
+                    sends.setdefault((p, peer), []).append(
+                        (chunk, val[p][chunk]))
+                elif act in (RECV, RECV_REDUCE):
+                    assert chunk not in touched_recv, (
+                        f"rank {p} step {step}: receives chunk {chunk} "
+                        f"twice — two receiver threads would race on one "
+                        f"buffer region")
+                    touched_recv.add(chunk)
+            assert not (touched_send & touched_recv), (
+                f"rank {p} step {step}: sends and receives the same chunk "
+                f"— the engine's buffers would race")
+        consumed = {k: 0 for k in sends}
+        new = [row[:] for row in val]
+        for p in range(nranks):
+            for (st, peer, chunk, act, _fl) in scheds[p][2]:
+                if st != step or act not in (RECV, RECV_REDUCE):
+                    continue
+                key = (peer, p)
+                assert key in sends and consumed[key] < len(sends[key]), (
+                    f"step {step}: rank {p} receives from {peer} with no "
+                    f"matching send — the real engine would deadlock")
+                got_chunk, got_val = sends[key][consumed[key]]
+                consumed[key] += 1
+                assert got_chunk == chunk, (
+                    f"step {step} {peer}->{p}: chunk order mismatch "
+                    f"(sent {got_chunk}, expected {chunk})")
+                new[p][chunk] = (got_val if act == RECV
+                                 else new[p][chunk] + got_val)
+        for key, n in consumed.items():
+            assert n == len(sends[key]), (
+                f"step {step}: {len(sends[key]) - n} unconsumed sends "
+                f"{key} — the sender would block forever")
+        val = new
+    return val, nchunks
+
+
+@pytest.mark.parametrize("algo,name", ALGOS)
+@pytest.mark.parametrize("nranks", NPS)
+def test_schedule_complete_and_deadlock_free(algo, name, nranks):
+    val, nchunks = simulate(algo, nranks)
+    want = [sum((r + 1) * 1000 + c for r in range(nranks))
+            for c in range(nchunks)]
+    for p in range(nranks):
+        assert val[p] == want, (
+            f"{name} np={nranks} rank {p} incomplete: {val[p][:4]}...")
+
+
+@pytest.mark.parametrize("nranks", NPS)
+def test_hd_latency_steps_beat_ring(nranks):
+    """The point of halving-doubling: O(log P) steps where the ring
+    pays 2(P-1). (Equal at the power-of-two np=2/4 boundary cases only
+    when 2 log2 P == 2(P-1), i.e. P <= 2.)"""
+    hd_steps = build(ALGO_HD, nranks, 0)[0]
+    ring_steps = build(ALGO_RING, nranks, 0)[0]
+    assert hd_steps <= ring_steps
+    if nranks >= 5:
+        assert hd_steps < ring_steps
+
+
+def test_striped_uses_both_directions():
+    """With 2 stripes the two rings must rotate opposite ways — that is
+    what makes striping drive both duplex directions of each link."""
+    _, _, ops = build(ALGO_STRIPED, 4, 0)
+    step0_send_peers = {o[1] for o in ops if o[0] == 0 and o[3] == SEND}
+    assert step0_send_peers == {1, 3}, step0_send_peers
+
+
+def test_hd_ragged_handoff_flagged():
+    """Ragged P marks the fold/unfold ops as hand-offs (schedule.h
+    kChunkFlagHandoff) — the structural record of which legs are
+    point-to-point republishes rather than persistent ring sites."""
+    _, _, ops = build(ALGO_HD, 3, 1)  # the folded-out odd rank
+    assert ops, "odd rank must fold and unfold"
+    assert all(fl == 1 for (_s, _p, _c, _a, fl) in ops), ops
+    acts = {a for (_s, _p, _c, a, _f) in ops}
+    assert acts == {SEND, RECV}, acts
+
+
+# ---------------------------------------------------------------------------
+# Default selection table (hvd_algo_select = ResolveAlgoDefault)
+# ---------------------------------------------------------------------------
+
+ALGO_DOUBLING, ALGO_HIER = 4, 5
+RING_THRESHOLD = 64 * 1024
+
+
+def _select(bytes_, np_, hier_ok=False, threshold=RING_THRESHOLD):
+    return get_lib().hvd_algo_select(ctypes.c_int64(bytes_), np_,
+                                     1 if hier_ok else 0,
+                                     ctypes.c_int64(threshold))
+
+
+def test_table_small_payloads_ride_doubling():
+    assert _select(256, 4) == ALGO_DOUBLING
+    assert _select(2048, 8) == ALGO_DOUBLING
+
+
+def test_table_latency_band_rides_hd():
+    for b in (4 * 1024, 16 * 1024, RING_THRESHOLD - 1):
+        assert _select(b, 4) == ALGO_HD, b
+
+
+def test_table_bandwidth_band_rides_ring_or_hier():
+    assert _select(RING_THRESHOLD, 4) == ALGO_RING
+    assert _select(16 << 20, 4) == ALGO_RING
+    assert _select(16 << 20, 4, hier_ok=True) == ALGO_HIER
+
+
+def test_table_np2_always_doubling():
+    """At P=2 every algorithm degenerates to one exchange; doubling
+    does it in a single round trip."""
+    for b in (16, 16 * 1024, 16 << 20):
+        assert _select(b, 2) == ALGO_DOUBLING, b
+
+
+def test_table_respects_ring_threshold_knob():
+    assert _select(8 * 1024, 4, threshold=4 * 1024) == ALGO_RING
+    assert _select(1 << 20, 4, threshold=1 << 30) == ALGO_HD
+
+
+def test_algo_names_roundtrip():
+    lib = get_lib()
+    names = [lib.hvd_algo_name(i).decode() for i in range(6)]
+    assert names == ["auto", "ring", "hd", "striped", "doubling", "hier"]

@@ -129,6 +129,11 @@ def _adasum_step_worker():
     return out
 
 
+@pytest.mark.slow  # redundancy: adasum math + the host data plane are
+# pinned by tests/test_adasum.py's fast-tier np=2 cases, and the
+# DistributedOptimizer op= plumbing this adds is the same wrapper path
+# test_two_rank_grad_average drives every run — slow tier keeps the
+# full composition without paying a ~22s spawn in tier-1.
 def test_two_rank_adasum_optimizer():
     from _adasum_model import adasum_fold_model
 
