@@ -448,7 +448,8 @@ def _serve_worker():
     benchmark so a kill mid-run keeps the finished part."""
     try:
         from horovod_tpu.serve.bench import (
-            run_prefix_benchmark, run_serving_benchmark,
+            run_prefix_benchmark, run_router_benchmark,
+            run_serving_benchmark,
         )
 
         # The benchmark's own contract: continuous batching must beat
@@ -460,14 +461,20 @@ def _serve_worker():
         # shared-prefix trace (the tokens-per-request lever).
         out.update(run_prefix_benchmark(n_requests=32))
         print("SERVEEXTRA " + json.dumps(out), flush=True)
+        # Fleet tier: routed vs random placement at 4 replicas on the
+        # multi-tenant trace (the placement lever above the engine).
+        # Last, so a budget kill keeps the single-replica keys.
+        out.update(run_router_benchmark(n_requests=32))
+        print("SERVEEXTRA " + json.dumps(out), flush=True)
     except Exception:
         pass
 
 
 def _serve_extra(remaining_secs: float):
-    """Serving benchmark extra (continuous-batching engine)."""
+    """Serving benchmark extra (continuous-batching engine + fleet
+    router; the cap grew with the third, fleet-level stage)."""
     return _worker_extra("--serve-worker", "SERVEEXTRA",
-                         remaining_secs, 240.0)
+                         remaining_secs, 300.0)
 
 
 def _previous_bench(bench_dir=None):
@@ -503,8 +510,12 @@ LOWER_IS_BETTER_SUFFIXES = ("_ms",)
 # jumps in powers of two with scheduler noise; _fill_pct tracks the
 # autotuner's live fusion threshold. Neither has a stable enough
 # better/worse direction for a 10% gate — they are trajectory keys.
+# _count covers the fleet-router tallies (handoffs moved, replicas in
+# the fleet): pure counts with no better/worse direction, while the
+# router's hit-rate/throughput keys gate higher-is-better and its
+# *_ms keys ride the latency inversion above.
 UNGATED_SUFFIXES = ("_steps", "_evictions", "_high_water", "_us_p99",
-                    "_fill_pct")
+                    "_fill_pct", "_count")
 
 
 def find_regressions(prev, cur, threshold=0.10):

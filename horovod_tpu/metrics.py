@@ -200,11 +200,28 @@ def _sanitize(name: str) -> str:
     return ("_" + s) if s and s[0].isdigit() else (s or "_")
 
 
-def render_gauges(prefix: str, values: Dict[str, object]) -> str:
+def _escape_label(v: str) -> str:
+    """Prometheus label-value escaping: backslash, quote, newline."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def render_gauges(prefix: str, values: Dict[str, object],
+                  labels: Optional[Dict[str, str]] = None) -> str:
     """Shared exposition helper: render a flat dict as gauge families
     under ``prefix`` (None values are skipped — an empty latency series
     has no sample, not a 0). The serving engine's snapshot renders
-    through here, so serving and training speak one text format."""
+    through here, so serving and training speak one text format.
+    ``labels`` (e.g. ``{"instance": "3"}``) ride every sample so
+    several exporters of the same family — N engine replicas in one
+    process — emit distinguishable series instead of colliding on the
+    bare name (:func:`metrics_prometheus` dedupes the per-family TYPE
+    line across fragments)."""
+    label_str = ""
+    if labels:
+        label_str = "{" + ",".join(
+            f'{_sanitize(k)}="{_escape_label(v)}"'
+            for k, v in sorted(labels.items())) + "}"
     lines = []
     for key in sorted(values):
         v = values[key]
@@ -212,7 +229,7 @@ def render_gauges(prefix: str, values: Dict[str, object]) -> str:
             continue
         name = f"{_sanitize(prefix)}_{_sanitize(key)}"
         lines.append(f"# TYPE {name} gauge")
-        lines.append(f"{name} {v}")
+        lines.append(f"{name}{label_str} {v}")
     return "\n".join(lines) + ("\n" if lines else "")
 
 
@@ -284,7 +301,11 @@ def metrics_prometheus() -> str:
     """Full-process Prometheus text exposition: the native registry
     plus every registered secondary exporter (serving). Scrape it via
     :func:`start_metrics_server` or dump it with
-    ``bin/hvd-metrics-dump``."""
+    ``bin/hvd-metrics-dump``. Duplicate per-family ``# TYPE`` lines
+    across fragments are dropped (the format allows one TYPE line per
+    metric name): N engine replicas each export the same ``serve_*``
+    families with different ``instance`` labels, and the first
+    fragment's TYPE line speaks for all of them."""
     parts = [render_native()]
     with _exporters_lock:
         fns = list(_exporters.items())
@@ -295,7 +316,22 @@ def metrics_prometheus() -> str:
             continue  # one sick exporter must not kill the scrape
         if frag:
             parts.append(frag)
-    return "".join(p if p.endswith("\n") else p + "\n" for p in parts)
+    lines: List[str] = []
+    typed: set = set()
+    for part in parts:
+        for line in part.splitlines():
+            if line.startswith("# TYPE "):
+                # Tolerate a malformed exporter line (too few tokens):
+                # the per-exporter try/except above can't catch THIS
+                # loop, and one sick fragment must not 500 the scrape.
+                toks = line.split()
+                fam = toks[2] if len(toks) >= 3 else None
+                if fam is not None:
+                    if fam in typed:
+                        continue
+                    typed.add(fam)
+            lines.append(line)
+    return "\n".join(lines) + "\n"
 
 
 # ---------------------------------------------------------------------------

@@ -5,7 +5,10 @@ an iteration-level scheduler (:class:`ServeEngine`) drives jitted
 prefill/decode step functions (:mod:`horovod_tpu.serve.decode`) over a
 paged KV cache (:mod:`horovod_tpu.serve.kv_cache`) on the same
 ``jax.sharding.Mesh`` the trainers use, and reports throughput + tail
-latency through :mod:`horovod_tpu.serve.metrics`.
+latency through :mod:`horovod_tpu.serve.metrics`. Above the single
+engine, :mod:`horovod_tpu.serve.router` runs a fleet: N replicas
+behind a cache-affinity admission router with prefill/decode pools
+(KV handoff) and deadline-class load shedding.
 
 Quick start::
 
@@ -24,6 +27,7 @@ See ``docs/serving.md`` for architecture and tuning.
 """
 
 from horovod_tpu.serve.engine import (  # noqa: F401
+    PrefillHandoff,
     QueueFull,
     RequestResult,
     ServeConfig,
@@ -35,14 +39,23 @@ from horovod_tpu.serve.kv_cache import (  # noqa: F401
     NULL_BLOCK,
     OutOfBlocks,
     block_hash,
+    hash_chain,
     init_kv_cache,
     pick_bucket,
 )
 from horovod_tpu.serve.decode import make_serve_fns  # noqa: F401
 from horovod_tpu.serve.metrics import ServeMetrics, percentile  # noqa: F401
+from horovod_tpu.serve.router import (  # noqa: F401
+    FleetMetrics,
+    FleetSaturated,
+    RouterConfig,
+    ServeRouter,
+)
 from horovod_tpu.serve.bench import (  # noqa: F401
+    make_multi_tenant_trace,
     make_shared_prefix_trace,
     make_trace,
     run_prefix_benchmark,
+    run_router_benchmark,
     run_serving_benchmark,
 )

@@ -100,10 +100,11 @@ def _ffn(cfg, lp, x):
 
 def make_serve_fns(cfg, mesh: Optional[Any] = None, *, block_size: int,
                    table_width: int):
-    """Build (prefill, prefill_resume, decode) jitted closures for
-    ``cfg`` over ``mesh``. ``table_width`` is the static block-table
-    row length (blocks per sequence, worst case); caches are donated
-    so steady-state decode updates the pool in place.
+    """Build (prefill, prefill_resume, decode, inject) jitted closures
+    for ``cfg`` over ``mesh``. ``table_width`` is the static block-
+    table row length (blocks per sequence, worst case); caches are
+    donated so steady-state decode — and the handoff-page ``inject``
+    scatter — update the pool in place.
 
     Memoized: engines sharing (cfg, mesh, block geometry) — e.g. the
     benchmark's continuous and static schedulers, or a fleet of
@@ -281,10 +282,24 @@ def _cached_serve_fns(cfg, mesh, block_size: int, table_width: int):
         logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
         return kc, vc, jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
+    def inject(kc, vc, blocks, k_pages, v_pages):
+        """Scatter handed-off prompt pages into this pool (the
+        prefill/decode disaggregation receive path). blocks
+        [table_width] i32 — real target blocks first, then NULL_BLOCK
+        padding whose zero pages land on the never-read null block
+        (the same padding contract as the prefill bucket blocks);
+        k/v_pages [L, table_width, bs, Hkv, Dh]. One compiled program
+        per geometry; without it the un-jitted ``.at[].set`` fallback
+        copies the ENTIRE pool per handoff instead of O(pages)."""
+        kc = kc.at[:, blocks].set(k_pages.astype(kc.dtype))
+        vc = vc.at[:, blocks].set(v_pages.astype(vc.dtype))
+        return kc, vc
+
     # Donate the cache pool: steady-state decode rewrites it in place
     # instead of allocating a fresh [L, n_blocks, bs, Hkv, Dh] copy
     # per step. `length`/`offset`/`positions` stay traced (they change
     # every call); only array shapes key the jit cache.
     return (jax.jit(prefill, donate_argnums=(1, 2)),
             jax.jit(prefill_resume, donate_argnums=(1, 2)),
-            jax.jit(decode, donate_argnums=(1, 2)))
+            jax.jit(decode, donate_argnums=(1, 2)),
+            jax.jit(inject, donate_argnums=(0, 1)))

@@ -40,8 +40,27 @@ Two throughput levers sit on top of the paged layout:
 
 Deadlines are absolute engine-clock times by which a request must be
 *admitted* (first token scheduled); stale requests are rejected with a
-503-style result rather than burning prefill FLOPs on an answer
+**structured rejection** (machine-readable ``reason``, the request's
+``deadline_class``, and a ``retry_after_s`` estimate derived from the
+queue depth and the engine's recent retirement rate) rather than a
+blanket 503 — and rather than burning prefill FLOPs on an answer
 nobody is waiting for. The clock is injectable for tests.
+
+Fleet hooks (used by :mod:`horovod_tpu.serve.router`, all cheap
+host-side reads or bounded mutations — none of them step the engine):
+
+* :meth:`admission_snapshot` — occupancy / free KV blocks / queue
+  depth, what a router polls to pick a replica;
+* :meth:`cached_chain_len` — how many leading blocks of a prompt's
+  hash chain this replica's content index already holds (the
+  cache-affinity placement signal);
+* :meth:`withdraw` — reclaim a still-queued request (replica drain);
+* ``submit(..., prefill_only=True)`` + :meth:`handoff_ready` /
+  :meth:`export_prefilled` / :meth:`inject_prefilled` — the
+  disaggregated prefill/decode path: a prefill replica runs the
+  prompt through the existing chunked-prefill machinery, parks the
+  finished sequence, and the router moves its K/V pages (bitwise) to
+  a decode replica's pool where decoding continues.
 
 Determinism: FIFO admission, stable batch-slot assignment, greedy
 argmax in-jit — the same submission order always yields bitwise the
@@ -60,14 +79,24 @@ import numpy as np
 
 from horovod_tpu.serve import decode as decode_lib
 from horovod_tpu.serve.kv_cache import (
-    BlockAllocator, block_hash, init_kv_cache, pick_bucket,
+    BlockAllocator, hash_chain, init_kv_cache, pick_bucket,
 )
 from horovod_tpu.serve.metrics import ServeMetrics
 
 
 class QueueFull(RuntimeError):
-    """Admission-queue backpressure — shed load upstream."""
+    """Admission-queue backpressure — shed load upstream. Carries the
+    structured-rejection fields so a caller (or the fleet router) can
+    tell its client *when* to retry instead of hammering a 503."""
     http_status = 503
+
+    def __init__(self, msg: str, *, reason: str = "queue_full",
+                 queue_depth: int = 0,
+                 retry_after_s: Optional[float] = None):
+        super().__init__(msg)
+        self.reason = reason
+        self.queue_depth = queue_depth
+        self.retry_after_s = retry_after_s
 
 
 @dataclasses.dataclass(frozen=True)
@@ -106,13 +135,20 @@ class ServeConfig:
 @dataclasses.dataclass
 class RequestResult:
     rid: int
-    status: str                  # "ok" | "expired"
+    status: str                  # "ok" | "expired" | "shed"
     http_status: int             # 200 | 503
     tokens: List[int]
     n_prompt: int
     submitted_at: float
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
+    # Structured rejection (status != "ok"): machine-readable reason
+    # ("deadline_expired" | "shed_low_class"), the request's deadline
+    # class, and how long the client should back off — estimated from
+    # the queue depth times the engine's recent retirement interval.
+    reason: Optional[str] = None
+    deadline_class: int = 0
+    retry_after_s: Optional[float] = None
 
     @property
     def first_token_latency_s(self) -> Optional[float]:
@@ -130,6 +166,8 @@ class _Queued:
     submitted_at: float
     chain: List[bytes]           # content-hash chain, hashed once at
     #                              submit (not per admission retry)
+    deadline_class: int = 0
+    prefill_only: bool = False   # park for handoff instead of decoding
 
 
 @dataclasses.dataclass
@@ -150,6 +188,8 @@ class _Seq:
     last_prefill_tok: int = 0    # argmax of the newest chunk's last
     #                              real position; the first generated
     #                              token once prefill completes
+    deadline_class: int = 0
+    prefill_only: bool = False
 
     @property
     def last_token(self) -> int:
@@ -158,6 +198,89 @@ class _Seq:
     def finished(self, eos_id: Optional[int]) -> bool:
         return (len(self.generated) >= self.max_new
                 or (eos_id is not None and self.last_token == eos_id))
+
+
+@dataclasses.dataclass
+class PrefillHandoff:
+    """A completed prefill packaged for a decode replica: the prompt's
+    K/V pages as host copies plus the request state needed to continue
+    decoding elsewhere. The pages are bitwise copies and the decode
+    math is position-dependent only, so a handed-off sequence decodes
+    to exactly the tokens it would have produced in place."""
+
+    prompt: List[int]
+    max_new: int
+    generated: List[int]         # [first_token] — prefill emits it
+    submitted_at: float
+    first_token_at: float
+    deadline_class: int
+    chain: List[bytes]           # content-hash chain (may be empty)
+    k_pages: Any                 # [L, n_prompt_blocks, bs, Hkv, Dh]
+    v_pages: Any
+    block_size: int
+
+    @property
+    def n_prompt_blocks(self) -> int:
+        return int(self.k_pages.shape[1])
+
+
+class RetireEma:
+    """Inter-retirement interval EMA: the drain-rate signal behind
+    every ``retry_after_s`` estimate. One implementation shared by
+    the engine and the fleet router so the smoothing (0.8/0.2,
+    first-observation seeding) can never diverge between tiers."""
+
+    def __init__(self):
+        self.value = 0.0
+        self._last: Optional[float] = None
+
+    def observe(self, now: float) -> None:
+        if self._last is not None:
+            dt = max(now - self._last, 0.0)
+            self.value = (0.8 * self.value + 0.2 * dt
+                          if self.value else dt)
+        self._last = now
+
+    def retry_after(self, queue_depth: int) -> float:
+        """Back-off estimate: requests ahead x the recent
+        inter-retirement interval. 0.0 before any retirement."""
+        return round(queue_depth * self.value, 6)
+
+
+def validate_request(serve_cfg: ServeConfig, model_cfg, n_pool_blocks: int,
+                     prompt: List[int], max_new: int,
+                     deadline_class: int) -> None:
+    """Shared admission validation — ONE implementation for both the
+    engine and the fleet router. The router accepts requests before
+    any engine sees them; if its checks ever drifted looser than the
+    engine's, an accepted request would blow ValueError out of a later
+    placement step (popped from the queue, leaked without a result)
+    instead of rejecting at submit."""
+    if not prompt:
+        raise ValueError("empty prompt")
+    if len(prompt) > serve_cfg.max_prompt:
+        raise ValueError(
+            f"prompt length {len(prompt)} > max_prompt "
+            f"{serve_cfg.max_prompt}")
+    if not 1 <= max_new <= serve_cfg.max_new_tokens:
+        raise ValueError(
+            f"max_new_tokens {max_new} outside [1, "
+            f"{serve_cfg.max_new_tokens}]")
+    if len(prompt) + max_new > model_cfg.max_seq:
+        raise ValueError(
+            f"prompt+max_new {len(prompt) + max_new} > model max_seq "
+            f"{model_cfg.max_seq}")
+    if deadline_class < 0:
+        raise ValueError(f"deadline_class {deadline_class} < 0")
+    need = -(-(len(prompt) + max_new) // serve_cfg.block_size)
+    if need > n_pool_blocks - 1:
+        # Worst-case reservation exceeds the whole pool: admission
+        # could never succeed and FIFO would starve every request
+        # behind it — reject now, not never.
+        raise ValueError(
+            f"request needs {need} KV blocks worst-case but the pool "
+            f"holds {n_pool_blocks - 1}; raise n_blocks or lower "
+            "max_new_tokens")
 
 
 def _pow2_menu(lo: int, hi: int) -> Tuple[int, ...]:
@@ -173,7 +296,8 @@ def _pow2_menu(lo: int, hi: int) -> Tuple[int, ...]:
 class ServeEngine:
     def __init__(self, model_cfg, params, serve_cfg: Optional[ServeConfig]
                  = None, mesh: Optional[Any] = None,
-                 clock=time.perf_counter):
+                 clock=time.perf_counter,
+                 instance: Optional[str] = None):
         cfg = serve_cfg or ServeConfig()
         if cfg.scheduling not in ("continuous", "static"):
             raise ValueError(f"unknown scheduling {cfg.scheduling!r}")
@@ -222,12 +346,12 @@ class ServeEngine:
         self.allocator = BlockAllocator(n_blocks, bs)
         self.cache = init_kv_cache(model_cfg, n_blocks, bs, mesh=mesh,
                                    dtype=cfg.cache_dtype)
-        self._prefill_fn, self._resume_fn, self._decode_fn = \
-            decode_lib.make_serve_fns(
-                model_cfg, mesh, block_size=bs,
-                table_width=self._table_width)
+        (self._prefill_fn, self._resume_fn, self._decode_fn,
+         self._inject_fn) = decode_lib.make_serve_fns(
+             model_cfg, mesh, block_size=bs,
+             table_width=self._table_width)
 
-        self.metrics = ServeMetrics(clock=clock)
+        self.metrics = ServeMetrics(clock=clock, instance=instance)
         self.metrics.attach_allocator(self.allocator)
         self._queue: collections.deque[_Queued] = collections.deque()
         self._active: List[_Seq] = []
@@ -235,52 +359,54 @@ class ServeEngine:
         # hold their block reservation and consume a batch slot, but
         # only join the decode batch once prefill finishes.
         self._prefilling: List[_Seq] = []
+        # prefill_only sequences whose prefill completed: parked (with
+        # their prompt blocks held) until the router exports them to a
+        # decode replica. Not counted in `pending` — draining them is
+        # the router's job, not the step loop's.
+        self._handoff: Dict[int, _Seq] = {}
         self._results: Dict[int, RequestResult] = {}
         self._rids = itertools.count()
+        # Drain-rate signal behind retry_after_s estimates.
+        self._retire_ema = RetireEma()
 
     # -- submission --------------------------------------------------
 
     def submit(self, prompt: Sequence[int],
                max_new_tokens: Optional[int] = None,
-               deadline: Optional[float] = None) -> int:
+               deadline: Optional[float] = None,
+               deadline_class: int = 0,
+               prefill_only: bool = False,
+               chain: Optional[List[bytes]] = None) -> int:
         """Enqueue a request; returns its id. Raises :class:`QueueFull`
         when the admission queue is at capacity (backpressure) and
-        ``ValueError`` on shapes the engine cannot ever serve."""
+        ``ValueError`` on shapes the engine cannot ever serve.
+        ``deadline_class`` rides rejections so upstream shedding can
+        order them; ``prefill_only`` parks the sequence for
+        :meth:`export_prefilled` instead of decoding it here;
+        ``chain`` is the prompt's precomputed hash chain (the router
+        hashed it once at fleet admission — passing it through keeps
+        the PR 4 hash-ONCE discipline across tiers; trusted, must
+        match ``hash_chain(prompt, block_size)``)."""
         prompt = list(prompt)
         max_new = (self.cfg.max_new_tokens if max_new_tokens is None
                    else max_new_tokens)
-        if not prompt:
-            raise ValueError("empty prompt")
-        if len(prompt) > self.cfg.max_prompt:
-            raise ValueError(
-                f"prompt length {len(prompt)} > max_prompt "
-                f"{self.cfg.max_prompt}")
-        if not 1 <= max_new <= self.cfg.max_new_tokens:
-            raise ValueError(
-                f"max_new_tokens {max_new} outside [1, "
-                f"{self.cfg.max_new_tokens}]")
-        if len(prompt) + max_new > self.model_cfg.max_seq:
-            raise ValueError(
-                f"prompt+max_new {len(prompt) + max_new} > model max_seq "
-                f"{self.model_cfg.max_seq}")
-        need = self.allocator.blocks_for_tokens(len(prompt) + max_new)
-        if need > self.allocator.n_blocks - 1:
-            # Worst-case reservation exceeds the whole pool: admission
-            # could never succeed and FIFO would starve every request
-            # behind it — reject now, not never.
-            raise ValueError(
-                f"request needs {need} KV blocks worst-case but the pool "
-                f"holds {self.allocator.n_blocks - 1}; raise n_blocks or "
-                "lower max_new_tokens")
+        validate_request(self.cfg, self.model_cfg,
+                         self.allocator.n_blocks, prompt, max_new,
+                         deadline_class)
         if len(self._queue) >= self.cfg.max_queue:
             self.metrics.record_rejected()
             raise QueueFull(
-                f"admission queue full ({self.cfg.max_queue} waiting)")
+                f"admission queue full ({self.cfg.max_queue} waiting)",
+                queue_depth=len(self._queue),
+                retry_after_s=self._retry_after())
         rid = next(self._rids)
-        chain = (self._hash_chain(prompt) if self.cfg.prefix_caching
-                 else [])
+        chain = ((hash_chain(prompt, self.cfg.block_size)
+                  if chain is None else chain)
+                 if self.cfg.prefix_caching else [])
         self._queue.append(_Queued(rid, prompt, max_new, deadline,
-                                   self._clock(), chain))
+                                   self._clock(), chain,
+                                   deadline_class=deadline_class,
+                                   prefill_only=prefill_only))
         self.metrics.record_submitted()
         self.metrics.record_queue_depth(len(self._queue))
         return rid
@@ -297,6 +423,60 @@ class ServeEngine:
     @property
     def results(self) -> Dict[int, RequestResult]:
         return dict(self._results)
+
+    # -- fleet hooks (cheap host-side reads; nothing here steps the
+    #    engine or touches the device) ------------------------------
+
+    def _retry_after(self) -> float:
+        return self._retire_ema.retry_after(len(self._queue))
+
+    def admission_snapshot(self) -> Dict[str, float]:
+        """Router-facing admission state: occupancy, free KV blocks,
+        queue depth. Pure host-side counter reads — a router can poll
+        every replica per placement decision without stepping anyone
+        or syncing a device value."""
+        n_run = len(self._active) + len(self._prefilling)
+        return {
+            "queue_depth": len(self._queue),
+            "queue_slots_free": self.cfg.max_queue - len(self._queue),
+            "running": n_run,
+            "batch_slots_free": self.cfg.max_batch - n_run,
+            "occupancy": n_run / self.cfg.max_batch,
+            "kv_blocks_free": self.allocator.n_free,
+            "kv_blocks_used": self.allocator.n_used,
+            "handoff_parked": len(self._handoff),
+            "retry_after_s": self._retry_after(),
+        }
+
+    def cached_chain_len(self, chain: Sequence[bytes]) -> int:
+        """Leading blocks of ``chain`` this engine's content index
+        holds (live or cached) — the prefix-affinity placement signal.
+        Non-mutating (`peek`): polling it from a router never inflates
+        hit counters or churns the LRU order."""
+        n = 0
+        for h in chain:
+            if self.allocator.peek(h) is None:
+                break
+            n += 1
+        return n
+
+    def withdraw(self, rid: int) -> bool:
+        """Remove a still-queued (never admitted) request, dropping it
+        without a result. False if ``rid`` is unknown, already
+        admitted, or already resolved — the caller keeps its own copy
+        of the request if it intends to resubmit elsewhere (this is
+        the router's replica-drain path)."""
+        for i, req in enumerate(self._queue):
+            if req.rid == rid:
+                del self._queue[i]
+                # Un-count the submission: the caller re-submits the
+                # request elsewhere (which counts it again there), so
+                # fleet-summed submitted = finished+expired+rejected
+                # stays balanced across drains.
+                self.metrics.record_withdrawn()
+                self.metrics.record_queue_depth(len(self._queue))
+                return True
+        return False
 
     # -- the scheduler iteration ------------------------------------
 
@@ -334,7 +514,9 @@ class ServeEngine:
             rid=seq.rid, status="ok", http_status=200,
             tokens=list(seq.generated), n_prompt=len(seq.prompt),
             submitted_at=seq.submitted_at,
-            first_token_at=seq.first_token_at, finished_at=now)
+            first_token_at=seq.first_token_at, finished_at=now,
+            deadline_class=seq.deadline_class)
+        self._retire_ema.observe(now)
         self.metrics.record_finished()
 
     def _retire_finished(self, now: float) -> None:
@@ -350,24 +532,21 @@ class ServeEngine:
         keep: collections.deque[_Queued] = collections.deque()
         for req in self._queue:
             if req.deadline is not None and now > req.deadline:
+                # Structured rejection, not a blanket 503: the client
+                # learns WHY (deadline passed in queue), at what
+                # priority it was classified, and when a retry might
+                # actually get served.
                 self._results[req.rid] = RequestResult(
                     rid=req.rid, status="expired", http_status=503,
                     tokens=[], n_prompt=len(req.prompt),
-                    submitted_at=req.submitted_at, finished_at=now)
+                    submitted_at=req.submitted_at, finished_at=now,
+                    reason="deadline_expired",
+                    deadline_class=req.deadline_class,
+                    retry_after_s=self._retry_after())
                 self.metrics.record_expired()
             else:
                 keep.append(req)
         self._queue = keep
-
-    def _hash_chain(self, prompt: List[int]) -> List[bytes]:
-        """Chained content hash per full prompt block (the partial
-        tail block, if any, stays private and unhashed)."""
-        bs = self.cfg.block_size
-        chain, h = [], b""
-        for i in range(len(prompt) // bs):
-            h = block_hash(h, prompt[i * bs:(i + 1) * bs])
-            chain.append(h)
-        return chain
 
     def _admit(self, now: float) -> None:
         batch_was_empty = not self._active and not self._prefilling
@@ -380,7 +559,11 @@ class ServeEngine:
                 return
             req = self._queue[0]
             plen = len(req.prompt)
-            need = self.allocator.blocks_for_tokens(plen + req.max_new)
+            # A prefill-only sequence never decodes here — it writes
+            # prompt pages and leaves — so reserving its max_new tail
+            # would waste prefill-pool capacity for nothing.
+            need = self.allocator.blocks_for_tokens(
+                plen if req.prefill_only else plen + req.max_new)
             # Walk the chain against the content index; every leading
             # whole block already cached maps into this sequence's
             # table with one refcount, zero FLOPs. Capped at plen-1
@@ -429,7 +612,9 @@ class ServeEngine:
                 rid=req.rid, prompt=req.prompt, max_new=req.max_new,
                 blocks=blocks, table=table, n_cached=n_hit,
                 generated=[], submitted_at=req.submitted_at,
-                chain=req.chain, registered=len(matched)))
+                chain=req.chain, registered=len(matched),
+                deadline_class=req.deadline_class,
+                prefill_only=req.prefill_only))
 
     def _advance_prefills(self) -> None:
         """Run prefill chunks FIFO across admitted-but-incomplete
@@ -536,9 +721,105 @@ class ServeEngine:
         seq.first_token_at = now
         self.metrics.record_first_token(now - seq.submitted_at)
         if seq.finished(self.cfg.eos_id):
+            # One-token requests (or an immediate eos) finish right
+            # here even in prefill_only mode — nothing left to hand
+            # off, so the result stays on this replica.
             self._finish(seq, now)
+        elif seq.prefill_only:
+            self._handoff[seq.rid] = seq
         else:
             self._active.append(seq)
+
+    # -- prefill/decode disaggregation (KV handoff) ------------------
+
+    def handoff_ready(self) -> List[int]:
+        """rids of prefill-only sequences whose prefill completed and
+        which are parked awaiting :meth:`export_prefilled`."""
+        return list(self._handoff)
+
+    def export_prefilled(self, rid: int) -> PrefillHandoff:
+        """Pop a parked prefill-only sequence: copy its written K/V
+        pages off this replica's pool, free its blocks, and return the
+        package a decode replica feeds to :meth:`inject_prefilled`.
+        The page copy is bitwise, so the handoff changes *where*
+        decode runs, never *what* it computes."""
+        seq = self._handoff.pop(rid)
+        n_blk = self.allocator.blocks_for_tokens(seq.n_cached)
+        idx = np.asarray(seq.blocks[:n_blk], np.int32)
+        k_pages = np.asarray(self.cache.k[:, idx])
+        v_pages = np.asarray(self.cache.v[:, idx])
+        self.allocator.free(seq.blocks)
+        self.metrics.record_handoff_out()
+        return PrefillHandoff(
+            prompt=list(seq.prompt), max_new=seq.max_new,
+            generated=list(seq.generated),
+            submitted_at=seq.submitted_at,
+            first_token_at=seq.first_token_at,
+            deadline_class=seq.deadline_class, chain=list(seq.chain),
+            k_pages=k_pages, v_pages=v_pages,
+            block_size=self.cfg.block_size)
+
+    def inject_prefilled(self, h: PrefillHandoff) -> int:
+        """Admit a handed-off sequence straight into the decode batch:
+        reserve its worst-case blocks, scatter the prompt pages into
+        this replica's pool, and decode from the already-emitted first
+        token. Raises :class:`QueueFull` (no batch slot) or
+        :class:`~horovod_tpu.serve.kv_cache.OutOfBlocks` — the router
+        checks :meth:`admission_snapshot` capacity first, so hitting
+        either here is a router bug, not backpressure."""
+        if h.block_size != self.cfg.block_size:
+            raise ValueError(
+                f"handoff block_size {h.block_size} != engine "
+                f"block_size {self.cfg.block_size} — replicas must "
+                "share geometry for pages to map block-for-block")
+        if len(self._active) + len(self._prefilling) >= self.cfg.max_batch:
+            raise QueueFull("no batch slot for handoff",
+                            reason="no_batch_slot",
+                            retry_after_s=self._retry_after())
+        plen = len(h.prompt)
+        need = self.allocator.blocks_for_tokens(plen + h.max_new)
+        blocks = self.allocator.alloc(need)
+        # Jitted donated scatter: pages land in place, O(prompt
+        # pages), never a full-pool copy. The pad width rides the
+        # SAME prefill bucket menu as every other serve shape (one
+        # compiled program per bucket, and the device transfer stays
+        # proportional to the prompt, not to table_width worst case);
+        # NULL_BLOCK targets + zero pages for the padding rows —
+        # written garbage on the null block is never read, the
+        # prefill bucket-padding contract.
+        n_page = h.n_prompt_blocks
+        bs = self.cfg.block_size
+        width = pick_bucket(n_page * bs, self._prefill_buckets) // bs
+        idx = np.full(width, 0, np.int32)               # NULL_BLOCK
+        idx[:n_page] = blocks[:n_page]
+        shape = (h.k_pages.shape[0], width) + h.k_pages.shape[2:]
+        k_pad = np.zeros(shape, h.k_pages.dtype)
+        v_pad = np.zeros(shape, h.v_pages.dtype)
+        k_pad[:, :n_page] = h.k_pages
+        v_pad[:, :n_page] = h.v_pages
+        self.cache.k, self.cache.v = self._inject_fn(
+            self.cache.k, self.cache.v, idx, k_pad, v_pad)
+        table = np.zeros(self._table_width, np.int32)
+        table[:len(blocks)] = blocks
+        rid = next(self._rids)
+        seq = _Seq(
+            rid=rid, prompt=list(h.prompt), max_new=h.max_new,
+            blocks=blocks, table=table, n_cached=plen,
+            generated=list(h.generated), submitted_at=h.submitted_at,
+            chain=list(h.chain), registered=0,
+            deadline_class=h.deadline_class)
+        seq.first_token_at = h.first_token_at
+        if self.cfg.prefix_caching:
+            # Publish the injected prompt blocks locally: future
+            # same-prefix requests (or handoffs) landing here hit them
+            # for free. A hash already published keeps this private
+            # copy anonymous (register no-ops), same as the twin race.
+            for i, ch in enumerate(h.chain):
+                self.allocator.register(blocks[i], ch)
+            seq.registered = len(h.chain)
+        self._active.append(seq)
+        self.metrics.record_handoff_in()
+        return rid
 
     def _decode_once(self) -> None:
         import jax

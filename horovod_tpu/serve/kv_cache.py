@@ -52,6 +52,21 @@ def block_hash(parent: bytes, tokens) -> bytes:
     return m.digest()
 
 
+def hash_chain(prompt, block_size: int) -> List[bytes]:
+    """Chained content hash per full prompt block (the partial tail
+    block, if any, stays private and unhashed). One chain entry per
+    whole block; entry ``i`` summarizes the whole prefix through block
+    ``i``. Shared between the engine (publish/lookup at admission) and
+    the fleet router (cache-affinity placement walks replicas' indexes
+    against the same chain) — both sides MUST hash identically or
+    affinity routes to replicas whose index can never hit."""
+    chain, h = [], b""
+    for i in range(len(prompt) // block_size):
+        h = block_hash(h, prompt[i * block_size:(i + 1) * block_size])
+        chain.append(h)
+    return chain
+
+
 class OutOfBlocks(RuntimeError):
     """Raised by :meth:`BlockAllocator.alloc` when the pool cannot
     serve the request — the engine's admission backpressure signal."""

@@ -22,6 +22,7 @@ Surfaces:
 
 from __future__ import annotations
 
+import itertools
 import json
 import time
 from typing import Dict, List, Optional
@@ -40,11 +41,23 @@ def percentile(samples: List[float], q: float) -> Optional[float]:
     return xs[idx]
 
 
+#: Process-wide monotonic default for the per-engine ``instance``
+#: label: N replicas sharing one exposition endpoint must not collide
+#: on the bare ``serve_`` series names (Prometheus reads duplicate
+#: unlabeled samples as one broken family, and fleet sums silently
+#: undercount). An explicit instance (the router passes its replica
+#: id) overrides the counter.
+_instance_ids = itertools.count()
+
+
 class ServeMetrics:
-    def __init__(self, clock=time.perf_counter):
+    def __init__(self, clock=time.perf_counter,
+                 instance: Optional[str] = None):
         self._clock = clock
         self._allocator = None
         self._alloc_base = (0, 0, 0)
+        self.instance = (str(next(_instance_ids)) if instance is None
+                         else str(instance))
         self.reset()
         # Export through the process-wide telemetry endpoint: a scrape
         # of hvd.metrics_prometheus() (or the rank-0 metrics server)
@@ -60,6 +73,8 @@ class ServeMetrics:
         self.requests_finished = 0
         self.requests_expired = 0
         self.requests_rejected = 0
+        self.handoffs_in = 0
+        self.handoffs_out = 0
         self.prefill_steps = 0
         self.decode_steps = 0
         self.queue_depth = 0
@@ -167,6 +182,14 @@ class ServeMetrics:
     def record_submitted(self) -> None:
         self.requests_submitted += 1
 
+    def record_withdrawn(self) -> None:
+        """A queued request reclaimed by ``ServeEngine.withdraw``: it
+        leaves without a result and will be re-counted wherever the
+        router re-submits it, so it must not stay in this replica's
+        submitted tally (fleet sums would report phantom in-flight
+        requests forever)."""
+        self.requests_submitted -= 1
+
     def record_finished(self) -> None:
         self.requests_finished += 1
 
@@ -175,6 +198,14 @@ class ServeMetrics:
 
     def record_rejected(self) -> None:
         self.requests_rejected += 1
+
+    def record_handoff_out(self) -> None:
+        """A completed prefill left this replica for a decode pool."""
+        self.handoffs_out += 1
+
+    def record_handoff_in(self) -> None:
+        """A prefilled sequence arrived to decode on this replica."""
+        self.handoffs_in += 1
 
     # -- export ------------------------------------------------------
 
@@ -195,6 +226,8 @@ class ServeMetrics:
             "requests_finished": self.requests_finished,
             "requests_expired": self.requests_expired,
             "requests_rejected": self.requests_rejected,
+            "handoffs_in": self.handoffs_in,
+            "handoffs_out": self.handoffs_out,
             "prefill_steps": self.prefill_steps,
             "decode_steps": self.decode_steps,
             "queue_depth": self.queue_depth,
@@ -204,6 +237,7 @@ class ServeMetrics:
                 round(self.prefix_hit_tokens / looked_up, 4)
                 if looked_up else 0.0),
             "prefix_hit_tokens": self.prefix_hit_tokens,
+            "prefix_prefill_tokens": self.prefix_prefill_tokens,
             "p50_first_token_ms": ms(percentile(self.first_token_s, 50)),
             "p99_first_token_ms": ms(percentile(self.first_token_s, 99)),
             "p50_per_token_ms": ms(percentile(self.per_token_s, 50)),
@@ -232,9 +266,13 @@ class ServeMetrics:
         exposition helper as the native registry
         (``horovod_tpu.metrics.render_gauges``) under the ``serve_``
         prefix — serving and training export one format, one endpoint
-        (docs/observability.md)."""
+        (docs/observability.md). Every sample carries this engine's
+        ``instance`` label so N replicas in one process stay
+        distinguishable in one scrape and fleet-level PromQL sums
+        (``sum(serve_tokens_generated)``) are correct."""
         from horovod_tpu.metrics import render_gauges
-        return render_gauges("serve", self.snapshot())
+        return render_gauges("serve", self.snapshot(),
+                             labels={"instance": self.instance})
 
     def export_chrome_trace(self, path: str) -> None:
         """Write recorded step spans as a chrome-tracing file (the

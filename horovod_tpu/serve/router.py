@@ -1,0 +1,706 @@
+"""Serving fleet: an admission router over N in-process engine
+replicas.
+
+One :class:`~horovod_tpu.serve.engine.ServeEngine` is a single
+replica; "heavy traffic from millions of users" means a fleet. The
+router is the layer above the engine — it owns fleet-level admission
+and placement, and the replicas stay plain engines (every replica
+invariant the engine tier pins — bitwise parity, allocator safety,
+backpressure — holds unchanged underneath):
+
+* **Cache-affinity placement.** The prefix cache only pays when a
+  request lands where its prefix is warm. At submit the router hashes
+  the prompt's block chain ONCE (the same
+  :func:`~horovod_tpu.serve.kv_cache.hash_chain` the engine publishes
+  under) and at placement walks every candidate replica's content
+  index (`ServeEngine.cached_chain_len`, a non-mutating peek): the
+  replica holding the longest chain prefix wins; no match (or a tie)
+  falls back to least-occupancy. A burst of same-prefix requests
+  placed in one step would all walk cold indexes (nobody has
+  prefilled yet) and scatter — the fleet-level twin of the engine's
+  same-step-burst problem, solved the same way: the router keeps a
+  bounded *placed-chain* index recording where each chain entry was
+  last routed, and scores candidates by the max of the live index
+  walk and that routing hint, so the first request of a tenant
+  CREATES the affinity its burst siblings follow. Random and
+  round-robin placements exist as benchmark baselines — `bench.py`'s
+  routed-vs-random comparison is the tentpole claim.
+* **Prefill/decode pools with KV handoff.** With
+  ``RouterConfig.n_prefill > 0`` the fleet splits: prefill replicas
+  run admission + (chunked) prefill only, then the router streams each
+  completed sequence's block pages to a decode replica
+  (`export_prefilled` -> `inject_prefilled`). Interactive decode
+  traffic never queues behind a long prompt's prefill, and because the
+  pages move bitwise and decode math is position-dependent only, the
+  token streams are identical to a single replica serving the same
+  trace (pinned by tests/test_router.py).
+* **Deadline-class load shedding.** Saturation sheds the *least
+  important* work first instead of blanket-503ing whoever arrives
+  last: every request carries a ``deadline_class`` (0 = protected,
+  higher = shed first). When the router queue is full, an arriving
+  request evicts the newest queued request of a strictly lower class
+  (higher number) — that victim resolves to a structured ``"shed"``
+  result carrying the reason, its class, and a retry-after estimate
+  from queue depth x drain rate; if nothing queued is lower-class, the
+  arrival itself is rejected with :class:`FleetSaturated` carrying the
+  same fields.
+* **Fleet telemetry.** Each replica's :class:`ServeMetrics` exports
+  with a distinct ``instance`` label, and :class:`FleetMetrics`
+  renders fleet-level aggregates (summed counters, pooled latency
+  tails, fleet hit rate) under ``serve_fleet_`` — one scrape of
+  ``hvd.metrics_prometheus()`` covers every replica plus the rollup.
+
+Replica membership is elastic: :meth:`ServeRouter.add_replica` joins a
+fresh engine (sharing the fleet's jitted programs — same geometry, one
+compile), :meth:`ServeRouter.remove_replica` drains one (queued work
+is withdrawn and requeued at the router, in-flight sequences decode to
+completion, then the replica drops out). No request is ever dropped or
+duplicated across membership changes — the randomized property test
+drives exactly that.
+
+Everything is deterministic for a fixed seed: FIFO placement order,
+tie-breaks by replica id, and the only randomness (the random
+placement baseline) runs off the config seed.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from horovod_tpu.serve.engine import (
+    QueueFull, RequestResult, RetireEma, ServeConfig, ServeEngine,
+    validate_request,
+)
+from horovod_tpu.serve.kv_cache import hash_chain
+from horovod_tpu.serve.metrics import MAX_SAMPLES, percentile
+
+#: Bound on the router's placed-chain hint index (16-byte hashes ->
+#: ~3 MB at the cap); beyond it the oldest routing hints fall off.
+#: Stale hints are harmless — the live per-replica index walk is the
+#: ground truth, the hint only pre-groups same-prefix bursts.
+CHAIN_INDEX_CAP = 65536
+
+
+class FleetSaturated(QueueFull):
+    """Router-level shed: the fleet queue is full and nothing queued
+    is lower-class than the arrival. Carries ``reason`` /
+    ``deadline_class`` / ``retry_after_s`` like every structured
+    rejection in the serve tier."""
+
+    def __init__(self, msg: str, *, deadline_class: int,
+                 queue_depth: int, retry_after_s: Optional[float]):
+        super().__init__(msg, reason="shed_low_class",
+                         queue_depth=queue_depth,
+                         retry_after_s=retry_after_s)
+        self.deadline_class = deadline_class
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    """Fleet knobs (per-replica knobs live in ``ServeConfig``)."""
+
+    n_replicas: int = 2
+    # Leading replicas become a prefill-only pool, the rest decode-only
+    # (KV handoff between them). 0 = unified: every replica prefills
+    # AND decodes, no handoff.
+    n_prefill: int = 0
+    # Router-held (not yet placed) request cap; beyond it the shedding
+    # policy decides who loses, by deadline class.
+    max_queue: int = 256
+    # "affinity" (cache-aware, the point of this module) with
+    # least-occupancy fallback; "least" = occupancy only;
+    # "random" / "round_robin" = benchmark baselines.
+    placement: str = "affinity"
+    seed: int = 0                # drives the random-placement baseline
+
+    def __post_init__(self):
+        if self.n_replicas < 1:
+            raise ValueError(f"n_replicas {self.n_replicas} < 1")
+        if not 0 <= self.n_prefill < self.n_replicas:
+            raise ValueError(
+                f"n_prefill {self.n_prefill} must leave at least one "
+                f"decode replica out of {self.n_replicas}")
+        if self.placement not in ("affinity", "least", "random",
+                                  "round_robin"):
+            raise ValueError(f"unknown placement {self.placement!r}")
+
+
+@dataclasses.dataclass
+class _Pending:
+    """Router-side copy of a request: enough to (re)place it on any
+    replica — this is what makes replica drain lossless."""
+
+    rid: int
+    prompt: List[int]
+    max_new: int
+    deadline: Optional[float]
+    deadline_class: int
+    submitted_at: float
+    chain: List[bytes]
+
+
+@dataclasses.dataclass
+class _Replica:
+    instance: str
+    role: str                    # "unified" | "prefill" | "decode"
+    engine: ServeEngine
+    draining: bool = False
+    # engine rid -> router rid, for every request placed here whose
+    # result has not been collected yet.
+    outstanding: Dict[int, int] = dataclasses.field(default_factory=dict)
+
+
+class FleetMetrics:
+    """Fleet-level rollup over the replicas' ``ServeMetrics``:
+    summed counters, pooled latency tails (per-replica p99s don't
+    average into a fleet p99 — the samples do), token-weighted fleet
+    hit rate, and the router's own counters (placements by kind,
+    sheds by class, handoffs). Registers on the shared exposition
+    under ``serve_fleet_`` so one scrape covers every replica AND the
+    rollup."""
+
+    #: Same single-instance-collision fix as ``ServeMetrics``: two
+    #: live fleets in one process must not emit identical unlabeled
+    #: ``serve_fleet_*`` samples into one scrape.
+    _fleet_ids = itertools.count()
+
+    #: Lifetime counters a reaped replica's history folds into (its
+    #: ServeMetrics object dies with it; without absorption a drain
+    #: would silently shrink fleet totals and break the submitted ==
+    #: finished+expired+rejected balance). Point-in-time gauges
+    #: (kv_blocks_*) and rates are deliberately NOT absorbed — a dead
+    #: pool holds nothing.
+    ABSORBED = ("tokens_generated", "requests_submitted",
+                "requests_finished", "requests_expired",
+                "requests_rejected", "prefix_hit_tokens",
+                "prefix_prefill_tokens")
+
+    def __init__(self, router: "ServeRouter"):
+        import weakref
+
+        self._router = weakref.ref(router)
+        self.fleet = str(next(self._fleet_ids))
+        self.placed_affinity = 0     # placements won by a chain match
+        self.placed_fallback = 0     # no match: occupancy/baseline pick
+        self.shed_total = 0
+        self.shed_by_class: Dict[int, int] = {}
+        self.expired_total = 0
+        self.handoffs = 0
+        self._retired: Dict[str, float] = {}   # absorbed counters
+        # Absorbed latency samples (same MAX_SAMPLES cap as the live
+        # series): without them the fleet p99 would silently IMPROVE
+        # after draining whichever replica served the slow tenant.
+        self._retired_samples: Dict[str, List[float]] = {
+            "first_token_s": [], "per_token_s": []}
+        from horovod_tpu.metrics import register_exporter_weak
+        register_exporter_weak(f"serve_fleet_{id(self)}", self,
+                               "prometheus")
+
+    def absorb(self, metrics) -> None:
+        """Fold a reaped replica's final ``ServeMetrics`` into the
+        rollup — lifetime counters AND its latency samples (capped) —
+        so fleet totals and tails survive membership churn."""
+        snap = metrics.snapshot()
+        for key in self.ABSORBED:
+            self._retired[key] = (self._retired.get(key, 0)
+                                  + snap.get(key, 0))
+        for series, kept in self._retired_samples.items():
+            room = MAX_SAMPLES - len(kept)
+            if room > 0:
+                kept.extend(getattr(metrics, series)[:room])
+
+    def record_placed(self, match_len: int) -> None:
+        if match_len > 0:
+            self.placed_affinity += 1
+        else:
+            self.placed_fallback += 1
+
+    def record_shed(self, deadline_class: int) -> None:
+        self.shed_total += 1
+        self.shed_by_class[deadline_class] = (
+            self.shed_by_class.get(deadline_class, 0) + 1)
+
+    def snapshot(self) -> Dict[str, float]:
+        router = self._router()
+        if router is None:
+            return {}
+        reps = router._replicas
+        snaps = [r.engine.metrics.snapshot() for r in reps]
+        out: Dict[str, float] = {
+            "replicas": len(reps),
+            "queue_depth": len(router._queue),
+            "placed_affinity": self.placed_affinity,
+            "placed_fallback": self.placed_fallback,
+            "shed_total": self.shed_total,
+            "expired_total": self.expired_total,
+            "handoffs": self.handoffs,
+        }
+        for c, n in sorted(self.shed_by_class.items()):
+            out[f"shed_class_{c}"] = n
+        for key in self.ABSORBED + ("kv_blocks_in_use",
+                                    "kv_blocks_cached"):
+            out[key] = (sum(s.get(key, 0) for s in snaps)
+                        + self._retired.get(key, 0))
+        rates = [s["tokens_per_sec"] for s in snaps]
+        out["tokens_per_sec"] = round(sum(rates), 2)
+        occ = [s["batch_occupancy"] for s in snaps]
+        out["batch_occupancy"] = (round(sum(occ) / len(occ), 4)
+                                  if occ else 0.0)
+        looked = out["prefix_hit_tokens"] + out["prefix_prefill_tokens"]
+        out["prefix_cache_hit_rate"] = (
+            round(out["prefix_hit_tokens"] / looked, 4)
+            if looked else 0.0)
+        # Pooled tails: the fleet p99 is a quantile of the union of
+        # every replica's samples (live + absorbed-from-reaped), not
+        # an average of replica p99s.
+        for series, label in (("first_token_s", "first_token_ms"),
+                              ("per_token_s", "per_token_ms")):
+            pooled = [x for r in reps
+                      for x in getattr(r.engine.metrics, series)]
+            pooled += self._retired_samples[series]
+            for q in (50, 99):
+                v = percentile(pooled, q)
+                out[f"p{q}_{label}"] = (None if v is None
+                                        else round(v * 1e3, 3))
+        return out
+
+    def prometheus(self) -> str:
+        from horovod_tpu.metrics import render_gauges
+        return render_gauges("serve_fleet", self.snapshot(),
+                             labels={"fleet": self.fleet})
+
+
+class ServeRouter:
+    """N in-process engine replicas behind one admission front door.
+
+    All replicas share the model config, params, mesh, and engine
+    geometry — so they share ONE set of jitted programs
+    (``make_serve_fns`` memoizes on the geometry) and adding a replica
+    costs a KV pool, not a compile.
+    """
+
+    def __init__(self, model_cfg, params,
+                 router_cfg: Optional[RouterConfig] = None,
+                 serve_cfg: Optional[ServeConfig] = None,
+                 mesh: Optional[Any] = None, clock=time.perf_counter):
+        self.cfg = router_cfg or RouterConfig()
+        self._model_cfg = model_cfg
+        self._params = params
+        self._serve_cfg = serve_cfg or ServeConfig()
+        self._mesh = mesh
+        self._clock = clock
+        self._rng = np.random.RandomState(self.cfg.seed)
+        self._rr = 0                 # round_robin cursor
+        self._replicas: List[_Replica] = []
+        self._next_instance = itertools.count()
+        self._queue: collections.deque[_Pending] = collections.deque()
+        self._requests: Dict[int, _Pending] = {}   # every unresolved rid
+        # chain entry -> instance it was last routed to (insertion-
+        # ordered for FIFO eviction at CHAIN_INDEX_CAP).
+        self._placed_chains: "collections.OrderedDict[bytes, str]" = \
+            collections.OrderedDict()
+        self._results: Dict[int, RequestResult] = {}
+        self._rids = itertools.count()
+        self._retire_ema = RetireEma()
+        self.metrics = FleetMetrics(self)
+        #: (rid, replica instance, chain-match length) per placement,
+        #: in placement order — the determinism probe the property
+        #: test replays. Capped like every other unbounded series.
+        self.placement_log: List[Tuple[int, str, int]] = []
+        for i in range(self.cfg.n_replicas):
+            role = ("prefill" if i < self.cfg.n_prefill else
+                    "decode" if self.cfg.n_prefill else "unified")
+            self._add_replica(role)
+
+    # -- membership --------------------------------------------------
+
+    def _add_replica(self, role: str) -> _Replica:
+        inst = str(next(self._next_instance))
+        # Router-facing id (`inst`) is per-router and deterministic —
+        # placement logs compare bit-for-bit across seeded runs. The
+        # EXPOSITION label prefixes the process-unique fleet id: two
+        # live fleets must not emit colliding serve_*{instance="0"}
+        # samples into one scrape (the exact single-instance collision
+        # this PR fixes for engines).
+        eng = ServeEngine(self._model_cfg, self._params,
+                          self._serve_cfg, mesh=self._mesh,
+                          clock=self._clock,
+                          instance=f"{self.metrics.fleet}.{inst}")
+        rep = _Replica(instance=inst, role=role, engine=eng)
+        self._replicas.append(rep)
+        return rep
+
+    def add_replica(self, role: Optional[str] = None) -> str:
+        """Join a fresh replica (elastic scale-up); returns its
+        instance id. Default role matches the fleet shape: "decode"
+        for a split fleet, "unified" otherwise."""
+        if role is None:
+            role = "decode" if self.cfg.n_prefill else "unified"
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(f"unknown role {role!r}")
+        return self._add_replica(role).instance
+
+    def remove_replica(self, instance: str) -> None:
+        """Drain a replica out of the fleet: its queued (never
+        admitted) requests are withdrawn and requeued at the router
+        in original submission order; in-flight sequences keep
+        decoding here until done, after which the replica is reaped.
+        Refuses to remove the last replica able to serve a role."""
+        rep = self._replica(instance)
+        peers = [r for r in self._replicas
+                 if r is not rep and not r.draining]
+        needed = (("prefill", "decode") if self.cfg.n_prefill
+                  else ("unified",))
+        for role in needed:
+            if rep.role == role and not any(p.role == role
+                                            for p in peers):
+                raise ValueError(
+                    f"cannot remove replica {instance}: last "
+                    f"non-draining {role!r} replica in the fleet")
+        rep.draining = True
+        requeue = []
+        for erid, rid in list(rep.outstanding.items()):
+            if rep.engine.withdraw(erid):
+                del rep.outstanding[erid]
+                requeue.append(self._requests[rid])
+        # Front of the router queue, original submit order preserved:
+        # drained work overtakes nothing and loses nothing.
+        for req in sorted(requeue, key=lambda r: r.rid, reverse=True):
+            self._queue.appendleft(req)
+
+    def _replica(self, instance: str) -> _Replica:
+        for rep in self._replicas:
+            if rep.instance == instance:
+                return rep
+        raise KeyError(f"no replica {instance!r}")
+
+    @property
+    def replicas(self) -> List[str]:
+        return [r.instance for r in self._replicas]
+
+    @property
+    def engines(self) -> List[ServeEngine]:
+        """The replica engines, fleet order (read-only introspection:
+        benchmarks pool latency samples across them)."""
+        return [r.engine for r in self._replicas]
+
+    # -- submission / shedding ---------------------------------------
+
+    def _retry_after(self) -> float:
+        return self._retire_ema.retry_after(len(self._queue))
+
+    def submit(self, prompt: Sequence[int],
+               max_new_tokens: Optional[int] = None,
+               deadline: Optional[float] = None,
+               deadline_class: int = 0) -> int:
+        """Fleet admission. Validates against the shared engine
+        limits, then queues for placement. On a full router queue the
+        shedding policy runs: the newest queued request of a strictly
+        lower class (higher number) is shed — resolved to a structured
+        ``"shed"`` result — to make room; if none exists, raises
+        :class:`FleetSaturated`."""
+        prompt = list(prompt)
+        cfg = self._serve_cfg
+        max_new = (cfg.max_new_tokens if max_new_tokens is None
+                   else max_new_tokens)
+        # The ENGINE's validation helper, verbatim: anything an engine
+        # would reject must reject HERE, not explode out of a later
+        # step() at placement time (all replicas share one geometry,
+        # so any engine's pool answers for the fleet).
+        validate_request(cfg, self._model_cfg,
+                         self._replicas[0].engine.allocator.n_blocks,
+                         prompt, max_new, deadline_class)
+        if len(self._queue) >= self.cfg.max_queue:
+            victim = self._shed_candidate(deadline_class)
+            if victim is None:
+                self.metrics.record_shed(deadline_class)
+                raise FleetSaturated(
+                    f"fleet queue full ({self.cfg.max_queue}) and "
+                    f"nothing queued is lower-class than "
+                    f"{deadline_class}",
+                    deadline_class=deadline_class,
+                    queue_depth=len(self._queue),
+                    retry_after_s=self._retry_after())
+            self._shed(victim)
+        rid = next(self._rids)
+        # Hashed ONCE here, reused by placement scoring, the burst
+        # hint, and engine admission (passed through). With the engine
+        # tier's caching off there is nothing to be affine TO — no
+        # index to walk, no reuse to win — so skip the hashing and let
+        # affinity degrade to least-load instead of pinning every
+        # same-prefix tenant onto one hot replica for zero benefit.
+        chain = (hash_chain(prompt, cfg.block_size)
+                 if cfg.prefix_caching else [])
+        req = _Pending(
+            rid=rid, prompt=prompt, max_new=max_new, deadline=deadline,
+            deadline_class=deadline_class, submitted_at=self._clock(),
+            chain=chain)
+        self._requests[rid] = req
+        self._queue.append(req)
+        return rid
+
+    def _shed_candidate(self, incoming_class: int) -> Optional[int]:
+        """Queue index of the request to shed for an arrival of
+        ``incoming_class``: the newest of the *worst* (highest) class,
+        and only if strictly worse than the arrival — FIFO favors the
+        already-queued at equal class."""
+        if not self._queue:
+            return None
+        worst = max(range(len(self._queue)),
+                    key=lambda i: (self._queue[i].deadline_class, i))
+        if self._queue[worst].deadline_class <= incoming_class:
+            return None
+        return worst
+
+    def _shed(self, idx: int) -> None:
+        req = self._queue[idx]
+        del self._queue[idx]
+        del self._requests[req.rid]
+        self._results[req.rid] = RequestResult(
+            rid=req.rid, status="shed", http_status=503, tokens=[],
+            n_prompt=len(req.prompt), submitted_at=req.submitted_at,
+            finished_at=self._clock(), reason="shed_low_class",
+            deadline_class=req.deadline_class,
+            retry_after_s=self._retry_after())
+        self.metrics.record_shed(req.deadline_class)
+
+    # -- results -----------------------------------------------------
+
+    def result(self, rid: int) -> Optional[RequestResult]:
+        return self._results.get(rid)
+
+    @property
+    def results(self) -> Dict[int, RequestResult]:
+        return dict(self._results)
+
+    @property
+    def pending(self) -> bool:
+        return bool(self._queue
+                    or any(r.outstanding for r in self._replicas))
+
+    # -- placement ---------------------------------------------------
+
+    def _candidates(
+            self, pool_role: Tuple[str, ...],
+    ) -> List[Tuple[_Replica, Dict[str, float]]]:
+        """(replica, admission snapshot) pairs eligible for a new
+        placement: right pool, not draining, engine-queue room. The
+        affinity invariant — never route to a replica without
+        capacity — is enforced here, before any cache walk happens;
+        each replica is snapshotted ONCE per placement decision and
+        the snapshot rides along for the load tie-breaks (it cannot
+        change between filter and pick within one decision)."""
+        out = []
+        for r in self._replicas:
+            if r.role not in pool_role or r.draining:
+                continue
+            snap = r.engine.admission_snapshot()
+            if snap["queue_slots_free"] > 0:
+                out.append((r, snap))
+        return out
+
+    @staticmethod
+    def _load(snap: Dict[str, float]) -> int:
+        """Placement-fallback occupancy signal: everything admitted
+        or waiting on the snapshotted replica."""
+        return int(snap["queue_depth"] + snap["running"]
+                   + snap["handoff_parked"])
+
+    def _pick(self, req: _Pending,
+              cands: List[Tuple[_Replica, Dict[str, float]]],
+              ) -> Tuple[_Replica, int]:
+        """Choose among capacity-checked candidates; returns (replica,
+        chain_match_len). Deterministic for a fixed seed: ties break
+        on load then list order, and the random baseline draws from
+        the config-seeded RNG."""
+        if self.cfg.placement == "random":
+            return cands[int(self._rng.randint(len(cands)))][0], 0
+        if self.cfg.placement == "round_robin":
+            rep = cands[self._rr % len(cands)][0]
+            self._rr += 1
+            return rep, 0
+        if self.cfg.placement == "affinity":
+            scored = [(self._chain_score(r, req.chain), r, s)
+                      for r, s in cands]
+            best = max(n for n, _, _ in scored)
+            if best > 0:
+                hot = [(r, s) for n, r, s in scored if n == best]
+                return min(hot, key=lambda t: self._load(t[1]))[0], best
+        return min(cands, key=lambda t: self._load(t[1]))[0], 0
+
+    def _chain_score(self, rep: _Replica, chain: List[bytes]) -> int:
+        """Affinity score of ``rep`` for a prompt chain: the longer of
+        the replica's LIVE content-index walk (blocks actually held)
+        and the leading run of chain entries last ROUTED there (the
+        burst hint — a same-prefix sibling placed moments ago whose
+        prefill hasn't published yet)."""
+        live = rep.engine.cached_chain_len(chain)
+        hint = 0
+        for h in chain:
+            if self._placed_chains.get(h) != rep.instance:
+                break
+            hint += 1
+        return max(live, hint)
+
+    def _record_chain(self, rep: _Replica, chain: List[bytes]) -> None:
+        for h in chain:
+            if h in self._placed_chains:
+                self._placed_chains.move_to_end(h)
+            self._placed_chains[h] = rep.instance
+        while len(self._placed_chains) > CHAIN_INDEX_CAP:
+            self._placed_chains.popitem(last=False)
+
+    def _place_queued(self) -> None:
+        """FIFO placement (no overtaking — same tail-predictability
+        contract as engine admission): place from the head until a
+        request finds no candidate, then stop and retry next step."""
+        pool = (("prefill",) if self.cfg.n_prefill else ("unified",))
+        while self._queue:
+            req = self._queue[0]
+            cands = self._candidates(pool)
+            if not cands:
+                return
+            rep, match = self._pick(req, cands)
+            self._queue.popleft()
+            erid = rep.engine.submit(
+                req.prompt, req.max_new, deadline=req.deadline,
+                deadline_class=req.deadline_class,
+                prefill_only=(rep.role == "prefill"),
+                chain=req.chain)
+            rep.outstanding[erid] = req.rid
+            if self.cfg.placement == "affinity":
+                # Only the affinity scorer ever reads the hint index;
+                # the baselines skip the OrderedDict churn entirely.
+                self._record_chain(rep, req.chain)
+            self.metrics.record_placed(match)
+            if len(self.placement_log) < MAX_SAMPLES:
+                self.placement_log.append((req.rid, rep.instance, match))
+
+    # -- handoff (prefill pool -> decode pool) -----------------------
+
+    def _collect_handoffs(self) -> None:
+        for rep in self._replicas:
+            if rep.role != "prefill":
+                continue
+            for erid in rep.engine.handoff_ready():
+                rid = rep.outstanding[erid]
+                req = self._requests[rid]
+                need = rep.engine.allocator.blocks_for_tokens(
+                    len(req.prompt) + req.max_new)
+                target = self._pick_decode(need)
+                if target is None:
+                    # No decode capacity this step; the sequence stays
+                    # parked (blocks held at the prefill replica) and
+                    # is retried next step — never dropped.
+                    continue
+                h = rep.engine.export_prefilled(erid)
+                del rep.outstanding[erid]
+                new_erid = target.engine.inject_prefilled(h)
+                target.outstanding[new_erid] = rid
+                self.metrics.handoffs += 1
+
+    def _pick_decode(self, need_blocks: int) -> Optional[_Replica]:
+        cands = []
+        for r in self._replicas:
+            if r.role != "decode" or r.draining:
+                continue
+            snap = r.engine.admission_snapshot()
+            if (snap["batch_slots_free"] > 0
+                    and r.engine.allocator.can_alloc(need_blocks)):
+                cands.append((r, snap))
+        if not cands:
+            return None
+        return min(cands, key=lambda t: self._load(t[1]))[0]
+
+    # -- the fleet iteration -----------------------------------------
+
+    def step(self) -> None:
+        """One fleet iteration: expire router-queued deadlines, move
+        completed prefills to the decode pool, place queued requests,
+        step every busy replica, collect results, reap drained
+        replicas."""
+        now = self._clock()
+        self._expire_queued(now)
+        self._collect_handoffs()
+        self._place_queued()
+        for rep in self._replicas:
+            if rep.engine.pending:
+                rep.engine.step()
+        self._collect_results()
+        self._reap_drained()
+
+    def _expire_queued(self, now: float) -> None:
+        keep: collections.deque[_Pending] = collections.deque()
+        for req in self._queue:
+            if req.deadline is not None and now > req.deadline:
+                del self._requests[req.rid]
+                self._results[req.rid] = RequestResult(
+                    rid=req.rid, status="expired", http_status=503,
+                    tokens=[], n_prompt=len(req.prompt),
+                    submitted_at=req.submitted_at, finished_at=now,
+                    reason="deadline_expired",
+                    deadline_class=req.deadline_class,
+                    retry_after_s=self._retry_after())
+                self.metrics.expired_total += 1
+            else:
+                keep.append(req)
+        self._queue = keep
+
+    def _collect_results(self) -> None:
+        for rep in self._replicas:
+            done = []
+            for erid, rid in rep.outstanding.items():
+                res = rep.engine.result(erid)
+                if res is None:
+                    continue
+                # Rebind to the router's rid space; everything else
+                # (tokens, latencies, structured-rejection fields)
+                # passes through untouched.
+                self._results[rid] = dataclasses.replace(res, rid=rid)
+                del self._requests[rid]
+                done.append(erid)
+                # Only REAL retirements feed the drain-rate EMA (the
+                # engine's own EMA observes only _finish): a deadline
+                # storm of back-to-back expirations would otherwise
+                # collapse retry_after_s toward 0 exactly when the
+                # fleet is saturated and serving nothing.
+                if res.status == "ok" and res.finished_at is not None:
+                    self._retire_ema.observe(res.finished_at)
+            for erid in done:
+                del rep.outstanding[erid]
+
+    def _reap_drained(self) -> None:
+        keep = []
+        for r in self._replicas:
+            if (r.draining and not r.outstanding
+                    and not r.engine.pending
+                    and not r.engine.handoff_ready()):
+                # Fold the dying replica's lifetime counters and
+                # latency samples into the rollup — fleet totals and
+                # tails must survive membership churn.
+                self.metrics.absorb(r.engine.metrics)
+            else:
+                keep.append(r)
+        self._replicas = keep
+
+    def run_until_idle(self, max_steps: int = 1_000_000) -> None:
+        for _ in range(max_steps):
+            if not self.pending:
+                return
+            self.step()
+        raise RuntimeError(f"fleet still busy after {max_steps} steps")
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 max_new_tokens: Optional[int] = None) -> List[List[int]]:
+        """Convenience batch API, mirroring ``ServeEngine.generate``:
+        serve ``prompts`` across the fleet and return their token
+        streams in submission order."""
+        rids = [self.submit(p, max_new_tokens) for p in prompts]
+        self.run_until_idle()
+        return [self._results[r].tokens for r in rids]

@@ -98,6 +98,38 @@ def test_find_regressions_telemetry_key_directions():
     assert regs["extra.wire_bytes_saved_pct"]["drop_pct"] > 50
 
 
+def test_find_regressions_router_key_directions():
+    """ISSUE 8 `serve_router_*` keys: hit rates and throughput gate
+    higher-is-better, `*_ms` latency keys gate on RISE, and the fleet
+    tallies (`*_count`: handoffs moved, replicas present) are
+    direction-less and ungated."""
+    prev = {"extra": {"serve_router_prefix_hit_rate": 0.60,
+                      "serve_router_tokens_per_sec_per_chip": 200.0,
+                      "serve_router_p99_first_token_ms": 400.0,
+                      "serve_router_handoff_count": 32.0,
+                      "serve_router_replica_count": 4.0}}
+    cur = {"extra": {"serve_router_prefix_hit_rate": 0.20,
+                     "serve_router_tokens_per_sec_per_chip": 205.0,
+                     "serve_router_p99_first_token_ms": 900.0,
+                     "serve_router_handoff_count": 2.0,
+                     "serve_router_replica_count": 8.0}}
+    regs = bench.find_regressions(prev, cur)
+    # Hit-rate collapse and latency blowup flag; count swings never do.
+    assert set(regs) == {"extra.serve_router_prefix_hit_rate",
+                         "extra.serve_router_p99_first_token_ms"}
+    assert regs["extra.serve_router_prefix_hit_rate"]["drop_pct"] > 60
+    assert regs["extra.serve_router_p99_first_token_ms"]["rise_pct"] > 100
+    # Both directions of the gated keys: a hit-rate WIN plus a
+    # throughput drop flags only the throughput.
+    cur2 = {"extra": {"serve_router_prefix_hit_rate": 0.90,
+                      "serve_router_tokens_per_sec_per_chip": 100.0,
+                      "serve_router_p99_first_token_ms": 200.0,
+                      "serve_router_handoff_count": 32.0,
+                      "serve_router_replica_count": 4.0}}
+    regs2 = bench.find_regressions(prev, cur2)
+    assert set(regs2) == {"extra.serve_router_tokens_per_sec_per_chip"}
+
+
 def test_find_regressions_threshold_boundary():
     prev = {"value": 100.0}
     assert bench.find_regressions(prev, {"value": 91.0}) == {}

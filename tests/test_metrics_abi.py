@@ -229,9 +229,16 @@ def test_flat_metrics_covers_every_series(lib):
 # Prometheus text-format validity
 # ---------------------------------------------------------------------------
 
+# Samples may carry label sets: histogram buckets ({le="..."}) and the
+# per-replica serving series ({instance="..."}, any escaped value —
+# the value grammar must accept the \" \\ \n escapes _escape_label
+# emits, not stop at the first backslash-escaped quote).
+_LVAL = r'"(?:[^"\\]|\\.)*"'
+_LABELS = (r'\{[a-zA-Z_][a-zA-Z0-9_]*=' + _LVAL
+           + r'(,[a-zA-Z_][a-zA-Z0-9_]*=' + _LVAL + r')*\}')
 EXPOSITION_LINE = re.compile(
     r'^(# (TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (counter|gauge|histogram)|HELP .*)'
-    r'|[a-zA-Z_:][a-zA-Z0-9_:]*(\{le="(\+Inf|[0-9]+)"\})?'
+    r'|[a-zA-Z_:][a-zA-Z0-9_:]*(' + _LABELS + r')?'
     r' [-+]?([0-9.eE+-]+|inf|nan))$')
 
 
@@ -278,20 +285,44 @@ def test_prometheus_includes_registered_exporters(lib):
     finally:
         unregister_exporter("t_probe")
     assert "t_probe" not in metrics_prometheus()
+    # A malformed fragment (truncated TYPE line) must not 500 the
+    # scrape: the dedupe pass runs OUTSIDE the per-exporter
+    # try/except, so it has to tolerate garbage itself.
+    register_exporter("t_sick", lambda: "# TYPE \nt_sick 1\n")
+    try:
+        txt = metrics_prometheus()
+        assert "t_sick 1" in txt
+        assert "hvd_cycles_total" in txt
+    finally:
+        unregister_exporter("t_sick")
 
 
 def test_serve_metrics_render_through_shared_helper(lib):
     """Serving snapshots export through the SAME exposition helper
-    under the serve_ prefix — one scrape covers both subsystems."""
+    under the serve_ prefix — one scrape covers both subsystems. N
+    live engines stay distinguishable: every sample carries the
+    engine's instance label (bare serve_ names used to collide across
+    replicas, breaking the family and undercounting fleet sums), and
+    the per-family TYPE line renders once no matter how many replicas
+    export it."""
     from horovod_tpu.serve.metrics import ServeMetrics
 
-    sm = ServeMetrics()
+    sm = ServeMetrics(instance="abi_a")
     sm.record_submitted()
     sm.record_first_token(0.025)
+    sm2 = ServeMetrics(instance="abi_b")
+    sm2.record_submitted()
+    sm2.record_submitted()
     txt = metrics_prometheus()
-    assert "serve_requests_submitted 1" in txt
+    assert 'serve_requests_submitted{instance="abi_a"} 1' in txt
+    assert 'serve_requests_submitted{instance="abi_b"} 2' in txt
     assert "hvd_cycles_total" in txt
     for line in txt.rstrip("\n").splitlines():
         assert EXPOSITION_LINE.match(line), line
+    # One TYPE line per family across every exporting replica — the
+    # text format allows exactly one.
+    assert txt.count("# TYPE serve_requests_submitted gauge") == 1
     # Empty latency series render as no sample, not 0 (None skipped).
     assert "serve_p50_per_token_ms" not in txt
+    # Default instances auto-number and never collide.
+    assert ServeMetrics().instance != ServeMetrics().instance

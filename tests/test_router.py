@@ -1,0 +1,460 @@
+"""Fleet-router tests: cache-affinity placement, prefill/decode KV
+handoff (bitwise parity with a single replica), deadline-class load
+shedding, elastic membership, and the randomized no-drop/no-dup
+property test.
+
+Every engine here shares one geometry (the ``_PFX_KW`` shape from
+test_serve.py) so the whole module — fleets included — reuses ONE
+compiled fn set via the ``make_serve_fns`` memo; adding replicas
+costs KV pools, not compiles, which keeps this file tier-1-fast.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+import jax
+
+from horovod_tpu.models import TransformerConfig, init_transformer
+from horovod_tpu.serve import (
+    FleetSaturated, RouterConfig, ServeConfig, ServeEngine, ServeRouter,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# Same geometry as test_serve.py's _PFX_KW: one compiled fn set for
+# the whole serve test tier.
+_KW = dict(max_batch=4, block_size=4, max_prompt=24, max_new_tokens=6,
+           batch_buckets=(4,), prefill_buckets=(4, 8, 16, 24))
+
+
+@pytest.fixture(scope="module")
+def served_model():
+    cfg = TransformerConfig.tiny(dtype=jnp.float32, remat=False)
+    params = init_transformer(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _mk_router(served_model, clock=None, serve_kw=None, **router_kw):
+    cfg, params = served_model
+    rc = RouterConfig(**router_kw)
+    sc = ServeConfig(**{**_KW, **(serve_kw or {})})
+    return ServeRouter(cfg, params, rc, sc, clock=clock or FakeClock())
+
+
+def _mk_engine(served_model, clock=None, **kw):
+    cfg, params = served_model
+    return ServeEngine(cfg, params, ServeConfig(**{**_KW, **kw}),
+                       clock=clock or FakeClock())
+
+
+def _tenant_prompts(n_per_tenant=3, n_tenants=2, prefix_len=12,
+                    rng_seed=21):
+    """Interleaved multi-tenant burst: tenant i's requests share a
+    ``prefix_len``-token system prompt."""
+    rng = np.random.RandomState(rng_seed)
+    prefixes = [rng.randint(1, 256, size=prefix_len).tolist()
+                for _ in range(n_tenants)]
+    out = []
+    for _ in range(n_per_tenant):
+        for p in prefixes:
+            out.append(p + rng.randint(1, 256,
+                                       size=int(rng.randint(2, 6))).tolist())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Config validation
+# ---------------------------------------------------------------------------
+
+def test_router_config_validation():
+    with pytest.raises(ValueError):
+        RouterConfig(n_replicas=0)
+    with pytest.raises(ValueError):
+        RouterConfig(n_replicas=2, n_prefill=2)  # no decode replica left
+    with pytest.raises(ValueError):
+        RouterConfig(placement="hash")
+
+
+def test_router_submit_validates_like_the_engine(served_model):
+    """Every rejection the engine enforces at submit must reject at
+    ROUTER submit too — an accepted-then-unplaceable request would
+    otherwise blow ValueError out of a later step() mid-serve."""
+    router = _mk_router(served_model, n_replicas=1,
+                        serve_kw={"max_prompt": 124,
+                                  "prefill_buckets": (124,),
+                                  "block_size": 4})
+    with pytest.raises(ValueError):
+        router.submit([])
+    with pytest.raises(ValueError, match="max_prompt"):
+        router.submit([1] * 125)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        router.submit([1, 2], max_new_tokens=7)
+    with pytest.raises(ValueError, match="deadline_class"):
+        router.submit([1, 2], deadline_class=-1)
+    # Fits max_prompt/max_new but overflows the MODEL's max_seq (128).
+    with pytest.raises(ValueError, match="max_seq"):
+        router.submit([1] * 124, max_new_tokens=6)
+    # Worst-case KV reservation no replica pool can ever cover.
+    tight = _mk_router(served_model, n_replicas=1,
+                       serve_kw={"n_blocks": 3})
+    with pytest.raises(ValueError, match="KV blocks"):
+        tight.submit([1] * 8, max_new_tokens=6)
+    # Nothing above left residue: the fleet still serves (the tight
+    # pool shares the module's one compiled geometry).
+    assert tight.generate([[1, 2, 3]], 2) == \
+        _mk_engine(served_model).generate([[1, 2, 3]], 2)
+
+
+# ---------------------------------------------------------------------------
+# Placement + parity
+# ---------------------------------------------------------------------------
+
+def test_routed_parity_with_single_replica(served_model):
+    """Acceptance: a routed fleet (shared pool churn, placement
+    spread) produces BITWISE the token streams of one replica serving
+    the same trace — and placement is deterministic for a fixed
+    seed."""
+    prompts = _tenant_prompts()
+    ref = _mk_engine(served_model).generate(prompts, 4)
+    r1 = _mk_router(served_model, n_replicas=2)
+    assert r1.generate(prompts, 4) == ref
+    r2 = _mk_router(served_model, n_replicas=2)
+    assert r2.generate(prompts, 4) == ref
+    assert r1.placement_log == r2.placement_log
+    # Random placement is a different policy but the same math.
+    r3 = _mk_router(served_model, n_replicas=2, placement="random")
+    assert r3.generate(prompts, 4) == ref
+
+
+def test_affinity_groups_same_prefix_traffic(served_model):
+    """A burst of two tenants' requests lands grouped: each tenant's
+    traffic goes to ONE replica (the burst hint — siblings placed
+    before anyone prefilled still follow the first placement), and
+    the two tenants end up on different replicas (least-load
+    fallback for the first request of each)."""
+    prompts = _tenant_prompts(n_per_tenant=3, n_tenants=2)
+    router = _mk_router(served_model, n_replicas=2)
+    router.generate(prompts, 4)
+    by_rid = {rid: inst for rid, inst, _ in router.placement_log}
+    tenant_a = [by_rid[i] for i in range(0, len(prompts), 2)]
+    tenant_b = [by_rid[i] for i in range(1, len(prompts), 2)]
+    assert len(set(tenant_a)) == 1
+    assert len(set(tenant_b)) == 1
+    assert tenant_a[0] != tenant_b[0]
+    # Follow-up same-tenant requests report a positive chain match.
+    matches = [m for rid, _, m in router.placement_log if rid >= 2]
+    assert all(m > 0 for m in matches)
+    # The fleet rollup sees the grouped traffic as cache hits.
+    snap = router.metrics.snapshot()
+    assert snap["prefix_cache_hit_rate"] > 0.4
+    assert snap["placed_affinity"] >= len(prompts) - 2
+    assert snap["requests_finished"] == len(prompts)
+
+
+def test_affinity_only_routes_with_capacity(served_model):
+    """The affinity walk never picks a replica whose admission queue
+    is full — capacity is filtered before scoring, so a hot replica
+    at its queue cap sheds follow-on traffic to a cold one instead of
+    overflowing."""
+    prompts = _tenant_prompts(n_per_tenant=4, n_tenants=1)
+    router = _mk_router(served_model, n_replicas=2,
+                        serve_kw={"max_queue": 2})
+    rids = [router.submit(p, 2) for p in prompts]
+    router._place_queued()
+    by_rid = {rid: inst for rid, inst, _ in router.placement_log}
+    # First two stick to the affinity target; once its queue is full
+    # the rest MUST go elsewhere (not stall, not overflow).
+    assert len(set(by_rid.values())) == 2
+    for eng in router.engines:
+        assert eng.metrics.max_queue_depth <= 2
+    router.run_until_idle()
+    assert all(router.result(r).status == "ok" for r in rids)
+
+
+# ---------------------------------------------------------------------------
+# Prefill/decode pools + KV handoff
+# ---------------------------------------------------------------------------
+
+def test_handoff_parity_and_pool_separation(served_model):
+    """Acceptance: a split fleet (prefill pool -> KV handoff ->
+    decode pool) emits bitwise the single-replica streams; prefill
+    replicas never decode, decode replicas never prefill, and every
+    pool drains to zero blocks."""
+    prompts = _tenant_prompts()
+    ref = _mk_engine(served_model).generate(prompts, 4)
+    router = _mk_router(served_model, n_replicas=2, n_prefill=1)
+    assert router.generate(prompts, 4) == ref
+    assert router.metrics.handoffs == len(prompts)
+    prefill_eng, decode_eng = router.engines
+    assert prefill_eng.metrics.decode_steps == 0
+    assert prefill_eng.metrics.handoffs_out == len(prompts)
+    assert decode_eng.metrics.prefill_steps == 0
+    assert decode_eng.metrics.handoffs_in == len(prompts)
+    for eng in router.engines:
+        assert eng.allocator.n_used == 0
+    # The decode replica registered the injected prompt blocks: a
+    # repeat of the same trace hands off with warm prefixes and still
+    # matches bitwise.
+    assert router.generate(prompts, 4) == ref
+
+
+def test_handoff_chunked_prefill_parity(served_model):
+    """Chunked prefill on the prefill pool composes with handoff:
+    long prompts stream in across steps, then move — same tokens."""
+    prompts = _tenant_prompts(prefix_len=16)
+    ref = _mk_engine(served_model).generate(prompts, 4)
+    router = _mk_router(served_model, n_replicas=2, n_prefill=1,
+                        serve_kw={"prefill_chunk": 4})
+    assert router.generate(prompts, 4) == ref
+    assert router.metrics.handoffs == len(prompts)
+
+
+def test_handoff_single_token_finishes_at_prefill_replica(served_model):
+    """max_new=1 finishes at prefill (the first token IS the whole
+    answer) — nothing to hand off, result still collected."""
+    prompts = _tenant_prompts(n_per_tenant=1)
+    ref = _mk_engine(served_model).generate(prompts, 1)
+    router = _mk_router(served_model, n_replicas=2, n_prefill=1)
+    assert router.generate(prompts, 1) == ref
+    assert router.metrics.handoffs == 0
+
+
+# ---------------------------------------------------------------------------
+# Deadline-class shedding + structured rejection
+# ---------------------------------------------------------------------------
+
+def test_shed_drops_lowest_class_first(served_model):
+    router = _mk_router(served_model, n_replicas=1, max_queue=2)
+    prompts = _tenant_prompts(n_per_tenant=2)
+    a = router.submit(prompts[0], 2, deadline_class=2)
+    b = router.submit(prompts[1], 2, deadline_class=1)
+    # Queue full; class 0 arrival sheds the NEWEST of the WORST class
+    # — a (class 2), not b (class 1).
+    c = router.submit(prompts[2], 2, deadline_class=0)
+    res = router.result(a)
+    assert res.status == "shed" and res.http_status == 503
+    assert res.reason == "shed_low_class"
+    assert res.deadline_class == 2
+    assert res.retry_after_s is not None and res.retry_after_s >= 0
+    assert res.tokens == []
+    # A same-or-lower-priority arrival cannot displace anyone: FIFO
+    # favors the queued, the arrival gets the structured exception.
+    with pytest.raises(FleetSaturated) as ei:
+        router.submit(prompts[3], 2, deadline_class=1)
+    assert ei.value.reason == "shed_low_class"
+    assert ei.value.deadline_class == 1
+    assert ei.value.http_status == 503
+    assert ei.value.retry_after_s is not None
+    router.run_until_idle()
+    assert router.result(b).status == "ok"
+    assert router.result(c).status == "ok"
+    snap = router.metrics.snapshot()
+    assert snap["shed_total"] == 2
+    assert snap["shed_class_1"] == 1 and snap["shed_class_2"] == 1
+
+
+def test_router_deadline_expiry_is_structured(served_model):
+    clock = FakeClock()
+    router = _mk_router(served_model, clock=clock, n_replicas=1,
+                        serve_kw={"max_batch": 1, "max_queue": 1})
+    # Two requests saturate the single replica's queue+batch; the
+    # third waits at the ROUTER and expires there.
+    prompts = _tenant_prompts(n_per_tenant=2)
+    a = router.submit(prompts[0], 2)
+    b = router.submit(prompts[1], 2)
+    stale = router.submit(prompts[2], 2, deadline=clock() + 1.0,
+                          deadline_class=1)
+    clock.advance(5.0)
+    router.run_until_idle()
+    res = router.result(stale)
+    assert res.status == "expired" and res.reason == "deadline_expired"
+    assert res.deadline_class == 1
+    assert res.retry_after_s is not None
+    assert router.result(a).status == "ok"
+    assert router.result(b).status == "ok"
+
+
+# ---------------------------------------------------------------------------
+# Elastic membership
+# ---------------------------------------------------------------------------
+
+def test_replica_join_and_drain_leave(served_model):
+    """Remove a replica mid-flight: its queued work requeues through
+    the router, in-flight sequences finish on the draining replica,
+    the replica reaps out — and nothing is dropped or duplicated."""
+    prompts = _tenant_prompts(n_per_tenant=4)
+    router = _mk_router(served_model, n_replicas=2,
+                        serve_kw={"max_batch": 2})
+    rids = [router.submit(p, 3) for p in prompts]
+    router.step()
+    victim = router.replicas[0]
+    router.remove_replica(victim)
+    joined = router.add_replica()
+    assert joined not in (victim,)
+    router.run_until_idle()
+    assert victim not in router.replicas
+    assert joined in router.replicas
+    results = [router.result(r) for r in rids]
+    assert all(res is not None and res.status == "ok" for res in results)
+    assert len({res.rid for res in results}) == len(rids)
+    # The reference stream is unchanged by membership churn.
+    ref = _mk_engine(served_model).generate(prompts, 3)
+    assert [res.tokens for res in results] == ref
+    # Drained-and-requeued work must not double-count in the fleet
+    # rollup: submitted balances finished exactly — the reaped
+    # replica's lifetime counters were absorbed, not dropped.
+    snap = router.metrics.snapshot()
+    assert snap["requests_submitted"] == snap["requests_finished"] \
+        == len(prompts)
+    # Its latency samples were absorbed too: the fleet tail still
+    # covers every request served, not just the survivors' (a drain
+    # must never make the fleet p99 look better).
+    assert len(router.metrics._retired_samples["first_token_s"]) > 0
+    live = sum(len(e.metrics.first_token_s) for e in router.engines)
+    absorbed = len(router.metrics._retired_samples["first_token_s"])
+    assert live + absorbed == len(prompts)
+    assert snap["p99_first_token_ms"] is not None
+
+
+def test_cannot_remove_last_replica(served_model):
+    router = _mk_router(served_model, n_replicas=1)
+    with pytest.raises(ValueError, match="last"):
+        router.remove_replica(router.replicas[0])
+    split = _mk_router(served_model, n_replicas=2, n_prefill=1)
+    with pytest.raises(ValueError, match="last"):
+        split.remove_replica(split.replicas[0])   # only prefill
+    with pytest.raises(ValueError, match="last"):
+        split.remove_replica(split.replicas[1])   # only decode
+
+
+# ---------------------------------------------------------------------------
+# Randomized property test (the PR 4 allocator-stress spirit)
+# ---------------------------------------------------------------------------
+
+def _drive_property_run(served_model, seed):
+    """One seeded run of the router property machine: random
+    submit/step/join/leave interleaving. Returns (placement_log,
+    {rid: (status, tokens)}, max queue depths seen)."""
+    rng = np.random.RandomState(seed)
+    clock = FakeClock()
+    router = _mk_router(served_model, clock=clock, n_replicas=2,
+                        max_queue=6, serve_kw={"max_batch": 2,
+                                               "max_queue": 3})
+    prefixes = [rng.randint(1, 256, size=8).tolist() for _ in range(3)]
+    submitted, saturated = [], 0
+    for _ in range(60):
+        op = rng.randint(4)
+        if op == 0:                   # submit
+            p = (prefixes[int(rng.randint(3))]
+                 + rng.randint(1, 256,
+                               size=int(rng.randint(1, 5))).tolist())
+            cls = int(rng.randint(3))
+            try:
+                submitted.append(router.submit(
+                    p, int(rng.randint(1, 4)), deadline_class=cls))
+            except FleetSaturated:
+                saturated += 1
+        elif op == 1:                 # step
+            clock.advance(0.01)
+            router.step()
+        elif op == 2 and len(router.replicas) < 4:   # join
+            router.add_replica()
+        elif op == 3:                 # leave (never the last one)
+            live = [i for i in router.replicas
+                    if not router._replica(i).draining]
+            if len(live) > 1:
+                router.remove_replica(live[int(rng.randint(len(live)))])
+    router.run_until_idle()
+    results = {rid: (router.result(rid).status,
+                     tuple(router.result(rid).tokens))
+               for rid in submitted}
+    depths = [e.metrics.max_queue_depth for e in router.engines]
+    return router.placement_log, results, depths, saturated
+
+
+def test_router_randomized_property(served_model):
+    """Invariants under random submit/step/join/leave interleaving:
+
+    * every submitted request resolves to EXACTLY one result — none
+      dropped (even across replica drains), none duplicated;
+    * non-shed results are complete ("ok" with tokens — no deadlines
+      were set, so nothing expires);
+    * no engine's admission queue ever exceeded its cap (affinity and
+      fallback both respect capacity);
+    * the whole run — placements included — is deterministic for a
+      fixed seed.
+    """
+    log1, results1, depths1, sat1 = _drive_property_run(served_model, 7)
+    assert results1, "property run submitted nothing"
+    for rid, (status, tokens) in results1.items():
+        assert status in ("ok", "shed"), (rid, status)
+        if status == "ok":
+            assert len(tokens) >= 1
+        else:
+            assert tokens == ()
+    assert all(d <= 3 for d in depths1), depths1
+    # Determinism: same seed, same machine evolution, bit for bit.
+    log2, results2, depths2, sat2 = _drive_property_run(served_model, 7)
+    assert log1 == log2
+    assert results1 == results2
+    assert sat1 == sat2
+    # A different seed takes a different trajectory (the test isn't
+    # vacuously comparing two empty runs).
+    log3, results3, _, _ = _drive_property_run(served_model, 8)
+    assert (log3, results3) != (log1, results1)
+
+
+# ---------------------------------------------------------------------------
+# Fleet metrics exposition
+# ---------------------------------------------------------------------------
+
+def test_fleet_prometheus_instances_and_rollup(served_model):
+    """One scrape carries every replica's serve_ series under
+    distinct instance labels plus the serve_fleet_ rollup, with one
+    TYPE line per family."""
+    import re
+
+    from horovod_tpu.metrics import metrics_prometheus
+
+    router = _mk_router(served_model, n_replicas=2)
+    router.generate(_tenant_prompts(n_per_tenant=1), 2)
+    txt = metrics_prometheus()
+    insts = set(re.findall(
+        r'^serve_requests_finished\{instance="([^"]+)"\} ', txt,
+        re.M))
+    assert {e.metrics.instance for e in router.engines} <= insts
+    fleet = re.escape(router.metrics.fleet)
+    assert re.search(r'^serve_fleet_replicas\{fleet="%s"\} 2$' % fleet,
+                     txt, re.M)
+    # Exactly one TYPE line per family, N labeled samples.
+    fams = re.findall(r"^# TYPE (serve_requests_finished) gauge$", txt,
+                      re.M)
+    assert len(fams) == 1
+    # Fleet sums equal the sum of the labeled per-replica samples.
+    per = [float(v) for v in re.findall(
+        r'^serve_requests_finished\{instance="[^"]+"\} ([0-9.]+)$',
+        txt, re.M)]
+    m = re.search(r'^serve_fleet_requests_finished\{fleet="%s"\} '
+                  r'([0-9.]+)$' % fleet, txt, re.M)
+    fleet_total = float(m.group(1))
+    # Other live engines from earlier tests may also export; restrict
+    # to this fleet's instances.
+    mine = 0.0
+    for e in router.engines:
+        mm = re.search(
+            r'^serve_requests_finished\{instance="%s"\} ([0-9.]+)$'
+            % re.escape(e.metrics.instance), txt, re.M)
+        mine += float(mm.group(1))
+    assert mine == fleet_total == 2.0
+    assert sum(per) >= fleet_total

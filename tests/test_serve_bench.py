@@ -5,8 +5,8 @@ batching, prefix-cache on vs off, chunked vs monolithic prefill."""
 import pytest
 
 from horovod_tpu.serve.bench import (
-    make_shared_prefix_trace, make_trace, run_prefix_benchmark,
-    run_serving_benchmark,
+    make_multi_tenant_trace, make_shared_prefix_trace, make_trace,
+    run_prefix_benchmark, run_router_benchmark, run_serving_benchmark,
 )
 
 
@@ -34,6 +34,48 @@ def test_make_shared_prefix_trace_shape():
     suffixes = {tuple(p[16:]) for p, _ in t1}
     assert len(suffixes) == 12
     assert all(len(p) > 16 for p, _ in t1)
+
+
+def test_make_multi_tenant_trace_shape():
+    t1 = make_multi_tenant_trace(24, seed=3, n_tenants=4, prefix_len=16)
+    assert t1 == make_multi_tenant_trace(24, seed=3, n_tenants=4,
+                                         prefix_len=16)
+    assert len(t1) == 24
+    prefixes = {tuple(p[:16]) for p, _ in t1}
+    # Several distinct tenants, each appearing more than once — the
+    # regime where placement (not just caching) decides the hit rate.
+    assert 1 < len(prefixes) <= 4
+    from collections import Counter
+    counts = Counter(tuple(p[:16]) for p, _ in t1)
+    assert max(counts.values()) > 1
+    assert all(len(p) > 16 for p, _ in t1)
+    assert make_multi_tenant_trace(8, seed=4) != \
+        make_multi_tenant_trace(8, seed=5)
+
+
+@pytest.mark.slow
+def test_router_beats_random_placement():
+    """Acceptance (ISSUE 8): on the 4-replica multi-tenant replay,
+    cache-affinity routing beats random placement on prefix hit rate
+    AND p99 first-token latency, with token streams bitwise identical
+    to a single replica — including across the prefill/decode
+    handoff. Structural claims (parity, hit-rate ordering — both
+    deterministic given seeded placement) hold on every attempt; the
+    latency ordering is measured wall time, so it gets the repo's
+    best-of-3-attempts weather allowance (the routed arm skips whole
+    prefix prefills, so only severe scheduler interference can invert
+    it)."""
+    for _ in range(3):
+        out = run_router_benchmark(n_requests=32, repeats=3)
+        assert out["serve_router_tokens_identical"]
+        assert (out["serve_router_prefix_hit_rate"]
+                > out["serve_router_random_prefix_hit_rate"])
+        assert out["serve_router_handoff_count"] > 0
+        perf_ok = (out["serve_router_p99_first_token_ms"]
+                   <= out["serve_router_random_p99_first_token_ms"])
+        if perf_ok:
+            break
+    assert perf_ok
 
 
 @pytest.mark.slow

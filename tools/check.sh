@@ -25,11 +25,14 @@ make -C native tsa || fail=1
 
 if [[ "${1:-}" != "--no-tests" ]]; then
   step "fast invariant tests"
-  # The lint self-tests (incl. real-tree-clean + bug injection) and the
-  # two-sided ABI pins — the dynamic halves of what lint checks
-  # statically. Everything here is tier-1-fast.
+  # The lint self-tests (incl. real-tree-clean + bug injection), the
+  # two-sided ABI pins, and the fleet-router invariants (no-drop/
+  # no-dup property machine, handoff bitwise parity, shed ordering) —
+  # the dynamic halves of what lint checks statically plus the newest
+  # subsystem's correctness gate. Everything here is tier-1-fast.
   python3 -m pytest -q -p no:cacheprovider \
       tests/test_lint.py tests/test_wire_abi.py tests/test_metrics_abi.py \
+      tests/test_router.py \
       || fail=1
 fi
 
