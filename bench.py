@@ -401,6 +401,59 @@ def _transformer_worker():
         if mfu is not None:
             out["transformer_mfu_pct"] = mfu
         print("TFEXTRA " + json.dumps(out), flush=True)
+
+        # In-jit mesh-compression arms (EQuARX, ops/quantized.py): the
+        # SAME train step at compression=none|bf16|int8, so the keys
+        # isolate what the quantized gradient reduce-scatter+all-gather
+        # buys end to end. The quantized path needs a dp-only mesh (no
+        # GSPMD collective to intercept otherwise) — build_mesh(dp=-1)
+        # above qualifies. Arms interleave round-robin per the +-30%
+        # protocol (docs/perf_tuning.md) and report best-of-rounds;
+        # smaller shape than the headline so three extra compiles fit
+        # the worker's 300s cap, printed incrementally so a cap kill
+        # keeps everything already measured.
+        if all(s == 1 for ax, s in mesh.shape.items() if ax != "dp"):
+            from horovod_tpu.compression import Compression
+            cfg_c = TransformerConfig(
+                vocab_size=4096, d_model=1024, n_layers=4, n_heads=16,
+                n_kv_heads=8, d_ff=4096, max_seq=512, dtype=jnp.bfloat16,
+                sp_attention="local", remat=False)
+            arms = {"comp_none": None, "bf16": Compression.bf16,
+                    "int8": Compression.int8}
+            B, T, iters, rounds = 4 * mesh.devices.size, 512, 5, 3
+            toks = jax.random.randint(jax.random.PRNGKey(2), (B, T + 1),
+                                      0, cfg_c.vocab_size)
+            live, n_params = {}, None
+            for name, comp in arms.items():
+                init_s, stp, _ = make_train_step(cfg_c, mesh,
+                                                 compression=comp)
+                st = jax.jit(init_s)(jax.random.PRNGKey(0))
+                for _ in range(2):                    # compile + warm
+                    st, loss = stp(st, {"tokens": toks})
+                float(loss)
+                if n_params is None:
+                    n_params = sum(int(x.size) for x in
+                                   jax.tree.leaves(st["params"]))
+                live[name] = (stp, st)
+            best = {name: 0.0 for name in arms}
+            for _ in range(rounds):
+                for name in arms:
+                    stp, st = live[name]
+                    t0 = time.perf_counter()
+                    for _ in range(iters):
+                        st, loss = stp(st, {"tokens": toks})
+                    float(loss)
+                    dt = time.perf_counter() - t0
+                    live[name] = (stp, st)
+                    best[name] = max(best[name],
+                                     B * T * iters / dt / mesh.devices.size)
+            for name, ts in best.items():
+                out[f"transformer_{name}_tokens_per_sec_per_chip"] = round(
+                    ts, 1)
+                if peak_flops:
+                    out[f"transformer_mfu_{name}"] = round(
+                        100 * 6 * n_params * ts / peak_flops, 1)
+            print("TFEXTRA " + json.dumps(out), flush=True)
     except Exception:
         pass
 

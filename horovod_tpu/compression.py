@@ -3,7 +3,8 @@ and ``tensorflow/compression.py``): compress before the wire, decompress
 after. On TPU the interesting codec is bf16 (native MXU dtype); fp16 is
 kept for parity.
 
-Two tiers share this namespace:
+Three tiers share this namespace, all selected by the SAME
+``compression=hvd.Compression.*`` knob:
 
 * **Cast compression** (``compress``/``decompress``) — the reference's
   framework-level API used by the optimizer wrappers: cast the tensor
@@ -15,6 +16,13 @@ Two tiers share this namespace:
   user memory and only the ring/doubling exchange bytes shrink (int8
   additionally carries per-block scales and rank-local error-feedback
   residuals, per EQuARX). See ``docs/perf_tuning.md``.
+* **In-jit mesh compression** — the XLA-graph codecs in
+  ``ops/quantized.py``. Passing a member as ``compression=`` on the
+  in-jit tier (``allreduce_gradients(axis_name=...)``,
+  ``ops.collectives.allreduce``, ``make_train_step``) maps it through
+  ``in_jit_codec`` below onto a quantized reduce-scatter + all-gather
+  whose collective operands ship narrow bytes inside the compiled
+  program. One knob, both planes.
 """
 
 from __future__ import annotations
@@ -29,6 +37,15 @@ class Compressor:
     #: native wire codec this compressor maps to when passed as
     #: ``compression=`` on an eager collective (None = not wire-capable).
     wire_codec = None
+    #: in-jit mesh codec name (``ops/quantized.py`` CODECS entry) this
+    #: compressor maps to on the jit tier (None = not in-jit capable).
+    in_jit_codec = None
+    #: whether ``compress``/``decompress`` implement the framework-level
+    #: cast tier (False = wire/in-jit only; the cast API raises).
+    cast_tier = True
+    #: whether the in-jit path threads a rank-local error-feedback
+    #: residual (the optimizer wrappers allocate state for it).
+    needs_error_feedback = False
 
     @staticmethod
     def compress(tensor):
@@ -42,6 +59,7 @@ class Compressor:
 
 class NoneCompressor(Compressor):
     wire_codec = _WIRE_NONE
+    in_jit_codec = "none"
 
     @staticmethod
     def compress(tensor):
@@ -68,6 +86,7 @@ def _cast(tensor, dtype_name: str):
 
 class FP16Compressor(Compressor):
     wire_codec = _WIRE_FP16
+    in_jit_codec = "fp16"
 
     @staticmethod
     def compress(tensor):
@@ -85,6 +104,7 @@ class FP16Compressor(Compressor):
 
 class BF16Compressor(Compressor):
     wire_codec = _WIRE_BF16
+    in_jit_codec = "bf16"
 
     @staticmethod
     def compress(tensor):
@@ -101,29 +121,47 @@ class BF16Compressor(Compressor):
 
 
 class Int8Compressor(Compressor):
-    """Blockwise-scaled int8 **wire** compression with error feedback.
+    """Blockwise-scaled int8 compression with error feedback.
 
     Unlike the cast compressors above there is no meaningful int8
     *tensor* representation to hand back to the framework (int8 values
-    cannot be summed by a collective without their scales), so the
-    cast API is an identity passthrough: the quantization lives
-    entirely inside the native TCP data plane, which keeps per-block
-    absmax scales on the wire and rank-local error-feedback residuals
-    so each step's rounding error is carried into the next
-    (``native/src/codec.cc``; EQuARX, arXiv:2506.17615). Use it as
-    ``hvd.allreduce(grad, compression=hvd.Compression.int8)`` or
-    job-wide via ``HOROVOD_WIRE_COMPRESSION=int8``.
+    cannot be summed by a collective without their scales), so the cast
+    API is undefined — :meth:`compress` raises instead of failing deep
+    inside a framework cast. The quantization lives in the data planes:
+    the native TCP wire codec (``native/src/codec.cc``) and the in-jit
+    mesh codec (``ops/quantized.py``), both keeping per-block absmax
+    scales on the wire and rank-local error-feedback residuals so each
+    step's rounding error is carried into the next (EQuARX,
+    arXiv:2506.17615). Use it as
+    ``hvd.allreduce(grad, compression=hvd.Compression.int8)`` (eager
+    wire), ``allreduce_gradients(..., axis_name="dp",
+    compression=hvd.Compression.int8)`` /
+    ``make_train_step(..., compression=...)`` (in-jit), or job-wide via
+    ``HOROVOD_WIRE_COMPRESSION=int8``.
     """
 
     wire_codec = _WIRE_INT8
+    in_jit_codec = "int8"
+    cast_tier = False
+    needs_error_feedback = True
 
     @staticmethod
     def compress(tensor):
-        return tensor, None
+        raise NotImplementedError(
+            "Compression.int8 has no framework-level cast form (int8 "
+            "values cannot be summed by a collective without their "
+            "scales). Pass it as compression= to the eager API "
+            "(hvd.allreduce / allreduce_gradients — rides the native "
+            "wire codec) or to the in-jit tier (allreduce_gradients("
+            "axis_name=...), ops.collectives.allreduce, make_train_step "
+            "— rides ops/quantized.py) instead of calling "
+            "compress()/decompress() directly.")
 
     @staticmethod
     def decompress(tensor, ctx):
-        return tensor
+        raise NotImplementedError(
+            "Compression.int8 has no framework-level cast form; see "
+            "Int8Compressor.compress")
 
 
 def wire_codec_id(compression) -> int:
@@ -142,6 +180,31 @@ def wire_codec_id(compression) -> int:
             f"compression must be None or a hvd.Compression member with a "
             f"wire codec, got {compression!r}")
     return int(codec)
+
+
+def in_jit_codec(compression) -> str:
+    """Map a ``compression=`` argument to the in-jit mesh codec name
+    (``ops/quantized.py`` CODECS entry).
+
+    ``None`` means uncompressed (``"none"``); a :class:`Compressor`
+    class or instance maps through its ``in_jit_codec``. Anything else
+    is a usage error — better loud than a silently uncompressed mesh.
+    """
+    if compression is None:
+        return "none"
+    codec = getattr(compression, "in_jit_codec", None)
+    if codec is None:
+        raise ValueError(
+            f"compression must be None or a hvd.Compression member with an "
+            f"in-jit codec, got {compression!r}")
+    return codec
+
+
+def needs_error_feedback(compression) -> bool:
+    """Whether the in-jit path for ``compression`` threads an EF
+    residual (int8 today; the cast codecs drop their tiny rounding
+    error like the reference's fp16 compressor does)."""
+    return bool(getattr(compression, "needs_error_feedback", False))
 
 
 class Compression:

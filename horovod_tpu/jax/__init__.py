@@ -52,7 +52,7 @@ AxisName = Union[str, tuple]
 def allreduce_gradients(grads: Any, *, axis_name: Optional[AxisName] = None,
                         op: ReduceOp = Average,
                         compression=Compression.none,
-                        name: str = "grads") -> Any:
+                        name: str = "grads", ef: Any = None) -> Any:
     """Reduce a gradient pytree across ranks.
 
     In-jit (``axis_name`` given): per-leaf ``lax.psum``/``pmean`` —
@@ -62,11 +62,27 @@ def allreduce_gradients(grads: Any, *, axis_name: Optional[AxisName] = None,
     axes typing, autodiff cotangents of *replicated* parameters are
     already globally correct (the mean-vs-sum choice lives in the loss
     — see :func:`distributed_value_and_grad`), and an explicit psum on
-    them would double-count.
+    them would double-count. With ``compression``, reduced leaves ride
+    the quantized reduce-scatter + all-gather of
+    :mod:`horovod_tpu.ops.quantized` (narrow bytes on both hops).
     Eager (no ``axis_name``): one grouped allreduce over all leaves via
-    the native-negotiated runtime, so fusion batches small gradients.
+    the native-negotiated runtime, so fusion batches small gradients;
+    ``compression`` maps to the framework cast (bf16/fp16) or the
+    native wire codec (int8) — the same knob either way.
+
+    ``ef`` (in-jit int8 only): a pytree of rank-local error-feedback
+    residuals matching ``grads`` (f32, zeros at step 0). When given,
+    returns ``(reduced, new_ef)`` so callers — normally
+    :func:`distributed_optimizer`, which threads it as optimizer-state
+    leaves — carry this step's rounding error into the next. Without
+    it, quantization error is dropped each step.
     """
     import jax
+
+    from horovod_tpu import compression as compression_lib
+    if compression is None:  # every surface reads None = uncompressed
+        compression = Compression.none
+    codec = compression_lib.in_jit_codec(compression)
 
     leaves, treedef = jax.tree.flatten(grads)
     if axis_name is not None:
@@ -74,7 +90,7 @@ def allreduce_gradients(grads: Any, *, axis_name: Optional[AxisName] = None,
         axes = ({axis_name} if isinstance(axis_name, str)
                 else set(axis_name))
 
-        def reduce_leaf(g):
+        def leaf_varies(g):
             # Legacy jax (no VMA types): every shard_map value is
             # implicitly varying, so always reduce. Keyed on the same
             # HAS_VMA flag as distributed_value_and_grad — the two
@@ -83,10 +99,55 @@ def allreduce_gradients(grads: Any, *, axis_name: Optional[AxisName] = None,
             vma = (getattr(jax.typeof(g), "vma", frozenset())
                    if jax_compat.HAS_VMA and hasattr(jax, "typeof")
                    else axes)
-            if not (axes & set(vma)):
+            return bool(axes & set(vma))
+
+        if codec == "int8":
+            # int8 has no cast form to fall back on: anything the
+            # quantized path can't express is an error up front.
+            if op not in (Average, Sum):
+                raise ValueError(
+                    f"in-jit compression=int8 supports op=Average/Sum "
+                    f"only (there is no meaningful quantized {op!r}); "
+                    "the cast codecs (bf16/fp16) still wrap "
+                    "Max/Min/Adasum")
+            if not isinstance(axis_name, str):
+                raise NotImplementedError(
+                    "in-jit compression=int8 reduces over a single "
+                    f"named axis; got {axis_name!r} — reshape the mesh "
+                    "or reduce axis-by-axis")
+        if (codec != "none" and op in (Average, Sum)
+                and isinstance(axis_name, str)):
+            from horovod_tpu.ops.quantized import quantized_allreduce
+            ef_leaves = (jax.tree.flatten(ef)[0] if ef is not None
+                         else [None] * len(leaves))
+            out, new_ef = [], []
+            for g, r in zip(leaves, ef_leaves):
+                if not leaf_varies(g):
+                    out.append(g)
+                    new_ef.append(r)
+                    continue
+                res = quantized_allreduce(g, op=op, axis_name=axis_name,
+                                          codec=codec, residual=r)
+                if r is None:
+                    out.append(res)
+                    new_ef.append(None)
+                else:
+                    out.append(res[0])
+                    new_ef.append(res[1])
+            reduced = jax.tree.unflatten(treedef, out)
+            if ef is None:
+                return reduced
+            return reduced, jax.tree.unflatten(treedef, new_ef)
+
+        def reduce_leaf(g):
+            if not leaf_varies(g):
                 return g  # replicated or already-reduced cotangent
-            # Compression casts around the collective (wire dtype); XLA
-            # fuses the casts into the psum's own data movement.
+            # Cast codecs wrap whatever the quantized branch doesn't
+            # take (Max/Min/Adasum, and tuple-axis reductions) the
+            # pre-PR way: cast to the wire dtype around the collective
+            # (identity for Compression.none). Single-axis Average/Sum
+            # with a codec never reach here — they ride the quantized
+            # branch above.
             g, ctx = compression.compress(g)
             if op == Average:
                 g = lax.pmean(g, axis_name)
@@ -105,8 +166,22 @@ def allreduce_gradients(grads: Any, *, axis_name: Optional[AxisName] = None,
                     "supported (use Average/Sum/Max/Min/Adasum)")
             return compression.decompress(g, ctx)
 
-        return jax.tree.unflatten(treedef, [reduce_leaf(g) for g in leaves])
+        reduced = jax.tree.unflatten(treedef, [reduce_leaf(g)
+                                               for g in leaves])
+        return (reduced, ef) if ef is not None else reduced
 
+    if ef is not None:
+        raise ValueError(
+            "ef= residuals are an in-jit concern; the eager tier's int8 "
+            "error feedback lives inside the native wire codec "
+            "(native/src/codec.cc)")
+    if not getattr(compression, "cast_tier", True):
+        # Wire-only codec (int8): no framework cast exists — the knob
+        # rides the native plane as a per-chunk wire codec instead, so
+        # eager and in-jit callers share one setting.
+        reduced = api.grouped_allreduce(leaves, name=name, op=op,
+                                        compression=compression)
+        return jax.tree.unflatten(treedef, list(reduced))
     compressed, ctxs = [], []
     for g in leaves:
         c, ctx = compression.compress(g)
@@ -145,15 +220,48 @@ def distributed_optimizer(optimizer, *,
     """
     import optax
 
+    from horovod_tpu import compression as compression_lib
+
     if backward_passes_per_step < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
 
-    def reduce_grads(grads):
+    # In-jit int8 threads rank-local error-feedback residuals as
+    # explicit optimizer-state leaves (the mesh-plane analog of the
+    # wire codec's EF slabs): state grows an "ef" pytree of f32 zeros
+    # and every reduce consumes/produces it, so int8 rounding error
+    # telescopes across steps instead of compounding.
+    use_ef = (axis_name is not None
+              and compression_lib.needs_error_feedback(compression))
+
+    def reduce_grads(grads, ef=None):
         return allreduce_gradients(
             grads, axis_name=axis_name, op=op, compression=compression,
-            name=name)
+            name=name, ef=ef)
+
+    def init_ef(params):
+        import jax
+        import jax.numpy as jnp
+        from horovod_tpu.common.jax_compat import pcast_varying
+        axes = ((axis_name,) if isinstance(axis_name, str)
+                else tuple(axis_name))
+        return jax.tree.map(
+            lambda p: pcast_varying(jnp.zeros(p.shape, jnp.float32), axes),
+            params)
 
     if backward_passes_per_step == 1:
+        if use_ef:
+            def init_fn(params):
+                return {"inner": optimizer.init(params),
+                        "ef": init_ef(params)}
+
+            def update_fn(updates, state, params=None, **extra):
+                reduced, new_ef = reduce_grads(updates, state["ef"])
+                out, inner = optimizer.update(reduced, state["inner"],
+                                              params, **extra)
+                return out, {"inner": inner, "ef": new_ef}
+
+            return optax.GradientTransformation(init_fn, update_fn)
+
         def init_fn(params):
             return optimizer.init(params)
 
@@ -196,28 +304,36 @@ def distributed_optimizer(optimizer, *,
         return jax.tree.map(one, t)
 
     def init_acc(params):
-        return {"inner": optimizer.init(params),
-                "acc": _pvary_missing(
-                    jax.tree.map(jnp.zeros_like, params)),
-                "count": jnp.zeros((), jnp.int32)}
+        state = {"inner": optimizer.init(params),
+                 "acc": _pvary_missing(
+                     jax.tree.map(jnp.zeros_like, params)),
+                 "count": jnp.zeros((), jnp.int32)}
+        if use_ef:
+            state["ef"] = init_ef(params)
+        return state
 
-    def boundary_update(acc, inner, params, extra):
+    def boundary_update(acc, inner, ef, params, extra):
+        if use_ef:
+            reduced, ef = reduce_grads(acc, ef)
+        else:
+            reduced = reduce_grads(acc)
         new_updates, new_inner = optimizer.update(
-            reduce_grads(acc), inner, params, **extra)
+            reduced, inner, params, **extra)
         zero_acc = jax.tree.map(jnp.zeros_like, acc)
-        return new_updates, zero_acc, new_inner
+        return new_updates, zero_acc, new_inner, ef
 
     def update_acc(updates, state, params=None, **extra):
         acc = _pvary_missing(
             jax.tree.map(jnp.add, state["acc"], updates))
         count = state["count"] + 1
+        ef = state.get("ef")
 
         if axis_name is None:
             # Eager tier: concrete control flow (the native-runtime
             # collective is a host call and cannot live under lax.cond).
             if int(count) >= n:
-                out, acc, inner = boundary_update(acc, state["inner"],
-                                                  params, extra)
+                out, acc, inner, ef = boundary_update(
+                    acc, state["inner"], ef, params, extra)
                 count = jnp.zeros((), jnp.int32)
             else:
                 out = jax.tree.map(jnp.zeros_like, updates)
@@ -228,7 +344,7 @@ def distributed_optimizer(optimizer, *,
             # the boundary branch stay SPMD-legal.
             from jax import lax
 
-            def hold(acc, inner):
+            def hold(acc, inner, ef):
                 # FRESH-constant zeros, not zeros_like(acc): constants
                 # are replicated under VMA typing, matching the
                 # boundary branch's post-reduction updates — and the
@@ -237,15 +353,18 @@ def distributed_optimizer(optimizer, *,
                 # device-varying type and poison params' VMA.)
                 zeros = jax.tree.map(
                     lambda a: jnp.zeros(a.shape, a.dtype), acc)
-                return zeros, acc, inner
+                return zeros, acc, inner, ef
 
-            out, acc, inner = lax.cond(
+            out, acc, inner, ef = lax.cond(
                 count >= n,
-                lambda a, i: boundary_update(a, i, params, extra),
-                hold, acc, state["inner"])
+                lambda a, i, e: boundary_update(a, i, e, params, extra),
+                hold, acc, state["inner"], ef)
             count = jnp.where(count >= n, 0, count)
 
-        return out, {"inner": inner, "acc": acc, "count": count}
+        new_state = {"inner": inner, "acc": acc, "count": count}
+        if use_ef:
+            new_state["ef"] = ef
+        return out, new_state
 
     return optax.GradientTransformation(init_acc, update_acc)
 
@@ -276,13 +395,20 @@ def distributed_value_and_grad(fun: Callable, argnums=0, *,
                 "in-jit distributed_value_and_grad supports Average/Sum")
 
         from horovod_tpu.common import jax_compat
+        from horovod_tpu import compression as compression_lib
 
-        if not jax_compat.HAS_VMA:
+        if (not jax_compat.HAS_VMA
+                or compression_lib.in_jit_codec(compression) != "none"):
             # Legacy jax: without VMA-typed transposes, grad-of-pmean
             # does not propagate the averaged cotangent back to
             # replicated params. Take the explicit formulation —
             # local grads, then reduce both loss and grads (the
-            # reduce_leaf legacy branch always psums).
+            # reduce_leaf legacy branch always psums). Compression
+            # takes the same route on ANY jax: grads must exist
+            # explicitly before the collective for the quantized
+            # reduce-scatter + all-gather to ride them (autodiff of a
+            # pmean'd loss never materializes an interceptable
+            # gradient allreduce).
             lvg = jax.value_and_grad(fun, argnums=argnums,
                                      has_aux=has_aux)
 

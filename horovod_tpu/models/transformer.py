@@ -219,7 +219,37 @@ def _rope(x, pos, theta):
     return y.astype(x.dtype)
 
 
-def embed_lookup(embed, tokens, dtype, mesh: Optional[Mesh]):
+def _replicated_table_lookup(embed, tokens, dtype, mesh, codec: str):
+    """The table-replication fallback of :func:`embed_lookup`, with the
+    replication reshard — the table-sized all-gather the island exists
+    to avoid — optionally shipped narrow. ``codec`` "none" is the exact
+    pre-existing path (annotated f32/bf16 reshard); "bf16"/"fp16" cast
+    the table to the wire dtype before the constraint; "int8" ships
+    blockwise q+scales (``ops/quantized.py`` codec, ~4x vs f32) and
+    dequantizes only the gathered rows."""
+    from jax.sharding import NamedSharding as NS
+
+    from horovod_tpu.ops.quantized import _CAST_WIRE
+
+    if codec in _CAST_WIRE:
+        t = lax.with_sharding_constraint(
+            embed.astype(_CAST_WIRE[codec]), NS(mesh, P(None, None)))
+        return t[tokens].astype(dtype)
+    if codec == "int8":
+        from horovod_tpu.ops.quantized import (
+            blockwise_int8_decode, blockwise_int8_encode)
+        q, s = blockwise_int8_encode(embed)
+        q = lax.with_sharding_constraint(q, NS(mesh, P(None, None)))
+        s = lax.with_sharding_constraint(s, NS(mesh, P(None, None)))
+        rows = blockwise_int8_decode(q[tokens], s[tokens], embed.shape[-1])
+        return rows.astype(dtype)
+    replicated = lax.with_sharding_constraint(
+        embed, NS(mesh, P(None, None)))
+    return replicated.astype(dtype)[tokens]
+
+
+def embed_lookup(embed, tokens, dtype, mesh: Optional[Mesh],
+                 compression=None):
     """Vocab-parallel embedding lookup (Megatron recipe, TPU island).
 
     With the table sharded ``P("tp", "fsdp")``, each device holds a
@@ -234,10 +264,18 @@ def embed_lookup(embed, tokens, dtype, mesh: Optional[Mesh]):
 
     Reference analog: none — the reference (torch DDP-style) replicates
     embeddings on every rank; vocab-parallelism is the TPU-first design.
+
+    ``compression`` (a ``hvd.Compression`` member; None = uncompressed)
+    narrows the table-replication *fallback* paths below — the case
+    where the whole table actually moves every step. The island path
+    ignores it: its wires are activation-sized psums/gathers already in
+    the model dtype, nothing table-sized to compress.
     """
+    from horovod_tpu import compression as compression_lib
     from horovod_tpu.common import jax_compat
     from horovod_tpu.common.jax_compat import shard_map
 
+    codec = compression_lib.in_jit_codec(compression)
     V, D = embed.shape
     tp = mesh.shape.get("tp", 1) if mesh is not None else 1
     fsdp = mesh.shape.get("fsdp", 1) if mesh is not None else 1
@@ -250,9 +288,7 @@ def embed_lookup(embed, tokens, dtype, mesh: Optional[Mesh]):
         # (the cost this island exists to avoid), but EXPLICITLY so:
         # an annotated reshard is a planned all-gather, not the
         # partitioner's "involuntary full rematerialization" red flag.
-        replicated = lax.with_sharding_constraint(
-            embed, NamedSharding(mesh, P(None, None)))
-        return replicated.astype(dtype)[tokens]
+        return _replicated_table_lookup(embed, tokens, dtype, mesh, codec)
     if V % tp or D % fsdp:
         import warnings
         warnings.warn(
@@ -260,6 +296,9 @@ def embed_lookup(embed, tokens, dtype, mesh: Optional[Mesh]):
             f"(tp={tp}, fsdp={fsdp}); falling back to a global-view "
             "gather, which forces GSPMD to replicate the table every "
             "step. Pad vocab_size/d_model to multiples of the mesh axes.")
+        if codec != "none" and mesh is not None:
+            return _replicated_table_lookup(embed, tokens, dtype, mesh,
+                                            codec)
         return embed.astype(dtype)[tokens]
     v_loc = V // tp
     # XLA-CPU workaround (same as pipeline.py): shard_map-level bf16
@@ -416,7 +455,8 @@ def lm_loss(params, batch, cfg: TransformerConfig,
 # Train step factory
 # ---------------------------------------------------------------------------
 
-def make_train_step(cfg: TransformerConfig, mesh: Mesh, optimizer=None):
+def make_train_step(cfg: TransformerConfig, mesh: Mesh, optimizer=None, *,
+                    compression=None):
     """Build ``(init_state, step)``: a jitted SPMD training step over
     ``mesh`` — grads by ``jax.grad`` with GSPMD-inserted collectives
     (tp psums, fsdp reduce-scatters, dp allreduces all ride ICI), optax
@@ -425,10 +465,27 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh, optimizer=None):
     The Horovod-product analog of ``DistributedOptimizer`` +
     fused allreduce (``torch/optimizer.py:128``, ``operations.cc:943``)
     collapsed into one compiled program.
+
+    ``compression`` (a ``hvd.Compression`` member; None/none = the
+    exact pre-existing GSPMD step, bitwise unchanged) opts the
+    data-parallel gradient allreduce into the quantized in-jit path
+    (EQuARX): the step is rebuilt as a ``shard_map`` over ``dp`` with
+    the model replicated per shard and gradients reduced by the
+    blockwise int8/bf16 reduce-scatter + all-gather of
+    ``ops/quantized.py``, int8 with rank-local error-feedback residuals
+    carried in ``state["ef"]``. Scope: the quantized plane is the DP
+    gradient allreduce — tp/fsdp/sp sharding has no explicit collective
+    to intercept under GSPMD, so meshes with those axes > 1 raise.
     """
     import optax
     if optimizer is None:
         optimizer = optax.adamw(3e-4, weight_decay=0.01)
+
+    from horovod_tpu import compression as compression_lib
+    codec = compression_lib.in_jit_codec(compression)
+    if codec != "none":
+        return _make_quantized_train_step(cfg, mesh, optimizer,
+                                          compression, codec)
 
     specs = param_specs(cfg)
 
@@ -461,3 +518,102 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh, optimizer=None):
                        in_shardings=(None, batch_sh),
                        out_shardings=(None, NamedSharding(mesh, P())))
     return init_state, jit_step, param_sh
+
+
+def _make_quantized_train_step(cfg: TransformerConfig, mesh: Mesh,
+                               optimizer, compression, codec: str):
+    """The ``compression=`` body of :func:`make_train_step`.
+
+    The GSPMD step has no interceptable dp gradient collective
+    (autodiff of the global-mean loss reduces implicitly), so this
+    variant makes the gradient plane explicit: one ``shard_map`` over
+    the whole mesh runs the model replicated per dp shard on its local
+    batch slice and reduces gradients with
+    :func:`~horovod_tpu.ops.quantized.quantized_allreduce` — both hops
+    of every gradient leaf ship ``codec``-narrow bytes, and int8
+    threads per-rank error-feedback residuals as ``state["ef"]``
+    leaves (globally ``[dp, *param.shape]`` f32, sharded ``P("dp")``,
+    exactly the host plane's per-rank EF-slab shape discipline).
+    """
+    import optax
+
+    from horovod_tpu import compression as compression_lib
+    from horovod_tpu.common.jax_compat import shard_map
+    from horovod_tpu.common.ops_enum import Average
+    from horovod_tpu.ops.quantized import quantized_allreduce
+
+    if "dp" not in mesh.shape:
+        raise ValueError(f"compression= needs a 'dp' mesh axis; mesh has "
+                         f"{tuple(mesh.axis_names)}")
+    for ax, sz in mesh.shape.items():
+        if ax != "dp" and sz > 1:
+            raise ValueError(
+                f"make_train_step(compression={codec!r}) quantizes the "
+                f"data-parallel gradient allreduce; mesh axis {ax!r} of "
+                f"size {sz} has no explicit collective to intercept under "
+                "GSPMD. Use a dp-only mesh, or compression=None for the "
+                "GSPMD-sharded step.")
+    ndp = mesh.shape["dp"]
+    use_ef = compression_lib.needs_error_feedback(compression)
+
+    def init_state(key):
+        # Params replicated over dp (a dp-only mesh has no model
+        # sharding; param_specs' tp/fsdp axes may not even exist here).
+        params = jax.device_put(init_params(cfg, key, None),
+                                NamedSharding(mesh, P()))
+        opt_state = optimizer.init(params)
+        state = {"params": params, "opt": opt_state,
+                 "step": jnp.zeros((), jnp.int32)}
+        if use_ef:
+            state["ef"] = jax.tree.map(
+                lambda p: jnp.zeros((ndp,) + p.shape, jnp.float32), params)
+        return state
+
+    def shard_step(params, opt, ef, tokens):
+        # Per dp shard: local batch slice, model built mesh-free (all
+        # sharded axes are manual here; there is no GSPMD inside).
+        def loss_fn(p):
+            return lm_loss(p, {"tokens": tokens}, cfg, None)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        leaves, treedef = jax.tree.flatten(grads)
+        if use_ef:
+            ef_leaves = jax.tree.flatten(ef)[0]
+            red, nef = [], []
+            for g, r in zip(leaves, ef_leaves):
+                y, nr = quantized_allreduce(g, op=Average, axis_name="dp",
+                                            codec=codec, residual=r[0])
+                red.append(y)
+                nef.append(nr[None])
+            grads = jax.tree.unflatten(treedef, red)
+            ef = jax.tree.unflatten(treedef, nef)
+        else:
+            grads = jax.tree.unflatten(treedef, [
+                quantized_allreduce(g, op=Average, axis_name="dp",
+                                    codec=codec) for g in leaves])
+        loss = lax.pmean(loss, "dp")
+        # Identical (all-gathered) reduced grads on every shard ->
+        # the replicated update keeps params bitwise in sync.
+        updates, opt = optimizer.update(grads, opt, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt, ef, loss
+
+    smapped = shard_map(
+        shard_step, mesh=mesh,
+        in_specs=(P(), P(), P("dp"), P("dp")),
+        out_specs=(P(), P(), P("dp"), P()))
+
+    def step(state, batch):
+        params, opt, ef, loss = smapped(
+            state["params"], state["opt"], state.get("ef", {}),
+            batch["tokens"])
+        new_state = {"params": params, "opt": opt,
+                     "step": state["step"] + 1}
+        if use_ef:
+            new_state["ef"] = ef
+        return new_state, loss
+
+    param_sh = jax.tree.map(lambda s: NamedSharding(mesh, P()),
+                            param_specs(cfg),
+                            is_leaf=lambda x: isinstance(x, P))
+    return init_state, jax.jit(step), param_sh

@@ -9,3 +9,7 @@ from horovod_tpu.ops.collectives import (  # noqa: F401
     axis_rank,
     axis_size,
 )
+from horovod_tpu.ops.quantized import (  # noqa: F401
+    quantized_allreduce,
+    quantized_allgather,
+)

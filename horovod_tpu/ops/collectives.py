@@ -58,13 +58,44 @@ def _scale(x, factor):
 
 def allreduce(x, op: ReduceOp = Average, axis_name: AxisName = "dp", *,
               prescale_factor: Optional[float] = None,
-              postscale_factor: Optional[float] = None):
+              postscale_factor: Optional[float] = None,
+              compression=None):
     """Reduce ``x`` across ``axis_name`` on every shard.
 
     Reference semantics: ``horovod/common/operations.cc:914``
     ``EnqueueTensorAllreduce`` + pre/postscale (``operations.cc:955-970``).
     ``Average`` divides by the axis size after summation.
+
+    ``compression`` (a ``hvd.Compression`` member; None/none =
+    uncompressed, the exact pre-existing path) routes Sum/Average
+    through the quantized reduce-scatter + all-gather in
+    :mod:`horovod_tpu.ops.quantized` so the collective ships narrow
+    bytes inside the XLA graph — the in-jit face of the same knob the
+    eager TCP plane reads as a wire codec.
     """
+    from horovod_tpu import compression as compression_lib
+    codec = compression_lib.in_jit_codec(compression)
+    if codec != "none":
+        if (op in (ReduceOp.AVERAGE, ReduceOp.SUM)
+                and isinstance(axis_name, str)):
+            from horovod_tpu.ops.quantized import quantized_allreduce
+            x = _scale(x, prescale_factor)
+            y = quantized_allreduce(x, op=op, axis_name=axis_name,
+                                    codec=codec)
+            return _scale(y, postscale_factor)
+        if codec == "int8":
+            raise ValueError(
+                f"compression=int8 supports op=Sum/Average over a single "
+                f"named axis (got op={op!r}, axis {axis_name!r}); the "
+                "cast codecs (bf16/fp16) wrap the other shapes")
+        # Cast codecs wrap everything else the plain path supports
+        # (Max/Min/Product/Adasum, tuple axes): cast to the wire dtype
+        # around the uncompressed collective — the same fallback
+        # contract as allreduce_gradients.
+        c, ctx = compression.compress(x)
+        y = allreduce(c, op, axis_name, prescale_factor=prescale_factor,
+                      postscale_factor=postscale_factor)
+        return compression.decompress(y, ctx)
     x = _scale(x, prescale_factor)
     if op == ReduceOp.ADASUM:
         from horovod_tpu.ops.adasum import adasum_allreduce
@@ -90,7 +121,8 @@ def allreduce(x, op: ReduceOp = Average, axis_name: AxisName = "dp", *,
 
 def grouped_allreduce(xs, op: ReduceOp = Average, axis_name: AxisName = "dp", *,
                       prescale_factor: Optional[float] = None,
-                      postscale_factor: Optional[float] = None):
+                      postscale_factor: Optional[float] = None,
+                      compression=None):
     """Allreduce a pytree of tensors as one logical step.
 
     Reference: ``EnqueueTensorAllreduces`` (``operations.cc:943``) +
@@ -98,7 +130,18 @@ def grouped_allreduce(xs, op: ReduceOp = Average, axis_name: AxisName = "dp", *,
     XLA a multi-operand ``psum`` compiles to batched collectives over
     one fused buffer — the moral equivalent of the reference's fusion
     buffer without the explicit memcpy kernels.
+
+    ``compression`` routes each leaf through the quantized path (see
+    :func:`allreduce`); XLA's combiner still batches the per-leaf
+    narrow collectives.
     """
+    from horovod_tpu import compression as compression_lib
+    if compression_lib.in_jit_codec(compression) != "none":
+        return jax.tree.map(
+            lambda t: allreduce(t, op, axis_name,
+                                prescale_factor=prescale_factor,
+                                postscale_factor=postscale_factor,
+                                compression=compression), xs)
     if op == ReduceOp.ADASUM:
         from horovod_tpu.ops.adasum import adasum_allreduce
         xs = jax.tree.map(lambda l: _scale(l, prescale_factor), xs)

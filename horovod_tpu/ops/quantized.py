@@ -1,0 +1,241 @@
+"""Quantized in-jit mesh collectives (EQuARX, arXiv:2506.17615).
+
+PR 3 compressed the host TCP ring; this module compresses the plane the
+models actually train on — the in-``jit`` collectives over NamedSharding
+meshes. Pure ``jnp`` (Pallas hard-aborts on this container's XLA-CPU),
+callable only under ``shard_map`` with the named axis fully manual.
+
+Codecs, mirroring ``native/src/codec.cc`` exactly:
+
+* **bf16 / fp16** — cast the wire representation down, reduce in f32.
+* **int8** — blockwise-scaled: each :data:`INT8_BLOCK_ELEMS`-element
+  block carries a ``absmax/127`` f32 scale; values quantize with
+  round-to-nearest-even (``jnp.round`` lowers to
+  ``lax.round(ROUND_TO_NEAREST_EVEN)``, the same RNE contract as the
+  native plane's branchless magic-constant trick in ``codec.cc`` —
+  bit-identical over the ±127 range) and clamp to ``[-127, 127]``.
+
+The allreduce is the MLPerf-TPU reduce-scatter + all-gather
+decomposition (arXiv:1909.09756) with both hops shipping narrow bytes:
+
+1. quantize the local value, blockwise per destination shard;
+2. reduce-scatter the narrow payload — expressed as ``lax.all_to_all``
+   of the int8/bf16 bytes plus a local f32 fold, because a reduction
+   collective cannot sum int8 encodings under per-rank scales (and the
+   legacy XLA-CPU ``AllReducePromotion`` pass aborts on sub-f32
+   ``psum_scatter`` operands); the wire bytes equal ``psum_scatter``'s;
+3. **requantize** the reduced shard;
+4. ``lax.all_gather`` the narrow bytes and dequantize.
+
+Determinism contract (same as ``HostAccumulate``): the fold is a fixed
+``sum(axis=0)`` over peer order and every decode is a *multiply* by the
+scale (``q * s``, never ``q / inv``) — a constant division gets
+algebraically rewritten under jit and breaks the jit/no-jit bitwise
+identity the tests pin.
+
+Error feedback (int8): the rank-local residual telescopes the rounding
+error across steps exactly like the host plane's EF slabs. Both
+quantization points are compensated: hop 1's encode error everywhere,
+and hop 2's requantize error on the shard this rank owns (it is the
+rank that performed that encode), so the summed decoded contributions
+reconstruct the collective's actual output and the time-average of the
+quantized mean converges to the true mean on a fixed gradient (the
+telescoping identity pinned in tests/test_quantized.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.common.ops_enum import Average, ReduceOp, Sum
+
+# Elements per int8 quantization block — pinned to the native plane's
+# kInt8BlockElems (native/include/hvd/codec.h) by tests/test_wire_abi.py
+# and the tools/lint wire-codec-pins rule, so one knob means one block
+# geometry on both planes.
+INT8_BLOCK_ELEMS = 256
+
+#: In-jit codec names (the `in_jit_codec` values compression.py maps to).
+CODECS = ("none", "bf16", "fp16", "int8")
+
+_CAST_WIRE = {"bf16": jnp.bfloat16, "fp16": jnp.float16}
+
+
+# ---------------------------------------------------------------------------
+# Blockwise int8 codec (pure jnp, shapes static)
+# ---------------------------------------------------------------------------
+
+def int8_blocks(n: int) -> int:
+    """ceil-div block count for ``n`` elements (codec.h Int8Blocks)."""
+    return -(-n // INT8_BLOCK_ELEMS)
+
+
+def blockwise_int8_encode(x):
+    """Quantize ``x`` [..., C] blockwise along the last axis.
+
+    Returns ``(q, scales)``: ``q`` int8 [..., NB*B] (C zero-padded up to
+    whole blocks — pad lanes quantize to exactly 0 and never perturb a
+    block's absmax), ``scales`` f32 [..., NB] with ``absmax/127`` per
+    block (0 for an all-zero block, matching codec.cc).
+    """
+    x = x.astype(jnp.float32)
+    c = x.shape[-1]
+    nb = int8_blocks(c)
+    pad = nb * INT8_BLOCK_ELEMS - c
+    if pad:
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+    v = x.reshape(x.shape[:-1] + (nb, INT8_BLOCK_ELEMS))
+    absmax = jnp.max(jnp.abs(v), axis=-1)
+    scales = absmax * jnp.float32(1.0 / 127.0)
+    inv = jnp.where(scales > 0, 1.0 / scales, 0.0)
+    q = jnp.clip(jnp.round(v * inv[..., None]), -127, 127).astype(jnp.int8)
+    return q.reshape(x.shape[:-1] + (nb * INT8_BLOCK_ELEMS,)), scales
+
+
+def blockwise_int8_decode(q, scales, c: int):
+    """Dequantize ``(q, scales)`` back to f32 [..., c].
+
+    Decode is ``q * scale`` — the native plane's exact arithmetic
+    (Int8DecodeBlocks) and the jit-stable spelling (see module doc).
+    """
+    nb = scales.shape[-1]
+    v = q.astype(jnp.float32).reshape(q.shape[:-1] + (nb, INT8_BLOCK_ELEMS))
+    out = (v * scales[..., None]).reshape(q.shape)
+    return out[..., :c]
+
+
+# ---------------------------------------------------------------------------
+# The quantized allreduce
+# ---------------------------------------------------------------------------
+
+def _axis_size(axis_name) -> int:
+    from horovod_tpu.common.jax_compat import axis_size
+    return axis_size(axis_name)
+
+
+def _check_codec(codec: str):
+    if codec not in CODECS:
+        raise ValueError(f"unknown in-jit codec {codec!r}; one of {CODECS}")
+
+
+def quantized_allreduce(x, op: ReduceOp = Average, axis_name: str = "dp", *,
+                        codec: str, residual: Optional[jax.Array] = None):
+    """Allreduce ``x`` over ``axis_name`` with narrow bytes on both hops.
+
+    Call under ``shard_map`` with ``axis_name`` manual. ``codec`` is one
+    of :data:`CODECS`; ``"none"`` takes the exact pre-existing
+    ``lax.psum`` path (bitwise identical to an uncompressed allreduce).
+    ``residual`` (int8/bf16/fp16; optional) is this rank's error-feedback
+    buffer, shaped and typed like ``x`` in f32 — when given, the value
+    quantized is ``x + residual`` and the call returns
+    ``(reduced, new_residual)``; without it the rounding error of this
+    step is dropped (plain quantized) and only ``reduced`` returns.
+
+    Only ``Sum``/``Average`` are compressible (MIN/MAX/PRODUCT have no
+    meaningful quantized composition); other ops raise.
+    """
+    _check_codec(codec)
+    if codec == "none":
+        y = lax.psum(x, axis_name)
+        if op == Average:
+            y = y / _axis_size(axis_name)
+        elif op != Sum:
+            raise ValueError("quantized_allreduce supports Sum/Average")
+        return (y, residual) if residual is not None else y
+    if op not in (Sum, Average):
+        raise ValueError(
+            f"compression={codec!r} supports op=Sum/Average only, got {op!r}")
+    if not isinstance(axis_name, str):
+        raise NotImplementedError(
+            "quantized_allreduce reduces over a single named axis; got "
+            f"axis tuple {axis_name!r} — reshape the mesh or reduce "
+            "axis-by-axis")
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        raise TypeError(
+            f"cannot quantize dtype {x.dtype}; compression applies to "
+            "float gradients")
+
+    p = _axis_size(axis_name)
+    orig_shape, orig_dtype = x.shape, x.dtype
+    n = x.size
+    xf = x.astype(jnp.float32).reshape(-1)
+    if residual is not None:
+        xf = xf + residual.astype(jnp.float32).reshape(-1)
+    n_per = -(-n // p)                     # elements per scattered shard
+    if n_per * p != n:
+        xf = jnp.pad(xf, (0, n_per * p - n))
+    v = xf.reshape(p, n_per)               # row r -> shard owned by rank r
+
+    if codec == "int8":
+        q1, s1 = blockwise_int8_encode(v)          # [P, NB*B], [P, NB]
+        qr = lax.all_to_all(q1, axis_name, split_axis=0, concat_axis=0,
+                            tiled=True)
+        sr = lax.all_to_all(s1, axis_name, split_axis=0, concat_axis=0,
+                            tiled=True)
+        y = blockwise_int8_decode(qr, sr, n_per).sum(axis=0)   # [n_per] f32
+        q2, s2 = blockwise_int8_encode(y[None])    # [1, NB*B], [1, NB]
+        gq = lax.all_gather(q2[0], axis_name, axis=0, tiled=False)
+        gs = lax.all_gather(s2[0], axis_name, axis=0, tiled=False)
+        z = blockwise_int8_decode(gq, gs, n_per)   # [P, n_per] f32
+        if residual is not None:
+            e1 = v - blockwise_int8_decode(q1, s1, n_per)
+            e2 = y - blockwise_int8_decode(q2, s2, n_per)[0]
+    else:
+        wire = _CAST_WIRE[codec]
+        w1 = v.astype(wire)
+        wr = lax.all_to_all(w1, axis_name, split_axis=0, concat_axis=0,
+                            tiled=True)
+        y = wr.astype(jnp.float32).sum(axis=0)
+        w2 = y.astype(wire)
+        z = lax.all_gather(w2, axis_name, axis=0,
+                           tiled=False).astype(jnp.float32)
+        if residual is not None:
+            e1 = v - w1.astype(jnp.float32)
+            e2 = y - w2.astype(jnp.float32)
+
+    if op == Average:
+        z = z * jnp.float32(1.0 / p)
+    out = z.reshape(-1)[:n].reshape(orig_shape).astype(orig_dtype)
+    if residual is None:
+        return out
+    # EF update: hop-1 encode error everywhere; hop-2 requantize error
+    # on this rank's own shard row (sum space — the averaging factor
+    # never enters the residual; see module doc).
+    own = (jnp.arange(p) == lax.axis_index(axis_name))[:, None]
+    new_r = e1 + jnp.where(own, e2[None, :], 0.0)
+    new_r = new_r.reshape(-1)[:n].reshape(orig_shape)
+    return out, new_r
+
+
+def quantized_allgather(x, axis_name: str = "dp", *, codec: str,
+                        axis: int = 0):
+    """All-gather ``x`` with the wire bytes narrowed by ``codec``
+    (tiled, like :func:`horovod_tpu.ops.collectives.allgather`). The
+    int8 form ships blockwise q+scales and dequantizes after the hop;
+    lossy like the allreduce's hop 2. ``"none"`` is the exact plain
+    gather."""
+    _check_codec(codec)
+    if codec == "none":
+        return lax.all_gather(x, axis_name, axis=axis, tiled=True)
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        raise TypeError(f"cannot quantize dtype {x.dtype}")
+    orig_dtype = x.dtype
+    if codec in _CAST_WIRE:
+        w = x.astype(_CAST_WIRE[codec])
+        return lax.all_gather(w, axis_name, axis=axis,
+                              tiled=True).astype(orig_dtype)
+    moved = jnp.moveaxis(x, axis, -1)
+    c = moved.shape[-1]
+    q, s = blockwise_int8_encode(moved)
+    gq = lax.all_gather(q, axis_name, axis=-1, tiled=True)
+    gs = lax.all_gather(s, axis_name, axis=-1, tiled=True)
+    p = gq.shape[-1] // q.shape[-1]
+    gq = gq.reshape(gq.shape[:-1] + (p, q.shape[-1]))
+    gs = gs.reshape(gs.shape[:-1] + (p, s.shape[-1]))
+    out = blockwise_int8_decode(gq, gs, c)          # [..., P, c]
+    out = out.reshape(moved.shape[:-1] + (p * c,))  # concat peers in order
+    return jnp.moveaxis(out, -1, axis).astype(orig_dtype)

@@ -99,22 +99,32 @@ def _ffn(cfg, lp, x):
 
 
 def make_serve_fns(cfg, mesh: Optional[Any] = None, *, block_size: int,
-                   table_width: int):
+                   table_width: int, compression=None):
     """Build (prefill, prefill_resume, decode, inject) jitted closures
     for ``cfg`` over ``mesh``. ``table_width`` is the static block-
     table row length (blocks per sequence, worst case); caches are
     donated so steady-state decode — and the handoff-page ``inject``
     scatter — update the pool in place.
 
-    Memoized: engines sharing (cfg, mesh, block geometry) — e.g. the
-    benchmark's continuous and static schedulers, or a fleet of
-    per-tenant engines — reuse one pair of jit closures and therefore
-    one compiled program per shape bucket."""
-    return _cached_serve_fns(cfg, mesh, block_size, table_width)
+    ``compression`` (a ``hvd.Compression`` member; None = uncompressed,
+    bitwise the pre-existing programs) is the serving face of the same
+    knob the training planes read: it narrows the embed table's mesh
+    movement in every prefill/decode program (see
+    ``transformer.embed_lookup``) — the per-step table reshard is the
+    one table-sized transfer on the decode hot loop when the vocab-
+    parallel island can't run.
+
+    Memoized: engines sharing (cfg, mesh, block geometry, compression)
+    — e.g. the benchmark's continuous and static schedulers, or a
+    fleet of per-tenant engines — reuse one pair of jit closures and
+    therefore one compiled program per shape bucket."""
+    return _cached_serve_fns(cfg, mesh, block_size, table_width,
+                             compression)
 
 
 @functools.lru_cache(maxsize=64)
-def _cached_serve_fns(cfg, mesh, block_size: int, table_width: int):
+def _cached_serve_fns(cfg, mesh, block_size: int, table_width: int,
+                      compression=None):
     if cfg.moe is not None:
         raise NotImplementedError(
             "serving the MoE FFN is not implemented yet; set n_experts=0")
@@ -132,7 +142,7 @@ def _cached_serve_fns(cfg, mesh, block_size: int, table_width: int):
             f"prompt bucket {Tp} needs {n_blk} blocks > table width "
             f"{table_width}")
         x = tf_lib.embed_lookup(params["embed"], tokens[None], cfg.dtype,
-                                mesh)                          # [1, Tp, D]
+                                mesh, compression)             # [1, Tp, D]
         pos = jnp.arange(Tp, dtype=jnp.int32)[None]            # [1, Tp]
 
         def body(x, per_layer):
@@ -185,7 +195,7 @@ def _cached_serve_fns(cfg, mesh, block_size: int, table_width: int):
         n_blk = Tc // block_size
         S = table_width * block_size
         x = tf_lib.embed_lookup(params["embed"], tokens[None], cfg.dtype,
-                                mesh)                          # [1, Tc, D]
+                                mesh, compression)             # [1, Tc, D]
         pos = offset + jnp.arange(Tc, dtype=jnp.int32)[None]   # [1, Tc]
         # Chunk rows land in table slots off_blk..off_blk+n_blk. Rows
         # whose slot falls past the table (bucket padding of the last
@@ -245,7 +255,7 @@ def _cached_serve_fns(cfg, mesh, block_size: int, table_width: int):
         B = tokens.shape[0]
         S = table_width * block_size
         x = tf_lib.embed_lookup(params["embed"], tokens[:, None], cfg.dtype,
-                                mesh)                          # [B, 1, D]
+                                mesh, compression)             # [B, 1, D]
         pos = positions[:, None]
 
         def body(x, per_layer):

@@ -130,6 +130,11 @@ class ServeConfig:
     # bound the prefill work one step can absorb, so long prompts
     # stream in across iterations interleaved with decode.
     prefill_chunk: Optional[int] = None
+    # In-jit mesh compression for the decode/prefill programs (a
+    # hvd.Compression member; None = uncompressed, bitwise the
+    # pre-existing programs) — the serving face of the training
+    # planes' one knob. See decode.make_serve_fns.
+    compression: Any = None
 
 
 @dataclasses.dataclass
@@ -349,7 +354,7 @@ class ServeEngine:
         (self._prefill_fn, self._resume_fn, self._decode_fn,
          self._inject_fn) = decode_lib.make_serve_fns(
              model_cfg, mesh, block_size=bs,
-             table_width=self._table_width)
+             table_width=self._table_width, compression=cfg.compression)
 
         self.metrics = ServeMetrics(clock=clock, instance=instance)
         self.metrics.attach_allocator(self.allocator)

@@ -82,31 +82,37 @@ def _fns():
 
     class HorovodAllreduce(torch.autograd.Function):
         @staticmethod
-        def forward(ctx, tensor, average, name, op, pre, post):
+        def forward(ctx, tensor, average, name, op, pre, post, wire=None):
             ctx.average, ctx.op, ctx.pre, ctx.post = average, op, pre, post
-            return api.allreduce(tensor, average, name, op, pre, post)
+            ctx.wire = wire
+            return api.allreduce(tensor, average, name, op, pre, post,
+                                 compression=wire)
 
         @staticmethod
         def backward(ctx, grad):
             # The gradient of allreduce is allreduce with the same
-            # op/scaling (reference mpi_ops.py:186).
+            # op/scaling — and the same wire codec (reference
+            # mpi_ops.py:186).
             return (api.allreduce(grad.contiguous(), ctx.average, None,
-                                  ctx.op, ctx.pre, ctx.post),
-                    None, None, None, None, None)
+                                  ctx.op, ctx.pre, ctx.post,
+                                  compression=ctx.wire),
+                    None, None, None, None, None, None)
 
     class HorovodGroupedAllreduce(torch.autograd.Function):
         @staticmethod
-        def forward(ctx, average, name, op, pre, post, *tensors):
+        def forward(ctx, average, name, op, pre, post, wire, *tensors):
             ctx.average, ctx.op, ctx.pre, ctx.post = average, op, pre, post
+            ctx.wire = wire
             return tuple(api.grouped_allreduce(
-                list(tensors), average, name, op, pre, post))
+                list(tensors), average, name, op, pre, post,
+                compression=wire))
 
         @staticmethod
         def backward(ctx, *grads):
             gs = api.grouped_allreduce(
                 [g.contiguous() for g in grads], ctx.average, None,
-                ctx.op, ctx.pre, ctx.post)
-            return (None, None, None, None, None, *gs)
+                ctx.op, ctx.pre, ctx.post, compression=ctx.wire)
+            return (None, None, None, None, None, None, *gs)
 
     class HorovodAllgather(torch.autograd.Function):
         @staticmethod
@@ -212,6 +218,15 @@ def _check_differentiable_op(op, what: str) -> None:
 
 # -- allreduce --------------------------------------------------------------
 
+def _split_wire_codec(compression):
+    """Wire-only codecs (int8) have no cast form: return them as the
+    native wire codec to pass down, with the cast tier neutralized —
+    the same one-knob routing as the jax eager tier."""
+    if not getattr(compression, "cast_tier", True):
+        return Compression.none, compression
+    return compression, None
+
+
 def allreduce(tensor, average: Optional[bool] = None,
               name: Optional[str] = None,
               compression=Compression.none, op: Optional[ReduceOp] = None,
@@ -219,14 +234,17 @@ def allreduce(tensor, average: Optional[bool] = None,
     """Out-of-place allreduce with optional wire compression
     (reference ``torch/mpi_ops.py:192``). Differentiable: gradients
     flow through as an allreduce of the cotangents."""
+    compression, wire = _split_wire_codec(compression)
     compressed, ctx = compression.compress(tensor)
     if _is_grad_tensor(compressed):
         _check_differentiable_op(op, "allreduce")
         out = _fns().allreduce.apply(compressed, average, name, op,
-                                     prescale_factor, postscale_factor)
+                                     prescale_factor, postscale_factor,
+                                     wire)
     else:
         out = api.allreduce(compressed, average, name, op,
-                            prescale_factor, postscale_factor)
+                            prescale_factor, postscale_factor,
+                            compression=wire)
     return compression.decompress(out, ctx)
 
 
@@ -253,15 +271,17 @@ def grouped_allreduce(tensors: Sequence, average: Optional[bool] = None,
                       op: Optional[ReduceOp] = None,
                       prescale_factor: float = 1.0,
                       postscale_factor: float = 1.0):
+    compression, wire = _split_wire_codec(compression)
     compressed, ctxs = zip(*[compression.compress(t) for t in tensors])
     if any(_is_grad_tensor(t) for t in compressed):
         _check_differentiable_op(op, "grouped_allreduce")
         outs = _fns().grouped_allreduce.apply(
-            average, name, op, prescale_factor, postscale_factor,
+            average, name, op, prescale_factor, postscale_factor, wire,
             *compressed)
     else:
         outs = api.grouped_allreduce(list(compressed), average, name, op,
-                                     prescale_factor, postscale_factor)
+                                     prescale_factor, postscale_factor,
+                                     compression=wire)
     return [compression.decompress(o, c) for o, c in zip(outs, ctxs)]
 
 

@@ -66,6 +66,15 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                  sparse_as_dense=False, groups=None):
         super(self.__class__, self).__init__(params)
         self._compression = compression
+        self._wire_compression = None
+        if not getattr(compression, "cast_tier", True):
+            # Wire-only codec (int8): no framework cast exists — the
+            # knob rides the native plane as a per-chunk wire codec on
+            # every collective this optimizer launches instead (the
+            # same one-knob contract as the jax tier).
+            self._wire_compression = compression
+            from horovod_tpu.compression import Compression
+            self._compression = Compression.none
         self._reduce_op = op
         self._gradient_predivide_factor = gradient_predivide_factor
         self.sparse_as_dense = sparse_as_dense
@@ -189,7 +198,8 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             *[self._compression.compress(q.grad) for q in members])
         handles = api.grouped_allreduce_async(
             list(compressed), name=f"allreduce.group.{gid}", op=op,
-            prescale_factor=prescale, postscale_factor=postscale)
+            prescale_factor=prescale, postscale_factor=postscale,
+            compression=self._wire_compression)
         self._handles[tuple(members)] = (handles, ctxs)
         self._group_fired[gid] = set()
         self._group_launched.add(gid)
@@ -267,7 +277,8 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         tensor_compressed, ctx = self._compression.compress(grad)
         handle = api.allreduce_async(
             tensor_compressed, name=f"allreduce.{name}", op=op,
-            prescale_factor=prescale, postscale_factor=postscale)
+            prescale_factor=prescale, postscale_factor=postscale,
+            compression=self._wire_compression)
         return handle, ctx
 
     # -- user surface -----------------------------------------------------

@@ -98,6 +98,25 @@ def test_find_regressions_telemetry_key_directions():
     assert regs["extra.wire_bytes_saved_pct"]["drop_pct"] > 50
 
 
+def test_find_regressions_mesh_compression_key_directions():
+    """ISSUE 9 keys: the in-jit compression arms (transformer_mfu_int8 /
+    _bf16 / _comp_none and their tokens/sec twins) are throughput
+    metrics — higher is better, gated on drops, and an int8 speedup
+    over the none arm never flags."""
+    prev = {"extra": {"transformer_mfu_int8": 66.0,
+                      "transformer_mfu_bf16": 64.0,
+                      "transformer_mfu_comp_none": 60.0,
+                      "transformer_int8_tokens_per_sec_per_chip": 2.2e4}}
+    cur = {"extra": {"transformer_mfu_int8": 40.0,       # drop: flags
+                     "transformer_mfu_bf16": 70.0,       # gain: silent
+                     "transformer_mfu_comp_none": 59.0,  # noise: silent
+                     "transformer_int8_tokens_per_sec_per_chip": 1.1e4}}
+    regs = bench.find_regressions(prev, cur)
+    assert set(regs) == {"extra.transformer_mfu_int8",
+                         "extra.transformer_int8_tokens_per_sec_per_chip"}
+    assert regs["extra.transformer_mfu_int8"]["drop_pct"] > 35
+
+
 def test_find_regressions_router_key_directions():
     """ISSUE 8 `serve_router_*` keys: hit rates and throughput gate
     higher-is-better, `*_ms` latency keys gate on RISE, and the fleet

@@ -1,9 +1,9 @@
 """Direct unit tests for ``horovod_tpu/compression.py`` — until now it
 was only exercised indirectly through the optimizer wrappers. Covers
 the cast round-trip across the numpy/jax/torch dispatch paths, fp64
-context restore, NoneCompressor passthrough identity, the int8 marker's
-passthrough semantics, and the Compression -> native wire-codec map the
-eager API relies on."""
+context restore, NoneCompressor passthrough identity, the int8 cast
+tier's defined failure mode, and the Compression -> native-wire /
+in-jit codec maps both planes of the one knob rely on."""
 
 import numpy as np
 import pytest
@@ -14,6 +14,8 @@ from horovod_tpu.compression import (
     FP16Compressor,
     Int8Compressor,
     NoneCompressor,
+    in_jit_codec,
+    needs_error_feedback,
     wire_codec_id,
 )
 
@@ -47,13 +49,19 @@ def test_none_compressor_identity(make):
     assert NoneCompressor.decompress(c, ctx) is x
 
 
-def test_int8_marker_is_cast_passthrough():
-    """Int8 is a WIRE codec: there is no framework-level int8 tensor
-    representation, so the cast API must be an exact passthrough."""
+def test_int8_cast_tier_raises_descriptively():
+    """Int8 is a data-plane codec: there is no framework-level int8
+    tensor representation (int8 cannot be summed by a collective
+    without its scales), so the cast API raises a descriptive error
+    pointing at the wire/in-jit paths instead of failing deep inside a
+    framework cast."""
     x = _np_tensor(np.float32)
-    c, ctx = Int8Compressor.compress(x)
-    assert c is x and ctx is None
-    assert Int8Compressor.decompress(c, ctx) is x
+    with pytest.raises(NotImplementedError, match="compression="):
+        Int8Compressor.compress(x)
+    with pytest.raises(NotImplementedError, match="cast form"):
+        Int8Compressor.decompress(x, None)
+    assert Int8Compressor.cast_tier is False
+    assert Int8Compressor.needs_error_feedback is True
 
 
 # ---------------------------------------------------------------------------
@@ -133,3 +141,78 @@ def test_wire_codec_ids_match_native_enum():
 def test_wire_codec_id_rejects_garbage():
     with pytest.raises(ValueError, match="compression"):
         wire_codec_id("int8")
+
+
+# ---------------------------------------------------------------------------
+# In-jit codec mapping (the mesh-plane face of the same knob)
+# ---------------------------------------------------------------------------
+
+def test_in_jit_codec_map():
+    # ops/quantized.py CODECS names; None means uncompressed.
+    assert in_jit_codec(None) == "none"
+    assert in_jit_codec(Compression.none) == "none"
+    assert in_jit_codec(Compression.bf16) == "bf16"
+    assert in_jit_codec(Compression.fp16) == "fp16"
+    assert in_jit_codec(Compression.int8) == "int8"
+    assert in_jit_codec(Compression.int8()) == "int8"
+    from horovod_tpu.ops.quantized import CODECS
+    for comp in (Compression.none, Compression.bf16, Compression.fp16,
+                 Compression.int8):
+        assert comp.in_jit_codec in CODECS
+
+
+def test_in_jit_codec_rejects_garbage():
+    with pytest.raises(ValueError, match="compression"):
+        in_jit_codec("int8")
+
+
+def test_error_feedback_flag():
+    """Only int8 threads EF residuals in-jit (the cast codecs drop
+    their tiny rounding error, like the reference's fp16 compressor)."""
+    assert needs_error_feedback(Compression.int8)
+    assert not needs_error_feedback(Compression.bf16)
+    assert not needs_error_feedback(Compression.none)
+    assert not needs_error_feedback(None)
+
+
+# ---------------------------------------------------------------------------
+# Torch tier: wire-only codecs route around the (raising) cast API
+# ---------------------------------------------------------------------------
+
+def test_torch_tier_splits_wire_codec():
+    """mpi_ops/_DistributedOptimizer must NOT call int8's raising cast
+    API: the knob is split into (cast=none, wire=int8) and the wire
+    codec rides the api calls — same contract as the jax eager tier."""
+    pytest.importorskip("torch")
+    from horovod_tpu.torch import mpi_ops
+
+    cast, wire = mpi_ops._split_wire_codec(Compression.int8)
+    assert cast is Compression.none and wire is Compression.int8
+    cast, wire = mpi_ops._split_wire_codec(Compression.bf16)
+    assert cast is Compression.bf16 and wire is None
+
+
+def test_torch_tier_int8_functional_and_optimizer():
+    """Single-process functional pin: allreduce/DistributedOptimizer
+    with Compression.int8 must not trip the cast-tier raise (before
+    the wire-split they called Int8Compressor.compress directly)."""
+    torch = pytest.importorskip("torch")
+    import horovod_tpu.torch as thvd
+
+    thvd.init()
+    try:
+        x = torch.arange(6, dtype=torch.float32)
+        out = thvd.allreduce(x, compression=Compression.int8,
+                             name="comp.i8")
+        np.testing.assert_allclose(out.numpy(), x.numpy())  # np=1
+        model = torch.nn.Linear(4, 2)
+        opt = thvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters(),
+            compression=Compression.int8)
+        assert opt._wire_compression is Compression.int8
+        assert opt._compression is Compression.none
+        model(torch.ones(3, 4)).sum().backward()
+        opt.step()
+    finally:
+        thvd.shutdown()

@@ -68,6 +68,21 @@ def make_clean_tree(root):
         WIRE_VERSION_RESPONSE_LIST = 5
         METRICS_VERSION = 1
         """)
+    _write(root, "native/include/hvd/codec.h", """\
+        enum class WireCodec : uint8_t {
+          NONE = 0,
+          BF16 = 1,
+          FP16 = 2,
+          INT8 = 3,
+        };
+        constexpr int64_t kInt8BlockElems = 256;
+        """)
+    _write(root, "horovod_tpu/compression.py", """\
+        _WIRE_NONE, _WIRE_BF16, _WIRE_FP16, _WIRE_INT8 = 0, 1, 2, 3
+        """)
+    _write(root, "horovod_tpu/ops/quantized.py", """\
+        INT8_BLOCK_ELEMS = 256
+        """)
     _write(root, "docs/index.md",
            "[observability](observability.md)\n")
     _write(root, "docs/observability.md", """\
@@ -181,6 +196,29 @@ def test_abi_pin_mismatch_fires(tree):
     assert len(fs) == 1 and "mismatch" in fs[0].message, fs
 
 
+def test_injected_wire_codec_drift_fires(tree):
+    # compression.py claims int8 is wire id 2 — the enum says 3.
+    _write(tree, "horovod_tpu/compression.py",
+           "_WIRE_NONE, _WIRE_BF16, _WIRE_FP16, _WIRE_INT8 = 0, 1, 2, 2\n")
+    fs = run_all(tree, only={"wire-codec-pins"})
+    assert len(fs) == 1 and "INT8" in fs[0].message, fs
+
+
+def test_injected_block_elems_drift_fires(tree):
+    _write(tree, "horovod_tpu/ops/quantized.py",
+           "INT8_BLOCK_ELEMS = 128\n")
+    fs = run_all(tree, only={"wire-codec-pins"})
+    assert len(fs) == 1 and "kInt8BlockElems" in fs[0].message, fs
+
+
+def test_injected_stray_wire_literal_fires(tree):
+    # A second definition site is how a bump forks the two planes.
+    _write(tree, "horovod_tpu/runtime.py",
+           "_WIRE_INT8 = 3\n")
+    fs = run_all(tree, only={"wire-codec-pins"})
+    assert len(fs) == 1 and fs[0].path == "horovod_tpu/runtime.py", fs
+
+
 def test_injected_dead_doc_link_fires(tree):
     _write(tree, "docs/index.md",
            "[observability](observability.md) [gone](missing.md)\n")
@@ -199,7 +237,7 @@ def test_every_rule_has_an_injection_test():
     """Meta-guard: adding a rule without an injection test here should
     fail loudly, not pass silently."""
     covered = {"getenv", "knob-docs", "abi-literal", "metric-sync",
-               "doc-links"}
+               "doc-links", "wire-codec-pins"}
     assert covered == set(ALL_RULES), (
         "new lint rule(s) without bug-injection coverage: "
         f"{set(ALL_RULES) - covered}")

@@ -13,6 +13,7 @@ from horovod_tpu.common import basics
 
 HEADER = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "native", "include", "hvd", "message.h")
+CODEC_HEADER = os.path.join(os.path.dirname(HEADER), "codec.h")
 
 
 def _header_constant(name: str) -> int:
@@ -38,6 +39,37 @@ def test_loaded_library_reports_pinned_abi():
     explicitly so this file documents the contract end to end."""
     lib = basics.get_lib()
     assert lib.hvd_abi_version() == basics.ABI_VERSION
+
+
+def test_wire_codec_ids_pin_native_enum():
+    """The Python wire-codec ids (compression.py) must equal the
+    WireCodec enum in codec.h — one knob cannot mean different codecs
+    on the two planes. The static face of this guard is the
+    wire-codec-pins lint rule; this is the runtime pin with the two
+    numbers in hand."""
+    from horovod_tpu import compression as comp
+
+    src = open(CODEC_HEADER).read()
+    body = re.search(r"enum\s+class\s+WireCodec[^{]*\{([^}]*)\}",
+                     src).group(1)
+    enum = {n: int(v) for n, v in re.findall(r"([A-Z0-9_]+)\s*=\s*(\d+)",
+                                             body)}
+    assert comp._WIRE_NONE == enum["NONE"]
+    assert comp._WIRE_BF16 == enum["BF16"]
+    assert comp._WIRE_FP16 == enum["FP16"]
+    assert comp._WIRE_INT8 == enum["INT8"]
+
+
+def test_int8_block_elems_pins_native_constant():
+    """In-jit int8 (ops/quantized.py) and the native wire codec must
+    quantize with the same block geometry — the compression= knob
+    promises one semantic on both planes."""
+    from horovod_tpu.ops import quantized
+
+    src = open(CODEC_HEADER).read()
+    m = re.search(r"kInt8BlockElems\s*=\s*(\d+)", src)
+    assert m, "kInt8BlockElems not found in codec.h"
+    assert quantized.INT8_BLOCK_ELEMS == int(m.group(1))
 
 
 def test_operations_cc_has_no_second_abi_literal():

@@ -221,6 +221,100 @@ def rule_abi_literal(root: str) -> List[Finding]:
     return out
 
 
+# -------------------------------------------------------- wire-codec-pins
+
+# Single-source discipline for the compression knob's shared constants:
+# the native WireCodec enum (codec.h) is the source of truth; the
+# Python wire ids (compression.py) and the in-jit int8 block geometry
+# (ops/quantized.py) pin it and may not drift or be redefined elsewhere.
+_CODEC_H = "native/include/hvd/codec.h"
+_COMPRESSION_PY = "horovod_tpu/compression.py"
+_QUANTIZED_PY = "horovod_tpu/ops/quantized.py"
+_WIRE_ORDER = ("NONE", "BF16", "FP16", "INT8")
+_WIRE_PY_RE = re.compile(
+    r"^\s*_WIRE_NONE\s*,\s*_WIRE_BF16\s*,\s*_WIRE_FP16\s*,\s*_WIRE_INT8"
+    r"\s*=\s*(\d+)\s*,\s*(\d+)\s*,\s*(\d+)\s*,\s*(\d+)", re.MULTILINE)
+_WIRE_STRAY_RE = re.compile(r"^\s*_WIRE_[A-Z0-9_]+(\s*,\s*_WIRE_[A-Z0-9_]+)*"
+                            r"\s*=", re.MULTILINE)
+_BLOCK_PY_RE = re.compile(r"^\s*INT8_BLOCK_ELEMS\s*=\s*(\d+)", re.MULTILINE)
+
+
+def rule_wire_codec_pins(root: str) -> List[Finding]:
+    """compression.py's wire-codec ids and quantized.py's int8 block
+    size must equal the native enum/constant in codec.h, and must not
+    be redefined anywhere else — a drifted literal means the two planes
+    silently disagree on what one knob setting ships."""
+    out: List[Finding] = []
+    try:
+        hdr = _read(root, _CODEC_H)
+    except FileNotFoundError:
+        return [Finding("wire-codec-pins", _CODEC_H, 0,
+                        "codec.h missing — the wire-codec source of truth")]
+    enum_vals = {}
+    m = re.search(r"enum\s+class\s+WireCodec[^{]*\{([^}]*)\}", hdr)
+    if m:
+        for name, val in re.findall(r"([A-Z0-9_]+)\s*=\s*(\d+)", m.group(1)):
+            enum_vals[name] = int(val)
+    for name in _WIRE_ORDER:
+        if name not in enum_vals:
+            out.append(Finding("wire-codec-pins", _CODEC_H, 0,
+                               f"WireCodec::{name} not found in codec.h"))
+    bm = re.search(r"kInt8BlockElems\s*=\s*(\d+)", hdr)
+    if not bm:
+        out.append(Finding("wire-codec-pins", _CODEC_H, 0,
+                           "kInt8BlockElems not found in codec.h"))
+
+    try:
+        comp = _read(root, _COMPRESSION_PY)
+    except FileNotFoundError:
+        comp = ""
+    pm = _WIRE_PY_RE.search(comp)
+    if not pm:
+        out.append(Finding(
+            "wire-codec-pins", _COMPRESSION_PY, 0,
+            "_WIRE_NONE.._WIRE_INT8 tuple pin not found"))
+    else:
+        for name, got in zip(_WIRE_ORDER, pm.groups()):
+            want = enum_vals.get(name)
+            if want is not None and int(got) != want:
+                out.append(Finding(
+                    "wire-codec-pins", _COMPRESSION_PY, 0,
+                    f"_WIRE_{name}={got} but codec.h WireCodec::{name}="
+                    f"{want} — the Python ids must pin the native enum"))
+
+    try:
+        quant = _read(root, _QUANTIZED_PY)
+    except FileNotFoundError:
+        quant = ""
+    qm = _BLOCK_PY_RE.search(quant)
+    if not qm:
+        out.append(Finding("wire-codec-pins", _QUANTIZED_PY, 0,
+                           "INT8_BLOCK_ELEMS pin not found"))
+    elif bm and int(qm.group(1)) != int(bm.group(1)):
+        out.append(Finding(
+            "wire-codec-pins", _QUANTIZED_PY, 0,
+            f"INT8_BLOCK_ELEMS={qm.group(1)} but codec.h "
+            f"kInt8BlockElems={bm.group(1)} — one knob, one block "
+            "geometry on both planes"))
+
+    for subdir in ("horovod_tpu", "bin", "examples"):
+        if not os.path.isdir(os.path.join(root, subdir)):
+            continue
+        for rel in _walk(root, subdir, {".py"}):
+            if rel in (_COMPRESSION_PY, _QUANTIZED_PY):
+                continue
+            text = _read(root, rel)
+            for i, ln in enumerate(text.splitlines(), 1):
+                if (_WIRE_STRAY_RE.match(ln)
+                        or _BLOCK_PY_RE.match(ln)):
+                    out.append(Finding(
+                        "wire-codec-pins", rel, i,
+                        "wire-codec/block constant assigned outside its "
+                        f"home ({_COMPRESSION_PY} / {_QUANTIZED_PY}) — "
+                        "import the pin instead"))
+    return out
+
+
 # ------------------------------------------------------------ metric-sync
 
 _METRICS_H = "native/include/hvd/metrics.h"
@@ -335,6 +429,7 @@ ALL_RULES: Dict[str, Callable[[str], List[Finding]]] = {
     "getenv": rule_getenv,
     "knob-docs": rule_knob_docs,
     "abi-literal": rule_abi_literal,
+    "wire-codec-pins": rule_wire_codec_pins,
     "metric-sync": rule_metric_sync,
     "doc-links": rule_doc_links,
 }
