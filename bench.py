@@ -192,6 +192,15 @@ def _bus_wire_worker():
                 comp.wire_codec, ctypes.c_int64(n)), 2)
             for name, comp in codecs if name != "none"
         }
+        # Transport-mode record (perf_tuning.md#zero-copy-transport):
+        # which syscall plane the arms above actually rode, plus the
+        # measured bytes-per-send-syscall over the whole job — the
+        # coalescing ratio the vectored layer is gated on.
+        results["transport"] = (
+            lib.hvd_tcp_transport_mode_name().decode())
+        if m.get("tcp_sendv_calls_total"):
+            results["sendv_bytes_per_call"] = int(
+                m["tcp_send_bytes_total"] / m["tcp_sendv_calls_total"])
         print("BUSWIRE " + json.dumps(results), flush=True)
     hvd.shutdown()
 
@@ -739,6 +748,15 @@ def main():
             and budget - (time.perf_counter() - _T0) > 150):
         wire = _bus_wire_bandwidth()
         if wire is not None:
+            # The uncompressed arm measured under the vectored/zerocopy
+            # transport (ISSUE 10), with the mode and the measured
+            # bytes-per-send-syscall attached (strings/aux keys ride
+            # along; the gate compares only the shared numeric key).
+            extra["host_allreduce_busbw_sendv_gbps_np4"] = {
+                f"{BUS_WIRE_MB}MB": wire.get("none"),
+                "transport": wire.pop("transport", None),
+                "bytes_per_syscall": wire.pop("sendv_bytes_per_call", None),
+            }
             ratio = wire.pop("ratio", {})
             saved = wire.pop("wire_bytes_saved_pct", None)
             if saved is not None:

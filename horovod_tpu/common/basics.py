@@ -38,7 +38,7 @@ EXEC_CALLBACK = 1
 # is enforced at library load below, and tests/test_wire_abi.py greps
 # the header so a native bump can't silently skew this shim even
 # before a rebuild happens.
-ABI_VERSION = 7
+ABI_VERSION = 8
 WIRE_VERSION_REQUEST_LIST = 3
 WIRE_VERSION_RESPONSE_LIST = 6
 
@@ -46,7 +46,7 @@ WIRE_VERSION_RESPONSE_LIST = 6
 # kMetricsVersion): the packed int64 layout hvd_metrics_snapshot
 # writes. Checked at library load AND against the header by
 # tests/test_metrics_abi.py, the same two-sided pin as the ABI above.
-METRICS_VERSION = 2
+METRICS_VERSION = 3
 
 # Native WireCodec ids (native/include/hvd/codec.h); -1 = follow the
 # job-wide HOROVOD_WIRE_COMPRESSION default.
@@ -288,6 +288,29 @@ def _declare_abi(lib: ctypes.CDLL, path: str) -> ctypes.CDLL:
     lib.hvd_set_reduce_threads.restype = None
     lib.hvd_set_reduce_threads.argtypes = [ctypes.c_int]
     lib.hvd_reduce_threads.restype = ctypes.c_int
+    # Vectored-transport surface (ABI v8, docs/perf_tuning.md
+    # zero-copy transport): real SendV/RecvV/frame paths over
+    # caller-owned fds — the socketpair unit-test surface
+    # (tests/test_transport.py) plus the resolved-mode probes bench.py
+    # reports alongside the busbw arms.
+    lib.hvd_tcp_sendv.restype = ctypes.c_int
+    lib.hvd_tcp_sendv.argtypes = [ctypes.c_int,
+                                  ctypes.POINTER(ctypes.c_void_p),
+                                  ctypes.POINTER(ctypes.c_uint64),
+                                  ctypes.c_int]
+    lib.hvd_tcp_recvv.restype = ctypes.c_int
+    lib.hvd_tcp_recvv.argtypes = [ctypes.c_int,
+                                  ctypes.POINTER(ctypes.c_void_p),
+                                  ctypes.POINTER(ctypes.c_uint64),
+                                  ctypes.c_int]
+    lib.hvd_tcp_send_frame.restype = ctypes.c_int
+    lib.hvd_tcp_send_frame.argtypes = [ctypes.c_int, ctypes.c_void_p,
+                                       ctypes.c_uint64]
+    lib.hvd_tcp_recv_frame.restype = ctypes.c_int64
+    lib.hvd_tcp_recv_frame.argtypes = [ctypes.c_int, ctypes.c_void_p,
+                                       ctypes.c_uint64]
+    lib.hvd_tcp_transport_mode.restype = ctypes.c_int
+    lib.hvd_tcp_transport_mode_name.restype = ctypes.c_char_p
     # Wire-codec kernels (perf_tuning.md HOROVOD_WIRE_COMPRESSION):
     # exercised directly by the codec round-trip/error-feedback tests.
     lib.hvd_wire_encoded_bytes.restype = ctypes.c_int64

@@ -28,6 +28,10 @@ namespace hvd {
 // dimension.
 // v5: Request/Response carry wire_codec; ResponseList carries
 // tuned_wire_codec; hvd_enqueue gained the wire_codec argument.
+// ABI v8 (wire formats unchanged): vectored-transport entry points
+// (hvd_tcp_sendv / hvd_tcp_recvv / hvd_tcp_send_frame /
+// hvd_tcp_recv_frame over caller-owned fds, hvd_tcp_transport_mode +
+// _name) — the socketpair test surface for hvd/tcp.h's SendV/RecvV.
 // ABI v7: hvd_enqueue gained the collective_algo argument; schedule
 // builder/table entry points (hvd_build_schedule, hvd_algo_select,
 // hvd_algo_name, hvd_collective_algo).
@@ -36,7 +40,7 @@ namespace hvd {
 // hvd_stalled_tensors, and hvd_start_timeline returning an error code.
 constexpr int kWireVersionRequestList = 3;
 constexpr int kWireVersionResponseList = 6;
-constexpr int kAbiVersion = 7;
+constexpr int kAbiVersion = 8;
 
 enum class RequestType : uint8_t {
   ALLREDUCE = 0,

@@ -32,9 +32,12 @@ namespace hvd {
 // Snapshot layout version (bump on any enum/table/layout change) and
 // bucket count. Pinned by horovod_tpu/common/basics.py +
 // tests/test_metrics_abi.py.
+// v3: vectored-transport counters (tcp_sendv_calls_total,
+// tcp_recvv_calls_total, tcp_zerocopy_sends_total) and the
+// tcp_zerocopy_mode gauge (resolved transport mode).
 // v2: per-algorithm TCP allreduce counters (tcp_algo_*_ops_total) and
 // the hd/striped schedule-interpreter phase histograms.
-constexpr int kMetricsVersion = 2;
+constexpr int kMetricsVersion = 3;
 constexpr int kMetricsHistBuckets = 28;  // le = 2^0 .. 2^26, then +Inf
 
 // Monotonic counters (suffix _total) and point-in-time gauges (filled
@@ -69,6 +72,13 @@ enum MetricCounter : int {
   kCtrTcpSendBytes,           // socket bytes out, ALL TcpConn links
   kCtrTcpRecvBytes,           // socket bytes in (control + data; with a
                               // wire codec the data share is encoded)
+  // Vectored transport (hvd/tcp.h SendV/RecvV): actual send/recv
+  // syscalls issued — against the byte counters above this reads out
+  // bytes-per-syscall, the coalescing win the zero-copy transport
+  // exists for. zerocopy_sends counts the MSG_ZEROCOPY subset.
+  kCtrTcpSendvCalls,
+  kCtrTcpRecvvCalls,
+  kCtrTcpZerocopySends,
   // Wire codec (codec.cc encode sites).
   kCtrWireEncodes,
   kCtrWirePreBytes,           // f32 payload bytes presented to encode
@@ -89,6 +99,8 @@ enum MetricCounter : int {
   kGaugePendingTensors,       // tensors currently in flight
   kGaugeStalledTensors,       // tensors past the stall warning age
   kGaugeReduceThreads,        // current host-reduction thread budget
+  kGaugeTcpZerocopyMode,      // resolved transport mode (hvd/tcp.h:
+                              // 0 = vectored, 1 = MSG_ZEROCOPY live)
   kNumMetricCounters
 };
 

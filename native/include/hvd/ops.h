@@ -28,6 +28,7 @@
 #include "hvd/controller.h"
 #include "hvd/fusion_buffer.h"
 #include "hvd/message.h"
+#include "hvd/pool.h"
 #include "hvd/schedule.h"
 #include "hvd/shm.h"
 #include "hvd/timeline.h"
@@ -107,6 +108,16 @@ class TcpOps : public OpExecutor {
                             DataType dtype, const std::vector<int>& ranks,
                             int p, WireCodec codec = WireCodec::NONE,
                             std::vector<float>* ef = nullptr);
+  // Vectored ring allgather over ARBITRARY span lists: chunk k is
+  // whatever iovec spans chunks[k] names (the fused allgather points
+  // them straight at the per-tensor OUTPUT slices, so the user buffers
+  // are the wire buffers — no fusion-buffer staging, no unpack).
+  // Forwarding step s sends chunk cs's spans with one SendV while
+  // chunk cr's spans fill via one RecvV; bytes and order on the wire
+  // are identical to the flat-buffer phase, so results are bitwise
+  // unchanged.
+  Status RingAllgatherVec(const std::vector<std::vector<struct iovec>>& chunks,
+                          const std::vector<int>& ranks, int p);
   Status RingAllreduce(uint8_t* buf, int64_t elems, DataType dtype,
                        ReduceOp op, const std::vector<int>& ranks, int p,
                        WireCodec codec = WireCodec::NONE,
@@ -197,17 +208,14 @@ class TcpOps : public OpExecutor {
 
   int64_t ring_threshold_bytes_;  // below: recursive doubling
   std::unordered_map<std::string, WireEfState> wire_ef_;
-  // Grow-only scratch for the compressed exchanges. A fresh
-  // std::vector per op would zero-fill and page-fault megabytes every
-  // allreduce — more CPU than the encode it stages. All ops run on the
-  // single background thread, and each phase finishes (receiver thread
-  // joined) before the next uses the pool, so reuse is race-free.
-  std::vector<uint8_t> wire_enc_a_, wire_enc_b_, wire_enc_c_;
-  std::vector<float> wire_dec_;
-  // Schedule-interpreter pools (same grow-only, single-consumer
-  // discipline as the wire scratch above): raw RECV_REDUCE staging and
-  // the per-chunk encoded-bytes cache the codec path forwards from.
-  std::vector<uint8_t> sched_scratch_, sched_cache_;
+  // Unified staging memory (hvd/pool.h): page-aligned, grow-only,
+  // NUMA-first-touched slabs replacing the old per-role scratch
+  // vectors AND the per-op vectors the raw paths allocated fresh (a
+  // 16 MB allreduce zero-filled ~8 MB per op). All ops run on the
+  // single background thread, and each phase finishes (receiver
+  // threads joined) before the next Gets a slab, so reuse is
+  // race-free.
+  BufferPool pool_;
   std::unique_ptr<ShmArena> shm_;
   // Per-node arena (multi-host jobs with a node-major layout): the
   // intra-host stages of hierarchical collectives ride shared memory,

@@ -1,0 +1,76 @@
+// Unified data-plane staging memory (ISSUE 10's memory plane).
+//
+// The TCP executor used to keep half a dozen grow-only std::vectors
+// (wire_enc_a/b/c_, wire_dec_, sched_scratch_, sched_cache_) PLUS
+// fresh per-op vectors in the raw ring/doubling paths — every 16 MB
+// allreduce zero-filled and page-faulted ~8 MB of brand-new scratch
+// before the first byte hit the wire. This pool gives all of that one
+// home with the properties the zero-copy transport needs:
+//  * page-aligned slabs: writev/readv and MSG_ZEROCOPY page pinning
+//    operate on whole pages, and a reused slab keeps its pin state
+//    warm across ops;
+//  * grow-only reuse: a slab is reallocated only when an op needs
+//    more than every previous op (sized up-front from the synced
+//    fusion threshold, so steady state never reallocates);
+//  * NUMA-aware first-touch: fresh pages are touched from the
+//    WorkerPool threads that later run the reduction over them, so
+//    first-touch placement lands the pages on the NUMA node that
+//    reads them (serial memset from the coordination thread would
+//    home every page next to THAT thread instead).
+//
+// Concurrency contract: one consumer — the single background op
+// thread Gets slabs at op/phase start; in-phase receiver threads may
+// WRITE INTO a slab but never Get (a Get can reallocate). Contents do
+// not survive a growing Get (no copy-over) — every call site stages
+// data whose lifetime ends with the phase, which is what makes the
+// grow-only discipline safe.
+#pragma once
+
+#include <cstdint>
+
+namespace hvd {
+
+class BufferPool {
+ public:
+  // Fixed slot identities, one per concurrently-live staging role (two
+  // roles alive in one phase MUST use different slots).
+  enum Slot : int {
+    kWireEncA = 0,   // encoded send scratch (ring/doubling)
+    kWireEncB,       // encoded recv scratch
+    kWireEncC,       // second pipelined recv scratch
+    kWireDec,        // f32 decode scratch (doubling combine)
+    kSchedScratch,   // schedule-interpreter RECV_REDUCE staging
+    kSchedCache,     // schedule-interpreter encoded-chunk cache
+    kExchA,          // raw exchange scratch (ring/doubling recv)
+    kExchB,          // raw exchange scratch, pipelined twin
+    kIov,            // iovec span tables for the vectored exchanges
+    kNumSlots
+  };
+
+  BufferPool() = default;
+  BufferPool(const BufferPool&) = delete;
+  BufferPool& operator=(const BufferPool&) = delete;
+  ~BufferPool();
+
+  // Page-aligned slab of >= bytes for `slot`; stable until the next
+  // GROWING Get on the same slot. Never null for bytes >= 0.
+  uint8_t* Get(int slot, int64_t bytes);
+  template <typename T>
+  T* GetAs(int slot, int64_t count) {
+    return reinterpret_cast<T*>(Get(slot, count * sizeof(T)));
+  }
+  // Pre-size the exchange slots (called at executor construction with
+  // fusion-threshold-derived bounds) so the first timed op does not
+  // pay the allocate + first-touch cost.
+  void Reserve(int slot, int64_t bytes) { Get(slot, bytes); }
+  int64_t allocated_bytes() const;
+
+ private:
+  struct Slab {
+    uint8_t* p = nullptr;
+    int64_t cap = 0;
+  };
+  Slab slabs_[kNumSlots];
+};
+
+}  // namespace hvd

@@ -5,6 +5,8 @@
 // all traffic is length-prefixed frames.
 #pragma once
 
+#include <sys/uio.h>
+
 #include <chrono>
 #include <cstdint>
 #include <string>
@@ -12,27 +14,60 @@
 
 namespace hvd {
 
+// Resolved transport mode for the data plane's vectored sends, decided
+// once per process from HOROVOD_TCP_ZEROCOPY (auto/on/off) plus a
+// kernel probe (SO_ZEROCOPY needs >= 4.14; this container's 4.4 MUST
+// fall back) — the same runtime-dispatch discipline as the F16C paths
+// in codec.cc. Exposed in hvd.metrics() as the tcp_zerocopy_mode gauge.
+enum TcpTransportMode : int {
+  kTransportVectored = 0,  // writev/readv/sendmsg, kernel copies
+  kTransportZerocopy = 1,  // sendmsg(MSG_ZEROCOPY) for large spans
+};
+int ResolvedTransportMode();
+const char* TransportModeName(int mode);
+
 class TcpConn {
  public:
   TcpConn() = default;
   explicit TcpConn(int fd) : fd_(fd) {}
   TcpConn(const TcpConn&) = delete;
   TcpConn& operator=(const TcpConn&) = delete;
-  TcpConn(TcpConn&& o) noexcept : fd_(o.fd_) { o.fd_ = -1; }
+  TcpConn(TcpConn&& o) noexcept : fd_(o.fd_), zc_(o.zc_) { o.fd_ = -1; }
   TcpConn& operator=(TcpConn&& o) noexcept;
   ~TcpConn();
 
   bool valid() const { return fd_ >= 0; }
   int fd() const { return fd_; }
   void Close();
+  // Relinquish ownership of the fd without closing it (test drivers
+  // wrap Python-owned socketpair fds; the dtor must not steal them).
+  int Detach() {
+    int fd = fd_;
+    fd_ = -1;
+    return fd;
+  }
 
-  // Length-prefixed frame IO; false on socket error/EOF.
+  // Length-prefixed frame IO; false on socket error/EOF. The header
+  // and payload ride ONE writev — under TCP_NODELAY the old two-send
+  // framing pushed an 8-byte packet per frame before the payload.
   bool SendFrame(const void* data, uint64_t len);
   bool SendFrame(const std::string& s) { return SendFrame(s.data(), s.size()); }
   bool RecvFrame(std::string* out);
   // Raw exact-count IO for the data plane (no extra copy into a frame).
   bool SendAll(const void* data, uint64_t len);
   bool RecvAll(void* data, uint64_t len);
+  // Vectored exact-count IO: the whole iovec span list is sent (or
+  // received) in as few syscalls as the kernel allows — frame headers
+  // ride the same syscall as payloads, and a schedule step's chunks to
+  // one peer coalesce into one call. The array is NOT mutated (partial
+  // progress is tracked in an internal window), so callers can reuse
+  // span tables across ring steps. Zero-length spans are allowed.
+  // SendV upgrades large spans to MSG_ZEROCOPY when
+  // ResolvedTransportMode() == kTransportZerocopy, reaping the kernel
+  // completion before returning so the caller may immediately reuse
+  // (or mutate) the buffers — the in-place exchanges depend on that.
+  bool SendV(const struct iovec* iov, int n);
+  bool RecvV(const struct iovec* iov, int n);
   // Local IP of this connection (the address peers can reach us on when
   // we share a network with them). Empty string on failure.
   std::string LocalIp() const;
@@ -41,7 +76,15 @@ class TcpConn {
   void SetRecvTimeout(int ms);
 
  private:
+  bool SendWindow(struct iovec* win, int cnt, uint64_t bytes);
+  // Drain MSG_ZEROCOPY completions from the error queue until
+  // `*pending` sends are acknowledged (wait = block on POLLERR).
+  bool ReapZerocopy(uint32_t* pending, bool wait);
+
   int fd_ = -1;
+  // Per-fd SO_ZEROCOPY state: 0 = not yet requested, 1 = enabled,
+  // -1 = the kernel refused (stay on the plain vectored path forever).
+  int zc_ = 0;
 };
 
 // Dial the first reachable address of a multi-NIC candidate list,

@@ -33,6 +33,9 @@ constexpr const char* kCounterNames[] = {
     "tcp_bytes_total",
     "tcp_send_bytes_total",
     "tcp_recv_bytes_total",
+    "tcp_sendv_calls_total",
+    "tcp_recvv_calls_total",
+    "tcp_zerocopy_sends_total",
     "wire_encodes_total",
     "wire_pre_bytes_total",
     "wire_post_bytes_total",
@@ -46,12 +49,13 @@ constexpr const char* kCounterNames[] = {
     "pending_tensors",
     "stalled_tensors",
     "reduce_threads",
+    "tcp_zerocopy_mode",
 };
 
 constexpr int kCounterKinds[] = {
     0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
-    0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
-    1, 1, 1,  // pending_tensors, stalled_tensors, reduce_threads
+    0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+    1, 1, 1, 1,  // pending/stalled tensors, reduce_threads, zc mode
 };
 
 constexpr const char* kHistNames[] = {

@@ -725,6 +725,9 @@ void hvd_shutdown() {
   st.initialized.store(false);
 }
 
+// v8: vectored-transport surface (hvd_tcp_sendv / hvd_tcp_recvv /
+// hvd_tcp_send_frame / hvd_tcp_recv_frame over caller-owned fds,
+// hvd_tcp_transport_mode + _name) — wire formats unchanged.
 // v7: hvd_enqueue gained collective_algo; schedule-interpreter surface
 // (hvd_build_schedule / hvd_algo_select / hvd_algo_name /
 // hvd_collective_algo); Request/Response/ResponseList carry the
@@ -925,6 +928,13 @@ int64_t hvd_metrics_snapshot(int64_t* out, int64_t max_slots) {
   reg.Set(hvd::kGaugeStalledTensors,
           static_cast<int64_t>(st.stall_inspector.Report(st.size).size()));
   reg.Set(hvd::kGaugeReduceThreads, hvd::HostReduceThreads());
+  // Deliberate: this resolves the transport mode (one-time end-to-end
+  // probe) so the gauge always reads the real verdict — the operator
+  // contract is "the chosen mode is visible in hvd.metrics()". On a
+  // real kernel the probe settles in microseconds (reject or deliver);
+  // only this completion-less sandbox pays its ~40 ms poll bound, once
+  // per metrics-reading process.
+  reg.Set(hvd::kGaugeTcpZerocopyMode, hvd::ResolvedTransportMode());
   return reg.Snapshot(out, max_slots);
 }
 
@@ -1077,6 +1087,57 @@ void hvd_wire_decode(int codec, const uint8_t* src, int64_t elems,
 void hvd_wire_decode_add(int codec, const uint8_t* src, int64_t elems,
                          float* dst) {
   hvd::WireDecodeAdd(static_cast<hvd::WireCodec>(codec), src, elems, dst);
+}
+
+// Vectored-transport entry points (ABI v8): wrap caller-owned fds
+// (socketpair halves in tests/test_transport.py) in a non-owning
+// TcpConn and drive the REAL SendV/RecvV/frame paths — split reads,
+// EINTR retries, iovec windowing and the metrics accounting are
+// exercised exactly as the data plane runs them. The fds stay the
+// caller's (Detach before the conn destructs).
+int hvd_tcp_sendv(int fd, void* const* bufs, const uint64_t* lens, int n) {
+  std::vector<struct iovec> iov(static_cast<size_t>(n > 0 ? n : 0));
+  for (int i = 0; i < n; ++i)
+    iov[i] = {bufs[i], static_cast<size_t>(lens[i])};
+  hvd::TcpConn conn(fd);
+  const bool ok = conn.SendV(iov.data(), n);
+  conn.Detach();
+  return ok ? 1 : 0;
+}
+
+int hvd_tcp_recvv(int fd, void* const* bufs, const uint64_t* lens, int n) {
+  std::vector<struct iovec> iov(static_cast<size_t>(n > 0 ? n : 0));
+  for (int i = 0; i < n; ++i)
+    iov[i] = {bufs[i], static_cast<size_t>(lens[i])};
+  hvd::TcpConn conn(fd);
+  const bool ok = conn.RecvV(iov.data(), n);
+  conn.Detach();
+  return ok ? 1 : 0;
+}
+
+int hvd_tcp_send_frame(int fd, const void* data, uint64_t len) {
+  hvd::TcpConn conn(fd);
+  const bool ok = conn.SendFrame(data, len);
+  conn.Detach();
+  return ok ? 1 : 0;
+}
+
+// Returns the frame length (which may exceed max_len — the copied
+// prefix is then truncated), or -1 on socket error/EOF.
+int64_t hvd_tcp_recv_frame(int fd, void* out, uint64_t max_len) {
+  hvd::TcpConn conn(fd);
+  std::string s;
+  const bool ok = conn.RecvFrame(&s);
+  conn.Detach();
+  if (!ok) return -1;
+  std::memcpy(out, s.data(), std::min<uint64_t>(s.size(), max_len));
+  return static_cast<int64_t>(s.size());
+}
+
+int hvd_tcp_transport_mode() { return hvd::ResolvedTransportMode(); }
+
+const char* hvd_tcp_transport_mode_name() {
+  return hvd::TransportModeName(hvd::ResolvedTransportMode());
 }
 
 // Test hooks: drive the Bayesian autotune optimizer (hvd/bayesian.h)

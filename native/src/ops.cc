@@ -427,6 +427,24 @@ TcpOps::TcpOps(Controller* controller, FusionBufferManager* fusion,
   // poison the arena on the first op.
   shm_timeout_secs_ = EnvDoubleSane("HOROVOD_SHM_TIMEOUT_SECONDS",
                                     shm_timeout_secs_);
+  // Pre-size the exchange slabs from the SYNCED fusion threshold (the
+  // largest fused payload the coordinator will emit) so steady state
+  // never reallocates and the first timed op does not pay the
+  // allocate + first-touch cost. A ring step stages at most one
+  // ceil(payload/size) chunk per slot; DoublingExchange stages the
+  // FULL payload in kExchA, and at size == 2 doubling IS the default
+  // for every payload — so the two-rank case reserves the whole
+  // threshold. (np > 2 reaches full-payload doubling only via opt-in
+  // Adasum or the sub-ring-threshold latency band; those pay one
+  // realloc on first use rather than costing every job the RSS.)
+  if (controller->size() > 1) {
+    const int64_t chunk =
+        controller->fusion_threshold() / controller->size() + 4096;
+    pool_.Reserve(BufferPool::kExchA, controller->size() == 2
+                                          ? controller->fusion_threshold()
+                                          : chunk);
+    pool_.Reserve(BufferPool::kExchB, chunk);
+  }
 }
 
 Status TcpOps::Execute(const Response& response,
@@ -886,13 +904,7 @@ Status TcpOps::RingReduceScatterPhase(uint8_t* buf,
       efd = ef->data();
     }
     const int64_t enc_max = WireEncodedBytes(codec, max_chunk);
-    if (static_cast<int64_t>(wire_enc_a_.size()) < enc_max)
-      wire_enc_a_.resize(enc_max);
-    if (static_cast<int64_t>(wire_enc_b_.size()) < enc_max)
-      wire_enc_b_.resize(enc_max);
-    if (static_cast<int64_t>(wire_enc_c_.size()) < enc_max)
-      wire_enc_c_.resize(enc_max);
-    uint8_t* enc_send = wire_enc_a_.data();
+    uint8_t* enc_send = pool_.Get(BufferPool::kWireEncA, enc_max);
     auto enc_bytes = [&](int64_t n) { return WireEncodedBytes(codec, n); };
     // Relay fusion: step s forwards the chunk received at step s-1, so
     // its fp32 accumulated form is dead the moment the encoded bytes
@@ -901,7 +913,7 @@ Status TcpOps::RingReduceScatterPhase(uint8_t* buf,
     // final chunk (the one this rank owns after the phase) lands in
     // fbuf; the allgather phase overwrites every other chunk anyway.
     if (max_chunk * esize <= 8 * 1024) {
-      uint8_t* enc_recv = wire_enc_b_.data();
+      uint8_t* enc_recv = pool_.Get(BufferPool::kWireEncB, enc_max);
       int last_cr = -1;
       for (int s = 0; s < P - 1; ++s) {
         int cs = ((p - s - 1) % P + P) % P, cr = ((p - s - 2) % P + P) % P;
@@ -930,7 +942,8 @@ Status TcpOps::RingReduceScatterPhase(uint8_t* buf,
     // strictly precedes this step's send in program order, while the
     // recv of chunk cr drains in the helper thread — the encode rides
     // the overlap the PR 2 pipeline opened.
-    uint8_t* enc_scratch[2] = {wire_enc_b_.data(), wire_enc_c_.data()};
+    uint8_t* enc_scratch[2] = {pool_.Get(BufferPool::kWireEncB, enc_max),
+                               pool_.Get(BufferPool::kWireEncC, enc_max)};
     int last_cr = -1;
     for (int s = 0; s < P - 1; ++s) {
       const int cs = ((p - s - 1) % P + P) % P;
@@ -968,34 +981,33 @@ Status TcpOps::RingReduceScatterPhase(uint8_t* buf,
   // send() and the reduce is nanoseconds — the thread handshake would
   // cost more than it overlaps. Same cutover as SendRecv's.
   if (max_chunk * esize <= 8 * 1024) {
-    std::vector<uint8_t> scratch(max_chunk * esize);
+    uint8_t* scratch = pool_.Get(BufferPool::kExchA, max_chunk * esize);
     for (int s = 0; s < P - 1; ++s) {
       int cs = ((p - s - 1) % P + P) % P, cr = ((p - s - 2) % P + P) % P;
       if (!SendRecv(next, buf + offs[cs] * esize,
-                    (offs[cs + 1] - offs[cs]) * esize, prev, scratch.data(),
+                    (offs[cs + 1] - offs[cs]) * esize, prev, scratch,
                     (offs[cr + 1] - offs[cr]) * esize))
         return Status::UnknownError("ring allreduce: lost data connection");
-      HostAccumulate(op, dtype, scratch.data(), buf + offs[cr] * esize,
+      HostAccumulate(op, dtype, scratch, buf + offs[cr] * esize,
                      offs[cr + 1] - offs[cr]);
     }
     return Status::OK();
   }
-  std::vector<uint8_t> scratch[2] = {
-      std::vector<uint8_t>(max_chunk * esize),
-      std::vector<uint8_t>(max_chunk * esize)};
+  uint8_t* scratch[2] = {pool_.Get(BufferPool::kExchA, max_chunk * esize),
+                         pool_.Get(BufferPool::kExchB, max_chunk * esize)};
   int prev_cr = -1;  // chunk received (not yet accumulated) last step
   for (int s = 0; s < P - 1; ++s) {
     const int cs = ((p - s - 1) % P + P) % P;
     const int cr = ((p - s - 2) % P + P) % P;
     std::atomic<bool> recv_ok{true};
-    uint8_t* rbuf = scratch[s % 2].data();
+    uint8_t* rbuf = scratch[s % 2];
     const int64_t rbytes = (offs[cr + 1] - offs[cr]) * esize;
     std::thread receiver([&, rbuf, rbytes] {
       if (!prev->RecvAll(rbuf, rbytes))
         recv_ok.store(false, std::memory_order_relaxed);
     });
     if (prev_cr >= 0)
-      HostAccumulate(op, dtype, scratch[(s - 1) % 2].data(),
+      HostAccumulate(op, dtype, scratch[(s - 1) % 2],
                      buf + offs[prev_cr] * esize,
                      offs[prev_cr + 1] - offs[prev_cr]);
     const bool send_ok = next->SendAll(buf + offs[cs] * esize,
@@ -1006,7 +1018,7 @@ Status TcpOps::RingReduceScatterPhase(uint8_t* buf,
     prev_cr = cr;
   }
   if (prev_cr >= 0)
-    HostAccumulate(op, dtype, scratch[(P - 2) % 2].data(),
+    HostAccumulate(op, dtype, scratch[(P - 2) % 2],
                    buf + offs[prev_cr] * esize,
                    offs[prev_cr + 1] - offs[prev_cr]);
   return Status::OK();
@@ -1044,12 +1056,8 @@ Status TcpOps::RingAllgatherPhase(uint8_t* buf,
     for (int k = 0; k < P; ++k)
       max_chunk = std::max(max_chunk, offs[k + 1] - offs[k]);
     const int64_t enc_max = WireEncodedBytes(codec, max_chunk);
-    if (static_cast<int64_t>(wire_enc_a_.size()) < enc_max)
-      wire_enc_a_.resize(enc_max);
-    if (static_cast<int64_t>(wire_enc_b_.size()) < enc_max)
-      wire_enc_b_.resize(enc_max);
-    uint8_t* send_enc = wire_enc_a_.data();
-    uint8_t* recv_enc = wire_enc_b_.data();
+    uint8_t* send_enc = pool_.Get(BufferPool::kWireEncA, enc_max);
+    uint8_t* recv_enc = pool_.Get(BufferPool::kWireEncB, enc_max);
     int last_cr = -1;
     for (int s = 0; s < P - 1; ++s) {
       const int cs = ((p - s) % P + P) % P;
@@ -1096,6 +1104,53 @@ Status TcpOps::RingAllgatherPhase(uint8_t* buf,
                   (offs[cs + 1] - offs[cs]) * esize, prev,
                   buf + offs[cr] * esize, (offs[cr + 1] - offs[cr]) * esize))
       return Status::UnknownError("ring allreduce: lost data connection");
+  }
+  return Status::OK();
+}
+
+Status TcpOps::RingAllgatherVec(
+    const std::vector<std::vector<struct iovec>>& chunks,
+    const std::vector<int>& ranks, int p) {
+  MetricTimer phase_timer(kHistTcpRingAgUs);
+  // The flat-buffer phase above with the chunk layout abstracted into
+  // span lists: step s forwards chunk cs's spans in ONE SendV while
+  // chunk cr's spans fill via ONE RecvV — same per-step byte stream,
+  // but the spans can point anywhere (the fused allgather points them
+  // at the final per-tensor output slices, so nothing is staged).
+  const int P = static_cast<int>(ranks.size());
+  TcpConn* next = controller_->DataConn(ranks[(p + 1) % P]);
+  TcpConn* prev = controller_->DataConn(ranks[(p - 1 + P) % P]);
+  auto span_bytes = [](const std::vector<struct iovec>& v) {
+    uint64_t b = 0;
+    for (const auto& io : v) b += io.iov_len;
+    return b;
+  };
+  for (int s = 0; s < P - 1; ++s) {
+    const int cs = ((p - s) % P + P) % P;
+    const int cr = ((p - s - 1) % P + P) % P;
+    const auto& sv = chunks[cs];
+    const auto& rv = chunks[cr];
+    const uint64_t sb = span_bytes(sv);
+    const uint64_t rb = span_bytes(rv);
+    // Below the kernel's send-buffer floor the send cannot block, so
+    // the helper-thread handshake would cost more than it overlaps —
+    // the SendRecv cutover, span-list edition.
+    if (sb <= 8 * 1024) {
+      if ((sb > 0 && !next->SendV(sv.data(), static_cast<int>(sv.size()))) ||
+          (rb > 0 && !prev->RecvV(rv.data(), static_cast<int>(rv.size()))))
+        return Status::UnknownError("ring allgather: lost data connection");
+      continue;
+    }
+    std::atomic<bool> send_ok{true};
+    std::thread sender([&] {
+      if (!next->SendV(sv.data(), static_cast<int>(sv.size())))
+        send_ok.store(false, std::memory_order_relaxed);
+    });
+    const bool recv_ok =
+        rb == 0 || prev->RecvV(rv.data(), static_cast<int>(rv.size()));
+    sender.join();
+    if (!send_ok.load(std::memory_order_relaxed) || !recv_ok)
+      return Status::UnknownError("ring allgather: lost data connection");
   }
   return Status::OK();
 }
@@ -1272,7 +1327,7 @@ Status TcpOps::DoublingExchange(
   int q = 1;
   while (q * 2 <= P) q *= 2;
   const int t = P - q;
-  std::vector<uint8_t> scratch(bytes);
+  uint8_t* scratch = pool_.Get(BufferPool::kExchA, bytes);
 
   int v;  // my index within the q-member core
   if (p < 2 * t) {
@@ -1283,9 +1338,9 @@ Status TcpOps::DoublingExchange(
         return Status::UnknownError("allreduce fold: lost data connection");
       return Status::OK();
     }
-    if (!controller_->DataConn(ranks[p + 1])->RecvAll(scratch.data(), bytes))
+    if (!controller_->DataConn(ranks[p + 1])->RecvAll(scratch, bytes))
       return Status::UnknownError("allreduce fold: lost data connection");
-    Status st = combine(scratch.data());
+    Status st = combine(scratch);
     if (!st.ok()) return st;
     v = p / 2;
   } else {
@@ -1296,9 +1351,9 @@ Status TcpOps::DoublingExchange(
   for (int d = 1; d < q; d *= 2) {
     int partner = pos_of(v ^ d);
     TcpConn* conn = controller_->DataConn(ranks[partner]);
-    if (!SendRecv(conn, buf, bytes, conn, scratch.data(), bytes))
+    if (!SendRecv(conn, buf, bytes, conn, scratch, bytes))
       return Status::UnknownError("allreduce: lost data connection");
-    Status st = combine(scratch.data());
+    Status st = combine(scratch);
     if (!st.ok()) return st;
   }
   if (p < 2 * t) {
@@ -1332,13 +1387,9 @@ Status TcpOps::DoublingExchangeCompressed(
   const int64_t elems = bytes / 4;
   float* fbuf = reinterpret_cast<float*>(buf);
   const int64_t eb = WireEncodedBytes(codec, elems);
-  if (static_cast<int64_t>(wire_enc_a_.size()) < eb) wire_enc_a_.resize(eb);
-  if (static_cast<int64_t>(wire_enc_b_.size()) < eb) wire_enc_b_.resize(eb);
-  if (static_cast<int64_t>(wire_dec_.size()) < elems)
-    wire_dec_.resize(elems);
-  uint8_t* enc_mine = wire_enc_a_.data();
-  uint8_t* enc_theirs = wire_enc_b_.data();
-  float* dec = wire_dec_.data();
+  uint8_t* enc_mine = pool_.Get(BufferPool::kWireEncA, eb);
+  uint8_t* enc_theirs = pool_.Get(BufferPool::kWireEncB, eb);
+  float* dec = pool_.GetAs<float>(BufferPool::kWireDec, elems);
   int rounds = 0;
   for (int d = 1; d < q; d *= 2) ++rounds;
   float* efd = nullptr;
@@ -1446,14 +1497,14 @@ Status TcpOps::ExecuteSchedule(const ChunkSchedule& sched, uint8_t* buf,
   float* fbuf = reinterpret_cast<float*>(buf);
   std::vector<int64_t> cache_off;
   std::vector<uint8_t> valid;
+  uint8_t* cache = nullptr;
   float* efd = nullptr;
   if (codec != WireCodec::NONE) {
     cache_off.resize(nchunks + 1, 0);
     for (int c = 0; c < nchunks; ++c)
       cache_off[c + 1] = cache_off[c] + WireEncodedBytes(codec,
                                                          chunk_elems(c));
-    if (static_cast<int64_t>(sched_cache_.size()) < cache_off[nchunks])
-      sched_cache_.resize(cache_off[nchunks]);
+    cache = pool_.Get(BufferPool::kSchedCache, cache_off[nchunks]);
     valid.assign(nchunks, 0);
     if (ef && offs[nchunks] > 0) {
       if (static_cast<int64_t>(ef->size()) != offs[nchunks])
@@ -1461,7 +1512,7 @@ Status TcpOps::ExecuteSchedule(const ChunkSchedule& sched, uint8_t* buf,
       efd = ef->data();
     }
   }
-  auto enc_region = [&](int c) { return sched_cache_.data() + cache_off[c]; };
+  auto enc_region = [&](int c) { return cache + cache_off[c]; };
   auto enc_bytes = [&](int c) { return WireEncodedBytes(codec,
                                                         chunk_elems(c)); };
 
@@ -1474,6 +1525,7 @@ Status TcpOps::ExecuteSchedule(const ChunkSchedule& sched, uint8_t* buf,
     // Raw-path RECV_REDUCE staging: lay out one scratch region per
     // recv-reduce op (codec recvs land in the encoded cache instead).
     std::vector<int64_t> rr_off(idx - lo + 1, 0);
+    uint8_t* rr_stage = nullptr;
     if (codec == WireCodec::NONE) {
       for (size_t i = lo; i < idx; ++i) {
         int64_t n = ops[i].action == ChunkAction::RECV_REDUCE
@@ -1481,59 +1533,80 @@ Status TcpOps::ExecuteSchedule(const ChunkSchedule& sched, uint8_t* buf,
                         : 0;
         rr_off[i - lo + 1] = rr_off[i - lo] + n;
       }
-      if (static_cast<int64_t>(sched_scratch_.size()) < rr_off.back())
-        sched_scratch_.resize(rr_off.back());
+      rr_stage = pool_.Get(BufferPool::kSchedScratch, rr_off.back());
     }
 
-    // One receiver thread per peer, draining that peer's recv ops in
-    // table order (the sender streams the same chunks in the same
-    // order — the generator contract the simulator tests pin).
     std::vector<int> recv_peers, send_peers;
     for (size_t i = lo; i < idx; ++i) {
       const auto& o = ops[i];
+      if (o.action == ChunkAction::COPY) continue;
       auto& list = o.action == ChunkAction::SEND ? send_peers : recv_peers;
-      if (o.action != ChunkAction::COPY &&
-          std::find(list.begin(), list.end(), o.peer) == list.end())
+      if (std::find(list.begin(), list.end(), o.peer) == list.end())
         list.push_back(o.peer);
+    }
+
+    // Vectored coalescing: ONE RecvV per recv peer and ONE SendV per
+    // send peer per step — a step's chunks to the same peer ride a
+    // single syscall, and verbatim RECVs still land straight in their
+    // final buf segment (the iovec simply points there). Span order is
+    // table order per peer on BOTH sides, so the byte stream is
+    // identical to the per-chunk sends and results stay bitwise
+    // unchanged. All span tables are laid out here, before the
+    // receiver threads spawn (a pool Get may reallocate the slab).
+    struct iovec* iov_all = pool_.GetAs<struct iovec>(
+        BufferPool::kIov, static_cast<int64_t>(idx - lo));
+    int cursor = 0;
+    struct RecvGroup {
+      int peer;
+      struct iovec* iov;
+      int n;
+    };
+    std::vector<RecvGroup> rgroups;
+    for (int peer : recv_peers) {
+      RecvGroup g{peer, iov_all + cursor, 0};
+      for (size_t i = lo; i < idx; ++i) {
+        const auto& o = ops[i];
+        if (o.peer != peer || o.action == ChunkAction::SEND ||
+            o.action == ChunkAction::COPY)
+          continue;
+        void* dst;
+        uint64_t bytes;
+        if (codec != WireCodec::NONE) {
+          dst = enc_region(o.chunk);
+          bytes = static_cast<uint64_t>(enc_bytes(o.chunk));
+        } else if (o.action == ChunkAction::RECV) {
+          dst = buf + offs[o.chunk] * esize;
+          bytes = static_cast<uint64_t>(chunk_elems(o.chunk) * esize);
+        } else {
+          dst = rr_stage + rr_off[i - lo];
+          bytes = static_cast<uint64_t>(chunk_elems(o.chunk) * esize);
+        }
+        if (bytes == 0) continue;
+        iov_all[cursor++] = {dst, static_cast<size_t>(bytes)};
+        ++g.n;
+      }
+      if (g.n > 0) rgroups.push_back(g);
     }
     std::atomic<bool> io_ok{true};
     std::vector<std::thread> receivers;
-    receivers.reserve(recv_peers.size());
-    for (int peer : recv_peers) {
-      receivers.emplace_back([&, peer] {
-        TcpConn* conn = controller_->DataConn(ranks[peer]);
-        for (size_t i = lo; i < idx; ++i) {
-          const auto& o = ops[i];
-          if (o.peer != peer || o.action == ChunkAction::SEND ||
-              o.action == ChunkAction::COPY)
-            continue;
-          void* dst;
-          uint64_t bytes;
-          if (codec != WireCodec::NONE) {
-            dst = enc_region(o.chunk);
-            bytes = static_cast<uint64_t>(enc_bytes(o.chunk));
-          } else if (o.action == ChunkAction::RECV) {
-            dst = buf + offs[o.chunk] * esize;
-            bytes = static_cast<uint64_t>(chunk_elems(o.chunk) * esize);
-          } else {
-            dst = sched_scratch_.data() + rr_off[i - lo];
-            bytes = static_cast<uint64_t>(chunk_elems(o.chunk) * esize);
-          }
-          if (bytes > 0 && (conn == nullptr || !conn->RecvAll(dst, bytes))) {
-            io_ok.store(false, std::memory_order_relaxed);
-            return;
-          }
-        }
+    receivers.reserve(rgroups.size());
+    for (const auto& g : rgroups) {
+      receivers.emplace_back([&, g] {
+        TcpConn* conn = controller_->DataConn(ranks[g.peer]);
+        if (conn == nullptr || !conn->RecvV(g.iov, g.n))
+          io_ok.store(false, std::memory_order_relaxed);
       });
     }
-    // Sends, grouped by peer in table order, from this thread. With a
-    // codec: forward the cached encoded bytes when the chunk already
+    // Sends, one coalesced SendV per peer, spans in table order. With
+    // a codec: forward the cached encoded bytes when the chunk already
     // passed through encoded; otherwise encode fresh (error feedback
-    // at persistent sites), ship, and SELF-DECODE the local copy so
-    // this rank holds exactly the bytes every receiver will decode.
+    // at persistent sites) and SELF-DECODE the local copy so this rank
+    // holds exactly the bytes every receiver will decode.
     bool send_ok = true;
     for (int peer : send_peers) {
       TcpConn* conn = controller_->DataConn(ranks[peer]);
+      struct iovec* siov = iov_all + cursor;
+      int sn = 0;
       for (size_t i = lo; i < idx && send_ok; ++i) {
         const auto& o = ops[i];
         if (o.peer != peer || o.action != ChunkAction::SEND) continue;
@@ -1557,11 +1630,16 @@ Status TcpOps::ExecuteSchedule(const ChunkSchedule& sched, uint8_t* buf,
             WireDecode(codec, enc_region(o.chunk), n, fbuf + offs[o.chunk]);
             valid[o.chunk] = 1;
           }
-          send_ok = conn->SendAll(enc_region(o.chunk), enc_bytes(o.chunk));
+          iov_all[cursor + sn] = {enc_region(o.chunk),
+                                  static_cast<size_t>(enc_bytes(o.chunk))};
         } else {
-          send_ok = conn->SendAll(buf + offs[o.chunk] * esize, n * esize);
+          iov_all[cursor + sn] = {buf + offs[o.chunk] * esize,
+                                  static_cast<size_t>(n * esize)};
         }
+        ++sn;
       }
+      if (send_ok && sn > 0) send_ok = conn->SendV(siov, sn);
+      cursor += sn;
       if (!send_ok) break;
     }
     for (auto& th : receivers) th.join();
@@ -1582,7 +1660,7 @@ Status TcpOps::ExecuteSchedule(const ChunkSchedule& sched, uint8_t* buf,
           valid[o.chunk] = 0;  // the cached bytes no longer match buf
         }
       } else if (o.action == ChunkAction::RECV_REDUCE) {
-        HostAccumulate(op, dtype, sched_scratch_.data() + rr_off[i - lo],
+        HostAccumulate(op, dtype, rr_stage + rr_off[i - lo],
                        buf + offs[o.chunk] * esize, n);
       }
     }
@@ -1641,8 +1719,8 @@ Status TcpOps::Allgather(const Response& r,
   // controller.cc:826-848): r.tensor_sizes holds per-tensor blocks of
   // `size` row counts. One ring pass moves every tensor: each rank's
   // ring "shard" is the concatenation of its rows of all fused
-  // tensors, packed into the fusion buffer, and the P-1 forwarding
-  // steps ship total−own bytes regardless of how many tensors fused.
+  // tensors, and the P-1 forwarding steps ship total−own bytes
+  // regardless of how many tensors fused.
   auto rows = [&](int t, int k) { return r.tensor_sizes[t * size + k]; };
   std::vector<int64_t> row_bytes(nt);
   for (int t = 0; t < nt; ++t) {
@@ -1653,9 +1731,8 @@ Status TcpOps::Allgather(const Response& r,
     if (e.output == nullptr)
       return Status::PreconditionError("allgather output not allocated");
   }
-  // Per-rank ring block offsets (bytes). All ranks in ring order; the
-  // ring itself is RingAllgatherPhase with byte-granular (UINT8)
-  // chunks so the fused and unfused paths share one implementation.
+  // Per-rank ring block offsets (bytes), in ring order — the shm
+  // paths' arena layout, and the span-table derivation below.
   std::vector<int64_t> offs(size + 1, 0);
   for (int k = 0; k < size; ++k) {
     int64_t b = 0;
@@ -1680,8 +1757,8 @@ Status TcpOps::Allgather(const Response& r,
                                         ? ACT_SHM_ALLGATHER
                                         : ACT_TCP_ALLGATHER);
   // Pack my block (my rows of every fused tensor, tensor order) at my
-  // global offset in `base` — shared by the shm, node-hierarchical and
-  // fusion-buffer paths.
+  // global offset in `base` — shared by the shm and node-hierarchical
+  // paths (the TCP path below needs no staging buffer at all).
   auto pack = [&](uint8_t* base) {
     int64_t poff = offs[rank];
     for (int t = 0; t < nt; ++t) {
@@ -1725,38 +1802,43 @@ Status TcpOps::Allgather(const Response& r,
     return st;
   }
 
-  if (nt == 1) {
-    // Single tensor: ring in place in the output buffer — no staging
-    // copy, no fusion-buffer growth to the gathered size.
-    auto& e = entries[0];
-    uint8_t* out = static_cast<uint8_t*>(e.output);
-    pack(out);
-    if (size > 1) {
-      Status st = RingAllgatherPhase(out, offs, DataType::UINT8, all_ranks,
-                                     rank);
-      if (!st.ok()) return st;
+  // TCP plane: vectored ring straight over the OUTPUT buffers. Chunk
+  // k's spans are rank k's rows of every fused tensor at their final
+  // output offsets, so the user buffers ARE the wire buffers: the old
+  // fused path staged through a fusion buffer grown to the GATHERED
+  // size and paid a full gathered-size unpack memcpy per op — both
+  // gone. Bytes and order on the wire are unchanged (the ring walks
+  // the same rank-major blocks), so results are bitwise identical.
+  std::vector<std::vector<struct iovec>> chunks(size);
+  {
+    std::vector<int64_t> out_off(nt, 0);
+    for (int k = 0; k < size; ++k) {
+      auto& spans = chunks[k];
+      for (int t = 0; t < nt; ++t) {
+        const int64_t bytes = rows(t, k) * row_bytes[t];
+        if (bytes > 0)
+          spans.push_back(
+              {static_cast<uint8_t*>(entries[t].output) + out_off[t],
+               static_cast<size_t>(bytes)});
+        out_off[t] += bytes;
+      }
     }
-    if (timeline_) timeline_->ActivityEnd(tname);
-    return Status::OK();
   }
-
-  uint8_t* buf = static_cast<uint8_t*>(fusion_->GetBuffer(0, offs[size]));
-
+  // My own rows land in my output block directly from the inputs (the
+  // only copy left on this path — and it is part of the result).
   if (timeline_) timeline_->ActivityStart(tname, ACT_MEMCPY_IN_FUSION_BUFFER);
-  pack(buf);
+  for (int t = 0; t < nt; ++t) {
+    int64_t off = 0;
+    for (int k = 0; k < rank; ++k) off += rows(t, k) * row_bytes[t];
+    std::memcpy(static_cast<uint8_t*>(entries[t].output) + off,
+                entries[t].data, rows(t, rank) * row_bytes[t]);
+  }
   if (timeline_) timeline_->ActivityEnd(tname);
 
   if (size > 1) {
-    Status st = RingAllgatherPhase(buf, offs, DataType::UINT8, all_ranks,
-                                   rank);
+    Status st = RingAllgatherVec(chunks, all_ranks, rank);
     if (!st.ok()) return st;
   }
-
-  // Unpack: rank k's block holds its rows of each tensor in order.
-  if (timeline_) timeline_->ActivityStart(tname,
-                                          ACT_MEMCPY_OUT_FUSION_BUFFER);
-  unpack(buf);
-  if (timeline_) timeline_->ActivityEnd(tname);
   if (timeline_) timeline_->ActivityEnd(tname);  // closes TCP_ALLGATHER
   return Status::OK();
 }

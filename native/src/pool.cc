@@ -1,0 +1,53 @@
+#include "hvd/pool.h"
+
+#include <cstdlib>
+#include <cstring>
+
+#include "hvd/thread_pool.h"
+
+namespace hvd {
+
+namespace {
+constexpr int64_t kPageBytes = 4096;
+}
+
+BufferPool::~BufferPool() {
+  for (auto& s : slabs_) std::free(s.p);
+}
+
+uint8_t* BufferPool::Get(int slot, int64_t bytes) {
+  Slab& s = slabs_[slot];
+  if (bytes <= s.cap && s.p != nullptr) return s.p;
+  const int64_t cap = ((bytes < 1 ? 1 : bytes) + kPageBytes - 1) /
+                      kPageBytes * kPageBytes;
+  void* p = nullptr;
+  if (posix_memalign(&p, static_cast<size_t>(kPageBytes),
+                     static_cast<size_t>(cap)) != 0)
+    p = std::malloc(static_cast<size_t>(cap));  // alignment is a perf
+                                                // hint, not correctness
+  std::free(s.p);
+  s.p = static_cast<uint8_t*>(p);
+  s.cap = cap;
+  // First-touch from the pool workers: the thread that first writes a
+  // fresh page decides its NUMA home, and these are the threads that
+  // later reduce/encode over the slab. Zeroing is incidental — the
+  // point is WHO faults the pages in, not what they hold.
+  const int parts = ParallelParts(cap);
+  if (parts <= 1) {
+    std::memset(s.p, 0, static_cast<size_t>(cap));
+  } else {
+    uint8_t* base = s.p;
+    WorkerPool::Get().ParallelFor(parts, cap, [base](int64_t lo, int64_t hi) {
+      std::memset(base + lo, 0, static_cast<size_t>(hi - lo));
+    });
+  }
+  return s.p;
+}
+
+int64_t BufferPool::allocated_bytes() const {
+  int64_t total = 0;
+  for (const auto& s : slabs_) total += s.cap;
+  return total;
+}
+
+}  // namespace hvd

@@ -9,12 +9,32 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <chrono>
 #include <cstring>
 #include <thread>
 
+#include "hvd/env.h"
 #include "hvd/logging.h"
 #include "hvd/metrics.h"
+
+// MSG_ZEROCOPY plumbing (kernel >= 4.14). The toolchain headers on
+// this container predate the feature, so the constants are defined
+// here when missing — the runtime probe below, not the build host,
+// decides whether the path is live.
+#ifndef SO_ZEROCOPY
+#define SO_ZEROCOPY 60
+#endif
+#ifndef SO_EE_ORIGIN_ZEROCOPY
+#define SO_EE_ORIGIN_ZEROCOPY 5
+#endif
+#ifndef MSG_ZEROCOPY
+#define MSG_ZEROCOPY 0x4000000
+#endif
+#if defined(__linux__) && __has_include(<linux/errqueue.h>)
+#include <linux/errqueue.h>
+#define HVD_HAS_ERRQUEUE 1
+#endif
 
 namespace hvd {
 namespace {
@@ -46,10 +66,130 @@ void SetNoDelay(int fd) {
 
 }  // namespace
 
+namespace {
+// Spans per syscall window: SendV/RecvV copy the caller's (const)
+// iovec table into a stack window this size and let the kernel drain
+// it — far below IOV_MAX, large enough that even a many-tensor fused
+// allgather block rarely needs a second window.
+constexpr int kIovWindow = 64;
+// MSG_ZEROCOPY floor: below this the page-pin + completion round trip
+// costs more than the copy it saves (the kernel's own guidance is
+// ~10 KB; we stay conservative since loopback often degrades to the
+// COPIED completion anyway — see docs/perf_tuning.md).
+constexpr uint64_t kZcMinBytes = 64 * 1024;
+
+uint64_t IovBytes(const struct iovec* iov, int n) {
+  uint64_t total = 0;
+  for (int i = 0; i < n; ++i) total += iov[i].iov_len;
+  return total;
+}
+}  // namespace
+
+#ifdef HVD_HAS_ERRQUEUE
+namespace {
+// END-TO-END zerocopy probe: one real MSG_ZEROCOPY send over a
+// loopback TCP pair whose completion must actually arrive on the
+// error queue. Merely accepting SO_ZEROCOPY proves nothing — this
+// container's sandboxed 4.4-era kernel ACCEPTS the option and then
+// never posts a completion, which would wedge every large send in
+// the reap loop. Anything short of a delivered completion within the
+// deadline means "feature absent".
+bool ProbeZerocopy() {
+  int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (lfd < 0) return false;
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  socklen_t slen = sizeof(sa);
+  bool ok = false;
+  int cfd = -1, afd = -1;
+  do {
+    if (::bind(lfd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0 ||
+        ::listen(lfd, 1) != 0 ||
+        getsockname(lfd, reinterpret_cast<sockaddr*>(&sa), &slen) != 0)
+      break;
+    cfd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (cfd < 0) break;
+    int one = 1;
+    if (setsockopt(cfd, SOL_SOCKET, SO_ZEROCOPY, &one, sizeof(one)) != 0)
+      break;
+    if (::connect(cfd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0)
+      break;
+    afd = ::accept(lfd, nullptr, nullptr);
+    if (afd < 0) break;
+    char payload[4096] = {};
+    struct iovec iov{payload, sizeof(payload)};
+    msghdr msg{};
+    msg.msg_iov = &iov;
+    msg.msg_iovlen = 1;
+    if (::sendmsg(cfd, &msg, MSG_NOSIGNAL | MSG_ZEROCOPY) !=
+        static_cast<ssize_t>(sizeof(payload)))
+      break;
+    char sink[4096];
+    for (size_t got = 0; got < sizeof(payload);) {
+      ssize_t k = ::recv(afd, sink, sizeof(sink), 0);
+      if (k <= 0) break;
+      got += static_cast<size_t>(k);
+    }
+    // A real kernel posts the loopback completion at skb-free time —
+    // microseconds after the peer's recv above — so a tight deadline
+    // suffices, and a completion-less sandbox costs every process only
+    // ~40 ms once, not a long stall (tier-1 spawns hundreds of ranks).
+    for (int spin = 0; spin < 2 && !ok; ++spin) {
+      pollfd p{cfd, 0, 0};
+      ::poll(&p, 1, 20);
+      char ctrl[128];
+      msghdr em{};
+      em.msg_control = ctrl;
+      em.msg_controllen = sizeof(ctrl);
+      if (::recvmsg(cfd, &em, MSG_ERRQUEUE) < 0) continue;
+      for (cmsghdr* cm = CMSG_FIRSTHDR(&em); cm != nullptr;
+           cm = CMSG_NXTHDR(&em, cm)) {
+        if (cm->cmsg_level != SOL_IP && cm->cmsg_level != SOL_IPV6) continue;
+        auto* ee = reinterpret_cast<const sock_extended_err*>(CMSG_DATA(cm));
+        if (ee->ee_origin == SO_EE_ORIGIN_ZEROCOPY) ok = true;
+      }
+    }
+  } while (false);
+  if (cfd >= 0) ::close(cfd);
+  if (afd >= 0) ::close(afd);
+  ::close(lfd);
+  return ok;
+}
+}  // namespace
+#endif
+
+int ResolvedTransportMode() {
+  // Decided once per process (the data plane asks per send): the env
+  // wish sanitized like every other knob, then a live end-to-end
+  // kernel probe — compile-time constants (or even an accepted
+  // setsockopt) prove nothing about the running kernel.
+  static const int mode = [] {
+    static const char* kChoices[] = {"auto", "on", "off"};
+    const int wish = EnvChoiceSane("HOROVOD_TCP_ZEROCOPY", 0, kChoices, 3);
+    if (wish == 2) return static_cast<int>(kTransportVectored);
+    bool ok = false;
+#ifdef HVD_HAS_ERRQUEUE
+    ok = ProbeZerocopy();
+#endif
+    if (!ok && wish == 1 && EnvWarnOnce("HOROVOD_TCP_ZEROCOPY(probe)"))
+      LOG_WARNING << "HOROVOD_TCP_ZEROCOPY=on but this kernel does not "
+                     "deliver MSG_ZEROCOPY completions (needs >= 4.14); "
+                     "staying on the vectored path";
+    return static_cast<int>(ok ? kTransportZerocopy : kTransportVectored);
+  }();
+  return mode;
+}
+
+const char* TransportModeName(int mode) {
+  return mode == kTransportZerocopy ? "zerocopy" : "vectored";
+}
+
 TcpConn& TcpConn::operator=(TcpConn&& o) noexcept {
   if (this != &o) {
     Close();
     fd_ = o.fd_;
+    zc_ = o.zc_;
     o.fd_ = -1;
   }
   return *this;
@@ -61,40 +201,186 @@ void TcpConn::Close() {
   if (fd_ >= 0) {
     ::close(fd_);
     fd_ = -1;
+    zc_ = 0;
   }
 }
 
-bool TcpConn::SendAll(const void* data, uint64_t len) {
-  const char* p = static_cast<const char*>(data);
+// Drain one mutable iovec window through sendmsg. Large windows ride
+// MSG_ZEROCOPY when the resolved mode allows and this fd accepts
+// SO_ZEROCOPY; every zerocopy completion is reaped from the error
+// queue BEFORE returning, so callers may immediately reuse or mutate
+// the spans (the in-place exchanges and the grow-only pool depend on
+// exactly that).
+bool TcpConn::SendWindow(struct iovec* win, int cnt, uint64_t bytes) {
+  bool use_zc = false;
+#ifdef HVD_HAS_ERRQUEUE
+  // Size gate FIRST: ResolvedTransportMode()'s one-time probe costs
+  // ~40 ms on a completion-less kernel, and most processes (tier-1
+  // spawns hundreds) never send a zerocopy-eligible span — they must
+  // never pay it. Only large sends, or an explicit mode query
+  // (metrics gauge / bench), resolve the mode.
+  if (bytes >= kZcMinBytes && zc_ >= 0 &&
+      ResolvedTransportMode() == kTransportZerocopy) {
+    if (zc_ == 0) {
+      int one = 1;
+      zc_ = setsockopt(fd_, SOL_SOCKET, SO_ZEROCOPY, &one, sizeof(one)) == 0
+                ? 1
+                : -1;
+    }
+    use_zc = zc_ == 1;
+  }
+#endif
+  (void)bytes;
+  uint32_t zc_pending = 0;
+  int j = 0;
+  while (j < cnt) {
+    while (j < cnt && win[j].iov_len == 0) ++j;  // recvmsg-EOF ambiguity
+    if (j == cnt) break;
+    msghdr msg{};
+    msg.msg_iov = win + j;
+    msg.msg_iovlen = static_cast<size_t>(cnt - j);
+    ssize_t n =
+        ::sendmsg(fd_, &msg, MSG_NOSIGNAL | (use_zc ? MSG_ZEROCOPY : 0));
+    if (n < 0) {
+      if (errno == EINTR) continue;
+#ifdef HVD_HAS_ERRQUEUE
+      if (use_zc && errno == ENOBUFS) {
+        if (zc_pending > 0) {
+          // optmem exhausted by un-reaped notifications: reap, retry.
+          if (!ReapZerocopy(&zc_pending, /*wait=*/true)) return false;
+        } else {
+          // Nothing left to reap: the socket's optmem budget or the
+          // process memlock limit cannot cover this send at all. The
+          // MSG_ZEROCOPY contract's documented fallback is a plain
+          // (copied) send — a healthy connection must not die over a
+          // pinning budget.
+          use_zc = false;
+        }
+        continue;
+      }
+#endif
+      return false;
+    }
+    MetricAdd(kCtrTcpSendvCalls);
+    if (use_zc) {
+      MetricAdd(kCtrTcpZerocopySends);
+      ++zc_pending;
+    }
+    uint64_t left = static_cast<uint64_t>(n);
+    while (j < cnt && left >= win[j].iov_len) {
+      left -= win[j].iov_len;
+      ++j;
+    }
+    if (j < cnt && left > 0) {
+      win[j].iov_base = static_cast<char*>(win[j].iov_base) + left;
+      win[j].iov_len -= left;
+    }
+  }
+#ifdef HVD_HAS_ERRQUEUE
+  while (zc_pending > 0)
+    if (!ReapZerocopy(&zc_pending, /*wait=*/true)) return false;
+#endif
+  return true;
+}
+
+#ifdef HVD_HAS_ERRQUEUE
+bool TcpConn::ReapZerocopy(uint32_t* pending, bool wait) {
+  // Each error-queue record acknowledges a RANGE of MSG_ZEROCOPY sends
+  // ([ee_info, ee_data]); block on POLLERR (level-triggered while the
+  // queue is non-empty) up to a generous bound so a dead peer surfaces
+  // as an error instead of a wedge.
+  while (*pending > 0) {
+    char ctrl[128];
+    msghdr msg{};
+    msg.msg_control = ctrl;
+    msg.msg_controllen = sizeof(ctrl);
+    ssize_t n = ::recvmsg(fd_, &msg, MSG_ERRQUEUE);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        if (!wait) return true;
+        pollfd p{fd_, 0, 0};
+        int rc = ::poll(&p, 1, 60 * 1000);
+        if (rc < 0 && errno == EINTR) continue;  // same retry as the IO
+        if (rc <= 0) return false;
+        continue;
+      }
+      return false;
+    }
+    for (cmsghdr* cm = CMSG_FIRSTHDR(&msg); cm != nullptr;
+         cm = CMSG_NXTHDR(&msg, cm)) {
+      if (cm->cmsg_level != SOL_IP && cm->cmsg_level != SOL_IPV6) continue;
+      auto* ee = reinterpret_cast<const sock_extended_err*>(CMSG_DATA(cm));
+      if (ee->ee_origin != SO_EE_ORIGIN_ZEROCOPY) continue;
+      const uint32_t acked = ee->ee_data - ee->ee_info + 1;
+      *pending -= std::min(*pending, acked);
+    }
+  }
+  return true;
+}
+#endif
+
+bool TcpConn::SendV(const struct iovec* iov, int n) {
   // Ground-truth on-the-wire accounting (one relaxed atomic add per
   // call): with a wire codec active this counts the ENCODED bytes, so
   // it is the denominator-of-record for effective-bandwidth math.
-  MetricAdd(kCtrTcpSendBytes, static_cast<int64_t>(len));
-  while (len > 0) {
-    ssize_t n = ::send(fd_, p, len, MSG_NOSIGNAL);
-    if (n <= 0) {
-      if (n < 0 && (errno == EINTR)) continue;
-      return false;
-    }
-    p += n;
-    len -= static_cast<uint64_t>(n);
+  MetricAdd(kCtrTcpSendBytes, static_cast<int64_t>(IovBytes(iov, n)));
+  struct iovec win[kIovWindow];
+  int i = 0;
+  while (i < n) {
+    const int cnt = std::min(n - i, kIovWindow);
+    std::memcpy(win, iov + i, sizeof(struct iovec) * cnt);
+    if (!SendWindow(win, cnt, IovBytes(win, cnt))) return false;
+    i += cnt;
   }
   return true;
 }
 
-bool TcpConn::RecvAll(void* data, uint64_t len) {
-  char* p = static_cast<char*>(data);
-  MetricAdd(kCtrTcpRecvBytes, static_cast<int64_t>(len));
-  while (len > 0) {
-    ssize_t n = ::recv(fd_, p, len, 0);
-    if (n <= 0) {
-      if (n < 0 && errno == EINTR) continue;
-      return false;
+bool TcpConn::RecvV(const struct iovec* iov, int n) {
+  MetricAdd(kCtrTcpRecvBytes, static_cast<int64_t>(IovBytes(iov, n)));
+  struct iovec win[kIovWindow];
+  int i = 0;
+  while (i < n) {
+    const int cnt = std::min(n - i, kIovWindow);
+    std::memcpy(win, iov + i, sizeof(struct iovec) * cnt);
+    int j = 0;
+    while (j < cnt) {
+      // Skip empty spans BEFORE the syscall: recvmsg over a zero-byte
+      // window returns 0, which is indistinguishable from peer EOF.
+      while (j < cnt && win[j].iov_len == 0) ++j;
+      if (j == cnt) break;
+      msghdr msg{};
+      msg.msg_iov = win + j;
+      msg.msg_iovlen = static_cast<size_t>(cnt - j);
+      ssize_t got = ::recvmsg(fd_, &msg, 0);
+      if (got <= 0) {
+        if (got < 0 && errno == EINTR) continue;
+        return false;
+      }
+      MetricAdd(kCtrTcpRecvvCalls);
+      uint64_t left = static_cast<uint64_t>(got);
+      while (j < cnt && left >= win[j].iov_len) {
+        left -= win[j].iov_len;
+        ++j;
+      }
+      if (j < cnt && left > 0) {
+        win[j].iov_base = static_cast<char*>(win[j].iov_base) + left;
+        win[j].iov_len -= left;
+      }
     }
-    p += n;
-    len -= static_cast<uint64_t>(n);
+    i += cnt;
   }
   return true;
+}
+
+bool TcpConn::SendAll(const void* data, uint64_t len) {
+  struct iovec iov{const_cast<void*>(data), static_cast<size_t>(len)};
+  return SendV(&iov, 1);
+}
+
+bool TcpConn::RecvAll(void* data, uint64_t len) {
+  struct iovec iov{data, static_cast<size_t>(len)};
+  return RecvV(&iov, 1);
 }
 
 void TcpConn::SetRecvTimeout(int ms) {
@@ -135,8 +421,13 @@ bool SendRecv(TcpConn* to, const void* sbuf, uint64_t sbytes, TcpConn* from,
 }
 
 bool TcpConn::SendFrame(const void* data, uint64_t len) {
+  // Header and payload in ONE vectored syscall: the old two-send
+  // framing under TCP_NODELAY pushed an 8-byte segment per frame and
+  // doubled the syscall count of every control-plane message.
   uint64_t hdr = len;
-  return SendAll(&hdr, sizeof(hdr)) && (len == 0 || SendAll(data, len));
+  struct iovec iov[2] = {{&hdr, sizeof(hdr)},
+                         {const_cast<void*>(data), static_cast<size_t>(len)}};
+  return SendV(iov, len == 0 ? 1 : 2);
 }
 
 bool TcpConn::RecvFrame(std::string* out) {
@@ -294,10 +585,20 @@ bool DialOnce(const std::string& host, int port, int my_rank, int channel,
   sockaddr_in sa{};
   sa.sin_family = AF_INET;
   sa.sin_port = htons(static_cast<uint16_t>(port));
-  hostent* he = gethostbyname(host.c_str());
-  if (he != nullptr) {
-    std::memcpy(&sa.sin_addr, he->h_addr, he->h_length);
+  // getaddrinfo, not gethostbyname: the dial path runs concurrently
+  // with elastic rebootstrap threads, and gethostbyname's static
+  // result buffer is a data race the tsan tier would (rightly) flag.
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  if (getaddrinfo(host.c_str(), nullptr, &hints, &res) == 0 &&
+      res != nullptr) {
+    sa.sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+    freeaddrinfo(res);
   } else {
+    // Numeric fallback, preserving the old path's acceptance of the
+    // legacy inet_addr spellings (hex/octal quads).
     sa.sin_addr.s_addr = inet_addr(host.c_str());
   }
   int fd = ConnectWithTimeout(sa, timeout_ms);

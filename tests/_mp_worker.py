@@ -1062,6 +1062,62 @@ def main():
             "failed restart killed the running timeline"
         print(f"OK rank={r}")
 
+    elif scenario == "transport_digest":
+        # Vectored-transport parity probe (ISSUE 10): a cheap spread of
+        # ops across every TCP exchange engine (ring/hd/striped/
+        # doubling, fused group, fused allgather, broadcast), digests
+        # printed so the driver can compare HOROVOD_TCP_ZEROCOPY=off vs
+        # auto byte-for-byte. Integer-valued floats keep every sum
+        # exact, so the digests are also cross-rank identical.
+        import hashlib
+
+        digests = []
+        x = np.random.RandomState(100 + r).randint(
+            -50, 50, 700003).astype(np.float32)
+        for algo in ("ring", "hd", "striped", "doubling"):
+            out = np.asarray(hvd.allreduce(x.copy(), op=hvd.Sum,
+                                           name=f"td.{algo}",
+                                           algorithm=algo))
+            digests.append(f"{algo}:{hashlib.sha1(out.tobytes()).hexdigest()}")
+        ts = [np.full(4096, float(r + i), np.float32) for i in range(8)]
+        outs = hvd.grouped_allreduce(ts, op=hvd.Sum, name="td.grp")
+        digests.append("grp:" + hashlib.sha1(
+            b"".join(np.asarray(o).tobytes() for o in outs)).hexdigest())
+        # Fused allgather with ragged rows (async pair enqueued
+        # together so the coordinator fuses them): the vectored ring
+        # runs straight over the output spans — the zero-staging path.
+        ga = hvd.allgather_async(
+            np.full((r + 1, 3), float(r), np.float32), name="td.ag.a")
+        gb = hvd.allgather_async(
+            np.full((2 * r + 1, 5), float(10 + r), np.float32),
+            name="td.ag.b")
+        gs = [hvd.synchronize(ga), hvd.synchronize(gb)]
+        digests.append("ag:" + hashlib.sha1(
+            b"".join(np.asarray(g).tobytes() for g in gs)).hexdigest())
+        b = np.asarray(hvd.broadcast(
+            np.arange(3001, dtype=np.float32) + r, root_rank=s - 1,
+            name="td.bc"))
+        digests.append("bc:" + hashlib.sha1(b.tobytes()).hexdigest())
+        print("DIGEST " + "|".join(digests))
+        # Syscall accounting: the vectored layer must be live (sendv
+        # syscalls issued on the data plane) and coalescing must hold —
+        # bytes-per-send-syscall stays well above frame-header size.
+        m = hvd.metrics()
+        assert m["tcp_sendv_calls_total"] > 0, m
+        assert m["tcp_recvv_calls_total"] > 0, m
+        assert m["tcp_zerocopy_mode"] in (0, 1), m
+        if m["tcp_zerocopy_mode"] == 0:
+            assert m["tcp_zerocopy_sends_total"] == 0, m
+        # Floor well above frame-header size but with headroom for the
+        # idle coordination cycles' tiny frames (1 ms cadence): a
+        # regression to per-header sends would read ~30 B/syscall.
+        bytes_per_call = (m["tcp_send_bytes_total"]
+                          / m["tcp_sendv_calls_total"])
+        assert bytes_per_call > 512, (
+            f"sendv averaging {bytes_per_call:.0f} B/syscall — header-"
+            "sized sends are back")
+        print(f"BPC {bytes_per_call:.0f}")
+
     else:
         raise SystemExit(f"unknown scenario {scenario}")
 

@@ -185,3 +185,18 @@ def test_find_regressions_skips_persisted_regression_subtree():
     prev2 = {"extra": {"regression": {"m": 10.0}}}
     cur2 = {"extra": {"regression": {"m": 5.0}}}
     assert "extra.regression.m" in bench.find_regressions(prev2, cur2)
+
+
+def test_find_regressions_sendv_key_directions():
+    """ISSUE 10 transport keys: the vectored-transport busbw arm and
+    its bytes-per-syscall coalescing ratio are real higher-is-better
+    metrics (fewer, fatter syscalls is the win the zero-copy transport
+    is gated on); the transport-mode string rides along ungated."""
+    prev = {"extra": {"host_allreduce_busbw_sendv_gbps_np4": {
+        "16MB": 1.2, "transport": "vectored", "bytes_per_syscall": 60000}}}
+    cur = {"extra": {"host_allreduce_busbw_sendv_gbps_np4": {
+        "16MB": 0.6, "transport": "zerocopy", "bytes_per_syscall": 200}}}
+    regs = bench.find_regressions(prev, cur)
+    assert set(regs) == {
+        "extra.host_allreduce_busbw_sendv_gbps_np4.16MB",
+        "extra.host_allreduce_busbw_sendv_gbps_np4.bytes_per_syscall"}

@@ -64,8 +64,14 @@ def run_job(scenario: str, np_: int, timeout: int = 120, extra_env=None,
     return outs
 
 
-@pytest.mark.parametrize("np_", [2, 4])
-@pytest.mark.parametrize("plane", ["shm", "tcp"])
+# np=2 on the TCP plane moved to the slow tier (ISSUE 10 budget
+# headroom): transport_digest pins the whole np=2 TCP exchange surface
+# per-bit (ring/hd/striped/doubling + fused group + fused allgather +
+# broadcast, cross-rank digests), and the np=4 matrix covers every op's
+# semantics on the same plane — the np=2 matrix re-proves neither.
+@pytest.mark.parametrize("np_, plane", [
+    (2, "shm"), (4, "shm"), (4, "tcp"),
+    pytest.param(2, "tcp", marks=pytest.mark.slow)])
 def test_full_matrix(np_, plane):
     # Both host data planes stay covered: shm is the single-host
     # default; HOROVOD_SHM_DISABLE forces the TCP peer-mesh algorithms

@@ -64,6 +64,11 @@ SCENARIOS = [
     # the encoded-chunk cache, across hd/striped/doubling and every
     # codec, at the ragged np that exercises fold/unfold.
     ("algo_parity", 3, {"HOROVOD_SHM_DISABLE": "1"}),
+    # Vectored transport (ISSUE 10): SendV/RecvV windows + the coalesced
+    # per-peer span tables + the zero-staging allgather ring, with the
+    # buffer pool's first-touch ParallelFor racing the receiver threads'
+    # writes — the concurrency this tier exists to prove clean.
+    ("transport_digest", 2, {"HOROVOD_SHM_DISABLE": "1"}),
 ]
 
 _RUNTIME_LIB = {"tsan": "libtsan.so", "asan": "libasan.so",

@@ -1,0 +1,223 @@
+"""Vectored transport unit tests (ISSUE 10): drive the REAL
+TcpConn::SendV/RecvV/SendFrame/RecvFrame paths over Python-owned
+socketpairs through the ABI v8 entry points — split reads/writes,
+EINTR retries, iovec spans straddling frame boundaries, the syscall
+accounting, and the forced-fallback (HOROVOD_TCP_ZEROCOPY=off vs auto)
+byte-identity of a real np=2 job.
+
+The socketpair halves stay Python's (the native wrappers Detach before
+their TcpConn destructs), so every test is hermetic — no ports, no
+ranks, no controller."""
+
+import ctypes
+import signal
+import socket
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common.basics import get_lib
+from test_eager_multiprocess import run_job
+
+
+def _sendv(lib, fd, chunks):
+    n = len(chunks)
+    bufs = (ctypes.c_void_p * n)(
+        *[ctypes.cast(ctypes.c_char_p(c), ctypes.c_void_p) for c in chunks])
+    lens = (ctypes.c_uint64 * n)(*[len(c) for c in chunks])
+    return lib.hvd_tcp_sendv(fd, bufs, lens, n)
+
+
+def _recvv(lib, fd, sizes):
+    out = [ctypes.create_string_buffer(max(1, sz)) for sz in sizes]
+    bufs = (ctypes.c_void_p * len(sizes))(
+        *[ctypes.cast(b, ctypes.c_void_p) for b in out])
+    lens = (ctypes.c_uint64 * len(sizes))(*sizes)
+    ok = lib.hvd_tcp_recvv(fd, bufs, lens, len(sizes))
+    return ok, [b.raw[:sz] for b, sz in zip(out, sizes)]
+
+
+@pytest.fixture
+def pair():
+    a, b = socket.socketpair()
+    yield a, b
+    a.close()
+    b.close()
+
+
+def test_transport_mode_resolved_and_named():
+    lib = get_lib()
+    mode = lib.hvd_tcp_transport_mode()
+    assert mode in (0, 1)
+    name = lib.hvd_tcp_transport_mode_name().decode()
+    assert name == ("zerocopy" if mode == 1 else "vectored")
+    # This container runs a 4.4 kernel: SO_ZEROCOPY (4.14+) must probe
+    # out and the transport must have fallen back cleanly. If this box
+    # ever upgrades, the assert documents the expectation to revisit.
+    assert name == "vectored"
+
+
+def test_sendv_recvv_roundtrip_multi_iovec(pair):
+    lib = get_lib()
+    a, b = pair
+    chunks = [bytes([i]) * (i * 37 + 1) for i in range(20)]
+    assert _sendv(lib, a.fileno(), chunks) == 1
+    ok, got = _recvv(lib, b.fileno(), [len(c) for c in chunks])
+    assert ok == 1
+    assert got == chunks
+
+
+def test_sendv_recvv_zero_length_spans(pair):
+    """Zero-length spans are legal anywhere in the list (empty chunks
+    exist in ragged schedules) and must not be mistaken for EOF."""
+    lib = get_lib()
+    a, b = pair
+    chunks = [b"", b"alpha", b"", b"", b"beta", b""]
+    assert _sendv(lib, a.fileno(), chunks) == 1
+    ok, got = _recvv(lib, b.fileno(), [0, 5, 0, 0, 4, 0])
+    assert ok == 1
+    assert b"".join(got) == b"alphabeta"
+
+
+def test_sendv_split_reads_and_window_straddle(pair):
+    """A payload far beyond the socket buffers, spread over more spans
+    than one iovec window (64): the writer must make progress through
+    partial writev returns while the reader drains in odd-sized RecvV
+    span lists that do NOT align with the sender's spans."""
+    lib = get_lib()
+    a, b = pair
+    a.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 8192)
+    b.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 8192)
+    rng = np.random.RandomState(7)
+    payload = rng.bytes(777777)
+    # 150 unequal spans (> 2 windows), byte content position-dependent.
+    cuts = sorted(rng.choice(len(payload) - 1, 149, replace=False) + 1)
+    chunks = [payload[i:j] for i, j in
+              zip([0] + list(cuts), list(cuts) + [len(payload)])]
+    send_ok = []
+    t = threading.Thread(
+        target=lambda: send_ok.append(_sendv(lib, a.fileno(), chunks)))
+    t.start()
+    # Reader: mismatched span sizes, several RecvV calls.
+    got = b""
+    sizes = [100001, 1, 65536, 300000, 0, 312239]
+    ok, parts = _recvv(lib, b.fileno(), sizes)
+    assert ok == 1
+    got = b"".join(parts)
+    t.join()
+    assert send_ok == [1]
+    assert got == payload
+
+
+def test_sendv_survives_eintr(pair):
+    """A repeating interval timer peppers the blocking sendmsg/recvmsg
+    with EINTR; the windowed loops must retry, not fail. (Python
+    installs handlers without SA_RESTART, so the syscalls really do
+    return EINTR here.)"""
+    lib = get_lib()
+    a, b = pair
+    a.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 8192)
+    payload = np.random.RandomState(3).bytes(2 * 1024 * 1024)
+    fired = []
+    old = signal.signal(signal.SIGALRM, lambda *args: fired.append(1))
+    signal.setitimer(signal.ITIMER_REAL, 0.005, 0.005)
+    try:
+        recv_res = []
+        t = threading.Thread(target=lambda: recv_res.append(
+            _recvv(lib, b.fileno(), [len(payload)])))
+        t.start()
+        # Main thread blocks inside the native sendmsg loop — signals
+        # are delivered to this thread, so EINTR lands on the sender.
+        assert _sendv(lib, a.fileno(), [payload]) == 1
+        t.join()
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0, 0)
+        signal.signal(signal.SIGALRM, old)
+    ok, parts = recv_res[0]
+    assert ok == 1 and parts[0] == payload
+
+
+def test_frames_straddling_one_sendv(pair):
+    """Two complete frames (header|payload|header|payload) shipped as
+    ONE 4-span SendV must parse as two intact RecvFrames — the iovec
+    boundary is invisible to the framing."""
+    lib = get_lib()
+    a, b = pair
+    p1, p2 = b"x" * 3000, b"y" * 17
+    chunks = [struct.pack("<Q", len(p1)), p1,
+              struct.pack("<Q", len(p2)), p2]
+    assert _sendv(lib, a.fileno(), chunks) == 1
+    for want in (p1, p2):
+        buf = ctypes.create_string_buffer(len(want))
+        got = lib.hvd_tcp_recv_frame(b.fileno(), buf, len(want))
+        assert got == len(want)
+        assert buf.raw == want
+
+
+def test_send_frame_is_one_syscall(pair):
+    """The satellite pin: SendFrame used to issue two send() syscalls
+    (header, then payload). Through the vectored layer one small frame
+    is exactly ONE sendv syscall — measured by the counter delta."""
+    lib = get_lib()
+    a, b = pair
+    lib.hvd_metrics_reset()
+    payload = b"z" * 4096  # well under any socket buffer: no partials
+    assert lib.hvd_tcp_send_frame(a.fileno(), payload, len(payload)) == 1
+    snap = _snapshot_counters(lib)
+    assert snap["tcp_sendv_calls_total"] == 1, snap
+    assert snap["tcp_send_bytes_total"] == len(payload) + 8, snap
+    buf = ctypes.create_string_buffer(len(payload))
+    assert lib.hvd_tcp_recv_frame(b.fileno(), buf, len(payload)) == \
+        len(payload)
+    assert buf.raw == payload
+
+
+def _snapshot_counters(lib):
+    needed = lib.hvd_metrics_snapshot(None, 0)
+    raw = (ctypes.c_int64 * needed)()
+    lib.hvd_metrics_snapshot(raw, needed)
+    nc = raw[1]
+    return {lib.hvd_metrics_counter_name(i).decode(): raw[4 + i]
+            for i in range(nc)}
+
+
+def test_recv_frame_rejects_oversized_header(pair):
+    lib = get_lib()
+    a, b = pair
+    a.sendall(struct.pack("<Q", 1 << 41))  # beyond the sanity cap
+    buf = ctypes.create_string_buffer(8)
+    assert lib.hvd_tcp_recv_frame(b.fileno(), buf, 8) == -1
+
+
+def _digest_lines(outs):
+    lines = []
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("DIGEST "):
+                lines.append(line)
+    return lines
+
+
+def test_forced_fallback_is_byte_identical():
+    """HOROVOD_TCP_ZEROCOPY=off vs auto: same ops, byte-identical
+    results across every TCP exchange engine — the knob may change
+    syscalls, never bytes. (On this 4.4 kernel both resolve to the
+    vectored path, so this doubles as the clean-fallback gate.) The
+    auto arm feeds the knob a TYPO instead of the literal "auto":
+    the sane-env discipline maps garbage to the default with a
+    warning, so one job pins fallback identity AND garbage handling
+    (two np=2 spawns instead of three — tier-1 budget). The scenario
+    also asserts the syscall accounting internally: sendv/recvv live,
+    bytes-per-syscall far above header size."""
+    base = {"HOROVOD_SHM_DISABLE": "1"}
+    off = run_job("transport_digest", 2, timeout=150,
+                  extra_env={**base, "HOROVOD_TCP_ZEROCOPY": "off"})
+    auto = run_job("transport_digest", 2, timeout=150,
+                   extra_env={**base, "HOROVOD_TCP_ZEROCOPY": "definitely"})
+    d_off, d_auto = _digest_lines(off), _digest_lines(auto)
+    assert d_off and len(d_off) == 2 and len(set(d_off)) == 1, d_off
+    assert d_auto == d_off, (d_off, d_auto)
+    # The typo'd knob warned (once, on the rank that resolved it).
+    assert any("HOROVOD_TCP_ZEROCOPY" in out for out in auto), auto
