@@ -13,6 +13,11 @@ CPU smoke (no accelerator needed):
 
 Split prefill/decode pools:
   JAX_PLATFORMS=cpu python examples/serve_fleet.py --tiny --prefill 1
+
+Cross-process fleet (each replica a spawned ``bin/hvd-serve-worker``
+process behind the RPC seam; add --kv-compression bf16 to halve
+KV-handoff bytes on a split fleet):
+  JAX_PLATFORMS=cpu python examples/serve_fleet.py --tiny --cross-process
 """
 
 import argparse
@@ -37,6 +42,14 @@ def main():
     ap.add_argument("--shed-demo", action="store_true",
                     help="also demo deadline-class shedding through a "
                          "deliberately tiny router queue")
+    ap.add_argument("--cross-process", action="store_true",
+                    help="spawn each replica as a bin/hvd-serve-worker "
+                         "process and route to it over the RPC seam "
+                         "(docs/serving.md 'Cross-process fleet')")
+    ap.add_argument("--kv-compression", default=None,
+                    choices=[None, "bf16", "fp16"],
+                    help="wire codec for KV pages on cross-process "
+                         "handoffs (bf16 halves migration bytes)")
     ap.add_argument("--tiny", action="store_true",
                     help="2-layer d=64 model (CPU smoke)")
     ap.add_argument("--platform", default=None, choices=[None, "cpu", "tpu"])
@@ -59,7 +72,10 @@ def main():
                              n_heads=8, n_kv_heads=4, d_ff=1376,
                              max_seq=1024, dtype=jnp.bfloat16,
                              remat=False))
-    params = init_transformer(cfg, jax.random.PRNGKey(0))
+    # Workers rebuild the same params from (config, seed 0); the
+    # router only needs them materialized for in-process replicas.
+    params = (None if args.cross_process
+              else init_transformer(cfg, jax.random.PRNGKey(0)))
 
     trace = make_multi_tenant_trace(
         args.requests, seed=0, n_tenants=args.tenants, prefix_len=16,
@@ -68,12 +84,21 @@ def main():
     serve_cfg = ServeConfig(
         max_batch=4, max_queue=max(args.requests, 8), block_size=8,
         max_prompt=max_prompt, max_new_tokens=args.max_new)
+    workers = []
+    if args.cross_process:
+        from horovod_tpu.serve import spawn_worker
+        print(f"spawning {args.replicas} hvd-serve-worker processes...")
+        workers = [spawn_worker(via_bin=True)
+                   for _ in range(args.replicas)]
+        for w in workers:
+            print(f"  worker pid={w.proc.pid} port={w.port}")
     router = ServeRouter(
         cfg, params,
         RouterConfig(n_replicas=args.replicas, n_prefill=args.prefill,
                      max_queue=max(args.requests, 8),
-                     placement=args.placement),
-        serve_cfg)
+                     placement=args.placement,
+                     handoff_compression=args.kv_compression),
+        serve_cfg, workers=workers or None, worker_seed=0)
 
     rids = [router.submit(p, n) for p, n in trace]
     router.run_until_idle()
@@ -102,8 +127,20 @@ def main():
                                 "placed_fallback", "handoffs",
                                 "requests_finished")})
 
+    if args.cross_process:
+        wire = sum(w.conn.span_wire_bytes for w in workers)
+        raw = sum(w.conn.span_raw_bytes for w in workers)
+        rpcs = sum(w.conn.msgs_sent for w in workers)
+        print(f"rpc plane: {rpcs} calls, heartbeats="
+              f"{snap['heartbeats']}, kv bytes {wire}/{raw} wire/raw"
+              + (f" ({100 * (raw - wire) / raw:.0f}% saved)"
+                 if raw > wire else ""))
+        router.close()
+
     if args.shed_demo:
         print("\n-- shedding demo (router queue cap 2) --")
+        if params is None:
+            params = init_transformer(cfg, jax.random.PRNGKey(0))
         shed_router = ServeRouter(
             cfg, params,
             RouterConfig(n_replicas=1, max_queue=2), serve_cfg)

@@ -8,7 +8,13 @@ paged KV cache (:mod:`horovod_tpu.serve.kv_cache`) on the same
 latency through :mod:`horovod_tpu.serve.metrics`. Above the single
 engine, :mod:`horovod_tpu.serve.router` runs a fleet: N replicas
 behind a cache-affinity admission router with prefill/decode pools
-(KV handoff) and deadline-class load shedding.
+(KV handoff) and deadline-class load shedding. The fleet spans
+processes: :mod:`horovod_tpu.serve.rpc` lifts the engine seam onto a
+length-prefixed RPC framing over the native vectored TCP transport,
+:mod:`horovod_tpu.serve.worker` runs one engine per worker process,
+and the router drives local and remote replicas identically
+(heartbeat liveness, dead-worker requeue, drains that migrate RUNNING
+decodes).
 
 Quick start::
 
@@ -50,6 +56,17 @@ from horovod_tpu.serve.router import (  # noqa: F401
     FleetSaturated,
     RouterConfig,
     ServeRouter,
+)
+from horovod_tpu.serve.rpc import (  # noqa: F401
+    RPC_PROTOCOL_VERSION,
+    RemoteReplica,
+    RpcConn,
+    RpcConnectionError,
+    RpcError,
+    RpcProtocolError,
+    WorkerHandle,
+    connect_worker,
+    spawn_worker,
 )
 from horovod_tpu.serve.bench import (  # noqa: F401
     make_multi_tenant_trace,

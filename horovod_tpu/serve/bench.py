@@ -326,19 +326,30 @@ def run_prefix_benchmark(n_requests: int = 32, *, seed: int = 0,
 
 def _run_router_pass(model_cfg, params, trace, *, placement: str,
                      n_replicas: int, n_prefill: int, serve_cfg,
-                     seed: int) -> dict:
+                     seed: int, workers=None,
+                     handoff_compression=None) -> dict:
     """One cold-fleet pass: fresh router (empty caches, reset
     placement state) over the whole trace. Freshness is the point —
     the routed-vs-random claim is about where PLACEMENT puts the
     first prefill of each tenant prefix, which a warm cache would
     erase. The jitted programs are memoized on the shared geometry,
-    so only the first-ever pass pays compiles."""
+    so only the first-ever pass pays compiles.
+
+    ``workers`` lifts the pass cross-process: the same spawned worker
+    handles are re-configured into a fresh fleet each pass (cold KV
+    pools, warm per-process jit caches — the cross-process twin of the
+    memo), and the spans moved over RPC are tallied into
+    ``handoff_wire_bytes`` / ``handoff_raw_bytes`` deltas."""
     from horovod_tpu.serve.router import RouterConfig, ServeRouter
 
     rc = RouterConfig(n_replicas=n_replicas, n_prefill=n_prefill,
                       max_queue=max(len(trace), 8),
-                      placement=placement, seed=seed)
-    router = ServeRouter(model_cfg, params, rc, serve_cfg)
+                      placement=placement, seed=seed,
+                      handoff_compression=handoff_compression)
+    router = ServeRouter(model_cfg, None if workers else params, rc,
+                         serve_cfg, workers=workers, worker_seed=0)
+    wire0 = sum(w.conn.span_wire_bytes for w in workers or [])
+    raw0 = sum(w.conn.span_raw_bytes for w in workers or [])
     t0 = time.perf_counter()
     rids = [router.submit(p, n) for p, n in trace]
     router.run_until_idle()
@@ -354,6 +365,10 @@ def _run_router_pass(model_cfg, params, trace, *, placement: str,
         "handoffs": snap["handoffs"],
         "first_token_s": [x for e in router.engines
                           for x in e.metrics.first_token_s],
+        "handoff_wire_bytes":
+            sum(w.conn.span_wire_bytes for w in workers or []) - wire0,
+        "handoff_raw_bytes":
+            sum(w.conn.span_raw_bytes for w in workers or []) - raw0,
         "_tokens": streams,
     }
 
@@ -362,7 +377,8 @@ def run_router_benchmark(n_requests: int = 48, *, seed: int = 0,
                          model_cfg=None, n_replicas: int = 4,
                          max_batch: int = 4, block_size: int = 8,
                          n_tenants: int = 8, prefix_len: int = 32,
-                         warmup: bool = True, repeats: int = 3) -> dict:
+                         warmup: bool = True, repeats: int = 3,
+                         cross_process: bool = False) -> dict:
     """The fleet-router claim: on a multi-tenant shared-prefix trace
     replayed at ``n_replicas`` replicas, cache-affinity placement
     beats random placement on prefix hit rate AND p99 first-token
@@ -375,7 +391,15 @@ def run_router_benchmark(n_requests: int = 48, *, seed: int = 0,
     round-robin per the +-30% drift protocol (docs/perf_tuning.md);
     throughput keys take the best pass, latency tails pool samples
     across every pass of an arm, hit rates pool token counts (they
-    are deterministic per arm up to admission timing)."""
+    are deterministic per arm up to admission timing).
+
+    ``cross_process=True`` adds the RPC arm (ISSUE 11): the same
+    routed fleet with every replica a spawned worker process,
+    interleaved with the in-process passes so the reported
+    ``serve_router_rpc_over_inproc`` ratio — the RPC tax — compares
+    like weather with like. A split cross-process pass with bf16 KV
+    encoding additionally reports the handoff bytes the codec saves
+    (``serve_router_rpc_handoff_bytes_saved_pct``)."""
     import jax
     import jax.numpy as jnp
 
@@ -423,12 +447,37 @@ def run_router_benchmark(n_requests: int = 48, *, seed: int = 0,
             n_replicas=n_replicas, n_prefill=0, serve_cfg=serve_cfg,
             seed=seed)
 
-    if warmup:
-        routed_pass()          # compiles every bucket once
-    passes = {"routed": [], "random": []}
-    for _ in range(max(repeats, 1)):
-        passes["routed"].append(routed_pass())
-        passes["random"].append(random_pass())
+    handles = []
+    if cross_process:
+        from horovod_tpu.serve.rpc import spawn_worker
+        handles = [spawn_worker() for _ in range(n_replicas)]
+
+    def rpc_pass(n_prefill=0, compression=None):
+        return _run_router_pass(
+            model_cfg, params, trace, placement="affinity",
+            n_replicas=n_replicas, n_prefill=n_prefill,
+            serve_cfg=serve_cfg, seed=seed, workers=handles,
+            handoff_compression=compression)
+
+    try:
+        if warmup:
+            routed_pass()      # compiles every bucket once
+            if cross_process:
+                rpc_pass()     # ...and once per worker process
+        passes = {"routed": [], "random": []}
+        if cross_process:
+            passes["rpc"] = []
+        for _ in range(max(repeats, 1)):
+            passes["routed"].append(routed_pass())
+            passes["random"].append(random_pass())
+            if cross_process:
+                passes["rpc"].append(rpc_pass())
+        rpc_split = (rpc_pass(n_prefill=max(n_replicas // 2, 1),
+                              compression="bf16")
+                     if cross_process else None)
+    finally:
+        for h in handles:
+            h.close()
 
     # Parity arms (structural, untimed): a single replica on the same
     # trace, and a split prefill/decode fleet exercising the handoff.
@@ -457,7 +506,33 @@ def run_router_benchmark(n_requests: int = 48, *, seed: int = 0,
              if best["random"]["tokens_per_sec_wall"] else None)
     identical = all(s["_tokens"] == ref
                     for ps in passes.values() for s in ps)
+    rpc_keys = {}
+    if cross_process:
+        # The RPC tax: best cross-process pass over best in-process
+        # pass, same trace, interleaved rounds. The bf16 split pass is
+        # LOSSY (excluded from the parity key by design — its own
+        # determinism is pinned in tests/test_rpc.py); it reports the
+        # migration bytes the codec saves.
+        tax = (best["rpc"]["tokens_per_sec_wall"]
+               / best["routed"]["tokens_per_sec_wall"]
+               if best["routed"]["tokens_per_sec_wall"] else None)
+        raw = rpc_split["handoff_raw_bytes"]
+        rpc_keys = {
+            "serve_router_rpc_tokens_per_sec_per_chip":
+                round(best["rpc"]["tokens_per_sec_wall"] / n_dev, 2),
+            "serve_router_rpc_over_inproc":
+                None if tax is None else round(tax, 3),
+            "serve_router_rpc_p99_first_token_ms":
+                agg["rpc"]["p99_first_ms"],
+            "serve_router_rpc_tokens_identical":
+                all(s["_tokens"] == ref for s in passes["rpc"]),
+            "serve_router_rpc_handoff_count": rpc_split["handoffs"],
+            "serve_router_rpc_handoff_bytes_saved_pct":
+                (round(100.0 * (raw - rpc_split["handoff_wire_bytes"])
+                       / raw, 2) if raw else None),
+        }
     return {
+        **rpc_keys,
         "serve_router_tokens_per_sec_per_chip":
             round(best["routed"]["tokens_per_sec_wall"] / n_dev, 2),
         "serve_router_random_tokens_per_sec_per_chip":

@@ -207,25 +207,35 @@ class _Seq:
 
 @dataclasses.dataclass
 class PrefillHandoff:
-    """A completed prefill packaged for a decode replica: the prompt's
-    K/V pages as host copies plus the request state needed to continue
-    decoding elsewhere. The pages are bitwise copies and the decode
-    math is position-dependent only, so a handed-off sequence decodes
-    to exactly the tokens it would have produced in place."""
+    """A sequence packaged for another replica: its K/V pages as host
+    copies plus the request state needed to continue decoding
+    elsewhere. The pages are bitwise copies and the decode math is
+    position-dependent only, so a handed-off sequence decodes to
+    exactly the tokens it would have produced in place.
+
+    Two producers share this shape: :meth:`ServeEngine.export_prefilled`
+    (a completed prefill leaving a prefill-pool replica — ``n_cached``
+    == prompt length, ``generated`` == the one prefill-emitted token)
+    and :meth:`ServeEngine.export_running` (a mid-decode sequence
+    leaving a draining replica — ``n_cached`` covers every token whose
+    K/V is in the pages, ``generated`` everything emitted so far). The
+    consumer is one :meth:`ServeEngine.inject_prefilled` either way.
+    """
 
     prompt: List[int]
     max_new: int
-    generated: List[int]         # [first_token] — prefill emits it
+    generated: List[int]         # tokens emitted so far (>= 1)
     submitted_at: float
     first_token_at: float
     deadline_class: int
     chain: List[bytes]           # content-hash chain (may be empty)
-    k_pages: Any                 # [L, n_prompt_blocks, bs, Hkv, Dh]
+    k_pages: Any                 # [L, n_pages, bs, Hkv, Dh]
     v_pages: Any
     block_size: int
+    n_cached: int                # tokens covered by the pages
 
     @property
-    def n_prompt_blocks(self) -> int:
+    def n_pages(self) -> int:
         return int(self.k_pages.shape[1])
 
 
@@ -342,6 +352,14 @@ class ServeEngine:
                     f"prefill_chunk {cfg.prefill_chunk} must be a "
                     f"positive multiple of block_size {bs}")
             pick_bucket(cfg.prefill_chunk, self._prefill_buckets)
+
+        # Inject pad-width menu, in BLOCK units: the prefill buckets
+        # (prompt-only handoffs keep their existing programs) plus the
+        # full table width (a migrated RUNNING sequence may carry
+        # prompt+generated pages beyond the largest prompt bucket).
+        self._inject_widths = tuple(sorted(
+            {b // bs for b in self._prefill_buckets}
+            | {self._table_width}))
 
         n_blocks = cfg.n_blocks
         if n_blocks is None:
@@ -748,7 +766,14 @@ class ServeEngine:
         package a decode replica feeds to :meth:`inject_prefilled`.
         The page copy is bitwise, so the handoff changes *where*
         decode runs, never *what* it computes."""
-        seq = self._handoff.pop(rid)
+        return self._export_seq(self._handoff.pop(rid))
+
+    def _export_seq(self, seq: _Seq) -> PrefillHandoff:
+        """Package ``seq`` for another replica: bitwise page copies of
+        every block its cached tokens touch (the partial tail block
+        rides whole — its bytes past ``n_cached`` are never attended
+        to, the same null-padding contract decode relies on), then
+        free the local reservation."""
         n_blk = self.allocator.blocks_for_tokens(seq.n_cached)
         idx = np.asarray(seq.blocks[:n_blk], np.int32)
         k_pages = np.asarray(self.cache.k[:, idx])
@@ -762,13 +787,42 @@ class ServeEngine:
             first_token_at=seq.first_token_at,
             deadline_class=seq.deadline_class, chain=list(seq.chain),
             k_pages=k_pages, v_pages=v_pages,
-            block_size=self.cfg.block_size)
+            block_size=self.cfg.block_size, n_cached=seq.n_cached)
+
+    def running_exportable(self) -> List[int]:
+        """rids of RUNNING (decoding) sequences a drain could migrate
+        right now: active, prefill complete, and not already finished
+        (a finished-but-unretired sequence must retire HERE — exporting
+        it would decode it past its cap on the target)."""
+        return [s.rid for s in self._active
+                if not s.finished(self.cfg.eos_id)]
+
+    def export_running(self, rid: int) -> PrefillHandoff:
+        """Pop a RUNNING sequence mid-decode and package it for
+        :meth:`inject_prefilled` on another replica — the migrating
+        half of a drain. Everything the sequence has computed (prompt
+        AND generated-token K/V) moves bitwise, so the remaining
+        tokens decode to exactly what they would have been in place."""
+        for i, seq in enumerate(self._active):
+            if seq.rid == rid:
+                break
+        else:
+            raise KeyError(f"no running sequence {rid}")
+        if seq.finished(self.cfg.eos_id):
+            raise ValueError(
+                f"sequence {rid} already finished — retire it here "
+                "instead of migrating it")
+        del self._active[i]
+        return self._export_seq(seq)
 
     def inject_prefilled(self, h: PrefillHandoff) -> int:
         """Admit a handed-off sequence straight into the decode batch:
-        reserve its worst-case blocks, scatter the prompt pages into
-        this replica's pool, and decode from the already-emitted first
-        token. Raises :class:`QueueFull` (no batch slot) or
+        reserve its worst-case blocks, scatter its pages into this
+        replica's pool, and decode onward from the last emitted token.
+        The handoff may be a completed prefill (pool split) or a
+        mid-decode RUNNING sequence (migrating drain) — ``n_cached``
+        says how many tokens the pages cover either way. Raises
+        :class:`QueueFull` (no batch slot) or
         :class:`~horovod_tpu.serve.kv_cache.OutOfBlocks` — the router
         checks :meth:`admission_snapshot` capacity first, so hitting
         either here is a router bug, not backpressure."""
@@ -777,24 +831,34 @@ class ServeEngine:
                 f"handoff block_size {h.block_size} != engine "
                 f"block_size {self.cfg.block_size} — replicas must "
                 "share geometry for pages to map block-for-block")
+        plen = len(h.prompt)
+        if not (plen <= h.n_cached <= plen + h.max_new
+                and h.generated
+                and h.n_cached == plen + len(h.generated) - 1):
+            raise ValueError(
+                f"inconsistent handoff: n_cached={h.n_cached} "
+                f"prompt={plen} generated={len(h.generated)}")
         if len(self._active) + len(self._prefilling) >= self.cfg.max_batch:
             raise QueueFull("no batch slot for handoff",
                             reason="no_batch_slot",
                             retry_after_s=self._retry_after())
-        plen = len(h.prompt)
         need = self.allocator.blocks_for_tokens(plen + h.max_new)
         blocks = self.allocator.alloc(need)
-        # Jitted donated scatter: pages land in place, O(prompt
+        # Jitted donated scatter: pages land in place, O(carried
         # pages), never a full-pool copy. The pad width rides the
-        # SAME prefill bucket menu as every other serve shape (one
-        # compiled program per bucket, and the device transfer stays
-        # proportional to the prompt, not to table_width worst case);
-        # NULL_BLOCK targets + zero pages for the padding rows —
-        # written garbage on the null block is never read, the
-        # prefill bucket-padding contract.
-        n_page = h.n_prompt_blocks
-        bs = self.cfg.block_size
-        width = pick_bucket(n_page * bs, self._prefill_buckets) // bs
+        # prefill bucket menu extended by table_width (a migrated
+        # RUNNING sequence can exceed the largest prompt bucket): one
+        # compiled program per width, device transfer proportional to
+        # the carried pages, NULL_BLOCK targets + zero pages for the
+        # padding rows — written garbage on the null block is never
+        # read, the prefill bucket-padding contract.
+        n_page = h.n_pages
+        if n_page != self.allocator.blocks_for_tokens(h.n_cached):
+            raise ValueError(
+                f"handoff carries {n_page} pages but n_cached="
+                f"{h.n_cached} needs "
+                f"{self.allocator.blocks_for_tokens(h.n_cached)}")
+        width = pick_bucket(n_page, self._inject_widths)
         idx = np.full(width, 0, np.int32)               # NULL_BLOCK
         idx[:n_page] = blocks[:n_page]
         shape = (h.k_pages.shape[0], width) + h.k_pages.shape[2:]
@@ -809,7 +873,7 @@ class ServeEngine:
         rid = next(self._rids)
         seq = _Seq(
             rid=rid, prompt=list(h.prompt), max_new=h.max_new,
-            blocks=blocks, table=table, n_cached=plen,
+            blocks=blocks, table=table, n_cached=h.n_cached,
             generated=list(h.generated), submitted_at=h.submitted_at,
             chain=list(h.chain), registered=0,
             deadline_class=h.deadline_class)

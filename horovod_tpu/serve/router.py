@@ -54,9 +54,18 @@ Replica membership is elastic: :meth:`ServeRouter.add_replica` joins a
 fresh engine (sharing the fleet's jitted programs — same geometry, one
 compile), :meth:`ServeRouter.remove_replica` drains one (queued work
 is withdrawn and requeued at the router, in-flight sequences decode to
-completion, then the replica drops out). No request is ever dropped or
+completion — or, with ``migrate_running=True``, are exported mid-decode
+and injected into peers, bitwise). No request is ever dropped or
 duplicated across membership changes — the randomized property test
 drives exactly that.
+
+The fleet spans processes (ISSUE 11): pass ``workers=`` (handles from
+:func:`horovod_tpu.serve.rpc.spawn_worker`) and every replica becomes
+a :class:`~horovod_tpu.serve.rpc.RemoteReplica` — the same engine seam
+over the RPC plane, driven by the identical placement/pool/shedding/
+drain code. Liveness is the transport plus a heartbeat sweep; a dead
+worker's uncollected requests requeue at the queue front and resolve
+exactly once on survivors. See docs/serving.md "Cross-process fleet".
 
 Everything is deterministic for a fixed seed: FIFO placement order,
 tie-breaks by replica id, and the only randomness (the random
@@ -85,6 +94,11 @@ from horovod_tpu.serve.metrics import MAX_SAMPLES, percentile
 #: Stale hints are harmless — the live per-replica index walk is the
 #: ground truth, the hint only pre-groups same-prefix bursts.
 CHAIN_INDEX_CAP = 65536
+
+
+def _codec_id(name) -> int:
+    from horovod_tpu.serve.rpc import span_codec_id
+    return span_codec_id(name)
 
 
 class FleetSaturated(QueueFull):
@@ -118,6 +132,22 @@ class RouterConfig:
     # "random" / "round_robin" = benchmark baselines.
     placement: str = "affinity"
     seed: int = 0                # drives the random-placement baseline
+    # -- cross-process fleet knobs (docs/serving.md) -----------------
+    # Seconds between liveness heartbeats to a remote replica the step
+    # loop would not otherwise talk to. 0 = every step (freshest
+    # metrics cache; fine on loopback), raise it on real networks.
+    heartbeat_every: float = 0.0
+    # Wire codec for K/V pages on RPC handoffs: None | "bf16" | "fp16"
+    # (the PR 9 cast codecs; bf16 halves migration bytes with the
+    # bitwise-pinned decode). Lossy for f32 pools — streams stay
+    # deterministic but are the bf16-rounded ones; leave None when the
+    # cross-process fleet must be bitwise the in-process one.
+    handoff_compression: Optional[str] = None
+    # SO_RCVTIMEO/SO_SNDTIMEO on worker connections: a worker that
+    # stops answering for this long is declared dead (requeue +
+    # failover). Generous default — the first step against a fresh
+    # worker pays jit compiles.
+    rpc_timeout: float = 300.0
 
     def __post_init__(self):
         if self.n_replicas < 1:
@@ -129,6 +159,12 @@ class RouterConfig:
         if self.placement not in ("affinity", "least", "random",
                                   "round_robin"):
             raise ValueError(f"unknown placement {self.placement!r}")
+        if self.heartbeat_every < 0:
+            raise ValueError(
+                f"heartbeat_every {self.heartbeat_every} < 0")
+        # Fail on garbage at config time, not mid-handoff.
+        from horovod_tpu.serve.rpc import span_codec_id
+        span_codec_id(self.handoff_compression)
 
 
 @dataclasses.dataclass
@@ -149,8 +185,10 @@ class _Pending:
 class _Replica:
     instance: str
     role: str                    # "unified" | "prefill" | "decode"
-    engine: ServeEngine
+    engine: Any                  # ServeEngine | rpc.RemoteReplica
     draining: bool = False
+    remote: bool = False         # engine lives in a worker process
+    migrate: bool = False        # drain moves RUNNING decodes out too
     # engine rid -> router rid, for every request placed here whose
     # result has not been collected yet.
     outstanding: Dict[int, int] = dataclasses.field(default_factory=dict)
@@ -192,6 +230,13 @@ class FleetMetrics:
         self.shed_by_class: Dict[int, int] = {}
         self.expired_total = 0
         self.handoffs = 0
+        # Cross-process fleet health (docs/observability.md rows):
+        self.heartbeats = 0          # liveness/metrics probes sent
+        self.worker_deaths = 0       # replicas declared dead (RPC fail)
+        self.requeued_total = 0      # requests requeued off dead/failed
+        #                              replicas (each still resolves
+        #                              exactly once)
+        self.migrations = 0          # RUNNING decodes moved by a drain
         self._retired: Dict[str, float] = {}   # absorbed counters
         # Absorbed latency samples (same MAX_SAMPLES cap as the live
         # series): without them the fleet p99 would silently IMPROVE
@@ -240,6 +285,10 @@ class FleetMetrics:
             "shed_total": self.shed_total,
             "expired_total": self.expired_total,
             "handoffs": self.handoffs,
+            "heartbeats": self.heartbeats,
+            "worker_deaths": self.worker_deaths,
+            "requeued_total": self.requeued_total,
+            "migrations": self.migrations,
         }
         for c, n in sorted(self.shed_by_class.items()):
             out[f"shed_class_{c}"] = n
@@ -288,13 +337,25 @@ class ServeRouter:
     def __init__(self, model_cfg, params,
                  router_cfg: Optional[RouterConfig] = None,
                  serve_cfg: Optional[ServeConfig] = None,
-                 mesh: Optional[Any] = None, clock=time.perf_counter):
+                 mesh: Optional[Any] = None, clock=time.perf_counter,
+                 workers: Optional[Sequence[Any]] = None,
+                 worker_seed: int = 0):
+        """``workers`` lifts the fleet across processes: a sequence of
+        ``rpc.WorkerHandle`` (from ``rpc.spawn_worker`` /
+        ``rpc.connect_worker``), one per replica — each is configured
+        with this fleet's model/serve geometry and builds its params
+        as ``init_transformer(model_cfg, PRNGKey(worker_seed))``, so
+        ``params`` here must equal that (pass ``params=None`` for an
+        all-remote fleet; it is only used to build in-process
+        engines). With ``workers=None`` every replica is in-process —
+        the pre-RPC behavior, byte for byte."""
         self.cfg = router_cfg or RouterConfig()
         self._model_cfg = model_cfg
         self._params = params
         self._serve_cfg = serve_cfg or ServeConfig()
         self._mesh = mesh
         self._clock = clock
+        self._worker_seed = worker_seed
         self._rng = np.random.RandomState(self.cfg.seed)
         self._rr = 0                 # round_robin cursor
         self._replicas: List[_Replica] = []
@@ -313,14 +374,20 @@ class ServeRouter:
         #: in placement order — the determinism probe the property
         #: test replays. Capped like every other unbounded series.
         self.placement_log: List[Tuple[int, str, int]] = []
+        workers = list(workers or [])
+        if workers and len(workers) != self.cfg.n_replicas:
+            raise ValueError(
+                f"{len(workers)} workers for n_replicas="
+                f"{self.cfg.n_replicas}; pass one handle per replica")
         for i in range(self.cfg.n_replicas):
             role = ("prefill" if i < self.cfg.n_prefill else
                     "decode" if self.cfg.n_prefill else "unified")
-            self._add_replica(role)
+            self._add_replica(role, worker=workers[i] if workers
+                              else None)
 
     # -- membership --------------------------------------------------
 
-    def _add_replica(self, role: str) -> _Replica:
+    def _add_replica(self, role: str, worker: Any = None) -> _Replica:
         inst = str(next(self._next_instance))
         # Router-facing id (`inst`) is per-router and deterministic —
         # placement logs compare bit-for-bit across seeded runs. The
@@ -328,30 +395,60 @@ class ServeRouter:
         # live fleets must not emit colliding serve_*{instance="0"}
         # samples into one scrape (the exact single-instance collision
         # this PR fixes for engines).
-        eng = ServeEngine(self._model_cfg, self._params,
-                          self._serve_cfg, mesh=self._mesh,
-                          clock=self._clock,
-                          instance=f"{self.metrics.fleet}.{inst}")
-        rep = _Replica(instance=inst, role=role, engine=eng)
+        label = f"{self.metrics.fleet}.{inst}"
+        if worker is not None:
+            from horovod_tpu.serve.rpc import RemoteReplica
+            worker.conn.codec = _codec_id(self.cfg.handoff_compression)
+            worker.conn.set_timeout(self.cfg.rpc_timeout)
+            eng = RemoteReplica(worker, self._model_cfg,
+                                self._serve_cfg,
+                                seed=self._worker_seed, instance=label,
+                                clock=self._clock)
+        else:
+            if self._params is None:
+                raise ValueError(
+                    "params=None: cannot build an in-process replica "
+                    "(pass params, or a worker handle per replica)")
+            eng = ServeEngine(self._model_cfg, self._params,
+                              self._serve_cfg, mesh=self._mesh,
+                              clock=self._clock, instance=label)
+        rep = _Replica(instance=inst, role=role, engine=eng,
+                       remote=worker is not None)
         self._replicas.append(rep)
         return rep
 
     def add_replica(self, role: Optional[str] = None) -> str:
-        """Join a fresh replica (elastic scale-up); returns its
-        instance id. Default role matches the fleet shape: "decode"
-        for a split fleet, "unified" otherwise."""
+        """Join a fresh in-process replica (elastic scale-up); returns
+        its instance id. Default role matches the fleet shape:
+        "decode" for a split fleet, "unified" otherwise."""
+        return self._join(role, None)
+
+    def add_remote_replica(self, worker: Any,
+                           role: Optional[str] = None) -> str:
+        """Join a serve-worker process (``rpc.spawn_worker`` /
+        ``rpc.connect_worker`` handle) as a replica — the elastic
+        scale-up path of the cross-process fleet."""
+        return self._join(role, worker)
+
+    def _join(self, role: Optional[str], worker: Any) -> str:
         if role is None:
             role = "decode" if self.cfg.n_prefill else "unified"
         if role not in ("unified", "prefill", "decode"):
             raise ValueError(f"unknown role {role!r}")
-        return self._add_replica(role).instance
+        return self._add_replica(role, worker=worker).instance
 
-    def remove_replica(self, instance: str) -> None:
+    def remove_replica(self, instance: str,
+                       migrate_running: bool = False) -> None:
         """Drain a replica out of the fleet: its queued (never
         admitted) requests are withdrawn and requeued at the router
-        in original submission order; in-flight sequences keep
-        decoding here until done, after which the replica is reaped.
-        Refuses to remove the last replica able to serve a role."""
+        in original submission order. In-flight sequences either keep
+        decoding here until done (the default) or — with
+        ``migrate_running=True`` — are exported mid-decode and
+        injected into peers with capacity (bitwise page moves, same
+        tokens), so a drain completes in O(one step) instead of
+        O(longest decode). The replica reaps out once empty; a remote
+        replica's worker process is then shut down. Refuses to remove
+        the last replica able to serve a role."""
         rep = self._replica(instance)
         peers = [r for r in self._replicas
                  if r is not rep and not r.draining]
@@ -364,14 +461,27 @@ class ServeRouter:
                     f"cannot remove replica {instance}: last "
                     f"non-draining {role!r} replica in the fleet")
         rep.draining = True
-        requeue = []
+        rep.migrate = migrate_running
+        # Successful withdrawals stay in `outstanding` until the loop
+        # completes: if a later RPC finds the worker dead,
+        # _handle_dead requeues EVERYTHING still mapped there — the
+        # already-withdrawn included (they can never produce a result
+        # on the dead worker), in one correctly-ordered batch. Deleting
+        # eagerly would strand those requests in _requests with no
+        # queue entry and no owner.
+        withdrawn = []
         for erid, rid in list(rep.outstanding.items()):
-            if rep.engine.withdraw(erid):
-                del rep.outstanding[erid]
-                requeue.append(self._requests[rid])
+            ok = self._guard(rep, lambda e=erid: rep.engine.withdraw(e))
+            if rep not in self._replicas:
+                return   # died mid-drain: _handle_dead requeued it all
+            if ok:
+                withdrawn.append((erid, rid))
+        for erid, _rid in withdrawn:
+            del rep.outstanding[erid]
         # Front of the router queue, original submit order preserved:
         # drained work overtakes nothing and loses nothing.
-        for req in sorted(requeue, key=lambda r: r.rid, reverse=True):
+        for req in sorted((self._requests[rid] for _, rid in withdrawn),
+                          key=lambda r: r.rid, reverse=True):
             self._queue.appendleft(req)
 
     def _replica(self, instance: str) -> _Replica:
@@ -379,6 +489,58 @@ class ServeRouter:
             if rep.instance == instance:
                 return rep
         raise KeyError(f"no replica {instance!r}")
+
+    # -- liveness / failover (cross-process fleet) -------------------
+
+    def _guard(self, rep: _Replica, fn):
+        """Run one engine interaction; a transport failure (the
+        dead-worker signal) turns into :meth:`_handle_dead` and a
+        ``None`` return instead of unwinding the step loop. In-process
+        engines never raise it, so this is free for them."""
+        from horovod_tpu.serve.rpc import RpcConnectionError
+        try:
+            return fn()
+        except RpcConnectionError:
+            self._handle_dead(rep)
+            return None
+
+    def _handle_dead(self, rep: _Replica) -> None:
+        """A replica's worker is gone. Every request placed there
+        whose result was never collected goes back to the FRONT of the
+        router queue in original submission order — it re-places on a
+        survivor and resolves exactly once (results already collected
+        stay collected; the dead worker can no longer deliver
+        anything). The replica's last-heartbeat metrics fold into the
+        fleet rollup like any reaped replica's."""
+        if rep not in self._replicas:
+            return
+        self._replicas.remove(rep)
+        getattr(rep.engine, "mark_dead", lambda: None)()
+        requeue = [rid for rid in rep.outstanding.values()
+                   if rid in self._requests]
+        for rid in sorted(requeue, reverse=True):
+            self._queue.appendleft(self._requests[rid])
+        self.metrics.worker_deaths += 1
+        self.metrics.requeued_total += len(requeue)
+        self.metrics.absorb(rep.engine.metrics)
+
+    def _heartbeat_sweep(self, now: float) -> None:
+        """Probe remote replicas the step loop will not otherwise talk
+        to this iteration (idle ones — a busy replica's ``step`` RPC
+        is its heartbeat): liveness, plus the metrics/admission cache
+        behind the cross-process fleet scrape. ``heartbeat_every``
+        throttles it for real networks; the 0 default keeps every
+        step's cache fresh."""
+        for rep in list(self._replicas):
+            if not rep.remote:
+                continue
+            if rep.engine.pending:
+                continue   # its step() RPC this iteration is the beat
+            if now - rep.engine.last_beat < self.cfg.heartbeat_every:
+                continue
+            self._guard(rep, rep.engine.heartbeat)
+            if rep in self._replicas:
+                self.metrics.heartbeats += 1
 
     @property
     def replicas(self) -> List[str]:
@@ -413,6 +575,13 @@ class ServeRouter:
         # would reject must reject HERE, not explode out of a later
         # step() at placement time (all replicas share one geometry,
         # so any engine's pool answers for the fleet).
+        if not self._replicas:
+            # Every worker died and nothing joined: be explicit
+            # instead of IndexError-ing out of validation.
+            raise QueueFull("fleet has no live replicas",
+                            reason="no_replicas",
+                            queue_depth=len(self._queue),
+                            retry_after_s=None)
         validate_request(cfg, self._model_cfg,
                          self._replicas[0].engine.allocator.n_blocks,
                          prompt, max_new, deadline_class)
@@ -497,11 +666,11 @@ class ServeRouter:
         the snapshot rides along for the load tie-breaks (it cannot
         change between filter and pick within one decision)."""
         out = []
-        for r in self._replicas:
+        for r in list(self._replicas):
             if r.role not in pool_role or r.draining:
                 continue
-            snap = r.engine.admission_snapshot()
-            if snap["queue_slots_free"] > 0:
+            snap = self._guard(r, r.engine.admission_snapshot)
+            if snap is not None and snap["queue_slots_free"] > 0:
                 out.append((r, snap))
         return out
 
@@ -567,12 +736,17 @@ class ServeRouter:
             if not cands:
                 return
             rep, match = self._pick(req, cands)
-            self._queue.popleft()
-            erid = rep.engine.submit(
+            erid = self._guard(rep, lambda: rep.engine.submit(
                 req.prompt, req.max_new, deadline=req.deadline,
                 deadline_class=req.deadline_class,
                 prefill_only=(rep.role == "prefill"),
-                chain=req.chain)
+                chain=req.chain))
+            if erid is None:
+                # The pick died mid-submit; the request is still at
+                # the queue head — re-run the decision against the
+                # survivors.
+                continue
+            self._queue.popleft()
             rep.outstanding[erid] = req.rid
             if self.cfg.placement == "affinity":
                 # Only the affinity scorer ever reads the hint index;
@@ -585,33 +759,99 @@ class ServeRouter:
     # -- handoff (prefill pool -> decode pool) -----------------------
 
     def _collect_handoffs(self) -> None:
-        for rep in self._replicas:
+        for rep in list(self._replicas):
             if rep.role != "prefill":
                 continue
-            for erid in rep.engine.handoff_ready():
+            ready = self._guard(rep, rep.engine.handoff_ready)
+            if ready is None:
+                continue   # died; _handle_dead requeued its work
+            for erid in ready:
                 rid = rep.outstanding[erid]
                 req = self._requests[rid]
                 need = rep.engine.allocator.blocks_for_tokens(
                     len(req.prompt) + req.max_new)
-                target = self._pick_decode(need)
+                target = self._pick_capacity(("decode",), need,
+                                             exclude=rep)
                 if target is None:
                     # No decode capacity this step; the sequence stays
                     # parked (blocks held at the prefill replica) and
                     # is retried next step — never dropped.
                     continue
-                h = rep.engine.export_prefilled(erid)
-                del rep.outstanding[erid]
-                new_erid = target.engine.inject_prefilled(h)
-                target.outstanding[new_erid] = rid
+                if not self._move_seq(rep, erid, rid, target,
+                                      rep.engine.export_prefilled):
+                    if rep not in self._replicas:
+                        break   # source died; its work is requeued
+                    continue
                 self.metrics.handoffs += 1
 
-    def _pick_decode(self, need_blocks: int) -> Optional[_Replica]:
-        cands = []
-        for r in self._replicas:
-            if r.role != "decode" or r.draining:
+    def _migrate_draining(self) -> None:
+        """The migrating half of ``remove_replica(migrate_running=
+        True)``: export RUNNING sequences off draining replicas and
+        inject them into same-pool peers with capacity (a bitwise page
+        move — the tokens that follow are exactly the ones the donor
+        would have produced). A sequence with no target this step
+        keeps decoding on the drainer and retries next step — never
+        dropped, never duplicated."""
+        for rep in list(self._replicas):
+            if not (rep.draining and rep.migrate):
                 continue
-            snap = r.engine.admission_snapshot()
-            if (snap["batch_slots_free"] > 0
+            running = self._guard(rep, rep.engine.running_exportable)
+            if running is None:
+                continue
+            pool = (("decode",) if self.cfg.n_prefill else ("unified",))
+            for erid in running:
+                rid = rep.outstanding.get(erid)
+                if rid is None:
+                    continue   # e.g. injected seq finishing this step
+                req = self._requests[rid]
+                need = rep.engine.allocator.blocks_for_tokens(
+                    len(req.prompt) + req.max_new)
+                target = self._pick_capacity(pool, need, exclude=rep)
+                if target is None:
+                    continue
+                if not self._move_seq(rep, erid, rid, target,
+                                      rep.engine.export_running):
+                    if rep not in self._replicas:
+                        break
+                    continue
+                self.metrics.migrations += 1
+
+    def _move_seq(self, src: _Replica, erid: int, rid: int,
+                  target: _Replica, export_fn) -> bool:
+        """Export ``erid`` off ``src`` and inject into ``target``.
+        Failure semantics keep exactly-once: an export that dies takes
+        the whole source down (its outstanding work — this rid
+        included — requeues); an inject that dies after the export
+        freed the source pages requeues THIS request explicitly (its
+        pages died with the target; it re-prefills from scratch on a
+        survivor)."""
+        h = self._guard(src, lambda: export_fn(erid))
+        if h is None:
+            return False
+        del src.outstanding[erid]
+        new_erid = self._guard(target,
+                               lambda: target.engine.inject_prefilled(h))
+        if new_erid is None:
+            self._queue.appendleft(self._requests[rid])
+            self.metrics.requeued_total += 1
+            return False
+        target.outstanding[new_erid] = rid
+        return True
+
+    def _pick_capacity(self, pool_role: Tuple[str, ...],
+                       need_blocks: int,
+                       exclude: Optional[_Replica] = None,
+                       ) -> Optional[_Replica]:
+        """Least-loaded replica in ``pool_role`` with a batch slot AND
+        ``need_blocks`` of KV headroom — the handoff/migration target
+        filter (admission-queue room is irrelevant: an injected
+        sequence bypasses the queue)."""
+        cands = []
+        for r in list(self._replicas):
+            if r.role not in pool_role or r.draining or r is exclude:
+                continue
+            snap = self._guard(r, r.engine.admission_snapshot)
+            if (snap is not None and snap["batch_slots_free"] > 0
                     and r.engine.allocator.can_alloc(need_blocks)):
                 cands.append((r, snap))
         if not cands:
@@ -621,17 +861,24 @@ class ServeRouter:
     # -- the fleet iteration -----------------------------------------
 
     def step(self) -> None:
-        """One fleet iteration: expire router-queued deadlines, move
-        completed prefills to the decode pool, place queued requests,
-        step every busy replica, collect results, reap drained
-        replicas."""
+        """One fleet iteration: heartbeat idle remote replicas
+        (liveness + the cross-process metrics cache), expire
+        router-queued deadlines, move completed prefills to the decode
+        pool, migrate RUNNING work off migrating drains, place queued
+        requests, step every busy replica, collect results, reap
+        drained replicas. A worker that died since the last step is
+        detected at its first RPC this step and its uncollected work
+        requeues at the front — nothing is dropped, nothing resolves
+        twice."""
         now = self._clock()
+        self._heartbeat_sweep(now)
         self._expire_queued(now)
         self._collect_handoffs()
+        self._migrate_draining()
         self._place_queued()
-        for rep in self._replicas:
-            if rep.engine.pending:
-                rep.engine.step()
+        for rep in list(self._replicas):
+            if rep in self._replicas and rep.engine.pending:
+                self._guard(rep, rep.engine.step)
         self._collect_results()
         self._reap_drained()
 
@@ -676,18 +923,21 @@ class ServeRouter:
                 del rep.outstanding[erid]
 
     def _reap_drained(self) -> None:
-        keep = []
-        for r in self._replicas:
-            if (r.draining and not r.outstanding
-                    and not r.engine.pending
-                    and not r.engine.handoff_ready()):
-                # Fold the dying replica's lifetime counters and
-                # latency samples into the rollup — fleet totals and
-                # tails must survive membership churn.
-                self.metrics.absorb(r.engine.metrics)
-            else:
-                keep.append(r)
-        self._replicas = keep
+        for r in list(self._replicas):
+            if not (r.draining and not r.outstanding
+                    and not r.engine.pending):
+                continue
+            parked = self._guard(r, r.engine.handoff_ready)
+            if r not in self._replicas or parked:
+                continue   # died (handled) or still holding handoffs
+            # Fold the dying replica's lifetime counters and latency
+            # samples into the rollup — fleet totals and tails must
+            # survive membership churn — then, for a worker process,
+            # shut it down (the drain owns the worker's lifecycle).
+            self.metrics.absorb(r.engine.metrics)
+            self._replicas.remove(r)
+            if r.remote:
+                r.engine.shutdown()
 
     def run_until_idle(self, max_steps: int = 1_000_000) -> None:
         for _ in range(max_steps):
@@ -704,3 +954,12 @@ class ServeRouter:
         rids = [self.submit(p, max_new_tokens) for p in prompts]
         self.run_until_idle()
         return [self._results[r].tokens for r in rids]
+
+    def close(self) -> None:
+        """Release remote replicas without drain semantics: best-
+        effort shutdown RPC to every worker, connections closed.
+        In-process replicas need no teardown. Idempotent; the
+        cross-process bench/tests call it between cold fleets."""
+        for rep in self._replicas:
+            if rep.remote:
+                rep.engine.shutdown()

@@ -1,0 +1,261 @@
+"""Replica worker process: one ``ServeEngine`` behind the RPC seam.
+
+``python -m horovod_tpu.serve.worker --port 0`` (or the
+``bin/hvd-serve-worker`` wrapper) listens on a TCP port, announces
+``HVD-SERVE-WORKER ready port=<p> pid=<pid>`` on stdout, accepts ONE
+router connection, and serves the engine seam over
+:mod:`horovod_tpu.serve.rpc` until the router disconnects or sends
+``shutdown``. The engine itself is untouched: every replica invariant
+the in-process fleet pins (bitwise decode parity, allocator safety,
+backpressure) holds because the worker runs exactly the same
+``ServeEngine`` code the router would have run in-process.
+
+The worker builds its own params deterministically from the model
+config plus a seed (``init_transformer(cfg, PRNGKey(seed))``), so the
+router never ships multi-GB weights over the control channel; router
+and workers agree on the model by construction (documented contract —
+see docs/serving.md "Cross-process fleet").
+
+Heartbeats are pull-based: the router's ``step``/``heartbeat`` RPCs
+both return one *beat* payload — the admission state, the full
+``ServeMetrics`` snapshot (so the router-process Prometheus scrape
+spans worker processes), the latency samples recorded since the last
+beat (delta-shipped, bounded), and every newly-finished result, each
+timestamp re-anchored as an age relative to this process's clock
+(``perf_counter`` epochs are per-process). Liveness is the transport
+itself: a worker that dies mid-anything fails the router's next RPC.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from horovod_tpu.serve.rpc import (
+    RpcConn, WORKER_READY_PREFIX, handoff_from_wire, handoff_to_wire,
+    serve_connection,
+)
+
+
+def _build_engine(model_cfg: Dict[str, Any], serve_cfg: Dict[str, Any],
+                  seed: int, instance: str):
+    """Materialize the engine from wire-shaped configs (the inverse of
+    ``rpc.model_cfg_to_wire``/``serve_cfg_to_wire``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.compression import Compression
+    from horovod_tpu.models import TransformerConfig, init_transformer
+    from horovod_tpu.serve.engine import ServeConfig, ServeEngine
+
+    mc = dict(model_cfg)
+    mc["dtype"] = getattr(jnp, mc["dtype"])
+    cfg = TransformerConfig(**mc)
+    params = init_transformer(cfg, jax.random.PRNGKey(seed))
+    sc = dict(serve_cfg)
+    if sc.get("cache_dtype") is not None:
+        sc["cache_dtype"] = getattr(jnp, sc["cache_dtype"])
+    comp = sc.get("compression")
+    sc["compression"] = (None if comp in (None, "none")
+                         else getattr(Compression, comp))
+    for k in ("batch_buckets", "prefill_buckets"):
+        if sc.get(k) is not None:
+            sc[k] = tuple(sc[k])
+    return ServeEngine(cfg, params, ServeConfig(**sc),
+                       instance=instance)
+
+
+class ReplicaWorker:
+    """The dispatch table over one engine. Process-agnostic by design:
+    :func:`main` runs it behind a listening socket, and the tier-1
+    tests run it in a thread over a socketpair (same dispatch, same
+    marshalling, no spawn cost) — only the slow tier pays real
+    processes."""
+
+    def __init__(self, conn: RpcConn, clock=time.perf_counter):
+        self.conn = conn
+        self.engine = None
+        self._clock = clock
+        # Delta cursors: each beat ships only samples recorded since
+        # the previous one (heartbeats stay O(step work), never
+        # O(lifetime)).
+        self._ft_cursor = 0
+        self._pt_cursor = 0
+
+    # -- handlers ----------------------------------------------------
+
+    def configure(self, model_cfg, serve_cfg, seed, instance,
+                  kv_codec=0):
+        """(Re)build the engine. A second configure replaces the
+        engine with a fresh one (same process, same jit cache via the
+        ``make_serve_fns`` memo) — the bench's cold-fleet-per-pass
+        protocol without a respawn. ``kv_codec`` sets the span codec
+        for THIS side's replies (the export path's K/V pages)."""
+        self.engine = _build_engine(model_cfg, serve_cfg, int(seed),
+                                    str(instance))
+        self.conn.codec = int(kv_codec)
+        self._ft_cursor = self._pt_cursor = 0
+        return {"n_blocks": self.engine.allocator.n_blocks,
+                "block_size": self.engine.cfg.block_size,
+                "pid": os.getpid(),
+                "beat": self._beat()}
+
+    def _require_engine(self):
+        if self.engine is None:
+            raise RuntimeError("worker not configured yet")
+        return self.engine
+
+    def _result_to_wire(self, res, now: float) -> Dict[str, Any]:
+        def age(t):
+            return None if t is None else now - t
+
+        return {
+            "rid": res.rid, "status": res.status,
+            "http_status": res.http_status, "tokens": list(res.tokens),
+            "n_prompt": res.n_prompt,
+            "age_submitted": age(res.submitted_at),
+            "age_first_token": age(res.first_token_at),
+            "age_finished": age(res.finished_at),
+            "reason": res.reason, "deadline_class": res.deadline_class,
+            "retry_after_s": res.retry_after_s,
+        }
+
+    def _beat(self) -> Dict[str, Any]:
+        eng = self._require_engine()
+        now = self._clock()
+        m = eng.metrics
+        ft = [float(x) for x in m.first_token_s[self._ft_cursor:]]
+        pt = [float(x) for x in m.per_token_s[self._pt_cursor:]]
+        self._ft_cursor += len(ft)
+        self._pt_cursor += len(pt)
+        # DRAIN finished results into the beat (pop, don't copy): the
+        # router is the only consumer — it caches them its side and
+        # never re-queries — so shipping is exactly-once by
+        # construction, each beat costs O(newly finished), and a
+        # long-lived worker's result map stays bounded instead of
+        # accumulating every token list it ever served.
+        results = {}
+        for rid in list(eng._results):
+            results[rid] = self._result_to_wire(eng._results.pop(rid),
+                                                now)
+        return {
+            "pending": eng.pending,
+            "kv_blocks_free": eng.allocator.n_free,
+            "snap": m.snapshot(),
+            "ft": ft, "pt": pt,
+            "results": results,
+        }
+
+    def heartbeat(self):
+        return self._beat()
+
+    def step(self):
+        eng = self._require_engine()
+        if eng.pending:
+            eng.step()
+        return self._beat()
+
+    def admission_snapshot(self):
+        return self._require_engine().admission_snapshot()
+
+    def cached_chain_len(self, chain):
+        return self._require_engine().cached_chain_len(
+            [bytes(c) for c in chain])
+
+    def submit(self, prompt, max_new_tokens=None, deadline_in=None,
+               deadline_class=0, prefill_only=False, chain=None):
+        eng = self._require_engine()
+        deadline = (None if deadline_in is None
+                    else self._clock() + float(deadline_in))
+        return eng.submit(
+            [int(t) for t in prompt], max_new_tokens=max_new_tokens,
+            deadline=deadline, deadline_class=int(deadline_class),
+            prefill_only=bool(prefill_only),
+            chain=[bytes(c) for c in chain] if chain is not None
+            else None)
+
+    def withdraw(self, rid):
+        return self._require_engine().withdraw(int(rid))
+
+    def handoff_ready(self):
+        return self._require_engine().handoff_ready()
+
+    def export_prefilled(self, rid):
+        eng = self._require_engine()
+        return handoff_to_wire(eng.export_prefilled(int(rid)),
+                               self._clock())
+
+    def inject_prefilled(self, wire_handoff):
+        eng = self._require_engine()
+        return eng.inject_prefilled(
+            handoff_from_wire(wire_handoff, self._clock()))
+
+    def running_exportable(self):
+        return self._require_engine().running_exportable()
+
+    def export_running(self, rid):
+        eng = self._require_engine()
+        return handoff_to_wire(eng.export_running(int(rid)),
+                               self._clock())
+
+    def shutdown(self):
+        return {"pid": os.getpid()}
+
+    # -- loop --------------------------------------------------------
+
+    def handlers(self) -> Dict[str, Any]:
+        return {
+            "configure": self.configure,
+            "heartbeat": self.heartbeat,
+            "step": self.step,
+            "admission_snapshot": self.admission_snapshot,
+            "cached_chain_len": self.cached_chain_len,
+            "submit": self.submit,
+            "withdraw": self.withdraw,
+            "handoff_ready": self.handoff_ready,
+            "export_prefilled": self.export_prefilled,
+            "inject_prefilled": self.inject_prefilled,
+            "running_exportable": self.running_exportable,
+            "export_running": self.export_running,
+            "shutdown": self.shutdown,
+            "__closing__": ("shutdown",),
+        }
+
+    def serve(self) -> None:
+        serve_connection(self.conn, self.handlers())
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import socket
+
+    ap = argparse.ArgumentParser(
+        description="horovod_tpu serve worker: one ServeEngine replica "
+                    "behind the fleet RPC seam (see docs/serving.md)")
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="bind address (default loopback; the RPC "
+                         "channel is unauthenticated — keep it on a "
+                         "trusted network)")
+    ap.add_argument("--port", type=int, default=0,
+                    help="listen port (0 = ephemeral, announced on "
+                         "stdout)")
+    args = ap.parse_args(argv)
+
+    lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind((args.host, args.port))
+    lsock.listen(1)
+    port = lsock.getsockname()[1]
+    print(f"{WORKER_READY_PREFIX} port={port} pid={os.getpid()}",
+          flush=True)
+    sock, _addr = lsock.accept()
+    lsock.close()
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    ReplicaWorker(RpcConn(sock)).serve()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -182,6 +182,31 @@ def test_adasum_fit_example_3proc(capfd):
         assert final < first * 0.2, line
 
 
+@pytest.mark.slow  # spawns 2 worker processes (jax import + compile
+# each, ~40s); the RPC/router logic it demos is pinned every tier-1
+# run by tests/test_rpc.py's in-thread fleet tier, and the true
+# cross-process path by that module's slow acceptance test — this is
+# the script-level smoke (PR 6 slow-tier discipline).
+def test_serve_fleet_example_cross_process():
+    """The fleet demo's --cross-process mode: replicas spawned via
+    bin/hvd-serve-worker, served over the RPC seam, with the bf16 KV
+    handoff savings visible in the printed rpc-plane line."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "examples", "serve_fleet.py"),
+         "--tiny", "--replicas", "2", "--prefill", "1",
+         "--requests", "6", "--cross-process",
+         "--kv-compression", "bf16"],
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, **_WORKER_ENV})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "served 6/6 ok" in proc.stdout
+    assert "rpc plane:" in proc.stdout
+    assert "50% saved" in proc.stdout
+    assert "serve_fleet_replicas" in proc.stdout
+
+
 def test_spark_estimator_example_degrades_without_pyspark():
     """The Spark example must explain itself when pyspark is absent
     (this container has none) instead of stack-tracing."""

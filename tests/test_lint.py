@@ -68,6 +68,9 @@ def make_clean_tree(root):
         WIRE_VERSION_RESPONSE_LIST = 5
         METRICS_VERSION = 1
         """)
+    _write(root, "horovod_tpu/serve/rpc.py", """\
+        RPC_PROTOCOL_VERSION = 1
+        """)
     _write(root, "native/include/hvd/codec.h", """\
         enum class WireCodec : uint8_t {
           NONE = 0,
@@ -194,6 +197,24 @@ def test_abi_pin_mismatch_fires(tree):
         """)
     fs = run_all(tree, only={"abi-literal"})
     assert len(fs) == 1 and "mismatch" in fs[0].message, fs
+
+
+def test_injected_stray_rpc_version_fires(tree):
+    """The serve-fleet RPC protocol version is a Python-only pin
+    (both ends are Python), single-sourced in serve/rpc.py — a second
+    definition site is how a router and a worker end up 'agreeing' on
+    versions that aren't the same constant."""
+    _write(tree, "horovod_tpu/serve/worker.py",
+           "RPC_PROTOCOL_VERSION = 2\n")
+    fs = run_all(tree, only={"abi-literal"})
+    assert len(fs) == 1 and "outside its home" in fs[0].message, fs
+    assert fs[0].path == "horovod_tpu/serve/worker.py"
+
+
+def test_missing_rpc_version_pin_fires(tree):
+    _write(tree, "horovod_tpu/serve/rpc.py", "VERSION = 1  # renamed\n")
+    fs = run_all(tree, only={"abi-literal"})
+    assert len(fs) == 1 and "RPC_PROTOCOL_VERSION" in fs[0].message, fs
 
 
 def test_injected_wire_codec_drift_fires(tree):

@@ -395,6 +395,41 @@ def test_prefill_handoff_roundtrip_bitwise(served_model):
     assert dec.allocator.prefix_hits > before
 
 
+def test_export_running_mid_decode_bitwise(served_model):
+    """The migrating-drain seam (ISSUE 11): a RUNNING sequence
+    exported mid-decode and injected into another engine finishes
+    with EXACTLY the tokens it would have produced in place — the
+    pages (prompt AND generated-token K/V, partial tail block
+    included) move bitwise. Finished-but-unretired sequences refuse
+    to export (they must retire on the donor)."""
+    prompts = _shared_prefix_prompts(3)
+    ref = _mk_engine(served_model, **_PFX_KW).generate(prompts, 5)
+    a = _mk_engine(served_model, **_PFX_KW)
+    b = _mk_engine(served_model, **_PFX_KW)
+    rids = [a.submit(p, 5) for p in prompts]
+    a.step()        # prefill + first decode
+    a.step()        # a couple of tokens in — genuinely mid-decode
+    assert set(a.running_exportable()) == set(rids)
+    moved = {}
+    for rid in rids:
+        h = a.export_running(rid)
+        assert len(h.generated) >= 2
+        assert h.n_cached == len(h.prompt) + len(h.generated) - 1
+        moved[rid] = b.inject_prefilled(h)
+    assert a.allocator.n_used == 0 and not a.pending
+    b.run_until_idle()
+    assert [b.result(moved[r]).tokens for r in rids] == ref
+    assert b.allocator.n_used == 0
+    # Unknown and finished rids refuse.
+    with pytest.raises(KeyError):
+        a.export_running(99999)
+    c = _mk_engine(served_model, **_PFX_KW)
+    rid = c.submit(prompts[0], 1)
+    c.step()
+    # max_new=1: finished at prefill, never RUNNING — not exportable.
+    assert c.running_exportable() == []
+
+
 def test_mid_batch_retirement_frees_blocks(served_model):
     eng = _mk_engine(served_model)
     short = eng.submit([1, 2, 3], max_new_tokens=2)

@@ -186,6 +186,14 @@ def _broadcast_state_worker():
     return digest, lr
 
 
+@pytest.mark.slow  # ~27s spawn; redundancy (ISSUE 11 budget audit):
+# the nonzero-root broadcast COLLECTIVE is pinned tier-1 by the eager
+# multiprocess scenarios (numpy + jax tiers both broadcast from
+# root s-1), and the broadcast_parameters wrapper runs tier-1 inside
+# test_two_rank_grad_average's worker and test_jax_optimizer's pytree
+# tier — the unique surface here (broadcast_optimizer_state's
+# state-dict walk from a nonzero root) is pure-Python glue over those
+# pinned paths.
 def test_broadcast_parameters_and_optimizer_state_nonzero_root():
     results = run(_broadcast_state_worker, np=2, env=_WORKER_ENV,
                   start_timeout=90)

@@ -157,6 +157,13 @@ _PIN_PAIRS = [("kAbiVersion", "ABI_VERSION"),
               ("kWireVersionRequestList", "WIRE_VERSION_REQUEST_LIST"),
               ("kWireVersionResponseList", "WIRE_VERSION_RESPONSE_LIST"),
               ("kMetricsVersion", "METRICS_VERSION")]
+# Python-only protocol pins: both ends of the serve-fleet RPC plane
+# are Python, so there is no C++ twin — but the one-definition-site
+# discipline is the same (a duplicated literal is how the router and
+# a worker end up speaking "the same" version that isn't).
+_PY_SOLO_PINS = {
+    "RPC_PROTOCOL_VERSION": "horovod_tpu/serve/rpc.py",
+}
 
 
 def _cc_def_re(name: str) -> re.Pattern:
@@ -189,7 +196,7 @@ def rule_abi_literal(root: str) -> List[Finding]:
                         "reference the constant instead"))
                 else:
                     values[name] = int(m.group(1))
-    for name, home in _PY_PINS.items():
+    for name, home in {**_PY_PINS, **_PY_SOLO_PINS}.items():
         pat = _py_def_re(name)
         for subdir in ("horovod_tpu", "bin", "examples"):
             if not os.path.isdir(os.path.join(root, subdir)):
@@ -206,6 +213,11 @@ def rule_abi_literal(root: str) -> List[Finding]:
                             "import the pin instead"))
                     else:
                         values[name] = int(m.group(1))
+    for name, home in _PY_SOLO_PINS.items():
+        if name not in values:
+            out.append(Finding(
+                "abi-literal", home, 0,
+                f"expected pin {name} not found at its home"))
     for cc, py in _PIN_PAIRS:
         if cc in values and py in values and values[cc] != values[py]:
             out.append(Finding(
