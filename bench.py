@@ -511,7 +511,7 @@ def _serve_worker():
     try:
         from horovod_tpu.serve.bench import (
             run_prefix_benchmark, run_router_benchmark,
-            run_serving_benchmark,
+            run_serving_benchmark, run_spec_benchmark,
         )
 
         # The benchmark's own contract: continuous batching must beat
@@ -523,9 +523,14 @@ def _serve_worker():
         # shared-prefix trace (the tokens-per-request lever).
         out.update(run_prefix_benchmark(n_requests=32))
         print("SERVEEXTRA " + json.dumps(out), flush=True)
+        # Speculative tier: draft/target pair vs plain decode on the
+        # decode-heavy multi-tenant trace (serve_spec_* keys — the
+        # tokens-per-weight-pass lever; accept rate rides along).
+        out.update(run_spec_benchmark(n_requests=24))
+        print("SERVEEXTRA " + json.dumps(out), flush=True)
         # Fleet tier: routed vs random placement at 4 replicas on the
         # multi-tenant trace (the placement lever above the engine).
-        # Last, so a budget kill keeps the single-replica keys.
+        # After the single-replica tiers, so a budget kill keeps them.
         out.update(run_router_benchmark(n_requests=32))
         print("SERVEEXTRA " + json.dumps(out), flush=True)
         # Cross-process tier: the same routed fleet over spawned
@@ -542,12 +547,13 @@ def _serve_worker():
 
 
 def _serve_extra(remaining_secs: float):
-    """Serving benchmark extra (continuous-batching engine + fleet
-    router + cross-process RPC arm; the cap grew with the third and
-    fourth stages — the RPC arm spawns worker processes that each pay
-    a jax import + compile)."""
+    """Serving benchmark extra (continuous-batching engine +
+    speculative decoding + fleet router + cross-process RPC arm; the
+    cap grew with each added stage — the spec tier compiles a deeper
+    target model, and the RPC arm spawns worker processes that each
+    pay a jax import + compile)."""
     return _worker_extra("--serve-worker", "SERVEEXTRA",
-                         remaining_secs, 420.0)
+                         remaining_secs, 480.0)
 
 
 def _previous_bench(bench_dir=None):

@@ -18,6 +18,12 @@ Cross-process fleet (each replica a spawned ``bin/hvd-serve-worker``
 process behind the RPC seam; add --kv-compression bf16 to halve
 KV-handoff bytes on a split fleet):
   JAX_PLATFORMS=cpu python examples/serve_fleet.py --tiny --cross-process
+
+Speculative draft/target pair (the target replicas decode with a
+1-layer draft proposing k tokens per step — greedy streams stay
+bitwise plain decode's — AND the draft registers as its own model
+group, served directly via ``model="draft"``):
+  JAX_PLATFORMS=cpu python examples/serve_fleet.py --tiny --draft
 """
 
 import argparse
@@ -50,6 +56,13 @@ def main():
                     choices=[None, "bf16", "fp16"],
                     help="wire codec for KV pages on cross-process "
                          "handoffs (bf16 halves migration bytes)")
+    ap.add_argument("--draft", action="store_true",
+                    help="speculative draft/target demo: serve the "
+                         "target fleet with a 1-layer draft proposing "
+                         "--spec-k tokens per step, and register the "
+                         "draft as its own model group (multi-model "
+                         "routing) served via model='draft'")
+    ap.add_argument("--spec-k", type=int, default=3)
     ap.add_argument("--tiny", action="store_true",
                     help="2-layer d=64 model (CPU smoke)")
     ap.add_argument("--platform", default=None, choices=[None, "cpu", "tpu"])
@@ -62,8 +75,8 @@ def main():
 
     from horovod_tpu.models import TransformerConfig, init_transformer
     from horovod_tpu.serve import (
-        FleetSaturated, RouterConfig, ServeConfig, ServeRouter,
-        make_multi_tenant_trace,
+        DraftConfig, FleetSaturated, RouterConfig, ServeConfig,
+        ServeRouter, make_multi_tenant_trace,
     )
 
     cfg = (TransformerConfig.tiny(dtype=jnp.float32, remat=False)
@@ -77,13 +90,34 @@ def main():
     params = (None if args.cross_process
               else init_transformer(cfg, jax.random.PRNGKey(0)))
 
+    draft_cfg = None
+    spec_kw = {}
+    if args.draft:
+        # 1-layer draft of the target's width; the engine rebuilds its
+        # params from (config, seed) — the cross-process contract too.
+        import dataclasses as _dc
+        draft_cfg = _dc.replace(cfg, n_layers=1)
+        spec_kw = dict(draft=DraftConfig(draft_cfg, seed=0),
+                       spec_k=args.spec_k)
+        if not args.cross_process:
+            # In-process: use the idealized pair (the target's extra
+            # layers contribute zero to the residual stream, so it
+            # computes the draft's exact logits) — accept rate 1.0
+            # shows the mechanism paying. Random-weight pairs (the
+            # cross-process path, where workers rebuild params from
+            # the seed) honestly show accept ~0: a real deployment
+            # needs a draft trained to agree with its target.
+            from horovod_tpu.serve import make_draft_target_params
+            cfg, params = make_draft_target_params(
+                draft_cfg, n_layers=cfg.n_layers, seed=0)
+
     trace = make_multi_tenant_trace(
         args.requests, seed=0, n_tenants=args.tenants, prefix_len=16,
         min_new=2, max_new=args.max_new, vocab=cfg.vocab_size)
     max_prompt = max(len(p) for p, _ in trace)
     serve_cfg = ServeConfig(
         max_batch=4, max_queue=max(args.requests, 8), block_size=8,
-        max_prompt=max_prompt, max_new_tokens=args.max_new)
+        max_prompt=max_prompt, max_new_tokens=args.max_new, **spec_kw)
     workers = []
     if args.cross_process:
         from horovod_tpu.serve import spawn_worker
@@ -95,10 +129,31 @@ def main():
     router = ServeRouter(
         cfg, params,
         RouterConfig(n_replicas=args.replicas, n_prefill=args.prefill,
-                     max_queue=max(args.requests, 8),
+                     # +4: the --draft demo queues a few draft-model
+                     # requests alongside the full target trace.
+                     max_queue=max(args.requests, 8) + 4,
                      placement=args.placement,
                      handoff_compression=args.kv_compression),
         serve_cfg, workers=workers or None, worker_seed=0)
+
+    draft_rids = []
+    if args.draft and args.cross_process:
+        print("note: --draft with --cross-process serves speculatively "
+              "(workers rebuild the draft from the seed; random pairs "
+              "accept ~0) but skips the in-process draft model group")
+    if args.draft and not args.cross_process:
+        # Multi-model: the draft is also an ordinary fleet member —
+        # its own model group, routed by model=, never mixed with the
+        # target's replicas.
+        draft_params = init_transformer(draft_cfg, jax.random.PRNGKey(0))
+        router.add_model(
+            "draft", draft_cfg, draft_params, n_replicas=1,
+            serve_cfg=ServeConfig(
+                max_batch=4, max_queue=max(args.requests, 8),
+                block_size=8, max_prompt=max_prompt,
+                max_new_tokens=args.max_new))
+        draft_rids = [router.submit(p, n, model="draft")
+                      for p, n in trace[:4]]
 
     rids = [router.submit(p, n) for p, n in trace]
     router.run_until_idle()
@@ -118,6 +173,20 @@ def main():
               f"{hits} with a warm chain prefix")
     ok = sum(1 for r in rids if router.result(r).status == "ok")
     print(f"served {ok}/{len(rids)} ok")
+    if args.draft:
+        snap = router.metrics.snapshot()
+        print(f"speculative: accept_rate={snap['spec_accept_rate']} "
+              f"({int(snap['spec_accepted_total'])}/"
+              f"{int(snap['spec_proposed_total'])} draft tokens "
+              f"accepted at k={args.spec_k})")
+        if draft_rids:
+            d_ok = sum(1 for r in draft_rids
+                       if router.result(r).status == "ok")
+            by_model = router.metrics.snapshot_by_model()
+            print(f"draft model group: {d_ok}/{len(draft_rids)} ok, "
+                  f"replicas={int(by_model['draft']['replicas'])}, "
+                  f"finished="
+                  f"{int(by_model['draft']['requests_finished'])}")
 
     snap = router.metrics.snapshot()
     print("fleet metrics:",

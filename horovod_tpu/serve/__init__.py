@@ -51,6 +51,12 @@ from horovod_tpu.serve.kv_cache import (  # noqa: F401
 )
 from horovod_tpu.serve.decode import make_serve_fns  # noqa: F401
 from horovod_tpu.serve.metrics import ServeMetrics, percentile  # noqa: F401
+from horovod_tpu.serve.speculative import (  # noqa: F401
+    DraftConfig,
+    SpecDecoder,
+    accept_greedy,
+    make_draft_target_params,
+)
 from horovod_tpu.serve.router import (  # noqa: F401
     FleetMetrics,
     FleetSaturated,
@@ -75,4 +81,5 @@ from horovod_tpu.serve.bench import (  # noqa: F401
     run_prefix_benchmark,
     run_router_benchmark,
     run_serving_benchmark,
+    run_spec_benchmark,
 )

@@ -324,6 +324,96 @@ def run_prefix_benchmark(n_requests: int = 32, *, seed: int = 0,
     }
 
 
+def run_spec_benchmark(n_requests: int = 24, *, seed: int = 0,
+                       draft_cfg=None, target_layers: int = 8,
+                       spec_k: int = 4, max_batch: int = 4,
+                       block_size: int = 8, warmup: bool = True,
+                       repeats: int = 3) -> dict:
+    """The speculative-decoding claim: on a decode-heavy multi-tenant
+    trace, a draft/target pair beats plain decode on tokens/sec at
+    equal-or-better p99 first-token, with bitwise-identical streams
+    (greedy acceptance) and the accept rate reported.
+
+    The pair is the **idealized construction**
+    (:func:`~horovod_tpu.serve.speculative.make_draft_target_params`):
+    the target is ``target_layers`` deep but its extra layers have
+    zeroed residual out-projections, so it computes the 1-layer
+    draft's exact logits — accept rate 1.0 by construction. That
+    isolates the mechanism under measurement: per accepted token the
+    target's weights stream once per ``spec_k`` tokens instead of once
+    per token (decode is weight-bound at small batch — on CPU exactly
+    as on TPU), while the verify chunk reuses one weight pass for the
+    whole chunk. A real draft scales the win by its measured accept
+    rate, which is why the rate rides the payload next to the ratio.
+
+    Arms are interleaved per the +-30% protocol; throughput takes the
+    best pass, first-token tails the least-interfered (min) pass,
+    accept rate pools token counts across passes."""
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.models import TransformerConfig
+    from horovod_tpu.serve.engine import ServeConfig, ServeEngine
+    from horovod_tpu.serve.speculative import (
+        DraftConfig, make_draft_target_params,
+    )
+
+    if draft_cfg is None:
+        # d=512 x 8 target layers so the per-call cost is the weight
+        # pass, not dispatch (at d<=256 on this host the ~1.5ms jit
+        # dispatch dominates and speculation's k+1 calls per k tokens
+        # measure call-count, not the mechanism; decode on real
+        # hardware is weight-bound, which is the regime this isolates).
+        draft_cfg = TransformerConfig.tiny(
+            d_model=512, d_ff=2048, n_layers=1, n_heads=8, n_kv_heads=4,
+            dtype=jnp.float32, remat=False)
+    target_cfg, target_params = make_draft_target_params(
+        draft_cfg, n_layers=target_layers, seed=0)
+    # Decode-heavy trace: speculation pays per GENERATED token, so the
+    # mixed-tenant prompts stay short and the decodes run long.
+    trace = make_multi_tenant_trace(n_requests, seed=seed, min_new=6,
+                                    max_new=12)
+    max_prompt = max(len(p) for p, _ in trace)
+    max_new = max(n for _, n in trace)
+    base = dict(max_batch=max_batch, max_queue=max(len(trace), 8),
+                block_size=block_size, max_prompt=max_prompt,
+                max_new_tokens=max_new)
+    engines = {
+        "plain": ServeEngine(target_cfg, target_params,
+                             ServeConfig(**base)),
+        "spec": ServeEngine(target_cfg, target_params, ServeConfig(
+            **base, draft=DraftConfig(draft_cfg, seed=0),
+            spec_k=spec_k)),
+    }
+    passes = _interleaved_passes(engines, trace, repeats, warmup)
+    snaps = {label: _best_pass(ps) for label, ps in passes.items()}
+    for label, ps in passes.items():
+        vals = [s["p99_first_token_ms"] for s in ps
+                if s["p99_first_token_ms"] is not None]
+        snaps[label]["p99_first_token_ms"] = min(vals) if vals else None
+    proposed = sum(s["spec_proposed_total"] for s in passes["spec"])
+    accepted = sum(s["spec_accepted_total"] for s in passes["spec"])
+    ref = snaps["plain"]["_tokens"]
+    identical = all(s["_tokens"] == ref
+                    for ps in passes.values() for s in ps)
+    plain_tps = snaps["plain"]["tokens_per_sec_wall"]
+    spec_tps = snaps["spec"]["tokens_per_sec_wall"]
+    return {
+        "serve_spec_tokens_per_sec": spec_tps,
+        "serve_spec_plain_tokens_per_sec": plain_tps,
+        "serve_spec_over_plain": (round(spec_tps / plain_tps, 3)
+                                  if plain_tps else None),
+        "serve_spec_accept_rate": (round(accepted / proposed, 4)
+                                   if proposed else 0.0),
+        "serve_spec_p99_first_token_ms":
+            snaps["spec"]["p99_first_token_ms"],
+        "serve_spec_plain_p99_first_token_ms":
+            snaps["plain"]["p99_first_token_ms"],
+        "serve_spec_verify_rounds_count": snaps["spec"]["spec_rounds"],
+        "serve_spec_tokens_identical": identical,
+    }
+
+
 def _run_router_pass(model_cfg, params, trace, *, placement: str,
                      n_replicas: int, n_prefill: int, serve_cfg,
                      seed: int, workers=None,
@@ -554,6 +644,7 @@ def run_router_benchmark(n_requests: int = 48, *, seed: int = 0,
 def main() -> None:
     out = run_serving_benchmark()
     out.update(run_prefix_benchmark())
+    out.update(run_spec_benchmark())
     out.update(run_router_benchmark())
     print(json.dumps(out, indent=2))
 

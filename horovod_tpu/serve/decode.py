@@ -100,11 +100,13 @@ def _ffn(cfg, lp, x):
 
 def make_serve_fns(cfg, mesh: Optional[Any] = None, *, block_size: int,
                    table_width: int, compression=None):
-    """Build (prefill, prefill_resume, decode, inject) jitted closures
-    for ``cfg`` over ``mesh``. ``table_width`` is the static block-
-    table row length (blocks per sequence, worst case); caches are
-    donated so steady-state decode — and the handoff-page ``inject``
-    scatter — update the pool in place.
+    """Build (prefill, prefill_resume, decode, inject, verify) jitted
+    closures for ``cfg`` over ``mesh``. ``table_width`` is the static
+    block-table row length (blocks per sequence, worst case); caches
+    are donated so steady-state decode — and the handoff-page
+    ``inject`` scatter — update the pool in place. ``verify`` is the
+    speculative-decoding chunk step (one target pass over k proposed
+    tokens; see serve/speculative.py).
 
     ``compression`` (a ``hvd.Compression`` member; None = uncompressed,
     bitwise the pre-existing programs) is the serving face of the same
@@ -261,8 +263,15 @@ def _cached_serve_fns(cfg, mesh, block_size: int, table_width: int,
         def body(x, per_layer):
             lp, kc_l, vc_l = per_layer
             q, k, v = _qkv(cfg, lp, x, pos)
+            # Positions past the table (a speculative draft's proposal
+            # frontier near a sequence's cap) route to the null block.
+            # The unguarded take_along_axis would CLAMP the slot and
+            # overwrite the sequence's last real block instead.
+            slot = positions // block_size                     # [B]
             blk = jnp.take_along_axis(
-                block_tables, (positions // block_size)[:, None], axis=1)[:, 0]
+                block_tables,
+                jnp.minimum(slot, table_width - 1)[:, None], axis=1)[:, 0]
+            blk = jnp.where(slot < table_width, blk, NULL_BLOCK)
             phys = blk * block_size + positions % block_size   # [B]
             flat = (-1, Hkv, Dh)
             kc_l = kc_l.reshape(flat).at[phys].set(
@@ -292,6 +301,70 @@ def _cached_serve_fns(cfg, mesh, block_size: int, table_width: int,
         logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
         return kc, vc, jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
+    def verify(params, kc, vc, tokens, positions, block_tables):
+        """Speculative verification (see serve/speculative.py): one
+        chunked target step over the batch's already-reserved pages.
+        tokens [B, C] — per sequence ``[last_token, d1..d_{C-1}]``;
+        positions [B] — each sequence's cache length (where the
+        chunk's first K/V lands); block_tables [B, table_width].
+
+        This is ``prefill_resume``'s math batched over sequences with
+        ``decode``'s token-granularity page addressing (speculative
+        chunks start mid-block): scatter the chunk's K/V through the
+        table at per-token physical slots, gather ALL of each
+        sequence's pages, attend under the global-position causal
+        mask. The argmax at chunk position j is therefore bitwise what
+        a plain decode step would emit after consuming
+        ``tokens[:, :j+1]`` — the property greedy acceptance needs.
+        Chunk positions past the table (proposal frontier near the
+        cap) and padded batch rows route to the null block; their
+        outputs are compared then discarded host-side (acceptance
+        truncates at max_new before any such position can be
+        emitted). Returns (kc, vc, out [B, C])."""
+        B, C = tokens.shape
+        S = table_width * block_size
+        x = tf_lib.embed_lookup(params["embed"], tokens, cfg.dtype,
+                                mesh, compression)             # [B, C, D]
+        pos = positions[:, None] + jnp.arange(C, dtype=jnp.int32)[None]
+
+        def body(x, per_layer):
+            lp, kc_l, vc_l = per_layer
+            q, k, v = _qkv(cfg, lp, x, pos)
+            slot = pos // block_size                           # [B, C]
+            blk = jnp.take_along_axis(
+                block_tables, jnp.minimum(slot, table_width - 1), axis=1)
+            blk = jnp.where(slot < table_width, blk, NULL_BLOCK)
+            phys = (blk * block_size + pos % block_size).reshape(-1)
+            flat = (-1, Hkv, Dh)
+            kc_l = kc_l.reshape(flat).at[phys].set(
+                k.reshape(-1, Hkv, Dh).astype(kc_l.dtype)).reshape(
+                    kc_l.shape)
+            vc_l = vc_l.reshape(flat).at[phys].set(
+                v.reshape(-1, Hkv, Dh).astype(vc_l.dtype)).reshape(
+                    vc_l.shape)
+            kp = kc_l[block_tables].reshape(B, S, Hkv, Dh).astype(q.dtype)
+            vp = vc_l[block_tables].reshape(B, S, Hkv, Dh).astype(q.dtype)
+            if rep > 1:
+                kp = jnp.repeat(kp, rep, axis=2)
+                vp = jnp.repeat(vp, rep, axis=2)
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, kp,
+                           preferred_element_type=jnp.float32) * scale
+            kpos = jnp.arange(S, dtype=jnp.int32)
+            mask = kpos[None, None, :] <= pos[:, :, None]      # [B, C, S]
+            s = jnp.where(mask[:, None], s, _NEG_BIG)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(vp.dtype), vp,
+                           preferred_element_type=jnp.float32).astype(
+                               q.dtype)
+            x = x + (o.reshape(B, C, H * Dh) @ lp["wo"]).astype(cfg.dtype)
+            x = _ffn(cfg, lp, x)
+            return x, (kc_l, vc_l)
+
+        x, (kc, vc) = lax.scan(body, x, (params["layers"], kc, vc))
+        x = tf_lib._rmsnorm(x, params["final_norm"], cfg.norm_eps)
+        logits = (x @ params["lm_head"]).astype(jnp.float32)   # [B, C, V]
+        return kc, vc, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
     def inject(kc, vc, blocks, k_pages, v_pages):
         """Scatter handed-off prompt pages into this pool (the
         prefill/decode disaggregation receive path). blocks
@@ -312,4 +385,5 @@ def _cached_serve_fns(cfg, mesh, block_size: int, table_width: int,
     return (jax.jit(prefill, donate_argnums=(1, 2)),
             jax.jit(prefill_resume, donate_argnums=(1, 2)),
             jax.jit(decode, donate_argnums=(1, 2)),
-            jax.jit(inject, donate_argnums=(0, 1)))
+            jax.jit(inject, donate_argnums=(0, 1)),
+            jax.jit(verify, donate_argnums=(1, 2)))

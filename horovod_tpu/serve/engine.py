@@ -135,6 +135,16 @@ class ServeConfig:
     # pre-existing programs) — the serving face of the training
     # planes' one knob. See decode.make_serve_fns.
     compression: Any = None
+    # Speculative decoding (serve/speculative.py): `draft` is the
+    # sub-config naming the draft transformer (a
+    # speculative.DraftConfig — model config + params seed + cache
+    # dtype; it inherits THIS engine's block geometry), and `spec_k`
+    # is how many tokens the draft proposes per scheduler iteration,
+    # all verified in ONE chunked target step. Both set = speculation
+    # on (greedy streams stay bitwise plain decode's); both unset =
+    # plain decode, byte for byte the pre-speculative engine.
+    draft: Any = None
+    spec_k: int = 0
 
 
 @dataclasses.dataclass
@@ -316,6 +326,12 @@ class ServeEngine:
         cfg = serve_cfg or ServeConfig()
         if cfg.scheduling not in ("continuous", "static"):
             raise ValueError(f"unknown scheduling {cfg.scheduling!r}")
+        if (cfg.draft is None) != (cfg.spec_k == 0) or cfg.spec_k < 0:
+            raise ValueError(
+                f"draft= and spec_k= go together (draft="
+                f"{'set' if cfg.draft is not None else None}, spec_k="
+                f"{cfg.spec_k}): set both for speculative decoding, "
+                "neither for plain decode")
         self.model_cfg = model_cfg
         self.cfg = cfg
         self.mesh = mesh
@@ -370,7 +386,7 @@ class ServeEngine:
         self.cache = init_kv_cache(model_cfg, n_blocks, bs, mesh=mesh,
                                    dtype=cfg.cache_dtype)
         (self._prefill_fn, self._resume_fn, self._decode_fn,
-         self._inject_fn) = decode_lib.make_serve_fns(
+         self._inject_fn, self._verify_fn) = decode_lib.make_serve_fns(
              model_cfg, mesh, block_size=bs,
              table_width=self._table_width, compression=cfg.compression)
 
@@ -391,6 +407,12 @@ class ServeEngine:
         self._rids = itertools.count()
         # Drain-rate signal behind retry_after_s estimates.
         self._retire_ema = RetireEma()
+        # Speculative side-car: draft params + mirror KV pool + the
+        # propose/verify/accept round that replaces _decode_once.
+        self._spec = None
+        if cfg.draft is not None:
+            from horovod_tpu.serve.speculative import SpecDecoder
+            self._spec = SpecDecoder(self)
 
     # -- submission --------------------------------------------------
 
@@ -533,6 +555,8 @@ class ServeEngine:
 
     def _finish(self, seq: _Seq, now: float) -> None:
         self.allocator.free(seq.blocks)
+        if self._spec is not None:
+            self._spec.drop(seq.rid)
         self._results[seq.rid] = RequestResult(
             rid=seq.rid, status="ok", http_status=200,
             tokens=list(seq.generated), n_prompt=len(seq.prompt),
@@ -779,6 +803,8 @@ class ServeEngine:
         k_pages = np.asarray(self.cache.k[:, idx])
         v_pages = np.asarray(self.cache.v[:, idx])
         self.allocator.free(seq.blocks)
+        if self._spec is not None:
+            self._spec.drop(seq.rid)
         self.metrics.record_handoff_out()
         return PrefillHandoff(
             prompt=list(seq.prompt), max_new=seq.max_new,
@@ -894,6 +920,14 @@ class ServeEngine:
         import jax
 
         if not self._active:
+            return
+        if self._spec is not None:
+            # Speculative iteration: k draft proposals per sequence,
+            # one chunked target verify, host-side greedy acceptance
+            # with cursor-only rollback of rejected positions. Swaps
+            # ONLY this decode iteration — admission, prefill,
+            # retirement, handoff all run unchanged above/below it.
+            self._spec.round()
             return
         n = len(self._active)
         bucket = pick_bucket(n, self._batch_buckets)

@@ -218,6 +218,29 @@ class BlockAllocator:
         self._block_of_hash[h] = block
         return True
 
+    def verify_integrity(self) -> None:
+        """Full-pool invariant check (the randomized property tests'
+        probe — e.g. the speculative-rollback machine calls it after
+        every trace): live / cached / free partition the non-null
+        blocks exactly, refcounts are positive, the content index is a
+        bijection, and every cached block is indexed. Raises
+        ``AssertionError`` on any violation."""
+        live, cached, free = (set(self._refs), set(self._lru),
+                              set(self._free))
+        assert len(self._free) == len(free), "duplicate free-list entry"
+        assert not (live & cached) and not (live & free) \
+            and not (cached & free), "block in two states"
+        assert live | cached | free == set(range(1, self.n_blocks)), \
+            "live/cached/free do not partition the pool"
+        assert all(r > 0 for r in self._refs.values()), \
+            "zero/negative refcount held as live"
+        assert len(self._block_of_hash) == len(self._hash_of_block), \
+            "content index out of sync"
+        for b, h in self._hash_of_block.items():
+            assert self._block_of_hash[h] == b, "index not a bijection"
+        for b in cached:
+            assert b in self._hash_of_block, "anonymous block in LRU"
+
     def free(self, blocks: List[int]) -> None:
         """Drop one reference per listed block. A block whose refcount
         reaches 0 parks in the LRU cache pool if it was registered

@@ -86,6 +86,14 @@ class ServeMetrics:
         # evictions) live on the attached BlockAllocator.
         self.prefix_hit_tokens = 0
         self.prefix_prefill_tokens = 0
+        # Speculative decoding (serve/speculative.py): proposal /
+        # acceptance tallies (their ratio is the token-weighted accept
+        # rate) and the per-round draft / verify wall-time series.
+        self.spec_rounds = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_draft_s: List[float] = []
+        self.spec_verify_s: List[float] = []
         self.first_token_s: List[float] = []
         self.per_token_s: List[float] = []
         self._events: List[dict] = []
@@ -172,6 +180,37 @@ class ServeMetrics:
         self._span("serve:decode", t0, dur_s, n_active=n_active,
                    **self._pool_gauges())
 
+    def record_spec_round(self, t0: float, draft_dur_s: float,
+                          verify_dur_s: float, n_active: int,
+                          max_batch: int, *, proposed: int,
+                          accepted: int, emitted: int) -> None:
+        """One speculative iteration: the k batched draft decode steps
+        (one span) plus the single chunked verify step, with the
+        round's proposal/acceptance tallies. Feeds the same
+        throughput/occupancy series a plain decode step feeds so
+        tokens/sec and batch_occupancy compare across speculative and
+        plain engines; the per-token latency sample is the round wall
+        time over tokens-per-sequence (a round delivers several tokens
+        at once — the inter-token interval a client sees is the round
+        amortized over them)."""
+        self.spec_rounds += 1
+        self.spec_proposed += proposed
+        self.spec_accepted += accepted
+        self.tokens_generated += emitted
+        self._occupancy_sum += n_active / max_batch
+        dur = draft_dur_s + verify_dur_s
+        if emitted and len(self.per_token_s) < MAX_SAMPLES:
+            self.per_token_s.append(dur * n_active / emitted)
+        if len(self.spec_draft_s) < MAX_SAMPLES:
+            self.spec_draft_s.append(draft_dur_s)
+        if len(self.spec_verify_s) < MAX_SAMPLES:
+            self.spec_verify_s.append(verify_dur_s)
+        self._span("serve:spec_draft", t0, draft_dur_s,
+                   n_active=n_active, proposed=proposed)
+        self._span("serve:spec_verify", t0 + draft_dur_s, verify_dur_s,
+                   accepted=accepted, emitted=emitted,
+                   **self._pool_gauges())
+
     def record_first_token(self, latency_s: float) -> None:
         # The first token comes out of prefill, not a decode step —
         # count it here so tokens/sec covers all generated tokens.
@@ -215,8 +254,11 @@ class ServeMetrics:
         def ms(x):
             return None if x is None else round(x * 1e3, 3)
 
-        occ = (self._occupancy_sum / self.decode_steps
-               if self.decode_steps else 0.0)
+        # A speculative round occupies batch slots exactly like a
+        # decode step — both feed the occupancy numerator, so both
+        # count in the denominator.
+        occ_steps = self.decode_steps + self.spec_rounds
+        occ = self._occupancy_sum / occ_steps if occ_steps else 0.0
         looked_up = self.prefix_hit_tokens + self.prefix_prefill_tokens
         out = {
             "elapsed_s": round(elapsed, 3),
@@ -242,6 +284,21 @@ class ServeMetrics:
             "p99_first_token_ms": ms(percentile(self.first_token_s, 99)),
             "p50_per_token_ms": ms(percentile(self.per_token_s, 50)),
             "p99_per_token_ms": ms(percentile(self.per_token_s, 99)),
+            # Speculative decoding: counters are zeros on a plain
+            # engine (so mixed-fleet rollups sum without key checks);
+            # the accept rate is token-weighted (accepted DRAFT tokens
+            # over proposed — correction tokens are the target's own
+            # and count in neither).
+            "spec_rounds": self.spec_rounds,
+            "spec_proposed_total": self.spec_proposed,
+            "spec_accepted_total": self.spec_accepted,
+            "spec_accept_rate": (
+                round(self.spec_accepted / self.spec_proposed, 4)
+                if self.spec_proposed else 0.0),
+            "p50_spec_draft_ms": ms(percentile(self.spec_draft_s, 50)),
+            "p99_spec_draft_ms": ms(percentile(self.spec_draft_s, 99)),
+            "p50_spec_verify_ms": ms(percentile(self.spec_verify_s, 50)),
+            "p99_spec_verify_ms": ms(percentile(self.spec_verify_s, 99)),
         }
         if self._allocator is not None:
             a = self._allocator

@@ -65,7 +65,22 @@ a :class:`~horovod_tpu.serve.rpc.RemoteReplica` — the same engine seam
 over the RPC plane, driven by the identical placement/pool/shedding/
 drain code. Liveness is the transport plus a heartbeat sweep; a dead
 worker's uncollected requests requeue at the queue front and resolve
-exactly once on survivors. See docs/serving.md "Cross-process fleet".
+exactly once on survivors. Remote step RPCs fan out (request frames to
+every busy worker first, replies applied in fleet order), so N worker
+processes compute their iterations concurrently while results stay
+seed-deterministic. See docs/serving.md "Cross-process fleet".
+
+The fleet is **multi-model** (ISSUE 12): the constructor registers the
+``"default"`` model group, :meth:`ServeRouter.add_model` registers
+more — each group carries its own model/serve configs, params (or
+worker seed), and prefill/decode split — and requests carry
+``model=``. Placement scores by (model, cache affinity) with capacity
+filtering inside the group; handoffs, migrating drains, and
+dead-worker requeue never cross groups (a KV page is meaningless under
+another model's weights, so exactly-once failover is same-model by
+construction); shedding stays fleet-wide by deadline class. This makes
+draft/target pairs, A/B fleets, and per-tenant models ordinary fleet
+members — see docs/serving.md "Multi-model fleets".
 
 Everything is deterministic for a fixed seed: FIFO placement order,
 tie-breaks by replica id, and the only randomness (the random
@@ -167,10 +182,17 @@ class RouterConfig:
         span_codec_id(self.handoff_compression)
 
 
+#: The model id of the constructor-registered group: a single-model
+#: fleet never has to spell a model id anywhere.
+DEFAULT_MODEL = "default"
+
+
 @dataclasses.dataclass
 class _Pending:
     """Router-side copy of a request: enough to (re)place it on any
-    replica — this is what makes replica drain lossless."""
+    same-model replica — this is what makes replica drain lossless
+    AND model-correct (a requeued request re-places only within its
+    model group)."""
 
     rid: int
     prompt: List[int]
@@ -179,6 +201,22 @@ class _Pending:
     deadline_class: int
     submitted_at: float
     chain: List[bytes]
+    model: str = DEFAULT_MODEL
+
+
+@dataclasses.dataclass
+class _ModelGroup:
+    """One registered model: its configs, params (None for all-remote
+    groups), pool split, and the worker params-from-seed contract.
+    Replicas of different groups are ordinary fleet members — only
+    placement, handoff, drain and the last-replica guard key on the
+    group."""
+
+    model_cfg: Any
+    params: Any
+    serve_cfg: ServeConfig
+    n_prefill: int = 0
+    worker_seed: int = 0
 
 
 @dataclasses.dataclass
@@ -186,6 +224,7 @@ class _Replica:
     instance: str
     role: str                    # "unified" | "prefill" | "decode"
     engine: Any                  # ServeEngine | rpc.RemoteReplica
+    model: str = DEFAULT_MODEL   # the _ModelGroup this replica serves
     draining: bool = False
     remote: bool = False         # engine lives in a worker process
     migrate: bool = False        # drain moves RUNNING decodes out too
@@ -217,7 +256,8 @@ class FleetMetrics:
     ABSORBED = ("tokens_generated", "requests_submitted",
                 "requests_finished", "requests_expired",
                 "requests_rejected", "prefix_hit_tokens",
-                "prefix_prefill_tokens")
+                "prefix_prefill_tokens", "spec_proposed_total",
+                "spec_accepted_total")
 
     def __init__(self, router: "ServeRouter"):
         import weakref
@@ -238,6 +278,9 @@ class FleetMetrics:
         #                              exactly once)
         self.migrations = 0          # RUNNING decodes moved by a drain
         self._retired: Dict[str, float] = {}   # absorbed counters
+        # ...and the same counters bucketed by model group, feeding
+        # the per-model rollup series (label model=...).
+        self._retired_models: Dict[str, Dict[str, float]] = {}
         # Absorbed latency samples (same MAX_SAMPLES cap as the live
         # series): without them the fleet p99 would silently IMPROVE
         # after draining whichever replica served the slow tenant.
@@ -247,14 +290,17 @@ class FleetMetrics:
         register_exporter_weak(f"serve_fleet_{id(self)}", self,
                                "prometheus")
 
-    def absorb(self, metrics) -> None:
+    def absorb(self, metrics, model: str = "default") -> None:
         """Fold a reaped replica's final ``ServeMetrics`` into the
-        rollup — lifetime counters AND its latency samples (capped) —
-        so fleet totals and tails survive membership churn."""
+        rollup — lifetime counters (fleet-wide AND under its model
+        group) plus its latency samples (capped) — so fleet totals and
+        tails survive membership churn."""
         snap = metrics.snapshot()
+        by_model = self._retired_models.setdefault(model, {})
         for key in self.ABSORBED:
             self._retired[key] = (self._retired.get(key, 0)
                                   + snap.get(key, 0))
+            by_model[key] = by_model.get(key, 0) + snap.get(key, 0)
         for series, kept in self._retired_samples.items():
             room = MAX_SAMPLES - len(kept)
             if room > 0:
@@ -305,6 +351,10 @@ class FleetMetrics:
         out["prefix_cache_hit_rate"] = (
             round(out["prefix_hit_tokens"] / looked, 4)
             if looked else 0.0)
+        out["spec_accept_rate"] = (
+            round(out["spec_accepted_total"]
+                  / out["spec_proposed_total"], 4)
+            if out["spec_proposed_total"] else 0.0)
         # Pooled tails: the fleet p99 is a quantile of the union of
         # every replica's samples (live + absorbed-from-reaped), not
         # an average of replica p99s.
@@ -319,10 +369,51 @@ class FleetMetrics:
                                         else round(v * 1e3, 3))
         return out
 
+    def snapshot_by_model(self) -> Dict[str, Dict[str, float]]:
+        """Per-model-group rollups: live replicas of each group summed
+        with the group's absorbed (reaped-replica) counters, plus the
+        group's queue depth and accept rate. The fleet-wide snapshot
+        stays the authoritative total; these slices answer "which
+        model is the traffic/accept-rate/backlog on?"."""
+        router = self._router()
+        if router is None:
+            return {}
+        out: Dict[str, Dict[str, float]] = {}
+        for model in sorted(router._models):
+            reps = [r for r in router._replicas if r.model == model]
+            snaps = [r.engine.metrics.snapshot() for r in reps]
+            retired = self._retired_models.get(model, {})
+            d: Dict[str, float] = {
+                "replicas": len(reps),
+                "queue_depth": sum(1 for q in router._queue
+                                   if q.model == model),
+            }
+            for key in self.ABSORBED:
+                d[key] = (sum(s.get(key, 0) for s in snaps)
+                          + retired.get(key, 0))
+            d["tokens_per_sec"] = round(
+                sum(s["tokens_per_sec"] for s in snaps), 2)
+            d["spec_accept_rate"] = (
+                round(d["spec_accepted_total"]
+                      / d["spec_proposed_total"], 4)
+                if d["spec_proposed_total"] else 0.0)
+            out[model] = d
+        return out
+
     def prometheus(self) -> str:
+        """Fleet-wide rollup under ``{fleet=...}`` plus one per-model
+        slice under ``{fleet=..., model=...}`` — same families,
+        different label sets (the exposition assembler dedupes the
+        per-family TYPE lines, so the one-TYPE-line-per-family pin
+        holds)."""
         from horovod_tpu.metrics import render_gauges
-        return render_gauges("serve_fleet", self.snapshot(),
-                             labels={"fleet": self.fleet})
+        parts = [render_gauges("serve_fleet", self.snapshot(),
+                               labels={"fleet": self.fleet})]
+        for model, snap in self.snapshot_by_model().items():
+            parts.append(render_gauges(
+                "serve_fleet", snap,
+                labels={"fleet": self.fleet, "model": model}))
+        return "".join(parts)
 
 
 class ServeRouter:
@@ -356,15 +447,24 @@ class ServeRouter:
         self._mesh = mesh
         self._clock = clock
         self._worker_seed = worker_seed
+        # Registered model groups; the constructor args define the
+        # DEFAULT_MODEL group, add_model() registers more (draft/target
+        # pairs, A/B fleets, per-tenant models as ordinary members).
+        self._models: Dict[str, _ModelGroup] = {
+            DEFAULT_MODEL: _ModelGroup(
+                model_cfg, params, self._serve_cfg,
+                n_prefill=self.cfg.n_prefill, worker_seed=worker_seed)}
         self._rng = np.random.RandomState(self.cfg.seed)
         self._rr = 0                 # round_robin cursor
         self._replicas: List[_Replica] = []
         self._next_instance = itertools.count()
         self._queue: collections.deque[_Pending] = collections.deque()
         self._requests: Dict[int, _Pending] = {}   # every unresolved rid
-        # chain entry -> instance it was last routed to (insertion-
-        # ordered for FIFO eviction at CHAIN_INDEX_CAP).
-        self._placed_chains: "collections.OrderedDict[bytes, str]" = \
+        # (model, chain entry) -> instance it was last routed to
+        # (insertion-ordered for FIFO eviction at CHAIN_INDEX_CAP; the
+        # model in the key stops identical token prefixes under
+        # different models from aliasing each other's routing hints).
+        self._placed_chains: "collections.OrderedDict[Tuple[str, bytes], str]" = \
             collections.OrderedDict()
         self._results: Dict[int, RequestResult] = {}
         self._rids = itertools.count()
@@ -387,7 +487,9 @@ class ServeRouter:
 
     # -- membership --------------------------------------------------
 
-    def _add_replica(self, role: str, worker: Any = None) -> _Replica:
+    def _add_replica(self, role: str, worker: Any = None,
+                     model: str = DEFAULT_MODEL) -> _Replica:
+        group = self._models[model]
         inst = str(next(self._next_instance))
         # Router-facing id (`inst`) is per-router and deterministic —
         # placement logs compare bit-for-bit across seeded runs. The
@@ -400,42 +502,105 @@ class ServeRouter:
             from horovod_tpu.serve.rpc import RemoteReplica
             worker.conn.codec = _codec_id(self.cfg.handoff_compression)
             worker.conn.set_timeout(self.cfg.rpc_timeout)
-            eng = RemoteReplica(worker, self._model_cfg,
-                                self._serve_cfg,
-                                seed=self._worker_seed, instance=label,
+            eng = RemoteReplica(worker, group.model_cfg,
+                                group.serve_cfg,
+                                seed=group.worker_seed, instance=label,
                                 clock=self._clock)
         else:
-            if self._params is None:
+            if group.params is None:
                 raise ValueError(
                     "params=None: cannot build an in-process replica "
                     "(pass params, or a worker handle per replica)")
-            eng = ServeEngine(self._model_cfg, self._params,
-                              self._serve_cfg, mesh=self._mesh,
+            eng = ServeEngine(group.model_cfg, group.params,
+                              group.serve_cfg, mesh=self._mesh,
                               clock=self._clock, instance=label)
         rep = _Replica(instance=inst, role=role, engine=eng,
-                       remote=worker is not None)
+                       model=model, remote=worker is not None)
         self._replicas.append(rep)
         return rep
 
-    def add_replica(self, role: Optional[str] = None) -> str:
+    def add_model(self, model: str, model_cfg, params=None,
+                  serve_cfg: Optional[ServeConfig] = None, *,
+                  n_replicas: int = 1, n_prefill: int = 0,
+                  workers: Optional[Sequence[Any]] = None,
+                  worker_seed: int = 0) -> List[str]:
+        """Register a model group and join its replicas; returns their
+        instance ids. Replicas of the new group are ordinary fleet
+        members — same placement, drain, shedding, and failover code —
+        but requests reach them only via ``submit(..., model=...)``,
+        handoffs/migrations stay inside the group, and the per-group
+        ``n_prefill`` splits ITS replicas into prefill/decode pools
+        independently of the default group's split. This is what makes
+        draft/target pairs, A/B fleets, and per-tenant models plain
+        members of one fleet. ``workers`` (one handle per replica)
+        lifts the group cross-process exactly like the constructor's —
+        workers rebuild THIS group's engine via ``configure``."""
+        if model in self._models:
+            raise ValueError(f"model {model!r} already registered")
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas {n_replicas} < 1")
+        if not 0 <= n_prefill < n_replicas:
+            raise ValueError(
+                f"n_prefill {n_prefill} must leave at least one decode "
+                f"replica out of {n_replicas}")
+        workers = list(workers or [])
+        if workers and len(workers) != n_replicas:
+            raise ValueError(
+                f"{len(workers)} workers for n_replicas={n_replicas}; "
+                "pass one handle per replica")
+        if params is None and not workers:
+            raise ValueError(
+                "params=None: cannot build in-process replicas for "
+                f"model {model!r} (pass params, or a worker handle "
+                "per replica)")
+        self._models[model] = _ModelGroup(
+            model_cfg, params, serve_cfg or ServeConfig(),
+            n_prefill=n_prefill, worker_seed=worker_seed)
+        out = []
+        try:
+            for i in range(n_replicas):
+                role = ("prefill" if i < n_prefill else
+                        "decode" if n_prefill else "unified")
+                out.append(self._add_replica(
+                    role, worker=workers[i] if workers else None,
+                    model=model).instance)
+        except Exception:
+            # Roll the half-registered group back: a failed worker
+            # configure must not leave a zombie model id that can
+            # neither be completed nor re-registered.
+            self._replicas = [r for r in self._replicas
+                              if r.instance not in out]
+            del self._models[model]
+            raise
+        return out
+
+    def add_replica(self, role: Optional[str] = None,
+                    model: str = DEFAULT_MODEL) -> str:
         """Join a fresh in-process replica (elastic scale-up); returns
-        its instance id. Default role matches the fleet shape:
-        "decode" for a split fleet, "unified" otherwise."""
-        return self._join(role, None)
+        its instance id. Default role matches the model group's shape:
+        "decode" for a split group, "unified" otherwise."""
+        return self._join(role, None, model)
 
     def add_remote_replica(self, worker: Any,
-                           role: Optional[str] = None) -> str:
+                           role: Optional[str] = None,
+                           model: str = DEFAULT_MODEL) -> str:
         """Join a serve-worker process (``rpc.spawn_worker`` /
         ``rpc.connect_worker`` handle) as a replica — the elastic
         scale-up path of the cross-process fleet."""
-        return self._join(role, worker)
+        return self._join(role, worker, model)
 
-    def _join(self, role: Optional[str], worker: Any) -> str:
+    def _join(self, role: Optional[str], worker: Any,
+              model: str = DEFAULT_MODEL) -> str:
+        group = self._models.get(model)
+        if group is None:
+            raise ValueError(f"unknown model {model!r}; registered: "
+                             f"{sorted(self._models)}")
         if role is None:
-            role = "decode" if self.cfg.n_prefill else "unified"
+            role = "decode" if group.n_prefill else "unified"
         if role not in ("unified", "prefill", "decode"):
             raise ValueError(f"unknown role {role!r}")
-        return self._add_replica(role, worker=worker).instance
+        return self._add_replica(role, worker=worker,
+                                 model=model).instance
 
     def remove_replica(self, instance: str,
                        migrate_running: bool = False) -> None:
@@ -444,22 +609,45 @@ class ServeRouter:
         in original submission order. In-flight sequences either keep
         decoding here until done (the default) or — with
         ``migrate_running=True`` — are exported mid-decode and
-        injected into peers with capacity (bitwise page moves, same
-        tokens), so a drain completes in O(one step) instead of
-        O(longest decode). The replica reaps out once empty; a remote
-        replica's worker process is then shut down. Refuses to remove
-        the last replica able to serve a role."""
+        injected into same-model peers with capacity (bitwise page
+        moves, same tokens), so a drain completes in O(one step)
+        instead of O(longest decode). The replica reaps out once
+        empty; a remote replica's worker process is then shut down.
+
+        Guard: refuses to remove the last non-draining replica of a
+        needed role *within its model group* when (a) no other group
+        has live replicas — an empty fleet serves nothing — or (b)
+        the group still has work (router-queued requests for that
+        model, or this replica's own in-flight work, which a drain
+        with no same-model survivor could never re-place). A workless
+        secondary group CAN drain to zero — that is how a model is
+        decommissioned."""
         rep = self._replica(instance)
+        group = self._models[rep.model]
         peers = [r for r in self._replicas
-                 if r is not rep and not r.draining]
-        needed = (("prefill", "decode") if self.cfg.n_prefill
+                 if r is not rep and not r.draining
+                 and r.model == rep.model]
+        other_groups = any(r.model != rep.model and not r.draining
+                           for r in self._replicas)
+        needed = (("prefill", "decode") if group.n_prefill
                   else ("unified",))
         for role in needed:
             if rep.role == role and not any(p.role == role
                                             for p in peers):
-                raise ValueError(
-                    f"cannot remove replica {instance}: last "
-                    f"non-draining {role!r} replica in the fleet")
+                queued = any(q.model == rep.model for q in self._queue)
+                # Work anywhere in the GROUP blocks the drain, not
+                # just this replica's: a peer prefill replica's parked
+                # sequence needs a same-model decode target that would
+                # never exist again after removing the last one.
+                group_work = any(r.outstanding for r in self._replicas
+                                 if r.model == rep.model)
+                if not other_groups or queued or group_work:
+                    raise ValueError(
+                        f"cannot remove replica {instance}: last "
+                        f"non-draining {role!r} replica for model "
+                        f"{rep.model!r}"
+                        + (" with queued work" if queued or group_work
+                           else " in the fleet"))
         rep.draining = True
         rep.migrate = migrate_running
         # Successful withdrawals stay in `outstanding` until the loop
@@ -522,7 +710,7 @@ class ServeRouter:
             self._queue.appendleft(self._requests[rid])
         self.metrics.worker_deaths += 1
         self.metrics.requeued_total += len(requeue)
-        self.metrics.absorb(rep.engine.metrics)
+        self.metrics.absorb(rep.engine.metrics, rep.model)
 
     def _heartbeat_sweep(self, now: float) -> None:
         """Probe remote replicas the step loop will not otherwise talk
@@ -560,30 +748,44 @@ class ServeRouter:
     def submit(self, prompt: Sequence[int],
                max_new_tokens: Optional[int] = None,
                deadline: Optional[float] = None,
-               deadline_class: int = 0) -> int:
-        """Fleet admission. Validates against the shared engine
-        limits, then queues for placement. On a full router queue the
-        shedding policy runs: the newest queued request of a strictly
-        lower class (higher number) is shed — resolved to a structured
-        ``"shed"`` result — to make room; if none exists, raises
+               deadline_class: int = 0,
+               model: str = DEFAULT_MODEL) -> int:
+        """Fleet admission. Validates against the target model group's
+        engine limits, then queues for placement (which only ever
+        considers that group's replicas — a request can never land on
+        a wrong-model replica, pinned by the router property test).
+        On a full router queue the shedding policy runs fleet-wide:
+        the newest queued request of a strictly lower class (higher
+        number) is shed — resolved to a structured ``"shed"`` result —
+        to make room; if none exists, raises
         :class:`FleetSaturated`."""
         prompt = list(prompt)
-        cfg = self._serve_cfg
+        group = self._models.get(model)
+        if group is None:
+            raise ValueError(f"unknown model {model!r}; registered: "
+                             f"{sorted(self._models)}")
+        cfg = group.serve_cfg
         max_new = (cfg.max_new_tokens if max_new_tokens is None
                    else max_new_tokens)
         # The ENGINE's validation helper, verbatim: anything an engine
         # would reject must reject HERE, not explode out of a later
-        # step() at placement time (all replicas share one geometry,
-        # so any engine's pool answers for the fleet).
-        if not self._replicas:
+        # step() at placement time (all replicas of a group share one
+        # geometry, so any group engine's pool answers for the group).
+        # Draining replicas don't count as live: accepting a request
+        # against a group mid-drain-to-zero would queue it forever
+        # once the drainer reaps (placement filters draining too).
+        mine = [r for r in self._replicas
+                if r.model == model and not r.draining]
+        if not mine:
             # Every worker died and nothing joined: be explicit
             # instead of IndexError-ing out of validation.
-            raise QueueFull("fleet has no live replicas",
-                            reason="no_replicas",
-                            queue_depth=len(self._queue),
-                            retry_after_s=None)
-        validate_request(cfg, self._model_cfg,
-                         self._replicas[0].engine.allocator.n_blocks,
+            raise QueueFull(
+                f"no live replicas for model {model!r}",
+                reason="no_replicas",
+                queue_depth=len(self._queue),
+                retry_after_s=None)
+        validate_request(cfg, group.model_cfg,
+                         mine[0].engine.allocator.n_blocks,
                          prompt, max_new, deadline_class)
         if len(self._queue) >= self.cfg.max_queue:
             victim = self._shed_candidate(deadline_class)
@@ -609,7 +811,7 @@ class ServeRouter:
         req = _Pending(
             rid=rid, prompt=prompt, max_new=max_new, deadline=deadline,
             deadline_class=deadline_class, submitted_at=self._clock(),
-            chain=chain)
+            chain=chain, model=model)
         self._requests[rid] = req
         self._queue.append(req)
         return rid
@@ -656,18 +858,22 @@ class ServeRouter:
     # -- placement ---------------------------------------------------
 
     def _candidates(
-            self, pool_role: Tuple[str, ...],
+            self, pool_role: Tuple[str, ...], model: str,
     ) -> List[Tuple[_Replica, Dict[str, float]]]:
         """(replica, admission snapshot) pairs eligible for a new
-        placement: right pool, not draining, engine-queue room. The
-        affinity invariant — never route to a replica without
-        capacity — is enforced here, before any cache walk happens;
-        each replica is snapshotted ONCE per placement decision and
-        the snapshot rides along for the load tie-breaks (it cannot
-        change between filter and pick within one decision)."""
+        placement: right MODEL group, right pool, not draining,
+        engine-queue room. Model is filtered before anything else —
+        capacity pressure in one group can never spill a request onto
+        another group's replicas. The affinity invariant — never route
+        to a replica without capacity — is enforced here, before any
+        cache walk happens; each replica is snapshotted ONCE per
+        placement decision and the snapshot rides along for the load
+        tie-breaks (it cannot change between filter and pick within
+        one decision)."""
         out = []
         for r in list(self._replicas):
-            if r.role not in pool_role or r.draining:
+            if (r.model != model or r.role not in pool_role
+                    or r.draining):
                 continue
             snap = self._guard(r, r.engine.admission_snapshot)
             if snap is not None and snap["queue_slots_free"] > 0:
@@ -708,53 +914,112 @@ class ServeRouter:
         the replica's LIVE content-index walk (blocks actually held)
         and the leading run of chain entries last ROUTED there (the
         burst hint — a same-prefix sibling placed moments ago whose
-        prefill hasn't published yet)."""
-        live = rep.engine.cached_chain_len(chain)
+        prefill hasn't published yet). Hint keys carry the model id,
+        so identical prefixes under different models never alias."""
+        live = self._guard(
+            rep, lambda: rep.engine.cached_chain_len(chain))
+        if live is None:
+            # Died mid-walk: score 0; the placement pass discovers the
+            # death at submit (or the replica-count check) and
+            # restarts against the survivors.
+            return 0
         hint = 0
         for h in chain:
-            if self._placed_chains.get(h) != rep.instance:
+            if self._placed_chains.get((rep.model, h)) != rep.instance:
                 break
             hint += 1
         return max(live, hint)
 
     def _record_chain(self, rep: _Replica, chain: List[bytes]) -> None:
         for h in chain:
-            if h in self._placed_chains:
-                self._placed_chains.move_to_end(h)
-            self._placed_chains[h] = rep.instance
+            key = (rep.model, h)
+            if key in self._placed_chains:
+                self._placed_chains.move_to_end(key)
+            self._placed_chains[key] = rep.instance
         while len(self._placed_chains) > CHAIN_INDEX_CAP:
             self._placed_chains.popitem(last=False)
 
     def _place_queued(self) -> None:
         """FIFO placement (no overtaking — same tail-predictability
-        contract as engine admission): place from the head until a
-        request finds no candidate, then stop and retry next step."""
-        pool = (("prefill",) if self.cfg.n_prefill else ("unified",))
-        while self._queue:
-            req = self._queue[0]
-            cands = self._candidates(pool)
-            if not cands:
+        contract as engine admission): place in queue order until a
+        MODEL's requests find no candidate, then skip that model's
+        remaining requests this step and keep placing other models' —
+        FIFO holds within each model group, but one saturated (or
+        replica-less) group never head-of-line-blocks the rest of the
+        fleet. Pool roles come from the request's group (each group
+        splits prefill/decode independently); candidates are always
+        same-model."""
+        # Snapshot scan, one rid-filtered rebuild per pass: a worker
+        # death inside a _guard call requeues its work at the queue
+        # FRONT mid-scan, so positional indexing could place one
+        # request and delete a different one — and per-placement
+        # deque.remove would make a deep queue O(n^2). A death
+        # RESTARTS the pass from the (mutated) front, so per-model
+        # FIFO holds even across failovers: the requeued-at-front work
+        # and the request whose pick died both go before anything
+        # younger.
+        while True:
+            stuck: set = set()    # models with no candidate this pass
+            placed: set = set()   # rids placed this pass
+            n_reps = len(self._replicas)
+            died = False
+            for req in list(self._queue):
+                if req.model in stuck:
+                    continue
+                group = self._models[req.model]
+                pool = (("prefill",) if group.n_prefill
+                        else ("unified",))
+                cands = self._candidates(pool, req.model)
+                if len(self._replicas) != n_reps:
+                    # A death detected inside the candidate probes (or
+                    # the affinity walk) requeued work at the front —
+                    # restart so it is not overtaken by this pass's
+                    # stale snapshot.
+                    died = True
+                    break
+                if not cands:
+                    stuck.add(req.model)
+                    continue
+                rep, match = self._pick(req, cands)
+                erid = self._guard(rep, lambda: rep.engine.submit(
+                    req.prompt, req.max_new, deadline=req.deadline,
+                    deadline_class=req.deadline_class,
+                    prefill_only=(rep.role == "prefill"),
+                    chain=req.chain))
+                if erid is None:
+                    died = True
+                    break
+                placed.add(req.rid)
+                rep.outstanding[erid] = req.rid
+                if self.cfg.placement == "affinity":
+                    # Only the affinity scorer ever reads the hint
+                    # index; the baselines skip the OrderedDict churn.
+                    self._record_chain(rep, req.chain)
+                self.metrics.record_placed(match)
+                if len(self.placement_log) < MAX_SAMPLES:
+                    self.placement_log.append(
+                        (req.rid, rep.instance, match))
+            if placed:
+                # A death mid-pass UN-places work: _handle_dead
+                # requeued every rid the dead replica owned — including
+                # ones placed earlier in THIS pass (the queue then
+                # holds the same _Pending twice: stale position +
+                # requeued front). Keep anything no longer owned by a
+                # live replica, deduped to its front (requeued)
+                # occurrence so requeue-at-front order survives.
+                owned = {rid for r in self._replicas
+                         for rid in r.outstanding.values()}
+                placed &= owned
+                seen: set = set()
+                newq: collections.deque = collections.deque()
+                for q in self._queue:
+                    if q.rid in placed or q.rid in seen:
+                        continue
+                    seen.add(q.rid)
+                    newq.append(q)
+                self._queue = newq
+            if not died:
                 return
-            rep, match = self._pick(req, cands)
-            erid = self._guard(rep, lambda: rep.engine.submit(
-                req.prompt, req.max_new, deadline=req.deadline,
-                deadline_class=req.deadline_class,
-                prefill_only=(rep.role == "prefill"),
-                chain=req.chain))
-            if erid is None:
-                # The pick died mid-submit; the request is still at
-                # the queue head — re-run the decision against the
-                # survivors.
-                continue
-            self._queue.popleft()
-            rep.outstanding[erid] = req.rid
-            if self.cfg.placement == "affinity":
-                # Only the affinity scorer ever reads the hint index;
-                # the baselines skip the OrderedDict churn entirely.
-                self._record_chain(rep, req.chain)
-            self.metrics.record_placed(match)
-            if len(self.placement_log) < MAX_SAMPLES:
-                self.placement_log.append((req.rid, rep.instance, match))
 
     # -- handoff (prefill pool -> decode pool) -----------------------
 
@@ -771,7 +1036,8 @@ class ServeRouter:
                 need = rep.engine.allocator.blocks_for_tokens(
                     len(req.prompt) + req.max_new)
                 target = self._pick_capacity(("decode",), need,
-                                             exclude=rep)
+                                             exclude=rep,
+                                             model=rep.model)
                 if target is None:
                     # No decode capacity this step; the sequence stays
                     # parked (blocks held at the prefill replica) and
@@ -798,7 +1064,9 @@ class ServeRouter:
             running = self._guard(rep, rep.engine.running_exportable)
             if running is None:
                 continue
-            pool = (("decode",) if self.cfg.n_prefill else ("unified",))
+            pool = (("decode",)
+                    if self._models[rep.model].n_prefill
+                    else ("unified",))
             for erid in running:
                 rid = rep.outstanding.get(erid)
                 if rid is None:
@@ -806,7 +1074,8 @@ class ServeRouter:
                 req = self._requests[rid]
                 need = rep.engine.allocator.blocks_for_tokens(
                     len(req.prompt) + req.max_new)
-                target = self._pick_capacity(pool, need, exclude=rep)
+                target = self._pick_capacity(pool, need, exclude=rep,
+                                             model=rep.model)
                 if target is None:
                     continue
                 if not self._move_seq(rep, erid, rid, target,
@@ -841,14 +1110,18 @@ class ServeRouter:
     def _pick_capacity(self, pool_role: Tuple[str, ...],
                        need_blocks: int,
                        exclude: Optional[_Replica] = None,
+                       model: str = DEFAULT_MODEL,
                        ) -> Optional[_Replica]:
-        """Least-loaded replica in ``pool_role`` with a batch slot AND
-        ``need_blocks`` of KV headroom — the handoff/migration target
-        filter (admission-queue room is irrelevant: an injected
-        sequence bypasses the queue)."""
+        """Least-loaded same-MODEL replica in ``pool_role`` with a
+        batch slot AND ``need_blocks`` of KV headroom — the handoff/
+        migration target filter (admission-queue room is irrelevant:
+        an injected sequence bypasses the queue). Pages only ever move
+        between replicas of one model group: a KV page is meaningless
+        under another model's weights."""
         cands = []
         for r in list(self._replicas):
-            if r.role not in pool_role or r.draining or r is exclude:
+            if (r.model != model or r.role not in pool_role
+                    or r.draining or r is exclude):
                 continue
             snap = self._guard(r, r.engine.admission_snapshot)
             if (snap is not None and snap["batch_slots_free"] > 0
@@ -876,11 +1149,58 @@ class ServeRouter:
         self._collect_handoffs()
         self._migrate_draining()
         self._place_queued()
-        for rep in list(self._replicas):
-            if rep in self._replicas and rep.engine.pending:
-                self._guard(rep, rep.engine.step)
+        self._step_replicas()
         self._collect_results()
         self._reap_drained()
+
+    def _step_replicas(self) -> None:
+        """Step every busy replica. Remote replicas' step RPCs FAN
+        OUT: the request frame goes to every busy worker first
+        (``step_begin``), in-process replicas step while the workers
+        compute, then the replies are collected — and applied — in
+        fleet order (``step_finish``). N workers therefore run their
+        iterations concurrently instead of serially per router step
+        (the measured loopback RPC tax was ~0.8x serial), while reply
+        application order stays the deterministic fleet order — never
+        network arrival order — so placement logs and results remain
+        seed-deterministic. A worker that died is detected at its send
+        OR its reply; either way ``_handle_dead`` requeues its work
+        exactly once."""
+        started: List[_Replica] = []
+        try:
+            # Remote begins FIRST (all of them), in-process steps
+            # second: the workers compute while the local engines run,
+            # instead of a leading local replica's full decode step
+            # delaying every worker's request frame.
+            for rep in list(self._replicas):
+                if (rep in self._replicas and rep.remote
+                        and rep.engine.pending):
+                    if self._guard(rep,
+                                   rep.engine.step_begin) is not None:
+                        started.append(rep)
+            for rep in list(self._replicas):
+                if (rep in self._replicas and not rep.remote
+                        and rep.engine.pending):
+                    self._guard(rep, rep.engine.step)
+            while started:
+                rep = started.pop(0)
+                if rep in self._replicas:
+                    self._guard(rep, rep.engine.step_finish)
+        except BaseException:
+            # A non-transport failure mid-fan-out (_guard only absorbs
+            # connection errors — e.g. a worker engine exception
+            # re-raised natively): the replicas still in `started`
+            # have an uncollected step reply on a STRICT
+            # request/response connection. Drain those replies
+            # best-effort before unwinding, or the next RPC on each
+            # would read a stale step beat as its own reply.
+            for rep in started:
+                if rep in self._replicas:
+                    try:
+                        rep.engine.step_finish()
+                    except Exception:
+                        pass
+            raise
 
     def _expire_queued(self, now: float) -> None:
         keep: collections.deque[_Pending] = collections.deque()
@@ -934,7 +1254,7 @@ class ServeRouter:
             # samples into the rollup — fleet totals and tails must
             # survive membership churn — then, for a worker process,
             # shut it down (the drain owns the worker's lifecycle).
-            self.metrics.absorb(r.engine.metrics)
+            self.metrics.absorb(r.engine.metrics, r.model)
             self._replicas.remove(r)
             if r.remote:
                 r.engine.shutdown()

@@ -476,13 +476,20 @@ class RpcConn:
 
     # -- request/response --------------------------------------------
 
-    def call(self, method: str, *args, **kwargs):
-        """One blocking RPC. Remote exceptions of known types
-        (ValueError, KeyError, the serve tier's structured rejections)
-        re-raise natively; anything else raises
-        :class:`RpcRemoteError`."""
+    def call_begin(self, method: str, *args, **kwargs) -> None:
+        """Write one request frame WITHOUT waiting for the reply — the
+        router's async step fan-out sends every busy worker's ``step``
+        first, then collects. Must be paired with exactly one
+        :meth:`call_finish` before any other call on this connection
+        (the channel is strict request/response)."""
         self.send({"t": "call", "m": method, "a": list(args),
                    "k": kwargs})
+
+    def call_finish(self):
+        """Collect the reply of a :meth:`call_begin`. Remote
+        exceptions of known types (ValueError, KeyError, the serve
+        tier's structured rejections) re-raise natively; anything else
+        raises :class:`RpcRemoteError`."""
         reply = self.recv()
         t = reply.get("t")
         if t == "ret":
@@ -491,6 +498,11 @@ class RpcConn:
             raise _rebuild_exception(reply)
         self.close()
         raise RpcProtocolError(f"unexpected reply type {t!r}")
+
+    def call(self, method: str, *args, **kwargs):
+        """One blocking RPC: ``call_begin`` + ``call_finish``."""
+        self.call_begin(method, *args, **kwargs)
+        return self.call_finish()
 
 
 def _exception_to_wire(e: BaseException) -> Dict[str, Any]:
@@ -705,6 +717,16 @@ def serve_cfg_to_wire(serve_cfg) -> Dict[str, Any]:
     for k in ("batch_buckets", "prefill_buckets"):
         if d[k] is not None:
             d[k] = list(d[k])
+    # Speculative sub-config: asdict() recursed into it with raw jnp
+    # dtype objects the value codec can't ship — rebuild it in wire
+    # shape (the draft model config marshals exactly like the target's).
+    draft = serve_cfg.draft
+    d["draft"] = (None if draft is None else {
+        "model_cfg": model_cfg_to_wire(draft.model_cfg),
+        "seed": int(draft.seed),
+        "cache_dtype": (None if draft.cache_dtype is None
+                        else np.dtype(draft.cache_dtype).name),
+    })
     return d
 
 
@@ -909,6 +931,22 @@ class RemoteReplica:
 
     def step(self) -> None:
         self._absorb_beat(self._conn.call("step"))
+
+    # -- async step fan-out (router._step_replicas) ------------------
+
+    def step_begin(self) -> bool:
+        """Fire the step request frame and return immediately; the
+        worker computes its iteration while the router steps other
+        replicas. MUST be paired with :meth:`step_finish` (and is —
+        the router pairs them within one `_step_replicas`). Returns
+        True so the router's `_guard` can distinguish success from a
+        detected death."""
+        self._conn.call_begin("step")
+        return True
+
+    def step_finish(self) -> None:
+        """Collect and apply a :meth:`step_begin`'s beat reply."""
+        self._absorb_beat(self._conn.call_finish())
 
     def result(self, rid: int):
         return self._results.get(rid)

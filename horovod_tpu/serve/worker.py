@@ -64,6 +64,19 @@ def _build_engine(model_cfg: Dict[str, Any], serve_cfg: Dict[str, Any],
     for k in ("batch_buckets", "prefill_buckets"):
         if sc.get(k) is not None:
             sc[k] = tuple(sc[k])
+    draft = sc.get("draft")
+    if draft is not None:
+        # Speculative sub-config: the worker rebuilds the draft model
+        # from (config, seed) exactly like it rebuilds the target —
+        # the engine's SpecDecoder does the init, so a cross-process
+        # speculative fleet agrees on the draft by construction.
+        from horovod_tpu.serve.speculative import DraftConfig
+        dmc = dict(draft["model_cfg"])
+        dmc["dtype"] = getattr(jnp, dmc["dtype"])
+        sc["draft"] = DraftConfig(
+            TransformerConfig(**dmc), seed=int(draft["seed"]),
+            cache_dtype=(None if draft["cache_dtype"] is None
+                         else getattr(jnp, draft["cache_dtype"])))
     return ServeEngine(cfg, params, ServeConfig(**sc),
                        instance=instance)
 

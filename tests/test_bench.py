@@ -149,6 +149,37 @@ def test_find_regressions_router_key_directions():
     assert set(regs2) == {"extra.serve_router_tokens_per_sec_per_chip"}
 
 
+def test_find_regressions_spec_key_directions():
+    """ISSUE 12 `serve_spec_*` keys: accept rate and tokens/sec gate
+    higher-is-better (an accept-rate collapse is a draft/acceptance
+    regression even when throughput hides it), `_ms` keys ride the
+    latency inversion, and the round tally (`_count`) is
+    direction-less and ungated."""
+    prev = {"extra": {"serve_spec_accept_rate": 0.95,
+                      "serve_spec_tokens_per_sec": 900.0,
+                      "serve_spec_over_plain": 1.8,
+                      "serve_spec_p99_first_token_ms": 50.0,
+                      "serve_spec_verify_rounds_count": 40.0}}
+    cur = {"extra": {"serve_spec_accept_rate": 0.40,      # flags
+                     "serve_spec_tokens_per_sec": 910.0,
+                     "serve_spec_over_plain": 1.9,
+                     "serve_spec_p99_first_token_ms": 120.0,  # flags
+                     "serve_spec_verify_rounds_count": 10.0}}  # silent
+    regs = bench.find_regressions(prev, cur)
+    assert set(regs) == {"extra.serve_spec_accept_rate",
+                         "extra.serve_spec_p99_first_token_ms"}
+    assert regs["extra.serve_spec_accept_rate"]["drop_pct"] > 50
+    assert regs["extra.serve_spec_p99_first_token_ms"]["rise_pct"] > 100
+    # The speedup ratio itself gates on drops like any throughput key.
+    cur2 = {"extra": {"serve_spec_accept_rate": 0.95,
+                      "serve_spec_tokens_per_sec": 900.0,
+                      "serve_spec_over_plain": 0.9,
+                      "serve_spec_p99_first_token_ms": 50.0,
+                      "serve_spec_verify_rounds_count": 40.0}}
+    assert set(bench.find_regressions(prev, cur2)) == \
+        {"extra.serve_spec_over_plain"}
+
+
 def test_find_regressions_threshold_boundary():
     prev = {"value": 100.0}
     assert bench.find_regressions(prev, {"value": 91.0}) == {}

@@ -17,7 +17,8 @@ import jax
 
 from horovod_tpu.models import TransformerConfig, init_transformer
 from horovod_tpu.serve import (
-    FleetSaturated, RouterConfig, ServeConfig, ServeEngine, ServeRouter,
+    FleetSaturated, QueueFull, RouterConfig, ServeConfig, ServeEngine,
+    ServeRouter,
 )
 
 
@@ -339,79 +340,241 @@ def test_cannot_remove_last_replica(served_model):
 
 
 # ---------------------------------------------------------------------------
+# Multi-model fleets (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+def test_add_model_validation(served_model):
+    cfg, params = served_model
+    router = _mk_router(served_model, n_replicas=1)
+    with pytest.raises(ValueError, match="already registered"):
+        router.add_model("default", cfg, params)
+    with pytest.raises(ValueError, match="n_prefill"):
+        router.add_model("b", cfg, params, n_replicas=1, n_prefill=1)
+    with pytest.raises(ValueError, match="unknown model"):
+        router.submit([1, 2, 3], 2, model="nope")
+    with pytest.raises(ValueError, match="unknown model"):
+        router.add_replica(model="nope")
+
+
+def test_multi_model_routing_isolation_and_parity(served_model):
+    """Two model groups (same config — an A/B fleet — so the whole
+    test shares the module's one compiled fn set): requests NEVER land
+    on the other group's replicas, each group's streams are bitwise
+    its single-engine reference, and the per-model rollups split the
+    traffic."""
+    cfg, params = served_model
+    prompts = _tenant_prompts()
+    router = _mk_router(served_model, n_replicas=2)
+    b_insts = set(router.add_model("b", cfg, params, n_replicas=2,
+                                   serve_cfg=ServeConfig(**_KW)))
+    a_insts = set(router.replicas) - b_insts
+    rids_a = [router.submit(p, 4) for p in prompts]
+    rids_b = [router.submit(p, 4, model="b") for p in prompts]
+    router.run_until_idle()
+    ref = _mk_engine(served_model).generate(prompts, 4)
+    assert [router.result(r).tokens for r in rids_a] == ref
+    assert [router.result(r).tokens for r in rids_b] == ref
+    # The wrong-model invariant, on every placement that happened.
+    placed = {rid: inst for rid, inst, _ in router.placement_log}
+    assert all(placed[r] in a_insts for r in rids_a)
+    assert all(placed[r] in b_insts for r in rids_b)
+    # Per-model rollups split the traffic; the fleet total covers both.
+    by_model = router.metrics.snapshot_by_model()
+    assert by_model["default"]["requests_finished"] == len(prompts)
+    assert by_model["b"]["requests_finished"] == len(prompts)
+    assert router.metrics.snapshot()["requests_finished"] \
+        == 2 * len(prompts)
+
+
+def test_multi_model_capacity_never_spills_across_groups(served_model):
+    """Group b saturated (1 replica, queue cap 2) while group a is
+    idle: b's overflow stays queued at the router — never placed on
+    a's replicas — and a's traffic keeps flowing past it (no
+    cross-model head-of-line blocking)."""
+    cfg, params = served_model
+    prompts = _tenant_prompts(n_per_tenant=4, n_tenants=1)
+    router = _mk_router(served_model, n_replicas=1)
+    b_insts = set(router.add_model(
+        "b", cfg, params, n_replicas=1,
+        serve_cfg=ServeConfig(**{**_KW, "max_queue": 2,
+                                 "max_batch": 1})))
+    rids_b = [router.submit(p, 2, model="b") for p in prompts]
+    rids_a = [router.submit(p, 2) for p in prompts]
+    router._place_queued()
+    placed = {rid: inst for rid, inst, _ in router.placement_log}
+    # All of a's requests placed despite b's backlog ahead of them in
+    # the router queue; b's spill stayed queued.
+    assert all(r in placed and placed[r] not in b_insts
+               for r in rids_a)
+    assert all(placed[r] in b_insts for r in rids_b if r in placed)
+    assert any(r not in placed for r in rids_b)   # spill stayed queued
+    router.run_until_idle()
+    assert all(router.result(r).status == "ok"
+               for r in rids_a + rids_b)
+
+
+def test_remove_last_model_replica_guard(served_model):
+    """The extended last-replica guard: a secondary model group CAN
+    drain to zero when workless (decommissioning), but the last
+    replica of a group with queued or in-flight work refuses, and the
+    single-model fleet's unconditional guard is unchanged."""
+    cfg, params = served_model
+    router = _mk_router(served_model, n_replicas=1)
+    (b_inst,) = router.add_model("b", cfg, params, n_replicas=1,
+                                 serve_cfg=ServeConfig(**_KW))
+    rid = router.submit([1, 2, 3], 2, model="b")
+    with pytest.raises(ValueError, match="last.*'b'.*queued"):
+        router.remove_replica(b_inst)
+    router.run_until_idle()
+    assert router.result(rid).status == "ok"
+    # Workless now: decommissioning the group is allowed...
+    router.remove_replica(b_inst)
+    router.step()   # the drained (empty) replica reaps this step
+    assert b_inst not in router.replicas
+    # ...after which submits for it reject with a structured error.
+    with pytest.raises(QueueFull) as ei:
+        router.submit([1, 2, 3], 2, model="b")
+    assert ei.value.reason == "no_replicas"
+    # The only remaining group keeps the unconditional guard.
+    with pytest.raises(ValueError, match="last"):
+        router.remove_replica(router.replicas[0])
+
+
+def test_fleet_model_label_rides_the_exposition(served_model):
+    """Per-model rollup series carry {fleet, model} labels next to the
+    fleet-wide {fleet} series, with the one-TYPE-line-per-family pin
+    intact."""
+    import re
+
+    from horovod_tpu.metrics import metrics_prometheus
+
+    cfg, params = served_model
+    router = _mk_router(served_model, n_replicas=1)
+    router.add_model("b", cfg, params, n_replicas=1,
+                     serve_cfg=ServeConfig(**_KW))
+    router.generate(_tenant_prompts(n_per_tenant=1), 2)
+    txt = metrics_prometheus()
+    fleet = re.escape(router.metrics.fleet)
+    assert re.search(
+        r'^serve_fleet_replicas\{fleet="%s"\} 2$' % fleet, txt, re.M)
+    assert re.search(
+        r'^serve_fleet_replicas\{fleet="%s",model="default"\} 1$'
+        % fleet, txt, re.M)
+    assert re.search(
+        r'^serve_fleet_replicas\{fleet="%s",model="b"\} 1$' % fleet,
+        txt, re.M)
+    fams = re.findall(r"^# TYPE (serve_fleet_replicas) gauge$", txt,
+                      re.M)
+    assert len(fams) == 1
+
+
+# ---------------------------------------------------------------------------
 # Randomized property test (the PR 4 allocator-stress spirit)
 # ---------------------------------------------------------------------------
 
 def _drive_property_run(served_model, seed):
     """One seeded run of the router property machine: random
-    submit/step/join/leave interleaving. Returns (placement_log,
-    {rid: (status, tokens)}, max queue depths seen)."""
+    submit/step/join/leave interleaving across TWO model groups
+    ("default" + "b", same geometry — one compiled fn set). Returns
+    (placement_log, {rid: (model, status, tokens)}, max queue depths,
+    saturation count, {instance: model})."""
+    cfg, params = served_model
     rng = np.random.RandomState(seed)
     clock = FakeClock()
     router = _mk_router(served_model, clock=clock, n_replicas=2,
                         max_queue=6, serve_kw={"max_batch": 2,
                                                "max_queue": 3})
+    router.add_model("b", cfg, params, n_replicas=1,
+                     serve_cfg=ServeConfig(**{**_KW, "max_batch": 2,
+                                              "max_queue": 3}))
+    inst_model = {i: router._replica(i).model for i in router.replicas}
     prefixes = [rng.randint(1, 256, size=8).tolist() for _ in range(3)]
-    submitted, saturated = [], 0
+    submitted, saturated = {}, 0
     for _ in range(60):
         op = rng.randint(4)
+        model = ("b" if rng.randint(2) else "default")
         if op == 0:                   # submit
             p = (prefixes[int(rng.randint(3))]
                  + rng.randint(1, 256,
                                size=int(rng.randint(1, 5))).tolist())
             cls = int(rng.randint(3))
             try:
-                submitted.append(router.submit(
-                    p, int(rng.randint(1, 4)), deadline_class=cls))
+                submitted[router.submit(
+                    p, int(rng.randint(1, 4)), deadline_class=cls,
+                    model=model)] = model
             except FleetSaturated:
                 saturated += 1
         elif op == 1:                 # step
             clock.advance(0.01)
             router.step()
-        elif op == 2 and len(router.replicas) < 4:   # join
-            router.add_replica()
-        elif op == 3:                 # leave (never the last one)
+        elif op == 2 and len(router.replicas) < 5:   # join
+            inst = router.add_replica(model=model)
+            inst_model[inst] = model
+        elif op == 3:                 # leave (keep every group alive)
             live = [i for i in router.replicas
                     if not router._replica(i).draining]
             if len(live) > 1:
-                router.remove_replica(live[int(rng.randint(len(live)))])
+                victim = live[int(rng.randint(len(live)))]
+                vm = router._replica(victim).model
+                if sum(1 for i in live
+                       if router._replica(i).model == vm) > 1:
+                    try:
+                        router.remove_replica(victim)
+                    except ValueError:
+                        pass   # guarded: last of a group with work
     router.run_until_idle()
-    results = {rid: (router.result(rid).status,
+    results = {rid: (model, router.result(rid).status,
                      tuple(router.result(rid).tokens))
-               for rid in submitted}
+               for rid, model in submitted.items()}
     depths = [e.metrics.max_queue_depth for e in router.engines]
-    return router.placement_log, results, depths, saturated
+    return (router.placement_log, results, depths, saturated,
+            inst_model)
 
 
 def test_router_randomized_property(served_model):
-    """Invariants under random submit/step/join/leave interleaving:
+    """Invariants under random submit/step/join/leave interleaving
+    across two model groups:
 
     * every submitted request resolves to EXACTLY one result — none
       dropped (even across replica drains), none duplicated;
     * non-shed results are complete ("ok" with tokens — no deadlines
       were set, so nothing expires);
+    * no placement EVER lands on a wrong-model replica;
     * no engine's admission queue ever exceeded its cap (affinity and
       fallback both respect capacity);
     * the whole run — placements included — is deterministic for a
       fixed seed.
     """
-    log1, results1, depths1, sat1 = _drive_property_run(served_model, 7)
+    log1, results1, depths1, sat1, inst_model = \
+        _drive_property_run(served_model, 7)
     assert results1, "property run submitted nothing"
-    for rid, (status, tokens) in results1.items():
+    models_seen = set()
+    for rid, (model, status, tokens) in results1.items():
+        models_seen.add(model)
         assert status in ("ok", "shed"), (rid, status)
         if status == "ok":
             assert len(tokens) >= 1
         else:
             assert tokens == ()
+    assert models_seen == {"default", "b"}, \
+        "property run never exercised both model groups"
+    # The wrong-model invariant over every placement that happened.
+    req_model = {rid: m for rid, (m, _s, _t) in results1.items()}
+    placed_models = set()
+    for rid, inst, _match in log1:
+        assert inst_model[inst] == req_model[rid], (rid, inst)
+        placed_models.add(req_model[rid])
+    assert placed_models == {"default", "b"}
     assert all(d <= 3 for d in depths1), depths1
     # Determinism: same seed, same machine evolution, bit for bit.
-    log2, results2, depths2, sat2 = _drive_property_run(served_model, 7)
+    log2, results2, depths2, sat2, _ = \
+        _drive_property_run(served_model, 7)
     assert log1 == log2
     assert results1 == results2
     assert sat1 == sat2
     # A different seed takes a different trajectory (the test isn't
     # vacuously comparing two empty runs).
-    log3, results3, _, _ = _drive_property_run(served_model, 8)
+    log3, results3, _, _, _ = _drive_property_run(served_model, 8)
     assert (log3, results3) != (log1, results1)
 
 

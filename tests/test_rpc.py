@@ -486,6 +486,198 @@ def test_worker_death_mid_drain_drops_nothing(served_model):
         router.close()
 
 
+def test_remote_spec_fleet_parity_with_mid_trace_drain(served_model):
+    """Acceptance (ISSUE 12): a speculative cross-RPC fleet — workers
+    rebuild target AND draft from (config, seed) via configure — emits
+    bitwise the plain in-process streams, through a mid-trace
+    migrating drain (target pages move; the survivor's draft catches
+    up from the migrated stream)."""
+    from horovod_tpu.serve.speculative import DraftConfig
+
+    cfg, params = served_model
+    prompts = _prompts(n_per_tenant=2)
+    ref = ServeEngine(cfg, params, ServeConfig(**_KW)).generate(prompts, 6)
+    spec_kw = {"draft": DraftConfig(cfg, seed=1), "spec_k": 3}
+    router, workers = _mk_remote_router(served_model, 3,
+                                        serve_kw=spec_kw)
+    try:
+        rids = [router.submit(p, 6) for p in prompts]
+        router.step()
+        router.step()
+        victim = router.replicas[0]
+        router.remove_replica(victim, migrate_running=True)
+        router.run_until_idle()
+        assert victim not in router.replicas
+        assert router.metrics.migrations > 0
+        assert [router.result(r).tokens for r in rids] == ref
+        # The speculative counters crossed the process boundary into
+        # the fleet rollup (worker-side engines ran the spec rounds).
+        snap = router.metrics.snapshot()
+        assert snap["spec_proposed_total"] > 0
+        assert 0 <= snap["spec_accept_rate"] <= 1
+    finally:
+        router.close()
+
+
+def test_async_step_fanout_order_and_determinism(served_model):
+    """The async step fan-out: within one router step, every busy
+    remote replica's step request is SENT before any reply is
+    collected (the workers compute concurrently), replies apply in
+    fleet order, and two identically-seeded runs stay bit-identical —
+    placement log included."""
+    from horovod_tpu.serve.rpc import RemoteReplica
+
+    events = []
+    orig_begin = RemoteReplica.step_begin
+    orig_finish = RemoteReplica.step_finish
+
+    def spy_begin(self):
+        events.append(("begin", self.instance))
+        return orig_begin(self)
+
+    def spy_finish(self):
+        events.append(("finish", self.instance))
+        return orig_finish(self)
+
+    def run():
+        router, _workers = _mk_remote_router(served_model, 2)
+        try:
+            rids = [router.submit(p, 4) for p in _prompts()]
+            router.run_until_idle()
+            return ([router.result(r).tokens for r in rids],
+                    list(router.placement_log))
+        finally:
+            router.close()
+
+    RemoteReplica.step_begin = spy_begin
+    RemoteReplica.step_finish = spy_finish
+    try:
+        streams1, log1 = run()
+        # Find a step where both replicas were busy: the event stream
+        # must show begin,begin,...,finish,finish — never
+        # begin,finish,begin,finish (that is the serial shape the
+        # fan-out replaces).
+        overlapped = any(
+            events[i][0] == "begin" and events[i + 1][0] == "begin"
+            for i in range(len(events) - 1))
+        assert overlapped, events[:12]
+        # Replies applied in fleet order within every step.
+        finishes = [inst for kind, inst in events if kind == "finish"]
+        begins = [inst for kind, inst in events if kind == "begin"]
+        assert sorted(finishes) == sorted(begins)
+        streams2, log2 = run()
+        assert streams1 == streams2
+        assert log1 == log2
+    finally:
+        RemoteReplica.step_begin = orig_begin
+        RemoteReplica.step_finish = orig_finish
+
+
+def test_remote_multi_model_group(served_model):
+    """add_model with worker handles: a second model group served by a
+    remote replica gets its own configure (the worker rebuilds THAT
+    group's engine), requests route by model, streams match the
+    reference."""
+    cfg, params = served_model
+    prompts = _prompts(n_per_tenant=1)
+    ref = ServeEngine(cfg, params, ServeConfig(**_KW)).generate(prompts, 3)
+    router, _workers = _mk_remote_router(served_model, 1)
+    try:
+        b_insts = router.add_model(
+            "b", cfg, None, serve_cfg=ServeConfig(**_KW),
+            n_replicas=1, workers=[_thread_worker()], worker_seed=0)
+        rids_a = [router.submit(p, 3) for p in prompts]
+        rids_b = [router.submit(p, 3, model="b") for p in prompts]
+        router.run_until_idle()
+        assert [router.result(r).tokens for r in rids_a] == ref
+        assert [router.result(r).tokens for r in rids_b] == ref
+        placed = {rid: inst for rid, inst, _ in router.placement_log}
+        assert all(placed[r] in b_insts for r in rids_b)
+        assert all(placed[r] not in b_insts for r in rids_a)
+    finally:
+        router.close()
+
+
+def test_death_right_after_same_pass_placement_loses_nothing(
+        served_model):
+    """Regression (review): a worker that dies immediately after
+    accepting a placement — so the SAME placement pass both placed a
+    request on it and (via _handle_dead on a later RPC) requeued that
+    request — must still resolve it exactly once on a survivor. The
+    end-of-pass queue rebuild used to filter the requeued copy out
+    with the stale one, stranding the request forever."""
+    cfg, params = served_model
+    prompts = _prompts(n_per_tenant=2)
+    ref = ServeEngine(cfg, params, ServeConfig(**_KW)).generate(prompts, 3)
+    router, _workers = _mk_remote_router(served_model, 2)
+    try:
+        rids = [router.submit(p, 3) for p in prompts]
+        rep = router._replicas[0]
+        orig_submit = rep.engine.submit
+
+        def dying_submit(*a, **k):
+            erid = orig_submit(*a, **k)
+            rep.engine.mark_dead()   # dies with the placement booked
+            return erid
+
+        rep.engine.submit = dying_submit
+        router.run_until_idle()
+        res = [router.result(r) for r in rids]
+        assert all(x is not None and x.status == "ok" for x in res), \
+            [None if x is None else x.status for x in res]
+        assert [x.tokens for x in res] == ref
+        assert len({x.rid for x in res}) == len(rids)
+        snap = router.metrics.snapshot()
+        assert snap["worker_deaths"] == 1
+        assert snap["requeued_total"] > 0
+    finally:
+        router.close()
+
+
+def test_dead_worker_requeue_stays_same_model(served_model):
+    """Acceptance (ISSUE 12): in a two-model remote fleet, a crashed
+    worker's uncollected requests re-place ONLY on same-model
+    survivors and resolve exactly once with the reference streams —
+    the other group's traffic is untouched."""
+    cfg, params = served_model
+    prompts = _prompts(n_per_tenant=2)
+    ref = ServeEngine(cfg, params, ServeConfig(**_KW)).generate(prompts, 4)
+    router, workers = _mk_remote_router(served_model, 1)
+    try:
+        b_workers = [_thread_worker(), _thread_worker()]
+        b_insts = set(router.add_model(
+            "b", cfg, None, serve_cfg=ServeConfig(**_KW),
+            n_replicas=2, workers=b_workers, worker_seed=0))
+        rids_a = [router.submit(p, 4) for p in prompts]
+        rids_b = [router.submit(p, 4, model="b") for p in prompts]
+        router.step()
+        # Crash the b worker that holds placed work.
+        victims = [r for r in router._replicas
+                   if r.instance in b_insts and r.outstanding]
+        assert victims, "no b replica held work — test would be vacuous"
+        victims[0].engine.mark_dead()
+        router.run_until_idle()
+        res_a = [router.result(r) for r in rids_a]
+        res_b = [router.result(r) for r in rids_b]
+        assert all(x is not None and x.status == "ok"
+                   for x in res_a + res_b)
+        assert [x.tokens for x in res_a] == ref
+        assert [x.tokens for x in res_b] == ref
+        assert len({x.rid for x in res_a + res_b}) \
+            == len(rids_a) + len(rids_b)
+        # Every placement — requeued re-placements included — stayed
+        # inside the request's model group.
+        for rid, inst, _m in router.placement_log:
+            want = "b" if rid in rids_b else "default"
+            got = "b" if inst in b_insts else "default"
+            assert got == want, (rid, inst)
+        snap = router.metrics.snapshot()
+        assert snap["worker_deaths"] == 1
+        assert snap["requeued_total"] > 0
+    finally:
+        router.close()
+
+
 def test_remote_deadline_reanchors_across_clocks(served_model):
     """Absolute deadlines are router-clock times; the wire carries
     time-remaining and the worker re-anchors onto its own clock — an
@@ -547,6 +739,40 @@ def test_router_scrape_spans_worker_processes(served_model):
 # ---------------------------------------------------------------------------
 # Cross-process tier (slow): real worker processes
 # ---------------------------------------------------------------------------
+
+@pytest.mark.slow  # ~3 worker processes x (jax import + compile); the
+# in-thread spec fleet test above pins the identical dispatch tier-1.
+def test_cross_process_speculative_fleet_parity_with_drain(served_model):
+    """Acceptance (ISSUE 12): a SPECULATIVE cross-process fleet —
+    every worker process rebuilds target AND draft from (config, seed)
+    — emits bitwise the plain in-process streams through a mid-trace
+    migrating drain."""
+    from horovod_tpu.serve.rpc import spawn_worker
+    from horovod_tpu.serve.speculative import DraftConfig
+
+    cfg, params = served_model
+    prompts = _prompts(n_per_tenant=2)
+    ref = ServeEngine(cfg, params, ServeConfig(**_KW)).generate(prompts, 6)
+    sc = ServeConfig(**_KW, draft=DraftConfig(cfg, seed=1), spec_k=3)
+    workers = [spawn_worker() for _ in range(3)]
+    try:
+        router = ServeRouter(cfg, None, RouterConfig(n_replicas=3), sc,
+                             workers=workers, worker_seed=0)
+        rids = [router.submit(p, 6) for p in prompts]
+        router.step()
+        router.step()
+        victim = router.replicas[0]
+        router.remove_replica(victim, migrate_running=True)
+        router.run_until_idle()
+        assert router.metrics.migrations > 0
+        assert [router.result(r).tokens for r in rids] == ref
+        snap = router.metrics.snapshot()
+        assert snap["spec_proposed_total"] > 0
+        router.close()
+    finally:
+        for w in workers:
+            w.kill()
+
 
 @pytest.mark.slow  # ~4 worker processes x (jax import + tiny compile);
 # the in-thread tier above pins the identical router/dispatch logic in

@@ -7,6 +7,7 @@ import pytest
 from horovod_tpu.serve.bench import (
     make_multi_tenant_trace, make_shared_prefix_trace, make_trace,
     run_prefix_benchmark, run_router_benchmark, run_serving_benchmark,
+    run_spec_benchmark,
 )
 
 
@@ -122,6 +123,31 @@ def test_continuous_beats_static_on_mixed_trace():
     assert out["serve_continuous_over_static"] >= 1.2
     assert (out["serve_chunked_p99_per_token_ms"]
             <= 1.10 * out["serve_p99_per_token_ms"])
+
+
+@pytest.mark.slow
+def test_speculative_beats_plain_decode():
+    """Acceptance (ISSUE 12 slow-tier gate): on the decode-heavy
+    multi-tenant trace, the idealized draft/target pair (accept rate
+    1.0 by construction — pinned tier-1 by test_speculative.py's
+    zero-contribution test) beats plain decode on tokens/sec at
+    equal-or-better p99 first-token. Structural claims (bitwise
+    parity, accept rate) hold on every attempt; the two perf
+    orderings get the repo's best-of-3 weather allowance (the spec
+    arm runs ~1/k of the target weight passes per token, so only
+    severe scheduler interference can invert them)."""
+    for _ in range(3):
+        out = run_spec_benchmark(n_requests=24, repeats=3)
+        assert out["serve_spec_tokens_identical"]
+        assert out["serve_spec_accept_rate"] > 0.95
+        assert out["serve_spec_verify_rounds_count"] > 0
+        perf_ok = (
+            out["serve_spec_over_plain"] > 1.0
+            and (out["serve_spec_p99_first_token_ms"]
+                 <= out["serve_spec_plain_p99_first_token_ms"]))
+        if perf_ok:
+            break
+    assert perf_ok, out
 
 
 @pytest.mark.slow
