@@ -207,10 +207,13 @@ def _bus_wire_worker():
 
 def _bus_algo_worker():
     """Per-rank body of the algorithm-selection busbw case: one TCP
-    job, each payload size measured under every algorithm arm with the
-    arms round-robined (best round per arm). Rank 0 also dumps the
-    default selection table for this np so the bench record shows WHAT
-    the auto path would pick alongside how each arm measured."""
+    job (HOROVOD_TOPOLOGY_PROBE=force, so a fresh measured model is
+    live), each payload size measured under every algorithm arm PLUS
+    the measured-model "auto" arm and the hand-band verdict arm, all
+    round-robined (best round per arm). Rank 0 dumps the default AND
+    synthesized selection tables plus the probe cost, so the bench
+    record proves which verdicts the measured model changed and what
+    each choice measured."""
     import ctypes
 
     import numpy as np
@@ -220,41 +223,68 @@ def _bus_algo_worker():
 
     hvd.init()
     r, s = hvd.rank(), hvd.size()
+    lib = get_lib()
+
+    def default_name(n_bytes):
+        return lib.hvd_algo_name(lib.hvd_algo_select(
+            ctypes.c_int64(n_bytes), s, 0,
+            ctypes.c_int64(256 * 1024))).decode()
+
     best = {}
     for n_bytes, label, iters in BUS_ALGO_SIZES:
         n = n_bytes // 4
         x = np.ones(n, np.float32)
-        for a in BUS_ALGO_ARMS:
+        # The comparison arms: `measured` rides algorithm=None (auto →
+        # the cost model, since the probe is forced on), `handbands`
+        # forces the hand-seeded default verdict per op — the measured-
+        # vs-default sweep the acceptance gate audits.
+        arms = list(BUS_ALGO_ARMS) + [
+            ("measured", None), ("handbands", default_name(n_bytes))]
+        arms = [(a, a) if isinstance(a, str) else a for a in arms]
+        for tag, a in arms:
             for _ in range(2):
-                hvd.allreduce(x, op=hvd.Sum, name=f"ba.{label}.{a}",
+                hvd.allreduce(x, op=hvd.Sum, name=f"ba.{label}.{tag}",
                               algorithm=a)
         for _ in range(BUS_ALGO_ROUNDS):
-            for a in BUS_ALGO_ARMS:
+            for tag, a in arms:
                 t0 = time.perf_counter()
                 for _ in range(iters):
-                    hvd.allreduce(x, op=hvd.Sum, name=f"ba.{label}.{a}",
+                    hvd.allreduce(x, op=hvd.Sum, name=f"ba.{label}.{tag}",
                                   algorithm=a)
                 dt = time.perf_counter() - t0
-                key = (label, a)
+                key = (label, tag)
                 best[key] = min(best.get(key, dt), dt)
     if r == 0:
-        lib = get_lib()
-        results = {a: {} for a in BUS_ALGO_ARMS}
+        results = {a: {} for a in
+                   list(BUS_ALGO_ARMS) + ["measured", "handbands"]}
         for n_bytes, label, iters in BUS_ALGO_SIZES:
-            for a in BUS_ALGO_ARMS:
-                bw = (n_bytes * iters / best[(label, a)]) / 1e9
-                results[a][label] = round(bw * 2 * (s - 1) / s, 3)
-        # Default selection table for this np (the auto path's verdict
-        # per log2 payload bucket, at the default ring threshold).
-        table = {}
+            for tag in results:
+                bw = (n_bytes * iters / best[(label, tag)]) / 1e9
+                results[tag][label] = round(bw * 2 * (s - 1) / s, 3)
+        # Selection tables per log2 payload bucket: the hand bands'
+        # verdicts and the measured model's (the synthesized table) —
+        # diffing the two is the audit trail of what the probe changed.
+        table, synth_table, audit = {}, {}, {}
         for lg in range(10, 27):
-            algo = lib.hvd_algo_select(ctypes.c_int64(1 << lg), s, 0,
-                                       ctypes.c_int64(256 * 1024))
-            table[f"{1 << lg}"] = lib.hvd_algo_name(algo).decode()
+            nb = 1 << lg
+            dflt = default_name(nb)
+            meas = lib.hvd_algo_select_measured(
+                ctypes.c_int64(nb), s, 0, ctypes.c_int64(256 * 1024))
+            mname = lib.hvd_algo_name(meas).decode() if meas >= 0 else dflt
+            table[f"{nb}"] = dflt
+            synth_table[f"{nb}"] = mname
+            if mname != dflt:
+                audit[f"{nb}"] = {"default": dflt, "measured": mname}
         results["table"] = table
+        results["synth_table"] = synth_table
+        results["audit"] = audit
+        results["topology_probe_ms"] = hvd.metrics()["topology_probe_ms"]
         print("ALGO-TABLE np=%d: %s" % (
             s, ", ".join(f"{int(k)//1024}KB={v}" for k, v in table.items())),
             flush=True)
+        print("SYNTH-TABLE np=%d: %s" % (
+            s, ", ".join(f"{int(k)//1024}KB={v}"
+                         for k, v in synth_table.items())), flush=True)
         print("BUSALGO " + json.dumps(results), flush=True)
     hvd.shutdown()
 
@@ -324,9 +354,14 @@ def _bus_wire_bandwidth():
 
 def _bus_algo_bandwidth():
     """The np=4 TCP algorithm-selection job (shm disabled so the
-    algorithms actually run the mesh); {algo: {size: GB/s}, table}."""
+    algorithms actually run the mesh; topology probe FORCED so the
+    measured-model arm reflects this draw's links, not a stale cache);
+    {algo: {size: GB/s}, table, synth_table, audit,
+    topology_probe_ms}."""
     return _bus_job("--bus-algo-worker", "BUSALGO",
-                    extra_env={"HOROVOD_SHM_DISABLE": "1"}, timeout=180)
+                    extra_env={"HOROVOD_SHM_DISABLE": "1",
+                               "HOROVOD_TOPOLOGY_PROBE": "force"},
+                    timeout=240)
 
 
 def _transformer_worker():
@@ -594,7 +629,7 @@ LOWER_IS_BETTER_SUFFIXES = ("_ms",)
 # router's hit-rate/throughput keys gate higher-is-better and its
 # *_ms keys ride the latency inversion above.
 UNGATED_SUFFIXES = ("_steps", "_evictions", "_high_water", "_us_p99",
-                    "_fill_pct", "_count")
+                    "_fill_pct", "_count", "_probe_ms")
 
 
 def find_regressions(prev, cur, threshold=0.10):
@@ -800,12 +835,28 @@ def main():
         algo = _bus_algo_bandwidth()
         if algo is not None:
             table = algo.pop("table", None)
+            synth_table = algo.pop("synth_table", None)
+            audit = algo.pop("audit", None)
+            probe_ms = algo.pop("topology_probe_ms", None)
             for arm, vals in algo.items():
                 extra[f"host_allreduce_busbw_{arm}_gbps_np4"] = vals
             if table:
                 # Strings, so the regression gate ignores them — the
                 # record simply shows what auto would pick per bucket.
                 extra["collective_algo_table_np4"] = table
+            if synth_table:
+                # The measured model's verdicts next to the hand
+                # bands', with the changed buckets called out — the
+                # audit trail proving which selections the probe moved
+                # (the measured/handbands busbw arms above show what
+                # each choice was worth).
+                extra["collective_algo_synth_table_np4"] = synth_table
+                extra["collective_algo_audit_np4"] = audit or {}
+            if probe_ms is not None:
+                # Probe cost rides the record ungated (_probe_ms in
+                # UNGATED_SUFFIXES): tracked, but ±30% box swings make
+                # a 10% gate on a ~40 ms measurement pure weather.
+                extra["topology_probe_ms"] = probe_ms
     remaining = budget - (time.perf_counter() - _T0)
     if extras_on and remaining > 30:
         tf = _transformer_extra(remaining)

@@ -59,6 +59,8 @@ from horovod_tpu.api import (  # noqa: F401
     reduce_threads,
     set_reduce_threads,
     collective_algo,
+    topology,
+    topology_probe,
     allreduce,
     allreduce_async,
     grouped_allreduce,

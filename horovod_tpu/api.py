@@ -27,7 +27,8 @@ __all__ = [
     "start_timeline", "stop_timeline", "reduce_threads",
     "set_reduce_threads", "metrics", "metrics_prometheus",
     "metrics_aggregate", "metrics_reset", "stalled_tensors",
-    "start_metrics_server",
+    "start_metrics_server", "collective_algo", "topology",
+    "topology_probe",
 ]
 
 
@@ -234,6 +235,54 @@ def collective_algo() -> str:
     any autotuner retarget."""
     lib = basics.get_lib()
     return lib.hvd_algo_name(lib.hvd_collective_algo()).decode()
+
+
+def topology():
+    """The measured alpha-beta link model driving schedule synthesis
+    and measured algorithm selection (docs/perf_tuning.md "Measured
+    topology & schedule synthesis"), or ``None`` when no model exists
+    (``HOROVOD_TOPOLOGY_PROBE=off``, single-process jobs, or a failed
+    probe). Every rank holds the identical broadcast numbers.
+
+    Returns ``{"np": P, "alpha_us": [[...]], "beta_us_per_byte":
+    [[...]]}`` with ``alpha_us[src][dst]`` the measured one-way launch
+    latency and ``beta_us_per_byte[src][dst]`` the inverse bandwidth of
+    the src→dst data link."""
+    import ctypes
+
+    lib = basics.get_lib()
+    np_ = lib.hvd_topology(None, None, 0)
+    if np_ <= 0:
+        return None
+    n2 = np_ * np_
+    alpha = (ctypes.c_double * n2)()
+    beta = (ctypes.c_double * n2)()
+    lib.hvd_topology(alpha, beta, n2)
+    return {
+        "np": np_,
+        "alpha_us": [[alpha[s * np_ + d] for d in range(np_)]
+                     for s in range(np_)],
+        "beta_us_per_byte": [[beta[s * np_ + d] for d in range(np_)]
+                             for s in range(np_)],
+    }
+
+
+def topology_probe() -> float:
+    """Re-run the pairwise link probe NOW and install the fresh model
+    on every rank (rank 0 also rewrites the disk cache).
+
+    COLLECTIVE CONTRACT: every rank must call this, with no collectives
+    in flight — the probe's ping-pong rounds ride the same quiet data
+    links the exchanges use. Returns the probe wall-clock in
+    milliseconds; raises on failure (all ranks then agree there is no
+    model and selection falls back to the hand-seeded bands)."""
+    ms = float(basics.get_lib().hvd_topology_probe())
+    if ms < 0:
+        raise RuntimeError(
+            "topology probe failed (single-process job, lost data link, "
+            "or a rank measured garbage); selection falls back to the "
+            "hand-seeded bands")
+    return ms
 
 
 def _group_key(names: Sequence[str]) -> int:

@@ -38,7 +38,7 @@ EXEC_CALLBACK = 1
 # is enforced at library load below, and tests/test_wire_abi.py greps
 # the header so a native bump can't silently skew this shim even
 # before a rebuild happens.
-ABI_VERSION = 8
+ABI_VERSION = 9
 WIRE_VERSION_REQUEST_LIST = 3
 WIRE_VERSION_RESPONSE_LIST = 6
 
@@ -46,7 +46,7 @@ WIRE_VERSION_RESPONSE_LIST = 6
 # kMetricsVersion): the packed int64 layout hvd_metrics_snapshot
 # writes. Checked at library load AND against the header by
 # tests/test_metrics_abi.py, the same two-sided pin as the ABI above.
-METRICS_VERSION = 3
+METRICS_VERSION = 4
 
 # Native WireCodec ids (native/include/hvd/codec.h); -1 = follow the
 # job-wide HOROVOD_WIRE_COMPRESSION default.
@@ -67,6 +67,14 @@ COLLECTIVE_ALGOS = {
     "doubling": 4,
     "hier": 5,
 }
+
+
+# Native CollKind ids (native/include/hvd/schedule.h): the collective
+# a chunk-op table expresses, for hvd_build_coll_schedule.
+COLL_ALLREDUCE = 0
+COLL_ALLGATHER = 1
+COLL_REDUCESCATTER = 2
+COLL_ALLTOALL = 3
 
 
 def collective_algo_id(algorithm) -> int:
@@ -340,6 +348,30 @@ def _declare_abi(lib: ctypes.CDLL, path: str) -> ctypes.CDLL:
     lib.hvd_algo_name.restype = ctypes.c_char_p
     lib.hvd_algo_name.argtypes = [ctypes.c_int]
     lib.hvd_collective_algo.restype = ctypes.c_int
+    # Measured-topology surface (ABI v9, docs/perf_tuning.md "Measured
+    # topology & schedule synthesis"): the alpha-beta link model, the
+    # on-demand re-probe, the measured selection verdict, the native
+    # cost walk, and the any-collective table builder tools/synth.py
+    # and the promoted verifier enumerate.
+    lib.hvd_topology.restype = ctypes.c_int
+    lib.hvd_topology.argtypes = [ctypes.POINTER(ctypes.c_double),
+                                 ctypes.POINTER(ctypes.c_double),
+                                 ctypes.c_int]
+    lib.hvd_topology_probe.restype = ctypes.c_double
+    lib.hvd_topology_probe.argtypes = []
+    lib.hvd_algo_select_measured.restype = ctypes.c_int
+    lib.hvd_algo_select_measured.argtypes = [ctypes.c_int64, ctypes.c_int,
+                                             ctypes.c_int, ctypes.c_int64]
+    lib.hvd_algo_cost_us.restype = ctypes.c_double
+    lib.hvd_algo_cost_us.argtypes = [ctypes.c_int, ctypes.c_int64,
+                                     ctypes.c_int, ctypes.c_int,
+                                     ctypes.c_int]
+    lib.hvd_build_coll_schedule.restype = ctypes.c_int
+    lib.hvd_build_coll_schedule.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int]
     return lib
 
 

@@ -24,6 +24,7 @@
 #include <cstdint>
 #include <map>
 #include <memory>
+#include <mutex>
 #include <set>
 #include <string>
 #include <unordered_map>
@@ -35,7 +36,9 @@
 #include "hvd/stall_inspector.h"
 #include "hvd/tcp.h"
 #include "hvd/tensor_queue.h"
+#include "hvd/thread_annotations.h"
 #include "hvd/timeline.h"
+#include "hvd/topology.h"
 
 namespace hvd {
 
@@ -138,6 +141,25 @@ class Controller {
   // like the thresholds, retargetable live by the autotuner's
   // algorithm dimension.
   int collective_algo_ = 0;
+  // Synthesis parameters for the generated tables (hvd/schedule.h):
+  // stripe count for kAlgoStriped, sub-chunks per ring shard, and the
+  // halving-doubling recursion ordering. Synced like the thresholds —
+  // all ranks must generate the SAME table or the exchange deadlocks —
+  // and seeded from HOROVOD_COLLECTIVE_STRIPES / _GRANULARITY /
+  // HOROVOD_HD_ORDER (tools/synth.py's hand-off surface).
+  int collective_stripes_ = 2;
+  int collective_granularity_ = 1;
+  int hd_order_ = 0;
+  // Topology-probe verdict (rank 0's HOROVOD_TOPOLOGY_PROBE parse,
+  // synced as param field 12): 0 = off, 1 = probe, 2 = cached blob
+  // follows the param sync on the data links.
+  int topo_mode_ = 0;
+  // Measured alpha-beta link model (hvd/topology.h), identical on
+  // every rank (broadcast as one serialized blob). Guarded: the API
+  // thread may re-probe (hvd_topology_probe) while the coordinator
+  // cycle reads it for selection.
+  mutable std::mutex topo_mu_;
+  std::shared_ptr<const TopologyModel> topo_model_ HVD_GUARDED_BY(topo_mu_);
 
  public:
   void SetFusionThreshold(int64_t bytes) { fusion_threshold_bytes_ = bytes; }
@@ -183,11 +205,43 @@ class Controller {
     collective_algo_ = a < 0 ? 0 : (a > 5 ? 0 : a);
   }
   int collective_algo() const { return collective_algo_; }
+  // Schedule synthesis parameters (synced; see the fields above).
+  void SetCollectiveStripes(int k) {
+    collective_stripes_ = k < 1 ? 1 : (k > 8 ? 8 : k);
+  }
+  int collective_stripes() const { return collective_stripes_; }
+  void SetCollectiveGranularity(int g) {
+    collective_granularity_ = g < 1 ? 1 : (g > 8 ? 8 : g);
+  }
+  int collective_granularity() const { return collective_granularity_; }
+  void SetHdOrder(int o) { hd_order_ = o == 1 ? 1 : 0; }
+  int hd_order() const { return hd_order_; }
+  // Measured link model (hvd/topology.h). Set collectively — the
+  // probe broadcasts one blob, so every rank installs identical
+  // numbers; a null/invalid model falls selection back to the bands.
+  void SetTopologyModel(TopologyModel m) {
+    auto p = m.valid() ? std::make_shared<const TopologyModel>(std::move(m))
+                       : std::shared_ptr<const TopologyModel>();
+    std::lock_guard<std::mutex> lock(topo_mu_);
+    topo_model_ = std::move(p);
+  }
+  std::shared_ptr<const TopologyModel> topology_model() const {
+    std::lock_guard<std::mutex> lock(topo_mu_);
+    return topo_model_;
+  }
   // Resolve the algorithm for one ALLREDUCE response: request override
   // > job-wide force (env / autotuner) > the default table — every
   // input coordinator-side or synced, so the verdict is job-unique.
   int ResolveCollectiveAlgo(int request_algo, int64_t payload_bytes,
                             int ncontributors) const;
+  // The "auto" leg of the resolution, shared with the executor-side
+  // fallback in ops.cc (same synced inputs on every rank): measured
+  // cost-model verdict when a model covering the full world exists,
+  // else ResolveAlgoDefault's hand bands. Join-shrunk contributor
+  // sets always ride the bands — the model's positions are world
+  // ranks.
+  int ResolveAlgoAuto(int64_t payload_bytes, int ncontributors,
+                      bool hier_ok) const;
   // Hierarchical allreduce: rank 0's env decides the request; the
   // value is only TRUE after Initialize when every rank's topology
   // fits the node-major layout (the verdict is broadcast — a per-rank

@@ -32,12 +32,16 @@ namespace hvd {
 // Snapshot layout version (bump on any enum/table/layout change) and
 // bucket count. Pinned by horovod_tpu/common/basics.py +
 // tests/test_metrics_abi.py.
+// v4: measured-topology surface (topology_probes_total,
+// collective_measured_selects_total, topology_probe_ms /
+// topology_links_measured gauges) and the tcp_alltoall_us histogram
+// (the pairwise exchange now rides the span-schedule interpreter).
 // v3: vectored-transport counters (tcp_sendv_calls_total,
 // tcp_recvv_calls_total, tcp_zerocopy_sends_total) and the
 // tcp_zerocopy_mode gauge (resolved transport mode).
 // v2: per-algorithm TCP allreduce counters (tcp_algo_*_ops_total) and
 // the hd/striped schedule-interpreter phase histograms.
-constexpr int kMetricsVersion = 3;
+constexpr int kMetricsVersion = 4;
 constexpr int kMetricsHistBuckets = 28;  // le = 2^0 .. 2^26, then +Inf
 
 // Monotonic counters (suffix _total) and point-in-time gauges (filled
@@ -91,6 +95,10 @@ enum MetricCounter : int {
   kCtrAlgoStripedOps,
   kCtrAlgoDoublingOps,
   kCtrAlgoHierOps,
+  // Measured-topology selection (hvd/topology.h): auto verdicts served
+  // by the cost model instead of the hand bands, and probe runs.
+  kCtrAlgoMeasuredSelects,
+  kCtrTopoProbes,
   // Worker pool.
   kCtrPoolJobs,               // ParallelFor dispatches (parts > 1)
   // Stall inspector.
@@ -101,6 +109,8 @@ enum MetricCounter : int {
   kGaugeReduceThreads,        // current host-reduction thread budget
   kGaugeTcpZerocopyMode,      // resolved transport mode (hvd/tcp.h:
                               // 0 = vectored, 1 = MSG_ZEROCOPY live)
+  kGaugeTopoProbeMs,          // last topology probe wall time (ms)
+  kGaugeTopoLinks,            // links the current model measured
   kNumMetricCounters
 };
 
@@ -119,6 +129,7 @@ enum MetricHistogram : int {
   kHistTcpDoublingUs,         // recursive-doubling exchange
   kHistTcpHdUs,               // halving-doubling schedule (interpreter)
   kHistTcpStripedUs,          // multi-ring striped schedule (interpreter)
+  kHistTcpAlltoallUs,         // pairwise alltoall (span interpreter)
   kHistPoolParts,             // parts per ParallelFor dispatch
   kNumMetricHistograms
 };

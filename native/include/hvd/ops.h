@@ -170,6 +170,23 @@ class TcpOps : public OpExecutor {
                          ReduceOp op, const std::vector<int>& ranks, int p,
                          WireCodec codec, std::vector<float>* ef,
                          int phase_hist);
+  // Span-list interpreter for the non-reducing table kinds (allgather
+  // / alltoall: SEND, RECV, COPY only — ISSUE 13's IR extension).
+  // Chunk c's bytes live at send_spans[c] on ranks that ship it and
+  // land at recv_spans[c] on ranks that receive it; a chunk received
+  // in an earlier step forwards from recv_spans (allgather passes ONE
+  // span table as both, so forwards read what just landed). Per step:
+  // one RecvV per recv peer (helper threads), one SendV per send peer,
+  // spans in table order on both sides — for the ring allgather table
+  // this reproduces RingAllgatherVec's byte stream exactly, and for
+  // the pairwise alltoall table the legacy SendRecv loop's. COPY
+  // memcpys send→recv spans (the self block; skipped when the two
+  // tables alias, as in allgather).
+  Status ExecuteScheduleSpans(
+      const ChunkSchedule& sched,
+      const std::vector<std::vector<struct iovec>>& send_spans,
+      const std::vector<std::vector<struct iovec>>& recv_spans,
+      const std::vector<int>& ranks, int p, int phase_hist);
   // Adasum recursive distance-doubling with per-tensor dot/norm
   // weighting (reference ops/adasum/adasum.h:166-330). `tensor_elems`
   // gives each fused tensor's element extent inside the buffer.
@@ -207,6 +224,14 @@ class TcpOps : public OpExecutor {
   WireEfState* WireEf(const std::string& name, int64_t elems);
 
   int64_t ring_threshold_bytes_;  // below: recursive doubling
+  // HOROVOD_COLLECTIVE_TABLES (on/off, default on): whether allgather
+  // / reducescatter / alltoall run their chunk-schedule tables or the
+  // dedicated legacy loops. The default tables are wire-byte-stream
+  // IDENTICAL to the legacy paths (schedule.cc), so this knob needs no
+  // cross-rank sync — it flips which ENGINE runs, never what the peer
+  // observes — and exists so the parity tests can pin table output
+  // against the pre-ISSUE-13 paths bit for bit.
+  bool tables_on_ = true;
   std::unordered_map<std::string, WireEfState> wire_ef_;
   // Unified staging memory (hvd/pool.h): page-aligned, grow-only,
   // NUMA-first-touched slabs replacing the old per-role scratch

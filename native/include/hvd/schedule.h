@@ -28,7 +28,22 @@
 // is response-derived or coordinator-synced, and per (step, src→dst)
 // pair both sides list the same chunks in the same order — the
 // framing contract tests/test_schedule.py verifies on a simulated
-// executor for every P.
+// executor (tools/schedule_verifier.py, shared with the synthesizer)
+// for every P.
+//
+// Since ISSUE 13 the IR covers every dense collective, not just
+// allreduce: a CollKind selects the table's data-movement semantics
+// (who starts with which chunks, who must end with them) and
+// BuildCollSchedule generates ring allgather, ring reduce-scatter and
+// pairwise alltoall tables whose per-step wire byte stream is
+// IDENTICAL to the dedicated legacy paths they replace — so flipping
+// HOROVOD_COLLECTIVE_TABLES cannot change result bits, only which
+// engine runs. The allreduce generators also grew the synthesis
+// dimensions tools/synth.py searches over: ring stripe count, chunk
+// granularity (sub-chunks per ring shard), and the halving-doubling
+// recursion ordering (contiguous-block halving vs interleaved
+// distance-doubling — same bytes and steps, different span
+// contiguity).
 #pragma once
 
 #include <cstdint>
@@ -88,15 +103,72 @@ struct ChunkSchedule {
   std::vector<ChunkOp> ops;     // this rank's ops, sorted by step
 };
 
+// Collective kinds a table can express (BuildCollSchedule). The ops
+// are shared; the KIND fixes the data-movement contract the verifier
+// checks:
+//  * allreduce      — all ranks start with all chunks, end with the
+//                     reduced grid (SEND/RECV/RECV_REDUCE).
+//  * allgather      — rank k starts owning chunk k's region, all ranks
+//                     end with every chunk (SEND/RECV forwarding only).
+//  * reducescatter  — all ranks start with all chunks, rank k ends
+//                     owning reduced chunk k.
+//  * alltoall       — grid is P*P with chunk s*P+d the (src s → dst d)
+//                     block; rank p starts with row p, ends with
+//                     column p (SEND/RECV/COPY, no reduction).
+enum CollKind : int {
+  kCollAllreduce = 0,
+  kCollAllgather = 1,
+  kCollReducescatter = 2,
+  kCollAlltoall = 3,
+  kNumCollKinds = 4,
+};
+
 // Generators (pure functions of (P, position)). P >= 1; position in
 // [0, P). A P == 1 schedule is a single COPY covering the grid.
-ChunkSchedule BuildHalvingDoubling(int nranks, int pos);
-ChunkSchedule BuildStripedRing(int nranks, int pos, int stripes);
+//
+// `hd_order` picks the halving-doubling recursion ordering (a
+// synthesis dimension): 0 = contiguous-block halving (distance q/2
+// down to 1; chunk sets are contiguous blocks, fewest spans), 1 =
+// interleaved distance-doubling (distance 1 up to q/2; chunk sets are
+// stride-2m congruence classes). Both move identical bytes in
+// identical steps and end with rank v owning chunk v, so the ragged-P
+// fold/unfold legs are shared.
+ChunkSchedule BuildHalvingDoubling(int nranks, int pos, int hd_order = 0);
+// `granularity` splits each ring shard into that many consecutive
+// sub-chunks (>= 1): same steps, same per-step peer byte totals, finer
+// chunk grid — the knob that lets the synthesizer trade span count
+// against codec/fold pipelining. granularity == 1 reproduces the
+// classic grid exactly.
+ChunkSchedule BuildStripedRing(int nranks, int pos, int stripes,
+                               int granularity = 1);
+// Ring allgather as a table: P chunks, position p seeded with chunk p,
+// step s ships chunk mod(p - s) to next while mod(p - s - 1) lands
+// from prev — the byte stream of RingAllgatherPhase/RingAllgatherVec
+// exactly (those stay as the HOROVOD_COLLECTIVE_TABLES=off path).
+ChunkSchedule BuildAllgatherRing(int nranks, int pos);
+// Ring reduce-scatter as a table: the reduce-scatter half of the
+// classic ring (position p ends owning reduced chunk p), byte-stream
+// identical to RingReduceScatterPhase over the same chunk offsets.
+ChunkSchedule BuildReduceScatterRing(int nranks, int pos);
+// Pairwise alltoall as a table: step 0 COPYes the self block, step
+// s >= 1 sends block (p → p+s) while block (p-s → p) lands — the
+// dense MPI_Alltoallv pairwise exchange as data.
+ChunkSchedule BuildAlltoallPairwise(int nranks, int pos);
 
 // Dispatch by algorithm id (kAlgoHd / kAlgoStriped / kAlgoRing — ring
 // maps to BuildStripedRing(P, p, 1)). Other ids return an empty
-// schedule (they run on dedicated paths).
+// schedule (they run on dedicated paths). The second overload routes
+// the synthesis parameters (stripes for kAlgoStriped, granularity for
+// both ring families, hd_order for kAlgoHd) — the coordinator-synced
+// values reach it via Controller::collective_stripes()/hd_order().
 ChunkSchedule BuildSchedule(int algo, int nranks, int pos);
+ChunkSchedule BuildSchedule(int algo, int nranks, int pos, int stripes,
+                            int granularity, int hd_order);
+// Kind dispatch: allreduce routes through BuildSchedule; the other
+// kinds ignore `algo` except where a family choice exists (allgather /
+// reducescatter ride the ring, alltoall the pairwise exchange).
+ChunkSchedule BuildCollSchedule(int kind, int algo, int nranks, int pos,
+                                int stripes, int granularity, int hd_order);
 
 // Default per-(payload, np, topology) selection table: the algorithm
 // used when neither the request nor HOROVOD_COLLECTIVE_ALGO nor the

@@ -1,0 +1,116 @@
+// Measured link topology: the alpha-beta model behind schedule
+// synthesis and measured algorithm selection (ISSUE 13, TACCL-style
+// arXiv:2111.04867).
+//
+// PR 7 seeded ResolveAlgoDefault's selection bands from ONE loopback
+// calibration sweep; the bench notes show this box swinging ±30% draw
+// to draw, so those bands are wrong on any other machine. This module
+// closes the loop: at startup (and on demand) every pair of ranks
+// ping-pongs over the EXISTING vectored TCP data connections —
+// bench.py's interleaved-rounds protocol internalized: small and large
+// payload iterations interleave so a scheduler phase shift lands on
+// both estimates, and each keeps its best round — producing a
+// per-(src, dst) alpha (latency, us) + beta (us per byte) model. Rank
+// 0 gathers every rank's measured out-links and broadcasts the full
+// matrix, so every rank holds IDENTICAL numbers (the same lockstep
+// discipline as the controller param sync the decision rides in on).
+//
+// The model feeds two consumers:
+//  * ResolveAlgoMeasured — cost-models the candidate chunk-schedule
+//    tables (ring / striped / hd / doubling) per (payload, np) and
+//    replaces the hand-seeded bands whenever a model exists (the
+//    bands stay as the fallback and the HOROVOD_TOPOLOGY_PROBE=off
+//    path).
+//  * tools/synth.py — the sketch-guided schedule search reads the
+//    model through hvd_topology and prices candidate tables with the
+//    same ScheduleCostUs walk (hvd_schedule_cost_us).
+//
+// Probing costs ~10 ms per rank pair, so the verdict is cached on
+// disk keyed by (hostname, np, local_size): HOROVOD_TOPOLOGY_PROBE=
+// auto loads the cache and only measures when it is missing; force
+// re-measures and rewrites it; off disables the model entirely.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "hvd/schedule.h"
+
+namespace hvd {
+
+class Controller;
+
+struct TopologyModel {
+  int np = 0;                    // 0 = no model
+  std::vector<double> alpha_us;  // np*np, [src*np + dst]; 0 on the diag
+  std::vector<double> beta_us_per_byte;  // np*np, same layout
+  bool valid() const {
+    return np > 1 &&
+           alpha_us.size() == static_cast<size_t>(np) * np &&
+           beta_us_per_byte.size() == static_cast<size_t>(np) * np;
+  }
+};
+
+// Text serialization (the cache file format AND the sync blob — every
+// rank parses the same broadcast string, so the doubles are identical
+// by construction). Parse returns an invalid model on any mismatch.
+std::string SerializeTopology(const TopologyModel& m,
+                              const std::string& hostkey);
+TopologyModel ParseTopology(const std::string& blob,
+                            const std::string& hostkey_expect);
+
+// Cache identity for this job shape: hostname + np + local_size.
+std::string TopologyHostKey(int np, int local_size);
+// Cache file path (HOROVOD_TOPOLOGY_CACHE_DIR, default /tmp).
+std::string TopologyCachePath(const std::string& hostkey);
+// Load iff the file exists, parses, and its hostkey matches.
+TopologyModel LoadTopologyCache(const std::string& hostkey);
+// Atomic write (tmp + rename) so concurrent jobs never read a torn
+// file. Best-effort: failure only costs the next job a re-probe.
+void StoreTopologyCache(const TopologyModel& m, const std::string& hostkey);
+
+// Run the pairwise probe rounds over the controller's data
+// connections and sync the full matrix (workers send their measured
+// out-link rows to rank 0 as one frame each; rank 0 broadcasts the
+// assembled blob). MUST run while the data plane is quiet — during
+// TcpController::Initialize, or as a collective call with no
+// in-flight collectives (the hvd.topology_probe contract). Returns an
+// invalid model if any rank's measurement or the sync failed (the
+// failure is broadcast, so all ranks agree there is no model).
+// `probe_ms_out` (optional) receives this rank's wall-clock cost.
+TopologyModel ProbeTopology(Controller* controller, double* probe_ms_out);
+
+// Alpha-beta cost of executing `algo`'s table at `bytes` over the
+// full world of `m` (us). Walks every rank's generated table step by
+// step: per step, a rank pays the sum of its coalesced per-peer sends
+// (alpha + bytes*beta + a per-span overhead) overlapped against its
+// slowest receive, and the step costs the slowest rank — the same
+// one-SendV/RecvV-per-peer shape ExecuteSchedule actually runs.
+// kAlgoDoubling (not a table) is costed analytically as its fold +
+// log2 rounds of full-payload exchanges. Returns a huge value for
+// algorithms the model cannot price (hier).
+double AlgoCostUs(int algo, int64_t bytes, const TopologyModel& m,
+                  int stripes, int granularity, int hd_order);
+
+// Generic table pricing for the synthesizer: cost of running
+// `per-rank tables` (all P of them, built elsewhere) at `bytes`.
+double ScheduleCostUs(const std::vector<ChunkSchedule>& tables,
+                      int64_t bytes, const TopologyModel& m);
+
+// Measured replacement for ResolveAlgoDefault: argmin cost over the
+// candidate family at the synced synthesis parameters. Defers to the
+// hand bands' hier verdict (the loopback model cannot price the
+// two-level decomposition) and never returns kAlgoAuto. Falls back to
+// ResolveAlgoDefault when the model is missing or np does not match.
+int ResolveAlgoMeasured(int64_t bytes, int np, bool hier_ok,
+                        int64_t ring_threshold_bytes,
+                        const TopologyModel& m, int stripes,
+                        int granularity, int hd_order);
+
+// Last-probe wall time for the topology_probe_ms gauge, process-wide
+// (the topology_links_measured gauge reads the LIVE controller model
+// instead — a cache-loaded model measured its links in another job).
+double TopologyProbeMs();
+
+}  // namespace hvd

@@ -288,15 +288,36 @@ Response Controller::ConstructResponse(const std::string& name,
   return resp;
 }
 
+int Controller::ResolveAlgoAuto(int64_t payload_bytes, int ncontributors,
+                                bool hier_ok) const {
+  // Measured verdict when a model covering the FULL world exists (the
+  // model's positions are world ranks, so a Join-shrunk contributor
+  // set rides the hand bands); the bands remain the fallback and the
+  // HOROVOD_TOPOLOGY_PROBE=off behavior. Model doubles are broadcast-
+  // identical, so every rank computes the same argmin.
+  auto m = topology_model();
+  if (m != nullptr && ncontributors == size_ && m->np == size_) {
+    const int algo = ResolveAlgoMeasured(
+        payload_bytes, ncontributors, hier_ok, ring_threshold_bytes_, *m,
+        collective_stripes_, collective_granularity_, hd_order_);
+    // hier is never a cost-model candidate (the loopback model cannot
+    // price the two-level legs) — a hier verdict came from the hand
+    // bands, so it must not count as a measured selection.
+    if (algo != kAlgoHier) MetricAdd(kCtrAlgoMeasuredSelects);
+    return algo;
+  }
+  return ResolveAlgoDefault(payload_bytes, ncontributors, hier_ok,
+                            ring_threshold_bytes_);
+}
+
 int Controller::ResolveCollectiveAlgo(int request_algo, int64_t payload_bytes,
                                       int ncontributors) const {
   int algo = (request_algo > kAlgoAuto && request_algo < kNumCollectiveAlgos)
                  ? request_algo
                  : collective_algo_;
   if (algo == kAlgoAuto)
-    algo = ResolveAlgoDefault(payload_bytes, ncontributors,
-                              hierarchical_ && ncontributors == size_,
-                              ring_threshold_bytes_);
+    algo = ResolveAlgoAuto(payload_bytes, ncontributors,
+                           hierarchical_ && ncontributors == size_);
   // A forced "hier" that the synced layout cannot run (ragged
   // contributor set under Join, non-node-major topology) downgrades
   // deterministically — the same rule the executor applies, computed
@@ -562,6 +583,20 @@ Status TcpController::Initialize() {
     hierarchical_fit_ = all_fit;
     hierarchical_ = hierarchical_ && all_fit;
     shm_enabled_ = shm_enabled_ && all_single;
+    // Topology-probe verdict (field 12): rank 0's knob decides for the
+    // whole job — probe rounds are lockstep pairwise exchanges, so a
+    // per-rank divergence would deadlock the data links. auto = use
+    // the cache when a matching file exists, measure otherwise.
+    static const char* const kTopoProbeChoices[] = {"auto", "off", "force"};
+    const int probe_knob =
+        EnvChoiceSane("HOROVOD_TOPOLOGY_PROBE", 0, kTopoProbeChoices, 3);
+    TopologyModel cached;
+    topo_mode_ = 0;
+    if (probe_knob != 1) {  // not "off"
+      if (probe_knob == 0)  // auto: cache hit skips the measurement
+        cached = LoadTopologyCache(TopologyHostKey(size_, local_size_));
+      topo_mode_ = cached.valid() ? 2 : 1;
+    }
     std::string params = std::to_string(fusion_threshold_bytes_) + ":" +
                          std::to_string(ring_threshold_bytes_) + ":" +
                          (hierarchical_ ? "1" : "0") + ":" +
@@ -572,10 +607,26 @@ Status TcpController::Initialize() {
                          std::to_string(shm_segment_depth_) + ":" +
                          std::to_string(reduce_threads_) + ":" +
                          std::to_string(wire_codec_) + ":" +
-                         std::to_string(collective_algo_);
+                         std::to_string(collective_algo_) + ":" +
+                         std::to_string(topo_mode_) + ":" +
+                         std::to_string(collective_stripes_) + ":" +
+                         std::to_string(collective_granularity_) + ":" +
+                         std::to_string(hd_order_);
     for (int peer = 1; peer < size_; ++peer) {
       if (!ctrl_conns_[peer].SendFrame(params))
         return Status::UnknownError("param sync: lost control link");
+    }
+    // Cached-model broadcast (mode 2): one frame per worker on the
+    // still-quiet DATA links, the same channel the probe's own sync
+    // uses. Probing (mode 1) runs below, on every rank.
+    if (topo_mode_ == 2) {
+      const std::string blob = SerializeTopology(
+          cached, TopologyHostKey(size_, local_size_));
+      for (int peer = 1; peer < size_; ++peer) {
+        if (!data_conns_[peer].SendFrame(blob))
+          return Status::UnknownError("topology sync: lost data link");
+      }
+      SetTopologyModel(std::move(cached));
     }
   } else {
     std::string fit = (my_hier_fit ? "fit:" + std::to_string(local_size_)
@@ -597,7 +648,11 @@ Status TcpController::Initialize() {
     auto c8 = c7 == std::string::npos ? c7 : params.find(':', c7 + 1);
     auto c9 = c8 == std::string::npos ? c8 : params.find(':', c8 + 1);
     auto c10 = c9 == std::string::npos ? c9 : params.find(':', c9 + 1);
-    if (!ok || c10 == std::string::npos)
+    auto c11 = c10 == std::string::npos ? c10 : params.find(':', c10 + 1);
+    auto c12 = c11 == std::string::npos ? c11 : params.find(':', c11 + 1);
+    auto c13 = c12 == std::string::npos ? c12 : params.find(':', c12 + 1);
+    auto c14 = c13 == std::string::npos ? c13 : params.find(':', c13 + 1);
+    if (!ok || c14 == std::string::npos)
       return Status::UnknownError("param sync: lost control link");
     fusion_threshold_bytes_ = std::atoll(params.c_str());
     ring_threshold_bytes_ = std::atoll(params.c_str() + c1 + 1);
@@ -610,6 +665,30 @@ Status TcpController::Initialize() {
     SetReduceThreads(std::atoi(params.c_str() + c8 + 1));
     SetWireCodec(std::atoi(params.c_str() + c9 + 1));
     SetCollectiveAlgo(std::atoi(params.c_str() + c10 + 1));
+    topo_mode_ = std::atoi(params.c_str() + c11 + 1);
+    SetCollectiveStripes(std::atoi(params.c_str() + c12 + 1));
+    SetCollectiveGranularity(std::atoi(params.c_str() + c13 + 1));
+    SetHdOrder(std::atoi(params.c_str() + c14 + 1));
+    if (topo_mode_ == 2) {
+      // Rank 0's cached model rides the quiet data link as one frame.
+      std::string blob;
+      data_conns_[0].SetRecvTimeout(timeout_ms);
+      const bool got = data_conns_[0].RecvFrame(&blob);
+      data_conns_[0].SetRecvTimeout(0);
+      if (!got)
+        return Status::UnknownError("topology sync: lost data link");
+      SetTopologyModel(ParseTopology(blob, ""));
+    }
+  }
+  // Startup probe (mode 1): lockstep pairwise ping rounds over the
+  // data links, full-matrix broadcast inside — every rank installs
+  // identical numbers or (on any failure) none. Rank 0 refreshes the
+  // disk cache so the NEXT job on this hostset skips the measurement.
+  if (topo_mode_ == 1) {
+    TopologyModel m = ProbeTopology(this, nullptr);
+    if (rank_ == 0 && m.valid())
+      StoreTopologyCache(m, TopologyHostKey(size_, local_size_));
+    SetTopologyModel(std::move(m));
   }
   return Status::OK();
 }

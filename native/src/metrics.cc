@@ -44,18 +44,24 @@ constexpr const char* kCounterNames[] = {
     "tcp_algo_striped_ops_total",
     "tcp_algo_doubling_ops_total",
     "tcp_algo_hier_ops_total",
+    "collective_measured_selects_total",
+    "topology_probes_total",
     "pool_jobs_total",
     "stall_events_total",
     "pending_tensors",
     "stalled_tensors",
     "reduce_threads",
     "tcp_zerocopy_mode",
+    "topology_probe_ms",
+    "topology_links_measured",
 };
 
 constexpr int kCounterKinds[] = {
     0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
     0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+    0, 0,        // measured selects, topology probes
     1, 1, 1, 1,  // pending/stalled tensors, reduce_threads, zc mode
+    1, 1,        // topology probe ms / links measured
 };
 
 constexpr const char* kHistNames[] = {
@@ -73,6 +79,7 @@ constexpr const char* kHistNames[] = {
     "tcp_doubling_us",
     "tcp_hd_us",
     "tcp_striped_us",
+    "tcp_alltoall_us",
     "pool_parts",
 };
 

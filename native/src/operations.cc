@@ -41,6 +41,7 @@
 #include "hvd/tensor_queue.h"
 #include "hvd/thread_pool.h"
 #include "hvd/timeline.h"
+#include "hvd/topology.h"
 
 namespace hvd {
 namespace {
@@ -633,6 +634,17 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
       hvd::EnvChoiceSane("HOROVOD_COLLECTIVE_ALGO", 0,
                          hvd::kCollectiveAlgoNames,
                          hvd::kNumCollectiveAlgos));
+  // Schedule-synthesis parameters (hvd/schedule.h): stripe count for
+  // the striped family, sub-chunks per ring shard, halving-doubling
+  // recursion ordering. Coordinator-synced like the algorithm force —
+  // every rank must generate the SAME table or the exchange deadlocks
+  // — and normally written by tools/synth.py's verdict, not by hand.
+  st.controller->SetCollectiveStripes(static_cast<int>(
+      hvd::EnvInt64Sane("HOROVOD_COLLECTIVE_STRIPES", 2, 1, 8)));
+  st.controller->SetCollectiveGranularity(static_cast<int>(
+      hvd::EnvInt64Sane("HOROVOD_COLLECTIVE_GRANULARITY", 1, 1, 8)));
+  st.controller->SetHdOrder(static_cast<int>(
+      hvd::EnvInt64Sane("HOROVOD_HD_ORDER", 0, 0, 1)));
   st.controller->SetTopology(local_rank, local_size, cross_rank, cross_size);
   st.controller->SetHierarchical(   // any nonzero enables (see above)
       hvd::EnvInt64Sane("HOROVOD_HIERARCHICAL_ALLREDUCE", 0, 0, 1 << 30)
@@ -725,6 +737,11 @@ void hvd_shutdown() {
   st.initialized.store(false);
 }
 
+// v9: measured-topology surface (hvd_topology / hvd_topology_probe /
+// hvd_algo_select_measured / hvd_algo_cost_us) + the extended
+// any-collective builder hvd_build_coll_schedule — wire formats
+// unchanged; the model rides the init-time param plane, not the
+// per-cycle wire.
 // v8: vectored-transport surface (hvd_tcp_sendv / hvd_tcp_recvv /
 // hvd_tcp_send_frame / hvd_tcp_recv_frame over caller-owned fds,
 // hvd_tcp_transport_mode + _name) — wire formats unchanged.
@@ -935,6 +952,16 @@ int64_t hvd_metrics_snapshot(int64_t* out, int64_t max_slots) {
   // only this completion-less sandbox pays its ~40 ms poll bound, once
   // per metrics-reading process.
   reg.Set(hvd::kGaugeTcpZerocopyMode, hvd::ResolvedTransportMode());
+  reg.Set(hvd::kGaugeTopoProbeMs,
+          static_cast<int64_t>(hvd::TopologyProbeMs()));
+  // Links reflect the LIVE model (a cache-loaded model measured them
+  // in an earlier job), not merely this process's last probe.
+  int64_t links = 0;
+  if (st.controller) {
+    if (auto m = st.controller->topology_model())
+      links = static_cast<int64_t>(m->np) * (m->np - 1);
+  }
+  reg.Set(hvd::kGaugeTopoLinks, links);
   return reg.Snapshot(out, max_slots);
 }
 
@@ -1052,12 +1079,104 @@ int hvd_build_schedule(int algo, int nranks, int pos, int* nsteps,
   return static_cast<int>(s.ops.size());
 }
 
+// Extended builder (ABI v9): any collective KIND (hvd/schedule.h
+// CollKind) plus the synthesis parameters — the surface
+// tools/synth.py's sketch-guided search and the promoted verifier
+// enumerate. Same quintet layout as hvd_build_schedule.
+int hvd_build_coll_schedule(int kind, int algo, int nranks, int pos,
+                            int stripes, int granularity, int hd_order,
+                            int* nsteps, int* nchunks, int32_t* out,
+                            int max_ops) {
+  hvd::ChunkSchedule s = hvd::BuildCollSchedule(
+      kind, algo, nranks, pos, stripes, granularity, hd_order);
+  if (nsteps) *nsteps = s.nsteps;
+  if (nchunks) *nchunks = s.nchunks;
+  if (out) {
+    int n = std::min<int>(max_ops, static_cast<int>(s.ops.size()));
+    for (int i = 0; i < n; ++i) {
+      out[i * 5 + 0] = s.ops[i].step;
+      out[i * 5 + 1] = s.ops[i].peer;
+      out[i * 5 + 2] = s.ops[i].chunk;
+      out[i * 5 + 3] = static_cast<int32_t>(s.ops[i].action);
+      out[i * 5 + 4] = s.ops[i].flags;
+    }
+  }
+  return static_cast<int>(s.ops.size());
+}
+
 // Default selection-table query (no controller state: callers pass the
 // synced inputs, so bench/tests can probe any (bytes, np, topology)
 // cell).
 int hvd_algo_select(int64_t bytes, int np, int hier_ok,
                     int64_t ring_threshold) {
   return hvd::ResolveAlgoDefault(bytes, np, hier_ok != 0, ring_threshold);
+}
+
+// Measured-model verdict for one (bytes, np) cell using THIS process's
+// broadcast topology model (bench.py's synthesized-table dump and the
+// audit comparison). Returns -1 when no model covers np — callers fall
+// back to hvd_algo_select's hand bands.
+int hvd_algo_select_measured(int64_t bytes, int np, int hier_ok,
+                             int64_t ring_threshold) {
+  auto& st = hvd::State();
+  if (!st.controller) return -1;
+  auto m = st.controller->topology_model();
+  if (m == nullptr || m->np != np) return -1;
+  return hvd::ResolveAlgoMeasured(
+      bytes, np, hier_ok != 0, ring_threshold, *m,
+      st.controller->collective_stripes(),
+      st.controller->collective_granularity(), st.controller->hd_order());
+}
+
+// Alpha-beta cost (us) of one candidate's table family at `bytes`
+// under the live model; <0 when no model. tools/synth.py uses this to
+// cross-check its Python cost walk against the native one.
+double hvd_algo_cost_us(int algo, int64_t bytes, int stripes,
+                        int granularity, int hd_order) {
+  auto& st = hvd::State();
+  if (!st.controller) return -1.0;
+  auto m = st.controller->topology_model();
+  if (m == nullptr) return -1.0;
+  const double c =
+      hvd::AlgoCostUs(algo, bytes, *m, stripes, granularity, hd_order);
+  return c >= 1e18 ? -1.0 : c;
+}
+
+// Measured topology accessor: fills alpha[np*np] (us) and
+// beta[np*np] (us/byte) when cap >= np*np; returns the model's np (0 =
+// no model). Every rank holds the identical broadcast numbers.
+int hvd_topology(double* alpha, double* beta, int cap) {
+  auto& st = hvd::State();
+  if (!st.controller) return 0;
+  auto m = st.controller->topology_model();
+  if (m == nullptr) return 0;
+  const int n2 = m->np * m->np;
+  if (alpha != nullptr && beta != nullptr && cap >= n2) {
+    for (int i = 0; i < n2; ++i) {
+      alpha[i] = m->alpha_us[i];
+      beta[i] = m->beta_us_per_byte[i];
+    }
+  }
+  return m->np;
+}
+
+// On-demand re-probe. COLLECTIVE CONTRACT: every rank must call this
+// with no collectives in flight — the probe ping-pongs over the data
+// links the exchanges use (the same quiet-plane discipline as
+// hvd_shutdown's drain). Returns the probe wall-clock in ms, or -1 on
+// failure (all ranks then agree there is no model). Rank 0 rewrites
+// the disk cache so subsequent jobs start from the fresh measurement.
+double hvd_topology_probe() {
+  auto& st = hvd::State();
+  if (!st.controller || st.size <= 1) return -1.0;
+  double ms = -1.0;
+  hvd::TopologyModel m = hvd::ProbeTopology(st.controller.get(), &ms);
+  const bool ok = m.valid();
+  if (ok && st.rank == 0)
+    hvd::StoreTopologyCache(
+        m, hvd::TopologyHostKey(st.size, st.local_size));
+  st.controller->SetTopologyModel(std::move(m));
+  return ok ? ms : -1.0;
 }
 
 const char* hvd_algo_name(int algo) { return hvd::CollectiveAlgoName(algo); }

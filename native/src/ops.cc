@@ -427,6 +427,14 @@ TcpOps::TcpOps(Controller* controller, FusionBufferManager* fusion,
   // poison the arena on the first op.
   shm_timeout_secs_ = EnvDoubleSane("HOROVOD_SHM_TIMEOUT_SECONDS",
                                     shm_timeout_secs_);
+  // Table engine for allgather/reducescatter/alltoall (ISSUE 13). The
+  // default tables are wire-identical to the legacy loops, so this is
+  // a per-rank engine choice, not a protocol knob (ops.h).
+  {
+    static const char* const kTablesChoices[] = {"on", "off"};
+    tables_on_ = EnvChoiceSane("HOROVOD_COLLECTIVE_TABLES", 0,
+                               kTablesChoices, 2) == 0;
+  }
   // Pre-size the exchange slabs from the SYNCED fusion threshold (the
   // largest fused payload the coordinator will emit) so steady state
   // never reallocates and the first timed op does not pay the
@@ -592,9 +600,12 @@ Status TcpOps::Allreduce(const Response& r,
       const int P = static_cast<int>(ranks.size());
       int algo = r.collective_algo;
       if (algo <= kAlgoAuto || algo >= kNumCollectiveAlgos)
-        algo = ResolveAlgoDefault(total_bytes, P,
-                                  HierarchicalApplicable(ranks),
-                                  ring_threshold_bytes_);
+        // Shared with the coordinator's resolution (same synced
+        // inputs, including the broadcast topology model): measured
+        // cost-model verdict when a model exists, hand bands
+        // otherwise.
+        algo = controller_->ResolveAlgoAuto(total_bytes, P,
+                                            HierarchicalApplicable(ranks));
       // Executor-side guard mirrors the coordinator's downgrade rule
       // exactly (same synced inputs): a hier verdict only runs when
       // the node-major layout fits and the full world contributes.
@@ -623,7 +634,11 @@ Status TcpOps::Allreduce(const Response& r,
           // Algorithms-as-data: the collective is a chunk-op table
           // consumed by the shared interpreter.
           MetricAdd(algo == kAlgoHd ? kCtrAlgoHdOps : kCtrAlgoStripedOps);
-          ChunkSchedule sched = BuildSchedule(algo, P, p);
+          // Synthesis parameters are coordinator-synced (param fields
+          // 13-15), so every rank generates the same table.
+          ChunkSchedule sched = BuildSchedule(
+              algo, P, p, controller_->collective_stripes(),
+              controller_->collective_granularity(), controller_->hd_order());
           auto offs = ChunkOffsets(total_elems, sched.nchunks);
           st = ExecuteSchedule(sched, buf, offs, dtype, r.reduce_op, ranks,
                                p, codec, ef ? &ef->sched : nullptr,
@@ -1668,6 +1683,142 @@ Status TcpOps::ExecuteSchedule(const ChunkSchedule& sched, uint8_t* buf,
   return Status::OK();
 }
 
+Status TcpOps::ExecuteScheduleSpans(
+    const ChunkSchedule& sched,
+    const std::vector<std::vector<struct iovec>>& send_spans,
+    const std::vector<std::vector<struct iovec>>& recv_spans,
+    const std::vector<int>& ranks, int p, int phase_hist) {
+  // The span-list face of the interpreter (ops.h): SEND/RECV/COPY
+  // tables over caller-provided per-chunk span lists — no staging, no
+  // reduction, no codec (those live on the flat-buffer engine above).
+  // Per step: one coalesced RecvV per recv peer in helper threads, one
+  // coalesced SendV per send peer from this thread, spans in table
+  // order on both sides — the byte stream of the legacy dedicated
+  // loops, chunk for chunk.
+  MetricTimer phase_timer(static_cast<MetricHistogram>(phase_hist));
+  const auto& ops = sched.ops;
+  const bool aliased = &send_spans == &recv_spans;
+  size_t idx = 0;
+  for (int step = 0; step < sched.nsteps; ++step) {
+    const size_t lo = idx;
+    while (idx < ops.size() && ops[idx].step == step) ++idx;
+    if (idx == lo) continue;
+
+    // Self blocks first (no traffic; aliased tables are already in
+    // place — the allgather caller seeds its own block directly).
+    for (size_t i = lo; i < idx; ++i) {
+      const auto& o = ops[i];
+      if (o.action != ChunkAction::COPY || aliased) continue;
+      const auto& sv = send_spans[o.chunk];
+      const auto& rv = recv_spans[o.chunk];
+      size_t si = 0, ri = 0, soff = 0, roff = 0;
+      while (si < sv.size() && ri < rv.size()) {
+        const size_t n = std::min(sv[si].iov_len - soff,
+                                  rv[ri].iov_len - roff);
+        std::memcpy(static_cast<uint8_t*>(rv[ri].iov_base) + roff,
+                    static_cast<const uint8_t*>(sv[si].iov_base) + soff, n);
+        soff += n;
+        roff += n;
+        if (soff == sv[si].iov_len) { ++si; soff = 0; }
+        if (roff == rv[ri].iov_len) { ++ri; roff = 0; }
+      }
+    }
+
+    std::vector<int> recv_peers, send_peers;
+    int64_t total_spans = 0;
+    for (size_t i = lo; i < idx; ++i) {
+      const auto& o = ops[i];
+      if (o.action == ChunkAction::COPY) continue;
+      if (o.action != ChunkAction::SEND && o.action != ChunkAction::RECV)
+        // This engine has no fold machinery: silently classifying a
+        // RECV_REDUCE as a receive would never post its RecvV and
+        // desync the wire. Reducing tables belong to ExecuteSchedule.
+        return Status::PreconditionError(
+            "span interpreter supports SEND/RECV/COPY tables only");
+      const bool is_send = o.action == ChunkAction::SEND;
+      total_spans += static_cast<int64_t>(
+          (is_send ? send_spans : recv_spans)[o.chunk].size());
+      auto& list = is_send ? send_peers : recv_peers;
+      if (std::find(list.begin(), list.end(), o.peer) == list.end())
+        list.push_back(o.peer);
+    }
+    struct iovec* iov_all =
+        pool_.GetAs<struct iovec>(BufferPool::kIov, total_spans);
+    int cursor = 0;
+    struct Group {
+      int peer;
+      struct iovec* iov;
+      int n;
+      uint64_t bytes;
+    };
+    auto collect = [&](const std::vector<int>& peers, ChunkAction want,
+                       const std::vector<std::vector<struct iovec>>& table) {
+      std::vector<Group> groups;
+      for (int peer : peers) {
+        Group g{peer, iov_all + cursor, 0, 0};
+        for (size_t i = lo; i < idx; ++i) {
+          const auto& o = ops[i];
+          if (o.peer != peer || o.action != want) continue;
+          for (const auto& io : table[o.chunk]) {
+            if (io.iov_len == 0) continue;
+            iov_all[cursor++] = io;
+            ++g.n;
+            g.bytes += io.iov_len;
+          }
+        }
+        if (g.n > 0) groups.push_back(g);
+      }
+      return groups;
+    };
+    auto rgroups = collect(recv_peers, ChunkAction::RECV, recv_spans);
+    auto sgroups = collect(send_peers, ChunkAction::SEND, send_spans);
+
+    // Below the kernel's send-buffer floor a send cannot block, so the
+    // helper-thread handshake would cost more than it overlaps — the
+    // RingAllgatherVec cutover, generalized per step.
+    uint64_t max_send = 0;
+    for (const auto& g : sgroups) max_send = std::max(max_send, g.bytes);
+    if (max_send <= 8 * 1024) {
+      for (const auto& g : sgroups) {
+        TcpConn* conn = controller_->DataConn(ranks[g.peer]);
+        if (conn == nullptr || !conn->SendV(g.iov, g.n))
+          return Status::UnknownError(
+              "schedule interpreter: lost data connection");
+      }
+      for (const auto& g : rgroups) {
+        TcpConn* conn = controller_->DataConn(ranks[g.peer]);
+        if (conn == nullptr || !conn->RecvV(g.iov, g.n))
+          return Status::UnknownError(
+              "schedule interpreter: lost data connection");
+      }
+      continue;
+    }
+    std::atomic<bool> io_ok{true};
+    std::vector<std::thread> receivers;
+    receivers.reserve(rgroups.size());
+    for (const auto& g : rgroups) {
+      receivers.emplace_back([&, g] {
+        TcpConn* conn = controller_->DataConn(ranks[g.peer]);
+        if (conn == nullptr || !conn->RecvV(g.iov, g.n))
+          io_ok.store(false, std::memory_order_relaxed);
+      });
+    }
+    bool send_ok = true;
+    for (const auto& g : sgroups) {
+      TcpConn* conn = controller_->DataConn(ranks[g.peer]);
+      if (conn == nullptr || !conn->SendV(g.iov, g.n)) {
+        send_ok = false;
+        break;
+      }
+    }
+    for (auto& th : receivers) th.join();
+    if (!send_ok || !io_ok.load(std::memory_order_relaxed))
+      return Status::UnknownError(
+          "schedule interpreter: lost data connection");
+  }
+  return Status::OK();
+}
+
 TcpOps::WireEfState* TcpOps::WireEf(const std::string& name, int64_t elems) {
   // One state per fused-response identity. Auto-generated tensor names
   // could grow this without bound, so past a cap the whole map resets —
@@ -1836,7 +1987,18 @@ Status TcpOps::Allgather(const Response& r,
   if (timeline_) timeline_->ActivityEnd(tname);
 
   if (size > 1) {
-    Status st = RingAllgatherVec(chunks, all_ranks, rank);
+    Status st;
+    if (tables_on_) {
+      // The PR 10 zero-staging allgather ring as a TABLE (ISSUE 13):
+      // BuildAllgatherRing emits the identical step/chunk sequence,
+      // executed by the shared span interpreter — the k=1 instance of
+      // the ring family, byte-for-byte the legacy engine's stream.
+      ChunkSchedule sched = BuildAllgatherRing(size, rank);
+      st = ExecuteScheduleSpans(sched, chunks, chunks, all_ranks, rank,
+                                kHistTcpRingAgUs);
+    } else {
+      st = RingAllgatherVec(chunks, all_ranks, rank);
+    }
     if (!st.ok()) return st;
   }
   if (timeline_) timeline_->ActivityEnd(tname);  // closes TCP_ALLGATHER
@@ -1976,6 +2138,38 @@ Status TcpOps::Alltoall(const Response& r,
     for (int k = 0; k < src; ++k) o += recv_rows(rank, k);
     return o;
   };
+  if (tables_on_ && size > 1) {
+    // Pairwise exchange as a table (ISSUE 13): chunk s*size + d is the
+    // (src → dst) block; my row's spans point into the input at the
+    // send offsets, my column's into the output at the recv offsets,
+    // and the COPY op is the self block. Step order and per-step byte
+    // stream match the legacy SendRecv loop exactly.
+    ChunkSchedule sched = BuildAlltoallPairwise(size, rank);
+    std::vector<std::vector<struct iovec>> sspans(
+        static_cast<size_t>(size) * size);
+    std::vector<std::vector<struct iovec>> rspans(
+        static_cast<size_t>(size) * size);
+    for (int d = 0; d < size; ++d) {
+      const int64_t b = recv_rows(d, rank) * row_bytes;
+      if (b > 0)
+        sspans[static_cast<size_t>(rank) * size + d].push_back(
+            {const_cast<uint8_t*>(in) + send_off_rows(d) * row_bytes,
+             static_cast<size_t>(b)});
+    }
+    for (int k = 0; k < size; ++k) {
+      const int64_t b = recv_rows(rank, k) * row_bytes;
+      if (b > 0)
+        rspans[static_cast<size_t>(k) * size + rank].push_back(
+            {out + recv_off_rows(k) * row_bytes, static_cast<size_t>(b)});
+    }
+    std::vector<int> all_ranks(size);
+    for (int k = 0; k < size; ++k) all_ranks[k] = k;
+    Status st = ExecuteScheduleSpans(sched, sspans, rspans, all_ranks,
+                                     rank, kHistTcpAlltoallUs);
+    if (!st.ok()) return st;
+    if (timeline_) timeline_->ActivityEnd(e.name);
+    return Status::OK();
+  }
   std::memcpy(out + recv_off_rows(rank) * row_bytes,
               in + send_off_rows(rank) * row_bytes,
               recv_rows(rank, rank) * row_bytes);
@@ -2059,8 +2253,19 @@ Status TcpOps::Reducescatter(const Response& r,
     for (size_t k = 0; k < offs.size(); ++k) elem_offs[k] = offs[k] / esize;
     std::vector<int> all_ranks(size);
     for (int k = 0; k < size; ++k) all_ranks[k] = k;
-    Status st = RingReduceScatterPhase(buf, elem_offs, e.dtype, e.reduce_op,
-                                       all_ranks, rank);
+    Status st;
+    if (tables_on_) {
+      // The ring reduce-scatter as a table (ISSUE 13): same step/chunk
+      // sequence and one fold per step as the dedicated phase, run by
+      // the shared flat-buffer interpreter (RECV_REDUCE machinery).
+      ChunkSchedule sched = BuildReduceScatterRing(size, rank);
+      st = ExecuteSchedule(sched, buf, elem_offs, e.dtype, e.reduce_op,
+                           all_ranks, rank, WireCodec::NONE, nullptr,
+                           kHistTcpRingRsUs);
+    } else {
+      st = RingReduceScatterPhase(buf, elem_offs, e.dtype, e.reduce_op,
+                                  all_ranks, rank);
+    }
     if (!st.ok()) return st;
   }
   std::memcpy(e.output, buf + offs[rank], offs[rank + 1] - offs[rank]);

@@ -34,13 +34,21 @@ ChunkSchedule Trivial(int nchunks) {
 
 }  // namespace
 
-ChunkSchedule BuildHalvingDoubling(int P, int p) {
+ChunkSchedule BuildHalvingDoubling(int P, int p, int hd_order) {
   // Chunk grid: q = largest power of two <= P. Core ranks (q of them
   // after the fold) run log2(q) halving reduce-scatter rounds — rank v
   // ends owning the fully reduced chunk v — then log2(q) doubling
   // allgather rounds. The fold/unfold legs carry the WHOLE grid as a
   // point-to-point hand-off (kChunkFlagHandoff), exactly the ragged-P
   // discipline of the legacy doubling exchange.
+  //
+  // hd_order == 1 runs the interleaved distance-doubling ordering:
+  // RS rounds at distance m = 1, 2, ..., q/2 where the round-m send
+  // set is {c ≡ (v^m) mod 2m} and the fold set {c ≡ v mod 2m} (the
+  // standard Rabenseifner interleaving), mirrored for the allgather.
+  // Same bytes, same steps, same final ownership (chunk v) — only the
+  // chunk-set contiguity differs, which is exactly the span-count
+  // trade the synthesizer's cost model prices.
   int q = 1;
   while (q * 2 <= P) q *= 2;
   const int t = P - q;
@@ -73,30 +81,55 @@ ChunkSchedule BuildHalvingDoubling(int P, int p) {
   const int v = p < 2 * t ? p / 2 : p - t;
   auto pos_of = [&](int vi) { return vi < t ? 2 * vi : vi + t; };
   int step = fold_steps;
-  // Reduce-scatter: halving block sizes, partner at halving distance;
-  // rank v ends owning the fully reduced chunk v.
-  for (int m = q / 2; m >= 1; m /= 2, ++step) {
-    const int w = pos_of(v ^ m);
-    const int base = v & ~(2 * m - 1);
-    const int keep = (v & m) ? base + m : base;
-    const int send = (v & m) ? base : base + m;
-    for (int c = send; c < send + m; ++c)
-      Push(&s, step, w, c, ChunkAction::SEND);
-    for (int c = keep; c < keep + m; ++c)
-      Push(&s, step, w, c, ChunkAction::RECV_REDUCE);
-  }
-  // Allgather: doubling block sizes, the mirror image of the rounds
-  // above. The interpreter forwards previously received chunks'
-  // encoded bytes verbatim, so under a wire codec every chunk is
-  // quantized exactly once, by its owner.
-  for (int m = 1; m < q; m *= 2, ++step) {
-    const int w = pos_of(v ^ m);
-    const int mine = v & ~(m - 1);
-    const int theirs = mine ^ m;
-    for (int c = mine; c < mine + m; ++c)
-      Push(&s, step, w, c, ChunkAction::SEND);
-    for (int c = theirs; c < theirs + m; ++c)
-      Push(&s, step, w, c, ChunkAction::RECV);
+  if (hd_order == 1) {
+    // Interleaved ordering. RS at distance m: send the partner's
+    // stride-2m congruence class, fold mine; AG mirrors in reverse.
+    // Both sides enumerate chunks ascending, so the per-(step, pair)
+    // span order matches by construction.
+    for (int m = 1; m < q; m *= 2, ++step) {
+      const int w = pos_of(v ^ m);
+      for (int c = 0; c < q; ++c) {
+        if ((c & (2 * m - 1)) == ((v ^ m) & (2 * m - 1)))
+          Push(&s, step, w, c, ChunkAction::SEND);
+        else if ((c & (2 * m - 1)) == (v & (2 * m - 1)))
+          Push(&s, step, w, c, ChunkAction::RECV_REDUCE);
+      }
+    }
+    for (int m = q / 2; m >= 1; m /= 2, ++step) {
+      const int w = pos_of(v ^ m);
+      for (int c = 0; c < q; ++c) {
+        if ((c & (2 * m - 1)) == (v & (2 * m - 1)))
+          Push(&s, step, w, c, ChunkAction::SEND);
+        else if ((c & (2 * m - 1)) == ((v ^ m) & (2 * m - 1)))
+          Push(&s, step, w, c, ChunkAction::RECV);
+      }
+    }
+  } else {
+    // Reduce-scatter: halving block sizes, partner at halving distance;
+    // rank v ends owning the fully reduced chunk v.
+    for (int m = q / 2; m >= 1; m /= 2, ++step) {
+      const int w = pos_of(v ^ m);
+      const int base = v & ~(2 * m - 1);
+      const int keep = (v & m) ? base + m : base;
+      const int send = (v & m) ? base : base + m;
+      for (int c = send; c < send + m; ++c)
+        Push(&s, step, w, c, ChunkAction::SEND);
+      for (int c = keep; c < keep + m; ++c)
+        Push(&s, step, w, c, ChunkAction::RECV_REDUCE);
+    }
+    // Allgather: doubling block sizes, the mirror image of the rounds
+    // above. The interpreter forwards previously received chunks'
+    // encoded bytes verbatim, so under a wire codec every chunk is
+    // quantized exactly once, by its owner.
+    for (int m = 1; m < q; m *= 2, ++step) {
+      const int w = pos_of(v ^ m);
+      const int mine = v & ~(m - 1);
+      const int theirs = mine ^ m;
+      for (int c = mine; c < mine + m; ++c)
+        Push(&s, step, w, c, ChunkAction::SEND);
+      for (int c = theirs; c < theirs + m; ++c)
+        Push(&s, step, w, c, ChunkAction::RECV);
+    }
   }
   if (t > 0 && p < 2 * t) {
     for (int c = 0; c < q; ++c)
@@ -106,53 +139,137 @@ ChunkSchedule BuildHalvingDoubling(int P, int p) {
   return s;
 }
 
-ChunkSchedule BuildStripedRing(int P, int p, int stripes) {
+ChunkSchedule BuildStripedRing(int P, int p, int stripes, int granularity) {
   // k independent ring instances over disjoint payload stripes; stripe
-  // j's chunk c is grid index j*P + c. Odd stripes rotate the OPPOSITE
-  // way, so with k >= 2 both duplex directions of each TCP link carry
-  // payload on every step — the classic bidirectional-ring bandwidth
-  // doubling. All stripes advance in lockstep per step, so the
-  // interpreter overlaps their transfers in one helper-thread wave.
+  // j's ring shard r splits into `granularity` consecutive sub-chunks,
+  // so shard (j, r)'s sub-chunk u is grid index (j*P + r)*g + u. Odd
+  // stripes rotate the OPPOSITE way, so with k >= 2 both duplex
+  // directions of each TCP link carry payload on every step — the
+  // classic bidirectional-ring bandwidth doubling. All stripes advance
+  // in lockstep per step, so the interpreter overlaps their transfers
+  // in one helper-thread wave. g == 1 reproduces the classic grid
+  // (and, at stripes == 1, the legacy ring's byte stream exactly).
   if (stripes < 1) stripes = 1;
+  if (granularity < 1) granularity = 1;
+  const int g = granularity;
   ChunkSchedule s;
-  s.nchunks = stripes * P;
+  s.nchunks = stripes * P * g;
   if (P <= 1) return Trivial(s.nchunks);
   auto mod = [&](int x) { return ((x % P) + P) % P; };
-  // Reduce-scatter: P-1 steps; stripe j's chunk mod(p - dir*(s+1))
+  auto shard = [&](ChunkSchedule* out, int st, int peer, int j, int r,
+                   ChunkAction a) {
+    for (int u = 0; u < g; ++u)
+      Push(out, st, peer, (j * P + r) * g + u, a);
+  };
+  // Reduce-scatter: P-1 steps; stripe j's shard mod(p - dir*(s+1))
   // leaves this rank while mod(p - dir*(s+2)) arrives and folds in.
   for (int st = 0; st < P - 1; ++st) {
     for (int j = 0; j < stripes; ++j) {
       const int dir = (j % 2 == 0) ? 1 : -1;
       const int next = mod(p + dir), prev = mod(p - dir);
-      Push(&s, st, next, j * P + mod(p - dir * (st + 1)),
-           ChunkAction::SEND);
-      Push(&s, st, prev, j * P + mod(p - dir * (st + 2)),
-           ChunkAction::RECV_REDUCE);
+      shard(&s, st, next, j, mod(p - dir * (st + 1)), ChunkAction::SEND);
+      shard(&s, st, prev, j, mod(p - dir * (st + 2)),
+            ChunkAction::RECV_REDUCE);
     }
   }
   // Allgather: P-1 forwarding steps; position p starts stripe j owning
-  // chunk p of that stripe.
+  // shard p of that stripe.
   for (int st = 0; st < P - 1; ++st) {
     for (int j = 0; j < stripes; ++j) {
       const int dir = (j % 2 == 0) ? 1 : -1;
       const int next = mod(p + dir), prev = mod(p - dir);
-      Push(&s, (P - 1) + st, next, j * P + mod(p - dir * st),
-           ChunkAction::SEND);
-      Push(&s, (P - 1) + st, prev, j * P + mod(p - dir * (st + 1)),
-           ChunkAction::RECV);
+      shard(&s, (P - 1) + st, next, j, mod(p - dir * st),
+            ChunkAction::SEND);
+      shard(&s, (P - 1) + st, prev, j, mod(p - dir * (st + 1)),
+            ChunkAction::RECV);
     }
   }
   return s;
 }
 
+ChunkSchedule BuildAllgatherRing(int P, int p) {
+  // P chunks, chunk k seeded at position k; step s ships chunk
+  // mod(p - s) to next while chunk mod(p - s - 1) lands from prev —
+  // the exact step/chunk sequence of RingAllgatherPhase /
+  // RingAllgatherVec, so the wire byte stream (and therefore the
+  // result bits) cannot differ between the table and legacy engines.
+  ChunkSchedule s;
+  s.nchunks = P;
+  if (P <= 1) return Trivial(P);
+  auto mod = [&](int x) { return ((x % P) + P) % P; };
+  for (int st = 0; st < P - 1; ++st) {
+    Push(&s, st, mod(p + 1), mod(p - st), ChunkAction::SEND);
+    Push(&s, st, mod(p - 1), mod(p - st - 1), ChunkAction::RECV);
+  }
+  return s;
+}
+
+ChunkSchedule BuildReduceScatterRing(int P, int p) {
+  // The reduce-scatter half of the classic ring: P-1 steps, chunk
+  // mod(p - st - 1) leaves while mod(p - st - 2) arrives and folds —
+  // position p ends owning reduced chunk p. Byte-stream identical to
+  // RingReduceScatterPhase over the same chunk offsets.
+  ChunkSchedule s;
+  s.nchunks = P;
+  if (P <= 1) return Trivial(P);
+  auto mod = [&](int x) { return ((x % P) + P) % P; };
+  for (int st = 0; st < P - 1; ++st) {
+    Push(&s, st, mod(p + 1), mod(p - st - 1), ChunkAction::SEND);
+    Push(&s, st, mod(p - 1), mod(p - st - 2), ChunkAction::RECV_REDUCE);
+  }
+  return s;
+}
+
+ChunkSchedule BuildAlltoallPairwise(int P, int p) {
+  // Grid P*P, chunk s*P + d = the (src s → dst d) block. Step 0 COPYes
+  // the self block; step s >= 1 sends my block for rank p+s while the
+  // block from rank p-s lands — the dense MPI_Alltoallv pairwise
+  // exchange, one full-duplex partner pair per step, exactly the
+  // legacy loop's wire pattern.
+  ChunkSchedule s;
+  s.nchunks = P * P;
+  if (P <= 1) return Trivial(P * P);
+  auto mod = [&](int x) { return ((x % P) + P) % P; };
+  Push(&s, 0, 0, p * P + p, ChunkAction::COPY);
+  for (int st = 1; st < P; ++st) {
+    const int dest = mod(p + st), src = mod(p - st);
+    Push(&s, st, dest, p * P + dest, ChunkAction::SEND);
+    Push(&s, st, src, src * P + p, ChunkAction::RECV);
+  }
+  return s;
+}
+
 ChunkSchedule BuildSchedule(int algo, int nranks, int pos) {
+  return BuildSchedule(algo, nranks, pos, 2, 1, 0);
+}
+
+ChunkSchedule BuildSchedule(int algo, int nranks, int pos, int stripes,
+                            int granularity, int hd_order) {
   switch (algo) {
     case kAlgoHd:
-      return BuildHalvingDoubling(nranks, pos);
+      return BuildHalvingDoubling(nranks, pos, hd_order);
     case kAlgoStriped:
-      return BuildStripedRing(nranks, pos, 2);
+      return BuildStripedRing(nranks, pos, stripes < 2 ? 2 : stripes,
+                              granularity);
     case kAlgoRing:
-      return BuildStripedRing(nranks, pos, 1);
+      return BuildStripedRing(nranks, pos, 1, granularity);
+    default:
+      return ChunkSchedule{};
+  }
+}
+
+ChunkSchedule BuildCollSchedule(int kind, int algo, int nranks, int pos,
+                                int stripes, int granularity, int hd_order) {
+  switch (kind) {
+    case kCollAllreduce:
+      return BuildSchedule(algo, nranks, pos, stripes, granularity,
+                           hd_order);
+    case kCollAllgather:
+      return BuildAllgatherRing(nranks, pos);
+    case kCollReducescatter:
+      return BuildReduceScatterRing(nranks, pos);
+    case kCollAlltoall:
+      return BuildAlltoallPairwise(nranks, pos);
     default:
       return ChunkSchedule{};
   }

@@ -1,0 +1,438 @@
+#include "hvd/topology.h"
+
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <sstream>
+
+#include "hvd/controller.h"
+#include "hvd/env.h"
+#include "hvd/logging.h"
+#include "hvd/metrics.h"
+#include "hvd/schedule.h"
+
+namespace hvd {
+
+namespace {
+
+// Probe shape. Small round-trips isolate alpha; large ones add enough
+// bytes that (rtt/2 - alpha)/bytes is a stable beta on loopback AND a
+// 10GbE link. Small and large iterations INTERLEAVE (the bench
+// protocol: sequential blocks drift ±30% under this box's scheduler)
+// and each estimator keeps its best (minimum) round — noise only ever
+// ADDS time, so the minimum is the cleanest sample either gets.
+constexpr int kProbeRounds = 4;
+constexpr int kSmallPerRound = 3;
+constexpr int64_t kSmallBytes = 64;
+constexpr int64_t kLargeBytes = 128 * 1024;
+constexpr int kWarmupPings = 2;
+constexpr int kProbeTimeoutMs = 20000;
+
+std::atomic<int64_t> g_probe_us{0};
+
+double NowUs() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// Round-robin tournament (circle method) partner of `me` in round `r`
+// over Q players (Q even; players >= P are byes). Player Q-1 is
+// fixed; the rest rotate through Q-1 slots.
+int CirclePartner(int me, int r, int Q) {
+  const int n = Q - 1;
+  auto player_at = [&](int slot) { return ((slot - r) % n + n) % n; };
+  if (me == Q - 1) return player_at(0);
+  const int slot = (me + r) % n;
+  if (slot == 0) return Q - 1;
+  return player_at(n - slot);
+}
+
+// One timed ping-pong leg. The initiator's clock sees send + echo;
+// rtt/2 is the one-way estimate under the (documented) symmetry
+// assumption. Returns false on a lost/timed-out connection.
+bool PingPong(TcpConn* conn, bool initiator, uint8_t* buf, int64_t n,
+              double* rtt_us) {
+  if (initiator) {
+    const double t0 = NowUs();
+    if (!conn->SendAll(buf, n) || !conn->RecvAll(buf, n)) return false;
+    *rtt_us = NowUs() - t0;
+    return true;
+  }
+  *rtt_us = 0;
+  return conn->RecvAll(buf, n) && conn->SendAll(buf, n);
+}
+
+// Measure my out-link to `peer` (I initiate) or serve as its echo
+// wall (peer initiates). Both roles walk the identical iteration
+// sequence, so the pair stays in lockstep without any barrier.
+bool MeasureLink(TcpConn* conn, bool initiator, double* alpha_us,
+                 double* beta_us_per_byte) {
+  std::vector<uint8_t> buf(static_cast<size_t>(kLargeBytes), 0x5a);
+  double small_min = 1e30, large_min = 1e30, rtt = 0;
+  for (int w = 0; w < kWarmupPings; ++w)
+    if (!PingPong(conn, initiator, buf.data(), kSmallBytes, &rtt))
+      return false;
+  for (int round = 0; round < kProbeRounds; ++round) {
+    for (int i = 0; i < kSmallPerRound; ++i) {
+      if (!PingPong(conn, initiator, buf.data(), kSmallBytes, &rtt))
+        return false;
+      small_min = std::min(small_min, rtt);
+    }
+    if (!PingPong(conn, initiator, buf.data(), kLargeBytes, &rtt))
+      return false;
+    large_min = std::min(large_min, rtt);
+  }
+  if (!initiator) return true;
+  // Floor alpha at a sane positive value: the cost model divides work
+  // among links and a zero-latency link would make every candidate
+  // free. A negative beta (large rtt measured under less interference
+  // than the small one) clamps to a tiny positive floor so bandwidth
+  // terms never vanish.
+  *alpha_us = std::max(0.05, small_min / 2.0);
+  *beta_us_per_byte =
+      std::max(1e-7, (large_min / 2.0 - *alpha_us) / kLargeBytes);
+  return true;
+}
+
+uint64_t Fnv1a(const std::string& s) {
+  uint64_t h = 1469598103934665603ull;
+  for (unsigned char c : s) {
+    h ^= c;
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+}  // namespace
+
+std::string SerializeTopology(const TopologyModel& m,
+                              const std::string& hostkey) {
+  std::ostringstream os;
+  os.precision(17);
+  os << "hvdtopo 1\nkey " << hostkey << "\nnp " << m.np << "\nalpha";
+  for (double a : m.alpha_us) os << " " << a;
+  os << "\nbeta";
+  for (double b : m.beta_us_per_byte) os << " " << b;
+  os << "\n";
+  return os.str();
+}
+
+TopologyModel ParseTopology(const std::string& blob,
+                            const std::string& hostkey_expect) {
+  TopologyModel m;
+  std::istringstream is(blob);
+  std::string tag, ver, key;
+  int np = 0;
+  if (!(is >> tag >> ver) || tag != "hvdtopo" || ver != "1") return m;
+  if (!(is >> tag >> key) || tag != "key") return m;
+  if (!hostkey_expect.empty() && key != hostkey_expect) return m;
+  if (!(is >> tag >> np) || tag != "np" || np < 2 || np > 4096) return m;
+  if (!(is >> tag) || tag != "alpha") return m;
+  const size_t n = static_cast<size_t>(np) * np;
+  m.alpha_us.resize(n);
+  for (size_t i = 0; i < n; ++i)
+    if (!(is >> m.alpha_us[i]) || m.alpha_us[i] < 0) return TopologyModel{};
+  if (!(is >> tag) || tag != "beta") return TopologyModel{};
+  m.beta_us_per_byte.resize(n);
+  for (size_t i = 0; i < n; ++i)
+    if (!(is >> m.beta_us_per_byte[i]) || m.beta_us_per_byte[i] < 0)
+      return TopologyModel{};
+  m.np = np;
+  return m;
+}
+
+std::string TopologyHostKey(int np, int local_size) {
+  char host[256] = "unknown";
+  gethostname(host, sizeof(host) - 1);
+  return std::string(host) + "|np" + std::to_string(np) + "|ls" +
+         std::to_string(local_size);
+}
+
+std::string TopologyCachePath(const std::string& hostkey) {
+  const char* dir = EnvStr("HOROVOD_TOPOLOGY_CACHE_DIR");
+  std::string d = dir != nullptr && *dir != '\0' ? dir : "/tmp";
+  char hex[24];
+  std::snprintf(hex, sizeof(hex), "%016llx",
+                static_cast<unsigned long long>(Fnv1a(hostkey)));
+  return d + "/horovod_tpu_topo_" + hex + ".txt";
+}
+
+TopologyModel LoadTopologyCache(const std::string& hostkey) {
+  FILE* f = std::fopen(TopologyCachePath(hostkey).c_str(), "rb");
+  if (f == nullptr) return TopologyModel{};
+  std::string blob;
+  char buf[4096];
+  size_t n;
+  while ((n = std::fread(buf, 1, sizeof(buf), f)) > 0) blob.append(buf, n);
+  std::fclose(f);
+  return ParseTopology(blob, hostkey);
+}
+
+void StoreTopologyCache(const TopologyModel& m, const std::string& hostkey) {
+  const std::string path = TopologyCachePath(hostkey);
+  const std::string tmp = path + "." + std::to_string(getpid());
+  FILE* f = std::fopen(tmp.c_str(), "wb");
+  if (f == nullptr) return;
+  const std::string blob = SerializeTopology(m, hostkey);
+  const bool ok = std::fwrite(blob.data(), 1, blob.size(), f) == blob.size();
+  std::fclose(f);
+  if (ok) {
+    std::rename(tmp.c_str(), path.c_str());  // atomic on one filesystem
+  } else {
+    std::remove(tmp.c_str());
+  }
+}
+
+TopologyModel ProbeTopology(Controller* controller, double* probe_ms_out) {
+  const int P = controller->size();
+  const int me = controller->rank();
+  TopologyModel out;
+  if (P < 2) return out;
+  MetricAdd(kCtrTopoProbes);
+  const double t0 = NowUs();
+
+  // My out-link row. Diagonal stays 0; unmeasured stays 0 until the
+  // broadcast fills the full matrix.
+  std::vector<double> row_a(P, 0.0), row_b(P, 0.0);
+  bool ok = true;
+  const int Q = P % 2 == 0 ? P : P + 1;
+  for (int r = 0; r < Q - 1 && ok; ++r) {
+    const int partner = CirclePartner(me, r, Q);
+    if (partner >= P) continue;  // bye round (odd P)
+    TcpConn* conn = controller->DataConn(partner);
+    if (conn == nullptr) {
+      ok = false;
+      break;
+    }
+    conn->SetRecvTimeout(kProbeTimeoutMs);
+    // Lower rank initiates first, then roles swap — each side measures
+    // its OWN out-link with its own clock.
+    for (int phase = 0; phase < 2 && ok; ++phase) {
+      const bool initiator = (me < partner) == (phase == 0);
+      ok = MeasureLink(conn, initiator, &row_a[partner], &row_b[partner]);
+    }
+    conn->SetRecvTimeout(0);
+  }
+
+  // Sync: workers frame their row to rank 0 over the (quiet) data
+  // link; rank 0 assembles the matrix and broadcasts ONE blob every
+  // rank parses — identical doubles everywhere, the property the
+  // coordinator-side selection and the schedule synthesizer rely on.
+  auto row_blob = [&](bool good) {
+    std::ostringstream os;
+    os.precision(17);
+    os << (good ? "row" : "fail") << " " << me;
+    for (int k = 0; k < P && good; ++k) os << " " << row_a[k];
+    for (int k = 0; k < P && good; ++k) os << " " << row_b[k];
+    return os.str();
+  };
+  // The blob is stamped with rank 0's hostkey; workers accept any key
+  // (on a multi-host job their hostname differs — the key only gates
+  // CACHE loads, where a stale file from another job shape must not
+  // leak in).
+  const std::string hostkey =
+      TopologyHostKey(P, controller->local_size());
+  std::string blob;
+  if (me == 0) {
+    TopologyModel m;
+    m.np = P;
+    m.alpha_us.assign(static_cast<size_t>(P) * P, 0.0);
+    m.beta_us_per_byte.assign(static_cast<size_t>(P) * P, 0.0);
+    bool all_ok = ok;
+    for (int k = 0; k < P; ++k) {
+      m.alpha_us[0 * P + k] = row_a[k];
+      m.beta_us_per_byte[0 * P + k] = row_b[k];
+    }
+    for (int peer = 1; peer < P; ++peer) {
+      TcpConn* conn = controller->DataConn(peer);
+      if (conn == nullptr) {
+        all_ok = false;
+        continue;
+      }
+      std::string rb;
+      conn->SetRecvTimeout(kProbeTimeoutMs);
+      const bool got = conn->RecvFrame(&rb);
+      conn->SetRecvTimeout(0);
+      if (!got) {
+        all_ok = false;
+        continue;
+      }
+      std::istringstream is(rb);
+      std::string tag;
+      int pos = -1;
+      if (!(is >> tag >> pos) || tag != "row" || pos != peer) {
+        all_ok = false;
+        continue;
+      }
+      for (int k = 0; k < P; ++k) is >> m.alpha_us[pos * P + k];
+      for (int k = 0; k < P; ++k) is >> m.beta_us_per_byte[pos * P + k];
+      if (!is) all_ok = false;
+    }
+    blob = all_ok ? SerializeTopology(m, hostkey) : std::string("invalid");
+    for (int peer = 1; peer < P; ++peer) {
+      TcpConn* conn = controller->DataConn(peer);
+      if (conn == nullptr || !conn->SendFrame(blob)) all_ok = false;
+    }
+    if (all_ok) out = m;
+  } else {
+    TcpConn* conn = controller->DataConn(0);
+    if (conn != nullptr && conn->SendFrame(row_blob(ok))) {
+      conn->SetRecvTimeout(kProbeTimeoutMs);
+      if (conn->RecvFrame(&blob)) out = ParseTopology(blob, "");
+      conn->SetRecvTimeout(0);
+    }
+  }
+
+  const double ms = (NowUs() - t0) / 1000.0;
+  g_probe_us.store(static_cast<int64_t>(ms * 1000.0),
+                   std::memory_order_relaxed);
+  if (probe_ms_out != nullptr) *probe_ms_out = ms;
+  if (!out.valid())
+    LOG_WARNING << "topology probe failed or was rejected; falling back "
+                   "to the hand-seeded selection bands";
+  return out;
+}
+
+double TopologyProbeMs() {
+  return g_probe_us.load(std::memory_order_relaxed) / 1000.0;
+}
+
+namespace {
+
+// Per-iovec-span overhead charged by the cost model: well under a
+// syscall (spans coalesce into one SendV) but nonzero, so contiguous
+// chunk sets (hd_order 0) price below interleaved ones at equal bytes
+// — the contiguity trade the hd orderings exist to expose.
+constexpr double kSpanOverheadUs = 0.2;
+
+// Byte split of `bytes` into `parts` chunks, ChunkOffsets discipline
+// (remainder on the leading chunks).
+int64_t ChunkBytes(int64_t bytes, int parts, int c) {
+  return bytes / parts + (c < bytes % parts ? 1 : 0);
+}
+
+}  // namespace
+
+double ScheduleCostUs(const std::vector<ChunkSchedule>& tables,
+                      int64_t bytes, const TopologyModel& m) {
+  const int P = static_cast<int>(tables.size());
+  if (P == 0 || !m.valid() || m.np != P) return 1e18;
+  const int nchunks = tables[0].nchunks;
+  int nsteps = 0;
+  for (const auto& t : tables) nsteps = std::max(nsteps, t.nsteps);
+  double total = 0;
+  for (int step = 0; step < nsteps; ++step) {
+    double step_us = 0;
+    for (int p = 0; p < P; ++p) {
+      // Coalesced per-peer send totals (one SendV per peer per step —
+      // the engine's actual shape) and the slowest receive; receives
+      // drain in parallel helper threads, sends stream sequentially.
+      std::vector<int64_t> send_b(P, 0), recv_b(P, 0);
+      std::vector<int> send_n(P, 0), recv_n(P, 0);
+      for (const auto& o : tables[p].ops) {
+        if (o.step != step) continue;
+        const int64_t b = ChunkBytes(bytes, nchunks, o.chunk);
+        if (o.action == ChunkAction::SEND) {
+          send_b[o.peer] += b;
+          ++send_n[o.peer];
+        } else if (o.action == ChunkAction::RECV ||
+                   o.action == ChunkAction::RECV_REDUCE) {
+          recv_b[o.peer] += b;
+          ++recv_n[o.peer];
+        }
+      }
+      double send_us = 0, recv_us = 0;
+      for (int w = 0; w < P; ++w) {
+        if (send_n[w] > 0)
+          send_us += m.alpha_us[p * P + w] +
+                     send_b[w] * m.beta_us_per_byte[p * P + w] +
+                     kSpanOverheadUs * send_n[w];
+        if (recv_n[w] > 0)
+          recv_us = std::max(
+              recv_us, m.alpha_us[w * P + p] +
+                           recv_b[w] * m.beta_us_per_byte[w * P + p] +
+                           kSpanOverheadUs * recv_n[w]);
+      }
+      step_us = std::max(step_us, std::max(send_us, recv_us));
+    }
+    total += step_us;
+  }
+  return total;
+}
+
+double AlgoCostUs(int algo, int64_t bytes, const TopologyModel& m,
+                  int stripes, int granularity, int hd_order) {
+  if (!m.valid()) return 1e18;
+  const int P = m.np;
+  if (algo == kAlgoDoubling) {
+    // Not a table: fold (odd halves ship the full payload to their
+    // even partner), log2(q) full-payload pair exchanges (full-duplex
+    // SendRecv, so a round costs its slowest LINK, not the sum), and
+    // the unfold. Worst link per round approximates the lockstep.
+    int q = 1;
+    while (q * 2 <= P) q *= 2;
+    const int t = P - q;
+    auto link = [&](int i, int j) {
+      return m.alpha_us[i * P + j] + bytes * m.beta_us_per_byte[i * P + j];
+    };
+    double total = 0;
+    if (t > 0) {
+      double fold = 0;
+      for (int i = 0; i < 2 * t; i += 2)
+        fold = std::max(fold, std::max(link(i + 1, i), link(i, i + 1)));
+      total += 2 * fold;  // fold + unfold
+    }
+    auto pos_of = [&](int vi) { return vi < t ? 2 * vi : vi + t; };
+    for (int mdist = 1; mdist < q; mdist *= 2) {
+      double round = 0;
+      for (int v = 0; v < q; ++v) {
+        const int i = pos_of(v), j = pos_of(v ^ mdist);
+        round = std::max(round, link(i, j));
+      }
+      total += round;
+    }
+    return total;
+  }
+  std::vector<ChunkSchedule> tables;
+  tables.reserve(P);
+  for (int p = 0; p < P; ++p)
+    tables.push_back(
+        BuildSchedule(algo, P, p, stripes, granularity, hd_order));
+  if (tables[0].ops.empty()) return 1e18;
+  return ScheduleCostUs(tables, bytes, m);
+}
+
+int ResolveAlgoMeasured(int64_t bytes, int np, bool hier_ok,
+                        int64_t ring_threshold_bytes,
+                        const TopologyModel& m, int stripes,
+                        int granularity, int hd_order) {
+  const int hand =
+      ResolveAlgoDefault(bytes, np, hier_ok, ring_threshold_bytes);
+  if (!m.valid() || m.np != np) return hand;
+  // The loopback-measured model cannot price the two-level hier
+  // decomposition (its intra-node legs ride shm, not these links);
+  // when the hand bands elect it, keep it.
+  if (hand == kAlgoHier) return kAlgoHier;
+  static const int kCandidates[] = {kAlgoRing, kAlgoHd, kAlgoStriped,
+                                    kAlgoDoubling};
+  int best = hand;
+  double best_cost = 1e18;
+  for (int algo : kCandidates) {
+    const double c = AlgoCostUs(algo, bytes, m, stripes, granularity,
+                                hd_order);
+    // Strict < keeps ties on the earlier candidate — deterministic on
+    // every rank because the model doubles are broadcast-identical.
+    if (c < best_cost) {
+      best_cost = c;
+      best = algo;
+    }
+  }
+  return best_cost < 1e18 ? best : hand;
+}
+
+}  // namespace hvd

@@ -997,9 +997,21 @@ def main():
         # early-exit margin at 1.018 — any attempt the box let through
         # honestly ends the protocol. Real overhead still fails: it
         # shows on every rank in every attempt.
-        iters, agreed = 50, None
+        # Box-speed gating (ISSUE 13 deflake): alongside each attempt's
+        # ratio, measure the box's OWN weather — the spread between the
+        # median and best metrics-off round. On a quiet box the rounds
+        # repeat within a few percent and the strict 2% budget is a
+        # meaningful gate; in a slow phase (the ~1/3 failure mode: the
+        # scheduler parks a rank for multi-second stretches) the spread
+        # blows past 15% and a best-vs-best ratio is weather, not
+        # registry cost. The spread is Max-allreduced like the ratio so
+        # every rank reports the same verdict, and the TEST widens the
+        # budget only when the measured spread says the box was noisy —
+        # real registry overhead shows at any spread, in every attempt.
+        iters, agreed, agreed_spread = 50, None, None
         for att in range(5):
             best = {}
+            off_rounds = []
             for rnd in range(10):
                 order = (False, True) if rnd % 2 == 0 else (True, False)
                 for on in order:
@@ -1009,16 +1021,22 @@ def main():
                         hvd.allreduce(x, op=hvd.Sum, name="ov.t")
                     dt = _t.perf_counter() - t0
                     best[on] = min(best.get(on, dt), dt)
+                    if not on:
+                        off_rounds.append(dt)
             set_metrics_enabled(True)
             ratio = best[True] / best[False]
-            worst = float(np.asarray(hvd.allreduce(
-                np.array([ratio]), op=hvd.Max, name=f"ov.agree.{att}"))[0])
-            agreed = worst if agreed is None else min(agreed, worst)
+            spread = (float(np.median(off_rounds)) - min(off_rounds)) \
+                / min(off_rounds)
+            worst, worst_spread = np.asarray(hvd.allreduce(
+                np.array([ratio, spread]), op=hvd.Max,
+                name=f"ov.agree.{att}")).tolist()
+            if agreed is None or worst < agreed:
+                agreed, agreed_spread = worst, worst_spread
             if agreed < 1.018:
                 break
         if r == 0:
             print(f"OVERHEAD on={best[True]:.6f} off={best[False]:.6f} "
-                  f"ratio={agreed:.4f}")
+                  f"ratio={agreed:.4f} spread={agreed_spread:.4f}")
         print(f"OK rank={r}")
 
     elif scenario == "timeline_restart":
@@ -1124,6 +1142,183 @@ def main():
             f"sendv averaging {bytes_per_call:.0f} B/syscall — header-"
             "sized sends are back")
         print(f"BPC {bytes_per_call:.0f}")
+
+    elif scenario == "topo_probe":
+        # Measured-topology plumbing (ISSUE 13), launched with
+        # HOROVOD_TOPOLOGY_PROBE=force by the test: the startup probe
+        # must install a full alpha-beta model on EVERY rank with
+        # byte-identical numbers (the broadcast-blob contract measured
+        # selection and synthesis rely on), selection must keep exact
+        # results, and the on-demand re-probe must run cleanly against
+        # the live background cycle (quiet data plane: no collectives
+        # in flight when it is called).
+        import hashlib
+        import json
+
+        topo = hvd.topology()
+        assert topo is not None, "probe forced but no model installed"
+        assert topo["np"] == s, topo
+        for i in range(s):
+            for j in range(s):
+                a = topo["alpha_us"][i][j]
+                b = topo["beta_us_per_byte"][i][j]
+                if i == j:
+                    assert a == 0.0 and b == 0.0, (i, j, a, b)
+                else:
+                    assert a > 0 and b > 0, (i, j, a, b)
+        blob = json.dumps(topo, sort_keys=True).encode()
+        print("TOPO " + hashlib.sha1(blob).hexdigest())
+        # Selection under the measured model stays exact (auto verdicts
+        # ride the cost model now — any table it picks must agree
+        # bitwise on integer-valued data).
+        for i, n in enumerate((1000, 40000, 300000)):
+            x = np.full(n, float(r + 1), np.float32)
+            out = np.asarray(hvd.allreduce(x, op=hvd.Sum, name=f"tp.{i}"))
+            assert (out == sum(range(1, s + 1))).all(), (i, out[:4])
+        m = hvd.metrics()
+        assert m["topology_probes_total"] >= 1, m
+        assert m["topology_links_measured"] == s * (s - 1), m
+        assert m["topology_probe_ms"] >= 0, m
+        assert m["collective_measured_selects_total"] >= (
+            1 if r == 0 else 0), m
+        # On-demand re-probe: collective call, no collectives in
+        # flight. The fresh model must remain full and identical.
+        ms = hvd.topology_probe()
+        assert ms > 0, ms
+        topo2 = hvd.topology()
+        assert topo2 is not None and topo2["np"] == s
+        print("TOPO2 " + hashlib.sha1(
+            json.dumps(topo2, sort_keys=True).encode()).hexdigest())
+        out = np.asarray(hvd.allreduce(
+            np.full(5000, float(r + 1), np.float32), op=hvd.Sum,
+            name="tp.post"))
+        assert (out == sum(range(1, s + 1))).all()
+
+    elif scenario == "topo_cached":
+        # HOROVOD_TOPOLOGY_PROBE=auto with a warm cache: the model must
+        # load from disk (rank 0) and broadcast — NO probe rounds run
+        # (topology_probes_total stays 0), which is what makes auto
+        # free for every job after the first on a hostset.
+        topo = hvd.topology()
+        assert topo is not None and topo["np"] == s, topo
+        m = hvd.metrics()
+        assert m["topology_probes_total"] == 0, m
+        assert m["topology_links_measured"] == s * (s - 1), m
+        out = np.asarray(hvd.allreduce(
+            np.full(3000, float(r + 1), np.float32), op=hvd.Sum,
+            name="tc.x"))
+        assert (out == sum(range(1, s + 1))).all()
+
+    elif scenario == "topo_off":
+        # HOROVOD_TOPOLOGY_PROBE=off: no model anywhere, measured
+        # selection unavailable (-1), hand bands serve every verdict,
+        # results stay exact.
+        import ctypes
+
+        from horovod_tpu.common.basics import get_lib
+
+        assert hvd.topology() is None
+        assert get_lib().hvd_algo_select_measured(
+            ctypes.c_int64(1 << 20), s, 0,
+            ctypes.c_int64(256 * 1024)) == -1
+        m = hvd.metrics()
+        assert m["topology_probes_total"] == 0, m
+        assert m["topology_links_measured"] == 0, m
+        out = np.asarray(hvd.allreduce(
+            np.full(3000, float(r + 1), np.float32), op=hvd.Sum,
+            name="to.x"))
+        assert (out == sum(range(1, s + 1))).all()
+
+    elif scenario == "table_parity":
+        # Allgather / reducescatter / alltoall through the schedule
+        # interpreter (ISSUE 13): digests printed so the test driver
+        # can compare HOROVOD_COLLECTIVE_TABLES=on vs off jobs bit for
+        # bit (the tables are wire-identical to the legacy engines by
+        # construction). Run with HOROVOD_SHM_DISABLE=1 so the TCP
+        # plane — not the arena — executes; ragged rows/splits exercise
+        # the non-uniform span paths, and MIN rides the RECV_REDUCE
+        # fold dispatch.
+        import hashlib
+
+        digests = []
+        rng = np.random.RandomState(40 + r)
+        g = hvd.allgather(rng.randn(3 * r + 1, 5).astype(np.float32),
+                          name="tb.ag")
+        digests.append("ag:" + hashlib.sha1(
+            np.asarray(g).tobytes()).hexdigest())
+        # Fused pair (async, coordinator fuses): multi-span chunks.
+        ga = hvd.allgather_async(
+            rng.randn(r + 1, 3).astype(np.float32), name="tb.agf.a")
+        gb = hvd.allgather_async(
+            rng.randn(2 * r + 2, 7).astype(np.float32), name="tb.agf.b")
+        gs = [hvd.synchronize(ga), hvd.synchronize(gb)]
+        digests.append("agf:" + hashlib.sha1(
+            b"".join(np.asarray(x).tobytes() for x in gs)).hexdigest())
+        x = rng.randn(4 * s, 3).astype(np.float32)
+        rs = hvd.reducescatter(x, op=hvd.Sum, name="tb.rs")
+        digests.append("rs:" + hashlib.sha1(
+            np.asarray(rs).tobytes()).hexdigest())
+        rs2 = hvd.reducescatter(x, op=hvd.Min, name="tb.rs.min")
+        digests.append("rsmin:" + hashlib.sha1(
+            np.asarray(rs2).tobytes()).hexdigest())
+        splits = [k + 1 for k in range(s)]
+        xa = rng.randn(sum(splits), 2).astype(np.float32)
+        a2a, rsplits = hvd.alltoall(xa, splits=splits, name="tb.a2a")
+        assert list(rsplits) == [r + 1] * s, rsplits
+        digests.append("a2a:" + hashlib.sha1(
+            np.asarray(a2a).tobytes()).hexdigest())
+        # A large allgather so the >8KB helper-thread wave runs too.
+        gbig = hvd.allgather(
+            rng.randn(5000 + 100 * r, 4).astype(np.float32), name="tb.agL")
+        digests.append("agL:" + hashlib.sha1(
+            np.asarray(gbig).tobytes()).hexdigest())
+        print("DIGEST " + "|".join(digests))
+
+    elif scenario == "synth_live":
+        # Synthesized allreduce tables live (ISSUE 13): the test sets
+        # HOROVOD_COLLECTIVE_STRIPES / _GRANULARITY / HOROVOD_HD_ORDER
+        # (tools/synth.py's hand-off knobs) and every forced family
+        # must reproduce the ring path's exact bits on integer-valued
+        # data — the live half of the simulated-executor verification.
+        rng = np.random.RandomState(300 + r)
+        x = rng.randint(-50, 50, 240007).astype(np.float32)
+        ref = np.asarray(hvd.allreduce(x.copy(), op=hvd.Sum, name="sl.ref",
+                                       algorithm="ring"))
+        want = sum(np.random.RandomState(300 + k)
+                   .randint(-50, 50, 240007).astype(np.float32)
+                   for k in range(s))
+        assert (ref == want).all(), "ring reference wrong"
+        for algo in ("striped", "hd", None):
+            out = np.asarray(hvd.allreduce(x.copy(), op=hvd.Sum,
+                                           name=f"sl.{algo}",
+                                           algorithm=algo))
+            assert out.tobytes() == ref.tobytes(), (
+                f"{algo} under synthesized parameters differs from ring")
+        # And under a lossy codec the synthesized tables must still
+        # land every rank on identical bytes (verbatim forwarding).
+        import hashlib
+        y = rng.randn(60013).astype(np.float32)
+        dg = []
+        for algo in ("striped", "hd"):
+            out = np.asarray(hvd.allreduce(
+                y.copy(), op=hvd.Sum, name=f"sl.{algo}.bf16",
+                algorithm=algo, compression=hvd.Compression.bf16))
+            dg.append(f"{algo}:{hashlib.sha1(out.tobytes()).hexdigest()}")
+        print("DIGEST " + "|".join(dg))
+        # Span-interpreter kinds in the same job (allgather over output
+        # spans, reduce-scatter fold, ragged alltoall) so one sanitizer
+        # scenario race-checks BOTH new engines alongside the
+        # synthesized allreduce tables.
+        g = np.asarray(hvd.allgather(
+            np.full((r + 2, 3), float(r), np.float32), name="sl.ag"))
+        assert g.shape[0] == sum(k + 2 for k in range(s)), g.shape
+        rs = np.asarray(hvd.reducescatter(
+            np.full((2 * s, 2), 1.0, np.float32), op=hvd.Sum, name="sl.rs"))
+        assert (rs == s).all(), rs
+        a2a, _ = hvd.alltoall(
+            np.repeat(np.arange(s, dtype=np.float32), 2)[:, None],
+            splits=[2] * s, name="sl.a2a")
+        assert (np.asarray(a2a) == r).all(), a2a
 
     else:
         raise SystemExit(f"unknown scenario {scenario}")

@@ -38,6 +38,38 @@ def test_find_regressions_algo_arm_keys():
     assert not any("collective_algo_table" in k for k in regs)
 
 
+def test_find_regressions_measured_selection_key_directions():
+    """ISSUE 13 keys: the measured-model and hand-band busbw arms gate
+    higher-is-better like every throughput key; the synthesized-table
+    and audit dumps (strings) never participate; topology_probe_ms is
+    tracked but UNGATED in both directions — a ~40 ms measurement under
+    ±30% box swings would make a 10% latency gate pure weather."""
+    prev = {"extra": {
+        "host_allreduce_busbw_measured_gbps_np4": {"16MB": 0.224},
+        "host_allreduce_busbw_handbands_gbps_np4": {"16MB": 0.198},
+        "collective_algo_synth_table_np4": {"16777216": "hd"},
+        "collective_algo_audit_np4": {
+            "16777216": {"default": "ring", "measured": "hd"}},
+        "topology_probe_ms": 71.0,
+    }}
+    cur = {"extra": {
+        "host_allreduce_busbw_measured_gbps_np4": {"16MB": 0.100},
+        "host_allreduce_busbw_handbands_gbps_np4": {"16MB": 0.100},
+        "collective_algo_synth_table_np4": {"16777216": "ring"},
+        "collective_algo_audit_np4": {},
+        "topology_probe_ms": 400.0,
+    }}
+    regs = bench.find_regressions(prev, cur)
+    assert "extra.host_allreduce_busbw_measured_gbps_np4.16MB" in regs
+    assert "extra.host_allreduce_busbw_handbands_gbps_np4.16MB" in regs
+    assert not any("synth_table" in k or "audit" in k for k in regs)
+    assert not any("topology_probe_ms" in k for k in regs)
+    # ...and a probe-time IMPROVEMENT is not flagged either (truly
+    # direction-less, not latency-inverted).
+    cur2 = {"extra": {"topology_probe_ms": 10.0}}
+    assert bench.find_regressions(prev, cur2) == {}
+
+
 def test_find_regressions_ignores_improvements_and_new_metrics():
     prev = {"value": 100.0, "extra": {"old_only": 5.0}}
     cur = {"value": 150.0, "extra": {"new_only": 1.0}}

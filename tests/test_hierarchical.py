@@ -94,6 +94,13 @@ def test_hierarchical_join_falls_back():
     run_two_node_job("join", local_size=2, n_nodes=2, extra_env=HIER_ENV)
 
 
+@pytest.mark.slow  # redundancy (ISSUE 13 budget): layout fitness is
+# ONE synced boolean (controller Initialize's AND-agreed my_hier_fit),
+# whose downgrade face runs tier-1 on every single-node np=4 job where
+# a hier verdict would be refused (ResolveCollectiveAlgo + the
+# executor-side guard read the same flag), and whose positive face the
+# remaining tier-1 2x2/2x3 hierarchical matrices pin. This ~8s spawn
+# re-proves only the flag's refusal wiring — slow tier.
 def test_hierarchical_refused_on_bad_layout():
     """A rank whose local/cross env does not fit node-major layout must
     disable hierarchical everywhere (not deadlock): run the matrix with
@@ -155,6 +162,14 @@ def test_hierarchical_fused_allgather_node_shm():
     _assert_node_arena_engaged(outs)
 
 
+@pytest.mark.slow  # redundancy (ISSUE 13 budget): the node-arena
+# gating predicate is single-sourced (controller.h
+# node_shm_applicable, which ANDs shm_wish) and its positive face runs
+# tier-1 every time via test_hierarchical_allgather_node_shm_2x3; the
+# shm-disable knob's job-wide semantics are separately pinned by the
+# single-host override-warning path. This spawns a full 2x2 matrix job
+# (~12s) only to assert a log line is absent — slow tier keeps the
+# negative composition without the tier-1 spawn.
 def test_node_arena_respects_shm_disable():
     outs = run_two_node_job("matrix", local_size=2, n_nodes=2,
                             extra_env={"HOROVOD_LOG_LEVEL": "info",

@@ -62,12 +62,6 @@ def make_clean_tree(root):
         #include "hvd/env.h"
         void f() { const char* v = EnvStr("HOROVOD_CYCLE_TIME"); (void)v; }
         """)
-    _write(root, "horovod_tpu/common/basics.py", """\
-        ABI_VERSION = 6
-        WIRE_VERSION_REQUEST_LIST = 2
-        WIRE_VERSION_RESPONSE_LIST = 5
-        METRICS_VERSION = 1
-        """)
     _write(root, "horovod_tpu/serve/rpc.py", """\
         RPC_PROTOCOL_VERSION = 1
         """)
@@ -86,11 +80,35 @@ def make_clean_tree(root):
     _write(root, "horovod_tpu/ops/quantized.py", """\
         INT8_BLOCK_ELEMS = 256
         """)
+    _write(root, "native/include/hvd/schedule.h", """\
+        enum CollectiveAlgo : int {
+          kAlgoAuto = 0,
+          kAlgoRing = 1,
+          kNumCollectiveAlgos = 2,
+        };
+        """)
+    _write(root, "native/src/schedule.cc", """\
+        const char* const kCollectiveAlgoNames[kNumCollectiveAlgos] = {
+            "auto", "ring"};
+        """)
+    _write(root, "horovod_tpu/common/basics.py", """\
+        ABI_VERSION = 6
+        WIRE_VERSION_REQUEST_LIST = 2
+        WIRE_VERSION_RESPONSE_LIST = 5
+        METRICS_VERSION = 1
+        COLLECTIVE_ALGOS = {
+            "auto": 0,
+            "ring": 1,
+        }
+        """)
+    _write(root, "docs/perf_tuning.md", """\
+        | `HOROVOD_COLLECTIVE_ALGO` | `auto` | force `ring` |
+        """)
     _write(root, "docs/index.md",
            "[observability](observability.md)\n")
     _write(root, "docs/observability.md", """\
         `cycles_total` `shm_ops_total` `cycle_us`
-        HOROVOD_CYCLE_TIME
+        HOROVOD_CYCLE_TIME HOROVOD_COLLECTIVE_ALGO
         """)
 
 
@@ -254,11 +272,50 @@ def test_external_links_ignored(tree):
     assert run_all(tree, only={"doc-links"}) == []
 
 
+def test_injected_algo_name_drift_fires(tree):
+    # basics.py maps "ring" to the wrong native id.
+    _write(tree, "horovod_tpu/common/basics.py", """\
+        ABI_VERSION = 6
+        WIRE_VERSION_REQUEST_LIST = 2
+        WIRE_VERSION_RESPONSE_LIST = 5
+        METRICS_VERSION = 1
+        COLLECTIVE_ALGOS = {
+            "auto": 0,
+            "ring": 2,
+        }
+        """)
+    fs = run_all(tree, only={"algo-name-pins"})
+    assert len(fs) == 1 and "COLLECTIVE_ALGOS" in fs[0].message, fs
+
+
+def test_injected_algo_enum_count_drift_fires(tree):
+    # A new enum entry without a name-table entry.
+    _write(tree, "native/include/hvd/schedule.h", """\
+        enum CollectiveAlgo : int {
+          kAlgoAuto = 0,
+          kAlgoRing = 1,
+          kAlgoHd = 2,
+          kNumCollectiveAlgos = 3,
+        };
+        """)
+    fs = run_all(tree, only={"algo-name-pins"})
+    assert fs and any("kNumCollectiveAlgos" in f.message for f in fs), fs
+
+
+def test_injected_algo_doc_row_drift_fires(tree):
+    # The docs knob row stops listing a live algorithm name.
+    _write(tree, "docs/perf_tuning.md", """\
+        | `HOROVOD_COLLECTIVE_ALGO` | `auto` | force an algorithm |
+        """)
+    fs = run_all(tree, only={"algo-name-pins"})
+    assert len(fs) == 1 and "`ring`" in fs[0].message, fs
+
+
 def test_every_rule_has_an_injection_test():
     """Meta-guard: adding a rule without an injection test here should
     fail loudly, not pass silently."""
     covered = {"getenv", "knob-docs", "abi-literal", "metric-sync",
-               "doc-links", "wire-codec-pins"}
+               "doc-links", "wire-codec-pins", "algo-name-pins"}
     assert covered == set(ALL_RULES), (
         "new lint rule(s) without bug-injection coverage: "
         f"{set(ALL_RULES) - covered}")

@@ -213,6 +213,29 @@ def test_scenario_clean_under_sanitizer(san, scenario, np_, extra, tmp_path):
         assert f"OK rank={r}" in out, f"[{san}] {scenario} rank {r}:\n{out}"
 
 
+@pytest.mark.parametrize("scenario,np_,extra", [
+    # The ISSUE 13 planes, tsan-only (their hazards are scheduling
+    # races, not memory errors, and the asan half already runs long):
+    # the startup probe's lockstep ping rounds + the on-demand re-probe
+    # racing the live background cycle + measured selection reading the
+    # model the API thread re-installs...
+    ("topo_probe", 4, {"HOROVOD_TOPOLOGY_PROBE": "force",
+                       "HOROVOD_SHM_DISABLE": "1"}),
+    # ...and the synthesized np=4 tables: interleaved-hd/striped-3/
+    # granularity-2 allreduce through ExecuteSchedule's receiver waves
+    # plus allgather/reducescatter/alltoall through the new span
+    # interpreter's helper threads.
+    ("synth_live", 4, {"HOROVOD_SHM_DISABLE": "1",
+                       "HOROVOD_COLLECTIVE_STRIPES": "3",
+                       "HOROVOD_COLLECTIVE_GRANULARITY": "2",
+                       "HOROVOD_HD_ORDER": "1"}),
+], ids=["topo_probe", "synth_live"])
+def test_topology_planes_clean_under_tsan(scenario, np_, extra, tmp_path):
+    outs = run_san_job("tsan", scenario, np_, extra, tmp_path)
+    for r, out in enumerate(outs):
+        assert f"OK rank={r}" in out, f"[tsan] {scenario} rank {r}:\n{out}"
+
+
 def test_ubsan_variant_builds_and_loads(tmp_path):
     """ubsan is build+smoke only: its findings are deterministic (no
     scheduling dependence), so one scenario through the fused pipeline

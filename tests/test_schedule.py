@@ -1,35 +1,36 @@
-"""Schedule-interpreter unit tests on a simulated in-process executor.
+"""Schedule-interpreter unit tests on the simulated executor.
 
 The chunk-schedule tables (native/include/hvd/schedule.h) are pure
-functions of (algorithm, nranks, position), exposed through
-``hvd_build_schedule``. This module executes every generated table for
-np ∈ {2, 3, 4, 8} on a lockstep simulator and verifies the properties
-the real interpreter relies on:
-
-* **complete** — every rank ends holding the full allreduce result;
-* **deadlock-free** — per (step, src→dst) pair the sender's chunk list
-  and the receiver's chunk list match exactly, in order (the real
-  engine posts one receiver thread per peer and streams sends in table
-  order, so matched per-step tables cannot deadlock);
-* **chunk-conserving** — nothing is received that was not sent, and a
-  rank never sends and receives the same chunk in one step (the
-  interpreter's buffers would race).
-
-Integer-valued chunk data makes float summation exact, so completeness
-is an equality check, not a tolerance.
+functions of (kind, algorithm, nranks, position, synthesis params),
+exposed through ``hvd_build_schedule`` / ``hvd_build_coll_schedule``.
+This module executes every generated table for np ∈ {2, 3, 4, 8} on
+the SHARED lockstep simulator (tools/schedule_verifier.py — the same
+verifier tools/synth.py gates synthesized tables through) and verifies
+completeness, deadlock-freedom and chunk conservation per collective
+kind, plus the selection-table and synthesis-surface contracts.
 """
 
 import ctypes
+import os
+import re
+import sys
 
 import pytest
 
-from horovod_tpu.common.basics import get_lib
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.common.basics import get_lib  # noqa: E402
+from tools import schedule_verifier as sv  # noqa: E402
+from tools import synth  # noqa: E402
 
 ALGO_RING, ALGO_HD, ALGO_STRIPED = 1, 2, 3
 SEND, RECV, RECV_REDUCE, COPY = 0, 1, 2, 3
+COLL_AR, COLL_AG, COLL_RS, COLL_A2A = 0, 1, 2, 3
 
 NPS = (2, 3, 4, 8)
 ALGOS = ((ALGO_RING, "ring"), (ALGO_HD, "hd"), (ALGO_STRIPED, "striped"))
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def build(algo, nranks, pos):
@@ -44,78 +45,81 @@ def build(algo, nranks, pos):
     return ns.value, nc.value, ops
 
 
-def simulate(algo, nranks):
-    """Run all ranks' tables in lockstep; returns per-rank final chunk
-    values. Raises AssertionError on any framing violation."""
-    scheds = [build(algo, nranks, p) for p in range(nranks)]
-    nsteps = max(s[0] for s in scheds)
-    nchunks = scheds[0][1]
-    assert all(s[1] == nchunks for s in scheds), "chunk grids disagree"
-    val = [[(r + 1) * 1000 + c for c in range(nchunks)]
-           for r in range(nranks)]
-    for step in range(nsteps):
-        sends = {}
-        for p in range(nranks):
-            touched_send, touched_recv = set(), set()
-            for (st, peer, chunk, act, _fl) in scheds[p][2]:
-                if st != step:
-                    continue
-                assert 0 <= chunk < nchunks
-                assert 0 <= peer < nranks and peer != p
-                if act == SEND:
-                    touched_send.add(chunk)
-                    sends.setdefault((p, peer), []).append(
-                        (chunk, val[p][chunk]))
-                elif act in (RECV, RECV_REDUCE):
-                    assert chunk not in touched_recv, (
-                        f"rank {p} step {step}: receives chunk {chunk} "
-                        f"twice — two receiver threads would race on one "
-                        f"buffer region")
-                    touched_recv.add(chunk)
-            assert not (touched_send & touched_recv), (
-                f"rank {p} step {step}: sends and receives the same chunk "
-                f"— the engine's buffers would race")
-        consumed = {k: 0 for k in sends}
-        new = [row[:] for row in val]
-        for p in range(nranks):
-            for (st, peer, chunk, act, _fl) in scheds[p][2]:
-                if st != step or act not in (RECV, RECV_REDUCE):
-                    continue
-                key = (peer, p)
-                assert key in sends and consumed[key] < len(sends[key]), (
-                    f"step {step}: rank {p} receives from {peer} with no "
-                    f"matching send — the real engine would deadlock")
-                got_chunk, got_val = sends[key][consumed[key]]
-                consumed[key] += 1
-                assert got_chunk == chunk, (
-                    f"step {step} {peer}->{p}: chunk order mismatch "
-                    f"(sent {got_chunk}, expected {chunk})")
-                new[p][chunk] = (got_val if act == RECV
-                                 else new[p][chunk] + got_val)
-        for key, n in consumed.items():
-            assert n == len(sends[key]), (
-                f"step {step}: {len(sends[key]) - n} unconsumed sends "
-                f"{key} — the sender would block forever")
-        val = new
-    return val, nchunks
+def build_all(nranks, algo=ALGO_RING, kind=COLL_AR, stripes=2, gran=1,
+              hd_order=0):
+    lib = get_lib()
+    return synth.build_all(lib, nranks, algo, stripes, gran, hd_order,
+                           kind=kind)
 
 
 @pytest.mark.parametrize("algo,name", ALGOS)
 @pytest.mark.parametrize("nranks", NPS)
 def test_schedule_complete_and_deadlock_free(algo, name, nranks):
-    val, nchunks = simulate(algo, nranks)
-    want = [sum((r + 1) * 1000 + c for r in range(nranks))
-            for c in range(nchunks)]
-    for p in range(nranks):
-        assert val[p] == want, (
-            f"{name} np={nranks} rank {p} incomplete: {val[p][:4]}...")
+    scheds = [build(algo, nranks, p) for p in range(nranks)]
+    sv.verify(scheds, nranks, sv.KIND_ALLREDUCE)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 13: every collective kind as a table, and the synthesis
+# parameter space (stripes × granularity × hd recursion ordering) —
+# the sketch grid tools/synth.py searches must verify wholesale.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kind,kname", [
+    (COLL_AG, sv.KIND_ALLGATHER),
+    (COLL_RS, sv.KIND_REDUCESCATTER),
+    (COLL_A2A, sv.KIND_ALLTOALL),
+])
+@pytest.mark.parametrize("nranks", NPS)
+def test_collective_kind_tables_verify(kind, kname, nranks):
+    scheds = build_all(nranks, kind=kind)
+    sv.verify(scheds, nranks, kname)
+
+
+@pytest.mark.parametrize("nranks", NPS)
+def test_synthesis_sketch_grid_verifies(nranks):
+    """Every sketch the synthesizer may emit is a valid allreduce at
+    every np — the verifier gate that makes a synthesized verdict safe
+    to hand to the live interpreter."""
+    for (algo, stripes, gran, hd_order) in synth.SKETCHES:
+        scheds = build_all(nranks, algo=algo, stripes=stripes, gran=gran,
+                           hd_order=hd_order)
+        sv.verify(scheds, nranks, sv.KIND_ALLREDUCE)
+
+
+def test_hd_orderings_same_steps_different_spans():
+    """The two hd recursion orderings move the same bytes in the same
+    step count; order 1's chunk sets are interleaved (that is the span
+    trade the cost model prices)."""
+    a = build_all(8, algo=ALGO_HD, hd_order=0)
+    b = build_all(8, algo=ALGO_HD, hd_order=1)
+    assert a[0][0] == b[0][0]  # nsteps
+    bytes_a = sum(1 for op in a[0][2] if op[3] == SEND)
+    bytes_b = sum(1 for op in b[0][2] if op[3] == SEND)
+    assert bytes_a == bytes_b  # same chunk count shipped
+    # Order-0 sends contiguous runs; order-1's first round sends the
+    # odd congruence class (stride 2) — provably non-contiguous.
+    step0_b = sorted(op[2] for op in b[0][2]
+                     if op[0] == 0 and op[3] == SEND)
+    assert step0_b == [c for c in range(8) if c % 2 == 1], step0_b
+
+
+def test_striped_granularity_refines_grid():
+    """granularity g multiplies the chunk grid without changing steps
+    or per-step peer byte totals (finer sub-chunks, same shards)."""
+    g1 = build_all(4, algo=ALGO_STRIPED, gran=1)
+    g2 = build_all(4, algo=ALGO_STRIPED, gran=2)
+    assert g2[0][1] == 2 * g1[0][1]  # nchunks doubles
+    assert g2[0][0] == g1[0][0]      # nsteps identical
+    ops1 = [op for op in g1[0][2] if op[0] == 0 and op[3] == SEND]
+    ops2 = [op for op in g2[0][2] if op[0] == 0 and op[3] == SEND]
+    assert len(ops2) == 2 * len(ops1)
 
 
 @pytest.mark.parametrize("nranks", NPS)
 def test_hd_latency_steps_beat_ring(nranks):
     """The point of halving-doubling: O(log P) steps where the ring
-    pays 2(P-1). (Equal at the power-of-two np=2/4 boundary cases only
-    when 2 log2 P == 2(P-1), i.e. P <= 2.)"""
+    pays 2(P-1)."""
     hd_steps = build(ALGO_HD, nranks, 0)[0]
     ring_steps = build(ALGO_RING, nranks, 0)[0]
     assert hd_steps <= ring_steps
@@ -140,6 +144,91 @@ def test_hd_ragged_handoff_flagged():
     assert all(fl == 1 for (_s, _p, _c, _a, fl) in ops), ops
     acts = {a for (_s, _p, _c, a, _f) in ops}
     assert acts == {SEND, RECV}, acts
+
+
+# ---------------------------------------------------------------------------
+# The verifier itself must catch broken tables (tools/synth.py's gate
+# is only as good as the injections that prove it fires).
+# ---------------------------------------------------------------------------
+
+def test_verifier_rejects_incomplete_table():
+    scheds = build_all(4)
+    # Drop rank 0's last step: its grid never completes.
+    ns, nc, ops = scheds[0]
+    scheds[0] = (ns, nc, [op for op in ops if op[0] < ns - 1])
+    with pytest.raises(AssertionError):
+        sv.verify(scheds, 4, sv.KIND_ALLREDUCE)
+
+
+def test_verifier_rejects_deadlock():
+    scheds = build_all(4)
+    ns, nc, ops = scheds[0]
+    # Rank 0 stops sending at step 0 — its peer's recv has no match.
+    scheds[0] = (ns, nc, [op for op in ops
+                          if not (op[0] == 0 and op[3] == SEND)])
+    with pytest.raises(AssertionError) as e:
+        sv.simulate(scheds, 4, sv.KIND_ALLREDUCE)
+    assert "deadlock" in str(e.value) or "matching send" in str(e.value)
+
+
+def test_verifier_rejects_chunk_order_mismatch():
+    # hd at np=4: step 0 ships a 2-chunk block to ONE partner, so
+    # reversing it breaks the per-(step, pair) span-order contract.
+    scheds = build_all(4, algo=ALGO_HD)
+    ns, nc, ops = scheds[0]
+    sends0 = [op for op in ops if op[0] == 0 and op[3] == SEND]
+    assert len(sends0) >= 2 and len({op[1] for op in sends0}) == 1
+    rest = [op for op in ops if not (op[0] == 0 and op[3] == SEND)]
+    scheds[0] = (ns, nc, list(reversed(sends0)) + rest)
+    with pytest.raises(AssertionError):
+        sv.simulate(scheds, 4, sv.KIND_ALLREDUCE)
+
+
+def test_verifier_rejects_unheld_send():
+    """Chunk conservation: an allgather rank must not ship a chunk it
+    never held/received."""
+    scheds = build_all(2, kind=COLL_AG)
+    ns, nc, ops = scheds[0]
+    # Rank 0 ships chunk 1 (rank 1's chunk) at step 0 — it holds only
+    # chunk 0. Give rank 1 a matching recv so framing is satisfied and
+    # conservation is the ONLY violation.
+    scheds[0] = (ns, nc, [(0, 1, 1, SEND, 0)] + ops)
+    ns1, nc1, ops1 = scheds[1]
+    scheds[1] = (ns1, nc1, [(0, 0, 1, RECV, 0)] + ops1)
+    with pytest.raises(AssertionError) as e:
+        sv.simulate(scheds, 2, sv.KIND_ALLGATHER)
+    assert "does not hold" in str(e.value)
+
+
+# ---------------------------------------------------------------------------
+# tools/synth.py: the sketch search itself.
+# ---------------------------------------------------------------------------
+
+def test_synth_ranks_only_verified_tables():
+    model = synth.uniform_model(4, alpha_us=30.0, gbps=1.0)
+    verdicts = synth.synthesize(model, sizes=[64 * 1024, 16 << 20])
+    for size, v in verdicts.items():
+        assert v["algo"] in ("ring", "hd", "striped"), v
+        assert v["cost_us"] > 0
+        assert v["rejected"] == [], v["rejected"]
+
+
+def test_synth_prefers_fewer_steps_when_latency_dominates():
+    """With huge alpha and infinite bandwidth the 2·log2 P hd table
+    must beat the 2(P-1)-step rings."""
+    model = synth.uniform_model(8, alpha_us=10000.0, gbps=1000.0)
+    v = synth.synthesize(model, sizes=[4096])[4096]
+    assert v["algo"] == "hd", v
+
+
+def test_synth_cost_constant_mirrors_native():
+    """SPAN_OVERHEAD_US must track kSpanOverheadUs in topology.cc —
+    drifted constants would make tools/synth.py and the runtime's
+    measured selection rank candidates differently."""
+    cc = open(os.path.join(ROOT, "native", "src", "topology.cc")).read()
+    m = re.search(r"kSpanOverheadUs\s*=\s*([0-9.]+)", cc)
+    assert m, "kSpanOverheadUs not found in topology.cc"
+    assert float(m.group(1)) == synth.SPAN_OVERHEAD_US
 
 
 # ---------------------------------------------------------------------------
@@ -188,3 +277,11 @@ def test_algo_names_roundtrip():
     lib = get_lib()
     names = [lib.hvd_algo_name(i).decode() for i in range(6)]
     assert names == ["auto", "ring", "hd", "striped", "doubling", "hier"]
+
+
+def test_measured_select_without_model_is_unavailable():
+    """hvd_algo_select_measured returns -1 with no live model (callers
+    fall back to the hand bands) — the off/failed-probe contract."""
+    lib = get_lib()
+    assert lib.hvd_algo_select_measured(
+        ctypes.c_int64(1 << 20), 4, 0, ctypes.c_int64(RING_THRESHOLD)) == -1

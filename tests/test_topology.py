@@ -1,0 +1,81 @@
+"""Measured-topology plumbing (ISSUE 13): the startup link probe, the
+disk cache, the broadcast-identical alpha-beta model, the on-demand
+re-probe, and the measured-selection fallback contract — live np jobs
+over loopback (scenarios in tests/_mp_worker.py)."""
+
+import glob
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tests.test_eager_multiprocess import run_job  # noqa: E402
+
+
+def test_forced_probe_installs_identical_model_np4(tmp_path):
+    """HOROVOD_TOPOLOGY_PROBE=force at np=4 (the acceptance shape):
+    every rank must hold a full, strictly positive alpha-beta matrix
+    with BYTE-IDENTICAL values (the broadcast-blob contract measured
+    selection and synthesis rely on), metrics must report the probe,
+    selection must stay exact, and the on-demand collective re-probe
+    must run cleanly against the live background cycle."""
+    outs = run_job("topo_probe", 4, timeout=240, extra_env={
+        "HOROVOD_TOPOLOGY_PROBE": "force",
+        "HOROVOD_TOPOLOGY_CACHE_DIR": str(tmp_path),
+        "HOROVOD_SHM_DISABLE": "1",
+    })
+    t1 = [re.search(r"TOPO (\w+)", o).group(1) for o in outs]
+    t2 = [re.search(r"TOPO2 (\w+)", o).group(1) for o in outs]
+    assert len(set(t1)) == 1, f"model diverged across ranks: {t1}"
+    assert len(set(t2)) == 1, f"re-probed model diverged: {t2}"
+    # force rewrites the cache; the file must parse as v1 with np=4.
+    files = glob.glob(str(tmp_path / "horovod_tpu_topo_*.txt"))
+    assert len(files) == 1, files
+    blob = open(files[0]).read()
+    assert blob.startswith("hvdtopo 1\n"), blob[:40]
+    assert "\nnp 4\n" in blob, blob[:120]
+    assert blob.count(" ") > 2 * 16, "matrix rows missing"
+
+
+def test_auto_loads_cache_without_reprobing(tmp_path):
+    """auto = probe once per hostset: the first job measures and writes
+    the cache, the second loads it (topology_probes_total == 0) and
+    still holds the full model."""
+    env = {
+        "HOROVOD_TOPOLOGY_CACHE_DIR": str(tmp_path),
+        "HOROVOD_SHM_DISABLE": "1",
+    }
+    run_job("topo_probe", 2, timeout=180,
+            extra_env=dict(env, HOROVOD_TOPOLOGY_PROBE="force"))
+    assert glob.glob(str(tmp_path / "horovod_tpu_topo_*.txt"))
+    run_job("topo_cached", 2, timeout=180,
+            extra_env=dict(env, HOROVOD_TOPOLOGY_PROBE="auto"))
+
+
+def test_probe_off_falls_back_to_hand_bands():
+    """off disables the model entirely: hvd.topology() is None,
+    hvd_algo_select_measured reads -1, and the hand-seeded bands keep
+    serving exact results."""
+    run_job("topo_off", 2, timeout=180, extra_env={
+        "HOROVOD_TOPOLOGY_PROBE": "off",
+        "HOROVOD_SHM_DISABLE": "1",
+    })
+
+
+def test_corrupt_cache_is_rejected_and_reprobed(tmp_path):
+    """A torn/garbage cache file must not poison the job: auto rejects
+    it at parse, probes fresh, and the job still ends with a full
+    model (the topo_probe scenario asserts probes >= 1)."""
+    env = {
+        "HOROVOD_TOPOLOGY_CACHE_DIR": str(tmp_path),
+        "HOROVOD_TOPOLOGY_PROBE": "force",
+        "HOROVOD_SHM_DISABLE": "1",
+    }
+    run_job("topo_probe", 2, timeout=180, extra_env=env)
+    files = glob.glob(str(tmp_path / "horovod_tpu_topo_*.txt"))
+    assert len(files) == 1
+    with open(files[0], "w") as f:
+        f.write("hvdtopo 1\nkey wrong\nnp 2\nalpha garbage\n")
+    env["HOROVOD_TOPOLOGY_PROBE"] = "auto"
+    run_job("topo_probe", 2, timeout=180, extra_env=env)

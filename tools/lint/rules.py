@@ -327,6 +327,83 @@ def rule_wire_codec_pins(root: str) -> List[Finding]:
     return out
 
 
+# ------------------------------------------------------------ algo-name-pins
+
+_SCHEDULE_H = "native/include/hvd/schedule.h"
+_SCHEDULE_CC = "native/src/schedule.cc"
+_BASICS_PY = "horovod_tpu/common/basics.py"
+_ALGO_DOC = "docs/perf_tuning.md"
+
+
+def rule_algo_name_pins(root: str) -> List[Finding]:
+    """The collective-algorithm id/name list lives in lockstep at three
+    sites: schedule.h's kAlgo* enum, schedule.cc's kCollectiveAlgoNames
+    table (the single native source — env parse, CSV, hvd_algo_name),
+    basics.py's COLLECTIVE_ALGOS ``algorithm=`` choices, and the
+    perf_tuning.md knob row. A drifted entry means an ``algorithm=``
+    kwarg, an env force, and the docs silently disagree about which
+    exchange a name runs."""
+    out: List[Finding] = []
+    try:
+        cc = _read(root, _SCHEDULE_CC)
+        hdr = _read(root, _SCHEDULE_H)
+    except FileNotFoundError:
+        return [Finding("algo-name-pins", _SCHEDULE_CC, 0,
+                        "schedule.cc/.h missing — the algo-name source "
+                        "of truth")]
+    m = re.search(
+        r"kCollectiveAlgoNames\[kNumCollectiveAlgos\]\s*=\s*\{([^}]*)\}", cc)
+    names = re.findall(r'"([a-z0-9]+)"', m.group(1)) if m else []
+    if not names:
+        return [Finding("algo-name-pins", _SCHEDULE_CC, 0,
+                        "kCollectiveAlgoNames initializer not found")]
+    nm = re.search(r"kNumCollectiveAlgos\s*=\s*(\d+)", hdr)
+    if nm and int(nm.group(1)) != len(names):
+        out.append(Finding(
+            "algo-name-pins", _SCHEDULE_H, 0,
+            f"kNumCollectiveAlgos={nm.group(1)} but kCollectiveAlgoNames "
+            f"has {len(names)} entries — enum and name table drifted"))
+    enum_ids = re.findall(r"kAlgo([A-Za-z0-9]+)\s*=\s*(\d+)", hdr)
+    for ident, val in enum_ids:
+        i = int(val)
+        if i >= len(names) or names[i] != ident.lower():
+            out.append(Finding(
+                "algo-name-pins", _SCHEDULE_H, 0,
+                f"kAlgo{ident}={val} does not map to "
+                f"kCollectiveAlgoNames[{val}] "
+                f"({names[i] if i < len(names) else '<missing>'})"))
+    try:
+        basics = _read(root, _BASICS_PY)
+    except FileNotFoundError:
+        basics = ""
+    bm = re.search(r"COLLECTIVE_ALGOS\s*=\s*\{([^}]*)\}", basics)
+    if not bm:
+        out.append(Finding("algo-name-pins", _BASICS_PY, 0,
+                           "COLLECTIVE_ALGOS dict pin not found"))
+    else:
+        pairs = re.findall(r'"([a-z0-9]+)"\s*:\s*(\d+)', bm.group(1))
+        if [p[0] for p in pairs] != names or any(
+                int(v) != i for i, (_, v) in enumerate(pairs)):
+            out.append(Finding(
+                "algo-name-pins", _BASICS_PY, 0,
+                f"COLLECTIVE_ALGOS {pairs} != native name order {names} — "
+                "the algorithm= choices must pin schedule.h ids"))
+    try:
+        doc = _read(root, _ALGO_DOC)
+    except FileNotFoundError:
+        doc = ""
+    doc_rows = "\n".join(ln for ln in doc.splitlines()
+                         if "HOROVOD_COLLECTIVE_ALGO" in ln)
+    for name in names:
+        if f"`{name}`" not in doc_rows:
+            out.append(Finding(
+                "algo-name-pins", _ALGO_DOC, 0,
+                f"algorithm name `{name}` missing from the "
+                "HOROVOD_COLLECTIVE_ALGO knob row — the docs list must "
+                "track kCollectiveAlgoNames"))
+    return out
+
+
 # ------------------------------------------------------------ metric-sync
 
 _METRICS_H = "native/include/hvd/metrics.h"
@@ -442,6 +519,7 @@ ALL_RULES: Dict[str, Callable[[str], List[Finding]]] = {
     "knob-docs": rule_knob_docs,
     "abi-literal": rule_abi_literal,
     "wire-codec-pins": rule_wire_codec_pins,
+    "algo-name-pins": rule_algo_name_pins,
     "metric-sync": rule_metric_sync,
     "doc-links": rule_doc_links,
 }

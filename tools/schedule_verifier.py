@@ -1,0 +1,173 @@
+"""Shared chunk-schedule verifier: the simulated in-process executor.
+
+Promoted out of tests/test_schedule.py (ISSUE 13) so BOTH consumers run
+the identical checks:
+
+* ``tests/test_schedule.py`` verifies every built-in generator for
+  np ∈ {2, 3, 4, 8};
+* ``tools/synth.py`` REJECTS any synthesized table that fails here
+  before it can ever be selected — an unverified table must never
+  reach the live interpreter.
+
+The simulator executes all ranks' tables in lockstep and enforces the
+framing contract the real engine (TcpOps::ExecuteSchedule /
+ExecuteScheduleSpans, native/src/ops.cc) relies on:
+
+* **deadlock-free** — per (step, src→dst) pair the sender's chunk list
+  and the receiver's chunk list match exactly, in order (the engine
+  posts one receiver thread per peer and streams sends in table order,
+  so matched per-step tables cannot deadlock);
+* **chunk-conserving** — nothing is received that was not sent, a rank
+  never ships a chunk it does not hold, and a rank never sends and
+  receives the same chunk in one step (the engine's buffers would
+  race);
+* **complete** — the final per-rank holdings satisfy the collective
+  KIND's contract (hvd/schedule.h CollKind): allreduce ends with every
+  rank holding the full reduced grid, allgather with every rank
+  holding every chunk, reducescatter with rank p owning reduced chunk
+  p, alltoall with rank p holding column p of the src×dst block grid.
+
+Integer-valued chunk data makes float summation exact, so completeness
+is an equality check, not a tolerance.
+
+Tables are (nsteps, nchunks, ops) triples with ops =
+[(step, peer, chunk, action, flags), ...] — exactly what
+``hvd_build_schedule`` / ``hvd_build_coll_schedule`` emit.
+"""
+
+SEND, RECV, RECV_REDUCE, COPY = 0, 1, 2, 3
+
+KIND_ALLREDUCE = "allreduce"
+KIND_ALLGATHER = "allgather"
+KIND_REDUCESCATTER = "reducescatter"
+KIND_ALLTOALL = "alltoall"
+
+
+def _seed(rank, chunk):
+    return (rank + 1) * 10000 + chunk
+
+
+def _initial(kind, nranks, nchunks):
+    """Per-rank initial chunk values; None = the rank does not hold the
+    chunk (sending it would ship garbage — the conservation check)."""
+    vals = []
+    for r in range(nranks):
+        if kind in (KIND_ALLREDUCE, KIND_REDUCESCATTER):
+            vals.append([_seed(r, c) for c in range(nchunks)])
+        elif kind == KIND_ALLGATHER:
+            # Chunk k seeded at position k (the ring table's ownership
+            # contract; P == 1 trivially owns its whole grid).
+            vals.append([_seed(r, c) if (c == r or nranks == 1) else None
+                         for c in range(nchunks)])
+        elif kind == KIND_ALLTOALL:
+            # Chunk s*P + d lives on src s until delivered to dst d.
+            vals.append([_seed(r, c) if c // nranks == r else None
+                         for c in range(nchunks)])
+        else:
+            raise ValueError(f"unknown kind {kind!r}")
+    return vals
+
+
+def simulate(scheds, nranks, kind=KIND_ALLREDUCE):
+    """Run all ranks' tables in lockstep; returns (per-rank final chunk
+    values, nchunks). Raises AssertionError on any framing violation."""
+    nsteps = max(s[0] for s in scheds)
+    nchunks = scheds[0][1]
+    assert all(s[1] == nchunks for s in scheds), "chunk grids disagree"
+    val = _initial(kind, nranks, nchunks)
+    for step in range(nsteps):
+        sends = {}
+        for p in range(nranks):
+            touched_send, touched_recv = set(), set()
+            for (st, peer, chunk, act, _fl) in scheds[p][2]:
+                if st != step:
+                    continue
+                assert 0 <= chunk < nchunks
+                if act == COPY:
+                    assert val[p][chunk] is not None, (
+                        f"rank {p} step {step}: COPY of chunk {chunk} it "
+                        f"does not hold")
+                    continue
+                assert 0 <= peer < nranks and peer != p
+                if act == SEND:
+                    assert val[p][chunk] is not None, (
+                        f"rank {p} step {step}: sends chunk {chunk} it "
+                        f"does not hold — the wire would ship garbage")
+                    touched_send.add(chunk)
+                    sends.setdefault((p, peer), []).append(
+                        (chunk, val[p][chunk]))
+                elif act in (RECV, RECV_REDUCE):
+                    assert chunk not in touched_recv, (
+                        f"rank {p} step {step}: receives chunk {chunk} "
+                        f"twice — two receiver threads would race on one "
+                        f"buffer region")
+                    touched_recv.add(chunk)
+            assert not (touched_send & touched_recv), (
+                f"rank {p} step {step}: sends and receives the same chunk "
+                f"— the engine's buffers would race")
+        consumed = {k: 0 for k in sends}
+        new = [row[:] for row in val]
+        for p in range(nranks):
+            for (st, peer, chunk, act, _fl) in scheds[p][2]:
+                if st != step or act not in (RECV, RECV_REDUCE):
+                    continue
+                key = (peer, p)
+                assert key in sends and consumed[key] < len(sends[key]), (
+                    f"step {step}: rank {p} receives from {peer} with no "
+                    f"matching send — the real engine would deadlock")
+                got_chunk, got_val = sends[key][consumed[key]]
+                consumed[key] += 1
+                assert got_chunk == chunk, (
+                    f"step {step} {peer}->{p}: chunk order mismatch "
+                    f"(sent {got_chunk}, expected {chunk})")
+                if act == RECV:
+                    new[p][chunk] = got_val
+                else:
+                    assert new[p][chunk] is not None, (
+                        f"step {step}: rank {p} RECV_REDUCEs into chunk "
+                        f"{chunk} it does not hold")
+                    new[p][chunk] += got_val
+        for key, n in consumed.items():
+            assert n == len(sends[key]), (
+                f"step {step}: {len(sends[key]) - n} unconsumed sends "
+                f"{key} — the sender would block forever")
+        val = new
+    return val, nchunks
+
+
+def verify(scheds, nranks, kind=KIND_ALLREDUCE):
+    """simulate() + the KIND's completeness contract. Raises
+    AssertionError with a diagnostic on any violation; returns the
+    final per-rank values on success (for further inspection)."""
+    val, nchunks = simulate(scheds, nranks, kind)
+    if kind == KIND_ALLREDUCE:
+        want = [sum(_seed(r, c) for r in range(nranks))
+                for c in range(nchunks)]
+        for p in range(nranks):
+            assert val[p] == want, (
+                f"allreduce np={nranks} rank {p} incomplete: "
+                f"{val[p][:4]}...")
+    elif kind == KIND_ALLGATHER:
+        for p in range(nranks):
+            for c in range(nchunks):
+                owner = c if nranks > 1 else p
+                assert val[p][c] == _seed(owner, c), (
+                    f"allgather np={nranks} rank {p} chunk {c}: "
+                    f"{val[p][c]} != owner {owner}'s value")
+    elif kind == KIND_REDUCESCATTER:
+        for p in range(nranks):
+            c = p if nranks > 1 else 0
+            want = sum(_seed(r, c) for r in range(nranks))
+            assert val[p][c] == want, (
+                f"reducescatter np={nranks} rank {p}: own chunk {c} = "
+                f"{val[p][c]} != reduced {want}")
+    elif kind == KIND_ALLTOALL:
+        for p in range(nranks):
+            for s in range(nranks):
+                c = s * nranks + p
+                assert val[p][c] == _seed(s, c), (
+                    f"alltoall np={nranks} rank {p}: block ({s}->{p}) = "
+                    f"{val[p][c]} != src value")
+    else:
+        raise ValueError(f"unknown kind {kind!r}")
+    return val

@@ -1,0 +1,220 @@
+"""Sketch-guided schedule synthesis from a measured topology model.
+
+TACCL (arXiv:2111.04867) closes the algorithm-selection loop by
+SYNTHESIZING collective schedules from a measured link profile instead
+of shipping hand-tuned ones; its "sketches" prune the search to
+structured families a human would recognize. This tool is that loop
+over the repo's chunk-schedule IR (native/include/hvd/schedule.h):
+
+* **sketches** — the generator families the native interpreter already
+  executes, enumerated over their synthesis parameters: the ring /
+  multi-ring-striped family (stripe count × chunk granularity) and the
+  halving-doubling family (recursion ordering). Every candidate is a
+  pure ``ChunkSchedule`` table built through the C ABI
+  (``hvd_build_coll_schedule``), so the output IS the IR the runtime
+  interprets — synthesis picks tables, it never invents a new engine.
+* **cost model** — the measured per-(src, dst) alpha-beta model
+  (hvd.topology(), the probe's broadcast matrix), walked with the same
+  one-SendV/RecvV-per-peer shape as native AlgoCostUs
+  (native/src/topology.cc): per step a rank pays its coalesced sends
+  overlapped against its slowest receive, and the step costs the
+  slowest rank.
+* **verifier** — every candidate must pass tools/schedule_verifier.py
+  (complete, deadlock-free, chunk-conserving) before it is eligible;
+  a table that fails verification is discarded with a note, never
+  ranked.
+
+The verdict per payload size is the winning (algo, stripes,
+granularity, hd_order) tuple; the runtime consumes it through the
+coordinator-synced knobs ``HOROVOD_COLLECTIVE_ALGO`` /
+``HOROVOD_COLLECTIVE_STRIPES`` / ``HOROVOD_COLLECTIVE_GRANULARITY`` /
+``HOROVOD_HD_ORDER`` (docs/perf_tuning.md "Measured topology &
+schedule synthesis").
+
+CLI::
+
+    python tools/synth.py --np 4 --model topo.json [--sizes 65536,...]
+    python tools/synth.py --np 4 --uniform-alpha-us 30 --uniform-gbps 1
+
+``--model`` takes hvd.topology()'s JSON shape; ``--uniform-*`` builds a
+synthetic homogeneous model (useful for what-if tables without a live
+job).
+"""
+
+import argparse
+import ctypes
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools import schedule_verifier as sv  # noqa: E402
+
+ALGO_RING, ALGO_HD, ALGO_STRIPED = 1, 2, 3
+ALGO_NAMES = {ALGO_RING: "ring", ALGO_HD: "hd", ALGO_STRIPED: "striped"}
+COLL_ALLREDUCE = 0
+
+# Per-iovec-span overhead (us) — MUST track kSpanOverheadUs in
+# native/src/topology.cc so this walk and the native one rank
+# candidates identically (hvd_algo_cost_us cross-checks in the tests).
+SPAN_OVERHEAD_US = 0.2
+
+# The sketch space: structured families, not free-form search — the
+# TACCL pruning. Granularity > 1 only pays when overlap matters, so
+# the grid stays small and the whole sweep is < 100 tables at np=8.
+SKETCHES = (
+    [(ALGO_RING, 1, g, 0) for g in (1, 2, 4)]
+    + [(ALGO_STRIPED, k, g, 0) for k in (2, 3, 4) for g in (1, 2)]
+    + [(ALGO_HD, 2, 1, o) for o in (0, 1)]
+)
+
+DEFAULT_SIZES = [1 << lg for lg in range(12, 25)]  # 4 KB .. 16 MB
+
+
+def _lib():
+    from horovod_tpu.common.basics import get_lib
+    return get_lib()
+
+
+def build_table(lib, kind, algo, nranks, pos, stripes, gran, hd_order):
+    """One rank's table via the C ABI; (nsteps, nchunks, ops)."""
+    ns, nc = ctypes.c_int(), ctypes.c_int()
+    n = lib.hvd_build_coll_schedule(kind, algo, nranks, pos, stripes, gran,
+                                    hd_order, ctypes.byref(ns),
+                                    ctypes.byref(nc), None, 0)
+    buf = (ctypes.c_int32 * (n * 5))()
+    lib.hvd_build_coll_schedule(kind, algo, nranks, pos, stripes, gran,
+                                hd_order, ctypes.byref(ns),
+                                ctypes.byref(nc), buf, n)
+    ops = [tuple(buf[i * 5:i * 5 + 5]) for i in range(n)]
+    return ns.value, nc.value, ops
+
+
+def build_all(lib, nranks, algo, stripes, gran, hd_order,
+              kind=COLL_ALLREDUCE):
+    return [build_table(lib, kind, algo, nranks, p, stripes, gran, hd_order)
+            for p in range(nranks)]
+
+
+def uniform_model(np_, alpha_us=30.0, gbps=1.0):
+    """Synthetic homogeneous model (what-if tables, unit tests)."""
+    beta = 1.0 / (gbps * 1000.0)  # us per byte at `gbps` GB/s
+    off_diag = lambda i, j, v: 0.0 if i == j else v  # noqa: E731
+    return {
+        "np": np_,
+        "alpha_us": [[off_diag(i, j, alpha_us) for j in range(np_)]
+                     for i in range(np_)],
+        "beta_us_per_byte": [[off_diag(i, j, beta) for j in range(np_)]
+                             for i in range(np_)],
+    }
+
+
+def schedule_cost_us(tables, bytes_, model):
+    """Python twin of native ScheduleCostUs (topology.cc) — same walk,
+    same constants, so the synthesizer and the runtime's measured
+    selection rank candidates identically."""
+    P = len(tables)
+    alpha, beta = model["alpha_us"], model["beta_us_per_byte"]
+    nchunks = tables[0][1]
+    nsteps = max(t[0] for t in tables)
+
+    def chunk_bytes(c):
+        return bytes_ // nchunks + (1 if c < bytes_ % nchunks else 0)
+
+    total = 0.0
+    for step in range(nsteps):
+        step_us = 0.0
+        for p in range(P):
+            send_b, send_n, recv_b, recv_n = {}, {}, {}, {}
+            for (st, peer, chunk, act, _fl) in tables[p][2]:
+                if st != step:
+                    continue
+                b = chunk_bytes(chunk)
+                if act == sv.SEND:
+                    send_b[peer] = send_b.get(peer, 0) + b
+                    send_n[peer] = send_n.get(peer, 0) + 1
+                elif act in (sv.RECV, sv.RECV_REDUCE):
+                    recv_b[peer] = recv_b.get(peer, 0) + b
+                    recv_n[peer] = recv_n.get(peer, 0) + 1
+            send_us = sum(alpha[p][w] + send_b[w] * beta[p][w]
+                          + SPAN_OVERHEAD_US * send_n[w]
+                          for w in send_b)
+            recv_us = max((alpha[w][p] + recv_b[w] * beta[w][p]
+                           + SPAN_OVERHEAD_US * recv_n[w]
+                           for w in recv_b), default=0.0)
+            step_us = max(step_us, send_us, recv_us)
+        total += step_us
+    return total
+
+
+def synthesize(model, sizes=None, lib=None):
+    """Search the sketch space per payload size. Returns
+    ``{size: {"algo", "stripes", "granularity", "hd_order", "cost_us",
+    "rejected": [...]}}`` — only VERIFIED tables are ever ranked."""
+    lib = lib or _lib()
+    np_ = model["np"]
+    sizes = sizes or DEFAULT_SIZES
+    verified, rejected = {}, []
+    for sketch in SKETCHES:
+        algo, stripes, gran, hd_order = sketch
+        tables = build_all(lib, np_, algo, stripes, gran, hd_order)
+        try:
+            sv.verify(tables, np_, sv.KIND_ALLREDUCE)
+        except AssertionError as e:
+            # An unverifiable table must never be selectable.
+            rejected.append({"sketch": sketch, "reason": str(e)[:200]})
+            continue
+        verified[sketch] = tables
+    if not verified:
+        # Surface the rejection reasons — they are the diagnostic the
+        # verifier gate exists to produce, not a stack trace.
+        raise RuntimeError(
+            "every sketch failed verification; nothing to rank:\n" +
+            json.dumps(rejected, indent=2, default=str))
+    out = {}
+    for size in sizes:
+        best, best_cost = None, float("inf")
+        for sketch, tables in sorted(verified.items()):
+            c = schedule_cost_us(tables, size, model)
+            if c < best_cost:
+                best, best_cost = sketch, c
+        algo, stripes, gran, hd_order = best
+        out[size] = {
+            "algo": ALGO_NAMES[algo],
+            "stripes": stripes,
+            "granularity": gran,
+            "hd_order": hd_order,
+            "cost_us": round(best_cost, 3),
+            "rejected": rejected,
+        }
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--np", type=int, required=True)
+    ap.add_argument("--model", help="JSON file in hvd.topology() shape")
+    ap.add_argument("--uniform-alpha-us", type=float, default=30.0)
+    ap.add_argument("--uniform-gbps", type=float, default=1.0)
+    ap.add_argument("--sizes",
+                    help="comma-separated payload bytes (default 4KB-16MB)")
+    args = ap.parse_args(argv)
+    if args.model:
+        with open(args.model) as f:
+            model = json.load(f)
+        if model.get("np") != args.np:
+            ap.error(f"model np={model.get('np')} != --np {args.np}")
+    else:
+        model = uniform_model(args.np, args.uniform_alpha_us,
+                              args.uniform_gbps)
+    sizes = ([int(s) for s in args.sizes.split(",")] if args.sizes
+             else None)
+    verdicts = synthesize(model, sizes)
+    print(json.dumps({str(k): v for k, v in sorted(verdicts.items())},
+                     indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
