@@ -198,6 +198,10 @@ def _bus_wire_worker():
         # coalescing ratio the vectored layer is gated on.
         results["transport"] = (
             lib.hvd_tcp_transport_mode_name().decode())
+        # Resolved submission-batching verdict rides along the same way
+        # (HOROVOD_TCP_IOURING wish ∧ end-to-end ring probe): "syscall"
+        # on this 4.4 kernel, "batched" where io_uring delivered.
+        results["iouring"] = lib.hvd_tcp_iouring_mode_name().decode()
         if m.get("tcp_sendv_calls_total"):
             results["sendv_bytes_per_call"] = int(
                 m["tcp_send_bytes_total"] / m["tcp_sendv_calls_total"])
@@ -447,29 +451,28 @@ def _transformer_worker():
         print("TFEXTRA " + json.dumps(out), flush=True)
 
         # In-jit mesh-compression arms (EQuARX, ops/quantized.py): the
-        # SAME train step at compression=none|bf16|int8, so the keys
-        # isolate what the quantized gradient reduce-scatter+all-gather
-        # buys end to end. The quantized path needs a dp-only mesh (no
-        # GSPMD collective to intercept otherwise) — build_mesh(dp=-1)
-        # above qualifies. Arms interleave round-robin per the +-30%
+        # SAME train step at compression=none|bf16|int8 on one mesh, so
+        # the key deltas isolate what the quantized gradient collectives
+        # buy end to end. Arms interleave round-robin per the +-30%
         # protocol (docs/perf_tuning.md) and report best-of-rounds;
-        # smaller shape than the headline so three extra compiles fit
-        # the worker's 300s cap, printed incrementally so a cap kill
-        # keeps everything already measured.
-        if all(s == 1 for ax, s in mesh.shape.items() if ax != "dp"):
-            from horovod_tpu.compression import Compression
+        # smaller shape than the headline so the extra compiles fit the
+        # worker's 300s cap, printed incrementally so a cap kill keeps
+        # everything already measured.
+        from horovod_tpu.compression import Compression
+
+        def comp_arms(arm_mesh, arms):
+            """Interleaved best-of-rounds compression arms on
+            ``arm_mesh`` -> ({arm: tokens/sec/chip}, n_params)."""
             cfg_c = TransformerConfig(
                 vocab_size=4096, d_model=1024, n_layers=4, n_heads=16,
                 n_kv_heads=8, d_ff=4096, max_seq=512, dtype=jnp.bfloat16,
                 sp_attention="local", remat=False)
-            arms = {"comp_none": None, "bf16": Compression.bf16,
-                    "int8": Compression.int8}
-            B, T, iters, rounds = 4 * mesh.devices.size, 512, 5, 3
+            B, T, iters, rounds = 4 * arm_mesh.devices.size, 512, 5, 3
             toks = jax.random.randint(jax.random.PRNGKey(2), (B, T + 1),
                                       0, cfg_c.vocab_size)
             live, n_params = {}, None
             for name, comp in arms.items():
-                init_s, stp, _ = make_train_step(cfg_c, mesh,
+                init_s, stp, _ = make_train_step(cfg_c, arm_mesh,
                                                  compression=comp)
                 st = jax.jit(init_s)(jax.random.PRNGKey(0))
                 for _ in range(2):                    # compile + warm
@@ -489,8 +492,12 @@ def _transformer_worker():
                     float(loss)
                     dt = time.perf_counter() - t0
                     live[name] = (stp, st)
-                    best[name] = max(best[name],
-                                     B * T * iters / dt / mesh.devices.size)
+                    best[name] = max(
+                        best[name],
+                        B * T * iters / dt / arm_mesh.devices.size)
+            return best, n_params
+
+        def emit_arms(best, n_params):
             for name, ts in best.items():
                 out[f"transformer_{name}_tokens_per_sec_per_chip"] = round(
                     ts, 1)
@@ -498,6 +505,25 @@ def _transformer_worker():
                     out[f"transformer_mfu_{name}"] = round(
                         100 * 6 * n_params * ts / peak_flops, 1)
             print("TFEXTRA " + json.dumps(out), flush=True)
+
+        # dp plane: the quantized allreduce needs a dp-only mesh (no
+        # GSPMD collective to intercept otherwise) — build_mesh(dp=-1)
+        # above qualifies.
+        if all(s == 1 for ax, s in mesh.shape.items() if ax != "dp"):
+            emit_arms(*comp_arms(mesh, {"comp_none": None,
+                                        "bf16": Compression.bf16,
+                                        "int8": Compression.int8}))
+
+        # fsdp plane (ISSUE 14): the same shape/protocol on a ZeRO-3
+        # mesh — comp_none rides GSPMD's own param-gather/grad-scatter,
+        # the codec arms the partial-manual fsdp island, so these keys
+        # isolate what quantizing the fsdp reduce-scatter hop buys.
+        if mesh.devices.size > 1:
+            emit_arms(*comp_arms(
+                build_mesh(fsdp=-1),
+                {"fsdp_comp_none": None,
+                 "fsdp_comp_bf16": Compression.bf16,
+                 "fsdp_comp_int8": Compression.int8}))
     except Exception:
         pass
 

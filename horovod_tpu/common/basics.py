@@ -38,7 +38,7 @@ EXEC_CALLBACK = 1
 # is enforced at library load below, and tests/test_wire_abi.py greps
 # the header so a native bump can't silently skew this shim even
 # before a rebuild happens.
-ABI_VERSION = 9
+ABI_VERSION = 10
 WIRE_VERSION_REQUEST_LIST = 3
 WIRE_VERSION_RESPONSE_LIST = 6
 
@@ -46,7 +46,7 @@ WIRE_VERSION_RESPONSE_LIST = 6
 # kMetricsVersion): the packed int64 layout hvd_metrics_snapshot
 # writes. Checked at library load AND against the header by
 # tests/test_metrics_abi.py, the same two-sided pin as the ABI above.
-METRICS_VERSION = 4
+METRICS_VERSION = 5
 
 # Native WireCodec ids (native/include/hvd/codec.h); -1 = follow the
 # job-wide HOROVOD_WIRE_COMPRESSION default.
@@ -319,6 +319,13 @@ def _declare_abi(lib: ctypes.CDLL, path: str) -> ctypes.CDLL:
                                        ctypes.c_uint64]
     lib.hvd_tcp_transport_mode.restype = ctypes.c_int
     lib.hvd_tcp_transport_mode_name.restype = ctypes.c_char_p
+    # Transport riders (ABI v10): io_uring submission-batching verdict
+    # (HOROVOD_TCP_IOURING end-to-end probe) and the WorkerPool
+    # affinity gauge (HOROVOD_REDUCE_THREAD_AFFINITY pinned-thread
+    # count).
+    lib.hvd_tcp_iouring_mode.restype = ctypes.c_int
+    lib.hvd_tcp_iouring_mode_name.restype = ctypes.c_char_p
+    lib.hvd_worker_affinity.restype = ctypes.c_int
     # Wire-codec kernels (perf_tuning.md HOROVOD_WIRE_COMPRESSION):
     # exercised directly by the codec round-trip/error-feedback tests.
     lib.hvd_wire_encoded_bytes.restype = ctypes.c_int64

@@ -78,6 +78,20 @@ def axis_size(axis_name) -> int:
     return _lax.psum(1, axis_name)
 
 
+def supports_narrow_psum_scatter() -> bool:
+    """Whether a sub-f32 ``lax.psum_scatter`` is safe to lower here.
+
+    Legacy XLA-CPU's ``AllReducePromotion`` pass hard-ABORTS on sub-f32
+    reduction-collective operands (the same crash the embed island and
+    pipeline.py work around with f32 wires); modern jax/XLA rewrites
+    them instead. The quantized reduce-scatter therefore only takes the
+    psum_scatter-native bf16/fp16 hop when the jax generation is modern
+    or the backend is not CPU — everywhere else it keeps the
+    all_to_all + f32-fold spelling (same wire bytes, no native reduce).
+    """
+    return HAS_NEW_SHARD_MAP or jax.default_backend() != "cpu"
+
+
 def pcast_varying(x, axes):
     """Declare ``x`` varying over manual ``axes`` where the VMA type
     system exists; identity on legacy jax (nothing to declare)."""

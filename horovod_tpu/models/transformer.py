@@ -468,14 +468,19 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh, optimizer=None, *,
 
     ``compression`` (a ``hvd.Compression`` member; None/none = the
     exact pre-existing GSPMD step, bitwise unchanged) opts the
-    data-parallel gradient allreduce into the quantized in-jit path
-    (EQuARX): the step is rebuilt as a ``shard_map`` over ``dp`` with
-    the model replicated per shard and gradients reduced by the
-    blockwise int8/bf16 reduce-scatter + all-gather of
+    data-plane gradient collectives into the quantized in-jit path
+    (EQuARX). On a dp-only mesh the step is rebuilt as a ``shard_map``
+    over ``dp`` with the model replicated per shard and gradients
+    reduced by the blockwise int8/bf16 reduce-scatter + all-gather of
     ``ops/quantized.py``, int8 with rank-local error-feedback residuals
-    carried in ``state["ef"]``. Scope: the quantized plane is the DP
-    gradient allreduce — tp/fsdp/sp sharding has no explicit collective
-    to intercept under GSPMD, so meshes with those axes > 1 raise.
+    carried in ``state["ef"]``. On a mesh with ``fsdp > 1`` the step
+    becomes the partial-manual fsdp island
+    (:func:`_make_fsdp_quantized_train_step`): params stay
+    fsdp-sharded, the gradient reduce-scatter ships ``codec``-narrow
+    bytes, and a second quantized hop covers ``dp`` when present.
+    Scope: dp and fsdp are the gradient planes — tp/sp/pp/ep sharding
+    has no gradient collective to intercept under GSPMD, so meshes with
+    those axes > 1 raise.
     """
     import optax
     if optimizer is None:
@@ -522,7 +527,37 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh, optimizer=None, *,
 
 def _make_quantized_train_step(cfg: TransformerConfig, mesh: Mesh,
                                optimizer, compression, codec: str):
-    """The ``compression=`` body of :func:`make_train_step`.
+    """The ``compression=`` dispatcher of :func:`make_train_step`.
+
+    Routes to the dp shard_map step (PR 9, byte-identical to before)
+    or — when the mesh carries ``fsdp > 1`` — to the fsdp island
+    below. Every other sharded axis raises: tp/sp/pp/ep collectives
+    are activation-sized psums GSPMD inserts in the middle of the
+    model, not gradient hops a codec could ride.
+    """
+    bad = [(ax, sz) for ax, sz in mesh.shape.items()
+           if ax not in ("dp", "fsdp") and sz > 1]
+    if bad:
+        raise ValueError(
+            f"make_train_step(compression={codec!r}) quantizes the "
+            f"data-parallel gradient allreduce and the fsdp gradient "
+            f"reduce-scatter; mesh axes {bad} have no explicit gradient "
+            "collective to intercept under GSPMD. Use a dp/fsdp mesh, "
+            "or compression=None for the GSPMD-sharded step.")
+    if "dp" not in mesh.shape and mesh.shape.get("fsdp", 1) <= 1:
+        raise ValueError(
+            f"compression= needs a data axis ('dp', or 'fsdp' > 1); "
+            f"mesh has {dict(mesh.shape)}")
+    if mesh.shape.get("fsdp", 1) > 1:
+        return _make_fsdp_quantized_train_step(cfg, mesh, optimizer,
+                                               compression, codec)
+    return _make_dp_quantized_train_step(cfg, mesh, optimizer,
+                                         compression, codec)
+
+
+def _make_dp_quantized_train_step(cfg: TransformerConfig, mesh: Mesh,
+                                  optimizer, compression, codec: str):
+    """The dp-only ``compression=`` body of :func:`make_train_step`.
 
     The GSPMD step has no interceptable dp gradient collective
     (autodiff of the global-mean loss reduces implicitly), so this
@@ -542,17 +577,6 @@ def _make_quantized_train_step(cfg: TransformerConfig, mesh: Mesh,
     from horovod_tpu.common.ops_enum import Average
     from horovod_tpu.ops.quantized import quantized_allreduce
 
-    if "dp" not in mesh.shape:
-        raise ValueError(f"compression= needs a 'dp' mesh axis; mesh has "
-                         f"{tuple(mesh.axis_names)}")
-    for ax, sz in mesh.shape.items():
-        if ax != "dp" and sz > 1:
-            raise ValueError(
-                f"make_train_step(compression={codec!r}) quantizes the "
-                f"data-parallel gradient allreduce; mesh axis {ax!r} of "
-                f"size {sz} has no explicit collective to intercept under "
-                "GSPMD. Use a dp-only mesh, or compression=None for the "
-                "GSPMD-sharded step.")
     ndp = mesh.shape["dp"]
     use_ef = compression_lib.needs_error_feedback(compression)
 
@@ -615,5 +639,207 @@ def _make_quantized_train_step(cfg: TransformerConfig, mesh: Mesh,
 
     param_sh = jax.tree.map(lambda s: NamedSharding(mesh, P()),
                             param_specs(cfg),
+                            is_leaf=lambda x: isinstance(x, P))
+    return init_state, jax.jit(step), param_sh
+
+
+def _fsdp_spec_dim(spec) -> Optional[int]:
+    """Index of the ``fsdp``-sharded dimension in a PartitionSpec
+    (None for fsdp-replicated leaves like the norms)."""
+    for i, entry in enumerate(spec):
+        if entry == "fsdp" or (isinstance(entry, tuple) and "fsdp" in entry):
+            return i
+    return None
+
+
+def _make_fsdp_quantized_train_step(cfg: TransformerConfig, mesh: Mesh,
+                                    optimizer, compression, codec: str):
+    """The fsdp ``compression=`` body of :func:`make_train_step`.
+
+    GSPMD's fsdp plane reduce-scatters gradients and all-gathers
+    params with collectives it inserts itself — there is no hop a
+    codec can ride. This variant expresses the fsdp step as a
+    partial-manual ``shard_map`` island (manual over the data axes
+    ``{dp, fsdp}``; on legacy jax the island is spelled full-manual,
+    exactly the generation gate the embed island uses — legal here
+    because every non-data axis is size 1, which the dispatcher
+    enforces):
+
+    * params stay fsdp-sharded on their ``param_specs`` dims (the
+      ZeRO-3 layout; optimizer state and EF residuals shard with
+      them), entering the island as local shards;
+    * the forward all-gathers each sharded leaf over ``fsdp`` in the
+      model dtype (the standard ZeRO param gather — already ≤ bf16
+      for bf16 models, deliberately not lossy-quantized: param error
+      has no EF to telescope through);
+    * the gradient reduce-scatter is the explicit
+      :func:`~horovod_tpu.ops.quantized.quantized_reduce_scatter`
+      hop — quantize per destination shard → ``all_to_all`` →
+      multiply-only f32 fold (psum_scatter-native for bf16/fp16 where
+      the backend allows, per the jax_compat probe); fsdp-replicated
+      leaves (norms) ride a full ``quantized_allreduce`` over fsdp;
+    * when the mesh also carries ``dp > 1``, a second
+      ``quantized_allreduce`` hop over ``dp`` reduces each gradient
+      shard across data-parallel groups (the requantize point — its
+      hop-2 re-encode + narrow all-gather);
+    * int8 error-feedback residuals are optimizer-state leaves
+      ``state["ef"] = {"fsdp": ..., "dp": ...}``, leading dims
+      ``[dp, fsdp]`` sharded ``P("dp", "fsdp")`` — per-rank slabs,
+      the same contract as the dp path — with the dp-hop residuals
+      shard-shaped (they compensate the post-scatter hop);
+    * the optimizer update runs OUTSIDE the island on the sharded
+      trees (pure elementwise; GSPMD keeps every leaf on its shard).
+    """
+    import optax
+
+    from horovod_tpu import compression as compression_lib
+    from horovod_tpu.common import jax_compat
+    from horovod_tpu.common.jax_compat import shard_map
+    from horovod_tpu.common.ops_enum import Average
+    from horovod_tpu.ops.quantized import (quantized_allreduce,
+                                           quantized_reduce_scatter)
+
+    nfsdp = mesh.shape["fsdp"]
+    ndp = mesh.shape.get("dp", 1)
+    dp_hop = ndp > 1
+    batch_axes = tuple(ax for ax in ("dp", "fsdp") if ax in mesh.shape)
+    lead = len(batch_axes)
+    world_shape = tuple(mesh.shape[ax] for ax in batch_axes)
+    use_ef = compression_lib.needs_error_feedback(compression)
+    specs = param_specs(cfg)
+
+    def _island_spec(spec):
+        d = _fsdp_spec_dim(spec)
+        return P(*[("fsdp" if i == d else None) for i in range(len(spec))])
+
+    isl_specs = jax.tree.map(_island_spec, specs,
+                             is_leaf=lambda x: isinstance(x, P))
+
+    # Shard divisibility is a build-time contract (shard_map cannot pad
+    # the way GSPMD does): every fsdp-sharded dim must divide by nfsdp.
+    shapes = jax.eval_shape(lambda k: init_params(cfg, k, None),
+                            jax.ShapeDtypeStruct((2,), jnp.uint32))
+    bad = []
+
+    def _check_divisible(path, leaf, spec):
+        d = _fsdp_spec_dim(spec)
+        if d is not None and leaf.shape[d] % nfsdp:
+            bad.append(f"{jax.tree_util.keystr(path)}{leaf.shape} dim {d}")
+    jax.tree_util.tree_map_with_path(_check_divisible, shapes, specs)
+    if bad:
+        raise ValueError(
+            f"make_train_step(compression={codec!r}): fsdp={nfsdp} does "
+            f"not divide the sharded dim of {bad}; pad the model dims "
+            "to multiples of the fsdp axis (the GSPMD path pads "
+            "implicitly, the manual island cannot).")
+
+    def island(p_shards, ef, tokens):
+        params = jax.tree.map(
+            lambda x, s: (lax.all_gather(x, "fsdp", axis=_fsdp_spec_dim(s),
+                                         tiled=True)
+                          if _fsdp_spec_dim(s) is not None else x),
+            p_shards, specs)
+
+        def loss_fn(p):
+            return lm_loss(p, {"tokens": tokens}, cfg, None)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        g_leaves, treedef = jax.tree.flatten(grads)
+        s_leaves = jax.tree.flatten(
+            specs, is_leaf=lambda x: isinstance(x, P))[0]
+        idx = (0,) * lead
+        expand = (None,) * lead
+        rs_res = (jax.tree.flatten(ef["fsdp"])[0] if use_ef
+                  else [None] * len(g_leaves))
+        dp_res = (jax.tree.flatten(ef["dp"])[0] if use_ef and dp_hop
+                  else [None] * len(g_leaves))
+        out, new_rs, new_dp = [], [], []
+        for g, s, r1, r2 in zip(g_leaves, s_leaves, rs_res, dp_res):
+            d = _fsdp_spec_dim(s)
+            r1l = r1[idx] if r1 is not None else None
+            if d is None:
+                y = quantized_allreduce(g, op=Average, axis_name="fsdp",
+                                        codec=codec, residual=r1l)
+            else:
+                y = quantized_reduce_scatter(g, op=Average,
+                                             axis_name="fsdp", codec=codec,
+                                             axis=d, residual=r1l)
+            if r1l is not None:
+                y, nr1 = y
+                new_rs.append(nr1[expand])
+            if dp_hop:
+                r2l = r2[idx] if r2 is not None else None
+                y = quantized_allreduce(y, op=Average, axis_name="dp",
+                                        codec=codec, residual=r2l)
+                if r2l is not None:
+                    y, nr2 = y
+                    new_dp.append(nr2[expand])
+            out.append(y)
+        grads = jax.tree.unflatten(treedef, out)
+        new_ef = {}
+        if use_ef:
+            new_ef["fsdp"] = jax.tree.unflatten(treedef, new_rs)
+            if dp_hop:
+                new_ef["dp"] = jax.tree.unflatten(treedef, new_dp)
+        for ax in batch_axes:
+            loss = lax.pmean(loss, ax)
+        return loss, grads, new_ef
+
+    # Modern jax: a genuine partial-manual island — only the data axes
+    # are manual, anything else rides auto/GSPMD. Legacy jax cannot
+    # lower partial-manual (axis_index becomes a PartitionId op the old
+    # partitioner rejects — the embed-island gate), so the island is
+    # full-manual there; the dispatcher guarantees the remaining axes
+    # are size 1, which full-manual handles trivially.
+    axis_names = ({"dp", "fsdp"} & set(mesh.axis_names)
+                  if jax_compat.HAS_NEW_SHARD_MAP else None)
+    # check_vma=False: the VMA checker cannot infer a tiled
+    # all_gather's output is replicated over the gathered axis (same
+    # limitation as the embed island).
+    smapped = shard_map(
+        island, mesh=mesh,
+        in_specs=(isl_specs, P(*batch_axes), P(batch_axes)),
+        out_specs=(P(), isl_specs, P(*batch_axes)),
+        axis_names=axis_names, check_vma=False)
+
+    def init_state(key):
+        shardings = jax.tree.map(lambda s: NamedSharding(mesh, s),
+                                 isl_specs,
+                                 is_leaf=lambda x: isinstance(x, P))
+        params = jax.device_put(init_params(cfg, key, None), shardings)
+        opt_state = optimizer.init(params)
+        state = {"params": params, "opt": opt_state,
+                 "step": jnp.zeros((), jnp.int32)}
+        if use_ef:
+            def z_full(p):
+                return jnp.zeros(world_shape + p.shape, jnp.float32)
+
+            def z_shard(p, s):
+                d = _fsdp_spec_dim(s)
+                shp = list(p.shape)
+                if d is not None:
+                    shp[d] //= nfsdp
+                return jnp.zeros(world_shape + tuple(shp), jnp.float32)
+
+            ef = {"fsdp": jax.tree.map(z_full, params)}
+            if dp_hop:
+                ef["dp"] = jax.tree.map(z_shard, params, specs)
+            state["ef"] = ef
+        return state
+
+    def step(state, batch):
+        loss, grads, new_ef = smapped(state["params"],
+                                      state.get("ef", {}),
+                                      batch["tokens"])
+        updates, new_opt = optimizer.update(grads, state["opt"],
+                                            state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        new_state = {"params": params, "opt": new_opt,
+                     "step": state["step"] + 1}
+        if use_ef:
+            new_state["ef"] = new_ef
+        return new_state, loss
+
+    param_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), isl_specs,
                             is_leaf=lambda x: isinstance(x, P))
     return init_state, jax.jit(step), param_sh

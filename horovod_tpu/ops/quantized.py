@@ -122,8 +122,34 @@ def _check_codec(codec: str):
         raise ValueError(f"unknown in-jit codec {codec!r}; one of {CODECS}")
 
 
+def _check_axis_name(axis_name, fn_name: str):
+    """Up-front rejection of tuple/list axis names on the quantized
+    paths: the all_to_all decomposition addresses ONE named axis, and a
+    tuple that slipped through used to die deep inside the collective
+    with an opaque XLA shape error. A clear ValueError at the API edge
+    is the contract (reshape the mesh, or reduce axis-by-axis — which
+    is exactly how the fsdp+dp train step composes its hops)."""
+    if not isinstance(axis_name, str):
+        raise ValueError(
+            f"{fn_name} reduces over a single named mesh axis; got "
+            f"{axis_name!r}. Reshape the mesh or reduce axis-by-axis "
+            "(sequential single-axis hops are the supported spelling "
+            "for multi-axis meshes).")
+
+
+def _native_cast_hop_ok(native_hop) -> bool:
+    """Whether the cast-codec reduce-scatter hop may lower as ONE
+    sub-f32 ``lax.psum_scatter`` instead of all_to_all + f32 fold.
+    ``native_hop`` None = probe (jax_compat), True/False = forced."""
+    if native_hop is not None:
+        return bool(native_hop)
+    from horovod_tpu.common.jax_compat import supports_narrow_psum_scatter
+    return supports_narrow_psum_scatter()
+
+
 def quantized_allreduce(x, op: ReduceOp = Average, axis_name: str = "dp", *,
-                        codec: str, residual: Optional[jax.Array] = None):
+                        codec: str, residual: Optional[jax.Array] = None,
+                        native_hop: Optional[bool] = None):
     """Allreduce ``x`` over ``axis_name`` with narrow bytes on both hops.
 
     Call under ``shard_map`` with ``axis_name`` manual. ``codec`` is one
@@ -149,11 +175,7 @@ def quantized_allreduce(x, op: ReduceOp = Average, axis_name: str = "dp", *,
     if op not in (Sum, Average):
         raise ValueError(
             f"compression={codec!r} supports op=Sum/Average only, got {op!r}")
-    if not isinstance(axis_name, str):
-        raise NotImplementedError(
-            "quantized_allreduce reduces over a single named axis; got "
-            f"axis tuple {axis_name!r} — reshape the mesh or reduce "
-            "axis-by-axis")
+    _check_axis_name(axis_name, "quantized_allreduce")
     if not jnp.issubdtype(x.dtype, jnp.floating):
         raise TypeError(
             f"cannot quantize dtype {x.dtype}; compression applies to "
@@ -187,9 +209,16 @@ def quantized_allreduce(x, op: ReduceOp = Average, axis_name: str = "dp", *,
     else:
         wire = _CAST_WIRE[codec]
         w1 = v.astype(wire)
-        wr = lax.all_to_all(w1, axis_name, split_axis=0, concat_axis=0,
-                            tiled=True)
-        y = wr.astype(jnp.float32).sum(axis=0)
+        if _native_cast_hop_ok(native_hop):
+            # psum_scatter-native hop: the backend reduces the narrow
+            # operand itself — one collective, same wire bytes as the
+            # all_to_all spelling, summation in the wire dtype.
+            y = lax.psum_scatter(w1, axis_name,
+                                 scatter_dimension=0).astype(jnp.float32)
+        else:
+            wr = lax.all_to_all(w1, axis_name, split_axis=0, concat_axis=0,
+                                tiled=True)
+            y = wr.astype(jnp.float32).sum(axis=0)
         w2 = y.astype(wire)
         z = lax.all_gather(w2, axis_name, axis=0,
                            tiled=False).astype(jnp.float32)
@@ -211,6 +240,94 @@ def quantized_allreduce(x, op: ReduceOp = Average, axis_name: str = "dp", *,
     return out, new_r
 
 
+def quantized_reduce_scatter(x, op: ReduceOp = Sum,
+                             axis_name: str = "fsdp", *, codec: str,
+                             axis: int = 0,
+                             residual: Optional[jax.Array] = None,
+                             native_hop: Optional[bool] = None):
+    """Reduce-scatter ``x`` over ``axis_name`` with the hop bytes
+    narrowed by ``codec`` — the explicit, interceptable spelling of the
+    GSPMD-inserted fsdp gradient reduce-scatter.
+
+    Composition (same contract as hop 1 of the allreduce): quantize
+    blockwise per destination shard → ``lax.all_to_all`` of the narrow
+    payload (+f32 scales for int8) → fixed-order **multiply-only** f32
+    fold; the wire bytes equal ``psum_scatter``'s. For the cast codecs
+    the fold may lower as ONE sub-f32 ``lax.psum_scatter`` where the
+    backend allows (``native_hop`` None = the jax_compat probe; legacy
+    XLA-CPU aborts on sub-f32 reduce collectives, so the probe keeps it
+    off there).
+
+    ``x``'s dim ``axis`` must divide by the axis size; this rank
+    returns its slice (``x.shape`` with that dim divided). ``"none"``
+    folds the exact f32 values (bitwise the psum-then-slice result
+    under the same fixed fold order). ``residual`` (f32, ``x``-shaped)
+    is this rank's EF buffer for the single encode point; with it the
+    call returns ``(shard, new_residual)``.
+    """
+    _check_codec(codec)
+    _check_axis_name(axis_name, "quantized_reduce_scatter")
+    if op not in (Sum, Average):
+        raise ValueError(
+            f"quantized_reduce_scatter supports op=Sum/Average, got {op!r}")
+    if codec != "none" and not jnp.issubdtype(x.dtype, jnp.floating):
+        raise TypeError(
+            f"cannot quantize dtype {x.dtype}; compression applies to "
+            "float gradients")
+    p = _axis_size(axis_name)
+    axis = axis % x.ndim
+    if x.shape[axis] % p:
+        raise ValueError(
+            f"quantized_reduce_scatter: dim {axis} of shape {x.shape} "
+            f"does not divide by the {axis_name!r} axis size {p}")
+    orig_dtype = x.dtype
+    moved = jnp.moveaxis(x, axis, 0)
+    # Row r of `rows` is the contiguous slab destined for rank r.
+    rows = moved.astype(jnp.float32).reshape(p, -1)
+    if residual is not None and codec != "none":
+        rows = rows + jnp.moveaxis(residual.astype(jnp.float32),
+                                   axis, 0).reshape(p, -1)
+    shard_shape = (moved.shape[0] // p,) + moved.shape[1:]
+
+    if codec == "none":
+        rr = lax.all_to_all(rows, axis_name, split_axis=0, concat_axis=0,
+                            tiled=True)
+        y = rr.sum(axis=0)
+        e1 = None
+    elif codec == "int8":
+        q1, s1 = blockwise_int8_encode(rows)
+        qr = lax.all_to_all(q1, axis_name, split_axis=0, concat_axis=0,
+                            tiled=True)
+        sr = lax.all_to_all(s1, axis_name, split_axis=0, concat_axis=0,
+                            tiled=True)
+        y = blockwise_int8_decode(qr, sr, rows.shape[-1]).sum(axis=0)
+        if residual is not None:
+            e1 = rows - blockwise_int8_decode(q1, s1, rows.shape[-1])
+    else:
+        wire = _CAST_WIRE[codec]
+        w1 = rows.astype(wire)
+        if _native_cast_hop_ok(native_hop):
+            y = lax.psum_scatter(w1, axis_name,
+                                 scatter_dimension=0).astype(jnp.float32)
+        else:
+            wr = lax.all_to_all(w1, axis_name, split_axis=0, concat_axis=0,
+                                tiled=True)
+            y = wr.astype(jnp.float32).sum(axis=0)
+        if residual is not None:
+            e1 = rows - w1.astype(jnp.float32)
+
+    if op == Average:
+        y = y * jnp.float32(1.0 / p)
+    shard = jnp.moveaxis(y.reshape(shard_shape), 0, axis).astype(orig_dtype)
+    if residual is None:
+        return shard
+    if e1 is None:                       # codec "none": nothing dropped
+        return shard, residual
+    # Encode error in SUM space (the Average factor never enters the
+    # residual, same discipline as the allreduce's EF update).
+    return shard, jnp.moveaxis(e1.reshape(moved.shape), 0, axis)
+
+
 def quantized_allgather(x, axis_name: str = "dp", *, codec: str,
                         axis: int = 0):
     """All-gather ``x`` with the wire bytes narrowed by ``codec``
@@ -221,6 +338,7 @@ def quantized_allgather(x, axis_name: str = "dp", *, codec: str,
     _check_codec(codec)
     if codec == "none":
         return lax.all_gather(x, axis_name, axis=axis, tiled=True)
+    _check_axis_name(axis_name, "quantized_allgather")
     if not jnp.issubdtype(x.dtype, jnp.floating):
         raise TypeError(f"cannot quantize dtype {x.dtype}")
     orig_dtype = x.dtype

@@ -40,7 +40,7 @@ namespace hvd {
 // hvd_stalled_tensors, and hvd_start_timeline returning an error code.
 constexpr int kWireVersionRequestList = 3;
 constexpr int kWireVersionResponseList = 6;
-constexpr int kAbiVersion = 9;
+constexpr int kAbiVersion = 10;
 
 enum class RequestType : uint8_t {
   ALLREDUCE = 0,

@@ -32,6 +32,9 @@ namespace hvd {
 // Snapshot layout version (bump on any enum/table/layout change) and
 // bucket count. Pinned by horovod_tpu/common/basics.py +
 // tests/test_metrics_abi.py.
+// v5: transport riders — tcp_iouring_batches_total counter plus the
+// tcp_iouring_mode (resolved submission-batching verdict) and
+// worker_affinity (currently CPU-pinned WorkerPool threads) gauges.
 // v4: measured-topology surface (topology_probes_total,
 // collective_measured_selects_total, topology_probe_ms /
 // topology_links_measured gauges) and the tcp_alltoall_us histogram
@@ -41,7 +44,7 @@ namespace hvd {
 // tcp_zerocopy_mode gauge (resolved transport mode).
 // v2: per-algorithm TCP allreduce counters (tcp_algo_*_ops_total) and
 // the hd/striped schedule-interpreter phase histograms.
-constexpr int kMetricsVersion = 4;
+constexpr int kMetricsVersion = 5;
 constexpr int kMetricsHistBuckets = 28;  // le = 2^0 .. 2^26, then +Inf
 
 // Monotonic counters (suffix _total) and point-in-time gauges (filled
@@ -83,6 +86,8 @@ enum MetricCounter : int {
   kCtrTcpSendvCalls,
   kCtrTcpRecvvCalls,
   kCtrTcpZerocopySends,
+  kCtrTcpIouringBatches,      // linked-SQE window batches submitted
+                              // (each = ONE io_uring_enter syscall)
   // Wire codec (codec.cc encode sites).
   kCtrWireEncodes,
   kCtrWirePreBytes,           // f32 payload bytes presented to encode
@@ -111,6 +116,9 @@ enum MetricCounter : int {
                               // 0 = vectored, 1 = MSG_ZEROCOPY live)
   kGaugeTopoProbeMs,          // last topology probe wall time (ms)
   kGaugeTopoLinks,            // links the current model measured
+  kGaugeTcpIouringMode,       // resolved submission batching (hvd/tcp.h:
+                              // 0 = per-window syscalls, 1 = io_uring)
+  kGaugeWorkerAffinity,       // WorkerPool threads currently CPU-pinned
   kNumMetricCounters
 };
 

@@ -7,8 +7,10 @@
 
 #include <sys/uio.h>
 
+#include <atomic>
 #include <chrono>
 #include <cstdint>
+#include <memory>
 #include <string>
 #include <vector>
 
@@ -26,13 +28,34 @@ enum TcpTransportMode : int {
 int ResolvedTransportMode();
 const char* TransportModeName(int mode);
 
+// Resolved io_uring submission-batching mode for multi-window
+// SendV/RecvV span lists, decided once per process from
+// HOROVOD_TCP_IOURING (auto/off) plus an end-to-end kernel probe: a
+// real SENDMSG + RECVMSG round trip through a freshly set-up ring must
+// deliver its completions (io_uring needs >= 5.1, the SENDMSG/RECVMSG
+// opcodes >= 5.3; this container's 4.4 kernel MUST fall back — the
+// probe discipline is the same as ProbeZerocopy's, nothing short of a
+// delivered completion counts). Exposed in hvd.metrics() as the
+// tcp_iouring_mode gauge.
+enum TcpIouringMode : int {
+  kIouringOff = 0,      // one sendmsg/recvmsg syscall per iovec window
+  kIouringBatched = 1,  // linked-SQE windows, one io_uring_enter each
+};
+int ResolvedIouringMode();
+const char* IouringModeName(int mode);
+
+class IouringQueue;  // tcp.cc-private ring state (one per direction)
+
 class TcpConn {
  public:
-  TcpConn() = default;
-  explicit TcpConn(int fd) : fd_(fd) {}
+  // Constructors/destructor live in tcp.cc: the batching ring members
+  // are unique_ptrs to a tcp.cc-private type, and any inline special
+  // member would need its complete definition for unwind cleanup.
+  TcpConn();
+  explicit TcpConn(int fd);
   TcpConn(const TcpConn&) = delete;
   TcpConn& operator=(const TcpConn&) = delete;
-  TcpConn(TcpConn&& o) noexcept : fd_(o.fd_), zc_(o.zc_) { o.fd_ = -1; }
+  TcpConn(TcpConn&& o) noexcept;
   TcpConn& operator=(TcpConn&& o) noexcept;
   ~TcpConn();
 
@@ -80,11 +103,33 @@ class TcpConn {
   // Drain MSG_ZEROCOPY completions from the error queue until
   // `*pending` sends are acknowledged (wait = block on POLLERR).
   bool ReapZerocopy(uint32_t* pending, bool wait);
+  // io_uring batched drain of iov[0..n): advances *consumed past the
+  // bytes the linked-SQE windows moved; the caller finishes any
+  // remainder (short transfer, cancelled link, sq pressure) on the
+  // classic windowed loop. False on a hard socket error or when
+  // submitted ops' completions cannot be confirmed (the stream
+  // position is then unknowable — the conn must tear down).
+  bool BatchedV(bool send, const struct iovec* iov, int n,
+                uint64_t* consumed);
 
   int fd_ = -1;
   // Per-fd SO_ZEROCOPY state: 0 = not yet requested, 1 = enabled,
   // -1 = the kernel refused (stay on the plain vectored path forever).
   int zc_ = 0;
+  // Lazily-created submission rings, one per direction: a conn may
+  // legitimately have ONE sender and ONE receiver thread concurrently
+  // (SendRecv's full-duplex exchange), but never two of either — the
+  // per-direction split keeps the rings single-threaded without locks.
+  std::unique_ptr<IouringQueue> iou_send_;
+  std::unique_ptr<IouringQueue> iou_recv_;
+  // Batching latched off for this conn after a ring failure (the
+  // zc_ = -1 discipline): without the latch, the lazy creation above
+  // would re-probe and retry a known-bad ring on every transfer.
+  // Atomic because the latch spans BOTH directions: SendRecv's
+  // concurrent sender and receiver may write/read it simultaneously
+  // (relaxed is enough — it only gates an optimization, and each
+  // direction's ring state is still single-threaded).
+  std::atomic<bool> iou_dead_{false};
 };
 
 // Dial the first reachable address of a multi-NIC candidate list,

@@ -47,10 +47,28 @@ class WorkerPool {
                    const std::function<void(int64_t, int64_t)>& fn)
       HVD_EXCLUDES(caller_mu_, mu_) HVD_NO_THREAD_SAFETY_ANALYSIS;
 
+  // NUMA/cache placement (HOROVOD_REDUCE_THREAD_AFFINITY=auto|off):
+  // under `auto`, every worker pins itself to one CPU of the process's
+  // allowed mask at spawn, round-robin from `base` — co-located ranks
+  // call ConfigureAffinity(local_rank * threads) at init so their
+  // crews interleave instead of stacking on cpu0. A pinned crew keeps
+  // the BufferPool's first-touch pages and the reducers that later
+  // read them on the SAME cores across ops (first-touch placement is
+  // only as stable as the threads that did the touching). Pinning is
+  // placement-only: the part split is a pure function of (n, parts),
+  // so results are bitwise identical pinned or not.
+  void ConfigureAffinity(int base);
+  // Worker threads currently holding a single-CPU pin (the
+  // worker_affinity gauge; 0 when the knob is off or pinning failed).
+  int PinnedWorkers() const {
+    return pinned_.load(std::memory_order_relaxed);
+  }
+
  private:
   WorkerPool() = default;
   void EnsureWorkers(int n) HVD_REQUIRES(mu_);
-  void WorkerLoop() HVD_NO_THREAD_SAFETY_ANALYSIS;
+  void WorkerLoop(int widx) HVD_NO_THREAD_SAFETY_ANALYSIS;
+  void MaybePin(int widx);
   // Claims + runs one range of the job generation `seq`; false when
   // none left or the live job is a different generation. Lock-free:
   // everything it touches is atomic or pinned by a successful claim.
@@ -76,6 +94,8 @@ class WorkerPool {
   // ordered by the ticket's release store, not by mu_).
   const std::function<void(int64_t, int64_t)>* job_fn_ = nullptr;
   int done_parts_ HVD_GUARDED_BY(mu_) = 0;
+  std::atomic<int> affinity_base_{0};
+  std::atomic<int> pinned_{0};
 };
 
 // Process-wide host-reduction thread budget consulted by

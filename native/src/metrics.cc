@@ -36,6 +36,7 @@ constexpr const char* kCounterNames[] = {
     "tcp_sendv_calls_total",
     "tcp_recvv_calls_total",
     "tcp_zerocopy_sends_total",
+    "tcp_iouring_batches_total",
     "wire_encodes_total",
     "wire_pre_bytes_total",
     "wire_post_bytes_total",
@@ -54,14 +55,17 @@ constexpr const char* kCounterNames[] = {
     "tcp_zerocopy_mode",
     "topology_probe_ms",
     "topology_links_measured",
+    "tcp_iouring_mode",
+    "worker_affinity",
 };
 
 constexpr int kCounterKinds[] = {
     0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
-    0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+    0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
     0, 0,        // measured selects, topology probes
     1, 1, 1, 1,  // pending/stalled tensors, reduce_threads, zc mode
     1, 1,        // topology probe ms / links measured
+    1, 1,        // iouring mode / worker affinity
 };
 
 constexpr const char* kHistNames[] = {

@@ -656,6 +656,11 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   // 0's knob (env or default) reaches every rank through the param
   // sync, the same discipline as the thresholds.
   hvd::SetHostReduceThreads(st.controller->reduce_threads());
+  // Stagger co-located ranks' pinned crews across the allowed CPUs
+  // (rank r's workers start r*threads slots in) so first-touch pages
+  // and their reducers land per-rank-disjoint under `auto` affinity.
+  hvd::WorkerPool::Get().ConfigureAffinity(
+      local_rank * st.controller->reduce_threads());
   if (s.ok() && hvd::EnvFlag("HOROVOD_SHM_DISABLE") &&
       (st.controller->shm_enabled() ||
        st.controller->node_shm_applicable())) {
@@ -737,6 +742,9 @@ void hvd_shutdown() {
   st.initialized.store(false);
 }
 
+// v10: transport-rider surface (hvd_tcp_iouring_mode + _name,
+// hvd_worker_affinity) and metrics v5 (tcp_iouring_batches_total,
+// tcp_iouring_mode / worker_affinity gauges) — wire formats unchanged.
 // v9: measured-topology surface (hvd_topology / hvd_topology_probe /
 // hvd_algo_select_measured / hvd_algo_cost_us) + the extended
 // any-collective builder hvd_build_coll_schedule — wire formats
@@ -952,6 +960,9 @@ int64_t hvd_metrics_snapshot(int64_t* out, int64_t max_slots) {
   // only this completion-less sandbox pays its ~40 ms poll bound, once
   // per metrics-reading process.
   reg.Set(hvd::kGaugeTcpZerocopyMode, hvd::ResolvedTransportMode());
+  reg.Set(hvd::kGaugeTcpIouringMode, hvd::ResolvedIouringMode());
+  reg.Set(hvd::kGaugeWorkerAffinity,
+          hvd::WorkerPool::Get().PinnedWorkers());
   reg.Set(hvd::kGaugeTopoProbeMs,
           static_cast<int64_t>(hvd::TopologyProbeMs()));
   // Links reflect the LIVE model (a cache-loaded model measured them
@@ -1258,6 +1269,17 @@ int hvd_tcp_transport_mode() { return hvd::ResolvedTransportMode(); }
 const char* hvd_tcp_transport_mode_name() {
   return hvd::TransportModeName(hvd::ResolvedTransportMode());
 }
+
+int hvd_tcp_iouring_mode() { return hvd::ResolvedIouringMode(); }
+
+const char* hvd_tcp_iouring_mode_name() {
+  return hvd::IouringModeName(hvd::ResolvedIouringMode());
+}
+
+// Worker threads currently CPU-pinned (the worker_affinity gauge; 0
+// under HOROVOD_REDUCE_THREAD_AFFINITY=off, and until the pool's lazy
+// workers have actually spawned).
+int hvd_worker_affinity() { return hvd::WorkerPool::Get().PinnedWorkers(); }
 
 // Test hooks: drive the Bayesian autotune optimizer (hvd/bayesian.h)
 // against a caller-provided objective, so tests can assert global

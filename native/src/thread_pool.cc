@@ -1,14 +1,32 @@
 #include "hvd/thread_pool.h"
 
+#if defined(__linux__)
+#include <pthread.h>
+#include <sched.h>
+#endif
+
 #include <algorithm>
 #include <cstring>
 
+#include "hvd/env.h"
 #include "hvd/metrics.h"
 
 namespace hvd {
 
 namespace {
 std::atomic<int> g_reduce_threads{1};
+
+// HOROVOD_REDUCE_THREAD_AFFINITY: auto (pin worker threads, the
+// default) | off. Resolved once per process, same sane-knob
+// discipline as the transport modes.
+bool AffinityEnabled() {
+  static const bool on = [] {
+    static const char* kChoices[] = {"auto", "off"};
+    return EnvChoiceSane("HOROVOD_REDUCE_THREAD_AFFINITY", 0, kChoices,
+                         2) == 0;
+  }();
+  return on;
+}
 }  // namespace
 
 int HostReduceThreads() {
@@ -32,9 +50,41 @@ WorkerPool& WorkerPool::Get() {
   return *pool;
 }
 
+void WorkerPool::ConfigureAffinity(int base) {
+  affinity_base_.store(base, std::memory_order_relaxed);
+}
+
+void WorkerPool::MaybePin(int widx) {
+  if (!AffinityEnabled()) return;
+#if defined(__linux__)
+  // Pin within the ALLOWED mask (a containerized or taskset'd process
+  // must stay inside its cgroup cpuset), round-robin from the
+  // configured base. Index 0 is reserved for the caller/coordination
+  // thread's usual home, so worker 0 starts at base + 1.
+  cpu_set_t allowed;
+  CPU_ZERO(&allowed);
+  if (sched_getaffinity(0, sizeof(allowed), &allowed) != 0) return;
+  int cpus[CPU_SETSIZE], n_allowed = 0;
+  for (int c = 0; c < CPU_SETSIZE && n_allowed < CPU_SETSIZE; ++c)
+    if (CPU_ISSET(c, &allowed)) cpus[n_allowed++] = c;
+  if (n_allowed <= 1) return;  // nothing to place against
+  const int base = affinity_base_.load(std::memory_order_relaxed);
+  const int cpu = cpus[(base + widx + 1) % n_allowed];
+  cpu_set_t one;
+  CPU_ZERO(&one);
+  CPU_SET(cpu, &one);
+  if (pthread_setaffinity_np(pthread_self(), sizeof(one), &one) == 0)
+    pinned_.fetch_add(1, std::memory_order_relaxed);
+#else
+  (void)widx;
+#endif
+}
+
 void WorkerPool::EnsureWorkers(int n) {
-  while (static_cast<int>(workers_.size()) < n)
-    workers_.emplace_back([this] { WorkerLoop(); });
+  while (static_cast<int>(workers_.size()) < n) {
+    const int widx = static_cast<int>(workers_.size());
+    workers_.emplace_back([this, widx] { WorkerLoop(widx); });
+  }
 }
 
 bool WorkerPool::RunOnePart(uint32_t seq) {
@@ -84,7 +134,8 @@ bool WorkerPool::RunOnePart(uint32_t seq) {
   return true;
 }
 
-void WorkerPool::WorkerLoop() {
+void WorkerPool::WorkerLoop(int widx) {
+  MaybePin(widx);
   uint32_t seen = 0;
   std::unique_lock<std::mutex> lock(mu_.native());
   for (;;) {

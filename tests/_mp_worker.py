@@ -1142,6 +1142,15 @@ def main():
             f"sendv averaging {bytes_per_call:.0f} B/syscall — header-"
             "sized sends are back")
         print(f"BPC {bytes_per_call:.0f}")
+        # Transport riders (ISSUE 14): the resolved io_uring verdict is
+        # a real gauge, and with batching off (forced, or probed out on
+        # this 4.4 kernel) no batch may ever have been submitted. The
+        # driver test compares the RIDERS line across knob arms.
+        assert m["tcp_iouring_mode"] in (0, 1), m
+        if m["tcp_iouring_mode"] == 0:
+            assert m["tcp_iouring_batches_total"] == 0, m
+        print(f"RIDERS iouring={int(m['tcp_iouring_mode'])} "
+              f"affinity={int(m['worker_affinity'])}")
 
     elif scenario == "topo_probe":
         # Measured-topology plumbing (ISSUE 13), launched with

@@ -149,6 +149,33 @@ def test_find_regressions_mesh_compression_key_directions():
     assert regs["extra.transformer_mfu_int8"]["drop_pct"] > 35
 
 
+def test_find_regressions_fsdp_compression_key_directions():
+    """ISSUE 14 keys: the fsdp-plane compression arms
+    (transformer_mfu_fsdp_comp_{none,bf16,int8} and their tokens/sec
+    twins) gate exactly like the dp arms — higher-is-better throughput,
+    flagged on drops only — and the bus-wire payload's resolved
+    ``iouring`` mode string rides along ungated (non-numeric)."""
+    prev = {"extra": {"transformer_mfu_fsdp_comp_int8": 64.0,
+                      "transformer_mfu_fsdp_comp_bf16": 62.0,
+                      "transformer_mfu_fsdp_comp_none": 57.0,
+                      "transformer_fsdp_comp_int8_tokens_per_sec_per_chip":
+                          2.0e4,
+                      "host_allreduce_busbw_sendv_gbps_np4": {
+                          "iouring": "syscall"}}}
+    cur = {"extra": {"transformer_mfu_fsdp_comp_int8": 40.0,  # drop: flags
+                     "transformer_mfu_fsdp_comp_bf16": 68.0,  # gain: silent
+                     "transformer_mfu_fsdp_comp_none": 56.0,  # noise: silent
+                     "transformer_fsdp_comp_int8_tokens_per_sec_per_chip":
+                         1.2e4,
+                     "host_allreduce_busbw_sendv_gbps_np4": {
+                         "iouring": "batched"}}}
+    regs = bench.find_regressions(prev, cur)
+    assert set(regs) == {
+        "extra.transformer_mfu_fsdp_comp_int8",
+        "extra.transformer_fsdp_comp_int8_tokens_per_sec_per_chip"}
+    assert regs["extra.transformer_mfu_fsdp_comp_int8"]["drop_pct"] > 35
+
+
 def test_find_regressions_router_key_directions():
     """ISSUE 8 `serve_router_*` keys: hit rates and throughput gate
     higher-is-better, `*_ms` latency keys gate on RISE, and the fleet

@@ -166,6 +166,29 @@ def test_injected_undocumented_knob_fires(tree):
     assert run_all(tree, only={"knob-docs"}) == []
 
 
+def test_rider_knobs_covered_by_knob_rule(tree):
+    """ISSUE 14 satellite: the env-var rule really covers the two
+    transport-rider knobs — spelled the way the native sources spell
+    them (EnvChoiceSane call sites), undocumented they fire one finding
+    each, and the real repo's tuning.md rows clear them (the live-tree
+    guarantee is test_real_tree_is_clean)."""
+    _write(tree, "native/src/tcp.cc",
+           'int m = EnvChoiceSane("HOROVOD_TCP_IOURING", 0, kC, 2);\n')
+    _write(tree, "native/src/thread_pool.cc",
+           'int a = EnvChoiceSane('
+           '"HOROVOD_REDUCE_THREAD_AFFINITY", 0, kC, 2);\n')
+    fs = run_all(tree, only={"knob-docs"})
+    hit = {k for f in fs for k in
+           ("HOROVOD_TCP_IOURING", "HOROVOD_REDUCE_THREAD_AFFINITY")
+           if k in f.message}
+    assert hit == {"HOROVOD_TCP_IOURING",
+                   "HOROVOD_REDUCE_THREAD_AFFINITY"}, fs
+    _write(tree, "docs/tuning.md",
+           "`HOROVOD_TCP_IOURING` batches; "
+           "`HOROVOD_REDUCE_THREAD_AFFINITY` pins.\n")
+    assert run_all(tree, only={"knob-docs"}) == []
+
+
 def test_injected_desynced_metric_name_fires(tree):
     # One enum entry added without a name-table entry.
     _write(tree, "native/include/hvd/metrics.h", """\

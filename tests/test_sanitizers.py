@@ -229,7 +229,16 @@ def test_scenario_clean_under_sanitizer(san, scenario, np_, extra, tmp_path):
                        "HOROVOD_COLLECTIVE_STRIPES": "3",
                        "HOROVOD_COLLECTIVE_GRANULARITY": "2",
                        "HOROVOD_HD_ORDER": "1"}),
-], ids=["topo_probe", "synth_live"])
+    # The ISSUE 14 affinity rider: the fused segment pipeline with the
+    # WorkerPool's 4 reducer threads AFFINITY-PINNED (forced explicitly
+    # so a future default flip cannot silently drop the coverage) — the
+    # pin runs at worker spawn concurrently with the pool's lock-free
+    # part claiming and the pinned_ gauge read on the metrics path, the
+    # scheduling hazards this tier exists to prove clean.
+    ("fused_bitwise", 2, {"HOROVOD_SHM_SEGMENT_BYTES": "65536",
+                          "HOROVOD_REDUCE_THREADS": "4",
+                          "HOROVOD_REDUCE_THREAD_AFFINITY": "auto"}),
+], ids=["topo_probe", "synth_live", "affinity_fused"])
 def test_topology_planes_clean_under_tsan(scenario, np_, extra, tmp_path):
     outs = run_san_job("tsan", scenario, np_, extra, tmp_path)
     for r, out in enumerate(outs):

@@ -221,3 +221,55 @@ def test_forced_fallback_is_byte_identical():
     assert d_auto == d_off, (d_off, d_auto)
     # The typo'd knob warned (once, on the rank that resolved it).
     assert any("HOROVOD_TCP_ZEROCOPY" in out for out in auto), auto
+
+
+def test_iouring_mode_resolved_and_named():
+    lib = get_lib()
+    mode = lib.hvd_tcp_iouring_mode()
+    assert mode in (0, 1)
+    name = lib.hvd_tcp_iouring_mode_name().decode()
+    assert name == ("batched" if mode == 1 else "syscall")
+    # This container runs a 4.4 kernel: io_uring (5.1+, SENDMSG/RECVMSG
+    # opcodes 5.3+) must probe out end-to-end and batching must have
+    # fallen back to per-window syscalls. If this box ever upgrades,
+    # the assert documents the expectation to revisit.
+    assert name == "syscall"
+
+
+def _rider_lines(outs):
+    return [line for out in outs for line in out.splitlines()
+            if line.startswith("RIDERS ")]
+
+
+def test_transport_riders_byte_identical():
+    """HOROVOD_TCP_IOURING / HOROVOD_REDUCE_THREAD_AFFINITY off vs
+    auto: same ops, byte-identical digests across every TCP exchange
+    engine at np=2 — both riders may change syscalls and thread
+    placement, never bytes. The affinity rider genuinely engages under
+    auto (this box has 2 allowed CPUs, REDUCE_THREADS=4 spins the
+    pool), so the auto arm also pins the worker_affinity gauge live and
+    the off arm pins it zero; the io_uring probe resolves off on this
+    4.4 kernel either way (mode pinned by the RIDERS line). The auto
+    arm feeds HOROVOD_TCP_IOURING a TYPO so one job also pins the
+    sane-env garbage handling of the new knob."""
+    base = {"HOROVOD_SHM_DISABLE": "1", "HOROVOD_REDUCE_THREADS": "4"}
+    off = run_job("transport_digest", 2, timeout=150,
+                  extra_env={**base,
+                             "HOROVOD_TCP_IOURING": "off",
+                             "HOROVOD_REDUCE_THREAD_AFFINITY": "off"})
+    auto = run_job("transport_digest", 2, timeout=150,
+                   extra_env={**base,
+                              "HOROVOD_TCP_IOURING": "definitely",
+                              "HOROVOD_REDUCE_THREAD_AFFINITY": "auto"})
+    d_off, d_auto = _digest_lines(off), _digest_lines(auto)
+    assert d_off and len(d_off) == 2 and len(set(d_off)) == 1, d_off
+    assert d_auto == d_off, (d_off, d_auto)
+    r_off, r_auto = _rider_lines(off), _rider_lines(auto)
+    assert all(l.startswith("RIDERS iouring=0") for l in r_off + r_auto), (
+        r_off, r_auto)  # 4.4 kernel: probe must say no on both arms
+    assert all(l.endswith("affinity=0") for l in r_off), r_off
+    import os
+    if len(os.sched_getaffinity(0)) > 1:
+        assert all(not l.endswith("affinity=0") for l in r_auto), r_auto
+    # The typo'd knob warned (once, on the rank that resolved it).
+    assert any("HOROVOD_TCP_IOURING" in out for out in auto), auto
