@@ -68,6 +68,13 @@ BUS_WIRE_ROUNDS = 8
 BUS_ALGO_SIZES = ((64 * 1024, "64KB", 30), ((16 << 20), "16MB", 3))
 BUS_ALGO_ROUNDS = 6
 BUS_ALGO_ARMS = ("ring", "hd", "striped")
+# Small-op latency family (ISSUE 15): round-trip allreduce latency at
+# control-path-bound payloads, steady-lock on vs off. Arms are whole
+# JOBS (the knob is init-time), interleaved locked/off per round per
+# the ±30% protocol; each arm keeps its best (lowest-p50) round.
+BUS_LAT_SIZES = ((4, "4B"), (1024, "1KB"), (64 * 1024, "64KB"))
+BUS_LAT_ROUNDS = 3
+BUS_LAT_ITERS = 250
 
 
 def _bus_worker():
@@ -293,6 +300,51 @@ def _bus_algo_worker():
     hvd.shutdown()
 
 
+def _latency_worker():
+    """Per-rank body of the small-op latency case: each iteration is
+    one enqueue -> synchronize round trip, so the measured time is the
+    control path (negotiation or the steady lock's token round) plus a
+    tiny exchange. The launcher sets HOROVOD_STEADY_LOCK per arm; the
+    locked arm reports whether the lock actually engaged so a silently
+    negotiating "locked" arm can never masquerade as a win."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r, s = hvd.rank(), hvd.size()
+    results = {}
+    engaged = True
+    for n_bytes, label in BUS_LAT_SIZES:
+        x = np.ones(max(1, n_bytes // 4), np.float32)
+        name = f"lat.{label}"
+        # Warmup negotiates, populates the cache, and (locked arm)
+        # gives the detector its K+1 pure cycles. FIXED op count on
+        # every rank: engagement is op-count-deterministic for a
+        # synchronous single-tensor loop (the engage broadcast rides
+        # op K+2's cycle and is installed before op K+3 completes),
+        # while a rank-local engaged-poll would issue rank-divergent
+        # collective counts and wedge the job at the size switch.
+        for _ in range(12):
+            hvd.allreduce(x, op=hvd.Sum, name=name)
+        engaged = engaged and (os.environ.get("HOROVOD_STEADY_LOCK") == "off"
+                               or hvd.steady_lock_engaged())
+        lats = []
+        for _ in range(BUS_LAT_ITERS):
+            t0 = time.perf_counter()
+            hvd.allreduce(x, op=hvd.Sum, name=name)
+            lats.append((time.perf_counter() - t0) * 1e6)
+        lats.sort()
+        results[label] = {
+            "p50": round(lats[len(lats) // 2], 1),
+            "p99": round(lats[min(len(lats) - 1, int(len(lats) * 0.99))], 1),
+        }
+    if r == 0:
+        results["engaged"] = engaged
+        print("BUSLAT " + json.dumps(results), flush=True)
+    hvd.shutdown()
+
+
 def _bus_job(flag, tag, extra_env=None, timeout=120):
     """Launch one np=4 host-plane microbenchmark job (`bench.py
     <flag>`) and return rank 0's parsed "<tag> {json}" payload, or
@@ -366,6 +418,37 @@ def _bus_algo_bandwidth():
                     extra_env={"HOROVOD_SHM_DISABLE": "1",
                                "HOROVOD_TOPOLOGY_PROBE": "force"},
                     timeout=240)
+
+
+def _bus_latency():
+    """The np=4 small-op latency family: locked vs off arms as whole
+    jobs, interleaved per round, best (lowest-p50) round per arm.
+    Returns {"locked": {size: {p50, p99}}, "off": {...},
+    "engaged": bool} or None."""
+    arms = {"locked": {"HOROVOD_STEADY_LOCK": "auto"},
+            "off": {"HOROVOD_STEADY_LOCK": "off"}}
+    best = {}
+    engaged = None
+    for _ in range(BUS_LAT_ROUNDS):
+        for arm, env in arms.items():
+            out = _bus_job("--latency-worker", "BUSLAT", extra_env=env,
+                           timeout=90)
+            if out is None:
+                continue
+            if arm == "locked":
+                e = out.pop("engaged", None)
+                engaged = e if engaged is None else (engaged and e)
+            else:
+                out.pop("engaged", None)
+            cur = best.setdefault(arm, out)
+            if out is not cur:
+                for label, v in out.items():
+                    if v["p50"] < cur[label]["p50"]:
+                        cur[label] = v
+    if "locked" not in best or "off" not in best:
+        return None
+    best["engaged"] = bool(engaged)
+    return best
 
 
 def _transformer_worker():
@@ -645,7 +728,7 @@ def _previous_bench(bench_dir=None):
 # and a latency win as a drop. Counter-ish keys (step counts, eviction
 # totals, high-water gauges) have no better/worse direction at all and
 # are excluded from the gate.
-LOWER_IS_BETTER_SUFFIXES = ("_ms",)
+LOWER_IS_BETTER_SUFFIXES = ("_ms", "_us")
 # _us_p99 (coordinator-cycle tail) is a log2-bucket upper bound that
 # jumps in powers of two with scheduler noise; _fill_pct tracks the
 # autotuner's live fusion threshold. Neither has a stable enough
@@ -883,6 +966,31 @@ def main():
                 # UNGATED_SUFFIXES): tracked, but ±30% box swings make
                 # a 10% gate on a ~40 ms measurement pure weather.
                 extra["topology_probe_ms"] = probe_ms
+    # Small-op latency family (ISSUE 15): steady-lock bypass vs
+    # negotiated control path at 4B-64KB, arms interleaved as whole
+    # jobs. `*_us` leaves gate lower-is-better; the speedup ratio
+    # (off p50 / locked p50, smallest payload — where the control
+    # path dominates) gates like any throughput key.
+    if (extras_on and os.environ.get("BENCH_SKIP_BUS") != "1"
+            and budget - (time.perf_counter() - _T0) > 200):
+        lat = _bus_latency()
+        if lat is not None:
+            # Leaf suffixes carry the gate direction: p50 leaves end in
+            # `_us` (lower-is-better, gated), p99 leaves in `_us_p99`
+            # (UNGATED — this box's p99 swings 3-6x with scheduler
+            # noise; a 10% gate on it would flag pure weather).
+            for arm in ("locked", "off"):
+                for q in ("p50", "p99"):
+                    leaf = "_us" if q == "p50" else "_us_p99"
+                    extra[f"host_allreduce_latency_us_{q}_{arm}_np4"] = {
+                        f"{label}{leaf}": lat[arm][label][q]
+                        for _, label in BUS_LAT_SIZES}
+            extra["steady_lock_engaged"] = lat["engaged"]  # bool: ungated
+            small = BUS_LAT_SIZES[0][1]
+            if lat["locked"][small]["p50"] > 0:
+                extra["steady_lock_p50_speedup"] = round(
+                    lat["off"][small]["p50"] / lat["locked"][small]["p50"],
+                    2)
     remaining = budget - (time.perf_counter() - _T0)
     if extras_on and remaining > 30:
         tf = _transformer_extra(remaining)
@@ -919,6 +1027,8 @@ def main():
 if __name__ == "__main__":
     if "--bus-worker" in sys.argv:
         _bus_worker()
+    elif "--latency-worker" in sys.argv:
+        _latency_worker()
     elif "--bus-wire-worker" in sys.argv:
         _bus_wire_worker()
     elif "--bus-algo-worker" in sys.argv:

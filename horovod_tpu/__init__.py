@@ -61,6 +61,7 @@ from horovod_tpu.api import (  # noqa: F401
     collective_algo,
     topology,
     topology_probe,
+    steady_lock_engaged,
     allreduce,
     allreduce_async,
     grouped_allreduce,

@@ -28,7 +28,7 @@ __all__ = [
     "set_reduce_threads", "metrics", "metrics_prometheus",
     "metrics_aggregate", "metrics_reset", "stalled_tensors",
     "start_metrics_server", "collective_algo", "topology",
-    "topology_probe",
+    "topology_probe", "steady_lock_engaged",
 ]
 
 
@@ -131,6 +131,15 @@ def stalled_tensors():
     the queryable form of the StallInspector's log warning."""
     from horovod_tpu.metrics import stalled_tensors as _fn
     return _fn()
+
+
+def steady_lock_engaged() -> bool:
+    """True while this rank runs the steady-state schedule lock's
+    negotiation-bypass plane (``HOROVOD_STEADY_LOCK``, see
+    ``docs/perf_tuning.md``). Also visible as the ``ctrl_locked``
+    gauge in :func:`metrics`."""
+    from horovod_tpu.common.basics import get_lib
+    return bool(get_lib().hvd_steady_lock_engaged())
 
 
 def start_metrics_server(port: int = 0, addr: str = "0.0.0.0"):

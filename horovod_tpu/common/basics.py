@@ -38,15 +38,15 @@ EXEC_CALLBACK = 1
 # is enforced at library load below, and tests/test_wire_abi.py greps
 # the header so a native bump can't silently skew this shim even
 # before a rebuild happens.
-ABI_VERSION = 10
+ABI_VERSION = 11
 WIRE_VERSION_REQUEST_LIST = 3
-WIRE_VERSION_RESPONSE_LIST = 6
+WIRE_VERSION_RESPONSE_LIST = 7
 
 # Metrics snapshot layout version (native/include/hvd/metrics.h
 # kMetricsVersion): the packed int64 layout hvd_metrics_snapshot
 # writes. Checked at library load AND against the header by
 # tests/test_metrics_abi.py, the same two-sided pin as the ABI above.
-METRICS_VERSION = 5
+METRICS_VERSION = 6
 
 # Native WireCodec ids (native/include/hvd/codec.h); -1 = follow the
 # job-wide HOROVOD_WIRE_COMPRESSION default.
@@ -326,6 +326,23 @@ def _declare_abi(lib: ctypes.CDLL, path: str) -> ctypes.CDLL:
     lib.hvd_tcp_iouring_mode.restype = ctypes.c_int
     lib.hvd_tcp_iouring_mode_name.restype = ctypes.c_char_p
     lib.hvd_worker_affinity.restype = ctypes.c_int
+    # Steady-state schedule lock (ABI v11, docs/perf_tuning.md
+    # "Steady-state schedule lock"): the engaged flag plus the period-
+    # detector test hooks tests/test_steady_lock.py drives without
+    # spawning ranks.
+    lib.hvd_steady_lock_engaged.restype = ctypes.c_int
+    lib.hvd_lockdet_create.restype = ctypes.c_void_p
+    lib.hvd_lockdet_feed.restype = None
+    lib.hvd_lockdet_feed.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                     ctypes.c_char_p]
+    lib.hvd_lockdet_ready.restype = ctypes.c_int
+    lib.hvd_lockdet_ready.argtypes = [ctypes.c_void_p]
+    lib.hvd_lockdet_period.restype = ctypes.c_int
+    lib.hvd_lockdet_period.argtypes = [ctypes.c_void_p]
+    lib.hvd_lockdet_take.restype = ctypes.c_int
+    lib.hvd_lockdet_take.argtypes = [ctypes.c_void_p]
+    lib.hvd_lockdet_destroy.restype = None
+    lib.hvd_lockdet_destroy.argtypes = [ctypes.c_void_p]
     # Wire-codec kernels (perf_tuning.md HOROVOD_WIRE_COMPRESSION):
     # exercised directly by the codec round-trip/error-feedback tests.
     lib.hvd_wire_encoded_bytes.restype = ctypes.c_int64

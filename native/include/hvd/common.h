@@ -9,6 +9,7 @@
 // programs (the NCCL-ops analog, with XLA/ICI in place of NCCL/NVLink).
 #pragma once
 
+#include <chrono>
 #include <cstdint>
 #include <functional>
 #include <memory>
@@ -163,6 +164,10 @@ struct TensorTableEntry {
   // HOROVOD_COLLECTIVE_ALGO. Resolved into each Response like the
   // wire codec.
   int8_t collective_algo = 0;
+  // Stamped by TensorQueue::AddToTensorQueue; the steady-lock fire
+  // path derives its enqueue->fire latency histogram from it
+  // (lock_fire_us) without a second timestamp table.
+  std::chrono::steady_clock::time_point enqueue_time;
 };
 
 // Named timeline activities (reference common/common.h:33-64).

@@ -21,6 +21,8 @@
 //    Control and data planes use separate sockets per worker.
 #pragma once
 
+#include <atomic>
+#include <chrono>
 #include <cstdint>
 #include <map>
 #include <memory>
@@ -34,6 +36,7 @@
 #include "hvd/message.h"
 #include "hvd/response_cache.h"
 #include "hvd/stall_inspector.h"
+#include "hvd/steady_lock.h"
 #include "hvd/tcp.h"
 #include "hvd/tensor_queue.h"
 #include "hvd/thread_annotations.h"
@@ -88,6 +91,20 @@ class Controller {
   // Only valid before the background cycle starts — it rides the
   // quiet control links, like the param sync.
   virtual bool AgreeAll(bool mine) { return mine; }
+
+  // Negotiation in flight (this rank announced tensors that have not
+  // come back, or the coordinator's pending table is non-empty). The
+  // event-driven background loop re-enters the cycle immediately when
+  // this is true — the blocking control rendezvous IS the wait — and
+  // parks on the enqueue condition variable otherwise.
+  virtual bool HasUnresolvedWork() const { return false; }
+
+  // This rank has called join() and is riding out the peers' cycles.
+  // The event-driven loop's idle park must stay SHORT for a joined
+  // rank: the still-training peers' collectives are gated on its
+  // (empty) announce frames, and local enqueues — the normal wake
+  // signal — will never come.
+  virtual bool IsJoined() const { return false; }
 
  protected:
   // ----- shared coordinator logic (used by rank 0 and LocalController)
@@ -297,7 +314,76 @@ class Controller {
   // per-node shm arenas exist within it.
   bool hierarchical_fit() const { return hierarchical_fit_; }
 
+  // ---- steady-state schedule lock (hvd/steady_lock.h; glue in
+  // steady_lock.cc). Knob: HOROVOD_STEADY_LOCK, rank 0's parse synced
+  // to every rank (param field 15) — engagement must be job-unique or
+  // the token rounds deadlock like any split data-plane choice.
+  void SetSteadyLock(int knob) {
+    steady_lock_knob_ = knob == kSteadyLockOff ? kSteadyLockOff
+                                               : kSteadyLockAuto;
+  }
+  int steady_lock() const { return steady_lock_knob_; }
+  void SetSteadyLockTimeout(double secs) {
+    lock_partial_timeout_secs_ = secs > 0 ? secs : 2.0;
+  }
+  // Cross-thread readable (the ctrl_locked gauge / Python accessor).
+  bool lock_engaged() const {
+    return lock_engaged_.load(std::memory_order_relaxed);
+  }
+  // Coordinator/local detection hook: feed one completed cycle; when
+  // K periods repeat, attaches lock_engage + the ring (cache_bits
+  // stamped from this rank's lockstep cache) to `out`. `quiescent` =
+  // the pending table drained fully this cycle: a half-announced
+  // group/straggler defers ENGAGEMENT (the locked plane could never
+  // finish an in-flight negotiation) without resetting the window.
+  void LockObserveCycle(bool pure, bool quiescent, ResponseList* out);
+  // Install a broadcast ring and enter locked mode (all ranks).
+  void EngageLock(const std::vector<Response>& ring);
+  // One locked-phase iteration, driven by the background loop:
+  //   kFired    — *fire is the next locked response; execute it.
+  //   kWait     — nothing ready; park on the enqueue CV and retry.
+  //   kUnlocked — the lock ended (pending work requeued); resume
+  //               negotiated cycles. *fatal = the data links are no
+  //               longer trustworthy (stall-shutdown abort): the
+  //               caller must raise the process shutdown flag.
+  enum class LockStep { kFired, kWait, kUnlocked };
+  LockStep LockedPhaseStep(bool shutdown_requested, int forced_reason,
+                           const std::atomic<bool>* shutdown_flag,
+                           Response* fire, bool* fatal);
+
  protected:
+  // Token-consensus round for one locked slot over the data links:
+  // send my vote, collect every peer's. True iff ALL ranks voted FIRE
+  // (the slot executes); false ends the lock with *out_reason. Base =
+  // single process: my vote is the consensus.
+  virtual bool LockTokenRound(uint32_t slot, bool my_fire, int my_reason,
+                              const std::string& waitname,
+                              const std::atomic<bool>* shutdown_flag,
+                              int* out_reason, bool* fatal) {
+    (void)slot; (void)waitname; (void)shutdown_flag; (void)fatal;
+    if (!my_fire) *out_reason = my_reason;
+    return my_fire;
+  }
+  // Non-blocking peek: has a peer proposed unlock (UNLOCK token or a
+  // dead data link) while this rank sits idle mid-slot?
+  virtual bool LockPeerProposedUnlock() { return false; }
+  // Tear down the lock: requeue fed-but-unfired bits and raw pending
+  // requests so the resumed negotiation loses nothing.
+  void UnlockNow(int reason);
+
+  int steady_lock_knob_ = kSteadyLockAuto;
+  double lock_partial_timeout_secs_ = 2.0;
+  std::atomic<bool> lock_engaged_{false};
+  // Background-thread-only lock state.
+  LockDetector lock_detector_;
+  LockMatcher lock_matcher_;
+  // Requests drained while locked that are not matched ring bits (the
+  // mismatching request itself, JOINs, barriers) — requeued on unlock.
+  std::vector<Request> lock_raw_pending_;
+  std::chrono::steady_clock::time_point lock_slot_feed_time_;
+  bool lock_slot_timer_armed_ = false;
+
+
   int64_t staged_fusion_ = 0;
   double staged_cycle_ms_ = 0.0;
   int staged_hier_ = -1;
@@ -329,6 +415,17 @@ class TcpController : public Controller {
   ResponseList ComputeResponseList(bool shutdown_requested) override;
   TcpConn* DataConn(int peer_rank) override;
   bool AgreeAll(bool mine) override;
+  bool HasUnresolvedWork() const override {
+    return !announced_.empty() || !table_.empty();
+  }
+  bool IsJoined() const override { return i_am_joined_; }
+
+ protected:
+  bool LockTokenRound(uint32_t slot, bool my_fire, int my_reason,
+                      const std::string& waitname,
+                      const std::atomic<bool>* shutdown_flag,
+                      int* out_reason, bool* fatal) override;
+  bool LockPeerProposedUnlock() override;
 
  private:
   ResponseList CoordinatorCycle(RequestList my_list, bool shutdown);

@@ -21,6 +21,12 @@ namespace hvd {
 // here so a bump is one edit — and guarded by tests/test_wire_abi.py,
 // which asserts the Python side expects the same numbers (a native
 // bump can't silently skew the shim).
+// ResponseList v7: carries the steady-state lock engagement (the
+// lock_engage flag + the locked response ring, hvd/steady_lock.h) the
+// coordinator broadcasts when K consecutive pure-cache-hit cycles
+// repeat with a fixed period; ABI v11 adds the lock surface
+// (hvd_steady_lock_engaged, the hvd_lockdet_* detector test hooks)
+// and metrics v6 the ctrl_locked/ctrl_unlocks_*/cycles_idle series.
 // RequestList v3 / ResponseList v6: Request/Response carry
 // collective_algo (the TCP-plane allreduce algorithm — request wish /
 // coordinator-resolved verdict, hvd/schedule.h ids) and ResponseList
@@ -39,8 +45,8 @@ namespace hvd {
 // points (hvd/metrics.h; snapshot layout versioned by kMetricsVersion),
 // hvd_stalled_tensors, and hvd_start_timeline returning an error code.
 constexpr int kWireVersionRequestList = 3;
-constexpr int kWireVersionResponseList = 6;
-constexpr int kAbiVersion = 10;
+constexpr int kWireVersionResponseList = 7;
+constexpr int kAbiVersion = 11;
 
 enum class RequestType : uint8_t {
   ALLREDUCE = 0,
@@ -178,6 +184,14 @@ struct ResponseList {
   int8_t tuned_wire_codec = -1;       // -1 = no change, 0-3 = new codec
   int8_t tuned_collective_algo = -1;  // -1 = no change, 0 = back to the
                                       // table, 1+ = forced algorithm
+  // Steady-state lock engagement (hvd/steady_lock.h): when the
+  // coordinator's detector sees K consecutive pure-cache-hit cycles
+  // repeating a fixed period, this cycle's broadcast carries the
+  // locked response ring (fire order; each response's cache_bits
+  // filled from the lockstep response cache). Every rank switches to
+  // negotiation-free local matching AFTER executing this cycle.
+  int8_t lock_engage = 0;
+  std::vector<Response> lock_ring;
 
   void SerializeTo(std::string* out) const;
   static bool ParseFrom(const std::string& buf, ResponseList* out);

@@ -32,6 +32,11 @@ namespace hvd {
 // Snapshot layout version (bump on any enum/table/layout change) and
 // bucket count. Pinned by horovod_tpu/common/basics.py +
 // tests/test_metrics_abi.py.
+// v6: steady-state schedule lock (hvd/steady_lock.h) —
+// ctrl_locks_total / ctrl_bypassed_responses_total / per-reason
+// ctrl_unlocks_* counters, the cycles_idle_total event-driven-loop
+// counter, the ctrl_locked gauge and the lock_fire_us enqueue->fire
+// latency histogram for the negotiation-bypass path.
 // v5: transport riders — tcp_iouring_batches_total counter plus the
 // tcp_iouring_mode (resolved submission-batching verdict) and
 // worker_affinity (currently CPU-pinned WorkerPool threads) gauges.
@@ -44,7 +49,7 @@ namespace hvd {
 // tcp_zerocopy_mode gauge (resolved transport mode).
 // v2: per-algorithm TCP allreduce counters (tcp_algo_*_ops_total) and
 // the hd/striped schedule-interpreter phase histograms.
-constexpr int kMetricsVersion = 5;
+constexpr int kMetricsVersion = 6;
 constexpr int kMetricsHistBuckets = 28;  // le = 2^0 .. 2^26, then +Inf
 
 // Monotonic counters (suffix _total) and point-in-time gauges (filled
@@ -108,6 +113,20 @@ enum MetricCounter : int {
   kCtrPoolJobs,               // ParallelFor dispatches (parts > 1)
   // Stall inspector.
   kCtrStallEvents,            // warning-threshold stall detections
+  // Event-driven coordination loop: cycles that drained no local
+  // messages and fired nothing (rendezvous heartbeats) — counted here
+  // so they never pollute the cycle_us percentiles.
+  kCtrCyclesIdle,
+  // Steady-state schedule lock (hvd/steady_lock.h).
+  kCtrLocks,                  // LOCK engagements (ring installs)
+  kCtrBypassedResponses,      // responses fired without negotiation
+  kCtrUnlocks,                // deterministic unlocks, total ...
+  kCtrUnlocksMismatch,        // ... and by reason (LockUnlockReason
+  kCtrUnlocksJoin,            //     order): cache miss / unknown bit,
+  kCtrUnlocksShutdown,        //     JOIN mid-lock, local shutdown,
+  kCtrUnlocksPeer,            //     peer proposal / dead data link,
+  kCtrUnlocksTunables,        //     staged autotune tunables,
+  kCtrUnlocksPartial,         //     half-fed slot past the timeout
   // ---- gauges (point-in-time, filled by hvd_metrics_snapshot) ----
   kGaugePendingTensors,       // tensors currently in flight
   kGaugeStalledTensors,       // tensors past the stall warning age
@@ -119,6 +138,7 @@ enum MetricCounter : int {
   kGaugeTcpIouringMode,       // resolved submission batching (hvd/tcp.h:
                               // 0 = per-window syscalls, 1 = io_uring)
   kGaugeWorkerAffinity,       // WorkerPool threads currently CPU-pinned
+  kGaugeCtrlLocked,           // 1 while the steady-state lock is engaged
   kNumMetricCounters
 };
 
@@ -139,6 +159,7 @@ enum MetricHistogram : int {
   kHistTcpStripedUs,          // multi-ring striped schedule (interpreter)
   kHistTcpAlltoallUs,         // pairwise alltoall (span interpreter)
   kHistPoolParts,             // parts per ParallelFor dispatch
+  kHistLockFireUs,            // locked path: oldest enqueue -> fire
   kNumMetricHistograms
 };
 

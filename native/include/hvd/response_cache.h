@@ -48,6 +48,11 @@ class ResponseCache {
   // from a cache bit.
   bool GetRequestByBit(uint32_t bit, Request* out) const;
 
+  // Bit a cached name occupies (steady-lock ring construction: the
+  // coordinator stamps each ring response's cache_bits from its own —
+  // lockstep — cache before the engage broadcast).
+  bool LookupBitByName(const std::string& name, uint32_t* bit) const;
+
   void Erase(uint32_t bit);
   void Clear();
 

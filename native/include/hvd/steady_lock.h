@@ -1,0 +1,136 @@
+// Steady-state schedule lock: negotiation-free dispatch for the
+// repeating phase of training.
+//
+// Horovod's controller (arXiv:1802.05799) re-negotiates readiness every
+// cycle even when the job has settled into a loop that repeats the
+// exact same fused response sequence each step — and for small /
+// latency-bound tensors the control path dominates the wire
+// (arXiv:1810.11112). The lock closes that gap: once the coordinator
+// observes K consecutive cycles whose pure-cache-hit response lists
+// repeat with a fixed period, it broadcasts the locked response ring
+// and every rank switches to local matching — an enqueue stream that
+// keeps reproducing the ring fires each fused response directly on the
+// (already peer-synchronized) data plane, skipping the coordinator
+// round entirely. Any divergence (new/changed tensor, Join, shutdown,
+// staged autotune tunables, a dead peer) unlocks deterministically and
+// falls back to negotiated cycles.
+//
+// This header holds the two pure-logic pieces (unit-testable through
+// the hvd_lockdet_* ctypes hooks without spawning ranks):
+//  * LockDetector — the coordinator-side period detector over cycle
+//    response-list signatures.
+//  * LockMatcher — the per-rank locked engine matching the local
+//    enqueue stream (as response-cache bits) against the ring.
+// The transport glue (token consensus rounds over the data links,
+// unlock requeue) lives in Controller (steady_lock.cc).
+#pragma once
+
+#include <cstdint>
+#include <deque>
+#include <unordered_map>
+#include <vector>
+
+#include "hvd/message.h"
+
+namespace hvd {
+
+// Knob values (HOROVOD_STEADY_LOCK; coordinator-synced param field —
+// a per-rank divergence would split lock engagement and deadlock the
+// token rounds exactly like a split data-plane choice).
+constexpr int kSteadyLockAuto = 0;
+constexpr int kSteadyLockOff = 1;
+
+// K consecutive repeating periods engage the lock (the acceptance
+// contract: a steady loop locks within K+2 steps — K+1 cycles to
+// detect, one broadcast to engage).
+constexpr int kSteadyLockK = 3;
+// Longest repeating period (in non-empty cycles) the detector tracks.
+constexpr int kSteadyLockMaxPeriod = 8;
+
+// Why a lock ended (wire token byte + the ctrl_unlocks_* metrics; the
+// order is pinned by tests/test_steady_lock.py).
+enum LockUnlockReason : int {
+  kUnlockMismatch = 0,  // cache miss / unknown bit / barrier request
+  kUnlockJoin = 1,      // a rank enqueued JOIN mid-lock
+  kUnlockShutdown = 2,  // local shutdown requested mid-lock
+  kUnlockPeer = 3,      // a peer proposed unlock / data link died
+  kUnlockTunables = 4,  // rank 0 staged autotune tunables mid-lock
+  kUnlockPartial = 5,   // a slot stayed partially fed past the timeout
+  kNumUnlockReasons
+};
+
+// Coordinator-side period detection over completed negotiation cycles.
+// Pure cycles (every announcement a cache hit; no joins, errors,
+// shutdown, purge or staged tunables) append their response-list
+// signature; empty cycles are ignored (event-driven heartbeats must
+// not break a period); any impure cycle resets the window.
+class LockDetector {
+ public:
+  // Feed one completed cycle. `pure` per the contract above;
+  // `responses` = the cycle's fired responses.
+  void FeedCycle(bool pure, const std::vector<Response>& responses);
+  bool Ready() const { return ready_; }
+  int period() const { return period_; }
+  // The locked ring (the last detected period's responses, in fire
+  // order). Resets the detector — re-arming requires a fresh window
+  // after the next unlock.
+  std::vector<Response> TakeRing();
+  void Reset();
+
+  // One canonical signature per response list (wire serialization of
+  // the responses, FNV-1a folded) — shared with tests.
+  static uint64_t Signature(const std::vector<Response>& responses);
+
+ private:
+  struct CycleRec {
+    uint64_t sig = 0;
+    std::vector<Response> responses;
+  };
+  std::deque<CycleRec> hist_;
+  bool ready_ = false;
+  int period_ = 0;
+};
+
+// Per-rank locked engine: the ring plus the pool of locally-ready
+// cache bits. All methods run on the background thread.
+class LockMatcher {
+ public:
+  // Install the ring; every response must carry its cache_bits (the
+  // coordinator fills them before broadcast; caches are lockstep, so
+  // the bit ids are valid on every rank).
+  void SetRing(std::vector<Response> ring);
+  bool has_ring() const { return !ring_.empty(); }
+  size_t ring_size() const { return ring_.size(); }
+
+  // Feed one locally-announced cache-hit bit. False = the bit is not
+  // part of the ring (the steady pattern changed -> unlock).
+  bool FeedBit(uint32_t bit);
+
+  // True when every bit of the current slot's response is ready.
+  bool SlotReady() const;
+  // True when fed bits are waiting while the current slot cannot fire
+  // (a half-fed slot, or a later slot's bits with the current slot's
+  // op dropped from the program) — the partial-timeout unlock
+  // predicate. A clean between-steps pause keeps the pool empty, so
+  // it never arms this.
+  bool SlotPartial() const;
+  const Response& Slot() const { return ring_[pos_]; }
+  // Monotone fired count (the token-round slot id, mod 2^32).
+  uint32_t slot_index() const { return static_cast<uint32_t>(fired_); }
+  // Consume the current slot's bits and advance around the ring.
+  void AdvanceSlot();
+
+  // Bits fed but not yet consumed by a fired slot (requeued as full
+  // Requests on unlock so negotiation resumes without losing work).
+  std::vector<uint32_t> PendingBits() const;
+  void Clear();
+
+ private:
+  std::vector<Response> ring_;
+  std::unordered_map<uint32_t, int> ring_need_;  // bit -> slots using it
+  std::unordered_map<uint32_t, int> have_;       // bit -> fed, unconsumed
+  size_t pos_ = 0;
+  uint64_t fired_ = 0;
+};
+
+}  // namespace hvd

@@ -32,6 +32,10 @@ class TensorQueue {
   void FailAll(const Status& status) HVD_EXCLUDES(mu_);
 
   size_t size() const HVD_EXCLUDES(mu_);
+  // Undrained request messages pending for the next cycle — the
+  // event-driven background loop's wake predicate (distinct from
+  // size(), which also counts entries already negotiated/executing).
+  bool has_messages() const HVD_EXCLUDES(mu_);
   bool Lookup(const std::string& name, TensorTableEntry* out) const
       HVD_EXCLUDES(mu_);
 

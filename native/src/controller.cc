@@ -507,20 +507,31 @@ ResponseList LocalController::ComputeResponseList(bool shutdown_requested) {
   deps_.tensor_queue->PopMessagesFromQueue(&msgs);
   ResponseList out;
   std::vector<Response> pre;
+  // Steady purity: every announcement this cycle is a cache hit (the
+  // single-process analog of the TCP plane's pure-bitset cycles).
+  bool pure = !shutdown_requested;
   for (auto& req : msgs) {
     if (req.request_type == RequestType::JOIN) {
       Response r;
       r.response_type = ResponseType::JOIN;
       r.tensor_names = {req.tensor_name};
       pre.push_back(std::move(r));
+      pure = false;
       continue;
     }
+    uint32_t bit = 0;
+    if (req.request_type == RequestType::BARRIER || !cache_active_ ||
+        deps_.response_cache == nullptr ||
+        deps_.response_cache->Lookup(req, &bit) !=
+            ResponseCache::CacheState::HIT)
+      pure = false;
     req.request_rank = 0;
     AccumulateRequest(req, &table_);
   }
   out = CoordinatorStep(&table_, {0}, shutdown_requested);
   for (auto& r : pre) out.responses.push_back(std::move(r));
   UpdateCacheFromResponses(out);
+  LockObserveCycle(pure, table_.empty(), &out);
   return out;
 }
 
@@ -611,7 +622,8 @@ Status TcpController::Initialize() {
                          std::to_string(topo_mode_) + ":" +
                          std::to_string(collective_stripes_) + ":" +
                          std::to_string(collective_granularity_) + ":" +
-                         std::to_string(hd_order_);
+                         std::to_string(hd_order_) + ":" +
+                         std::to_string(steady_lock_knob_);
     for (int peer = 1; peer < size_; ++peer) {
       if (!ctrl_conns_[peer].SendFrame(params))
         return Status::UnknownError("param sync: lost control link");
@@ -652,7 +664,8 @@ Status TcpController::Initialize() {
     auto c12 = c11 == std::string::npos ? c11 : params.find(':', c11 + 1);
     auto c13 = c12 == std::string::npos ? c12 : params.find(':', c12 + 1);
     auto c14 = c13 == std::string::npos ? c13 : params.find(':', c13 + 1);
-    if (!ok || c14 == std::string::npos)
+    auto c15 = c14 == std::string::npos ? c14 : params.find(':', c14 + 1);
+    if (!ok || c15 == std::string::npos)
       return Status::UnknownError("param sync: lost control link");
     fusion_threshold_bytes_ = std::atoll(params.c_str());
     ring_threshold_bytes_ = std::atoll(params.c_str() + c1 + 1);
@@ -669,6 +682,10 @@ Status TcpController::Initialize() {
     SetCollectiveStripes(std::atoi(params.c_str() + c12 + 1));
     SetCollectiveGranularity(std::atoi(params.c_str() + c13 + 1));
     SetHdOrder(std::atoi(params.c_str() + c14 + 1));
+    // Field 15: rank 0's HOROVOD_STEADY_LOCK verdict — engagement is
+    // broadcast, so every rank must agree the feature is live or the
+    // token rounds would split like any desynced data-plane choice.
+    SetSteadyLock(std::atoi(params.c_str() + c15 + 1));
     if (topo_mode_ == 2) {
       // Rank 0's cached model rides the quiet data link as one frame.
       std::string blob;
@@ -895,6 +912,17 @@ ResponseList TcpController::ComputeResponseList(bool shutdown_requested) {
   if (size_ == 1) {
     // Degenerate distributed mode: behave like LocalController.
     ResponseList out;
+    // Cache hits already split out by BuildRequestList: leftover raw
+    // requests (or a join/shutdown) make the cycle impure.
+    bool pure = my_list.requests.empty() && !saw_join && !my_list.shutdown;
+    for (uint32_t bit : my_list.cache_hits) {
+      Request req;
+      if (deps_.response_cache &&
+          deps_.response_cache->GetRequestByBit(bit, &req)) {
+        req.request_rank = 0;
+        AccumulateRequest(req, &table_);
+      }
+    }
     for (auto& req : my_list.requests) AccumulateRequest(req, &table_);
     std::vector<int> active = {0};
     out = CoordinatorStep(&table_, active, my_list.shutdown);
@@ -906,6 +934,7 @@ ResponseList TcpController::ComputeResponseList(bool shutdown_requested) {
       i_am_joined_ = false;
     }
     UpdateCacheFromResponses(out);
+    LockObserveCycle(pure, table_.empty(), &out);
     return out;
   }
   return rank_ == 0 ? CoordinatorCycle(std::move(my_list), shutdown_requested)
@@ -961,6 +990,12 @@ ResponseList TcpController::CoordinatorCycle(RequestList my_list,
     return out;
   }
 
+  // Steady purity for the lock detector: every rank announced only
+  // cache bits, nobody joined (now or earlier), nothing shut down.
+  bool pure = !any_shutdown;
+  for (int r = 0; r < size_; ++r)
+    pure = pure && lists[r].requests.empty() && lists[r].joined == 0;
+
   for (int r = 0; r < size_; ++r) {
     if (lists[r].joined) joined_ranks_[r] = true;
     for (auto& req : lists[r].requests) AccumulateRequest(req, &table_);
@@ -998,6 +1033,8 @@ ResponseList TcpController::CoordinatorCycle(RequestList my_list,
   } else {
     out = CoordinatorStep(&table_, active, any_shutdown);
   }
+  pure = pure && static_cast<int>(active.size()) == size_;
+  LockObserveCycle(pure, table_.empty(), &out);
   Broadcast(out);
   UpdateCacheFromResponses(out);
   return out;

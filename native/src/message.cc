@@ -227,6 +227,9 @@ void ResponseList::SerializeTo(std::string* out) const {
   WriteScalar<int32_t>(out, tuned_seg_depth);
   WriteScalar<int8_t>(out, tuned_wire_codec);
   WriteScalar<int8_t>(out, tuned_collective_algo);
+  WriteScalar<int8_t>(out, lock_engage);
+  WriteScalar<uint32_t>(out, static_cast<uint32_t>(lock_ring.size()));
+  for (const auto& r : lock_ring) r.SerializeTo(out);
   WriteScalar<uint32_t>(out, static_cast<uint32_t>(responses.size()));
   for (const auto& r : responses) r.SerializeTo(out);
 }
@@ -250,6 +253,12 @@ bool ResponseList::ParseFrom(const std::string& buf, ResponseList* out) {
   if (!ReadScalar(&p, end, &out->tuned_seg_depth)) return false;
   if (!ReadScalar(&p, end, &out->tuned_wire_codec)) return false;
   if (!ReadScalar(&p, end, &out->tuned_collective_algo)) return false;
+  if (!ReadScalar(&p, end, &out->lock_engage)) return false;
+  uint32_t nring;
+  if (!ReadScalar(&p, end, &nring)) return false;
+  out->lock_ring.resize(nring);
+  for (uint32_t i = 0; i < nring; ++i)
+    if (!Response::ParseFrom(&p, end, &out->lock_ring[i])) return false;
   uint32_t n;
   if (!ReadScalar(&p, end, &n)) return false;
   out->responses.resize(n);

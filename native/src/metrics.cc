@@ -49,6 +49,16 @@ constexpr const char* kCounterNames[] = {
     "topology_probes_total",
     "pool_jobs_total",
     "stall_events_total",
+    "cycles_idle_total",
+    "ctrl_locks_total",
+    "ctrl_bypassed_responses_total",
+    "ctrl_unlocks_total",
+    "ctrl_unlocks_mismatch_total",
+    "ctrl_unlocks_join_total",
+    "ctrl_unlocks_shutdown_total",
+    "ctrl_unlocks_peer_total",
+    "ctrl_unlocks_tunables_total",
+    "ctrl_unlocks_partial_total",
     "pending_tensors",
     "stalled_tensors",
     "reduce_threads",
@@ -57,15 +67,19 @@ constexpr const char* kCounterNames[] = {
     "topology_links_measured",
     "tcp_iouring_mode",
     "worker_affinity",
+    "ctrl_locked",
 };
 
 constexpr int kCounterKinds[] = {
     0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
     0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
     0, 0,        // measured selects, topology probes
+    0, 0, 0,     // idle cycles, lock engagements, bypassed responses
+    0, 0, 0, 0, 0, 0, 0,  // unlocks: total + six reasons
     1, 1, 1, 1,  // pending/stalled tensors, reduce_threads, zc mode
     1, 1,        // topology probe ms / links measured
     1, 1,        // iouring mode / worker affinity
+    1,           // steady-lock engaged gauge
 };
 
 constexpr const char* kHistNames[] = {
@@ -85,6 +99,7 @@ constexpr const char* kHistNames[] = {
     "tcp_striped_us",
     "tcp_alltoall_us",
     "pool_parts",
+    "lock_fire_us",
 };
 
 static_assert(sizeof(kCounterNames) / sizeof(kCounterNames[0]) ==

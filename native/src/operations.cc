@@ -38,6 +38,7 @@
 #include "hvd/parameter_manager.h"
 #include "hvd/response_cache.h"
 #include "hvd/stall_inspector.h"
+#include "hvd/steady_lock.h"
 #include "hvd/tensor_queue.h"
 #include "hvd/thread_pool.h"
 #include "hvd/timeline.h"
@@ -45,6 +46,25 @@
 
 namespace hvd {
 namespace {
+
+// Bounded CV wait via a system_clock wait_until: libstdc++ 10's
+// wait_for lowers to pthread_cond_clockwait (glibc >= 2.30), which
+// this container's gcc-10 libtsan does NOT intercept — tsan then
+// misses the unlock inside the wait and reports a bogus "double lock"
+// on every subsequent acquire (verified with a 15-line repro). The
+// system_clock path lowers to the intercepted pthread_cond_timedwait.
+// All callers are heartbeat-style waits with predicates, so a wall
+// clock jump at worst delays one tick.
+template <typename Rep, typename Period, typename Pred>
+bool CvWaitFor(std::condition_variable& cv,
+               std::unique_lock<std::mutex>& lk,
+               std::chrono::duration<Rep, Period> dur, Pred pred) {
+  return cv.wait_until(
+      lk,
+      std::chrono::system_clock::now() +
+          std::chrono::duration_cast<std::chrono::microseconds>(dur),
+      pred);
+}
 
 // ---- handle manager (reference horovod/torch/handle_manager.h:31-40)
 class HandleManager {
@@ -80,9 +100,22 @@ class HandleManager {
     };
     if (timeout_ms < 0) {
       cv_.wait(lock, pred);
-    } else if (!cv_.wait_for(lock, std::chrono::milliseconds(timeout_ms),
-                             pred)) {
-      return false;
+    } else {
+      // The user-supplied deadline runs on the STEADY clock (a wall
+      // step must not shrink or stretch a synchronize() timeout);
+      // each bounded chunk rides CvWaitFor's tsan-safe wait, so a
+      // step costs at most one 100ms chunk of extra wait.
+      const auto deadline = std::chrono::steady_clock::now() +
+                            std::chrono::milliseconds(timeout_ms);
+      while (!pred()) {
+        const auto left = deadline - std::chrono::steady_clock::now();
+        if (left <= std::chrono::steady_clock::duration::zero())
+          return false;
+        CvWaitFor(cv_, lock,
+                  std::min<std::chrono::steady_clock::duration>(
+                      left, std::chrono::milliseconds(100)),
+                  pred);
+      }
     }
     auto it = results_.find(h);
     *out = it == results_.end() ? Status::OK() : it->second.status;
@@ -155,6 +188,14 @@ struct GlobalState {
   double cycle_time_ms = 1.0;
   ExecCallback exec_cb = nullptr;
   AllocCallback alloc_cb = nullptr;
+
+  // Event-driven coordination: enqueues (and shutdown) signal the
+  // background loop instead of it sleeping a fixed cadence. Plain
+  // std::mutex (not the annotated wrapper): it exists only to pair
+  // with the condition variable — the guarded predicate state lives
+  // behind the tensor queue's own lock.
+  std::mutex wake_mu;
+  std::condition_variable wake_cv;
 
   // Python executor handoff: the coordinator publishes a pending exec,
   // arbitrary Python threads complete it via hvd_exec_done.
@@ -347,9 +388,138 @@ void PerformOperation(GlobalState& st, const Response& response) {
   for (auto& e : entries) CompleteEntry(st, e, status);
 }
 
+// Rank-0 autotune bookkeeping, shared by the negotiated cycle and the
+// locked phase: record the window's reduction traffic and, on a
+// parameter move, apply rank 0's new values and stage the broadcast
+// (reference parameter-manager hook, operations.cc:635-642). Returns
+// true when tunables were staged this call — the locked phase turns
+// that into a deterministic unlock so the stage can ride the next
+// negotiated broadcast.
+bool MaybeAutotuneRank0(GlobalState& st, int64_t bytes, double now_secs) {
+  if (st.rank != 0 || !st.param_manager.enabled()) return false;
+  st.param_manager.Record(bytes);  // allreduce traffic (others size 0)
+  if (!st.param_manager.Update(now_secs)) return false;
+  using PM = hvd::ParameterManager;
+  auto cat = [&](PM::Categorical c) {
+    return st.param_manager.categorical_tunable(c)
+               ? (st.param_manager.categorical(c) ? 1 : 0)
+               : -1;
+  };
+  st.controller->SetFusionThreshold(st.param_manager.fusion_threshold());
+  st.cycle_time_ms = st.param_manager.cycle_time_ms();
+  st.controller->SetHierarchical(st.param_manager.hierarchical_tunable()
+                                     ? st.param_manager.hierarchical()
+                                     : st.controller->hierarchical());
+  if (st.param_manager.categorical_tunable(PM::kCatCache))
+    st.controller->SetCacheActive(st.param_manager.categorical(PM::kCatCache));
+  if (st.param_manager.categorical_tunable(PM::kCatShm))
+    st.controller->SetShmActive(st.param_manager.categorical(PM::kCatShm));
+  // Stage host knobs only when the search owns them: an untuned knob
+  // staged every window would clobber runtime overrides
+  // (hvd.set_reduce_threads) with the stale init-time value.
+  int tuned_threads = 0, tuned_depth = 0, tuned_wire = -1;
+  int tuned_algo = -1;
+  if (st.param_manager.threads_tunable()) {
+    st.controller->SetReduceThreads(st.param_manager.reduce_threads());
+    SetHostReduceThreads(st.controller->reduce_threads());
+    tuned_threads = st.controller->reduce_threads();
+  }
+  if (st.param_manager.depth_tunable()) {
+    st.controller->SetShmSegmentDepth(st.param_manager.seg_depth());
+    tuned_depth = st.controller->shm_segment_depth();
+  }
+  if (st.param_manager.wire_tunable()) {
+    st.controller->SetWireCodec(st.param_manager.wire_codec());
+    tuned_wire = st.controller->wire_codec();
+  }
+  if (st.param_manager.algo_tunable()) {
+    st.controller->SetCollectiveAlgo(st.param_manager.collective_algo());
+    tuned_algo = st.controller->collective_algo();
+  }
+  st.controller->StageTunedParams(
+      st.param_manager.fusion_threshold(), st.param_manager.cycle_time_ms(),
+      cat(PM::kCatHier), cat(PM::kCatCache), cat(PM::kCatShm), tuned_threads,
+      tuned_depth, tuned_wire, tuned_algo);
+  return true;
+}
+
+// Idle heartbeat: an idle rank still enters a (cheap, empty) cycle at
+// this cadence so coordinator stall checks and broadcast shutdown
+// verdicts stay live — 10 wakeups/s instead of the old 1000.
+constexpr int kIdleHeartbeatMs = 100;
+// A JOINED rank idles differently: the peers' every collective is
+// gated on its empty announce frames and no local enqueue will ever
+// wake it, so it keeps near the old cycle cadence instead.
+constexpr int kJoinedHeartbeatMs = 2;
+// Locked-phase wait tick: bounds how long a peer's UNLOCK proposal or
+// the partial-slot timeout can sit unnoticed while this rank idles.
+constexpr int kLockWaitTickMs = 50;
+
+// One locked-phase iteration. Returns false when the lock ended (the
+// caller falls back to negotiated cycles).
+bool RunLockedIteration(GlobalState& st,
+                        std::chrono::steady_clock::time_point loop_epoch) {
+  int forced = -1;
+  if (st.rank == 0 && st.param_manager.enabled()) {
+    const double now = std::chrono::duration<double>(
+                           std::chrono::steady_clock::now() - loop_epoch)
+                           .count();
+    if (MaybeAutotuneRank0(st, 0, now)) forced = hvd::kUnlockTunables;
+  }
+  Response fire;
+  bool fatal = false;
+  const auto step = st.controller->LockedPhaseStep(
+      st.shutdown_requested.load(), forced, &st.shutdown_requested, &fire,
+      &fatal);
+  using LS = hvd::Controller::LockStep;
+  if (step == LS::kFired) {
+    if (MetricsRegistry::Get().enabled()) {
+      // Bypass-path latency: oldest member enqueue -> fire (the
+      // negotiation+cycle budget this path exists to delete).
+      const auto now = std::chrono::steady_clock::now();
+      int64_t worst = -1;
+      for (const auto& name : fire.tensor_names) {
+        TensorTableEntry e;
+        if (st.tensor_queue.Lookup(name, &e))
+          worst = std::max<int64_t>(
+              worst, std::chrono::duration_cast<std::chrono::microseconds>(
+                         now - e.enqueue_time)
+                         .count());
+      }
+      if (worst >= 0) MetricObserve(kHistLockFireUs, worst);
+    }
+    MetricAdd(kCtrBypassedResponses);
+    PerformOperation(st, fire);
+    if (st.rank == 0 && st.param_manager.enabled())
+      st.param_manager.Record(fire.TotalByteSize());
+    return true;
+  }
+  if (step == LS::kWait) {
+    std::unique_lock<std::mutex> lk(st.wake_mu);
+    CvWaitFor(st.wake_cv, lk, std::chrono::milliseconds(kLockWaitTickMs),
+              [&] {
+                return st.tensor_queue.has_messages() ||
+                       st.shutdown_requested.load();
+              });
+    return true;
+  }
+  // kUnlocked: pending work was requeued; negotiated cycles resume. A
+  // fatal unlock (stall-shutdown abort tore the links down) raises the
+  // process shutdown flag so the next cycle ends the job.
+  if (fatal) st.shutdown_requested.store(true);
+  return false;
+}
+
 void BackgroundThreadLoop(GlobalState& st) {
   const auto loop_epoch = std::chrono::steady_clock::now();
   while (true) {
+    if (st.controller->lock_engaged()) {
+      RunLockedIteration(st, loop_epoch);
+      continue;
+    }
+    // Messages pending BEFORE the cycle: a cycle that drained none and
+    // fired nothing is an idle heartbeat, not coordination work.
+    const bool had_msgs = st.tensor_queue.has_messages();
     auto cycle_start = std::chrono::steady_clock::now();
     st.timeline.MarkCycleStart();
     ResponseList list =
@@ -390,70 +560,38 @@ void BackgroundThreadLoop(GlobalState& st) {
     }
     for (const auto& resp : list.responses) PerformOperation(st, resp);
     if (list.shutdown) break;
-    // Autotune: rank 0 scores the window by reduction traffic and, on
-    // a parameter move, stages the new values onto the next broadcast
-    // (reference parameter-manager hook, operations.cc:635-642).
-    if (st.rank == 0 && st.param_manager.enabled()) {
+    // Steady-state lock engagement rides the broadcast list; switch
+    // AFTER executing this cycle's responses so every rank enters the
+    // locked phase at the same ring position.
+    if (list.lock_engage && !list.lock_ring.empty()) {
+      st.controller->EngageLock(list.lock_ring);
+      continue;
+    }
+    // HOROVOD_STEADY_LOCK=off reverts the WHOLE feature to the PR 14
+    // loop — fixed sleep-to-budget, every cycle counted in cycle_us —
+    // so `off` is behaviorally byte-identical to the pre-lock runtime
+    // (and the bench's off arm measures the real baseline).
+    const bool event_driven =
+        st.controller->steady_lock() != hvd::kSteadyLockOff;
+    const bool empty_cycle =
+        event_driven && !had_msgs && list.responses.empty();
+    if (!empty_cycle) {
       int64_t bytes = 0;
       for (const auto& r : list.responses) bytes += r.TotalByteSize();
-      st.param_manager.Record(bytes);  // allreduce traffic (others size 0)
-      double now = std::chrono::duration<double>(
-                       std::chrono::steady_clock::now() - loop_epoch)
-                       .count();
-      if (st.param_manager.Update(now)) {
-        using PM = hvd::ParameterManager;
-        auto cat = [&](PM::Categorical c) {
-          return st.param_manager.categorical_tunable(c)
-                     ? (st.param_manager.categorical(c) ? 1 : 0)
-                     : -1;
-        };
-        st.controller->SetFusionThreshold(st.param_manager.fusion_threshold());
-        st.cycle_time_ms = st.param_manager.cycle_time_ms();
-        st.controller->SetHierarchical(st.param_manager.hierarchical_tunable()
-                                           ? st.param_manager.hierarchical()
-                                           : st.controller->hierarchical());
-        if (st.param_manager.categorical_tunable(PM::kCatCache))
-          st.controller->SetCacheActive(
-              st.param_manager.categorical(PM::kCatCache));
-        if (st.param_manager.categorical_tunable(PM::kCatShm))
-          st.controller->SetShmActive(
-              st.param_manager.categorical(PM::kCatShm));
-        // Stage host knobs only when the search owns them: an untuned
-        // knob staged every window would clobber runtime overrides
-        // (hvd.set_reduce_threads) with the stale init-time value.
-        int tuned_threads = 0, tuned_depth = 0, tuned_wire = -1;
-        int tuned_algo = -1;
-        if (st.param_manager.threads_tunable()) {
-          st.controller->SetReduceThreads(
-              st.param_manager.reduce_threads());
-          SetHostReduceThreads(st.controller->reduce_threads());
-          tuned_threads = st.controller->reduce_threads();
-        }
-        if (st.param_manager.depth_tunable()) {
-          st.controller->SetShmSegmentDepth(st.param_manager.seg_depth());
-          tuned_depth = st.controller->shm_segment_depth();
-        }
-        if (st.param_manager.wire_tunable()) {
-          st.controller->SetWireCodec(st.param_manager.wire_codec());
-          tuned_wire = st.controller->wire_codec();
-        }
-        if (st.param_manager.algo_tunable()) {
-          st.controller->SetCollectiveAlgo(
-              st.param_manager.collective_algo());
-          tuned_algo = st.controller->collective_algo();
-        }
-        st.controller->StageTunedParams(
-            st.param_manager.fusion_threshold(),
-            st.param_manager.cycle_time_ms(), cat(PM::kCatHier),
-            cat(PM::kCatCache), cat(PM::kCatShm), tuned_threads,
-            tuned_depth, tuned_wire, tuned_algo);
-      }
+      MaybeAutotuneRank0(st, bytes,
+                         std::chrono::duration<double>(
+                             std::chrono::steady_clock::now() - loop_epoch)
+                             .count());
     }
     auto elapsed = std::chrono::steady_clock::now() - cycle_start;
     // Coordinator-cycle telemetry: wall time of negotiate + execute
-    // (the sleep to the cycle budget is idle time, not cycle cost) and
-    // the in-flight depth this cycle left behind.
-    if (MetricsRegistry::Get().enabled()) {
+    // (waits are idle time, not cycle cost) and the in-flight depth
+    // this cycle left behind. Idle heartbeats skip the clock-derived
+    // observes entirely — with event-driven wakeups they are waits,
+    // and folding them in would poison the cycle_us percentiles.
+    if (empty_cycle) {
+      MetricAdd(kCtrCyclesIdle);
+    } else if (MetricsRegistry::Get().enabled()) {
       MetricAdd(kCtrCycles);
       const int64_t cyc_us =
           std::chrono::duration_cast<std::chrono::microseconds>(elapsed)
@@ -478,9 +616,42 @@ void BackgroundThreadLoop(GlobalState& st) {
         st.timeline.Counter("busbw_gbps", busbw);
       }
     }
-    auto budget = std::chrono::duration<double, std::milli>(st.cycle_time_ms);
-    if (elapsed < budget)
-      std::this_thread::sleep_for(budget - elapsed);
+    // Event-driven wait (replaces the fixed sleep-to-budget):
+    //  * fresh messages already queued -> hold the batching window out
+    //    to the cycle budget (fusion and the autotuner's cycle-time
+    //    dimension keep their semantics), then cycle again;
+    //  * negotiation in flight -> re-enter immediately (the blocking
+    //    control rendezvous IS the wait), pacing consecutive empty
+    //    cycles at the budget so straggler churn stays bounded;
+    //  * idle -> park until an enqueue arrives (heartbeat-capped so
+    //    stall checks and shutdown verdicts stay live). An op enqueued
+    //    after an idle gap starts its cycle immediately instead of
+    //    paying up to a full HOROVOD_CYCLE_TIME of residual sleep.
+    const auto budget =
+        std::chrono::duration<double, std::milli>(st.cycle_time_ms);
+    elapsed = std::chrono::steady_clock::now() - cycle_start;
+    if (!event_driven) {
+      if (elapsed < budget) std::this_thread::sleep_for(budget - elapsed);
+      continue;
+    }
+    std::unique_lock<std::mutex> lk(st.wake_mu);
+    auto woken = [&] {
+      return st.tensor_queue.has_messages() || st.shutdown_requested.load();
+    };
+    if (woken()) {
+      if (elapsed < budget)
+        CvWaitFor(st.wake_cv, lk, budget - elapsed,
+                  [&] { return st.shutdown_requested.load(); });
+    } else if (st.controller->HasUnresolvedWork()) {
+      if (empty_cycle && elapsed < budget)
+        CvWaitFor(st.wake_cv, lk, budget - elapsed, woken);
+    } else {
+      CvWaitFor(st.wake_cv, lk,
+                std::chrono::milliseconds(st.controller->IsJoined()
+                                              ? kJoinedHeartbeatMs
+                                              : kIdleHeartbeatMs),
+                woken);
+    }
   }
   st.tensor_queue.FailAll(Status::Aborted("Horovod has been shut down"));
   st.timeline.Shutdown();
@@ -513,8 +684,15 @@ Status EnqueueEntries(std::vector<TensorTableEntry> entries,
     req.collective_algo = e.collective_algo;
     requests.push_back(std::move(req));
   }
-  return st.tensor_queue.AddToTensorQueue(std::move(entries),
-                                          std::move(requests));
+  Status s = st.tensor_queue.AddToTensorQueue(std::move(entries),
+                                              std::move(requests));
+  if (s.ok()) {
+    // Wake the event-driven background loop: an op arriving after an
+    // idle gap starts negotiating (or lock-matching) immediately.
+    std::lock_guard<std::mutex> g(st.wake_mu);
+    st.wake_cv.notify_all();
+  }
+  return s;
 }
 
 }  // namespace
@@ -651,6 +829,20 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
       != 0);
   st.controller->SetShmEnabled(
       size > 1 && !hvd::EnvFlag("HOROVOD_SHM_DISABLE"));
+  // Steady-state schedule lock (hvd/steady_lock.h): a choice knob —
+  // garbage must not silently disable (or enable) the bypass plane.
+  // Rank 0's parse is synced in Initialize (param field 15): the LOCK
+  // broadcast and its token rounds must be job-unique.
+  {
+    static const char* const kSteadyLockChoices[] = {"auto", "off"};
+    st.controller->SetSteadyLock(
+        hvd::EnvChoiceSane("HOROVOD_STEADY_LOCK", 0, kSteadyLockChoices, 2));
+    // Partial-slot unlock deadline: how long a half-fed locked slot may
+    // wait for its remaining members before the lock concedes the op
+    // set changed and renegotiates. 0/garbage fall back to the default.
+    st.controller->SetSteadyLockTimeout(hvd::EnvDoubleSane(
+        "HOROVOD_STEADY_LOCK_TIMEOUT_SECONDS", 2.0));
+  }
   hvd::Status s = st.controller->Initialize();
   // The pool's budget follows the controller's POST-SYNC value: rank
   // 0's knob (env or default) reaches every rank through the param
@@ -738,10 +930,21 @@ void hvd_shutdown() {
   auto& st = hvd::State();
   if (!st.initialized.load()) return;
   st.shutdown_requested.store(true);
+  {
+    // The background loop may be parked on the enqueue CV (idle or
+    // locked-wait); wake it so the shutdown cycle runs promptly.
+    std::lock_guard<std::mutex> g(st.wake_mu);
+    st.wake_cv.notify_all();
+  }
   if (st.background_thread.joinable()) st.background_thread.join();
   st.initialized.store(false);
 }
 
+// v11: steady-state schedule lock (ResponseList wire v7 carries the
+// LOCK engagement ring): hvd_steady_lock_engaged plus the
+// hvd_lockdet_* period-detector test hooks; metrics v6 adds the
+// ctrl_locked gauge, the ctrl_locks/_bypassed_responses/_unlocks_*
+// counters, cycles_idle_total and the lock_fire_us histogram.
 // v10: transport-rider surface (hvd_tcp_iouring_mode + _name,
 // hvd_worker_affinity) and metrics v5 (tcp_iouring_batches_total,
 // tcp_iouring_mode / worker_affinity gauges) — wire formats unchanged.
@@ -973,6 +1176,8 @@ int64_t hvd_metrics_snapshot(int64_t* out, int64_t max_slots) {
       links = static_cast<int64_t>(m->np) * (m->np - 1);
   }
   reg.Set(hvd::kGaugeTopoLinks, links);
+  reg.Set(hvd::kGaugeCtrlLocked,
+          st.controller && st.controller->lock_engaged() ? 1 : 0);
   return reg.Snapshot(out, max_slots);
 }
 
@@ -1280,6 +1485,45 @@ const char* hvd_tcp_iouring_mode_name() {
 // under HOROVOD_REDUCE_THREAD_AFFINITY=off, and until the pool's lazy
 // workers have actually spawned).
 int hvd_worker_affinity() { return hvd::WorkerPool::Get().PinnedWorkers(); }
+
+// Steady-state lock state (docs/perf_tuning.md "Steady-state schedule
+// lock"): 1 while this rank runs the negotiation-bypass plane. Also a
+// gauge (ctrl_locked) so dashboards see it without the ABI call.
+int hvd_steady_lock_engaged() {
+  auto& st = hvd::State();
+  return st.controller && st.controller->lock_engaged() ? 1 : 0;
+}
+
+// Test hooks: drive the period detector (hvd/steady_lock.h) without
+// spawning ranks — tests/test_steady_lock.py pins the K/period/reset
+// semantics the coordinator's engage decision is built on. Each feed
+// is one cycle carrying a single synthetic response named `name`
+// (NULL/empty = an empty cycle, which must neither extend nor break a
+// window).
+void* hvd_lockdet_create() { return new hvd::LockDetector(); }
+void hvd_lockdet_feed(void* h, int pure, const char* name) {
+  std::vector<hvd::Response> responses;
+  if (name != nullptr && name[0] != '\0') {
+    hvd::Response r;
+    r.tensor_names = {name};
+    responses.push_back(std::move(r));
+  }
+  static_cast<hvd::LockDetector*>(h)->FeedCycle(pure != 0, responses);
+}
+int hvd_lockdet_ready(void* h) {
+  return static_cast<hvd::LockDetector*>(h)->Ready() ? 1 : 0;
+}
+int hvd_lockdet_period(void* h) {
+  return static_cast<hvd::LockDetector*>(h)->period();
+}
+// Returns the detected ring's response count and resets the detector.
+int hvd_lockdet_take(void* h) {
+  return static_cast<int>(
+      static_cast<hvd::LockDetector*>(h)->TakeRing().size());
+}
+void hvd_lockdet_destroy(void* h) {
+  delete static_cast<hvd::LockDetector*>(h);
+}
 
 // Test hooks: drive the Bayesian autotune optimizer (hvd/bayesian.h)
 // against a caller-provided objective, so tests can assert global

@@ -78,6 +78,14 @@ uint32_t ResponseCache::Put(const Request& req) {
   return e.bit;
 }
 
+bool ResponseCache::LookupBitByName(const std::string& name,
+                                    uint32_t* bit) const {
+  auto it = entries_.find(name);
+  if (it == entries_.end()) return false;
+  *bit = it->second.bit;
+  return true;
+}
+
 bool ResponseCache::GetRequestByBit(uint32_t bit, Request* out) const {
   auto it = bit_to_entry_.find(bit);
   if (it == bit_to_entry_.end()) return false;

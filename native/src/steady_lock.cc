@@ -1,0 +1,478 @@
+// Steady-state schedule lock (hvd/steady_lock.h): the period detector,
+// the per-rank ring matcher, and the Controller glue — engagement,
+// the locked-phase step driven by the background loop, the token
+// consensus rounds over the data links, and the deterministic unlock.
+
+#include "hvd/steady_lock.h"
+
+#include <errno.h>
+#include <poll.h>
+#include <sys/socket.h>
+
+#include <algorithm>
+#include <cstring>
+
+#include "hvd/controller.h"
+#include "hvd/logging.h"
+#include "hvd/metrics.h"
+
+namespace hvd {
+
+// ---------------------------------------------------------------------------
+// LockDetector
+// ---------------------------------------------------------------------------
+
+uint64_t LockDetector::Signature(const std::vector<Response>& responses) {
+  std::string buf;
+  for (const auto& r : responses) r.SerializeTo(&buf);
+  uint64_t h = 1469598103934665603ull;
+  for (char c : buf) {
+    h ^= static_cast<uint8_t>(c);
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+void LockDetector::FeedCycle(bool pure, const std::vector<Response>& responses) {
+  if (!pure) {
+    Reset();
+    return;
+  }
+  // Empty pure cycles (event-driven heartbeats, stragglers crossing a
+  // cycle boundary) neither extend nor break a period.
+  if (responses.empty()) return;
+  CycleRec rec;
+  rec.sig = Signature(responses);
+  rec.responses = responses;
+  hist_.push_back(std::move(rec));
+  const size_t cap =
+      static_cast<size_t>((kSteadyLockK + 1) * kSteadyLockMaxPeriod);
+  while (hist_.size() > cap) hist_.pop_front();
+  // Smallest period whose last K repetitions all match. Re-derived
+  // from scratch every feed: a stale ready_ surviving a cycle that
+  // extends no period would let a DEFERRED engagement (non-quiescent
+  // pending table) later take a ring the new history never verified.
+  ready_ = false;
+  period_ = 0;
+  const size_t n = hist_.size();
+  for (int p = 1; p <= kSteadyLockMaxPeriod; ++p) {
+    const size_t need = static_cast<size_t>((kSteadyLockK + 1) * p);
+    if (n < need) continue;
+    bool match = true;
+    for (size_t j = n - kSteadyLockK * p; j < n && match; ++j)
+      match = hist_[j].sig == hist_[j - p].sig;
+    if (match) {
+      ready_ = true;
+      period_ = p;
+      return;
+    }
+  }
+}
+
+std::vector<Response> LockDetector::TakeRing() {
+  std::vector<Response> ring;
+  if (!ready_) return ring;
+  for (size_t j = hist_.size() - period_; j < hist_.size(); ++j)
+    for (const auto& r : hist_[j].responses) ring.push_back(r);
+  Reset();
+  return ring;
+}
+
+void LockDetector::Reset() {
+  hist_.clear();
+  ready_ = false;
+  period_ = 0;
+}
+
+// ---------------------------------------------------------------------------
+// LockMatcher
+// ---------------------------------------------------------------------------
+
+void LockMatcher::SetRing(std::vector<Response> ring) {
+  Clear();
+  ring_ = std::move(ring);
+  for (const auto& r : ring_)
+    for (uint32_t b : r.cache_bits) ring_need_[b]++;
+}
+
+bool LockMatcher::FeedBit(uint32_t bit) {
+  if (!ring_need_.count(bit)) return false;
+  have_[bit]++;
+  return true;
+}
+
+bool LockMatcher::SlotReady() const {
+  if (ring_.empty()) return false;
+  for (uint32_t b : ring_[pos_].cache_bits) {
+    auto it = have_.find(b);
+    if (it == have_.end() || it->second < 1) return false;
+  }
+  return true;
+}
+
+bool LockMatcher::SlotPartial() const {
+  if (ring_.empty()) return false;
+  return !have_.empty();
+}
+
+void LockMatcher::AdvanceSlot() {
+  for (uint32_t b : ring_[pos_].cache_bits) {
+    auto it = have_.find(b);
+    if (it != have_.end() && --it->second <= 0) have_.erase(it);
+  }
+  pos_ = (pos_ + 1) % ring_.size();
+  ++fired_;
+}
+
+std::vector<uint32_t> LockMatcher::PendingBits() const {
+  std::vector<uint32_t> out;
+  for (const auto& kv : have_)
+    for (int i = 0; i < kv.second; ++i) out.push_back(kv.first);
+  std::sort(out.begin(), out.end());
+  return out;
+}
+
+void LockMatcher::Clear() {
+  ring_.clear();
+  ring_need_.clear();
+  have_.clear();
+  pos_ = 0;
+  fired_ = 0;
+}
+
+// ---------------------------------------------------------------------------
+// Controller glue
+// ---------------------------------------------------------------------------
+
+namespace {
+// Token recv poll tick (stall feed + shutdown checks while blocked).
+constexpr int kLockTokenTickMs = 250;
+
+constexpr MetricCounter kUnlockReasonCounters[kNumUnlockReasons] = {
+    kCtrUnlocksMismatch, kCtrUnlocksJoin,     kCtrUnlocksShutdown,
+    kCtrUnlocksPeer,     kCtrUnlocksTunables, kCtrUnlocksPartial,
+};
+
+// 8-byte lock token exchanged on the data links, one per rank per
+// locked slot: all-FIRE executes the slot, anything else ends the
+// lock everywhere with the carried reason.
+struct LockToken {
+  uint8_t fire = 0;  // 1 = FIRE, 2 = UNLOCK
+  uint8_t reason = 0;
+  uint8_t pad[2] = {0, 0};
+  uint32_t slot = 0;
+};
+static_assert(sizeof(LockToken) == 8, "lock token must be 8 bytes");
+}  // namespace
+
+void Controller::LockObserveCycle(bool pure, bool quiescent,
+                                  ResponseList* out) {
+  if (steady_lock_knob_ != kSteadyLockAuto) return;
+  // Staged tunables / purge / shutdown are cycle-level control traffic
+  // the lock must not freeze across; a response the cache can't
+  // reproduce (ERROR / JOIN / BARRIER, shrunk contributors) or a
+  // non-empty pending table (a straggler negotiation the locked plane
+  // could never finish) also disqualifies the window.
+  if (staged_fusion_ > 0 || out->purge_cache || out->shutdown) pure = false;
+  for (const auto& r : out->responses) {
+    if (r.response_type == ResponseType::ERROR ||
+        r.response_type == ResponseType::JOIN ||
+        r.response_type == ResponseType::BARRIER)
+      pure = false;
+    if (!r.contributors.empty() &&
+        static_cast<int>(r.contributors.size()) != size_)
+      pure = false;
+  }
+  lock_detector_.FeedCycle(pure, out->responses);
+  if (!lock_detector_.Ready() || !quiescent) return;
+  std::vector<Response> ring = lock_detector_.TakeRing();
+  for (auto& resp : ring) {
+    resp.cache_bits.clear();
+    for (const auto& name : resp.tensor_names) {
+      uint32_t bit = 0;
+      if (deps_.response_cache == nullptr ||
+          !deps_.response_cache->LookupBitByName(name, &bit))
+        return;  // evicted between detection and engage: stay unlocked
+      resp.cache_bits.push_back(bit);
+    }
+  }
+  out->lock_engage = 1;
+  out->lock_ring = std::move(ring);
+}
+
+void Controller::EngageLock(const std::vector<Response>& ring) {
+  if (ring.empty()) return;
+  lock_matcher_.SetRing(ring);
+  lock_raw_pending_.clear();
+  lock_slot_timer_armed_ = false;
+  lock_engaged_.store(true, std::memory_order_relaxed);
+  MetricAdd(kCtrLocks);
+  LOG_DEBUG << "steady-state lock engaged: ring of " << ring.size()
+            << " fused response(s)";
+}
+
+void Controller::UnlockNow(int reason) {
+  std::vector<Request> requeue = std::move(lock_raw_pending_);
+  lock_raw_pending_.clear();
+  if (deps_.response_cache != nullptr) {
+    for (uint32_t bit : lock_matcher_.PendingBits()) {
+      Request req;
+      if (deps_.response_cache->GetRequestByBit(bit, &req)) {
+        req.request_rank = rank_;
+        requeue.push_back(std::move(req));
+      }
+    }
+  }
+  lock_matcher_.Clear();
+  lock_detector_.Reset();
+  lock_slot_timer_armed_ = false;
+  lock_engaged_.store(false, std::memory_order_relaxed);
+  if (!requeue.empty() && deps_.tensor_queue != nullptr)
+    deps_.tensor_queue->AddToTensorQueue({}, std::move(requeue));
+  MetricAdd(kCtrUnlocks);
+  if (reason >= 0 && reason < kNumUnlockReasons)
+    MetricAdd(kUnlockReasonCounters[reason]);
+  LOG_DEBUG << "steady-state lock released (reason " << reason << ")";
+}
+
+Controller::LockStep Controller::LockedPhaseStep(
+    bool shutdown_requested, int forced_reason,
+    const std::atomic<bool>* shutdown_flag, Response* fire, bool* fatal) {
+  *fatal = false;
+  int trigger = forced_reason;
+  if (shutdown_requested && trigger < 0) trigger = kUnlockShutdown;
+
+  // Drain and classify fresh enqueues against the ring.
+  std::vector<Request> msgs;
+  if (deps_.tensor_queue != nullptr)
+    deps_.tensor_queue->PopMessagesFromQueue(&msgs);
+  for (auto& req : msgs) {
+    req.request_rank = rank_;
+    if (req.request_type == RequestType::JOIN) {
+      lock_raw_pending_.push_back(std::move(req));
+      if (trigger < 0) trigger = kUnlockJoin;
+      continue;
+    }
+    uint32_t bit = 0;
+    bool matched = false;
+    if (req.request_type != RequestType::BARRIER &&
+        deps_.response_cache != nullptr &&
+        deps_.response_cache->Lookup(req, &bit) ==
+            ResponseCache::CacheState::HIT)
+      matched = lock_matcher_.FeedBit(bit);
+    if (!matched) {
+      lock_raw_pending_.push_back(std::move(req));
+      if (trigger < 0) trigger = kUnlockMismatch;
+    }
+  }
+
+  // A slot stuck half-fed past the timeout means the program changed
+  // its op set without a new name (e.g. dropped one member of a fused
+  // group) — unlock so the leftovers renegotiate instead of hanging.
+  if (trigger < 0) {
+    if (lock_matcher_.SlotPartial() && !lock_matcher_.SlotReady()) {
+      const auto now = std::chrono::steady_clock::now();
+      if (!lock_slot_timer_armed_) {
+        lock_slot_timer_armed_ = true;
+        lock_slot_feed_time_ = now;
+      } else if (std::chrono::duration<double>(now - lock_slot_feed_time_)
+                     .count() > lock_partial_timeout_secs_) {
+        trigger = kUnlockPartial;
+      }
+    } else {
+      lock_slot_timer_armed_ = false;
+    }
+  }
+
+  if (trigger < 0 && !lock_matcher_.SlotReady()) {
+    // Nothing to fire and no local trigger — but a peer may have
+    // proposed unlock (join/shutdown/divergence elsewhere). Joining
+    // its round from here keeps an idle rank from stalling consensus.
+    if (LockPeerProposedUnlock())
+      trigger = kUnlockPeer;
+    else
+      return LockStep::kWait;
+  }
+
+  const bool my_fire = trigger < 0;
+  int reason = my_fire ? kUnlockPeer : trigger;
+  const std::string waitname = lock_matcher_.has_ring() &&
+                                       !lock_matcher_.Slot().tensor_names.empty()
+                                   ? lock_matcher_.Slot().tensor_names.front()
+                                   : std::string("steady-lock");
+  const bool all_fire =
+      LockTokenRound(lock_matcher_.slot_index(), my_fire,
+                     my_fire ? kUnlockMismatch : trigger, waitname,
+                     shutdown_flag, &reason, fatal);
+  if (all_fire) {
+    *fire = lock_matcher_.Slot();
+    lock_matcher_.AdvanceSlot();
+    lock_slot_timer_armed_ = false;
+    return LockStep::kFired;
+  }
+  UnlockNow(reason);
+  return LockStep::kUnlocked;
+}
+
+// ---------------------------------------------------------------------------
+// TcpController: token consensus over the data links
+// ---------------------------------------------------------------------------
+
+bool TcpController::LockTokenRound(uint32_t slot, bool my_fire, int my_reason,
+                                   const std::string& waitname,
+                                   const std::atomic<bool>* shutdown_flag,
+                                   int* out_reason, bool* fatal) {
+  *fatal = false;
+  if (size_ <= 1) {
+    if (!my_fire) *out_reason = my_reason;
+    return my_fire;
+  }
+  LockToken mine;
+  mine.fire = my_fire ? 1 : 2;
+  mine.reason = static_cast<uint8_t>(my_reason);
+  mine.slot = slot;
+  bool all_fire = my_fire;
+  *out_reason = my_fire ? kUnlockPeer : my_reason;
+
+  // A one-phase consensus cannot AGREE across a dead link: a peer
+  // that collected all-FIRE may already be firing the slot we are
+  // about to abandon, splitting the fleet between locked and
+  // negotiated planes. Any link I/O error (send/recv failure, EOF,
+  // hard poll error) therefore tears every conn down — peers' waits
+  // error out, everyone unwinds to the negotiated plane's
+  // lost-connection shutdown, and the job dies fast instead of
+  // wedging split (the same fail-fast contract as a peer death in
+  // negotiated mode).
+  auto teardown_fatal = [&](int reason) {
+    for (auto& c : ctrl_conns_) c.Close();
+    for (auto& c : data_conns_) c.Close();
+    for (auto& c : mesh_conns_) c.Close();
+    *fatal = true;
+    *out_reason = reason;
+    return false;
+  };
+  auto link_fatal = [&] {
+    LOG_ERROR << "steady-lock token round lost a data link; tearing the "
+                 "job down";
+    return teardown_fatal(kUnlockPeer);
+  };
+
+  // Send my vote everywhere first (8 bytes per peer — cannot block
+  // meaningfully), then collect every peer's for this slot.
+  std::vector<TcpConn*> conns(size_, nullptr);
+  for (int peer = 0; peer < size_; ++peer) {
+    if (peer == rank_) continue;
+    conns[peer] = DataConn(peer);
+    if (conns[peer] == nullptr || !conns[peer]->valid() ||
+        !conns[peer]->SendAll(&mine, sizeof(mine)))
+      return link_fatal();
+  }
+
+  std::vector<bool> got(size_, false);
+  got[rank_] = true;
+  bool stall_recorded = false;
+  // Shutdown grace measured in ELAPSED steady time from the first
+  // tick that observed the flag — never in wakeup counts, which a
+  // signal-heavy process (EINTR storms) would burn through early.
+  std::chrono::steady_clock::time_point shutdown_since{};
+  auto outstanding = [&] {
+    for (int peer = 0; peer < size_; ++peer)
+      if (conns[peer] != nullptr && !got[peer]) return true;
+    return false;
+  };
+  while (outstanding()) {
+    std::vector<struct pollfd> pfds;
+    std::vector<int> pfd_rank;
+    for (int peer = 0; peer < size_; ++peer) {
+      if (conns[peer] == nullptr || got[peer]) continue;
+      pfds.push_back({conns[peer]->fd(), POLLIN, 0});
+      pfd_rank.push_back(peer);
+    }
+    int pr = ::poll(pfds.data(), pfds.size(), kLockTokenTickMs);
+    if (pr < 0) {
+      if (errno == EINTR) continue;  // a signal is not a tick
+      return link_fatal();
+    }
+    if (pr == 0) {
+      // Timeout tick: surface the wait through the stall inspector —
+      // the locked plane's replacement for RecordUncachedTensor (a
+      // peer that stopped firing mid-lock must still show up in
+      // hvd.stalled_tensors() with the silent ranks listed).
+      if (deps_.stall_inspector != nullptr) {
+        stall_recorded = true;
+        for (int peer = 0; peer < size_; ++peer)
+          if (got[peer])
+            deps_.stall_inspector->RecordUncachedTensor(waitname, peer);
+        if (deps_.stall_inspector->CheckForStalledTensors(size_)) {
+          // Stall-shutdown threshold: the links now hold a token we
+          // cannot retract, so the only safe exit is tearing the job
+          // down — close the links (peers see EOF and unlock) and
+          // tell the caller to raise the process shutdown flag.
+          LOG_ERROR << "steady-lock wait exceeded the stall shutdown "
+                       "threshold; tearing down the data links";
+          return teardown_fatal(kUnlockShutdown);
+        }
+      }
+      // A shutdown requested while we are parked here cannot be
+      // negotiated (the token is already sent); bound the wait so the
+      // process stays killable even against a hung peer.
+      if (shutdown_flag != nullptr &&
+          shutdown_flag->load(std::memory_order_relaxed)) {
+        const auto now = std::chrono::steady_clock::now();
+        if (shutdown_since == std::chrono::steady_clock::time_point{}) {
+          shutdown_since = now;
+        } else if (now - shutdown_since > std::chrono::seconds(30)) {
+          return teardown_fatal(kUnlockShutdown);
+        }
+      }
+      continue;
+    }
+    for (size_t i = 0; i < pfds.size(); ++i) {
+      if (!(pfds[i].revents & (POLLIN | POLLERR | POLLHUP))) continue;
+      const int peer = pfd_rank[i];
+      LockToken t;
+      if (!conns[peer]->RecvAll(&t, sizeof(t))) return link_fatal();
+      got[peer] = true;
+      if (t.fire != 1) {
+        all_fire = false;
+        if (*out_reason == kUnlockPeer && t.reason < kNumUnlockReasons)
+          *out_reason = t.reason;  // propagate the initiating cause
+      } else if (t.slot != slot) {
+        // Slot skew means the rings diverged — never execute on it.
+        LOG_WARNING << "steady-lock token slot skew (peer " << peer
+                    << ": " << t.slot << " vs " << slot << "); unlocking";
+        all_fire = false;
+        *out_reason = kUnlockPeer;
+      }
+    }
+  }
+  if (stall_recorded && deps_.stall_inspector != nullptr)
+    deps_.stall_inspector->RemoveUncachedTensor(waitname);
+  return all_fire;
+}
+
+bool TcpController::LockPeerProposedUnlock() {
+  if (size_ <= 1) return false;
+  // During locked idle the only bytes a peer can have in flight on a
+  // data link are its token for OUR current slot (it cannot pass the
+  // slot without our vote) — an 8-byte MSG_PEEK reads a whole token
+  // or nothing. EOF / a dead link counts as an unlock proposal.
+  for (int peer = 0; peer < size_; ++peer) {
+    if (peer == rank_) continue;
+    TcpConn* c = DataConn(peer);
+    if (c == nullptr || !c->valid()) return true;
+    LockToken t;
+    const ssize_t n =
+        ::recv(c->fd(), &t, sizeof(t), MSG_PEEK | MSG_DONTWAIT);
+    if (n == 0) return true;  // EOF: peer died
+    if (n < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR)
+        continue;
+      return true;  // hard socket error
+    }
+    if (n == static_cast<ssize_t>(sizeof(t)) && t.fire != 1) return true;
+  }
+  return false;
+}
+
+}  // namespace hvd

@@ -13,7 +13,11 @@ Status TensorQueue::AddToTensorQueue(std::vector<TensorTableEntry> entries,
           "call a distinct name= argument");
     }
   }
-  for (auto& e : entries) table_.emplace(e.name, std::move(e));
+  const auto now = std::chrono::steady_clock::now();
+  for (auto& e : entries) {
+    e.enqueue_time = now;
+    table_.emplace(e.name, std::move(e));
+  }
   for (auto& r : requests) queue_.push_back(std::move(r));
   return Status::OK();
 }
@@ -52,6 +56,11 @@ void TensorQueue::FailAll(const Status& status) {
 size_t TensorQueue::size() const {
   MutexLock lock(mu_);
   return table_.size();
+}
+
+bool TensorQueue::has_messages() const {
+  MutexLock lock(mu_);
+  return !queue_.empty();
 }
 
 bool TensorQueue::Lookup(const std::string& name, TensorTableEntry* out) const {

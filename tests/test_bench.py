@@ -239,6 +239,47 @@ def test_find_regressions_spec_key_directions():
         {"extra.serve_spec_over_plain"}
 
 
+def test_find_regressions_latency_family_key_directions():
+    """ISSUE 15 keys: the small-op latency family's p50 `*_us` leaves
+    (locked and off arms alike) regress when they RISE; the p99 twins
+    carry the `_us_p99` leaf suffix and are UNGATED (this box's p99
+    swings 3-6x with scheduler noise — a 10% gate would flag pure
+    weather); the steady_lock_p50_speedup ratio gates like a
+    throughput key (flags on drops); the engaged flag is a bool and
+    never participates."""
+    prev = {"extra": {
+        "host_allreduce_latency_us_p50_locked_np4": {"4B_us": 80.0,
+                                                     "64KB_us": 300.0},
+        "host_allreduce_latency_us_p99_locked_np4": {"4B_us_p99": 200.0},
+        "host_allreduce_latency_us_p50_off_np4": {"4B_us": 140.0},
+        "steady_lock_p50_speedup": 1.75,
+        "steady_lock_engaged": True,
+    }}
+    cur = {"extra": {
+        "host_allreduce_latency_us_p50_locked_np4": {"4B_us": 160.0,  # rise
+                                                     "64KB_us": 250.0},
+        "host_allreduce_latency_us_p99_locked_np4": {
+            "4B_us_p99": 900.0},  # 4.5x p99 swing: weather, ungated
+        "host_allreduce_latency_us_p50_off_np4": {"4B_us": 145.0},
+        "steady_lock_p50_speedup": 0.9,                       # drop: flags
+        "steady_lock_engaged": False,
+    }}
+    regs = bench.find_regressions(prev, cur)
+    assert set(regs) == {
+        "extra.host_allreduce_latency_us_p50_locked_np4.4B_us",
+        "extra.steady_lock_p50_speedup"}
+    assert regs["extra.host_allreduce_latency_us_p50_locked_np4.4B_us"][
+        "rise_pct"] == 100.0
+    assert regs["extra.steady_lock_p50_speedup"]["drop_pct"] > 45
+    # A latency WIN never flags.
+    cur2 = {"extra": {
+        "host_allreduce_latency_us_p50_locked_np4": {"4B_us": 40.0,
+                                                     "64KB_us": 150.0},
+        "steady_lock_p50_speedup": 2.5,
+    }}
+    assert bench.find_regressions(prev, cur2) == {}
+
+
 def test_find_regressions_threshold_boundary():
     prev = {"value": 100.0}
     assert bench.find_regressions(prev, {"value": 91.0}) == {}

@@ -339,6 +339,10 @@ def test_multi_nic_candidate_election():
         assert f"OK rank={r}" in out
 
 
+@pytest.mark.slow  # redundancy (ISSUE 15 budget): the candidate
+# election itself is tier-1-gated (test_multi_nic_candidate_election);
+# this arm re-proves only the bounded-timeout refusal, ~9s of which is
+# the deliberate 6s dial deadline.
 def test_multi_nic_all_unreachable_fails_fast():
     """Only unreachable candidates: init must surface a bounded error
     (the non-blocking dialer), never hang on the kernel SYN backoff."""

@@ -334,6 +334,67 @@ def test_injected_algo_doc_row_drift_fires(tree):
     assert len(fs) == 1 and "`ring`" in fs[0].message, fs
 
 
+def test_steady_lock_knobs_covered_by_knob_rule(tree):
+    """ISSUE 15 satellite: the env-var rule really covers the
+    steady-lock knobs spelled the way the native source spells them
+    (EnvChoiceSane / EnvDoubleSane call sites): undocumented they fire
+    one finding each, and knob rows like the real tuning.md's clear
+    them (the live-tree guarantee is test_real_tree_is_clean)."""
+    _write(tree, "native/src/operations2.cc",
+           'int k = EnvChoiceSane("HOROVOD_STEADY_LOCK", 0, kC, 2);\n'
+           'double t = EnvDoubleSane('
+           '"HOROVOD_STEADY_LOCK_TIMEOUT_SECONDS", 2.0);\n')
+    fs = run_all(tree, only={"knob-docs"})
+    hit = {k for f in fs for k in
+           ("HOROVOD_STEADY_LOCK", "HOROVOD_STEADY_LOCK_TIMEOUT_SECONDS")
+           if f.message.startswith(k + " ")}
+    assert hit == {"HOROVOD_STEADY_LOCK",
+                   "HOROVOD_STEADY_LOCK_TIMEOUT_SECONDS"}, fs
+    _write(tree, "docs/tuning.md",
+           "`HOROVOD_STEADY_LOCK` locks; "
+           "`HOROVOD_STEADY_LOCK_TIMEOUT_SECONDS` bounds half-fed "
+           "slots.\n")
+    assert run_all(tree, only={"knob-docs"}) == []
+
+
+def test_undocumented_lock_metric_fires(tree):
+    """ISSUE 15 satellite: a ctrl_* lock series present in the native
+    tables but missing from the observability catalog fires
+    metric-sync — the guard that forced the real catalog rows."""
+    _write(tree, "native/include/hvd/metrics.h", """\
+        constexpr int kMetricsVersion = 1;
+        enum MetricCounter : int {
+          kCtrCycles = 0,
+          kCtrShmOps,
+          kCtrBypassedResponses,
+          kNumMetricCounters
+        };
+        enum MetricHistogram : int {
+          kHistCycleUs = 0,
+          kNumMetricHistograms
+        };
+        """)
+    _write(tree, "native/src/metrics.cc", """\
+        constexpr const char* kCounterNames[] = {
+            "cycles_total",
+            "shm_ops_total",
+            "ctrl_bypassed_responses_total",
+        };
+        constexpr const char* kHistNames[] = {
+            "cycle_us",
+        };
+        """)
+    fs = run_all(tree, only={"metric-sync"})
+    assert any("ctrl_bypassed_responses_total" in f.message for f in fs), fs
+    # The real catalog documents the unlock reasons as ONE brace-family
+    # row; prove the expansion counts every reason as documented.
+    _write(tree, "docs/observability.md",
+           "`cycles_total` `shm_ops_total` `cycle_us` "
+           "`ctrl_{bypassed_responses}_total`\n"
+           "HOROVOD_CYCLE_TIME HOROVOD_COLLECTIVE_ALGO\n")
+    assert run_all(tree, only={"metric-sync"}) == []
+
+
 def test_every_rule_has_an_injection_test():
     """Meta-guard: adding a rule without an injection test here should
     fail loudly, not pass silently."""

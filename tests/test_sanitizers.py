@@ -69,6 +69,11 @@ SCENARIOS = [
     # buffer pool's first-touch ParallelFor racing the receiver threads'
     # writes — the concurrency this tier exists to prove clean.
     ("transport_digest", 2, {"HOROVOD_SHM_DISABLE": "1"}),
+    # Steady-lock churn (ISSUE 15): np=4 loop that locks, a rank
+    # injects a shape change to force the consensus unlock, re-locks —
+    # three rounds, so the detector/matcher/token rounds and the
+    # engaged-flag reads from Python threads run under the sanitizer.
+    ("lock_churn", 4, {}),
 ]
 
 _RUNTIME_LIB = {"tsan": "libtsan.so", "asan": "libasan.so",

@@ -1,0 +1,208 @@
+"""Steady-state schedule lock (ISSUE 15): the coordinator locks a
+repeating pure-cache-hit response sequence and every rank bypasses
+negotiation until a deterministic unlock (shape change, Join,
+shutdown, staged tunables, dead peer). Unit tier drives the period
+detector through its ctypes hooks; the integration tier launches real
+multi-process jobs through every unlock trigger — each one a scenario
+that would hang or diverge without the unlock path."""
+
+import ctypes
+import os
+import signal
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.common import basics  # noqa: E402
+from test_eager_multiprocess import run_job  # noqa: E402
+
+K = 3           # kSteadyLockK (native/include/hvd/steady_lock.h)
+MAX_PERIOD = 8  # kSteadyLockMaxPeriod
+
+
+def _header_constants():
+    hdr = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native", "include", "hvd",
+        "steady_lock.h")
+    import re
+    src = open(hdr).read()
+    return {m.group(1): int(m.group(2)) for m in re.finditer(
+        r"constexpr\s+int\s+(kSteadyLock\w+)\s*=\s*(\d+)\s*;", src)}
+
+
+def test_k_and_period_pins_match_header():
+    consts = _header_constants()
+    assert consts["kSteadyLockK"] == K
+    assert consts["kSteadyLockMaxPeriod"] == MAX_PERIOD
+
+
+# ---------------------------------------------------------------------------
+# period detector (pure logic, no ranks)
+# ---------------------------------------------------------------------------
+
+class _Det:
+    def __init__(self):
+        self.lib = basics.get_lib()
+        self.h = self.lib.hvd_lockdet_create()
+
+    def feed(self, name, pure=True):
+        self.lib.hvd_lockdet_feed(
+            ctypes.c_void_p(self.h), 1 if pure else 0,
+            name.encode() if name else None)
+
+    def ready(self):
+        return bool(self.lib.hvd_lockdet_ready(ctypes.c_void_p(self.h)))
+
+    def period(self):
+        return self.lib.hvd_lockdet_period(ctypes.c_void_p(self.h))
+
+    def take(self):
+        return self.lib.hvd_lockdet_take(ctypes.c_void_p(self.h))
+
+    def close(self):
+        self.lib.hvd_lockdet_destroy(ctypes.c_void_p(self.h))
+
+
+def test_detector_engages_after_k_plus_one_identical_cycles():
+    d = _Det()
+    try:
+        for i in range(K):
+            d.feed("a")
+            assert not d.ready(), f"ready after only {i + 1} cycles"
+        d.feed("a")  # the (K+1)th identical cycle completes K periods
+        assert d.ready() and d.period() == 1
+        assert d.take() == 1  # ring = one response
+        assert not d.ready()  # take() resets
+    finally:
+        d.close()
+
+
+def test_detector_finds_period_two_and_rings_both_cycles():
+    d = _Det()
+    try:
+        for _ in range(K):
+            d.feed("a")
+            d.feed("b")
+            assert not d.ready()
+        d.feed("a")
+        d.feed("b")
+        assert d.ready() and d.period() == 2
+        assert d.take() == 2
+    finally:
+        d.close()
+
+
+def test_detector_resets_on_impure_cycle():
+    d = _Det()
+    try:
+        for _ in range(K):
+            d.feed("a")
+        d.feed("a", pure=False)  # raw request / join / staged tunables
+        d.feed("a")
+        assert not d.ready(), "impure cycle must reset the window"
+        for _ in range(K):
+            d.feed("a")
+        assert d.ready()
+    finally:
+        d.close()
+
+
+def test_detector_ignores_empty_cycles():
+    """Event-driven heartbeats (pure cycles with no responses) neither
+    extend nor break a period."""
+    d = _Det()
+    try:
+        for _ in range(K):
+            d.feed("a")
+            d.feed(None)  # empty heartbeat between steps
+        d.feed("a")
+        assert d.ready() and d.period() == 1
+    finally:
+        d.close()
+
+
+def test_detector_ready_does_not_survive_a_period_break():
+    """A detected-but-not-yet-taken ring (engagement deferred by a
+    non-quiescent pending table) must be withdrawn when the next pure
+    cycle extends no period — a stale ready_ would let the coordinator
+    broadcast a ring the new history never verified."""
+    d = _Det()
+    try:
+        for _ in range(K + 1):
+            d.feed("a")
+        assert d.ready()
+        d.feed("b")  # pure, but the single-occurrence b breaks period 1
+        assert not d.ready(), "ready_ survived a period break"
+    finally:
+        d.close()
+
+
+def test_detector_no_false_lock_on_alternation_shorter_than_k():
+    d = _Det()
+    try:
+        d.feed("a")
+        d.feed("b")
+        d.feed("a")
+        d.feed("b")
+        d.feed("c")  # pattern breaks before K periods of (a, b)
+        assert not d.ready()
+    finally:
+        d.close()
+
+
+# ---------------------------------------------------------------------------
+# multi-process integration: engage, bypass, every unlock trigger
+# ---------------------------------------------------------------------------
+
+def test_lock_steady_np4_engage_bypass_mismatch_relock():
+    outs = run_job("lock_steady", 4, timeout=180)
+    for r, out in enumerate(outs):
+        assert f"OK rank={r}" in out
+
+
+def test_lock_off_is_inert():
+    outs = run_job("lock_off", 2, extra_env={"HOROVOD_STEADY_LOCK": "off"})
+    for r, out in enumerate(outs):
+        assert f"OK rank={r}" in out
+
+
+def test_lock_join_unlocks_every_rank():
+    outs = run_job("lock_join", 2, timeout=150)
+    for r, out in enumerate(outs):
+        assert f"OK rank={r}" in out
+
+
+def test_lock_stall_surfaces_on_waiting_rank():
+    outs = run_job("lock_stall", 2, timeout=150,
+                   extra_env={"HOROVOD_STALL_CHECK_TIME_SECONDS": "0.5"})
+    for r, out in enumerate(outs):
+        assert f"OK rank={r}" in out
+
+
+def test_lock_shutdown_mid_lock_exits_cleanly():
+    outs = run_job("lock_shutdown", 2, timeout=120)
+    for r, out in enumerate(outs):
+        assert f"OK rank={r}" in out
+
+
+def test_lock_autotune_staging_unlocks():
+    outs = run_job("lock_autotune", 2, timeout=150,
+                   extra_env={"HOROVOD_AUTOTUNE": "1",
+                              "HOROVOD_AUTOTUNE_WINDOW_SECS": "0.3"})
+    for r, out in enumerate(outs):
+        assert f"OK rank={r}" in out
+
+
+@pytest.mark.slow  # a 3-rank spawn around a deliberate SIGKILL
+def test_lock_chaos_sigkill_mid_lock_no_hang():
+    outs = run_job("lock_die", 3, timeout=180,
+                   expected_rc={2: -signal.SIGKILL})
+    for r, out in enumerate(outs[:2]):
+        assert f"OK rank={r}" in out
+
+
+def test_idle_cycles_event_driven_telemetry():
+    outs = run_job("idle_cycles", 1)
+    assert "OK rank=0" in outs[0]

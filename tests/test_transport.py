@@ -200,6 +200,11 @@ def _digest_lines(outs):
     return lines
 
 
+@pytest.mark.slow  # redundancy (ISSUE 15 budget): on this pinned 4.4
+# kernel BOTH arms resolve to the vectored path, so the ~30s two-job
+# comparison pins the engine against itself; the cross-rank digest gate
+# stays in tier-1 (test_transport_riders_byte_identical) and the
+# sane-env garbage handling is a static warn path.
 def test_forced_fallback_is_byte_identical():
     """HOROVOD_TCP_ZEROCOPY=off vs auto: same ops, byte-identical
     results across every TCP exchange engine — the knob may change

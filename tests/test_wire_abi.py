@@ -27,6 +27,17 @@ def test_abi_version_pins_match():
     assert _header_constant("kAbiVersion") == basics.ABI_VERSION
 
 
+def test_issue15_version_bumps_landed():
+    """ISSUE 15 lockstep pins: ResponseList wire v7 (the LOCK
+    engagement ring) / ABI v11 (hvd_steady_lock_engaged + detector
+    hooks) / metrics v6 (the ctrl_* lock series). The relative checks
+    above catch a one-sided bump; this pins the absolute values so a
+    stray revert of BOTH sides is caught too."""
+    assert basics.WIRE_VERSION_RESPONSE_LIST == 7
+    assert basics.ABI_VERSION == 11
+    assert basics.METRICS_VERSION == 6
+
+
 def test_wire_version_pins_match():
     assert (_header_constant("kWireVersionRequestList")
             == basics.WIRE_VERSION_REQUEST_LIST)
