@@ -690,6 +690,165 @@ def _serve_worker():
         pass
 
 
+def _elastic_chaos_child():
+    """Per-worker body of the elastic churn-recovery case: train
+    BENCH_CHAOS_TOTAL batches of a fixed-name allreduce under the
+    elastic driver, logging ``batch t_mono size epoch engaged`` per
+    completed step (CLOCK_MONOTONIC is system-wide on Linux, so the
+    launcher can difference timestamps across processes). Identity
+    localhost:1 SIGKILLs itself once at BENCH_CHAOS_KILL_AT — the
+    membership event whose recovery latency the launcher measures."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+    import horovod_tpu.elastic as elastic
+
+    log_dir = os.environ["BENCH_CHAOS_DIR"]
+    total = int(os.environ.get("BENCH_CHAOS_TOTAL", "24"))
+    kill_at = int(os.environ.get("BENCH_CHAOS_KILL_AT", "6"))
+    ident = os.environ["HOROVOD_ELASTIC_ID"]
+    path = os.path.join(log_dir, ident.replace(":", "_") + ".log")
+
+    hvd.init()
+    state = elastic.ObjectState(batch=0)
+
+    @elastic.run
+    def train(state):
+        while state.batch < total:
+            hvd.allreduce(np.ones(64, np.float32), op=hvd.Average,
+                          name="bench_chaos")
+            state.batch += 1
+            with open(path, "a") as f:
+                f.write(f"{state.batch} {time.monotonic():.6f} "
+                        f"{hvd.size()} {hvd.membership().epoch} "
+                        f"{int(hvd.steady_lock_engaged())}\n")
+            if ident == "localhost:1" and state.batch == kill_at:
+                marker = os.path.join(log_dir, "killed")
+                if not os.path.exists(marker):
+                    with open(marker, "w") as f:
+                        f.write(f"{time.monotonic():.6f}\n")
+                    os.kill(os.getpid(), 9)  # SIGKILL, no cleanup
+            time.sleep(0.05)
+            state.commit()
+        return state.batch
+
+    train(state)
+    hvd.shutdown()
+
+
+def _elastic_chaos_worker():
+    """Elastic churn-recovery latencies (ISSUE 16): one seeded chaos
+    job — SIGKILL a worker mid-run, then grow 2->4 — and report
+
+    * ``elastic_recovery_ms``: kill to the first step completed under
+      the post-churn membership epoch (restore + re-rendezvous +
+      respawn, the whole recovery path);
+    * ``steady_relock_after_join_ms``: grow trigger to the first step
+      at the grown size with the steady lock re-engaged (how long the
+      job pays negotiated cycles after a join).
+
+    Prints "ELASTICEXTRA {json}"."""
+    import glob
+    import tempfile
+    import threading
+
+    from horovod_tpu.runner.elastic_driver import FixedHostDiscovery
+    from horovod_tpu.runner.launch import LaunchSettings, launch_elastic
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    log_dir = tempfile.mkdtemp(prefix="bench_chaos_")
+    kill_at = 6
+    env = {
+        "PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": root, "HOROVOD_CYCLE_TIME": "1",
+        "BENCH_CHAOS_DIR": log_dir, "BENCH_CHAOS_TOTAL": "70",
+        "BENCH_CHAOS_KILL_AT": str(kill_at),
+        # Tight watcher poll: the measured windows must not be
+        # dominated by a 1 s default poll interval, and the job must
+        # still be RUNNING when the joiners arrive (a job that drains
+        # before noticing the grow strands them mid-rendezvous).
+        "HOROVOD_ELASTIC_POLL_SECS": "0.1",
+        # The host-plane recovery path is the thing under test; the
+        # XLA data plane would only add compile noise to the clock.
+        "HOROVOD_XLA_EXEC": "0",
+    }
+    settings = LaunchSettings(
+        np=0, command=[sys.executable, os.path.abspath(__file__),
+                       "--elastic-chaos-child"],
+        env=env, start_timeout=60)
+    discovery = FixedHostDiscovery({"localhost": 2})
+    result = {}
+
+    def runner():
+        result["codes"] = launch_elastic(
+            settings, discovery, min_np=1, max_np=4,
+            discovery_interval=0.3)
+
+    def max_batch():
+        out = 0
+        for p in glob.glob(os.path.join(log_dir, "*.log")):
+            try:
+                with open(p) as f:
+                    for ln in f:
+                        out = max(out, int(ln.split()[0]))
+            except (OSError, ValueError, IndexError):
+                pass
+        return out
+
+    t = threading.Thread(target=runner, daemon=True)
+    t.start()
+    # Grow AFTER the kill has been recovered from (two completed
+    # post-kill steps), so the two measured windows never overlap.
+    t_grow = None
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline and t.is_alive():
+        if (os.path.exists(os.path.join(log_dir, "killed"))
+                and max_batch() >= kill_at + 2):
+            discovery.set_hosts({"localhost": 4})
+            t_grow = time.monotonic()
+            break
+        time.sleep(0.05)
+    t.join(120)
+    if t.is_alive() or t_grow is None:
+        print(f"elastic-chaos: job stalled (alive={t.is_alive()}, "
+              f"grow_fired={t_grow is not None})", file=sys.stderr)
+        return
+    codes = result.get("codes", {})
+    if any(c != 0 for c in codes.values()):
+        print(f"elastic-chaos: nonzero exits {codes}", file=sys.stderr)
+        return
+
+    with open(os.path.join(log_dir, "killed")) as f:
+        t_kill = float(f.read().split()[0])
+    rows = []
+    for p in glob.glob(os.path.join(log_dir, "*.log")):
+        with open(p) as f:
+            for ln in f:
+                b, ts, size, ep, eng = ln.split()
+                rows.append((float(ts), int(size), int(ep), int(eng)))
+    ep_kill = max((ep for ts, _, ep, _ in rows if ts <= t_kill),
+                  default=0)
+    post = [ts for ts, _, ep, _ in rows if ep > ep_kill]
+    relock = [ts for ts, size, _, eng in rows
+              if size == 4 and eng and ts > t_grow]
+    if not post or not relock:
+        print(f"elastic-chaos: no measurement (post={len(post)}, "
+              f"relock={len(relock)})", file=sys.stderr)
+        return
+    print("ELASTICEXTRA " + json.dumps({
+        "elastic_recovery_ms": round((min(post) - t_kill) * 1000, 1),
+        "steady_relock_after_join_ms": round(
+            (min(relock) - t_grow) * 1000, 1),
+    }), flush=True)
+
+
+def _elastic_extra(remaining_secs: float):
+    """Elastic churn-recovery extra (spawns a small elastic job: a
+    kill + a grow over ~30 s of CPU host-plane training)."""
+    return _worker_extra("--elastic-chaos-worker", "ELASTICEXTRA",
+                         remaining_secs, 150.0)
+
+
 def _serve_extra(remaining_secs: float):
     """Serving benchmark extra (continuous-batching engine +
     speculative decoding + fleet router + cross-process RPC arm; the
@@ -1005,6 +1164,16 @@ def main():
         sv = _serve_extra(remaining)
         if sv is not None:
             extra.update(sv)
+    # Elastic churn-recovery tier: kill-to-recovered-step and
+    # join-to-relocked wall times from a small seeded chaos job
+    # (ISSUE 16's membership plane). `_ms` leaves gate
+    # lower-is-better like the serve latency tails.
+    remaining = budget - (time.perf_counter() - _T0)
+    if (extras_on and os.environ.get("BENCH_SKIP_ELASTIC") != "1"
+            and remaining > 40):
+        el = _elastic_extra(remaining)
+        if el is not None:
+            extra.update(el)
     payload = {
         "metric": "resnet50_images_per_sec_per_chip",
         "value": round(per_chip, 2),
@@ -1037,5 +1206,9 @@ if __name__ == "__main__":
         _transformer_worker()
     elif "--serve-worker" in sys.argv:
         _serve_worker()
+    elif "--elastic-chaos-worker" in sys.argv:
+        _elastic_chaos_worker()
+    elif "--elastic-chaos-child" in sys.argv:
+        _elastic_chaos_child()
     else:
         main()

@@ -28,7 +28,7 @@ __all__ = [
     "set_reduce_threads", "metrics", "metrics_prometheus",
     "metrics_aggregate", "metrics_reset", "stalled_tensors",
     "start_metrics_server", "collective_algo", "topology",
-    "topology_probe", "steady_lock_engaged",
+    "topology_probe", "steady_lock_engaged", "membership",
 ]
 
 
@@ -140,6 +140,39 @@ def steady_lock_engaged() -> bool:
     gauge in :func:`metrics`."""
     from horovod_tpu.common.basics import get_lib
     return bool(get_lib().hvd_steady_lock_engaged())
+
+
+def membership():
+    """Snapshot of the process-global membership plane (ABI v12,
+    ``docs/elastic.md``): the monotone epoch every stateful consumer
+    fences on, plus the active rank set.
+
+    Works before ``init()`` — the elastic driver's epoch publisher and
+    the serving router's replica plane ride the same accessor from
+    processes that never initialize the collective core. Returns a
+    namedtuple ``(epoch, generation, external_epoch, size, ranks)``
+    where ``epoch == external_epoch << 20 | generation``: the external
+    component is the driver-published ``HOROVOD_ELASTIC_EPOCH``, the
+    generation counts in-job changes (Join flushes, dead peers,
+    explicit shrinks)."""
+    import ctypes
+    from collections import namedtuple
+
+    lib = basics.get_lib()
+    n = lib.hvd_membership_ranks(None, 0)
+    buf = (ctypes.c_int * max(n, 1))()
+    lib.hvd_membership_ranks(buf, n)
+    Membership = namedtuple(
+        "Membership", ["epoch", "generation", "external_epoch", "size",
+                       "ranks"])
+    epoch = int(lib.hvd_membership_epoch())
+    return Membership(
+        epoch=epoch,
+        generation=int(lib.hvd_membership_generation()),
+        external_epoch=epoch >> 20,
+        size=int(lib.hvd_membership_size()),
+        ranks=tuple(buf[i] for i in range(n)),
+    )
 
 
 def start_metrics_server(port: int = 0, addr: str = "0.0.0.0"):

@@ -38,7 +38,7 @@ EXEC_CALLBACK = 1
 # is enforced at library load below, and tests/test_wire_abi.py greps
 # the header so a native bump can't silently skew this shim even
 # before a rebuild happens.
-ABI_VERSION = 11
+ABI_VERSION = 12
 WIRE_VERSION_REQUEST_LIST = 3
 WIRE_VERSION_RESPONSE_LIST = 7
 
@@ -46,7 +46,7 @@ WIRE_VERSION_RESPONSE_LIST = 7
 # kMetricsVersion): the packed int64 layout hvd_metrics_snapshot
 # writes. Checked at library load AND against the header by
 # tests/test_metrics_abi.py, the same two-sided pin as the ABI above.
-METRICS_VERSION = 6
+METRICS_VERSION = 7
 
 # Native WireCodec ids (native/include/hvd/codec.h); -1 = follow the
 # job-wide HOROVOD_WIRE_COMPRESSION default.
@@ -396,7 +396,49 @@ def _declare_abi(lib: ctypes.CDLL, path: str) -> ctypes.CDLL:
         ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int]
+    # Membership plane (ABI v12, docs/elastic.md): the process-global
+    # epoch / active-rank / fence surface hvd.membership() reads, plus
+    # the decay blacklist the elastic driver and serving router share.
+    # Usable BEFORE hvd_init — driver/router processes never init the
+    # core.
+    lib.hvd_membership_epoch.restype = ctypes.c_int64
+    lib.hvd_membership_generation.restype = ctypes.c_int64
+    lib.hvd_membership_size.restype = ctypes.c_int
+    lib.hvd_membership_ranks.restype = ctypes.c_int
+    lib.hvd_membership_ranks.argtypes = [ctypes.POINTER(ctypes.c_int),
+                                         ctypes.c_int]
+    lib.hvd_membership_advance.restype = ctypes.c_int64
+    lib.hvd_membership_advance.argtypes = [ctypes.c_int, ctypes.c_int]
+    lib.hvd_membership_reset.restype = None
+    lib.hvd_membership_reset.argtypes = [ctypes.c_int64, ctypes.c_int]
+    lib.hvd_membership_fence_count.restype = ctypes.c_int
+    lib.hvd_blacklist_configure.restype = None
+    lib.hvd_blacklist_configure.argtypes = [ctypes.c_double, ctypes.c_double]
+    lib.hvd_blacklist_record.restype = ctypes.c_double
+    lib.hvd_blacklist_record.argtypes = [ctypes.c_char_p, ctypes.c_double]
+    lib.hvd_blacklist_weight.restype = ctypes.c_double
+    lib.hvd_blacklist_weight.argtypes = [ctypes.c_char_p, ctypes.c_double]
+    lib.hvd_blacklist_check.restype = ctypes.c_int
+    lib.hvd_blacklist_check.argtypes = [ctypes.c_char_p, ctypes.c_double]
+    lib.hvd_blacklist_count.restype = ctypes.c_int
+    lib.hvd_blacklist_count.argtypes = [ctypes.c_double]
+    lib.hvd_blacklist_clear.restype = None
+    # Topology staleness hooks (ABI v12): keyless model injection + the
+    # auto-resolution verdict, the test surface pinning ResolveAlgoAuto's
+    # refuse-stale-hostkey rule.
+    lib.hvd_topology_inject.restype = ctypes.c_int
+    lib.hvd_topology_inject.argtypes = [ctypes.c_char_p]
+    lib.hvd_algo_resolve_auto.restype = ctypes.c_int
+    lib.hvd_algo_resolve_auto.argtypes = [ctypes.c_int64, ctypes.c_int,
+                                          ctypes.c_int]
     return lib
+
+# Membership change reasons (native/include/hvd/membership.h
+# MembershipChangeReason — stable ints, part of the ABI surface).
+MEMBER_RESET = 0
+MEMBER_JOIN = 1
+MEMBER_DEAD_PEER = 2
+MEMBER_SHRINK = 3
 
 
 _lib: Optional[ctypes.CDLL] = None

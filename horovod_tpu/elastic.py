@@ -23,6 +23,7 @@ step window's view of membership can be.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Any, Callable, Dict, Optional
 
 import cloudpickle
@@ -35,6 +36,54 @@ from horovod_tpu.common.topology import Topology
 from horovod_tpu.functions import broadcast_object
 
 ASSIGN_SCOPE = "elastic"
+
+# KV-unreachable fallbacks (docs/observability.md
+# elastic_kv_fallbacks_total): every failed watcher poll and every
+# stale-forced direct read at a check boundary ticks this counter, so
+# an outage the (re-armed) log warning only reports once is still
+# visible — and sized — on a scrape. Python-side because the driver KV
+# is a Python-plane dependency; exported as a fragment next to the
+# native registry.
+_kv_fallback_lock = threading.Lock()
+_kv_fallbacks = 0
+
+
+def _count_kv_fallback() -> None:
+    global _kv_fallbacks
+    with _kv_fallback_lock:
+        _kv_fallbacks += 1
+
+
+def kv_fallbacks_total() -> int:
+    """Cumulative count of launcher-KV-unreachable fallbacks (failed
+    watcher polls + stale-forced direct epoch reads)."""
+    with _kv_fallback_lock:
+        return _kv_fallbacks
+
+
+def _render_kv_fallbacks() -> str:
+    n = kv_fallbacks_total()
+    name = "hvd_elastic_kv_fallbacks_total"
+    return f"# TYPE {name} counter\n{name} {n}\n"
+
+
+def _membership_external_epoch() -> int:
+    """The driver-epoch component of the native membership plane
+    (``hvd.membership().external_epoch``)."""
+    from horovod_tpu.common.basics import get_lib
+    return int(get_lib().hvd_membership_epoch()) >> 20
+
+
+def _publish_membership_epoch(epoch: int) -> None:
+    """Forward-only convergence of the KV-published driver epoch into
+    the native membership plane: the watcher and ``hvd.membership()``
+    report one number. Reset is only issued when the external component
+    actually advances — re-publishing the current epoch would burn a
+    generation via the plane's monotone clamp."""
+    from horovod_tpu.common.basics import get_lib
+    lib = get_lib()
+    if epoch > (int(lib.hvd_membership_epoch()) >> 20):
+        lib.hvd_membership_reset(epoch, lib.hvd_membership_size())
 
 
 def _rdv() -> Optional[str]:
@@ -65,7 +114,6 @@ class _EpochWatcher:
     must not leave workers silently training on stale membership."""
 
     def __init__(self, initial_epoch: int):
-        import threading
         import time
         self._lock = threading.Lock()
         self._latest = initial_epoch
@@ -77,37 +125,61 @@ class _EpochWatcher:
         self._interval = max(0.05, iv)
         self._last_ok = time.monotonic()
         self._stop = threading.Event()
+        # The fallback counter is scrape-visible next to the native
+        # registry the moment a watcher exists.
+        from horovod_tpu.metrics import register_exporter
+        register_exporter("elastic_kv", _render_kv_fallbacks)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="hvd-epoch-watcher")
         self._thread.start()
 
     def _run(self):
+        import logging
         import time
+        log = logging.getLogger("horovod_tpu")
         warned = False
         while not self._stop.wait(self._interval):
             try:
                 e = current_epoch()
             except Exception:
+                _count_kv_fallback()
                 if not warned and self.stale():
                     warned = True
-                    import logging
-                    logging.getLogger("horovod_tpu").warning(
+                    log.warning(
                         "elastic epoch watcher: launcher KV unreachable; "
-                        "membership checks fall back to direct reads")
+                        "membership checks fall back to direct reads "
+                        "(elastic_kv_fallbacks_total=%d)",
+                        kv_fallbacks_total())
                 continue
-            warned = False
+            if warned:
+                # Re-arm: log the recovery so the outage has a visible
+                # end, and let the NEXT outage warn again instead of
+                # staying silent for the life of the process.
+                warned = False
+                log.info(
+                    "elastic epoch watcher: launcher KV reachable again; "
+                    "mirrored epoch reads resumed")
             self._last_ok = time.monotonic()
             self.observe(e)
 
     def observe(self, epoch: int) -> None:
-        """Advance the mirrored epoch (forward-only)."""
+        """Advance the mirrored epoch (forward-only) and converge it
+        into the native membership plane, so ``hvd.membership()``'s
+        external component and the watcher report one number."""
         with self._lock:
             if epoch > self._latest:
                 self._latest = epoch
+            latest = self._latest
+        _publish_membership_epoch(latest)
 
     def latest(self) -> int:
+        """Newest driver epoch this process has seen — the mirrored KV
+        value or the membership plane's external component, whichever
+        is ahead (re-init via HOROVOD_ELASTIC_EPOCH lands in the plane
+        first)."""
         with self._lock:
-            return self._latest
+            mine = self._latest
+        return max(mine, _membership_external_epoch())
 
     def stale(self) -> bool:
         """True when polling has failed for several intervals — the
@@ -164,7 +236,10 @@ class State:
         else:
             # No watcher, or its polls keep failing: read directly so
             # a dead KV store fails LOUDLY at the check boundary
-            # instead of silently freezing membership.
+            # instead of silently freezing membership. A stale-forced
+            # direct read is a fallback event — count it.
+            if w is not None:
+                _count_kv_fallback()
             epoch = current_epoch()
             if w is not None:
                 w.observe(epoch)
@@ -264,16 +339,85 @@ def _rendezvous_new_topology(timeout: float,
                     cross_rank=slot.cross_rank, cross_size=slot.cross_size)
 
 
+def _init_with_retry(min_epoch: int = 0) -> None:
+    """Rendezvous at the newest driver epoch and init, retrying
+    in-process when an attempt fails.
+
+    Membership can churn again while a process is between worlds (a
+    second failure, a grow and a kill landing together): the address it
+    rendezvoused against is then already dead, and a single-shot init
+    would hang its full connect timeout there, exit nonzero, and record
+    a host flap in the decay blacklist for what is really rendezvous
+    churn — enough cascading casualties and the blacklist excludes a
+    perfectly healthy host and starves the job. Re-reading the
+    assignment table per attempt makes (re-)joining follow the
+    membership plane instead of racing it; a worker that still cannot
+    join after the retry budget dies nonzero, and THAT flap is
+    deserved.
+
+    Each attempt's native connect wait is bounded to a slice of the
+    start timeout (an explicit ``HOROVOD_CONTROLLER_TIMEOUT_MS`` wins)
+    so a roll mid-connect costs one slice, not the whole budget.
+    """
+    timeout = float(os.environ.get("HOROVOD_START_TIMEOUT", "120"))
+    attempts = max(1, int(os.environ.get(
+        "HOROVOD_ELASTIC_INIT_ATTEMPTS", "3")))
+    pinned_ms = os.environ.get("HOROVOD_CONTROLLER_TIMEOUT_MS")
+    attempt_ms = pinned_ms or str(int(
+        max(15.0, timeout / attempts) * 1000))
+    last_err: Optional[BaseException] = None
+    for attempt in range(attempts):
+        try:
+            # WorkerExcludedError passes through: clean exit 0, our
+            # slot shrank away while we were between worlds.
+            topo = _rendezvous_new_topology(timeout, min_epoch)
+        except Exception:
+            if last_err is not None:
+                raise last_err
+            raise
+        os.environ["HOROVOD_CONTROLLER_TIMEOUT_MS"] = attempt_ms
+        try:
+            api.init(topo)
+            return
+        except HorovodInternalError as e:
+            last_err = e
+            try:
+                api.shutdown()
+            except Exception:
+                pass
+            # The failed attempt usually means the epoch rolled under
+            # us; ask the next rendezvous to wait (bounded, inside
+            # _rendezvous_new_topology) for a NEWER epoch so it reads
+            # the fresh table instead of re-dialing the same dead
+            # address. A transient same-epoch failure falls through
+            # after the bounded wait — same-epoch re-init is then
+            # correct for every process.
+            min_epoch = max(min_epoch, current_epoch() + 1)
+        finally:
+            if pinned_ms is None:
+                os.environ.pop("HOROVOD_CONTROLLER_TIMEOUT_MS", None)
+            else:
+                os.environ["HOROVOD_CONTROLLER_TIMEOUT_MS"] = pinned_ms
+            os.environ.pop("HOROVOD_CONTROLLER_ADDR", None)
+    raise last_err
+
+
 def _reset(min_epoch: int = 0) -> None:
     """Shutdown + re-rendezvous with the new membership (reference
     ``common/elastic.py`` ``reset()``: shutdown, re-init)."""
     api.shutdown()
-    timeout = float(os.environ.get("HOROVOD_START_TIMEOUT", "120"))
-    topo = _rendezvous_new_topology(timeout, min_epoch)
-    try:
-        api.init(topo)
-    finally:
-        os.environ.pop("HOROVOD_CONTROLLER_ADDR", None)
+    _init_with_retry(min_epoch)
+
+
+def initial_init(runtime) -> None:
+    """First init of a driver-spawned elastic worker: the spawn env
+    pins the epoch the driver saw when it forked this process, which
+    may be stale by the time the interpreter is up — rendezvous at the
+    newest epoch instead, with the same bounded retry the in-process
+    reset path uses (``runtime.init`` re-enters with an explicit
+    topology, so this never recurses)."""
+    del runtime  # the singleton api.init path is the re-entry point
+    _init_with_retry()
 
 
 def run(func: Callable) -> Callable:

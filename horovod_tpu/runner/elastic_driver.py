@@ -24,6 +24,7 @@ reused), stable across epochs even as ranks and local ranks change.
 from __future__ import annotations
 
 import dataclasses
+import os
 import subprocess
 import threading
 import time
@@ -173,7 +174,15 @@ class ElasticDriver:
         self._next_seq: Dict[str, int] = {}
         self._workers: Dict[str, WorkerRecord] = {}
         self._completed: set = set()     # identities that exited 0
-        self._blacklist: set = set()
+        # Flap accounting lives in the native membership plane's decay
+        # blacklist (docs/elastic.md): every unexpected failure records
+        # a flap whose weight halves each HOROVOD_ELASTIC_BLACKLIST_
+        # HALF_LIFE_SECONDS, and a host is excluded only while its
+        # decayed weight sits at or above the threshold — a host that
+        # flapped last week is not banned forever like the old
+        # permanent set. max_worker_failures maps onto the threshold
+        # (same default, 3) unless the env knob overrides it.
+        self._native = self._configure_blacklist(max_worker_failures)
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -235,10 +244,44 @@ class ElasticDriver:
 
     # -- internals --------------------------------------------------------
 
+    @staticmethod
+    def _configure_blacklist(max_worker_failures: int):
+        """Bind the native decay blacklist and map the driver's
+        ``max_worker_failures`` onto its threshold. Env knobs win when
+        set (the native plane already parsed them at load; re-passing
+        keeps the explicit-argument and env paths one code path)."""
+        from horovod_tpu.common.basics import get_lib
+        lib = get_lib()
+
+        def _env_float(name: str, dflt: float) -> float:
+            try:
+                return float(os.environ.get(name, dflt))
+            except ValueError:
+                return dflt
+
+        lib.hvd_blacklist_configure(
+            _env_float("HOROVOD_ELASTIC_BLACKLIST_THRESHOLD",
+                       float(max_worker_failures)),
+            _env_float("HOROVOD_ELASTIC_BLACKLIST_HALF_LIFE_SECONDS", 300.0))
+        # A new driver is a new job: flap history from a previous
+        # launch in this process (the native plane is process-global)
+        # must not pre-poison this job's hosts — the reference's
+        # blacklist lives on the driver object for the same reason.
+        lib.hvd_blacklist_clear()
+        return lib
+
+    def _host_blacklisted(self, host: str) -> bool:
+        return bool(self._native.hvd_blacklist_check(
+            host.encode(), time.monotonic()))
+
+    def _record_host_failure(self, host: str) -> None:
+        self._native.hvd_blacklist_record(
+            host.encode(), time.monotonic())
+
     def _current_hosts(self) -> Dict[str, int]:
         found = self._discovery.find_available_hosts_and_slots()
         return {h: s for h, s in found.items()
-                if h not in self._blacklist and s > 0}
+                if not self._host_blacklisted(h) and s > 0}
 
     def _publish(self, table: Dict[str, hosts_mod.SlotInfo],
                  controller_addr: str) -> None:
@@ -329,8 +372,11 @@ class ElasticDriver:
                     self._completed.add(ident)
                     continue
                 rec.failures += 1
-                if rec.failures >= self._max_failures:
-                    self._blacklist.add(rec.hostname)
+                # Decay blacklist: every unexpected failure is a flap;
+                # exclusion happens when the host's decayed weight
+                # crosses the threshold (expected_exit terminations
+                # above never reach here, so scale-downs stay clean).
+                self._record_host_failure(rec.hostname)
                 respawn = True
         return respawn
 

@@ -104,6 +104,18 @@ class Runtime:
     def init(self, topology: Optional[Topology] = None) -> None:
         if self.initialized():
             return
+        if (topology is None and os.environ.get("HOROVOD_ELASTIC_ID")
+                and os.environ.get("HOROVOD_RENDEZVOUS_ADDR")):
+            # Driver-spawned elastic worker: the spawn env's epoch (and
+            # its controller address) may already be stale if membership
+            # churned while this interpreter came up. Rendezvous at the
+            # newest driver epoch with in-process retries instead of
+            # dying a nonzero death the driver would count as a host
+            # flap (elastic.initial_init re-enters here with an
+            # explicit topology).
+            from horovod_tpu import elastic
+            elastic.initial_init(self)
+            return
         self.lib = basics.get_lib()
         topo = topology or topology_from_env()
         discovered = False

@@ -116,6 +116,24 @@ def _codec_id(name) -> int:
     return span_codec_id(name)
 
 
+def _advance_membership(reason: int) -> None:
+    """Tick the process-global membership plane (docs/elastic.md): the
+    serving fleet's replica churn rides the same epoch
+    ``hvd.membership()`` reports for training, so one monotone number
+    fences both planes. Safe from any thread — the plane's fences gate
+    background-owned state internally."""
+    from horovod_tpu.common import basics
+    basics.get_lib().hvd_membership_advance(reason, -1)
+
+
+def _record_flap(identity: str) -> None:
+    """Record a replica death in the decay blacklist under its fleet
+    identity (same flap model the elastic driver uses for hosts)."""
+    from horovod_tpu.common import basics
+    basics.get_lib().hvd_blacklist_record(
+        identity.encode(), time.monotonic())
+
+
 class FleetSaturated(QueueFull):
     """Router-level shed: the fleet queue is full and nothing queued
     is lower-class than the arrival. Carries ``reason`` /
@@ -517,6 +535,8 @@ class ServeRouter:
         rep = _Replica(instance=inst, role=role, engine=eng,
                        model=model, remote=worker is not None)
         self._replicas.append(rep)
+        from horovod_tpu.common import basics
+        _advance_membership(basics.MEMBER_JOIN)
         return rep
 
     def add_model(self, model: str, model_cfg, params=None,
@@ -703,6 +723,9 @@ class ServeRouter:
         if rep not in self._replicas:
             return
         self._replicas.remove(rep)
+        from horovod_tpu.common import basics
+        _advance_membership(basics.MEMBER_DEAD_PEER)
+        _record_flap(f"replica:{self.metrics.fleet}.{rep.instance}")
         getattr(rep.engine, "mark_dead", lambda: None)()
         requeue = [rid for rid in rep.outstanding.values()
                    if rid in self._requests]
@@ -733,6 +756,16 @@ class ServeRouter:
     @property
     def replicas(self) -> List[str]:
         return [r.instance for r in self._replicas]
+
+    @property
+    def membership_epoch(self) -> int:
+        """The process-global membership epoch after this fleet's
+        churn (``hvd.membership().epoch``): joins, drains-to-reap, and
+        worker deaths each tick it, alongside any training-plane
+        changes in the same process. Monotone — the chaos harness
+        asserts exactly that."""
+        from horovod_tpu.common import basics
+        return int(basics.get_lib().hvd_membership_epoch())
 
     @property
     def engines(self) -> List[ServeEngine]:
@@ -1256,6 +1289,8 @@ class ServeRouter:
             # shut it down (the drain owns the worker's lifecycle).
             self.metrics.absorb(r.engine.metrics, r.model)
             self._replicas.remove(r)
+            from horovod_tpu.common import basics
+            _advance_membership(basics.MEMBER_SHRINK)
             if r.remote:
                 r.engine.shutdown()
 

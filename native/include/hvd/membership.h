@@ -1,0 +1,156 @@
+// Membership plane: the one model of "who is in the job right now"
+// (ISSUE 16).
+//
+// Elastic mode is restart-based (runner/elastic_driver.py): the driver
+// owns an EXTERNAL epoch that bumps on every host-set change and
+// reaches each worker as HOROVOD_ELASTIC_EPOCH at re-init. Inside one
+// incarnation the world can still change — ranks enter join() (shrink
+// by intent), a peer SIGKILLs (dead data link), the driver directs an
+// explicit scale-down. Before this plane each consumer observed those
+// events through its own side channel (the steady lock's unlock
+// reasons, the response cache's signature net, the epoch watcher's KV
+// polls) and nothing tied them to ONE monotone number.
+//
+// This module is that number. The membership epoch is
+//
+//     epoch = external_epoch << kGenerationBits | generation
+//
+// — the driver's restart counter in the high bits, an in-incarnation
+// generation in the low bits. Reset() installs a new external epoch
+// (generation 0); Advance() bumps the generation on in-job changes.
+// Monotone by construction: the driver's epoch strictly increases and
+// a generation never survives a Reset. Every Advance is driven by a
+// broadcast-observed event (the JOIN flush response, a dead control
+// link), so surviving ranks compute IDENTICAL epochs without any new
+// wire traffic — the same discipline that makes the coordinator's
+// response ordering safe for XLA.
+//
+// Consumers register epoch FENCES: callbacks invoked (in registration
+// order, serialized) after every membership change. A fence must be
+// thread-safe — Advance runs on whichever thread observed the change
+// (the background coordination loop for JOIN/dead-peer, an API or
+// serving thread for explicit advances) — and must not call back into
+// the plane. operations.cc registers the stateful consumers at init:
+// topology-model invalidation (a lost peer voids the measured
+// verdicts; re-probe or hand bands per ResolveAlgoAuto's key check)
+// and the response-cache purge on dead peers.
+//
+// The plane also owns the per-host FLAP history: an exponentially
+// decaying failure weight per hostname (half-life decay, threshold
+// blacklisting) replacing the driver's old permanent blacklist set. A
+// crash-looping host crosses the threshold and stops churning the
+// ring; a host that failed once long ago decays back to eligible.
+// Knobs (sane-env, docs/elastic.md):
+//   HOROVOD_ELASTIC_BLACKLIST_THRESHOLD          decayed-failure count
+//                                                that blacklists (3.0)
+//   HOROVOD_ELASTIC_BLACKLIST_HALF_LIFE_SECONDS  decay half-life (300)
+//   HOROVOD_ELASTIC_BLACKLIST_DISABLE            presence disables
+// All clock inputs are caller-supplied seconds (CLOCK_MONOTONIC base:
+// Python's time.monotonic() and steady_clock agree on Linux), so the
+// decay model is deterministic under test-supplied timestamps.
+//
+// The plane is a process-global leaked singleton (MetricsRegistry
+// discipline) usable BEFORE hvd_init: the elastic driver and the
+// serving router ride the same accessor (hvd.membership()) from
+// processes that never initialize the collective core.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <functional>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <utility>
+#include <vector>
+
+namespace hvd {
+
+// Change reasons (stable ints: the C ABI surface and fence argument).
+enum MembershipChangeReason : int {
+  kMemberReset = 0,     // Reset(): a new external epoch installed
+  kMemberJoin = 1,      // everyone-joined flush committed (by intent)
+  kMemberDeadPeer = 2,  // a peer's control/data link died
+  kMemberShrink = 3,    // explicit scale-down (driver/router directed)
+};
+
+class MembershipPlane {
+ public:
+  static MembershipPlane& Get();
+
+  static constexpr int kGenerationBits = 20;
+
+  // Install a new incarnation: external epoch, full rank set, zero
+  // generation. Runs fences with kMemberReset. Out-of-order externals
+  // are clamped monotone (a stale re-init can never rewind the epoch).
+  void Reset(int64_t external_epoch, int size);
+
+  // One in-incarnation membership change. `rank` >= 0 marks that rank
+  // inactive (join/dead/shrink); rank < 0 with kMemberJoin is the
+  // everyone-joined flush (all ranks return to active). Returns the
+  // new epoch. Runs fences.
+  int64_t Advance(int reason, int rank);
+
+  int64_t epoch() const {
+    return epoch_.load(std::memory_order_relaxed);
+  }
+  int64_t generation() const {
+    return epoch() & ((int64_t(1) << kGenerationBits) - 1);
+  }
+  int64_t external_epoch() const { return epoch() >> kGenerationBits; }
+  int size() const;
+  std::vector<int> active_ranks() const;
+
+  // Fences: invoked after every Reset/Advance, registration order,
+  // serialized under the advance lock. Returns a token for removal.
+  using Fence = std::function<void(int reason, int64_t epoch)>;
+  int RegisterFence(const std::string& name, Fence fn);
+  void UnregisterFence(int token);
+  int fence_count() const;
+
+  // ---- per-host flap history (exponential-decay blacklist) ----
+  // Override the env-seeded parameters (the Python driver maps its
+  // max_worker_failures onto the threshold). half_life_s <= 0 keeps
+  // the current value.
+  void BlacklistConfigure(double threshold, double half_life_s);
+  // Record one failure at now_s: decay the stored weight to now, add
+  // 1, return the new weight.
+  double BlacklistRecord(const std::string& host, double now_s);
+  double BlacklistWeight(const std::string& host, double now_s) const;
+  bool Blacklisted(const std::string& host, double now_s) const;
+  int BlacklistedCount(double now_s) const;
+  void BlacklistClear();
+
+ private:
+  MembershipPlane();
+
+  struct FenceEntry {
+    int token;
+    std::string name;
+    Fence fn;
+  };
+  struct Flap {
+    double weight = 0.0;
+    double stamp_s = 0.0;
+  };
+  double DecayedWeight(const Flap& f, double now_s) const;
+
+  // Serializes Reset/Advance AND the fence invocations so concurrent
+  // changes observe fences in epoch order. Fences run under this lock
+  // — they must not call back into the plane.
+  mutable std::mutex advance_mu_;
+  // Guards the state the accessors read (active set, fences, flaps).
+  // epoch_ is additionally an atomic so the metrics gauge and the hot
+  // Python accessor never take a lock.
+  mutable std::mutex mu_;
+  std::atomic<int64_t> epoch_{0};
+  std::vector<bool> active_;  // by rank; true = in the contributor set
+  std::vector<FenceEntry> fences_;
+  int next_token_ = 1;
+  std::unordered_map<std::string, Flap> flaps_;
+  double blacklist_threshold_;
+  double blacklist_half_life_s_;
+  bool blacklist_disabled_;
+};
+
+}  // namespace hvd

@@ -21,6 +21,14 @@ namespace hvd {
 // here so a bump is one edit — and guarded by tests/test_wire_abi.py,
 // which asserts the Python side expects the same numbers (a native
 // bump can't silently skew the shim).
+// ABI v12 (wire formats unchanged): membership plane
+// (hvd/membership.h) — hvd_membership_epoch / _generation / _size /
+// _ranks / _advance / _reset / _fence_count, the decay-blacklist
+// surface (hvd_blacklist_configure / _record / _weight / _check /
+// _count / _clear), and the topology staleness hooks
+// (hvd_topology_inject, hvd_algo_resolve_auto); metrics v7 adds
+// membership_changes_total plus the membership_epoch and
+// hosts_blacklisted gauges.
 // ResponseList v7: carries the steady-state lock engagement (the
 // lock_engage flag + the locked response ring, hvd/steady_lock.h) the
 // coordinator broadcasts when K consecutive pure-cache-hit cycles
@@ -46,7 +54,7 @@ namespace hvd {
 // hvd_stalled_tensors, and hvd_start_timeline returning an error code.
 constexpr int kWireVersionRequestList = 3;
 constexpr int kWireVersionResponseList = 7;
-constexpr int kAbiVersion = 11;
+constexpr int kAbiVersion = 12;
 
 enum class RequestType : uint8_t {
   ALLREDUCE = 0,

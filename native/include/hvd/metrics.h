@@ -32,6 +32,9 @@ namespace hvd {
 // Snapshot layout version (bump on any enum/table/layout change) and
 // bucket count. Pinned by horovod_tpu/common/basics.py +
 // tests/test_metrics_abi.py.
+// v7: membership plane (hvd/membership.h) — membership_changes_total
+// plus the membership_epoch (driver epoch << 20 | generation) and
+// hosts_blacklisted (decayed flap weights over threshold) gauges.
 // v6: steady-state schedule lock (hvd/steady_lock.h) —
 // ctrl_locks_total / ctrl_bypassed_responses_total / per-reason
 // ctrl_unlocks_* counters, the cycles_idle_total event-driven-loop
@@ -49,7 +52,7 @@ namespace hvd {
 // tcp_zerocopy_mode gauge (resolved transport mode).
 // v2: per-algorithm TCP allreduce counters (tcp_algo_*_ops_total) and
 // the hd/striped schedule-interpreter phase histograms.
-constexpr int kMetricsVersion = 6;
+constexpr int kMetricsVersion = 7;
 constexpr int kMetricsHistBuckets = 28;  // le = 2^0 .. 2^26, then +Inf
 
 // Monotonic counters (suffix _total) and point-in-time gauges (filled
@@ -127,6 +130,9 @@ enum MetricCounter : int {
   kCtrUnlocksPeer,            //     peer proposal / dead data link,
   kCtrUnlocksTunables,        //     staged autotune tunables,
   kCtrUnlocksPartial,         //     half-fed slot past the timeout
+  // Membership plane (hvd/membership.h): every Reset/Advance — the
+  // observable face of join/dead-peer/shrink churn.
+  kCtrMembershipChanges,
   // ---- gauges (point-in-time, filled by hvd_metrics_snapshot) ----
   kGaugePendingTensors,       // tensors currently in flight
   kGaugeStalledTensors,       // tensors past the stall warning age
@@ -139,6 +145,8 @@ enum MetricCounter : int {
                               // 0 = per-window syscalls, 1 = io_uring)
   kGaugeWorkerAffinity,       // WorkerPool threads currently CPU-pinned
   kGaugeCtrlLocked,           // 1 while the steady-state lock is engaged
+  kGaugeMembershipEpoch,      // driver epoch << 20 | in-job generation
+  kGaugeHostsBlacklisted,     // hosts with decayed flap weight >= threshold
   kNumMetricCounters
 };
 

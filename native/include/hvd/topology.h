@@ -45,6 +45,14 @@ struct TopologyModel {
   int np = 0;                    // 0 = no model
   std::vector<double> alpha_us;  // np*np, [src*np + dst]; 0 on the diag
   std::vector<double> beta_us_per_byte;  // np*np, same layout
+  // Job-shape identity the model was measured under ("host|npN|lsM",
+  // TopologyHostKey format; rank 0's key on a broadcast blob). The
+  // cache layer gates loads on the FULL key; selection
+  // (Controller::ResolveAlgoAuto) re-checks the np/ls components
+  // against the LIVE world so a model that survived a membership
+  // change (elastic restart, Join-shrink) can never serve stale
+  // measured verdicts — the hand bands take over until a re-probe.
+  std::string hostkey;
   bool valid() const {
     return np > 1 &&
            alpha_us.size() == static_cast<size_t>(np) * np &&
@@ -62,6 +70,14 @@ TopologyModel ParseTopology(const std::string& blob,
 
 // Cache identity for this job shape: hostname + np + local_size.
 std::string TopologyHostKey(int np, int local_size);
+// Do the np/ls components of a stored hostkey match the live world?
+// The hostname component is deliberately NOT compared here: it cannot
+// change within a process (the cache layer already gates on it), and
+// a broadcast blob carries rank 0's hostname, which legitimately
+// differs on workers of a multi-host job. An empty key never matches
+// (a model without provenance must not serve measured verdicts).
+bool TopologyKeyMatchesWorld(const std::string& hostkey, int np,
+                             int local_size);
 // Cache file path (HOROVOD_TOPOLOGY_CACHE_DIR, default /tmp).
 std::string TopologyCachePath(const std::string& hostkey);
 // Load iff the file exists, parses, and its hostkey matches.

@@ -10,6 +10,7 @@
 
 #include "hvd/env.h"
 #include "hvd/logging.h"
+#include "hvd/membership.h"
 #include "hvd/metrics.h"
 #include "hvd/schedule.h"
 
@@ -294,9 +295,15 @@ int Controller::ResolveAlgoAuto(int64_t payload_bytes, int ncontributors,
   // model's positions are world ranks, so a Join-shrunk contributor
   // set rides the hand bands); the bands remain the fallback and the
   // HOROVOD_TOPOLOGY_PROBE=off behavior. Model doubles are broadcast-
-  // identical, so every rank computes the same argmin.
+  // identical, so every rank computes the same argmin. The model's
+  // stored hostkey must also still describe the LIVE world: a model
+  // that outlived a membership change (the np/ls it was probed under
+  // no longer match) is stale provenance, and serving its verdicts
+  // would price schedules for a world that no longer exists — refuse
+  // and ride the bands until a re-probe stamps a fresh key.
   auto m = topology_model();
-  if (m != nullptr && ncontributors == size_ && m->np == size_) {
+  if (m != nullptr && ncontributors == size_ && m->np == size_ &&
+      TopologyKeyMatchesWorld(m->hostkey, size_, local_size_)) {
     const int algo = ResolveAlgoMeasured(
         payload_bytes, ncontributors, hier_ok, ring_threshold_bytes_, *m,
         collective_stripes_, collective_granularity_, hd_order_);
@@ -961,6 +968,11 @@ ResponseList TcpController::CoordinatorCycle(RequestList my_list,
         !RequestList::ParseFrom(buf, &lists[r])) {
       LOG_ERROR << "coordinator lost connection to rank " << r
                 << "; shutting down";
+      // Dead peer: one membership advance before the shutdown verdict
+      // broadcasts — the fences purge cycle-lockstep state (cache,
+      // staged tunables, topology model) on this thread, and the
+      // elastic driver's restart installs the next external epoch.
+      MembershipPlane::Get().Advance(kMemberDeadPeer, r);
       ResponseList out;
       out.shutdown = true;
       Broadcast(out);
@@ -1056,6 +1068,10 @@ ResponseList TcpController::WorkerCycle(RequestList my_list) {
   if (!ctrl_conns_[0].SendFrame(buf) || !ctrl_conns_[0].RecvFrame(&buf) ||
       !ResponseList::ParseFrom(buf, &out)) {
     LOG_ERROR << "worker lost connection to coordinator; shutting down";
+    // The coordinator (or the link to it) died: advance once with the
+    // peer unknown (-1). Survivors of the same death each advance
+    // exactly once, so their epochs stay identical.
+    MembershipPlane::Get().Advance(kMemberDeadPeer, -1);
     out.responses.clear();
     out.shutdown = true;
     return out;

@@ -1,0 +1,174 @@
+// Membership plane implementation (hvd/membership.h).
+
+#include "hvd/membership.h"
+
+#include <algorithm>
+#include <cmath>
+
+#include "hvd/env.h"
+#include "hvd/logging.h"
+#include "hvd/metrics.h"
+
+namespace hvd {
+
+MembershipPlane& MembershipPlane::Get() {
+  // Leaked singleton (MetricsRegistry discipline): fences registered
+  // by one subsystem must survive any other's teardown order, and the
+  // serving router reads the plane from atexit paths.
+  static MembershipPlane* g = new MembershipPlane();
+  return *g;
+}
+
+MembershipPlane::MembershipPlane() {
+  // Parsed here, not in hvd_init: the elastic driver and the router
+  // consult the flap history from processes that never init the core.
+  blacklist_threshold_ =
+      EnvDoubleSane("HOROVOD_ELASTIC_BLACKLIST_THRESHOLD", 3.0);
+  blacklist_half_life_s_ = EnvDoubleSane(
+      "HOROVOD_ELASTIC_BLACKLIST_HALF_LIFE_SECONDS", 300.0);
+  blacklist_disabled_ = EnvFlag("HOROVOD_ELASTIC_BLACKLIST_DISABLE");
+}
+
+void MembershipPlane::Reset(int64_t external_epoch, int size) {
+  std::lock_guard<std::mutex> advance(advance_mu_);
+  std::vector<FenceEntry> fences;
+  int64_t epoch;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    if (external_epoch < 0) external_epoch = 0;
+    epoch = external_epoch << kGenerationBits;
+    // Monotone even against a stale/replayed re-init: a driver epoch
+    // at or below the current one keeps the high bits and bumps the
+    // generation instead, so no observer ever sees the number rewind.
+    if (epoch <= epoch_.load(std::memory_order_relaxed))
+      epoch = epoch_.load(std::memory_order_relaxed) + 1;
+    epoch_.store(epoch, std::memory_order_relaxed);
+    active_.assign(size < 0 ? 0 : size, true);
+    fences = fences_;
+  }
+  MetricAdd(kCtrMembershipChanges);
+  for (auto& f : fences) f.fn(kMemberReset, epoch);
+}
+
+int64_t MembershipPlane::Advance(int reason, int rank) {
+  std::lock_guard<std::mutex> advance(advance_mu_);
+  std::vector<FenceEntry> fences;
+  int64_t epoch;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    epoch = epoch_.load(std::memory_order_relaxed) + 1;
+    epoch_.store(epoch, std::memory_order_relaxed);
+    if (rank >= 0) {
+      if (rank >= static_cast<int>(active_.size()))
+        active_.resize(rank + 1, true);
+      active_[rank] = false;
+    } else if (reason == kMemberJoin) {
+      // Everyone-joined flush: the full rank set returns to active
+      // (mirrors the coordinator's joined_ranks_ reset).
+      std::fill(active_.begin(), active_.end(), true);
+    }
+    fences = fences_;
+  }
+  MetricAdd(kCtrMembershipChanges);
+  for (auto& f : fences) f.fn(reason, epoch);
+  return epoch;
+}
+
+int MembershipPlane::size() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return static_cast<int>(active_.size());
+}
+
+std::vector<int> MembershipPlane::active_ranks() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  std::vector<int> out;
+  for (size_t r = 0; r < active_.size(); ++r)
+    if (active_[r]) out.push_back(static_cast<int>(r));
+  return out;
+}
+
+int MembershipPlane::RegisterFence(const std::string& name, Fence fn) {
+  std::lock_guard<std::mutex> lock(mu_);
+  FenceEntry e;
+  e.token = next_token_++;
+  e.name = name;
+  e.fn = std::move(fn);
+  fences_.push_back(std::move(e));
+  return fences_.back().token;
+}
+
+void MembershipPlane::UnregisterFence(int token) {
+  std::lock_guard<std::mutex> lock(mu_);
+  fences_.erase(std::remove_if(fences_.begin(), fences_.end(),
+                               [token](const FenceEntry& e) {
+                                 return e.token == token;
+                               }),
+                fences_.end());
+}
+
+int MembershipPlane::fence_count() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return static_cast<int>(fences_.size());
+}
+
+double MembershipPlane::DecayedWeight(const Flap& f, double now_s) const {
+  const double dt = now_s - f.stamp_s;
+  if (dt <= 0) return f.weight;
+  return f.weight * std::exp2(-dt / blacklist_half_life_s_);
+}
+
+void MembershipPlane::BlacklistConfigure(double threshold,
+                                         double half_life_s) {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (threshold > 0) blacklist_threshold_ = threshold;
+  if (half_life_s > 0) blacklist_half_life_s_ = half_life_s;
+}
+
+double MembershipPlane::BlacklistRecord(const std::string& host,
+                                        double now_s) {
+  std::lock_guard<std::mutex> lock(mu_);
+  Flap& f = flaps_[host];
+  const double before = DecayedWeight(f, now_s);
+  f.weight = before + 1.0;
+  f.stamp_s = now_s;
+  // Warn on the below->above transition only: a host that keeps
+  // flapping while excluded would otherwise log once per flap (and a
+  // tight recording loop once per call).
+  if (!blacklist_disabled_ && f.weight >= blacklist_threshold_ &&
+      before < blacklist_threshold_)
+    LOG_WARNING << "host " << host << " blacklisted (flap weight "
+                << f.weight << " >= " << blacklist_threshold_ << ")";
+  return f.weight;
+}
+
+double MembershipPlane::BlacklistWeight(const std::string& host,
+                                        double now_s) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = flaps_.find(host);
+  return it == flaps_.end() ? 0.0 : DecayedWeight(it->second, now_s);
+}
+
+bool MembershipPlane::Blacklisted(const std::string& host,
+                                  double now_s) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (blacklist_disabled_) return false;
+  auto it = flaps_.find(host);
+  return it != flaps_.end() &&
+         DecayedWeight(it->second, now_s) >= blacklist_threshold_;
+}
+
+int MembershipPlane::BlacklistedCount(double now_s) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (blacklist_disabled_) return 0;
+  int n = 0;
+  for (const auto& kv : flaps_)
+    if (DecayedWeight(kv.second, now_s) >= blacklist_threshold_) ++n;
+  return n;
+}
+
+void MembershipPlane::BlacklistClear() {
+  std::lock_guard<std::mutex> lock(mu_);
+  flaps_.clear();
+}
+
+}  // namespace hvd

@@ -59,6 +59,7 @@ constexpr const char* kCounterNames[] = {
     "ctrl_unlocks_peer_total",
     "ctrl_unlocks_tunables_total",
     "ctrl_unlocks_partial_total",
+    "membership_changes_total",
     "pending_tensors",
     "stalled_tensors",
     "reduce_threads",
@@ -68,6 +69,8 @@ constexpr const char* kCounterNames[] = {
     "tcp_iouring_mode",
     "worker_affinity",
     "ctrl_locked",
+    "membership_epoch",
+    "hosts_blacklisted",
 };
 
 constexpr int kCounterKinds[] = {
@@ -76,10 +79,12 @@ constexpr int kCounterKinds[] = {
     0, 0,        // measured selects, topology probes
     0, 0, 0,     // idle cycles, lock engagements, bypassed responses
     0, 0, 0, 0, 0, 0, 0,  // unlocks: total + six reasons
+    0,           // membership changes
     1, 1, 1, 1,  // pending/stalled tensors, reduce_threads, zc mode
     1, 1,        // topology probe ms / links measured
     1, 1,        // iouring mode / worker affinity
     1,           // steady-lock engaged gauge
+    1, 1,        // membership epoch / hosts blacklisted
 };
 
 constexpr const char* kHistNames[] = {

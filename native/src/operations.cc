@@ -30,6 +30,7 @@
 #include "hvd/env.h"
 #include "hvd/fusion_buffer.h"
 #include "hvd/logging.h"
+#include "hvd/membership.h"
 #include "hvd/message.h"
 #include "hvd/metrics.h"
 #include "hvd/ops.h"
@@ -184,6 +185,11 @@ struct GlobalState {
   std::unique_ptr<Controller> controller;
   std::unique_ptr<OpExecutor> host_ops;
   std::thread background_thread;
+  // Set by BackgroundThreadLoop at entry (cleared at exit): lets
+  // membership fences tell whether they are running ON the
+  // coordination loop — the std::thread object itself must not be
+  // touched from other threads while init assigns it.
+  std::atomic<std::thread::id> background_thread_id{};
 
   double cycle_time_ms = 1.0;
   ExecCallback exec_cb = nullptr;
@@ -209,6 +215,11 @@ struct GlobalState {
   Mutex recvsplits_mu;
   std::unordered_map<int64_t, std::vector<int64_t>> recvsplits
       HVD_GUARDED_BY(recvsplits_mu);  // by handle
+
+  // Epoch fences this incarnation registered on the membership plane
+  // (hvd/membership.h) — unregistered at shutdown so an elastic
+  // re-init never stacks duplicates on the process-global singleton.
+  std::vector<int> membership_fence_tokens;
 };
 
 GlobalState& State() {
@@ -319,6 +330,14 @@ void PerformOperation(GlobalState& st, const Response& response) {
     Status err = Status::PreconditionError(response.error_message);
     for (auto& e : entries) CompleteEntry(st, e, err);
     return;
+  }
+  if (response.response_type == ResponseType::JOIN) {
+    // Everyone-joined flush committed. The JOIN response is broadcast-
+    // ordered AFTER the flushed tensors in the same list, so every
+    // rank advances the membership epoch at the identical point in the
+    // response stream — no op straddles two epochs, and all ranks
+    // compute the same new epoch without extra wire traffic.
+    MembershipPlane::Get().Advance(kMemberJoin, -1);
   }
   if (entries.empty()) {
     // Joined rank: no local tensors. HOST mode: nothing to do — the
@@ -511,6 +530,11 @@ bool RunLockedIteration(GlobalState& st,
 }
 
 void BackgroundThreadLoop(GlobalState& st) {
+  // Publish this loop's identity for the membership fences: purges of
+  // cycle-lockstep state (response cache, staged tunables) only act
+  // when the advance itself ran on this thread.
+  st.background_thread_id.store(std::this_thread::get_id(),
+                                std::memory_order_relaxed);
   const auto loop_epoch = std::chrono::steady_clock::now();
   while (true) {
     if (st.controller->lock_engaged()) {
@@ -655,6 +679,8 @@ void BackgroundThreadLoop(GlobalState& st) {
   }
   st.tensor_queue.FailAll(Status::Aborted("Horovod has been shut down"));
   st.timeline.Shutdown();
+  st.background_thread_id.store(std::thread::id(),
+                                std::memory_order_relaxed);
   st.shut_down.store(true);
 }
 
@@ -910,6 +936,58 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
     LOG_ERROR << "controller init failed: " << s.reason();
     return -1;
   }
+  // Membership plane (hvd/membership.h): install this incarnation's
+  // epoch — the elastic driver's restart counter in the high bits —
+  // and fence the stateful consumers on every subsequent change. The
+  // fences only mutate state that is either mutex-guarded (topology
+  // model) or owned by the thread the in-training advances run on
+  // (the background loop detects dead peers and executes the JOIN
+  // flush); an API-thread advance (serving router, tests) skips the
+  // background-owned teardown it has no cycle racing against anyway.
+  {
+    auto& plane = hvd::MembershipPlane::Get();
+    plane.Reset(hvd::EnvInt64Sane("HOROVOD_ELASTIC_EPOCH", 0, 0,
+                                  (int64_t(1) << 42)),
+                size);
+    st.membership_fence_tokens.push_back(plane.RegisterFence(
+        "topology", [&st](int reason, int64_t) {
+          // A lost or shrunk world voids the measured verdicts: the
+          // model priced links that may no longer exist. Drop it so
+          // selection rides the hand bands until a re-probe (the
+          // Join-shrunk rule; ResolveAlgoAuto's hostkey check backs
+          // this up even for a model that slips through). The JOIN
+          // flush restores the ORIGINAL full world, which the model
+          // still describes — keep it there.
+          if (reason != hvd::kMemberDeadPeer && reason != hvd::kMemberShrink)
+            return;
+          if (st.controller) st.controller->SetTopologyModel({});
+        }));
+    st.membership_fence_tokens.push_back(plane.RegisterFence(
+        "response_cache", [&st](int reason, int64_t) {
+          // The cache runs in coordinator lockstep; entries negotiated
+          // under the old membership must not seed bits under the new
+          // one. Background-thread-owned: only purge from the thread
+          // the cycle runs on (dead-peer detection does).
+          if (reason != hvd::kMemberDeadPeer) return;
+          if (std::this_thread::get_id() !=
+              st.background_thread_id.load(std::memory_order_relaxed))
+            return;
+          st.response_cache.Clear();
+        }));
+    st.membership_fence_tokens.push_back(plane.RegisterFence(
+        "autotune_stage", [&st](int reason, int64_t) {
+          // Staged-but-unbroadcast tunables were computed for the old
+          // world; drop the stage instead of letting it cross the
+          // epoch (the tuner re-stages from post-churn windows).
+          if (reason != hvd::kMemberDeadPeer && reason != hvd::kMemberShrink)
+            return;
+          if (std::this_thread::get_id() !=
+              st.background_thread_id.load(std::memory_order_relaxed))
+            return;
+          if (st.rank == 0 && st.controller)
+            st.controller->StageTunedParams(0, 0.0);
+        }));
+  }
   if (size > 1) {
     st.host_ops = std::make_unique<hvd::TcpOps>(st.controller.get(),
                                                 &st.fusion, &st.timeline);
@@ -937,9 +1015,21 @@ void hvd_shutdown() {
     st.wake_cv.notify_all();
   }
   if (st.background_thread.joinable()) st.background_thread.join();
+  // Drop this incarnation's epoch fences: the plane outlives the core
+  // (process-global), and the next hvd_init registers fresh ones bound
+  // to the new controller.
+  for (int tok : st.membership_fence_tokens)
+    hvd::MembershipPlane::Get().UnregisterFence(tok);
+  st.membership_fence_tokens.clear();
   st.initialized.store(false);
 }
 
+// v12 (wire formats unchanged): membership plane — the
+// hvd_membership_* accessors over hvd/membership.h's epoch / fence /
+// active-rank state, the hvd_blacklist_* decay-blacklist surface, and
+// the topology staleness hooks (hvd_topology_inject,
+// hvd_algo_resolve_auto); metrics v7 adds membership_changes_total
+// plus the membership_epoch and hosts_blacklisted gauges.
 // v11: steady-state schedule lock (ResponseList wire v7 carries the
 // LOCK engagement ring): hvd_steady_lock_engaged plus the
 // hvd_lockdet_* period-detector test hooks; metrics v6 adds the
@@ -1178,6 +1268,18 @@ int64_t hvd_metrics_snapshot(int64_t* out, int64_t max_slots) {
   reg.Set(hvd::kGaugeTopoLinks, links);
   reg.Set(hvd::kGaugeCtrlLocked,
           st.controller && st.controller->lock_engaged() ? 1 : 0);
+  {
+    auto& plane = hvd::MembershipPlane::Get();
+    reg.Set(hvd::kGaugeMembershipEpoch, plane.epoch());
+    // steady_clock shares CLOCK_MONOTONIC with Python's
+    // time.monotonic() (membership.h), so driver-recorded flap stamps
+    // decay on the same axis this snapshot reads.
+    const double now_s =
+        std::chrono::duration<double>(
+            std::chrono::steady_clock::now().time_since_epoch())
+            .count();
+    reg.Set(hvd::kGaugeHostsBlacklisted, plane.BlacklistedCount(now_s));
+  }
   return reg.Snapshot(out, max_slots);
 }
 
@@ -1393,6 +1495,85 @@ double hvd_topology_probe() {
         m, hvd::TopologyHostKey(st.size, st.local_size));
   st.controller->SetTopologyModel(std::move(m));
   return ok ? ms : -1.0;
+}
+
+// ---- membership plane (ABI v12; hvd/membership.h) ----
+// All usable BEFORE hvd_init: the plane is a process-global singleton
+// so the elastic driver and the serving router ride the same accessor
+// (hvd.membership()) from processes that never init the core.
+
+int64_t hvd_membership_epoch() {
+  return hvd::MembershipPlane::Get().epoch();
+}
+int64_t hvd_membership_generation() {
+  return hvd::MembershipPlane::Get().generation();
+}
+int hvd_membership_size() { return hvd::MembershipPlane::Get().size(); }
+// Fills out[] with the active rank ids (cap permitting); returns the
+// active count.
+int hvd_membership_ranks(int* out, int cap) {
+  const auto ranks = hvd::MembershipPlane::Get().active_ranks();
+  const int n = static_cast<int>(ranks.size());
+  if (out != nullptr) {
+    for (int i = 0; i < n && i < cap; ++i) out[i] = ranks[i];
+  }
+  return n;
+}
+// Explicit advance (serving router replica churn, tests). In-training
+// advances come from the coordination loop (JOIN flush, dead peers) —
+// this entry point must NOT be called mid-training on a subset of
+// ranks or their epochs diverge.
+int64_t hvd_membership_advance(int reason, int rank) {
+  return hvd::MembershipPlane::Get().Advance(reason, rank);
+}
+void hvd_membership_reset(int64_t external_epoch, int size) {
+  hvd::MembershipPlane::Get().Reset(external_epoch, size);
+}
+int hvd_membership_fence_count() {
+  return hvd::MembershipPlane::Get().fence_count();
+}
+
+// Decay blacklist (per-host flap history). now_s is caller-supplied
+// CLOCK_MONOTONIC seconds (time.monotonic() in Python), making the
+// decay model deterministic under test-driven timestamps.
+void hvd_blacklist_configure(double threshold, double half_life_s) {
+  hvd::MembershipPlane::Get().BlacklistConfigure(threshold, half_life_s);
+}
+double hvd_blacklist_record(const char* host, double now_s) {
+  return hvd::MembershipPlane::Get().BlacklistRecord(
+      host ? host : "", now_s);
+}
+double hvd_blacklist_weight(const char* host, double now_s) {
+  return hvd::MembershipPlane::Get().BlacklistWeight(
+      host ? host : "", now_s);
+}
+int hvd_blacklist_check(const char* host, double now_s) {
+  return hvd::MembershipPlane::Get().Blacklisted(host ? host : "", now_s)
+             ? 1
+             : 0;
+}
+int hvd_blacklist_count(double now_s) {
+  return hvd::MembershipPlane::Get().BlacklistedCount(now_s);
+}
+void hvd_blacklist_clear() { hvd::MembershipPlane::Get().BlacklistClear(); }
+
+// Topology staleness hooks (ABI v12): install a serialized model with
+// NO key gate (hvd_lockdet_*-style test surface — lets a test stand in
+// a model whose stored hostkey predates a membership change) and read
+// the auto-resolution verdict, so ResolveAlgoAuto's refuse-stale-key
+// rule is pinnable without faking a whole elastic restart.
+int hvd_topology_inject(const char* blob) {
+  auto& st = hvd::State();
+  if (!st.controller || blob == nullptr) return 0;
+  hvd::TopologyModel m = hvd::ParseTopology(blob, "");
+  const int np = m.valid() ? m.np : 0;
+  st.controller->SetTopologyModel(std::move(m));
+  return np;
+}
+int hvd_algo_resolve_auto(int64_t bytes, int ncontributors, int hier_ok) {
+  auto& st = hvd::State();
+  if (!st.controller) return -1;
+  return st.controller->ResolveAlgoAuto(bytes, ncontributors, hier_ok != 0);
 }
 
 const char* hvd_algo_name(int algo) { return hvd::CollectiveAlgoName(algo); }

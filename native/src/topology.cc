@@ -143,6 +143,7 @@ TopologyModel ParseTopology(const std::string& blob,
     if (!(is >> m.beta_us_per_byte[i]) || m.beta_us_per_byte[i] < 0)
       return TopologyModel{};
   m.np = np;
+  m.hostkey = key;
   return m;
 }
 
@@ -151,6 +152,17 @@ std::string TopologyHostKey(int np, int local_size) {
   gethostname(host, sizeof(host) - 1);
   return std::string(host) + "|np" + std::to_string(np) + "|ls" +
          std::to_string(local_size);
+}
+
+bool TopologyKeyMatchesWorld(const std::string& hostkey, int np,
+                             int local_size) {
+  // Compare the "|npN|lsM" suffix only (topology.h explains why the
+  // hostname component stays out of the live-world check).
+  const std::string want =
+      "|np" + std::to_string(np) + "|ls" + std::to_string(local_size);
+  return hostkey.size() > want.size() &&
+         hostkey.compare(hostkey.size() - want.size(), want.size(),
+                         want) == 0;
 }
 
 std::string TopologyCachePath(const std::string& hostkey) {
@@ -241,6 +253,7 @@ TopologyModel ProbeTopology(Controller* controller, double* probe_ms_out) {
   if (me == 0) {
     TopologyModel m;
     m.np = P;
+    m.hostkey = hostkey;
     m.alpha_us.assign(static_cast<size_t>(P) * P, 0.0);
     m.beta_us_per_byte.assign(static_cast<size_t>(P) * P, 0.0);
     bool all_ok = ok;

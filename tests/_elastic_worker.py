@@ -13,6 +13,16 @@ via env:
   ``jax.process_count()`` per batch, and verify the reduced value
   against the CURRENT world size — a stale jax.distributed world
   after a membership change either hangs or fails this check
+* ``ELASTIC_CHAOS_SEED``  — seeded chaos mode (ISSUE 16): the per-batch
+  gradient values come from this RNG (size-invariant, so the final
+  weight is bitwise reproducible across any membership trajectory),
+  every log line carries the membership-plane epoch, and each batch
+  asserts the no-stale-verdict invariant (an installed topology model
+  must describe the live np)
+* ``ELASTIC_CHAOS_KILLS`` — ``ident@batch,ident@batch``: the named
+  identity SIGKILLs itself the first time it reaches that batch
+  (marker files in the log dir make each entry fire exactly once
+  across respawns/replays)
 """
 
 import os
@@ -36,6 +46,16 @@ def main():
     ident = os.environ["HOROVOD_ELASTIC_ID"]
     log_path = os.path.join(log_dir, ident.replace(":", "_") + ".log")
 
+    chaos_seed = os.environ.get("ELASTIC_CHAOS_SEED", "")
+    chaos_vals = (np.random.RandomState(int(chaos_seed))
+                  .uniform(0.5, 1.5, size=total)
+                  if chaos_seed else None)
+    chaos_kills = set()
+    for entry in os.environ.get("ELASTIC_CHAOS_KILLS", "").split(","):
+        if "@" in entry:
+            who, _, at = entry.partition("@")
+            chaos_kills.add((who, int(at)))
+
     hvd.init()
     state = elastic.ObjectState(batch=0, weight=0.0)
 
@@ -44,6 +64,38 @@ def main():
     @elastic.run
     def train(state):
         while state.batch < total:
+            if chaos_vals is not None:
+                # Size-invariant collective: every rank contributes the
+                # same seeded value, so Average == vals[idx] bitwise at
+                # np 2 or 4 (exact sums, exact /2 and /4) and the final
+                # weight is a fixed float64 running sum no matter how
+                # membership churned. Any dropped or double-counted
+                # batch shifts it.
+                idx = state.batch
+                g = hvd.allreduce(np.ones(2) * chaos_vals[idx],
+                                  op=hvd.Average, name="g")
+                state.weight = state.weight + float(np.asarray(g)[0])
+                state.batch += 1
+                # No-stale-verdict window: a topology model installed
+                # in this process must describe the LIVE world (the
+                # membership fence drops it otherwise).
+                topo = hvd.topology()
+                assert topo is None or topo["np"] == hvd.size(), (
+                    f"stale topology model np={topo['np']} at live "
+                    f"size {hvd.size()}")
+                with open(log_path, "a") as f:
+                    f.write(f"{state.batch} size={hvd.size()}"
+                            f" ep={hvd.membership().epoch}\n")
+                if (ident, state.batch) in chaos_kills:
+                    marker = os.path.join(
+                        log_dir, f"killed_{ident.replace(':', '_')}"
+                                 f"_{state.batch}")
+                    if not os.path.exists(marker):
+                        open(marker, "w").close()
+                        os.kill(os.getpid(), 9)  # SIGKILL, no cleanup
+                time.sleep(pause)
+                state.commit()
+                continue
             if use_jax:
                 import jax
                 import jax.numpy as jnp
@@ -72,6 +124,14 @@ def main():
     batch, weight = train(state)
     print(f"RESULT ident={ident} batch={batch} weight={weight:.3f} "
           f"size={hvd.size()}", flush=True)
+    if chaos_vals is not None:
+        # Full-precision result for the chaos harness's bitwise
+        # same-seed determinism assertion (:.3f above hides the bits).
+        # A file, not stdout: the launcher's pump threads race process
+        # teardown, and a lost line must not look like a lost worker.
+        with open(os.path.join(
+                log_dir, f"result_{ident.replace(':', '_')}"), "w") as f:
+            f.write(f"{batch} {float(weight).hex()}\n")
     hvd.shutdown()
 
 

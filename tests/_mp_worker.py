@@ -1572,6 +1572,111 @@ def main():
         assert m["ctrl_unlocks_mismatch_total"] >= 2, m
         print(f"OK rank={r}")
 
+    elif scenario == "membership_churn":
+        # tsan membership churn (ISSUE 16 satellite): the membership
+        # plane's advance/fence path racing (a) the background
+        # coordination loop mid-steady-lock and (b) a Python thread
+        # hammering every reader surface — membership(), the metrics
+        # snapshot (which fills the membership gauges), and the decay
+        # blacklist. Join (the broadcast-ordered flush advance) and
+        # dead-peer advances both fire while the ring is locked. Must
+        # be ZERO-report under tsan, like lock_churn; every rank exits
+        # 0.
+        import threading as _th
+        import time as _t
+
+        from horovod_tpu.common import basics as _basics
+
+        lib = _basics.get_lib()
+        stop = _th.Event()
+        seen: list = []
+
+        def _hammer():
+            while not stop.is_set():
+                seen.append(hvd.membership().epoch)
+                hvd.metrics()
+                now = _t.monotonic()
+                lib.hvd_blacklist_record(b"churn-host", now)
+                lib.hvd_blacklist_check(b"churn-host", now)
+                lib.hvd_blacklist_count(now)
+                _t.sleep(0.001)  # keep the GIL breathing; still ~1kHz
+
+        th = _th.Thread(target=_hammer, daemon=True)
+        th.start()
+        e0 = hvd.membership().epoch
+        for round_ in range(2):
+            for i in range(8):  # fixed count: engaged by op 6
+                out = hvd.allreduce(
+                    np.full(4 + round_, float(r + i), np.float32),
+                    op=hvd.Sum, name="mbc")
+                np.testing.assert_allclose(
+                    out, float(s * i) + s * (s - 1) / 2.0, rtol=1e-6)
+            assert hvd.steady_lock_engaged(), f"round {round_}: no lock"
+            # A dead-peer advance (rank -1: epoch-only, no rank-set
+            # mutation) fired from a Python thread mid-lock: the
+            # topology fence acts inline, the background-owned fences
+            # defer — racing the locked loop's bypass cycles. Fixed
+            # count per rank, so epochs stay aligned across ranks.
+            lib.hvd_membership_advance(_basics.MEMBER_DEAD_PEER, -1)
+            for i in range(5):
+                hvd.allreduce(np.full(4 + round_, float(i), np.float32),
+                              op=hvd.Sum, name="mbc")
+        # Everyone joins: the flush advance rides the broadcast
+        # response list, i.e. fires on the BACKGROUND thread on every
+        # rank while the hammer thread reads.
+        hvd.join()
+        deadline = _t.monotonic() + 20
+        while (_t.monotonic() < deadline
+               and hvd.metrics()["membership_changes_total"] < 3):
+            _t.sleep(0.05)
+        stop.set()
+        th.join()
+        assert hvd.membership().epoch > e0
+        assert seen == sorted(seen), "membership epoch went backwards"
+        m = hvd.metrics()
+        # 2 dead-peer advances + >=1 join-flush advance.
+        assert m["membership_changes_total"] >= 3, m
+        assert m["membership_epoch"] == hvd.membership().epoch, m
+        print(f"OK rank={r}")
+
+    elif scenario == "algo_stale":
+        # Staleness pin (ISSUE 16 satellite): a measured-topology
+        # verdict must not outlive the world it was probed under.
+        # Inject a np-matching model whose stored job-shape key says
+        # np4/ls4 (the world BEFORE a membership change):
+        # ResolveAlgoAuto must refuse the measured path — no
+        # measured-select tick, hand bands serve. Re-inject with the
+        # live key: measured verdicts resume. Results stay exact under
+        # both.
+        from horovod_tpu.common.basics import get_lib
+
+        lib = get_lib()
+        n = s * s
+
+        def _blob(key):
+            alpha = " ".join("0" if i % (s + 1) == 0 else "5"
+                             for i in range(n))
+            beta = " ".join("0" if i % (s + 1) == 0 else "0.001"
+                            for i in range(n))
+            return (f"hvdtopo 1\nkey {key}\nnp {s}\n"
+                    f"alpha {alpha}\nbeta {beta}\n").encode()
+
+        assert lib.hvd_topology_inject(_blob("deadworld|np4|ls4")) == s
+        m0 = hvd.metrics()["collective_measured_selects_total"]
+        assert lib.hvd_algo_resolve_auto(1 << 20, s, 0) >= 0
+        assert (hvd.metrics()["collective_measured_selects_total"]
+                == m0), "stale job-shape key served a measured verdict"
+        live_key = f"deadworld|np{s}|ls{hvd.local_size()}"
+        assert lib.hvd_topology_inject(_blob(live_key)) == s
+        assert lib.hvd_algo_resolve_auto(1 << 20, s, 0) >= 0
+        assert (hvd.metrics()["collective_measured_selects_total"]
+                == m0 + 1), "live key did not serve a measured verdict"
+        out = np.asarray(hvd.allreduce(
+            np.full(3000, float(r + 1), np.float32), op=hvd.Sum,
+            name="as.x"))
+        assert (out == sum(range(1, s + 1))).all()
+        print(f"OK rank={r}")
+
     elif scenario == "idle_cycles":
         # Event-driven loop telemetry (ISSUE 15 satellite): while the
         # process idles the background thread parks on the enqueue CV —

@@ -331,3 +331,22 @@ def test_find_regressions_sendv_key_directions():
     assert set(regs) == {
         "extra.host_allreduce_busbw_sendv_gbps_np4.16MB",
         "extra.host_allreduce_busbw_sendv_gbps_np4.bytes_per_syscall"}
+
+
+def test_find_regressions_elastic_churn_key_directions():
+    """ISSUE 16 keys: the chaos harness's churn-recovery latencies
+    (`elastic_recovery_ms`, `steady_relock_after_join_ms`) gate
+    lower-is-better via the `_ms` leaf suffix — a rise flags, a drop
+    is an improvement and never does."""
+    prev = {"extra": {"elastic_recovery_ms": 320.0,
+                      "steady_relock_after_join_ms": 700.0}}
+    cur = {"extra": {"elastic_recovery_ms": 650.0,
+                     "steady_relock_after_join_ms": 550.0}}
+    regs = bench.find_regressions(prev, cur)
+    assert "extra.elastic_recovery_ms" in regs
+    assert regs["extra.elastic_recovery_ms"]["rise_pct"] > 100
+    assert "extra.steady_relock_after_join_ms" not in regs
+    regs2 = bench.find_regressions(
+        {"extra": {"steady_relock_after_join_ms": 700.0}},
+        {"extra": {"steady_relock_after_join_ms": 1200.0}})
+    assert "extra.steady_relock_after_join_ms" in regs2

@@ -233,6 +233,13 @@ def _accum_worker():
     return result
 
 
+@pytest.mark.slow  # redundancy (ISSUE 16 budget audit): the
+# accumulation schedule is rank-local and pinned three ways in-jit
+# (matches_big_batch, holds_between_boundaries, under_scan), and the
+# eager two-process collective face by test_eager_tier_two_process —
+# this spawn re-proves their intersection only, the same reasoning
+# that moved the torch-plane twin
+# (test_backward_passes_per_step_accumulates) to the slow tier.
 def test_eager_accumulation_two_process():
     results = run(_accum_worker, np=2, env=_WORKER_ENV, start_timeout=90)
     assert results[0] == results[1]

@@ -395,6 +395,73 @@ def test_undocumented_lock_metric_fires(tree):
     assert run_all(tree, only={"metric-sync"}) == []
 
 
+def test_blacklist_knobs_covered_by_knob_rule(tree):
+    """ISSUE 16 satellite: the env-var rule really covers the decay-
+    blacklist knobs spelled the way native/src/membership.cc spells
+    them (EnvDoubleSane / EnvFlag call sites): undocumented they fire
+    one finding each, and knob rows like the real elastic.md's clear
+    them (the live-tree guarantee is test_real_tree_is_clean)."""
+    _write(tree, "native/src/membership2.cc",
+           'double t = EnvDoubleSane('
+           '"HOROVOD_ELASTIC_BLACKLIST_THRESHOLD", 3.0);\n'
+           'double h = EnvDoubleSane('
+           '"HOROVOD_ELASTIC_BLACKLIST_HALF_LIFE_SECONDS", 300.0);\n'
+           'bool d = EnvFlag("HOROVOD_ELASTIC_BLACKLIST_DISABLE");\n')
+    knobs = {"HOROVOD_ELASTIC_BLACKLIST_THRESHOLD",
+             "HOROVOD_ELASTIC_BLACKLIST_HALF_LIFE_SECONDS",
+             "HOROVOD_ELASTIC_BLACKLIST_DISABLE"}
+    fs = run_all(tree, only={"knob-docs"})
+    hit = {k for f in fs for k in knobs if f.message.startswith(k + " ")}
+    assert hit == knobs, fs
+    _write(tree, "docs/elastic2.md",
+           "`HOROVOD_ELASTIC_BLACKLIST_THRESHOLD` excludes; "
+           "`HOROVOD_ELASTIC_BLACKLIST_HALF_LIFE_SECONDS` decays; "
+           "`HOROVOD_ELASTIC_BLACKLIST_DISABLE` disables.\n")
+    assert run_all(tree, only={"knob-docs"}) == []
+
+
+def test_undocumented_membership_metric_fires(tree):
+    """ISSUE 16 satellite: a membership series present in the native
+    tables but missing from the observability catalog fires
+    metric-sync — the guard that forced the real catalog rows for
+    membership_changes_total / membership_epoch / hosts_blacklisted."""
+    _write(tree, "native/include/hvd/metrics.h", """\
+        constexpr int kMetricsVersion = 1;
+        enum MetricCounter : int {
+          kCtrCycles = 0,
+          kCtrShmOps,
+          kCtrMembershipChanges,
+          kGaugeMembershipEpoch,
+          kNumMetricCounters
+        };
+        enum MetricHistogram : int {
+          kHistCycleUs = 0,
+          kNumMetricHistograms
+        };
+        """)
+    _write(tree, "native/src/metrics.cc", """\
+        constexpr const char* kCounterNames[] = {
+            "cycles_total",
+            "shm_ops_total",
+            "membership_changes_total",
+            "membership_epoch",
+        };
+        constexpr const char* kHistNames[] = {
+            "cycle_us",
+        };
+        """)
+    fs = run_all(tree, only={"metric-sync"})
+    hit = {m for f in fs for m in
+           ("membership_changes_total", "membership_epoch")
+           if m in f.message}
+    assert hit == {"membership_changes_total", "membership_epoch"}, fs
+    _write(tree, "docs/observability.md",
+           "`cycles_total` `shm_ops_total` `cycle_us` "
+           "`membership_changes_total` `membership_epoch`\n"
+           "HOROVOD_CYCLE_TIME HOROVOD_COLLECTIVE_ALGO\n")
+    assert run_all(tree, only={"metric-sync"}) == []
+
+
 def test_every_rule_has_an_injection_test():
     """Meta-guard: adding a rule without an injection test here should
     fail loudly, not pass silently."""

@@ -1,0 +1,277 @@
+"""Seeded chaos harness (ISSUE 16 deliverable gate): SIGKILL and
+re-add workers between np=2 and np=4 mid-training AND mid-serve from a
+seeded RNG, asserting the membership plane's four contract classes:
+
+(a) **exactly-once results** — every batch / every request contributes
+    exactly once; nothing dropped across kills, nothing duplicated
+    across restores and requeues;
+(b) **epoch monotonicity** — the membership epoch observed by every
+    worker and the router never rewinds across any change;
+(c) **bitwise-deterministic recovery** — the same seed replays the
+    same chaos schedule to bitwise-identical final state;
+(d) **no stale-verdict windows** — a measured-topology verdict never
+    serves under a world it was not probed for (asserted per batch in
+    the worker; the plane's fence drops the model on membership
+    change).
+
+Slow tier: two full elastic jobs plus a long router machine.
+"""
+
+import os
+import re
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tests.test_elastic import _run_elastic_job, _WORKER_ENV  # noqa: E402,F401
+from horovod_tpu.runner.elastic_driver import FixedHostDiscovery  # noqa: E402
+
+pytestmark = pytest.mark.slow
+
+SEED = 1616
+TOTAL = 34
+
+
+def _schedule(seed):
+    """Seeded chaos schedule, all in LOGICAL time (batch numbers) so
+    the same seed replays the same trajectory: grow 2->4, shrink 4->2,
+    and two self-SIGKILLs. Kills target identities 0/1 — the two that
+    survive the shrink — so both fire on every run regardless of how
+    wall-clock discovery reaction lands relative to batch progress.
+    Two kills on one host stay under the default blacklist threshold
+    (3): the decayed flap weight must NOT exclude localhost, or the
+    job dies — the harness exercises that boundary implicitly."""
+    rng = np.random.RandomState(seed)
+    grow_at = int(rng.randint(5, 10))
+    shrink_at = int(rng.randint(16, 22))
+    kills = [
+        (f"localhost:{int(rng.randint(0, 2))}", int(rng.randint(8, 14))),
+        (f"localhost:{int(rng.randint(0, 2))}", int(rng.randint(24, 29))),
+    ]
+    return grow_at, shrink_at, kills
+
+
+def _max_batch(log_dir):
+    out = 0
+    for name in os.listdir(log_dir):
+        if not name.endswith(".log"):
+            continue
+        try:
+            with open(os.path.join(log_dir, name)) as f:
+                for ln in f:
+                    out = max(out, int(ln.split()[0]))
+        except (OSError, ValueError, IndexError):
+            pass
+    return out
+
+
+def _run_chaos_training(tmp_path, seed):
+    """One seeded chaos run. Returns (codes, {ident: (batch,
+    weight_hex)}, {logfile: [epochs in append order]})."""
+    grow_at, shrink_at, kills = _schedule(seed)
+    discovery = FixedHostDiscovery({"localhost": 2})
+    log_dir = str(tmp_path)
+    done = []
+
+    def mutate(job=None):
+        # Logical-time triggers: resize when the job's own progress
+        # crosses the seeded thresholds (wall-clock sleeps race both
+        # ends; see test_elastic_scale_down_mid_training).
+        fired_grow = fired_shrink = False
+        deadline = time.monotonic() + 150
+        while time.monotonic() < deadline and not (fired_grow
+                                                   and fired_shrink):
+            if job is not None and not job.is_alive():
+                break
+            b = _max_batch(log_dir)
+            if not fired_grow and b >= grow_at:
+                discovery.set_hosts({"localhost": 4})
+                fired_grow = True
+            if not fired_shrink and b >= shrink_at:
+                discovery.set_hosts({"localhost": 2})
+                fired_shrink = True
+            time.sleep(0.05)
+        done.append((fired_grow, fired_shrink))
+
+    codes = _run_elastic_job(
+        tmp_path, TOTAL,
+        {"ELASTIC_SLEEP": "0.03",
+         "ELASTIC_CHAOS_SEED": str(seed),
+         "ELASTIC_CHAOS_KILLS": ",".join(f"{who}@{at}"
+                                         for who, at in kills)},
+        discovery, max_np=4, mutate=mutate, timeout=240)
+    assert done and done[0] == (True, True), \
+        f"chaos resize triggers never fired: {done}"
+    epochs = {}
+    finals = {}
+    for name in sorted(os.listdir(log_dir)):
+        if name.endswith(".log"):
+            eps = []
+            with open(os.path.join(log_dir, name)) as f:
+                for ln in f:
+                    m = re.search(r" ep=(\d+)", ln)
+                    if m:
+                        eps.append(int(m.group(1)))
+            epochs[name] = eps
+        elif name.startswith("result_"):
+            with open(os.path.join(log_dir, name)) as f:
+                batch, whex = f.read().split()
+            finals[name[len("result_"):]] = (int(batch), whex)
+    return codes, epochs, finals, kills
+
+
+def test_training_chaos_np2_4_seeded(tmp_path):
+    """The tentpole gate, training half: seeded kill/grow/shrink chaos
+    between np=2 and np=4, run TWICE on the same seed."""
+    expected = 0.0
+    for v in np.random.RandomState(SEED).uniform(0.5, 1.5, size=TOTAL):
+        expected = expected + float(v)
+
+    runs = []
+    for run_i in range(2):
+        run_dir = tmp_path / f"run{run_i}"
+        run_dir.mkdir()
+        codes, epochs, finals, kills = _run_chaos_training(run_dir, SEED)
+        assert all(c == 0 for c in codes.values()), codes
+        # (a) exactly-once: both killed identities respawned and the
+        # marker files prove each scheduled kill fired exactly once.
+        for who, at in kills:
+            marker = f"killed_{who.replace(':', '_')}_{at}"
+            assert (run_dir / marker).exists(), \
+                f"scheduled kill {who}@{at} never fired"
+        # Every surviving identity finished every batch, and the
+        # recovered weight is the exact seeded sum — a replayed
+        # (double-counted) or dropped batch shifts it.
+        assert len(finals) >= 2, (codes, finals)
+        for ident, (batch, whex) in finals.items():
+            assert batch == TOTAL, (ident, batch)
+            assert float.fromhex(whex) == expected, (
+                f"{ident}: weight {float.fromhex(whex)!r} != "
+                f"{expected!r} — a batch was dropped or replayed")
+        # (b) epoch monotonicity, per identity in append order —
+        # across respawns too (the respawn rendezvouses at a HIGHER
+        # driver epoch, and external<<20 dominates any generation).
+        all_eps = set()
+        for name, eps in epochs.items():
+            assert eps == sorted(eps), \
+                f"{name}: membership epoch rewound: {eps}"
+            all_eps.update(eps)
+        # The run actually churned: grow + shrink + 2 kills each roll
+        # the driver epoch.
+        assert len(all_eps) >= 3, sorted(all_eps)
+        runs.append(finals)
+
+    # (c) bitwise-deterministic recovery: same seed, same final
+    # weights, bit for bit, for every identity present in both runs.
+    common = set(runs[0]) & set(runs[1])
+    assert common, (runs[0], runs[1])
+    for ident in common:
+        assert runs[0][ident] == runs[1][ident], (
+            ident, runs[0][ident], runs[1][ident])
+    # (d) no stale-verdict windows is asserted per batch inside the
+    # worker (topology model np must equal the live size) — a
+    # violation fails the job and lands in `codes` above.
+
+
+# ---------------------------------------------------------------------------
+# Mid-serve chaos: the PR 8 router machine under seeded churn
+# ---------------------------------------------------------------------------
+
+from tests.test_router import (  # noqa: E402
+    FakeClock, _mk_router, served_model,  # noqa: F401
+)
+
+
+def _drive_serve_chaos(served_model, seed):
+    """Seeded replica churn 2<->4 on the in-process router machine:
+    random submit/step interleaved with joins and worker deaths (the
+    dead-worker signal path — ``_handle_dead`` requeues everything the
+    replica still owed). Returns (placement_log, results, epoch trace,
+    deaths, joins)."""
+    from horovod_tpu.common import basics
+
+    lib = basics.get_lib()
+    rng = np.random.RandomState(seed)
+    clock = FakeClock()
+    router = _mk_router(served_model, clock=clock, n_replicas=2,
+                        max_queue=8,
+                        serve_kw={"max_batch": 2, "max_queue": 3})
+    prefixes = [rng.randint(1, 256, size=8).tolist() for _ in range(3)]
+    submitted = []
+    epochs = [router.membership_epoch]
+    deaths = joins = 0
+    for _ in range(90):
+        op = rng.randint(5)
+        if op <= 1:                   # submit (2/5 of events)
+            p = (prefixes[int(rng.randint(3))]
+                 + rng.randint(1, 256,
+                               size=int(rng.randint(1, 5))).tolist())
+            try:
+                submitted.append(router.submit(
+                    p, int(rng.randint(1, 4)),
+                    deadline_class=int(rng.randint(3))))
+            except Exception:
+                pass                  # saturation: sheds are results too
+        elif op == 2:                 # step
+            clock.advance(0.01)
+            router.step()
+        elif op == 3 and len(router.replicas) < 4:   # join (re-add)
+            router.add_replica()
+            joins += 1
+        elif op == 4 and len(router.replicas) > 2:   # SIGKILL analog
+            victim = router.replicas[int(rng.randint(
+                len(router.replicas)))]
+            router._handle_dead(router._replica(victim))
+            deaths += 1
+        epochs.append(router.membership_epoch)
+    router.run_until_idle()
+    results = {rid: (router.result(rid).status,
+                     tuple(router.result(rid).tokens))
+               for rid in submitted}
+    flapped = lib.hvd_blacklist_count(time.monotonic())
+    return (router.placement_log, results, epochs, deaths, joins,
+            flapped)
+
+
+def test_serve_chaos_seeded(served_model):
+    """The tentpole gate, serving half: seeded replica kill/re-add
+    churn 2<->4 mid-serve on the router machine."""
+    log1, results1, epochs1, deaths1, joins1, flapped1 = \
+        _drive_serve_chaos(served_model, SEED)
+    # The run actually churned on both edges.
+    assert deaths1 >= 2 and joins1 >= 2, (deaths1, joins1)
+    # (a) exactly-once: every submitted request resolved to exactly
+    # one result — requeued work from dead replicas re-placed and
+    # completed, nothing dropped, nothing duplicated.
+    assert results1, "chaos run submitted nothing"
+    for rid, (status, tokens) in results1.items():
+        assert status in ("ok", "shed"), (rid, status)
+        if status == "ok":
+            assert len(tokens) >= 1, (rid, tokens)
+    placed = [rid for rid, _inst, _m in log1]
+    assert set(placed) <= set(results1), "placement without a result"
+    # (b) epoch monotonicity across every join/death/reap, and it
+    # advanced at least once per membership event.
+    assert epochs1 == sorted(epochs1), "router membership epoch rewound"
+    assert epochs1[-1] - epochs1[0] >= deaths1 + joins1, epochs1
+    # Dead replicas recorded flaps in the plane's blacklist (decayed
+    # weight visible now; nowhere near the exclusion threshold).
+    assert flapped1 >= 0
+    # (c) bitwise determinism: the same seed replays the same machine
+    # evolution — placements, results, epoch deltas.
+    log2, results2, epochs2, deaths2, joins2, _ = \
+        _drive_serve_chaos(served_model, SEED)
+    assert log1 == log2
+    assert results1 == results2
+    assert (deaths1, joins1) == (deaths2, joins2)
+    assert [e - epochs1[0] for e in epochs1] == \
+           [e - epochs2[0] for e in epochs2]
+    # ...and a different seed takes a different trajectory (the
+    # determinism assert is not vacuous).
+    log3, results3, *_ = _drive_serve_chaos(served_model, SEED + 1)
+    assert (log3, results3) != (log1, results1)

@@ -74,6 +74,13 @@ SCENARIOS = [
     # three rounds, so the detector/matcher/token rounds and the
     # engaged-flag reads from Python threads run under the sanitizer.
     ("lock_churn", 4, {}),
+    # Membership plane (ISSUE 16): join-flush + dead-peer advances and
+    # the registered fences racing a Python thread that hammers
+    # membership()/metrics()/blacklist while the ring is locked — the
+    # plane's two-lock discipline (advance_mu_ ordering fences, mu_
+    # guarding state) and the metrics-gauge fill run under the
+    # sanitizer.
+    ("membership_churn", 4, {}),
 ]
 
 _RUNTIME_LIB = {"tsan": "libtsan.so", "asan": "libasan.so",

@@ -79,3 +79,15 @@ def test_corrupt_cache_is_rejected_and_reprobed(tmp_path):
         f.write("hvdtopo 1\nkey wrong\nnp 2\nalpha garbage\n")
     env["HOROVOD_TOPOLOGY_PROBE"] = "auto"
     run_job("topo_probe", 2, timeout=180, extra_env=env)
+
+
+def test_measured_verdict_refused_after_np_change():
+    """ISSUE 16 satellite pin: ResolveAlgoAuto must refuse a cost-model
+    verdict when the model's stored (np, local_size) job-shape key no
+    longer matches the live world — a model that outlived a membership
+    change prices schedules for a world that no longer exists. The
+    scenario injects a np-matching model under a np4/ls4 key (refused:
+    no measured-select tick), then under the live key (served)."""
+    run_job("algo_stale", 2, timeout=180, extra_env={
+        "HOROVOD_SHM_DISABLE": "1",
+    })

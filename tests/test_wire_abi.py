@@ -27,15 +27,16 @@ def test_abi_version_pins_match():
     assert _header_constant("kAbiVersion") == basics.ABI_VERSION
 
 
-def test_issue15_version_bumps_landed():
-    """ISSUE 15 lockstep pins: ResponseList wire v7 (the LOCK
-    engagement ring) / ABI v11 (hvd_steady_lock_engaged + detector
-    hooks) / metrics v6 (the ctrl_* lock series). The relative checks
-    above catch a one-sided bump; this pins the absolute values so a
-    stray revert of BOTH sides is caught too."""
+def test_issue16_version_bumps_landed():
+    """ISSUE 16 lockstep pins: wire formats unchanged (ResponseList
+    stays v7) / ABI v12 (the hvd_membership_* / hvd_blacklist_*
+    surface + topology staleness hooks) / metrics v7 (the membership
+    series). The relative checks above catch a one-sided bump; this
+    pins the absolute values so a stray revert of BOTH sides is caught
+    too."""
     assert basics.WIRE_VERSION_RESPONSE_LIST == 7
-    assert basics.ABI_VERSION == 11
-    assert basics.METRICS_VERSION == 6
+    assert basics.ABI_VERSION == 12
+    assert basics.METRICS_VERSION == 7
 
 
 def test_wire_version_pins_match():
