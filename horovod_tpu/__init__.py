@@ -62,6 +62,7 @@ from horovod_tpu.api import (  # noqa: F401
     topology,
     topology_probe,
     steady_lock_engaged,
+    steady_persistent,
     membership,
     allreduce,
     allreduce_async,

@@ -28,7 +28,8 @@ __all__ = [
     "set_reduce_threads", "metrics", "metrics_prometheus",
     "metrics_aggregate", "metrics_reset", "stalled_tensors",
     "start_metrics_server", "collective_algo", "topology",
-    "topology_probe", "steady_lock_engaged", "membership",
+    "topology_probe", "steady_lock_engaged", "steady_persistent",
+    "membership",
 ]
 
 
@@ -140,6 +141,16 @@ def steady_lock_engaged() -> bool:
     gauge in :func:`metrics`."""
     from horovod_tpu.common.basics import get_lib
     return bool(get_lib().hvd_steady_lock_engaged())
+
+
+def steady_persistent() -> bool:
+    """True when the persistent locked data plane is enabled — the
+    coordinator-synced ``HOROVOD_STEADY_PERSISTENT`` verdict (see
+    ``docs/perf_tuning.md`` "Persistent locked data plane"). Its live
+    footprint shows as the ``tcp_prepost_buffers`` gauge in
+    :func:`metrics`."""
+    from horovod_tpu.common.basics import get_lib
+    return get_lib().hvd_steady_persistent() == 0
 
 
 def membership():

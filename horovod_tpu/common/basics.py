@@ -38,7 +38,7 @@ EXEC_CALLBACK = 1
 # is enforced at library load below, and tests/test_wire_abi.py greps
 # the header so a native bump can't silently skew this shim even
 # before a rebuild happens.
-ABI_VERSION = 12
+ABI_VERSION = 13
 WIRE_VERSION_REQUEST_LIST = 3
 WIRE_VERSION_RESPONSE_LIST = 7
 
@@ -46,7 +46,7 @@ WIRE_VERSION_RESPONSE_LIST = 7
 # kMetricsVersion): the packed int64 layout hvd_metrics_snapshot
 # writes. Checked at library load AND against the header by
 # tests/test_metrics_abi.py, the same two-sided pin as the ABI above.
-METRICS_VERSION = 7
+METRICS_VERSION = 8
 
 # Native WireCodec ids (native/include/hvd/codec.h); -1 = follow the
 # job-wide HOROVOD_WIRE_COMPRESSION default.
@@ -331,6 +331,13 @@ def _declare_abi(lib: ctypes.CDLL, path: str) -> ctypes.CDLL:
     # detector test hooks tests/test_steady_lock.py drives without
     # spawning ranks.
     lib.hvd_steady_lock_engaged.restype = ctypes.c_int
+    # Persistent locked data plane (ABI v13, docs/perf_tuning.md
+    # "Persistent locked data plane"): the coordinator-synced
+    # HOROVOD_STEADY_PERSISTENT verdict (0 = auto, 1 = off) and the
+    # live pre-posted recv buffer count (the tcp_prepost_buffers
+    # gauge's backing store).
+    lib.hvd_steady_persistent.restype = ctypes.c_int
+    lib.hvd_tcp_prepost_buffers.restype = ctypes.c_int64
     lib.hvd_lockdet_create.restype = ctypes.c_void_p
     lib.hvd_lockdet_feed.restype = None
     lib.hvd_lockdet_feed.argtypes = [ctypes.c_void_p, ctypes.c_int,

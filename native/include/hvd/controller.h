@@ -35,6 +35,7 @@
 #include "hvd/common.h"
 #include "hvd/message.h"
 #include "hvd/response_cache.h"
+#include "hvd/shm.h"
 #include "hvd/stall_inspector.h"
 #include "hvd/steady_lock.h"
 #include "hvd/tcp.h"
@@ -326,6 +327,53 @@ class Controller {
   void SetSteadyLockTimeout(double secs) {
     lock_partial_timeout_secs_ = secs > 0 ? secs : 2.0;
   }
+  // ---- persistent locked data plane (ISSUE 17). Knob:
+  // HOROVOD_STEADY_PERSISTENT, rank 0's parse synced to every rank
+  // (param field 16) — the plan changes consensus transport and wire
+  // framing, so a per-rank divergence would deadlock the locked plane
+  // exactly like a split HOROVOD_STEADY_LOCK.
+  void SetSteadyPersistent(int knob) {
+    steady_persistent_knob_ = knob == kSteadyPersistentOff
+                                  ? kSteadyPersistentOff
+                                  : kSteadyPersistentAuto;
+  }
+  int steady_persistent() const { return steady_persistent_knob_; }
+  // Registered by TcpOps after its arena AgreeAll: whether the fused
+  // DATA plane rides shared memory. All-or-none by construction, so
+  // the inline-eligibility predicate stays identical on every rank.
+  void SetDataPlaneShm(bool on) { data_plane_shm_ = on; }
+  bool data_plane_shm() const { return data_plane_shm_; }
+  // Monotone lock-session counter (bumped by EngageLock) + the locked
+  // ring and its per-slot inline verdicts: the executor keys its
+  // compiled slot plan on the generation and rebuilds only on re-lock.
+  uint64_t lock_generation() const { return lock_generation_; }
+  const std::vector<Response>& LockRing() const {
+    return lock_matcher_.ring();
+  }
+  size_t LockPos() const { return lock_matcher_.pos(); }
+  uint32_t LockSlotIndex() const { return lock_matcher_.slot_index(); }
+  bool LockInlineOk(size_t pos) const {
+    return pos < lock_inline_ok_.size() && lock_inline_ok_[pos] != 0;
+  }
+  int64_t LockInlineBytes(size_t pos) const {
+    return pos < lock_inline_bytes_.size() ? lock_inline_bytes_[pos] : 0;
+  }
+  // Inline-slot deferred consensus: LockedPhaseStep ARMS an eligible
+  // slot (kFired without advancing) and the executor folds the FIRE
+  // token into each peer's first data frame; it then reports the
+  // outcome — Commit advances the slot, Abort restores the fired
+  // entries (requests requeue via UnlockNow's pending bits, so the
+  // work re-announces exactly once) and tears the lock down.
+  bool LockInlineArmed() const { return lock_inline_armed_; }
+  void LockInlineCommit();
+  void LockInlineAbort(int reason, std::vector<TensorTableEntry> entries);
+  // Fail-fast teardown for a link error mid-inline-firing: a peer
+  // already holds our FIRE token and may be executing the slot, so
+  // the only safe exit closes every link (peers' waits error out and
+  // the whole job unwinds) — the same contract the standalone token
+  // round applies internally. Base (single process) has no links.
+  virtual void LockFatalTeardown() {}
+
   // Cross-thread readable (the ctrl_locked gauge / Python accessor).
   bool lock_engaged() const {
     return lock_engaged_.load(std::memory_order_relaxed);
@@ -367,14 +415,35 @@ class Controller {
   // Non-blocking peek: has a peer proposed unlock (UNLOCK token or a
   // dead data link) while this rank sits idle mid-slot?
   virtual bool LockPeerProposedUnlock() { return false; }
+  // Standalone-token unlock round for an INLINE-eligible slot: peers
+  // may already be mid-inline-firing, so besides the 8-byte UNLOCK
+  // votes the round must drain their piggybacked payload frames
+  // (`payload_bytes` per FIRE peer) to keep the streams framed. Base =
+  // single process: my vote is the consensus.
+  virtual void LockInlineUnlockRound(uint32_t slot, int64_t payload_bytes,
+                                     int my_reason,
+                                     const std::atomic<bool>* shutdown_flag,
+                                     int* out_reason, bool* fatal) {
+    (void)slot; (void)payload_bytes; (void)shutdown_flag; (void)fatal;
+    *out_reason = my_reason;
+  }
   // Tear down the lock: requeue fed-but-unfired bits and raw pending
   // requests so the resumed negotiation loses nothing.
   void UnlockNow(int reason);
 
   int steady_lock_knob_ = kSteadyLockAuto;
+  int steady_persistent_knob_ = kSteadyPersistentAuto;
   double lock_partial_timeout_secs_ = 2.0;
   std::atomic<bool> lock_engaged_{false};
+  bool data_plane_shm_ = false;
   // Background-thread-only lock state.
+  uint64_t lock_generation_ = 0;
+  bool lock_inline_armed_ = false;
+  // Per-ring-slot inline verdicts, computed once at EngageLock from
+  // synced values only (persistent knob, data-plane verdict, resolved
+  // response geometry) — identical on every rank by construction.
+  std::vector<uint8_t> lock_inline_ok_;
+  std::vector<int64_t> lock_inline_bytes_;
   LockDetector lock_detector_;
   LockMatcher lock_matcher_;
   // Requests drained while locked that are not matched ring bits (the
@@ -419,6 +488,7 @@ class TcpController : public Controller {
     return !announced_.empty() || !table_.empty();
   }
   bool IsJoined() const override { return i_am_joined_; }
+  void LockFatalTeardown() override;
 
  protected:
   bool LockTokenRound(uint32_t slot, bool my_fire, int my_reason,
@@ -426,6 +496,10 @@ class TcpController : public Controller {
                       const std::atomic<bool>* shutdown_flag,
                       int* out_reason, bool* fatal) override;
   bool LockPeerProposedUnlock() override;
+  void LockInlineUnlockRound(uint32_t slot, int64_t payload_bytes,
+                             int my_reason,
+                             const std::atomic<bool>* shutdown_flag,
+                             int* out_reason, bool* fatal) override;
 
  private:
   ResponseList CoordinatorCycle(RequestList my_list, bool shutdown);
@@ -442,6 +516,19 @@ class TcpController : public Controller {
   // instead of serializing through a rank-0 hub (the reference gets the
   // same from gloo's full-mesh TCP, horovod/common/gloo/).
   Status InitializeMesh(int timeout_ms);
+
+  // Shared-memory lock-plane consensus cells (ISSUE 17): one 64-byte
+  // slot per rank holding two parity-alternating seqlock cells
+  // {round, token}. When present (single host, persistent=auto,
+  // AgreeAll'd at init) every token round rides plain memory — zero
+  // syscalls in the steady state. Classic TCP rounds remain the
+  // fallback and the teardown channel.
+  bool CellTokenRound(uint32_t slot, bool my_fire, int my_reason,
+                      const std::string& waitname,
+                      const std::atomic<bool>* shutdown_flag,
+                      int* out_reason, bool* fatal);
+  std::unique_ptr<ShmArena> lock_cells_;
+  uint64_t lock_round_ = 0;  // monotone across lock sessions
 
   std::string addr_;
   TcpServer server_;                 // rank 0

@@ -21,6 +21,14 @@ namespace hvd {
 // here so a bump is one edit — and guarded by tests/test_wire_abi.py,
 // which asserts the Python side expects the same numbers (a native
 // bump can't silently skew the shim).
+// ABI v13 (wire formats unchanged): persistent locked data plane
+// (hvd/steady_lock.h) — the HOROVOD_STEADY_PERSISTENT knob (param
+// field 16) with the hvd_steady_persistent accessor, shared-memory
+// consensus cells + token-on-first-frame piggyback replacing the
+// per-slot socket token round when eligible, and the pre-posted recv
+// buffer plan (hvd_tcp_prepost_buffers); metrics v8 adds
+// ctrl_persistent_fires_total / ctrl_token_piggybacks_total and the
+// tcp_prepost_buffers gauge.
 // ABI v12 (wire formats unchanged): membership plane
 // (hvd/membership.h) — hvd_membership_epoch / _generation / _size /
 // _ranks / _advance / _reset / _fence_count, the decay-blacklist
@@ -54,7 +62,7 @@ namespace hvd {
 // hvd_stalled_tensors, and hvd_start_timeline returning an error code.
 constexpr int kWireVersionRequestList = 3;
 constexpr int kWireVersionResponseList = 7;
-constexpr int kAbiVersion = 12;
+constexpr int kAbiVersion = 13;
 
 enum class RequestType : uint8_t {
   ALLREDUCE = 0,

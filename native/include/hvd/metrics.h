@@ -32,6 +32,12 @@ namespace hvd {
 // Snapshot layout version (bump on any enum/table/layout change) and
 // bucket count. Pinned by horovod_tpu/common/basics.py +
 // tests/test_metrics_abi.py.
+// v8: persistent locked data plane (ISSUE 17) —
+// ctrl_persistent_fires_total (consensus rounds served by the
+// shared-memory cells or the inline token piggyback),
+// ctrl_token_piggybacks_total (slots whose FIRE token rode the first
+// data frame) and the tcp_prepost_buffers gauge (receive buffers held
+// pre-posted by the compiled slot plan).
 // v7: membership plane (hvd/membership.h) — membership_changes_total
 // plus the membership_epoch (driver epoch << 20 | generation) and
 // hosts_blacklisted (decayed flap weights over threshold) gauges.
@@ -52,7 +58,7 @@ namespace hvd {
 // tcp_zerocopy_mode gauge (resolved transport mode).
 // v2: per-algorithm TCP allreduce counters (tcp_algo_*_ops_total) and
 // the hd/striped schedule-interpreter phase histograms.
-constexpr int kMetricsVersion = 7;
+constexpr int kMetricsVersion = 8;
 constexpr int kMetricsHistBuckets = 28;  // le = 2^0 .. 2^26, then +Inf
 
 // Monotonic counters (suffix _total) and point-in-time gauges (filled
@@ -133,6 +139,11 @@ enum MetricCounter : int {
   // Membership plane (hvd/membership.h): every Reset/Advance — the
   // observable face of join/dead-peer/shrink churn.
   kCtrMembershipChanges,
+  // Persistent locked data plane (hvd/steady_lock.h, ISSUE 17).
+  kCtrPersistentFires,        // slots whose token consensus rode the
+                              // persistent plane (shm cells or inline)
+  kCtrTokenPiggybacks,        // slots whose FIRE token rode the first
+                              // data frame (inline piggyback subset)
   // ---- gauges (point-in-time, filled by hvd_metrics_snapshot) ----
   kGaugePendingTensors,       // tensors currently in flight
   kGaugeStalledTensors,       // tensors past the stall warning age
@@ -147,6 +158,8 @@ enum MetricCounter : int {
   kGaugeCtrlLocked,           // 1 while the steady-state lock is engaged
   kGaugeMembershipEpoch,      // driver epoch << 20 | in-job generation
   kGaugeHostsBlacklisted,     // hosts with decayed flap weight >= threshold
+  kGaugeTcpPrepostBuffers,    // recv buffers held pre-posted by the
+                              // compiled persistent slot plan
   kNumMetricCounters
 };
 

@@ -31,6 +31,7 @@
 #include "hvd/pool.h"
 #include "hvd/schedule.h"
 #include "hvd/shm.h"
+#include "hvd/thread_pool.h"
 #include "hvd/timeline.h"
 
 namespace hvd {
@@ -223,6 +224,32 @@ class TcpOps : public OpExecutor {
   // residuals only costs one uncompensated step.
   WireEfState* WireEf(const std::string& name, int64_t elems);
 
+  // ---- Persistent locked data plane (hvd/steady_lock.h) ----
+  // One compiled plan per inline-eligible ring slot: the pre-posted
+  // receive buffers for the flat token-piggyback all-to-all plus the
+  // doubling simulation's double-buffered per-rank value arrays, all
+  // carved from ONE BufferPool::kPrepost slab at lock time, and the
+  // worker fan-out pinned alongside (hvd/thread_pool.h WorkerPlan).
+  struct SlotPlan {
+    bool inline_ok = false;
+    int64_t bytes = 0;               // fused payload bytes
+    int64_t stride = 0;              // bytes rounded to a cache line
+    int64_t elems = 0;               // fused element count
+    uint8_t* val = nullptr;          // P arrays of `stride` (round in)
+    uint8_t* next = nullptr;         // P arrays of `stride` (round out)
+    WorkerPlan accum;                // pinned accumulate split
+  };
+  // (Re)compiles plans for the controller's current locked ring;
+  // no-op when plan_gen_ already matches lock_generation(). Publishes
+  // the tcp_prepost_buffers gauge.
+  void CompileLockPlan();
+  // The armed inline firing: token-piggybacked flat exchange over the
+  // pre-posted plan, locally simulated recursive doubling (bitwise
+  // identical to the classic engine), deferred consensus reported via
+  // Controller::LockInlineCommit/LockInlineAbort.
+  Status InlineLockedAllreduce(const Response& r,
+                               std::vector<TensorTableEntry>& entries);
+
   int64_t ring_threshold_bytes_;  // below: recursive doubling
   // HOROVOD_COLLECTIVE_TABLES (on/off, default on): whether allgather
   // / reducescatter / alltoall run their chunk-schedule tables or the
@@ -247,6 +274,11 @@ class TcpOps : public OpExecutor {
   // the cross-host stage rides the leaders' TCP ring.
   std::unique_ptr<ShmArena> node_shm_;
   double shm_timeout_secs_ = 60.0;
+  // Compiled persistent slot plans, keyed (via plan_gen_) to the
+  // controller's lock generation — a fresh EngageLock invalidates the
+  // whole vector, an unlock leaves it to die with the generation.
+  std::vector<SlotPlan> plan_;
+  uint64_t plan_gen_ = 0;
 };
 
 // Accumulate src into dst elementwise on the host ("SUM"/"MIN"/...),

@@ -44,6 +44,9 @@ class BufferPool {
     kExchA,          // raw exchange scratch (ring/doubling recv)
     kExchB,          // raw exchange scratch, pipelined twin
     kIov,            // iovec span tables for the vectored exchanges
+    kPrepost,        // persistent slot plan: pre-posted recv buffers +
+                     // the inline doubling simulation's val/next arrays
+                     // (carved once at lock time, hvd/steady_lock.h)
     kNumSlots
   };
 

@@ -45,9 +45,14 @@ class ShmArena {
   // longer be trusted).
   bool Barrier(double timeout_secs);
 
+  // Liveness probe over the published peer pids (kill(pid, 0) + /proc
+  // zombie check). Public for waiters that block on arena memory
+  // OUTSIDE Barrier — the lock-plane consensus cells poll this on
+  // their tick so a SIGKILLed peer can never wedge a token round.
+  bool PeersAlive();
+
  private:
   ShmArena() = default;
-  bool PeersAlive();
   struct Control;
   Control* ctrl_ = nullptr;
   std::atomic<int32_t>* pids_ = nullptr;

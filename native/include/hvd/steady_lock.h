@@ -40,6 +40,40 @@ namespace hvd {
 constexpr int kSteadyLockAuto = 0;
 constexpr int kSteadyLockOff = 1;
 
+// Knob values (HOROVOD_STEADY_PERSISTENT; coordinator-synced param
+// field 16). `auto` compiles the persistent slot plan whenever the
+// lock engages — shared-memory consensus cells on the shm plane,
+// token-on-first-frame piggyback + pre-posted recv buffers on the TCP
+// plane; `off` restores the PR 15 per-slot token round exactly.
+constexpr int kSteadyPersistentAuto = 0;
+constexpr int kSteadyPersistentOff = 1;
+
+// Inline (token-piggyback) eligibility ceiling: one slot's fused
+// ALLREDUCE payload must fit a kernel socket buffer so the flat
+// all-to-all's sends cannot block (the SendRecv kNoBlockBytes
+// argument, tcp.cc) — above this the classic exchange engines win on
+// bandwidth anyway.
+constexpr int64_t kInlineMaxBytes = 4096;
+
+// 8-byte lock token exchanged once per rank per locked slot — on the
+// data links (PR 15), in the shared-memory consensus cells, or as the
+// leading 8 bytes of an inline slot's piggybacked data frame: all-FIRE
+// executes the slot, anything else ends the lock everywhere with the
+// carried reason. Shared between the controller's consensus rounds
+// (steady_lock.cc) and the executor's inline exchange (ops.cc).
+struct LockToken {
+  uint8_t fire = 0;  // 1 = FIRE, 2 = UNLOCK
+  uint8_t reason = 0;
+  uint8_t pad[2] = {0, 0};
+  uint32_t slot = 0;
+};
+static_assert(sizeof(LockToken) == 8, "lock token must be 8 bytes");
+
+// Per-rank arena slot size for the shared-memory consensus cells (two
+// 16-byte parity-alternating seqlock cells + pad to a cache line so
+// writers never false-share).
+constexpr int64_t kLockCellSlotBytes = 64;
+
 // K consecutive repeating periods engage the lock (the acceptance
 // contract: a steady loop locks within K+2 steps — K+1 cycles to
 // detect, one broadcast to engage).
@@ -115,6 +149,8 @@ class LockMatcher {
   // it never arms this.
   bool SlotPartial() const;
   const Response& Slot() const { return ring_[pos_]; }
+  const std::vector<Response>& ring() const { return ring_; }
+  size_t pos() const { return pos_; }
   // Monotone fired count (the token-round slot id, mod 2^32).
   uint32_t slot_index() const { return static_cast<uint32_t>(fired_); }
   // Consume the current slot's bits and advance around the ring.

@@ -44,6 +44,13 @@ enum TcpIouringMode : int {
 int ResolvedIouringMode();
 const char* IouringModeName(int mode);
 
+// Pre-posted receive buffer accounting for the persistent slot plan
+// (the tcp_prepost_buffers gauge): the executor publishes how many
+// recv buffers its compiled plan holds posted; hvd_metrics_snapshot
+// reads it. Process-wide atomic — one executor per process.
+void SetPrepostBufferGauge(int64_t n);
+int64_t PrepostBufferGauge();
+
 class IouringQueue;  // tcp.cc-private ring state (one per direction)
 
 class TcpConn {
@@ -91,6 +98,13 @@ class TcpConn {
   // (or mutate) the buffers — the in-place exchanges depend on that.
   bool SendV(const struct iovec* iov, int n);
   bool RecvV(const struct iovec* iov, int n);
+  // Token-on-first-frame piggyback (hvd/steady_lock.h's persistent
+  // locked data plane): the 8-byte consensus token and the slot's
+  // payload ride ONE vectored send — the same fold SendFrame applies
+  // to its length header, so a locked firing costs zero extra packets
+  // (and zero extra syscalls) over the bare payload.
+  bool SendTokenFrame(const void* token, const void* payload,
+                      uint64_t payload_len);
   // Local IP of this connection (the address peers can reach us on when
   // we share a network with them). Empty string on failure.
   std::string LocalIp() const;

@@ -112,6 +112,22 @@ void SetHostReduceThreads(int n);
 constexpr int64_t kMinParallelBytes = 256 * 1024;
 int ParallelParts(int64_t bytes);
 
+// Pinned per-slot worker plan (hvd/steady_lock.h's persistent slot
+// plan): the fan-out width and element count — and therefore the
+// segment split and accumulate order, both pure functions of
+// (n, parts) — are resolved ONCE when the lock engages and replayed
+// verbatim on every firing. A mid-lock HOROVOD_REDUCE_THREADS
+// retarget (autotuner broadcast) cannot reshape a locked slot's
+// partitioning, and the locked hot path skips the per-op
+// ParallelParts resolve entirely.
+struct WorkerPlan {
+  int parts = 1;
+  int64_t n = 0;
+};
+WorkerPlan PlanParts(int64_t n, int64_t bytes);
+void ParallelForPlanned(const WorkerPlan& plan,
+                        const std::function<void(int64_t, int64_t)>& fn);
+
 // memcpy spread across the pool (large pack/unpack copies are the
 // other half of the host data plane's critical path).
 void ParallelMemcpy(void* dst, const void* src, int64_t bytes);

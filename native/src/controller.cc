@@ -630,7 +630,8 @@ Status TcpController::Initialize() {
                          std::to_string(collective_stripes_) + ":" +
                          std::to_string(collective_granularity_) + ":" +
                          std::to_string(hd_order_) + ":" +
-                         std::to_string(steady_lock_knob_);
+                         std::to_string(steady_lock_knob_) + ":" +
+                         std::to_string(steady_persistent_knob_);
     for (int peer = 1; peer < size_; ++peer) {
       if (!ctrl_conns_[peer].SendFrame(params))
         return Status::UnknownError("param sync: lost control link");
@@ -672,7 +673,8 @@ Status TcpController::Initialize() {
     auto c13 = c12 == std::string::npos ? c12 : params.find(':', c12 + 1);
     auto c14 = c13 == std::string::npos ? c13 : params.find(':', c13 + 1);
     auto c15 = c14 == std::string::npos ? c14 : params.find(':', c14 + 1);
-    if (!ok || c15 == std::string::npos)
+    auto c16 = c15 == std::string::npos ? c15 : params.find(':', c15 + 1);
+    if (!ok || c16 == std::string::npos)
       return Status::UnknownError("param sync: lost control link");
     fusion_threshold_bytes_ = std::atoll(params.c_str());
     ring_threshold_bytes_ = std::atoll(params.c_str() + c1 + 1);
@@ -693,6 +695,10 @@ Status TcpController::Initialize() {
     // broadcast, so every rank must agree the feature is live or the
     // token rounds would split like any desynced data-plane choice.
     SetSteadyLock(std::atoi(params.c_str() + c15 + 1));
+    // Field 16: rank 0's HOROVOD_STEADY_PERSISTENT verdict — the
+    // persistent plan changes the consensus transport and the locked
+    // wire framing, so it must be job-unique for the same reason.
+    SetSteadyPersistent(std::atoi(params.c_str() + c16 + 1));
     if (topo_mode_ == 2) {
       // Rank 0's cached model rides the quiet data link as one frame.
       std::string blob;
@@ -713,6 +719,28 @@ Status TcpController::Initialize() {
     if (rank_ == 0 && m.valid())
       StoreTopologyCache(m, TopologyHostKey(size_, local_size_));
     SetTopologyModel(std::move(m));
+  }
+  // Persistent lock-plane consensus cells (ISSUE 17): a tiny dedicated
+  // arena (64 bytes per rank) carrying the steady-lock token votes as
+  // seqlock cells — every locked firing's consensus becomes plain
+  // loads/stores instead of 2(P-1) socket syscalls plus a poll. Every
+  // gating input is synced by the param exchange above, so all ranks
+  // enter (or skip) this block together; the AgreeAll makes the
+  // mapping itself all-or-none, exactly like the data arena.
+  if (size_ > 1 && shm_enabled_ && steady_lock_knob_ == kSteadyLockAuto &&
+      steady_persistent_knob_ == kSteadyPersistentAuto) {
+    const char* addr = EnvStr("HOROVOD_CONTROLLER_ADDR");
+    const char* epoch = EnvStr("HOROVOD_ELASTIC_EPOCH");
+    std::string a = addr ? addr : "local";
+    auto colon = a.rfind(':');
+    const std::string tag =
+        (colon == std::string::npos ? a : a.substr(colon + 1)) + "|" +
+        (epoch ? epoch : "0") + "|lock";
+    lock_cells_ = ShmArena::Create(tag, rank_, size_, kLockCellSlotBytes);
+    if (!AgreeAll(lock_cells_ != nullptr)) lock_cells_.reset();
+    if (lock_cells_)
+      LOG_DEBUG << "steady-lock consensus cells mapped (" << size_
+                << " ranks)";
   }
   return Status::OK();
 }

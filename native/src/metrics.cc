@@ -60,6 +60,8 @@ constexpr const char* kCounterNames[] = {
     "ctrl_unlocks_tunables_total",
     "ctrl_unlocks_partial_total",
     "membership_changes_total",
+    "ctrl_persistent_fires_total",
+    "ctrl_token_piggybacks_total",
     "pending_tensors",
     "stalled_tensors",
     "reduce_threads",
@@ -71,6 +73,7 @@ constexpr const char* kCounterNames[] = {
     "ctrl_locked",
     "membership_epoch",
     "hosts_blacklisted",
+    "tcp_prepost_buffers",
 };
 
 constexpr int kCounterKinds[] = {
@@ -80,11 +83,13 @@ constexpr int kCounterKinds[] = {
     0, 0, 0,     // idle cycles, lock engagements, bypassed responses
     0, 0, 0, 0, 0, 0, 0,  // unlocks: total + six reasons
     0,           // membership changes
+    0, 0,        // persistent fires / token piggybacks
     1, 1, 1, 1,  // pending/stalled tensors, reduce_threads, zc mode
     1, 1,        // topology probe ms / links measured
     1, 1,        // iouring mode / worker affinity
     1,           // steady-lock engaged gauge
     1, 1,        // membership epoch / hosts blacklisted
+    1,           // pre-posted recv buffers (persistent slot plan)
 };
 
 constexpr const char* kHistNames[] = {

@@ -12,6 +12,9 @@
 // see horovod_tpu/runtime.py. Host-tensor responses run natively
 // (LocalOps/TcpOps).
 
+#include <sched.h>
+#include <unistd.h>
+
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
@@ -67,6 +70,36 @@ bool CvWaitFor(std::condition_variable& cv,
       pred);
 }
 
+// Persistent locked hot-wait (steady_lock.h): while the steady lock
+// runs with persistent slot plans, ops arrive back-to-back by the
+// lock's own definition, so the two per-op thread handoffs (enqueue ->
+// background wake, fire -> synchronize wake) poll through a bounded
+// sched_yield window before parking on their condition variables —
+// each futex wake round trip skipped is scheduler latency off the
+// locked p50. The window matches the transport's 200 us yield budget
+// (no busy-spinning past it). Level 2 (TCP data plane only) lets the
+// synchronize side keep polling at 100 us sleeps past the window: a
+// cross-rank fire outlives the yield window, and on TCP the exchange
+// threads block off-CPU in recv so the poller's quanta are free. On
+// the shm plane the SAME extension is a net loss — the arena barriers
+// spin/sleep on-CPU and the poller steals their timeslices — so shm
+// stops at the yield window (level 1). Level 0 (off the persistent
+// plane, idle rank, or HOROVOD_STEADY_PERSISTENT=off) never spins:
+// the PR 15 wake path exactly.
+std::atomic<int> g_persistent_hot_wait{0};
+
+template <typename Pred>
+bool HotWaitPoll(Pred&& pred) {
+  if (g_persistent_hot_wait.load(std::memory_order_relaxed) < 1) return false;
+  const auto until =
+      std::chrono::steady_clock::now() + std::chrono::microseconds(200);
+  do {
+    if (pred()) return true;
+    sched_yield();
+  } while (std::chrono::steady_clock::now() < until);
+  return pred();
+}
+
 // ---- handle manager (reference horovod/torch/handle_manager.h:31-40)
 class HandleManager {
  public:
@@ -94,6 +127,20 @@ class HandleManager {
   // tier covers it).
   bool Wait(int64_t h, int timeout_ms, Status* out)
       HVD_NO_THREAD_SAFETY_ANALYSIS {
+    // Hot-wait: under the persistent locked plane a fire is a few
+    // scheduler quanta away (a cross-rank 4B slot runs ~300 us on the
+    // bench box — past the yield window), so ride the transport's full
+    // wait pattern: bounded sched_yield, then (level 2) 100 us sleep
+    // polls while the plane stays hot. The level dropping (unlock,
+    // knob off, loop exit) breaks to the classic futex park below,
+    // whose pred passes immediately when the poll already saw the
+    // completion.
+    if (timeout_ms < 0) {
+      HotWaitPoll([&] { return Poll(h); });
+      while (g_persistent_hot_wait.load(std::memory_order_relaxed) >= 2 &&
+             !Poll(h))
+        usleep(100);
+    }
     std::unique_lock<std::mutex> lock(mu_.native());
     auto pred = [&] {
       auto it = results_.find(h);
@@ -514,12 +561,31 @@ bool RunLockedIteration(GlobalState& st,
     return true;
   }
   if (step == LS::kWait) {
-    std::unique_lock<std::mutex> lk(st.wake_mu);
-    CvWaitFor(st.wake_cv, lk, std::chrono::milliseconds(kLockWaitTickMs),
-              [&] {
-                return st.tensor_queue.has_messages() ||
-                       st.shutdown_requested.load();
-              });
+    auto ready = [&] {
+      return st.tensor_queue.has_messages() || st.shutdown_requested.load();
+    };
+    // Hot-wait first (persistent plane only): the next enqueue usually
+    // lands within a quantum of the previous synchronize, and catching
+    // it in the yield window skips the enqueue->background futex wake.
+    // On the TCP plane (level 2) a miss keeps sleep-polling at 100 us
+    // up to the same kLockWaitTickMs bound the parked wait uses, so
+    // peer proposals / partial-slot timeouts are still inspected at
+    // tick cadence; the shm plane (level 1) parks after the window —
+    // its arena barriers need the quanta a poller would burn.
+    if (!HotWaitPoll(ready)) {
+      if (g_persistent_hot_wait.load(std::memory_order_relaxed) >= 2) {
+        const auto tick_end =
+            std::chrono::steady_clock::now() +
+            std::chrono::milliseconds(kLockWaitTickMs);
+        while (!ready() && std::chrono::steady_clock::now() < tick_end &&
+               g_persistent_hot_wait.load(std::memory_order_relaxed) >= 2)
+          usleep(100);
+      } else {
+        std::unique_lock<std::mutex> lk(st.wake_mu);
+        CvWaitFor(st.wake_cv, lk, std::chrono::milliseconds(kLockWaitTickMs),
+                  ready);
+      }
+    }
     return true;
   }
   // kUnlocked: pending work was requeued; negotiated cycles resume. A
@@ -537,7 +603,14 @@ void BackgroundThreadLoop(GlobalState& st) {
                                 std::memory_order_relaxed);
   const auto loop_epoch = std::chrono::steady_clock::now();
   while (true) {
-    if (st.controller->lock_engaged()) {
+    const bool locked = st.controller->lock_engaged();
+    const bool hot =
+        locked &&
+        st.controller->steady_persistent() == hvd::kSteadyPersistentAuto;
+    g_persistent_hot_wait.store(
+        hot ? (st.controller->data_plane_shm() ? 1 : 2) : 0,
+        std::memory_order_relaxed);
+    if (locked) {
       RunLockedIteration(st, loop_epoch);
       continue;
     }
@@ -677,6 +750,7 @@ void BackgroundThreadLoop(GlobalState& st) {
                 woken);
     }
   }
+  g_persistent_hot_wait.store(0, std::memory_order_relaxed);
   st.tensor_queue.FailAll(Status::Aborted("Horovod has been shut down"));
   st.timeline.Shutdown();
   st.background_thread_id.store(std::thread::id(),
@@ -868,6 +942,13 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
     // set changed and renegotiates. 0/garbage fall back to the default.
     st.controller->SetSteadyLockTimeout(hvd::EnvDoubleSane(
         "HOROVOD_STEADY_LOCK_TIMEOUT_SECONDS", 2.0));
+    // Persistent locked data plane (ISSUE 17): same sane-choice + sync
+    // discipline (param field 16) — the consensus-cell mapping and the
+    // per-slot inline verdicts both derive from it, and either one
+    // split across ranks would wedge the token rounds.
+    static const char* const kSteadyPersistentChoices[] = {"auto", "off"};
+    st.controller->SetSteadyPersistent(hvd::EnvChoiceSane(
+        "HOROVOD_STEADY_PERSISTENT", 0, kSteadyPersistentChoices, 2));
   }
   hvd::Status s = st.controller->Initialize();
   // The pool's budget follows the controller's POST-SYNC value: rank
@@ -1024,6 +1105,11 @@ void hvd_shutdown() {
   st.initialized.store(false);
 }
 
+// v13 (wire formats unchanged): persistent locked data plane — the
+// HOROVOD_STEADY_PERSISTENT knob (param field 16) with the
+// hvd_steady_persistent accessor and the hvd_tcp_prepost_buffers
+// gauge hook; metrics v8 adds ctrl_persistent_fires_total /
+// ctrl_token_piggybacks_total and the tcp_prepost_buffers gauge.
 // v12 (wire formats unchanged): membership plane — the
 // hvd_membership_* accessors over hvd/membership.h's epoch / fence /
 // active-rank state, the hvd_blacklist_* decay-blacklist surface, and
@@ -1280,6 +1366,13 @@ int64_t hvd_metrics_snapshot(int64_t* out, int64_t max_slots) {
             .count();
     reg.Set(hvd::kGaugeHostsBlacklisted, plane.BlacklistedCount(now_s));
   }
+  // Pre-posted recv buffers: only meaningful while the lock is
+  // engaged — the compiled plan dies with the lock session, so the
+  // gauge reads 0 the moment the job falls back to negotiation.
+  reg.Set(hvd::kGaugeTcpPrepostBuffers,
+          st.controller && st.controller->lock_engaged()
+              ? hvd::PrepostBufferGauge()
+              : 0);
   return reg.Snapshot(out, max_slots);
 }
 
@@ -1674,6 +1767,16 @@ int hvd_steady_lock_engaged() {
   auto& st = hvd::State();
   return st.controller && st.controller->lock_engaged() ? 1 : 0;
 }
+
+// Persistent locked data plane (docs/perf_tuning.md "Persistent
+// locked data plane"): the resolved HOROVOD_STEADY_PERSISTENT knob
+// (0 = auto, 1 = off — the coordinator-synced value, not the local
+// env wish) and the live pre-posted recv buffer count.
+int hvd_steady_persistent() {
+  auto& st = hvd::State();
+  return st.controller ? st.controller->steady_persistent() : 0;
+}
+int64_t hvd_tcp_prepost_buffers() { return hvd::PrepostBufferGauge(); }
 
 // Test hooks: drive the period detector (hvd/steady_lock.h) without
 // spawning ranks — tests/test_steady_lock.py pins the K/period/reset

@@ -421,6 +421,11 @@ TcpOps::TcpOps(Controller* controller, FusionBufferManager* fusion,
                << controller->local_size() << " local ranks) — "
                << "hierarchical allgather rides shared memory";
   }
+  // Tell the controller which plane fused allreduces ride: the
+  // inline-lock (token-piggyback) verdict in EngageLock needs the
+  // ALL-OR-NONE arena outcome, not just the env wish — and the
+  // AgreeAll above makes this the same answer on every rank.
+  controller->SetDataPlaneShm(shm_ != nullptr);
   // Sanitized parse (warn once per process, not per TcpOps rebuild —
   // elastic re-init constructs a fresh executor every epoch): atof's
   // 0.0 for garbage would make every barrier "time out" instantly and
@@ -479,6 +484,12 @@ Status TcpOps::Execute(const Response& response,
 
 Status TcpOps::Allreduce(const Response& r,
                          std::vector<TensorTableEntry>& entries) {
+  // Armed inline locked slot (hvd/steady_lock.h): the consensus token
+  // rides the first 8 bytes of this slot's data frames instead of a
+  // standalone round — the controller armed it only for slots whose
+  // eligibility every rank derived identically at lock time.
+  if (controller_->LockInlineArmed())
+    return InlineLockedAllreduce(r, entries);
   const int rank = controller_->rank();
   const int size = controller_->size();
   // Participation follows the response's contributor set (the
@@ -673,6 +684,199 @@ Status TcpOps::Allreduce(const Response& r,
       }
       off += bytes;
     }
+  }
+  if (timeline_) timeline_->ActivityEnd(tname);
+  return Status::OK();
+}
+
+// ---------------------------------------------------------------------------
+// Persistent locked data plane (hvd/steady_lock.h): the compiled slot
+// plan and the token-piggybacked inline firing.
+// ---------------------------------------------------------------------------
+
+void TcpOps::CompileLockPlan() {
+  const uint64_t gen = controller_->lock_generation();
+  if (plan_gen_ == gen) return;
+  plan_gen_ = gen;
+  plan_.clear();
+  const std::vector<Response>& ring = controller_->LockRing();
+  const int P = controller_->size();
+  plan_.resize(ring.size());
+  // Pass 1: geometry. Every inline slot pre-posts its receive buffers
+  // for the WHOLE lock session — P per-rank value arrays plus their
+  // double-buffer twins, 64-aligned so no two ranks' arrays share a
+  // cache line during the simulated combine.
+  int64_t total = 0;
+  for (size_t i = 0; i < ring.size(); ++i) {
+    if (!controller_->LockInlineOk(i)) continue;
+    SlotPlan& pl = plan_[i];
+    pl.inline_ok = true;
+    pl.bytes = controller_->LockInlineBytes(i);
+    pl.stride = (pl.bytes + 63) & ~int64_t{63};
+    pl.elems = 0;
+    for (auto n : ring[i].tensor_sizes) pl.elems += n;
+    total += 2 * static_cast<int64_t>(P) * pl.stride;
+  }
+  // Pass 2: carve ONE kPrepost slab (grow-only, so a re-lock with the
+  // same ring reuses the warm pages) and pin each slot's worker plan.
+  int64_t preposted = 0;
+  if (total > 0) {
+    uint8_t* slab = pool_.Get(BufferPool::kPrepost, total);
+    int64_t off = 0;
+    for (auto& pl : plan_) {
+      if (!pl.inline_ok) continue;
+      pl.val = slab + off;
+      off += P * pl.stride;
+      pl.next = slab + off;
+      off += P * pl.stride;
+      pl.accum = PlanParts(pl.elems, pl.bytes);
+      preposted += P - 1;  // one posted recv buffer per peer per slot
+    }
+  }
+  SetPrepostBufferGauge(preposted);
+}
+
+Status TcpOps::InlineLockedAllreduce(const Response& r,
+                                     std::vector<TensorTableEntry>& entries) {
+  CompileLockPlan();
+  const size_t pos = controller_->LockPos();
+  SlotPlan* pl = pos < plan_.size() ? &plan_[pos] : nullptr;
+  if (pl == nullptr || !pl->inline_ok || entries.empty()) {
+    // Unreachable by construction (armed implies the slot compiled
+    // inline on every rank); fail safe by restoring the entries and
+    // unlocking rather than executing on a plan we do not have.
+    controller_->LockInlineAbort(kUnlockMismatch, std::move(entries));
+    entries.clear();
+    return Status::OK();
+  }
+  const int rank = controller_->rank();
+  const int P = controller_->size();
+  const DataType dtype = r.tensor_type;
+  const int64_t bytes = pl->bytes;
+  const std::string tname = entries.front().name;
+  MetricAdd(kCtrTcpOps);
+  MetricAdd(kCtrTcpBytes, bytes);
+  MetricAdd(kCtrAlgoDoublingOps);
+
+  // Pack + prescale straight into my pre-posted value array — the
+  // same staging the classic path does into the fusion buffer, so the
+  // bytes entering the exchange are identical.
+  if (timeline_) timeline_->ActivityStart(tname, ACT_MEMCPY_IN_FUSION_BUFFER);
+  uint8_t* mine = pl->val + static_cast<int64_t>(rank) * pl->stride;
+  int64_t off = 0;
+  for (auto& e : entries) {
+    const int64_t b = e.shape.num_elements() * DataTypeSize(e.dtype);
+    std::memcpy(mine + off, e.data, b);
+    if (e.prescale_factor != 1.0)
+      HostScale(e.dtype, mine + off, e.shape.num_elements(),
+                e.prescale_factor);
+    off += b;
+  }
+  if (timeline_) timeline_->ActivityEnd(tname);
+
+  // Flat all-to-all, token on the first frame: ONE vectored send per
+  // peer carries [8B FIRE token][payload] (≤ 4 KB + 8 B — inside the
+  // no-block socket budget, so send-all-then-recv-all cannot
+  // deadlock), then one token (+ conditional payload) recv per peer.
+  // Any link error tears the job down exactly like the standalone
+  // token round — a peer holding our FIRE may already be executing
+  // the slot, so the only safe exit is the fail-fast teardown.
+  if (timeline_) timeline_->ActivityStart(tname, ACT_TCP_ALLREDUCE);
+  LockToken tok;
+  tok.fire = 1;
+  tok.reason = 0;
+  tok.slot = controller_->LockSlotIndex();
+  auto link_fatal = [&]() {
+    LOG_ERROR << "inline locked firing lost a data link; tearing the "
+                 "job down";
+    if (timeline_) timeline_->ActivityEnd(tname);
+    controller_->LockFatalTeardown();
+    controller_->LockInlineAbort(kUnlockShutdown, std::move(entries));
+    entries.clear();
+    return Status::OK();
+  };
+  for (int peer = 0; peer < P; ++peer) {
+    if (peer == rank) continue;
+    TcpConn* c = controller_->DataConn(peer);
+    if (c == nullptr || !c->valid() || !c->SendTokenFrame(&tok, mine, bytes))
+      return link_fatal();
+  }
+  bool all_fire = true;
+  int reason = kUnlockPeer;
+  for (int peer = 0; peer < P; ++peer) {
+    if (peer == rank) continue;
+    TcpConn* c = controller_->DataConn(peer);
+    LockToken t;
+    if (c == nullptr || !c->valid() || !c->RecvAll(&t, sizeof(t)))
+      return link_fatal();
+    if (t.fire == 1) {
+      // FIRE: the payload is glued behind the token — it lands in the
+      // peer's pre-posted value array whether or not the round still
+      // commits (an earlier UNLOCK vote just means we drain it).
+      uint8_t* dst = pl->val + static_cast<int64_t>(peer) * pl->stride;
+      if (!c->RecvAll(dst, bytes)) return link_fatal();
+      if (t.slot != tok.slot) {
+        LOG_WARNING << "inline locked slot skew (peer " << peer << ": "
+                    << t.slot << " vs " << tok.slot << "); unlocking";
+        all_fire = false;
+      }
+    } else {
+      all_fire = false;
+      if (reason == kUnlockPeer && t.reason < kNumUnlockReasons)
+        reason = t.reason;  // propagate the initiating cause
+    }
+  }
+  if (!all_fire) {
+    if (timeline_) timeline_->ActivityEnd(tname);
+    controller_->LockInlineAbort(reason, std::move(entries));
+    entries.clear();
+    return Status::OK();
+  }
+  // All-FIRE consensus: commit (slot advances, both persistent-plane
+  // metrics count) before the local combine — the wire work is done.
+  controller_->LockInlineCommit();
+
+  // Locally SIMULATE the recursive-doubling exchange for every rank:
+  // per round d, next[q] = val[q] then HostAccumulate(val[q^d]) —
+  // exactly the classic engine's "recv partner's pre-round buffer,
+  // accumulate into mine" computation graph, replicated for all P
+  // positions. Elementwise accumulates are deterministic under any
+  // partitioning, so val[rank] after log2(P) rounds is bit-identical
+  // to the classic path's result buffer. The accumulate split rides
+  // the plan pinned at lock time (parts == 1 at inline sizes).
+  uint8_t* val = pl->val;
+  uint8_t* next = pl->next;
+  const int64_t esz = DataTypeSize(dtype);
+  for (int d = 1; d < P; d *= 2) {
+    for (int q = 0; q < P; ++q) {
+      uint8_t* dst = next + static_cast<int64_t>(q) * pl->stride;
+      const uint8_t* own = val + static_cast<int64_t>(q) * pl->stride;
+      const uint8_t* peer = val + static_cast<int64_t>(q ^ d) * pl->stride;
+      ParallelForPlanned(pl->accum, [&](int64_t lo, int64_t hi) {
+        std::memcpy(dst + lo * esz, own + lo * esz, (hi - lo) * esz);
+        HostAccumulate(r.reduce_op, dtype, peer + lo * esz, dst + lo * esz,
+                       hi - lo);
+      });
+    }
+    std::swap(val, next);
+  }
+  if (timeline_) timeline_->ActivityEnd(tname);
+
+  // Unpack with postscale — the classic path's epilogue verbatim.
+  if (timeline_)
+    timeline_->ActivityStart(tname, ACT_MEMCPY_OUT_FUSION_BUFFER);
+  const uint8_t* src = val + static_cast<int64_t>(rank) * pl->stride;
+  off = 0;
+  for (auto& e : entries) {
+    const int64_t n = e.shape.num_elements();
+    const int64_t b = n * DataTypeSize(e.dtype);
+    if (e.output) {
+      std::memcpy(e.output, src + off, b);
+      double factor = e.postscale_factor;
+      if (e.reduce_op == ReduceOp::AVERAGE) factor /= P;
+      if (factor != 1.0) HostScale(e.dtype, e.output, n, factor);
+    }
+    off += b;
   }
   if (timeline_) timeline_->ActivityEnd(tname);
   return Status::OK();

@@ -7,7 +7,9 @@
 
 #include <errno.h>
 #include <poll.h>
+#include <sched.h>
 #include <sys/socket.h>
+#include <unistd.h>
 
 #include <algorithm>
 #include <cstring>
@@ -15,6 +17,7 @@
 #include "hvd/controller.h"
 #include "hvd/logging.h"
 #include "hvd/metrics.h"
+#include "hvd/schedule.h"
 
 namespace hvd {
 
@@ -153,16 +156,21 @@ constexpr MetricCounter kUnlockReasonCounters[kNumUnlockReasons] = {
     kCtrUnlocksPeer,     kCtrUnlocksTunables, kCtrUnlocksPartial,
 };
 
-// 8-byte lock token exchanged on the data links, one per rank per
-// locked slot: all-FIRE executes the slot, anything else ends the
-// lock everywhere with the carried reason.
-struct LockToken {
-  uint8_t fire = 0;  // 1 = FIRE, 2 = UNLOCK
-  uint8_t reason = 0;
-  uint8_t pad[2] = {0, 0};
-  uint32_t slot = 0;
+// Shared-memory consensus cell (ISSUE 17): each rank's 64-byte arena
+// slot holds TWO parity-alternating seqlock cells. A round-r vote is
+// published by storing the token (one atomic 8-byte word — no
+// tearing) into cell[r & 1] and then the round number with release
+// order; readers wait for seq >= r with acquire order. Cell r is
+// stable until round r+2, and a rank can only REACH round r+2 after
+// this reader itself completed round r+1 — so a plain load after the
+// seq check always observes the intended round's token.
+struct LockCell {
+  std::atomic<uint64_t> seq;
+  std::atomic<uint64_t> tok;
 };
-static_assert(sizeof(LockToken) == 8, "lock token must be 8 bytes");
+static_assert(sizeof(LockCell) == 16, "lock cell must be 16 bytes");
+static_assert(2 * sizeof(LockCell) <= kLockCellSlotBytes,
+              "both parity cells must fit the arena slot");
 }  // namespace
 
 void Controller::LockObserveCycle(bool pure, bool quiescent,
@@ -205,10 +213,63 @@ void Controller::EngageLock(const std::vector<Response>& ring) {
   lock_matcher_.SetRing(ring);
   lock_raw_pending_.clear();
   lock_slot_timer_armed_ = false;
+  // Persistent slot plan (ISSUE 17): a new lock session invalidates
+  // any compiled plan, and every slot gets its inline (token-on-
+  // first-frame) verdict HERE, from synced values only — persistent
+  // knob (param field 16), the all-or-none data-plane verdict, and
+  // the resolved response geometry — so the verdict vector is
+  // identical on every rank by construction.
+  ++lock_generation_;
+  lock_inline_armed_ = false;
+  lock_inline_ok_.assign(ring.size(), 0);
+  lock_inline_bytes_.assign(ring.size(), 0);
+  const bool pow2 = size_ > 1 && (size_ & (size_ - 1)) == 0;
+  const bool plane_ok = steady_persistent_knob_ == kSteadyPersistentAuto &&
+                        !data_plane_shm_ && pow2;
+  for (size_t i = 0; plane_ok && i < ring.size(); ++i) {
+    const Response& r = ring[i];
+    const int64_t bytes = r.TotalByteSize();
+    // Inline = the flat all-to-all with a locally-simulated doubling
+    // combine; it must reproduce the classic dispatch bit for bit, so
+    // only uncompressed full-world recursive-doubling ALLREDUCEs that
+    // fit the no-block send budget qualify. Everything else keeps the
+    // PR 15 consensus round (cells or classic tokens).
+    if (r.response_type != ResponseType::ALLREDUCE) continue;
+    if (r.reduce_op == ReduceOp::ADASUM) continue;
+    if (!r.contributors.empty() &&
+        static_cast<int>(r.contributors.size()) != size_)
+      continue;
+    if (r.wire_codec > 0) continue;
+    if (r.collective_algo != kAlgoDoubling) continue;
+    if (bytes <= 0 || bytes > kInlineMaxBytes) continue;
+    lock_inline_ok_[i] = 1;
+    lock_inline_bytes_[i] = bytes;
+  }
   lock_engaged_.store(true, std::memory_order_relaxed);
   MetricAdd(kCtrLocks);
   LOG_DEBUG << "steady-state lock engaged: ring of " << ring.size()
             << " fused response(s)";
+}
+
+void Controller::LockInlineCommit() {
+  lock_inline_armed_ = false;
+  lock_matcher_.AdvanceSlot();
+  lock_slot_timer_armed_ = false;
+  MetricAdd(kCtrPersistentFires);
+  MetricAdd(kCtrTokenPiggybacks);
+}
+
+void Controller::LockInlineAbort(int reason,
+                                 std::vector<TensorTableEntry> entries) {
+  lock_inline_armed_ = false;
+  // The armed slot never advanced, so its fed bits are still in the
+  // matcher pool: UnlockNow re-announces them as full requests. The
+  // executor hands back the entries it already popped — restoring
+  // them here (without announcing) makes the requeue exactly-once:
+  // one entry record, one re-announced request per tensor.
+  if (!entries.empty() && deps_.tensor_queue != nullptr)
+    deps_.tensor_queue->AddToTensorQueue(std::move(entries), {});
+  UnlockNow(reason);
 }
 
 void Controller::UnlockNow(int reason) {
@@ -226,6 +287,9 @@ void Controller::UnlockNow(int reason) {
   lock_matcher_.Clear();
   lock_detector_.Reset();
   lock_slot_timer_armed_ = false;
+  lock_inline_armed_ = false;
+  lock_inline_ok_.clear();
+  lock_inline_bytes_.clear();
   lock_engaged_.store(false, std::memory_order_relaxed);
   if (!requeue.empty() && deps_.tensor_queue != nullptr)
     deps_.tensor_queue->AddToTensorQueue({}, std::move(requeue));
@@ -296,6 +360,28 @@ Controller::LockStep Controller::LockedPhaseStep(
 
   const bool my_fire = trigger < 0;
   int reason = my_fire ? kUnlockPeer : trigger;
+  const bool inline_slot =
+      lock_matcher_.has_ring() && LockInlineOk(lock_matcher_.pos());
+  if (inline_slot && my_fire) {
+    // Deferred consensus: the FIRE token rides the first 8 bytes of
+    // each peer's data frame (zero extra round trips). The executor
+    // reports the slot's outcome via LockInlineCommit/Abort — the
+    // slot does NOT advance here, so an abort requeues its bits.
+    lock_inline_armed_ = true;
+    *fire = lock_matcher_.Slot();
+    return LockStep::kFired;
+  }
+  if (inline_slot) {
+    // Unlock vote on an inline slot: the standalone token is still
+    // the deterministic teardown channel, but peers may already be
+    // mid-inline-firing — the round drains their piggybacked payload
+    // frames so the streams stay framed for the negotiated plane.
+    LockInlineUnlockRound(lock_matcher_.slot_index(),
+                          LockInlineBytes(lock_matcher_.pos()), trigger,
+                          shutdown_flag, &reason, fatal);
+    UnlockNow(reason);
+    return LockStep::kUnlocked;
+  }
   const std::string waitname = lock_matcher_.has_ring() &&
                                        !lock_matcher_.Slot().tensor_names.empty()
                                    ? lock_matcher_.Slot().tensor_names.front()
@@ -327,6 +413,14 @@ bool TcpController::LockTokenRound(uint32_t slot, bool my_fire, int my_reason,
     if (!my_fire) *out_reason = my_reason;
     return my_fire;
   }
+  // Persistent plane: when the consensus cells mapped at init (single
+  // host, persistent=auto, AgreeAll'd) EVERY round rides them — the
+  // choice is a synced init verdict, so no rank can split between the
+  // cell and socket transports. A poisoned arena (dead peer mid-wait)
+  // tears down exactly like a lost data link.
+  if (lock_cells_ != nullptr)
+    return CellTokenRound(slot, my_fire, my_reason, waitname, shutdown_flag,
+                          out_reason, fatal);
   LockToken mine;
   mine.fire = my_fire ? 1 : 2;
   mine.reason = static_cast<uint8_t>(my_reason);
@@ -451,8 +545,197 @@ bool TcpController::LockTokenRound(uint32_t slot, bool my_fire, int my_reason,
   return all_fire;
 }
 
+// Token consensus over the shared-memory cells: publish my vote with
+// one release store, then wait for every peer's — pure loads in the
+// steady state, zero syscalls. The wait copies ShmArena::Barrier's
+// discipline (a short sched_yield window, then usleep(100)) and runs
+// the same tick work as the socket round: stall-inspector feeds, the
+// 30s shutdown grace, and peer liveness (pids + poison) so a SIGKILL
+// mid-round tears the job down instead of wedging it.
+bool TcpController::CellTokenRound(uint32_t slot, bool my_fire, int my_reason,
+                                   const std::string& waitname,
+                                   const std::atomic<bool>* shutdown_flag,
+                                   int* out_reason, bool* fatal) {
+  const uint64_t round = ++lock_round_;
+  auto cell_at = [&](int r) {
+    return reinterpret_cast<LockCell*>(lock_cells_->slot(r)) + (round & 1);
+  };
+  LockToken mine;
+  mine.fire = my_fire ? 1 : 2;
+  mine.reason = static_cast<uint8_t>(my_reason);
+  mine.slot = slot;
+  uint64_t mine_bits = 0;
+  std::memcpy(&mine_bits, &mine, sizeof(mine));
+  LockCell* me = cell_at(rank_);
+  me->tok.store(mine_bits, std::memory_order_relaxed);
+  me->seq.store(round, std::memory_order_release);
+
+  bool all_fire = my_fire;
+  *out_reason = my_fire ? kUnlockPeer : my_reason;
+  auto teardown_fatal = [&](int reason) {
+    for (auto& c : ctrl_conns_) c.Close();
+    for (auto& c : data_conns_) c.Close();
+    for (auto& c : mesh_conns_) c.Close();
+    *fatal = true;
+    *out_reason = reason;
+    return false;
+  };
+
+  bool stall_recorded = false;
+  std::chrono::steady_clock::time_point shutdown_since{};
+  for (int peer = 0; peer < size_; ++peer) {
+    if (peer == rank_) continue;
+    LockCell* c = cell_at(peer);
+    auto now = std::chrono::steady_clock::now();
+    auto spin_until = now + std::chrono::microseconds(200);
+    auto next_tick = now + std::chrono::milliseconds(kLockTokenTickMs);
+    uint64_t seq;
+    while ((seq = c->seq.load(std::memory_order_acquire)) < round) {
+      now = std::chrono::steady_clock::now();
+      if (now >= next_tick) {
+        next_tick = now + std::chrono::milliseconds(kLockTokenTickMs);
+        // Same tick work as the socket round: a silent peer must show
+        // up in hvd.stalled_tensors(), a SIGKILLed one must kill the
+        // round (the cells cannot deliver EOF), and a requested
+        // shutdown is granted after the 30s grace.
+        if (lock_cells_->poisoned() || !lock_cells_->PeersAlive()) {
+          LOG_ERROR << "steady-lock cell round lost a peer; tearing the "
+                       "job down";
+          return teardown_fatal(kUnlockShutdown);
+        }
+        if (deps_.stall_inspector != nullptr) {
+          stall_recorded = true;
+          for (int r = 0; r < size_; ++r)
+            if (r < peer || r == rank_)
+              deps_.stall_inspector->RecordUncachedTensor(waitname, r);
+          if (deps_.stall_inspector->CheckForStalledTensors(size_)) {
+            LOG_ERROR << "steady-lock cell wait exceeded the stall "
+                         "shutdown threshold; tearing down the data links";
+            return teardown_fatal(kUnlockShutdown);
+          }
+        }
+        if (shutdown_flag != nullptr &&
+            shutdown_flag->load(std::memory_order_relaxed)) {
+          if (shutdown_since == std::chrono::steady_clock::time_point{}) {
+            shutdown_since = now;
+          } else if (now - shutdown_since > std::chrono::seconds(30)) {
+            return teardown_fatal(kUnlockShutdown);
+          }
+        }
+      }
+      if (now < spin_until)
+        sched_yield();
+      else
+        usleep(100);
+    }
+    if (seq > round) {
+      // Skew: the peer already completed this round and published a
+      // later one. It can only have advanced past round r after an
+      // all-FIRE consensus at r (an unlock ends the session, and a
+      // re-lock cannot happen while this rank still sits here), so
+      // the missed vote was necessarily FIRE for our slot.
+      continue;
+    }
+    uint64_t bits = c->tok.load(std::memory_order_relaxed);
+    LockToken t;
+    std::memcpy(static_cast<void*>(&t), &bits, sizeof(t));
+    if (t.fire != 1) {
+      all_fire = false;
+      if (*out_reason == kUnlockPeer && t.reason < kNumUnlockReasons)
+        *out_reason = t.reason;  // propagate the initiating cause
+    } else if (t.slot != slot) {
+      LOG_WARNING << "steady-lock cell slot skew (peer " << peer << ": "
+                  << t.slot << " vs " << slot << "); unlocking";
+      all_fire = false;
+      *out_reason = kUnlockPeer;
+    }
+  }
+  if (stall_recorded && deps_.stall_inspector != nullptr)
+    deps_.stall_inspector->RemoveUncachedTensor(waitname);
+  if (all_fire) MetricAdd(kCtrPersistentFires);
+  return all_fire;
+}
+
+// Standalone-token unlock round for an inline slot: votes ride the
+// sockets exactly like PR 15 (the cells never exist on the TCP data
+// plane), but FIRE peers have a payload glued to their token — drain
+// it so the byte streams stay framed for the negotiated plane.
+void TcpController::LockInlineUnlockRound(
+    uint32_t slot, int64_t payload_bytes, int my_reason,
+    const std::atomic<bool>* shutdown_flag, int* out_reason, bool* fatal) {
+  (void)shutdown_flag;
+  *fatal = false;
+  *out_reason = my_reason;
+  if (size_ <= 1) return;
+  LockToken mine;
+  mine.fire = 2;
+  mine.reason = static_cast<uint8_t>(my_reason);
+  mine.slot = slot;
+  auto teardown_fatal = [&] {
+    LOG_ERROR << "steady-lock inline unlock lost a data link; tearing "
+                 "the job down";
+    for (auto& c : ctrl_conns_) c.Close();
+    for (auto& c : data_conns_) c.Close();
+    for (auto& c : mesh_conns_) c.Close();
+    *fatal = true;
+    *out_reason = kUnlockShutdown;
+  };
+  std::vector<TcpConn*> conns(size_, nullptr);
+  for (int peer = 0; peer < size_; ++peer) {
+    if (peer == rank_) continue;
+    conns[peer] = DataConn(peer);
+    if (conns[peer] == nullptr || !conns[peer]->valid() ||
+        !conns[peer]->SendAll(&mine, sizeof(mine)))
+      return teardown_fatal();
+  }
+  std::vector<uint8_t> drain(static_cast<size_t>(
+      payload_bytes > 0 ? payload_bytes : 0));
+  for (int peer = 0; peer < size_; ++peer) {
+    if (peer == rank_) continue;
+    LockToken t;
+    if (!conns[peer]->RecvAll(&t, sizeof(t))) return teardown_fatal();
+    if (t.fire == 1) {
+      // The peer armed inline before seeing our unlock: its payload
+      // is already in flight behind the token.
+      if (!drain.empty() &&
+          !conns[peer]->RecvAll(drain.data(), drain.size()))
+        return teardown_fatal();
+    } else if (t.reason < kNumUnlockReasons && my_reason == kUnlockPeer) {
+      *out_reason = t.reason;  // propagate the initiating cause
+    }
+  }
+}
+
+void TcpController::LockFatalTeardown() {
+  for (auto& c : ctrl_conns_) c.Close();
+  for (auto& c : data_conns_) c.Close();
+  for (auto& c : mesh_conns_) c.Close();
+}
+
 bool TcpController::LockPeerProposedUnlock() {
   if (size_ <= 1) return false;
+  // Persistent cells: a peer that entered the NEXT consensus round
+  // publishes its vote in the round's parity cell — a pure load peek.
+  // A FIRE vote is a peer waiting out our slot feed (keep waiting); an
+  // UNLOCK vote (or a later round having completed — impossible
+  // without us — or a dead peer) proposes teardown. The socket peek
+  // below still runs either way: inline-slot unlock votes ride the
+  // sockets even when cells exist.
+  if (lock_cells_ != nullptr) {
+    if (lock_cells_->poisoned() || !lock_cells_->PeersAlive()) return true;
+    const uint64_t next_round = lock_round_ + 1;
+    for (int peer = 0; peer < size_; ++peer) {
+      if (peer == rank_) continue;
+      LockCell* c =
+          reinterpret_cast<LockCell*>(lock_cells_->slot(peer)) +
+          (next_round & 1);
+      if (c->seq.load(std::memory_order_acquire) < next_round) continue;
+      uint64_t bits = c->tok.load(std::memory_order_relaxed);
+      LockToken t;
+      std::memcpy(static_cast<void*>(&t), &bits, sizeof(t));
+      if (t.fire != 1) return true;
+    }
+  }
   // During locked idle the only bytes a peer can have in flight on a
   // data link are its token for OUR current slot (it cannot pass the
   // slot without our vote) — an 8-byte MSG_PEEK reads a whole token

@@ -449,6 +449,21 @@ const char* IouringModeName(int mode) {
   return mode == kIouringBatched ? "batched" : "syscall";
 }
 
+// tcp_prepost_buffers gauge backing store. Written by the executor
+// when a persistent slot plan is compiled/torn down, read by
+// hvd_metrics_snapshot — relaxed is enough for a monitoring gauge.
+namespace {
+std::atomic<int64_t> g_prepost_buffers{0};
+}  // namespace
+
+void SetPrepostBufferGauge(int64_t n) {
+  g_prepost_buffers.store(n, std::memory_order_relaxed);
+}
+
+int64_t PrepostBufferGauge() {
+  return g_prepost_buffers.load(std::memory_order_relaxed);
+}
+
 int ResolvedTransportMode() {
   // Decided once per process (the data plane asks per send): the env
   // wish sanitized like every other knob, then a live end-to-end
@@ -894,6 +909,18 @@ bool TcpConn::SendFrame(const void* data, uint64_t len) {
   struct iovec iov[2] = {{&hdr, sizeof(hdr)},
                          {const_cast<void*>(data), static_cast<size_t>(len)}};
   return SendV(iov, len == 0 ? 1 : 2);
+}
+
+bool TcpConn::SendTokenFrame(const void* token, const void* payload,
+                             uint64_t payload_len) {
+  // The 8-byte consensus token leads the slot's payload in ONE
+  // vectored send — the SendFrame header-fold applied to the lock
+  // token, so a persistent locked firing costs no packet (and no
+  // syscall) beyond the bare payload it had to push anyway.
+  struct iovec iov[2] = {
+      {const_cast<void*>(token), 8},
+      {const_cast<void*>(payload), static_cast<size_t>(payload_len)}};
+  return SendV(iov, payload_len == 0 ? 1 : 2);
 }
 
 bool TcpConn::RecvFrame(std::string* out) {

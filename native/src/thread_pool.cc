@@ -45,6 +45,22 @@ int ParallelParts(int64_t bytes) {
       std::min<int64_t>(threads, bytes / kMinParallelBytes));
 }
 
+WorkerPlan PlanParts(int64_t n, int64_t bytes) {
+  WorkerPlan plan;
+  plan.n = n;
+  // Same resolve as the per-op path, additionally clamped by n so a
+  // plan never publishes more parts than elements (empty ranges are
+  // harmless but pointless to wake workers for).
+  plan.parts = static_cast<int>(
+      std::min<int64_t>(std::max<int64_t>(1, n), ParallelParts(bytes)));
+  return plan;
+}
+
+void ParallelForPlanned(const WorkerPlan& plan,
+                        const std::function<void(int64_t, int64_t)>& fn) {
+  WorkerPool::Get().ParallelFor(plan.parts, plan.n, fn);
+}
+
 WorkerPool& WorkerPool::Get() {
   static WorkerPool* pool = new WorkerPool();
   return *pool;

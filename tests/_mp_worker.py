@@ -1379,7 +1379,13 @@ def main():
                 outs[0], float(s * i) + s * (s - 1) / 2.0, rtol=1e-6)
             np.testing.assert_allclose(outs[1], float(s * (s - 1)),
                                        rtol=1e-6)
-        assert hvd.steady_lock_engaged(), "no re-lock on the fused loop"
+            if i == 2 * (K + 4) - 2:
+                # Asserted BEFORE the last group: a faster peer's
+                # exit-time shutdown unlock (near-instant on the
+                # persistent cells plane) races a post-loop flag read,
+                # but it cannot exit before this rank fires the final
+                # slot.
+                assert hvd.steady_lock_engaged(), "no re-lock (fused)"
         print(f"OK rank={r}")
 
     elif scenario == "lock_off":
@@ -1403,10 +1409,16 @@ def main():
         # would wait forever for rank 1's ring slot — the joiner's
         # UNLOCK token must tear the lock down on every rank and the
         # resumed negotiation completes with the joined rank absent.
-        for i in range(8):  # fixed count: engaged by op 6 (see lock_steady)
+        for i in range(7):  # fixed count: engaged by op 6 (see lock_steady)
             hvd.allreduce(np.full(4, float(r + 1), np.float32),
                           op=hvd.Sum, name="lkj")
+        # Asserted BEFORE the last pre-join op: rank 1 cannot reach
+        # join() (whose unlock races this flag read — near-instantly
+        # on the persistent cells plane) until op 8 completes, and op
+        # 8 cannot complete before this rank fires it.
         assert hvd.steady_lock_engaged(), "lock never engaged"
+        hvd.allreduce(np.full(4, float(r + 1), np.float32),
+                      op=hvd.Sum, name="lkj")
         if r == 1:
             hvd.join()
             m = hvd.metrics()
@@ -1529,10 +1541,14 @@ def main():
         import signal
         import time as _t
 
-        for i in range(8):  # fixed count: engaged by op 6 (see lock_steady)
+        for i in range(7):  # fixed count: engaged by op 6 (see lock_steady)
             hvd.allreduce(np.full(4, 1.0, np.float32), op=hvd.Sum,
                           name="lkx")
+        # Asserted BEFORE the last op: the victim cannot die (whose
+        # EOF/poison unlock races this flag read) until op 8 fires.
         assert hvd.steady_lock_engaged(), "lock never engaged"
+        hvd.allreduce(np.full(4, 1.0, np.float32), op=hvd.Sum,
+                      name="lkx")
         if r == s - 1:
             os.kill(os.getpid(), signal.SIGKILL)
         t0 = _t.monotonic()
@@ -1571,6 +1587,208 @@ def main():
         assert m["ctrl_locks_total"] >= 3, m
         assert m["ctrl_unlocks_mismatch_total"] >= 2, m
         print(f"OK rank={r}")
+
+    elif scenario == "lock_persistent":
+        # Persistent locked data plane (ISSUE 17): every locked
+        # firing's token consensus rides the persistent plane — the
+        # shared-memory cells on the single-host default, the inline
+        # first-frame piggyback on the TCP plane (HOROVOD_SHM_DISABLE=1
+        # + pow2 np + payload <= kInlineMaxBytes). With
+        # HOROVOD_STEADY_PERSISTENT=off the identical loop must run
+        # the classic per-slot socket token round: zero persistent
+        # metrics, same values.
+        tcp_plane = os.environ.get("HOROVOD_SHM_DISABLE") == "1"
+        knob_off = os.environ.get("HOROVOD_STEADY_PERSISTENT") == "off"
+        for i in range(7):  # fixed count: engaged by op 6 (lock_steady)
+            out = hvd.allreduce(np.full(8, float(r + i), np.float32),
+                                op=hvd.Sum, name="lp")
+            np.testing.assert_allclose(
+                out, float(s * i) + s * (s - 1) / 2.0, rtol=1e-6)
+        assert hvd.steady_lock_engaged(), "lock never engaged"
+        for i in range(10):
+            out = hvd.allreduce(np.full(8, float(r + i), np.float32),
+                                op=hvd.Sum, name="lp")
+            np.testing.assert_allclose(
+                out, float(s * i) + s * (s - 1) / 2.0, rtol=1e-6)
+        m = hvd.metrics()
+        assert m["ctrl_locked"] == 1, m
+        if knob_off:
+            assert m["ctrl_persistent_fires_total"] == 0, m
+            assert m["ctrl_token_piggybacks_total"] == 0, m
+            assert m["tcp_prepost_buffers"] == 0, m
+        else:
+            assert m["ctrl_persistent_fires_total"] >= 5, m
+            if tcp_plane:
+                # 8 floats = 32B at pow2 np: every locked firing
+                # piggybacks its FIRE token on the first data frame,
+                # and the compiled plan pre-posts one recv buffer per
+                # peer for the single-slot ring.
+                assert m["ctrl_token_piggybacks_total"] >= 5, m
+                assert m["tcp_prepost_buffers"] == s - 1, m
+            else:
+                # Cells plane: no TCP data frames to piggyback on.
+                assert m["ctrl_token_piggybacks_total"] == 0, m
+        # Deterministic unlock (shape change): the gauge drops with
+        # the lock, values stay right, and the loop re-locks on the
+        # new shape with the persistent plane following.
+        out = hvd.allreduce(np.full(3, 1.0, np.float32), op=hvd.Sum,
+                            name="lp")
+        np.testing.assert_allclose(out, float(s))
+        assert not hvd.steady_lock_engaged()
+        assert hvd.metrics()["tcp_prepost_buffers"] == 0
+        p0 = hvd.metrics()["ctrl_persistent_fires_total"]
+        for i in range(11):
+            out = hvd.allreduce(np.full(3, float(r), np.float32),
+                                op=hvd.Sum, name="lp")
+            np.testing.assert_allclose(out, s * (s - 1) / 2.0, rtol=1e-6)
+        # Asserted BEFORE the last op: a faster peer's exit-time
+        # shutdown unlock races a post-loop flag read (near-instantly
+        # over the cells), but no peer can exit before this rank fires
+        # the final slot.
+        assert hvd.steady_lock_engaged(), "no re-lock"
+        if not knob_off:
+            assert hvd.metrics()["ctrl_persistent_fires_total"] > p0
+        out = hvd.allreduce(np.full(3, float(r), np.float32),
+                            op=hvd.Sum, name="lp")
+        np.testing.assert_allclose(out, s * (s - 1) / 2.0, rtol=1e-6)
+        print(f"OK rank={r}")
+
+    elif scenario == "persistent_mismatch":
+        # Inline abort + exactly-once requeue (ISSUE 17, np=2 TCP
+        # plane): rank 0 arms the token-piggybacked slot and fires its
+        # first frame; rank 1 feeds a different tensor first, so its
+        # match fails and its UNLOCK token answers rank 0's posted
+        # recv. Rank 0 must abort the armed slot and requeue the
+        # fed-but-unfired tensor EXACTLY once — the values below are
+        # wrong if it fires twice and the job hangs if it is dropped.
+        import time as _t
+
+        for i in range(8):
+            out = hvd.allreduce(np.full(4, float(r + i), np.float32),
+                                op=hvd.Sum, name="pm")
+            np.testing.assert_allclose(
+                out, float(s * i) + s * (s - 1) / 2.0, rtol=1e-6)
+        assert hvd.steady_lock_engaged(), "lock never engaged"
+        if r == 1:
+            _t.sleep(0.3)  # let rank 0 arm + fire before the mismatch
+            hs = [hvd.allreduce_async(np.full(2, 1.0, np.float32),
+                                      op=hvd.Sum, name="pm.other"),
+                  hvd.allreduce_async(np.full(4, float(r), np.float32),
+                                      op=hvd.Sum, name="pm")]
+            other, mine = hvd.synchronize(hs[0]), hvd.synchronize(hs[1])
+        else:
+            hs = [hvd.allreduce_async(np.full(4, float(r), np.float32),
+                                      op=hvd.Sum, name="pm"),
+                  hvd.allreduce_async(np.full(2, 1.0, np.float32),
+                                      op=hvd.Sum, name="pm.other")]
+            mine, other = hvd.synchronize(hs[0]), hvd.synchronize(hs[1])
+        np.testing.assert_allclose(mine, s * (s - 1) / 2.0, rtol=1e-6)
+        np.testing.assert_allclose(other, float(s))
+        assert not hvd.steady_lock_engaged()
+        m = hvd.metrics()
+        assert m["ctrl_unlocks_total"] >= 1, m
+        # Sanity that the mismatch really interrupted a persistent
+        # session, not a never-engaged one.
+        assert m["ctrl_persistent_fires_total"] >= 1, m
+        print(f"OK rank={r}")
+
+    elif scenario == "persistent_lock_churn":
+        # Persistent-plane chaos (ISSUE 17 satellite, tsan+asan):
+        # lock -> persistent firings -> deterministic unlock (shape
+        # change) -> re-lock -> more firings -> a SEEDED victim
+        # SIGKILLs itself mid-slot. Survivors' waits (cell tick work
+        # on the shm plane, posted recv EOF on the TCP plane) must
+        # surface the death as an error within the timeout — never a
+        # hang, zero sanitizer reports. Seeding mirrors the ISSUE 16
+        # chaos harness: one HOROVOD_CHAOS_SEED env, every rank (and
+        # the test) derives the same schedule.
+        import signal
+        import time as _t
+
+        rng = np.random.RandomState(
+            int(os.environ.get("HOROVOD_CHAOS_SEED", "17")))
+        victim = int(rng.randint(0, s))
+        kill_at = int(rng.randint(2, 6))
+        for round_ in range(2):
+            for i in range(8):
+                out = hvd.allreduce(
+                    np.full(4 + round_, float(r + i), np.float32),
+                    op=hvd.Sum, name="plc")
+                np.testing.assert_allclose(
+                    out, float(s * i) + s * (s - 1) / 2.0, rtol=1e-6)
+            assert hvd.steady_lock_engaged(), f"round {round_}: no lock"
+            for i in range(5):
+                hvd.allreduce(np.full(4 + round_, float(i), np.float32),
+                              op=hvd.Sum, name="plc")
+        m = hvd.metrics()
+        assert m["ctrl_locks_total"] >= 2, m
+        if os.environ.get("HOROVOD_STEADY_PERSISTENT") != "off":
+            assert m["ctrl_persistent_fires_total"] >= 1, m
+        if r == victim:
+            for i in range(kill_at):
+                hvd.allreduce(np.full(5, 1.0, np.float32), op=hvd.Sum,
+                              name="plc")
+            print(f"VICTIM rank={r}", flush=True)
+            os.kill(os.getpid(), signal.SIGKILL)
+        t0 = _t.monotonic()
+        try:
+            for i in range(1000):
+                hvd.allreduce(np.full(5, 1.0, np.float32), op=hvd.Sum,
+                              name="plc")
+            raise SystemExit("survivor never saw the failure")
+        except hvd.HorovodInternalError:
+            dt = _t.monotonic() - t0
+            assert dt < 120.0, f"death took {dt:.1f}s to surface"
+        assert not hvd.steady_lock_engaged()
+        # The fatal teardown already stopped the background loop, so
+        # shutdown() just joins the finished thread — required, or tsan
+        # flags the unjoined thread at exit (it intercepts _exit).
+        hvd.shutdown()
+        print(f"OK rank={r}", flush=True)
+        os._exit(0)  # skip atexit: the controller plane is torn down
+
+    elif scenario == "lock_digest":
+        # Bitwise parity pin (ISSUE 17): one seeded op stream printed
+        # as a single digest; the test runs it under persistent=auto /
+        # persistent=off / steady_lock=off arms and requires IDENTICAL
+        # bytes — locked firings (cells, inline piggyback, classic
+        # token round) may never change a single bit, including across
+        # a codec slot (not inline eligible), a grouped Average slot,
+        # and a deterministic mid-stream unlock with queued-but-unfired
+        # async work that must complete exactly once.
+        import hashlib
+
+        h = hashlib.sha256()
+        rng = np.random.RandomState(7 + r)
+        xs = [rng.randn(16).astype(np.float32) for _ in range(14)]
+        for x in xs:
+            out = np.asarray(hvd.allreduce(x, op=hvd.Sum, name="ld"))
+            h.update(out.tobytes())
+        for y in [rng.randn(64).astype(np.float32) for _ in range(10)]:
+            out = np.asarray(hvd.allreduce(
+                y, op=hvd.Sum, name="ldc",
+                compression=hvd.Compression.bf16))
+            h.update(out.tobytes())
+        for i in range(10):
+            outs = hvd.grouped_allreduce(
+                [np.full(4, float(r + i), np.float32),
+                 rng.randn(8).astype(np.float32)],
+                op=hvd.Average, name="ldg")
+            for o in outs:
+                h.update(np.asarray(o).tobytes())
+        # Re-lock on the plain loop, then pipeline async feeds ending
+        # in a changed shape: on the auto arms the mismatch unlocks
+        # with fed-but-unfired requests still queued.
+        for x in xs[:8]:
+            h.update(np.asarray(
+                hvd.allreduce(x, op=hvd.Sum, name="ld")).tobytes())
+        hs = [hvd.allreduce_async(xs[i], op=hvd.Sum, name=f"ld.q{i}")
+              for i in range(3)]
+        hs.append(hvd.allreduce_async(rng.randn(5).astype(np.float32),
+                                      op=hvd.Sum, name="ld.q3"))
+        for hh in hs:
+            h.update(np.asarray(hvd.synchronize(hh)).tobytes())
+        print(f"DIGEST rank={r} {h.hexdigest()}")
 
     elif scenario == "membership_churn":
         # tsan membership churn (ISSUE 16 satellite): the membership

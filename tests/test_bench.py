@@ -280,6 +280,36 @@ def test_find_regressions_latency_family_key_directions():
     assert bench.find_regressions(prev, cur2) == {}
 
 
+def test_find_regressions_persistent_arm_key_directions():
+    """ISSUE 17 keys: the persistent arm's p50 `*_us` leaves gate
+    exactly like the locked/off arms (regress on RISE), the
+    steady_persistent_p50_speedup ratio gates like a throughput key,
+    and the flat raw-socket ping-pong floor — whose trailing `_np4`
+    tag would default the direction to higher-is-better — is pinned
+    lower-is-better via the `_us_p50_np4` suffix."""
+    prev = {"extra": {
+        "host_allreduce_latency_us_p50_persistent_np4": {"4B_us": 50.0},
+        "host_allreduce_latency_us_p99_persistent_np4": {"4B_us_p99": 150.0},
+        "steady_persistent_p50_speedup": 1.6,
+        "raw_socket_pingpong_us_p50_np4": 20.0,
+    }}
+    cur = {"extra": {
+        "host_allreduce_latency_us_p50_persistent_np4": {"4B_us": 100.0},
+        "host_allreduce_latency_us_p99_persistent_np4": {
+            "4B_us_p99": 600.0},  # p99 swing: weather, ungated
+        "steady_persistent_p50_speedup": 0.8,             # drop: flags
+        "raw_socket_pingpong_us_p50_np4": 40.0,           # rise: flags
+    }}
+    regs = bench.find_regressions(prev, cur)
+    assert set(regs) == {
+        "extra.host_allreduce_latency_us_p50_persistent_np4.4B_us",
+        "extra.steady_persistent_p50_speedup",
+        "extra.raw_socket_pingpong_us_p50_np4"}
+    assert regs["extra.raw_socket_pingpong_us_p50_np4"]["rise_pct"] == 100.0
+    # Wins in every key never flag (the ping-pong DROP is a win).
+    assert bench.find_regressions(cur, prev) == {}
+
+
 def test_find_regressions_threshold_boundary():
     prev = {"value": 100.0}
     assert bench.find_regressions(prev, {"value": 91.0}) == {}

@@ -395,6 +395,64 @@ def test_undocumented_lock_metric_fires(tree):
     assert run_all(tree, only={"metric-sync"}) == []
 
 
+def test_steady_persistent_knob_covered_by_knob_rule(tree):
+    """ISSUE 17 satellite: the env-var rule covers the persistent-
+    plane knob spelled the way native/src/operations.cc spells it
+    (an EnvChoiceSane call site): undocumented it fires, and a knob
+    row like the real tuning.md's clears it."""
+    _write(tree, "native/src/operations2.cc",
+           'int p = EnvChoiceSane('
+           '"HOROVOD_STEADY_PERSISTENT", 0, kChoices, 2);\n')
+    fs = run_all(tree, only={"knob-docs"})
+    assert any(f.message.startswith("HOROVOD_STEADY_PERSISTENT ")
+               for f in fs), fs
+    _write(tree, "docs/tuning.md",
+           "`HOROVOD_STEADY_PERSISTENT` compiles persistent slot "
+           "plans while locked.\n")
+    assert run_all(tree, only={"knob-docs"}) == []
+
+
+def test_undocumented_persistent_metric_fires(tree):
+    """ISSUE 17 satellite: the persistent-plane series (fires /
+    piggyback counters, pre-post gauge) present in the native tables
+    but missing from the observability catalog fire metric-sync —
+    the guard that forced the real catalog rows."""
+    _write(tree, "native/include/hvd/metrics.h", """\
+        constexpr int kMetricsVersion = 1;
+        enum MetricCounter : int {
+          kCtrCycles = 0,
+          kCtrPersistentFires,
+          kCtrTokenPiggybacks,
+          kGaugePrepostBuffers,
+          kNumMetricCounters
+        };
+        enum MetricHistogram : int {
+          kHistCycleUs = 0,
+          kNumMetricHistograms
+        };
+        """)
+    _write(tree, "native/src/metrics.cc", """\
+        constexpr const char* kCounterNames[] = {
+            "cycles_total",
+            "ctrl_persistent_fires_total",
+            "ctrl_token_piggybacks_total",
+            "tcp_prepost_buffers",
+        };
+        constexpr const char* kHistNames[] = {
+            "cycle_us",
+        };
+        """)
+    fs = run_all(tree, only={"metric-sync"})
+    for name in ("ctrl_persistent_fires_total",
+                 "ctrl_token_piggybacks_total", "tcp_prepost_buffers"):
+        assert any(name in f.message for f in fs), (name, fs)
+    _write(tree, "docs/observability.md",
+           "`cycles_total` `cycle_us` `ctrl_persistent_fires_total` "
+           "`ctrl_token_piggybacks_total` `tcp_prepost_buffers`\n"
+           "HOROVOD_CYCLE_TIME\n")
+    assert run_all(tree, only={"metric-sync"}) == []
+
+
 def test_blacklist_knobs_covered_by_knob_rule(tree):
     """ISSUE 16 satellite: the env-var rule really covers the decay-
     blacklist knobs spelled the way native/src/membership.cc spells

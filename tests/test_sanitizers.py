@@ -110,7 +110,8 @@ def _build_variant(san: str) -> str:
     return lib
 
 
-def run_san_job(san, scenario, np_, extra_env, tmp_path, timeout=420):
+def run_san_job(san, scenario, np_, extra_env, tmp_path, timeout=420,
+                expected_rc=None):
     lib = _build_variant(san)
     preload = _runtime_path(san)
     logdir = str(tmp_path / f"{san}-{scenario}")
@@ -152,7 +153,7 @@ def run_san_job(san, scenario, np_, extra_env, tmp_path, timeout=420):
                 f"[{san}] rank {r} timed out in {scenario} "
                 f"(reports so far: {glob.glob(report_stem + '*')})")
         outs.append(out)
-        if p.returncode != 0:
+        if p.returncode != (expected_rc or {}).get(r, 0):
             fails.append((r, p.returncode, out))
     reports = sorted(glob.glob(report_stem + "*"))
     if reports or fails:
@@ -223,6 +224,36 @@ def test_scenario_clean_under_sanitizer(san, scenario, np_, extra, tmp_path):
     outs = run_san_job(san, scenario, np_, extra, tmp_path)
     for r, out in enumerate(outs):
         assert f"OK rank={r}" in out, f"[{san}] {scenario} rank {r}:\n{out}"
+
+
+@pytest.mark.parametrize("plane", [{}, {"HOROVOD_SHM_DISABLE": "1"}],
+                         ids=["cells", "inline"])
+@pytest.mark.parametrize("san", ["tsan", "asan"])
+def test_persistent_lock_churn_clean_under_sanitizer(san, plane, tmp_path):
+    """Persistent locked data plane chaos (ISSUE 17): lock ->
+    persistent firings (shm consensus cells / inline token piggyback)
+    -> forced unlock -> re-lock -> a SEEDED victim SIGKILLs mid-slot.
+    The seqlock cell publish/peek, the plan compile racing the metrics
+    snapshot's gauge read, and the teardown paths (liveness tick /
+    posted-recv EOF) must all be zero-report; survivors exit 0 and the
+    victim dies by exactly the planted signal. Seeding mirrors the
+    ISSUE 16 chaos harness: one env seed, every rank and this test
+    derive the same schedule."""
+    import signal
+
+    import numpy as np
+
+    seed = 17
+    victim = int(np.random.RandomState(seed).randint(0, 4))
+    extra = dict(plane)
+    extra["HOROVOD_CHAOS_SEED"] = str(seed)
+    outs = run_san_job(san, "persistent_lock_churn", 4, extra, tmp_path,
+                       expected_rc={victim: -signal.SIGKILL})
+    for r, out in enumerate(outs):
+        if r == victim:
+            assert f"VICTIM rank={r}" in out, f"[{san}] rank {r}:\n{out}"
+        else:
+            assert f"OK rank={r}" in out, f"[{san}] rank {r}:\n{out}"
 
 
 @pytest.mark.parametrize("scenario,np_,extra", [

@@ -27,16 +27,39 @@ def test_abi_version_pins_match():
     assert _header_constant("kAbiVersion") == basics.ABI_VERSION
 
 
-def test_issue16_version_bumps_landed():
-    """ISSUE 16 lockstep pins: wire formats unchanged (ResponseList
-    stays v7) / ABI v12 (the hvd_membership_* / hvd_blacklist_*
-    surface + topology staleness hooks) / metrics v7 (the membership
-    series). The relative checks above catch a one-sided bump; this
-    pins the absolute values so a stray revert of BOTH sides is caught
-    too."""
+def test_issue17_version_bumps_landed():
+    """ISSUE 17 lockstep pins: wire formats unchanged (ResponseList
+    stays v7 — the persistent plane reuses the 8-byte LockToken frame
+    and glues it to existing payload bytes) / ABI v13 (the
+    hvd_steady_persistent + hvd_tcp_prepost_buffers accessors and the
+    HOROVOD_STEADY_PERSISTENT param-sync field) / metrics v8 (the
+    persistent-fire counters + pre-post gauge). The relative checks
+    above catch a one-sided bump; this pins the absolute values so a
+    stray revert of BOTH sides is caught too."""
     assert basics.WIRE_VERSION_RESPONSE_LIST == 7
-    assert basics.ABI_VERSION == 12
-    assert basics.METRICS_VERSION == 7
+    assert basics.ABI_VERSION == 13
+    assert basics.METRICS_VERSION == 8
+
+
+def test_issue17_inline_geometry_pins():
+    """The inline (token-on-first-frame) eligibility geometry is part
+    of the cross-rank contract: every rank derives the verdict from
+    kInlineMaxBytes and the 8-byte token, so a drift in either is a
+    split-brain, not a tune. kLockCellSlotBytes pins the consensus
+    cell stride the AgreeAll'd arena was sized with."""
+    hdr = os.path.join(os.path.dirname(HEADER), "steady_lock.h")
+    src = open(hdr).read()
+
+    def pin(name):
+        m = re.search(rf"constexpr\s+(?:int|int64_t)\s+{name}\s*=\s*(\d+)\s*;",
+                      src)
+        assert m, f"{name} not found in steady_lock.h"
+        return int(m.group(1))
+
+    assert pin("kInlineMaxBytes") == 4096
+    assert pin("kLockCellSlotBytes") == 64
+    m = re.search(r"static_assert\(sizeof\(LockToken\) == 8", src)
+    assert m, "LockToken must stay 8 bytes (it IS the wire frame prefix)"
 
 
 def test_wire_version_pins_match():
