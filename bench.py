@@ -702,6 +702,105 @@ def _transformer_extra(remaining_secs: float):
                          remaining_secs, 300.0)
 
 
+def _moe_worker():
+    """Expert-parallel MoE dispatch arms (ISSUE 18): the SAME MoE train
+    step under dispatch=gspmd vs the shard_map island at codec
+    none|bf16|int8, interleaved best-of-rounds under the ±30% protocol
+    like the compression arms, so the key deltas isolate what the
+    quantized alltoall dispatch buys end to end. Also reports the
+    codec's static dispatch-wire saving (``moe_dispatch_bytes_saved_pct``,
+    from the same byte accounting quantized_alltoall itself uses) —
+    a plumbing regression shows there even when tokens/sec noise hides
+    it. Prints "MOEEXTRA {json}" incrementally so a cap kill keeps the
+    finished arms."""
+    try:
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+
+        from horovod_tpu.models.moe import capacity as moe_capacity
+        from horovod_tpu.models.transformer import (
+            TransformerConfig, make_train_step)
+        from horovod_tpu.ops.quantized import alltoall_wire_bytes
+        from horovod_tpu.parallel import build_mesh
+
+        mesh = build_mesh(ep=-1)
+        ep = int(mesh.shape.get("ep", 1))
+        out = {}
+        # d_model >= 256 so every int8 dispatch slab spans multiple
+        # 256-elem blocks (a slab that pads its last block understates
+        # the codec's real saving); n_experts=8 divides any pow-2 ep.
+        # Shape sized so 4 arms x 3 rounds fit the 300s cap even on a
+        # host-device box (the gspmd arm's all-experts einsum is ~2x
+        # the island's cost there and dominates the budget).
+        base = TransformerConfig(
+            vocab_size=2048, d_model=256, n_layers=1, n_heads=4,
+            n_kv_heads=4, d_ff=512, max_seq=128, dtype=jnp.bfloat16,
+            sp_attention="local", remat=False, n_experts=8,
+            moe_top_k=2, moe_capacity_factor=1.25)
+        B, T, iters, rounds = 2 * mesh.devices.size, 128, 3, 3
+        toks = jax.random.randint(jax.random.PRNGKey(3), (B, T + 1),
+                                  0, base.vocab_size)
+        # On a single-device box the island routes to the GSPMD closure
+        # by construction (make_moe_ffn's ep<=1 rule): the three island
+        # arms would measure the identical XLA program three more
+        # times, so only the gspmd reference runs there. The gate only
+        # compares keys present in both rounds, so the narrower payload
+        # never trips it.
+        arms = {"gspmd": ("gspmd", None)}
+        if ep > 1:
+            arms.update({"none": ("island", "none"),
+                         "bf16": ("island", "bf16"),
+                         "int8": ("island", "int8")})
+        live = {}
+        for name, (disp, codec) in arms.items():
+            cfg = dataclasses.replace(base, moe_dispatch=disp,
+                                      moe_compression=codec)
+            init_s, stp, _ = make_train_step(cfg, mesh)
+            st = jax.jit(init_s)(jax.random.PRNGKey(0))
+            for _ in range(2):                    # compile + warm
+                st, loss = stp(st, {"tokens": toks})
+            float(loss)
+            live[name] = (stp, st)
+        best = {name: 0.0 for name in arms}
+        for _ in range(rounds):
+            for name in arms:
+                stp, st = live[name]
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    st, loss = stp(st, {"tokens": toks})
+                float(loss)
+                dt = time.perf_counter() - t0
+                live[name] = (stp, st)
+                best[name] = max(best[name],
+                                 B * T * iters / dt / mesh.devices.size)
+            for name, ts in best.items():
+                out[f"moe_tokens_per_sec_{name}"] = round(ts, 1)
+            print("MOEEXTRA " + json.dumps(out), flush=True)
+        if ep > 1:
+            # Static accounting for ONE dispatch hop at the measured
+            # shape (the combine hop ships the same slabs back, so the
+            # ratio is identical): int8 vs the f32 slabs the island
+            # would otherwise put on the inter-chip wire.
+            C = moe_capacity(base.moe, T)
+            shape = (ep, base.n_experts // ep, B // ep, C, base.d_model)
+            none_b = alltoall_wire_bytes(shape, "none")
+            int8_b = alltoall_wire_bytes(shape, "int8")
+            out["moe_dispatch_bytes_saved_pct"] = round(
+                100.0 * (1.0 - int8_b / none_b), 1)
+            print("MOEEXTRA " + json.dumps(out), flush=True)
+    except Exception:
+        pass
+
+
+def _moe_extra(remaining_secs: float):
+    """MoE dispatch-plane arms (four train-step compiles — hence the
+    killable subprocess, same cap as the transformer extra)."""
+    return _worker_extra("--moe-worker", "MOEEXTRA",
+                         remaining_secs, 300.0)
+
+
 def _serve_worker():
     """Serving metrics: continuous-batching throughput + latency tails
     on the mixed-length trace, the chunked-prefill tail on the same
@@ -1223,6 +1322,16 @@ def main():
         tf = _transformer_extra(remaining)
         if tf is not None:
             extra.update(tf)
+    # Expert-parallel MoE dispatch arms (ISSUE 18): gspmd vs the
+    # quantized-alltoall island per codec, plus the static dispatch
+    # wire saving. Same killable-subprocess treatment as the
+    # transformer extra (four train-step compiles).
+    remaining = budget - (time.perf_counter() - _T0)
+    if (extras_on and os.environ.get("BENCH_SKIP_MOE") != "1"
+            and remaining > 30):
+        moe = _moe_extra(remaining)
+        if moe is not None:
+            extra.update(moe)
     # Serving tier: tokens/sec + first-token tails from the
     # continuous-batching engine (ISSUE 1's workload layer). Cheap on
     # CPU (tiny model, ~10s) but still budget-gated.
@@ -1272,6 +1381,8 @@ if __name__ == "__main__":
         _bus_algo_worker()
     elif "--transformer-worker" in sys.argv:
         _transformer_worker()
+    elif "--moe-worker" in sys.argv:
+        _moe_worker()
     elif "--serve-worker" in sys.argv:
         _serve_worker()
     elif "--elastic-chaos-worker" in sys.argv:

@@ -41,6 +41,18 @@ def main():
                          "backward, O(pp) activation residency")
     ap.add_argument("--n-micro", type=int, default=2,
                     help="microbatches per step when --pp > 1")
+    ap.add_argument("--moe", action="store_true",
+                    help="mixture-of-experts FFN (8 experts, top-2, "
+                         "GShard capacity routing); with --ep > 1 the "
+                         "dispatch runs as the quantized-alltoall "
+                         "shard_map island (docs/perf_tuning.md)")
+    ap.add_argument("--ep", type=int, default=1,
+                    help="expert-parallel axis size (-1 = all remaining "
+                         "devices); requires --moe")
+    ap.add_argument("--moe-compression", default="int8",
+                    choices=["none", "bf16", "int8"],
+                    help="island dispatch codec (none = bitwise the "
+                         "GSPMD einsum path)")
     ap.add_argument("--tiny", action="store_true",
                     help="2-layer d=64 model (CI smoke)")
     ap.add_argument("--platform", default=None, choices=[None, "cpu", "tpu"])
@@ -58,16 +70,27 @@ def main():
     from horovod_tpu.parallel import (build_mesh, make_pp_train_step,
                                       make_pp_train_step_1f1b)
 
+    if args.ep != 1 and not args.moe:
+        ap.error("--ep needs --moe (the axis only shards experts)")
     mesh = build_mesh(dp=args.dp, fsdp=args.fsdp, tp=args.tp, sp=args.sp,
-                      pp=args.pp)
+                      pp=args.pp, ep=args.ep)
+    # MoE: 8 experts, top-2; with ep > 1 the dispatch/combine hops run
+    # as the quantized-alltoall island (make_train_step builds it from
+    # these cfg fields — codec "none" routes back to the exact GSPMD
+    # einsum path by construction).
+    ep_size = mesh.shape.get("ep", 1)
+    moe_kw = dict(n_experts=8, moe_top_k=2,
+                  moe_dispatch="island" if ep_size > 1 else None,
+                  moe_compression=args.moe_compression
+                  if ep_size > 1 else None) if args.moe else {}
     if args.tiny:
-        cfg = TransformerConfig.tiny(max_seq=args.seq)
+        cfg = TransformerConfig.tiny(max_seq=args.seq, **moe_kw)
     else:
         cfg = TransformerConfig(
             vocab_size=8192, d_model=512, n_layers=4, n_heads=8,
             n_kv_heads=8, d_ff=1376, max_seq=args.seq,
             dtype=jnp.bfloat16,
-            sp_attention="ring" if args.sp > 1 else "local")
+            sp_attention="ring" if args.sp > 1 else "local", **moe_kw)
 
     if args.pp > 1:
         factory = (make_pp_train_step_1f1b

@@ -38,7 +38,7 @@ EXEC_CALLBACK = 1
 # is enforced at library load below, and tests/test_wire_abi.py greps
 # the header so a native bump can't silently skew this shim even
 # before a rebuild happens.
-ABI_VERSION = 13
+ABI_VERSION = 14
 WIRE_VERSION_REQUEST_LIST = 3
 WIRE_VERSION_RESPONSE_LIST = 7
 
@@ -46,7 +46,7 @@ WIRE_VERSION_RESPONSE_LIST = 7
 # kMetricsVersion): the packed int64 layout hvd_metrics_snapshot
 # writes. Checked at library load AND against the header by
 # tests/test_metrics_abi.py, the same two-sided pin as the ABI above.
-METRICS_VERSION = 8
+METRICS_VERSION = 9
 
 # Native WireCodec ids (native/include/hvd/codec.h); -1 = follow the
 # job-wide HOROVOD_WIRE_COMPRESSION default.
@@ -66,6 +66,15 @@ COLLECTIVE_ALGOS = {
     "striped": 3,
     "doubling": 4,
     "hier": 5,
+}
+
+# Native AlltoallAlgo ids (native/include/hvd/schedule.h); 0 = follow
+# the measured pairwise-vs-bruck verdict / HOROVOD_ALLTOALL_ALGO.
+# Name order mirrors kAlltoallAlgoNames.
+ALLTOALL_ALGOS = {
+    "auto": 0,
+    "pairwise": 1,
+    "bruck": 2,
 }
 
 

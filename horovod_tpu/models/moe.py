@@ -19,11 +19,18 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Any, Dict
+import os
+import threading
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import PartitionSpec as P
+
+#: Dispatch-plane values for the HOROVOD_MOE_DISPATCH knob /
+#: ``TransformerConfig.moe_dispatch`` (docs/perf_tuning.md).
+MOE_DISPATCH_MODES = ("gspmd", "island")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -71,24 +78,21 @@ def init_moe_params(key, n_layers: int, d_model: int, d_ff: int,
     }
 
 
-def moe_ffn(x, lp, cfg: MoEConfig):
-    """One MoE FFN block. ``x``: [B, T, D] (cfg.dtype); ``lp``: this
-    layer's param dict (no leading L). Returns (y [B, T, D], aux_loss
-    scalar f32).
+def _route(x, router, cfg: MoEConfig, C: int):
+    """GShard routing on ``x`` [B, T, D] (any batch slice): top-k
+    gating, (t, k)-ordered capacity assignment, one-hot dispatch /
+    combine tensors. Per-token math only — no cross-batch-row coupling
+    (the capacity cumsum runs within each row), so routing a batch
+    SHARD equals the global routing restricted to those rows. The
+    island leans on exactly this property.
 
-    Dispatch math follows GShard: one-hot ``dispatch [B,T,E,C]``
-    scatters tokens into per-expert capacity slots, the ``ebcd``
-    einsums move tokens to the ``ep``-sharded expert dim (GSPMD →
-    all-to-all over ICI), experts run SwiGLU batched over their local
-    shard, and ``combine`` (dispatch × gate prob) returns weighted
-    outputs. Tokens over capacity are dropped (their residual path
-    passes through unchanged — standard Switch behavior).
+    Returns ``(dispatch [B,T,E,C], combine [B,T,E,C], probs [B,T,E],
+    top1 [B,T,E], sel [B,T,K,E], within [B,T,K,E])``.
     """
-    B, T, D = x.shape
+    B, T, _D = x.shape
     E, K = cfg.n_experts, cfg.top_k
-    C = capacity(cfg, T)
 
-    logits = jnp.einsum("btd,de->bte", x.astype(jnp.float32), lp["router"])
+    logits = jnp.einsum("btd,de->bte", x.astype(jnp.float32), router)
     probs = jax.nn.softmax(logits, axis=-1)            # [B, T, E]
 
     # Top-k expert choice per token.
@@ -115,18 +119,275 @@ def moe_ffn(x, lp, cfg: MoEConfig):
     combine = jnp.einsum("btk,btke,btkec->btec",
                          gate_vals, within, slot_oh)
 
+    top1 = jax.nn.one_hot(gate_idx[..., 0], E, dtype=jnp.float32)
+    return dispatch, combine, probs, top1, sel, within
+
+
+def _expert_ffn(xin, lp, dtype):
+    """SwiGLU over per-expert token slabs ``xin`` [E', b, C, D] with
+    expert weights ``lp`` [E', D, F] — shared verbatim by the GSPMD
+    path (E' = E, b = B) and the island (E' = E/ep, b = ep·B/ep), so
+    the per-element contraction math is identical in both."""
+    g = jax.nn.silu(jnp.einsum("ebcd,edf->ebcf", xin,
+                               lp["w_gate"]).astype(jnp.float32))
+    u = jnp.einsum("ebcd,edf->ebcf", xin, lp["w_up"]).astype(jnp.float32)
+    h = (g * u).astype(dtype)
+    return jnp.einsum("ebcf,efd->ebcd", h, lp["w_down"])
+
+
+def moe_ffn(x, lp, cfg: MoEConfig):
+    """One MoE FFN block. ``x``: [B, T, D] (cfg.dtype); ``lp``: this
+    layer's param dict (no leading L). Returns (y [B, T, D], aux_loss
+    scalar f32).
+
+    Dispatch math follows GShard: one-hot ``dispatch [B,T,E,C]``
+    scatters tokens into per-expert capacity slots, the ``ebcd``
+    einsums move tokens to the ``ep``-sharded expert dim (GSPMD →
+    all-to-all over ICI), experts run SwiGLU batched over their local
+    shard, and ``combine`` (dispatch × gate prob) returns weighted
+    outputs. Tokens over capacity are dropped (their residual path
+    passes through unchanged — standard Switch behavior).
+    """
+    E = cfg.n_experts
+    C = capacity(cfg, x.shape[1])
+    dispatch, combine, probs, top1, _sel, _within = _route(
+        x, lp["router"], cfg, C)
+
     # Load-balancing aux loss (Switch eq. 4): E * sum_e f_e * p_e with
     # f = fraction of tokens whose TOP-1 lands on e, p = mean prob.
-    top1 = jax.nn.one_hot(gate_idx[..., 0], E, dtype=jnp.float32)
     aux = cfg.aux_loss_coef * E * jnp.sum(
         top1.mean((0, 1)) * probs.mean((0, 1)))
 
     # To experts (ep all-to-all by GSPMD), run SwiGLU, and back.
     xin = jnp.einsum("btec,btd->ebcd", dispatch.astype(x.dtype), x)
-    g = jax.nn.silu(jnp.einsum("ebcd,edf->ebcf", xin,
-                               lp["w_gate"]).astype(jnp.float32))
-    u = jnp.einsum("ebcd,edf->ebcf", xin, lp["w_up"]).astype(jnp.float32)
-    h = (g * u).astype(x.dtype)
-    xout = jnp.einsum("ebcf,efd->ebcd", h, lp["w_down"])
+    xout = _expert_ffn(xin, lp, x.dtype)
     y = jnp.einsum("btec,ebcd->btd", combine.astype(x.dtype), xout)
     return y.astype(x.dtype), aux
+
+
+# ---------------------------------------------------------------------------
+# The quantized-dispatch island (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+def moe_ffn_island(x, lp, cfg: MoEConfig, mesh, *, codec: str = "int8"):
+    """:func:`moe_ffn` with the dispatch/combine hops as an explicit
+    ``shard_map`` island over ``ep``, both riding
+    :func:`~horovod_tpu.ops.quantized.quantized_alltoall` — the EQuARX
+    treatment applied to the one collective that dominates sparse-model
+    step time (the reference's alltoall, ``operations.cc:1131``).
+
+    Token rows are batch-sharded over ``ep`` inside the island; each
+    shard routes its rows locally (identical to the global routing —
+    the capacity cumsum is per batch row, see :func:`_route`), packs
+    per-expert token slabs, and exchanges them with the expert owners
+    over the quantized alltoall: blockwise int8 (+f32 scales) at
+    ~1/3.94 of the f32 wire bytes, bf16 at 1/2, ``"none"`` the plain
+    f32 hop (same island math, lossless wire — the A/B control the
+    int8 error-bound tests compare against). The expert SwiGLU and the
+    combine weighting are byte-for-byte the GSPMD path's math.
+
+    Requires ``B % ep == 0`` and ``E % ep == 0``. On legacy jax the
+    island must be spelled full-manual (the embed-island generation
+    gate), which is legal only when every non-``ep`` mesh axis is
+    size 1 — :func:`make_moe_ffn` enforces that at build time.
+
+    Capacity overflow is handled exactly like the GSPMD path (dropped
+    tokens ride the residual stream); :func:`moe_routing_stats` is the
+    telemetry face of the same routing math.
+    """
+    from horovod_tpu.common import jax_compat
+    from horovod_tpu.common.jax_compat import shard_map
+    from horovod_tpu.ops.quantized import quantized_alltoall
+
+    ep = mesh.shape.get("ep", 1) if mesh is not None else 1
+    if ep <= 1:
+        return moe_ffn(x, lp, cfg)       # no exchange to quantize
+    E = cfg.n_experts
+    B, T, D = x.shape
+    C = capacity(cfg, T)
+    if E % ep:
+        raise ValueError(
+            f"moe_ffn_island: n_experts={E} must divide by the ep axis "
+            f"size {ep} (each shard owns E/ep experts)")
+    if B % ep:
+        raise ValueError(
+            f"moe_ffn_island: batch {B} must divide by the ep axis "
+            f"size {ep} (token rows are batch-sharded over ep)")
+    e_loc = E // ep
+
+    def island(xl, router, wg, wu, wd):
+        b_loc = xl.shape[0]
+        dispatch, combine, probs, top1, _sel, _within = _route(
+            xl, router, cfg, C)
+
+        # Aux loss from the GLOBAL f/p vectors (pmean of equal-sized
+        # shard means == the global mean), so the island's aux equals
+        # the GSPMD path's — NOT a pmean of per-shard aux values,
+        # which would average the nonlinear f·p product instead.
+        f = lax.pmean(top1.mean((0, 1)), "ep")
+        pbar = lax.pmean(probs.mean((0, 1)), "ep")
+        aux = cfg.aux_loss_coef * E * jnp.sum(f * pbar)
+
+        # Pack per-expert slabs for ALL E experts from local rows,
+        # grouped by owner shard, and trade them: after the alltoall,
+        # axis 0 indexes the SOURCE shard and the local expert slabs
+        # cover this shard's E/ep experts for every token row.
+        xin = jnp.einsum("btec,btd->ebcd", dispatch.astype(xl.dtype), xl)
+        xin = xin.reshape(ep, e_loc, b_loc, C, D)
+        r = quantized_alltoall(xin, "ep", codec=codec)
+        r = jnp.moveaxis(r, 0, 1).reshape(e_loc, ep * b_loc, C, D)
+
+        xout = _expert_ffn(r, {"w_gate": wg, "w_up": wu, "w_down": wd},
+                           xl.dtype)
+
+        # Quantized combine hop back to the token owners (axis 0 now
+        # indexes the expert-OWNER shard), then the weighted combine.
+        back = jnp.moveaxis(xout.reshape(e_loc, ep, b_loc, C, D), 0, 1)
+        back = quantized_alltoall(back, "ep", codec=codec)
+        xfull = back.reshape(E, b_loc, C, D)
+        y = jnp.einsum("btec,ebcd->btd", combine.astype(xl.dtype), xfull)
+        return y.astype(xl.dtype), aux
+
+    # Modern jax: partial-manual over ep only (dp/fsdp/tp ride
+    # auto/GSPMD). Legacy jax cannot lower partial-manual (the
+    # embed-island gate); full-manual is correct because make_moe_ffn
+    # guarantees every non-ep axis is size 1 there.
+    axis_names = {"ep"} if jax_compat.HAS_NEW_SHARD_MAP else None
+    # check_vma=False: the VMA checker cannot see that the pmean'd aux
+    # is replicated over ep (same limitation as the embed island).
+    return shard_map(
+        island, mesh=mesh,
+        in_specs=(P("ep"), P(), P("ep"), P("ep"), P("ep")),
+        out_specs=(P("ep"), P()),
+        axis_names=axis_names, check_vma=False)(
+        x, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"])
+
+
+def resolve_moe_knobs(dispatch: Optional[str] = None,
+                      codec: Optional[str] = None):
+    """Resolve the MoE dispatch-plane knobs: explicit config values win,
+    ``None`` falls back to the env knobs (docs/perf_tuning.md) —
+    ``HOROVOD_MOE_DISPATCH`` (default ``gspmd``) and
+    ``HOROVOD_MOE_COMPRESSION`` (default ``int8``, the codec the
+    island exists for). Returns ``(dispatch, codec)`` validated."""
+    from horovod_tpu.ops.quantized import CODECS
+
+    d = dispatch or os.environ.get("HOROVOD_MOE_DISPATCH", "gspmd")
+    c = codec or os.environ.get("HOROVOD_MOE_COMPRESSION", "int8")
+    if d not in MOE_DISPATCH_MODES:
+        raise ValueError(
+            f"unknown MoE dispatch mode {d!r}; one of {MOE_DISPATCH_MODES}")
+    if c not in CODECS:
+        raise ValueError(f"unknown MoE codec {c!r}; one of {CODECS}")
+    return d, c
+
+
+def make_moe_ffn(cfg: MoEConfig, mesh, *, dispatch: Optional[str] = None,
+                 codec: Optional[str] = None):
+    """Single construction point for the transformer's MoE FFN call:
+    returns ``fn(x, lp) -> (y, aux)``.
+
+    Routing discipline (the PR 9 ``compression=none`` contract):
+    ``dispatch="gspmd"``, ``codec="none"``, a meshless build, or
+    ``ep == 1`` all take the EXACT pre-existing GSPMD einsum path —
+    so "island at compression=none is bitwise-identical to GSPMD"
+    holds by construction, and only a genuinely narrow wire pays the
+    island's restructuring. ``dispatch="island"`` with a lossy codec
+    builds :func:`moe_ffn_island`; build-time failures (legacy jax
+    with a non-ep axis > 1, E not divisible by ep) raise HERE with
+    the mesh in hand, not mid-trace.
+    """
+    from horovod_tpu.common import jax_compat
+
+    d, c = resolve_moe_knobs(dispatch, codec)
+    ep = mesh.shape.get("ep", 1) if mesh is not None else 1
+    if d == "gspmd" or c == "none" or ep <= 1:
+        return lambda x, lp: moe_ffn(x, lp, cfg)
+    if cfg.n_experts % ep:
+        raise ValueError(
+            f"moe_dispatch='island': n_experts={cfg.n_experts} must "
+            f"divide by ep={ep}")
+    if not jax_compat.HAS_NEW_SHARD_MAP:
+        bad = [(ax, sz) for ax, sz in mesh.shape.items()
+               if ax != "ep" and sz > 1]
+        if bad:
+            raise ValueError(
+                "moe_dispatch='island' on legacy jax runs the island "
+                f"full-manual (the embed-island generation gate); mesh "
+                f"axes {bad} must be size 1 there. Use an ep-only mesh "
+                "or moe_dispatch='gspmd'.")
+    return lambda x, lp: moe_ffn_island(x, lp, cfg, mesh, codec=c)
+
+
+# ---------------------------------------------------------------------------
+# Routing telemetry (overflow counter / dropped-token fraction)
+# ---------------------------------------------------------------------------
+
+#: Python-plane MoE metric keys, locked to docs/observability.md by the
+#: tools/lint metric-sync rule (same lockstep discipline as the native
+#: registry's name tables).
+MOE_METRIC_KEYS = (
+    "moe_dispatch_overflow_tokens_total",
+    "moe_dispatch_dropped_token_frac",
+    "moe_dispatch_bytes_saved_pct",
+)
+
+_moe_metrics: Dict[str, float] = {}
+_moe_metrics_lock = threading.Lock()
+
+
+def moe_routing_stats(x, router, cfg: MoEConfig) -> Dict[str, float]:
+    """Capacity-overflow telemetry for one batch: runs the exact
+    routing math of :func:`_route` (so the numbers describe what the
+    dispatch actually dropped, not an estimate) and returns
+
+    * ``moe_dispatch_overflow_tokens_total`` — (token, choice) claims
+      that landed past an expert's capacity this batch;
+    * ``moe_dispatch_dropped_token_frac`` — that count over the
+      ``B·T·k`` total claims.
+
+    Host-callable (no mesh needed — routing is per batch row); feed
+    the result to :func:`record_moe_stats` to accumulate into the
+    exported series.
+    """
+    C = capacity(cfg, x.shape[1])
+    _d, _c, _p, _t1, sel, within = _route(x, router, cfg, C)
+    claims = float(sel.sum())
+    overflow = claims - float(within.sum())
+    return {
+        "moe_dispatch_overflow_tokens_total": overflow,
+        "moe_dispatch_dropped_token_frac": (
+            overflow / claims if claims else 0.0),
+    }
+
+
+def _render_moe_metrics() -> str:
+    from horovod_tpu.metrics import NAMESPACE, render_gauges
+    with _moe_metrics_lock:
+        vals = dict(_moe_metrics)
+    return render_gauges(NAMESPACE, vals)
+
+
+def record_moe_stats(stats: Dict[str, float]) -> None:
+    """Fold one batch's telemetry into the exported MoE series:
+    ``*_total`` keys accumulate (counters), everything else is a
+    last-value gauge. First call registers the exporter, so the rows
+    ride :func:`horovod_tpu.metrics.metrics_prometheus` alongside the
+    native registry (docs/observability.md)."""
+    from horovod_tpu.metrics import register_exporter
+    with _moe_metrics_lock:
+        register = not _moe_metrics
+        for k, v in stats.items():
+            if k.endswith("_total"):
+                _moe_metrics[k] = _moe_metrics.get(k, 0.0) + float(v)
+            else:
+                _moe_metrics[k] = float(v)
+    if register:
+        register_exporter("moe", _render_moe_metrics)
+
+
+def moe_metrics() -> Dict[str, float]:
+    """Current values of the recorded MoE series (empty before the
+    first :func:`record_moe_stats`)."""
+    with _moe_metrics_lock:
+        return dict(_moe_metrics)

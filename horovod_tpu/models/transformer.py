@@ -79,6 +79,14 @@ class TransformerConfig:
     n_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # MoE dispatch plane (ISSUE 18): None defers to the
+    # HOROVOD_MOE_DISPATCH / HOROVOD_MOE_COMPRESSION env knobs
+    # (docs/perf_tuning.md). "island" + a lossy codec routes the
+    # dispatch/combine hops through the quantized-alltoall shard_map
+    # island in models/moe.py; "gspmd" (the default) or codec "none"
+    # keep the exact pre-existing GSPMD einsum path.
+    moe_dispatch: Optional[str] = None
+    moe_compression: Optional[str] = None
 
     @property
     def head_dim(self) -> int:
@@ -361,12 +369,18 @@ def _constrainer(mesh: Optional[Mesh]):
 
 
 def decoder_layer(cfg: TransformerConfig, attend, constrain, x, lp,
-                  pos_offset=0):
+                  pos_offset=0, moe_fn=None):
     """One pre-norm decoder block (attention + FFN/MoE) on ``x``
     [B, T, D]; ``lp`` is this layer's param dict (no leading L dim).
     Returns (x, aux_loss) — aux is 0 for dense FFN, the load-balancing
     term for MoE. Module-level so both the layer scan and the pipeline
     stage function build on it.
+
+    ``moe_fn`` overrides the MoE FFN call (``fn(h, lp['moe']) ->
+    (y, aux)``): :func:`forward_with_aux` passes the
+    :func:`moe_lib.make_moe_ffn`-selected dispatch plane; ``None``
+    (pipeline/island callers, which run inside their own manual
+    regions) keeps the plain GSPMD :func:`moe_lib.moe_ffn`.
 
     ``pos_offset`` shifts the rotary positions: callers running this
     layer INSIDE a manual island on a sequence SHARD (pp+sp) pass
@@ -394,7 +408,10 @@ def decoder_layer(cfg: TransformerConfig, attend, constrain, x, lp,
 
     h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
     if cfg.moe is not None:
-        y, aux = moe_lib.moe_ffn(h, lp["moe"], cfg.moe)
+        if moe_fn is None:
+            y, aux = moe_lib.moe_ffn(h, lp["moe"], cfg.moe)
+        else:
+            y, aux = moe_fn(h, lp["moe"])
         x = x + y.astype(cfg.dtype)
     else:
         g = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32))
@@ -415,12 +432,17 @@ def forward_with_aux(params, tokens, cfg: TransformerConfig,
     """
     constrain = _constrainer(mesh)
     attend = _attention_island(cfg, mesh)
+    moe_fn = (moe_lib.make_moe_ffn(cfg.moe, mesh,
+                                   dispatch=cfg.moe_dispatch,
+                                   codec=cfg.moe_compression)
+              if cfg.moe is not None else None)
 
     x = embed_lookup(params["embed"], tokens, cfg.dtype, mesh)
     x = constrain(x, ("dp", "fsdp"), "sp", None)
 
     def layer(x, lp):
-        return decoder_layer(cfg, attend, constrain, x, lp)
+        return decoder_layer(cfg, attend, constrain, x, lp,
+                             moe_fn=moe_fn)
 
     if cfg.remat:
         layer = jax.checkpoint(layer, policy=remat_policy_fn(cfg),

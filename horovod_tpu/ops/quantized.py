@@ -45,6 +45,8 @@ telescoping identity pinned in tests/test_quantized.py).
 
 from __future__ import annotations
 
+import functools
+import math
 from typing import Optional
 
 import jax
@@ -357,3 +359,108 @@ def quantized_allgather(x, axis_name: str = "dp", *, codec: str,
     out = blockwise_int8_decode(gq, gs, c)          # [..., P, c]
     out = out.reshape(moved.shape[:-1] + (p * c,))  # concat peers in order
     return jnp.moveaxis(out, -1, axis).astype(orig_dtype)
+
+
+# ---------------------------------------------------------------------------
+# The quantized alltoall (MoE dispatch/combine hop, ISSUE 18)
+# ---------------------------------------------------------------------------
+
+def _plain_alltoall(x, axis_name: str):
+    return lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True)
+
+
+def _alltoall_value(x, axis_name: str, codec: str):
+    """Forward value of the quantized alltoall: each destination slab
+    ``x[d]`` is flattened and encoded as ONE blockwise payload (same
+    slab-flattening discipline as the allreduce's per-shard rows, so
+    block utilization never depends on the trailing-dim geometry), the
+    narrow bytes (+f32 scales for int8) ride ``lax.all_to_all``, and
+    the received slabs decode back to ``x.dtype``."""
+    if codec == "none":
+        return _plain_alltoall(x, axis_name)
+    shape, dtype = x.shape, x.dtype
+    if codec in _CAST_WIRE:
+        w = x.astype(_CAST_WIRE[codec])
+        return _plain_alltoall(w, axis_name).astype(dtype)
+    rows = x.astype(jnp.float32).reshape(shape[0], -1)
+    q, s = blockwise_int8_encode(rows)
+    qr = _plain_alltoall(q, axis_name)
+    sr = _plain_alltoall(s, axis_name)
+    out = blockwise_int8_decode(qr, sr, rows.shape[-1])
+    return out.reshape(shape).astype(dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _qa2a(x, axis_name: str, codec: str, bwd_codec: str):
+    return _alltoall_value(x, axis_name, codec)
+
+
+def _qa2a_fwd(x, axis_name, codec, bwd_codec):
+    return _alltoall_value(x, axis_name, codec), None
+
+
+def _qa2a_bwd(axis_name, codec, bwd_codec, _res, g):
+    # The tiled (split=concat=0) alltoall is its own transpose: the
+    # slab that went p->q routes back q->p under the identical op. The
+    # cotangent rides the SAME narrow wire (bwd_codec), quantized the
+    # straight-through way — the rounding of the forward hop never
+    # enters the backward graph (jnp.round's zero derivative would
+    # otherwise kill every gradient flowing through the dispatch).
+    return (_alltoall_value(g, axis_name, bwd_codec),)
+
+
+_qa2a.defvjp(_qa2a_fwd, _qa2a_bwd)
+
+
+def quantized_alltoall(x, axis_name: str = "ep", *, codec: str,
+                       bwd_codec: Optional[str] = None):
+    """Alltoall ``x`` over ``axis_name`` with the wire narrowed by
+    ``codec`` — the explicit MoE dispatch/combine hop (EQuARX applied
+    to the one collective that dominates sparse-model step time).
+
+    Call under ``shard_map`` with ``axis_name`` manual. ``x``'s leading
+    dim must equal the axis size P; slab ``x[d]`` is delivered to rank
+    ``d`` and the result's slab ``[s]`` came from rank ``s`` (tiled
+    ``lax.all_to_all`` semantics, split/concat axis 0).
+
+    ``codec`` is one of :data:`CODECS`; ``"none"`` is the exact plain
+    ``lax.all_to_all`` — bitwise the uncompressed hop, native autodiff.
+    The lossy codecs are differentiable with a straight-through custom
+    VJP whose backward hop ships ``bwd_codec`` (default: same as
+    ``codec``) in the reverse direction — both directions of the
+    exchange stay narrow.
+    """
+    _check_codec(codec)
+    bwd = codec if bwd_codec is None else bwd_codec
+    _check_codec(bwd)
+    if codec == "none" and bwd == "none":
+        return _plain_alltoall(x, axis_name)
+    _check_axis_name(axis_name, "quantized_alltoall")
+    p = _axis_size(axis_name)
+    if x.shape[0] != p:
+        raise ValueError(
+            f"quantized_alltoall: leading dim {x.shape[0]} must equal "
+            f"the {axis_name!r} axis size {p} (one slab per peer)")
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        raise TypeError(
+            f"cannot quantize dtype {x.dtype}; compression applies to "
+            "float activations")
+    return _qa2a(x, axis_name, codec, bwd)
+
+
+def alltoall_wire_bytes(shape, codec: str, *, elem_bytes: int = 4) -> int:
+    """Bytes one :func:`quantized_alltoall` of a ``shape``-shaped f32
+    payload puts on the wire (all P slabs, scales included) — the
+    static accounting behind bench.py's ``moe_dispatch_bytes_saved_pct``
+    (int8 ships ~1/3.94 of the f32 bytes once a slab spans a few
+    blocks; tiny slabs amortize worse because the last block pads)."""
+    _check_codec(codec)
+    n = math.prod(shape)
+    if codec == "none":
+        return n * elem_bytes
+    if codec in _CAST_WIRE:
+        return n * 2
+    per_slab = math.prod(shape[1:])
+    nb = int8_blocks(per_slab)
+    return shape[0] * nb * (INT8_BLOCK_ELEMS + 4)

@@ -50,6 +50,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from horovod_tpu.models import moe as moe_lib
 from horovod_tpu.models import transformer as tf_lib
 from horovod_tpu.parallel.ring_attention import local_attention
 from horovod_tpu.serve.kv_cache import NULL_BLOCK
@@ -91,8 +92,16 @@ def _qkv(cfg, lp, x, pos):
 
 
 def _ffn(cfg, lp, x):
-    """Post-attention FFN block, decoder_layer's exact math."""
+    """Post-attention FFN block, decoder_layer's exact math. MoE
+    configs take the GSPMD :func:`moe_lib.moe_ffn` (experts stay
+    ep-sharded by the weight specs; the quantized-dispatch island is a
+    training-path construct — decode's T=1 slabs are too narrow to pay
+    for restructuring, see docs/serving.md). The aux loss is routing
+    telemetry only at serve time and is dropped."""
     h = tf_lib._rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+    if cfg.moe is not None:
+        y, _aux = moe_lib.moe_ffn(h, lp["moe"], cfg.moe)
+        return x + y.astype(cfg.dtype)
     g = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32))
     u = (h @ lp["w_up"]).astype(jnp.float32)
     return x + ((g * u).astype(cfg.dtype) @ lp["w_down"]).astype(cfg.dtype)
@@ -127,9 +136,6 @@ def make_serve_fns(cfg, mesh: Optional[Any] = None, *, block_size: int,
 @functools.lru_cache(maxsize=64)
 def _cached_serve_fns(cfg, mesh, block_size: int, table_width: int,
                       compression=None):
-    if cfg.moe is not None:
-        raise NotImplementedError(
-            "serving the MoE FFN is not implemented yet; set n_experts=0")
     H, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     rep = H // Hkv
     scale = Dh ** -0.5

@@ -168,6 +168,10 @@ class Controller {
   int collective_stripes_ = 2;
   int collective_granularity_ = 1;
   int hd_order_ = 0;
+  // Alltoall schedule-family force (AlltoallAlgo space, 0 = the
+  // measured verdict decides per response). Seeded from
+  // HOROVOD_ALLTOALL_ALGO, synced as param field 17.
+  int alltoall_algo_ = 0;
   // Topology-probe verdict (rank 0's HOROVOD_TOPOLOGY_PROBE parse,
   // synced as param field 12): 0 = off, 1 = probe, 2 = cached blob
   // follows the param sync on the data links.
@@ -234,6 +238,13 @@ class Controller {
   int collective_granularity() const { return collective_granularity_; }
   void SetHdOrder(int o) { hd_order_ = o == 1 ? 1 : 0; }
   int hd_order() const { return hd_order_; }
+  // Alltoall schedule-family force (AlltoallAlgo space, 0 = measured
+  // cost model / pairwise). Synced like the allreduce force (param
+  // field 17) and resolved into each ALLTOALL response.
+  void SetAlltoallAlgo(int a) {
+    alltoall_algo_ = a < 0 ? 0 : (a > 2 ? 0 : a);
+  }
+  int alltoall_algo() const { return alltoall_algo_; }
   // Measured link model (hvd/topology.h). Set collectively — the
   // probe broadcasts one blob, so every rank installs identical
   // numbers; a null/invalid model falls selection back to the bands.
@@ -260,6 +271,12 @@ class Controller {
   // ranks.
   int ResolveAlgoAuto(int64_t payload_bytes, int ncontributors,
                       bool hier_ok) const;
+  // Resolve the schedule family for one ALLTOALL response: request
+  // override > job-wide force > the measured pairwise-vs-bruck
+  // verdict (pairwise when no broadcast model covers the live world).
+  // `payload_bytes` is one rank's input payload; the model prices the
+  // whole exchange (bytes * np over the P*P grid).
+  int ResolveAlltoallAlgo(int request_algo, int64_t payload_bytes) const;
   // Hierarchical allreduce: rank 0's env decides the request; the
   // value is only TRUE after Initialize when every rank's topology
   // fits the node-major layout (the verdict is broadcast — a per-rank

@@ -21,6 +21,15 @@ namespace hvd {
 // here so a bump is one edit — and guarded by tests/test_wire_abi.py,
 // which asserts the Python side expects the same numbers (a native
 // bump can't silently skew the shim).
+// ABI v14 (wire formats unchanged — Response already serializes
+// collective_algo for every response type): alltoall schedule
+// families (hvd/schedule.h AlltoallAlgo) — the HOROVOD_ALLTOALL_ALGO
+// knob (param field 17) with the hvd_alltoall_algo /
+// hvd_alltoall_algo_name accessors and the hvd_alltoall_cost_us /
+// hvd_alltoall_select_measured probes, the Bruck store-and-forward
+// table (BuildAlltoallBruck) selected per ALLTOALL response by the
+// measured alpha-beta cost model (ResolveAlltoallMeasured); metrics
+// v9 adds alltoall_measured_selects_total.
 // ABI v13 (wire formats unchanged): persistent locked data plane
 // (hvd/steady_lock.h) — the HOROVOD_STEADY_PERSISTENT knob (param
 // field 16) with the hvd_steady_persistent accessor, shared-memory
@@ -62,7 +71,7 @@ namespace hvd {
 // hvd_stalled_tensors, and hvd_start_timeline returning an error code.
 constexpr int kWireVersionRequestList = 3;
 constexpr int kWireVersionResponseList = 7;
-constexpr int kAbiVersion = 13;
+constexpr int kAbiVersion = 14;
 
 enum class RequestType : uint8_t {
   ALLREDUCE = 0,

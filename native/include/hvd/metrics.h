@@ -32,6 +32,9 @@ namespace hvd {
 // Snapshot layout version (bump on any enum/table/layout change) and
 // bucket count. Pinned by horovod_tpu/common/basics.py +
 // tests/test_metrics_abi.py.
+// v9: alltoall_measured_selects_total (pairwise-vs-bruck cost-model
+// verdicts, ISSUE 18) — inserted after topology_probes_total, so
+// later counter ids shifted.
 // v8: persistent locked data plane (ISSUE 17) —
 // ctrl_persistent_fires_total (consensus rounds served by the
 // shared-memory cells or the inline token piggyback),
@@ -58,7 +61,7 @@ namespace hvd {
 // tcp_zerocopy_mode gauge (resolved transport mode).
 // v2: per-algorithm TCP allreduce counters (tcp_algo_*_ops_total) and
 // the hd/striped schedule-interpreter phase histograms.
-constexpr int kMetricsVersion = 8;
+constexpr int kMetricsVersion = 9;
 constexpr int kMetricsHistBuckets = 28;  // le = 2^0 .. 2^26, then +Inf
 
 // Monotonic counters (suffix _total) and point-in-time gauges (filled
@@ -118,6 +121,9 @@ enum MetricCounter : int {
   // by the cost model instead of the hand bands, and probe runs.
   kCtrAlgoMeasuredSelects,
   kCtrTopoProbes,
+  // Alltoall schedule-family auto verdicts served by the measured
+  // cost model (pairwise vs bruck; hvd/topology.h, ISSUE 18).
+  kCtrAlltoallMeasuredSelects,
   // Worker pool.
   kCtrPoolJobs,               // ParallelFor dispatches (parts > 1)
   // Stall inspector.

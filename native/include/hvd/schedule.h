@@ -72,6 +72,23 @@ extern const char* const kCollectiveAlgoNames[kNumCollectiveAlgos];
 
 const char* CollectiveAlgoName(int algo);
 
+// Alltoall schedule families (ISSUE 18). Wire-stable like
+// CollectiveAlgo: the ids ride Response.collective_algo on ALLTOALL
+// responses and the param-sync string (field 17), and index
+// kAlltoallAlgoNames (the HOROVOD_ALLTOALL_ALGO choice list).
+// kA2aAuto resolves through the measured cost model (pairwise when no
+// model covers the world) and never appears in a Response.
+enum AlltoallAlgo : int {
+  kA2aAuto = 0,
+  kA2aPairwise = 1,  // dense pairwise exchange (legacy byte stream)
+  kA2aBruck = 2,     // log-round store-and-forward (latency regime)
+  kNumAlltoallAlgos = 3,
+};
+
+extern const char* const kAlltoallAlgoNames[kNumAlltoallAlgos];
+
+const char* AlltoallAlgoName(int algo);
+
 enum class ChunkAction : uint8_t {
   SEND = 0,         // ship my chunk bytes to `peer`
   RECV = 1,         // land the peer's chunk bytes (final value)
@@ -154,6 +171,14 @@ ChunkSchedule BuildReduceScatterRing(int nranks, int pos);
 // s >= 1 sends block (p → p+s) while block (p-s → p) lands — the
 // dense MPI_Alltoallv pairwise exchange as data.
 ChunkSchedule BuildAlltoallPairwise(int nranks, int pos);
+// Bruck-style store-and-forward alltoall: chunk (s → d) travels the
+// binary expansion of its rank distance, so the exchange finishes in
+// ceil(log2(P)) rounds of ~P/2 chunks each instead of P-1 direct
+// steps — relayed chunks ship up to log2(P) times, the latency-vs-
+// bandwidth trade the alltoall cost model arbitrates. Relay ranks
+// RECV a chunk one step and SEND the same chunk a later step (the
+// executor provides the scratch spans).
+ChunkSchedule BuildAlltoallBruck(int nranks, int pos);
 
 // Dispatch by algorithm id (kAlgoHd / kAlgoStriped / kAlgoRing — ring
 // maps to BuildStripedRing(P, p, 1)). Other ids return an empty
@@ -164,9 +189,10 @@ ChunkSchedule BuildAlltoallPairwise(int nranks, int pos);
 ChunkSchedule BuildSchedule(int algo, int nranks, int pos);
 ChunkSchedule BuildSchedule(int algo, int nranks, int pos, int stripes,
                             int granularity, int hd_order);
-// Kind dispatch: allreduce routes through BuildSchedule; the other
-// kinds ignore `algo` except where a family choice exists (allgather /
-// reducescatter ride the ring, alltoall the pairwise exchange).
+// Kind dispatch: allreduce routes through BuildSchedule; allgather /
+// reducescatter ride the ring regardless of `algo`; alltoall reads
+// `algo` in AlltoallAlgo space (kA2aBruck selects the Bruck table,
+// anything else the legacy pairwise exchange).
 ChunkSchedule BuildCollSchedule(int kind, int algo, int nranks, int pos,
                                 int stripes, int granularity, int hd_order);
 

@@ -124,6 +124,20 @@ int ResolveAlgoMeasured(int64_t bytes, int np, bool hier_ok,
                         const TopologyModel& m, int stripes,
                         int granularity, int hd_order);
 
+// Alltoall family pricing (ISSUE 18): cost of the `algo` (AlltoallAlgo
+// space) chunk table at `bytes` — the TOTAL exchanged payload across
+// all ranks; the P*P grid splits it uniformly, matching the dense
+// equal-splits case the schedule families differ on. Same ScheduleCostUs
+// walk as the allreduce candidates.
+double AlltoallAlgoCostUs(int algo, int64_t bytes, const TopologyModel& m);
+
+// Measured pairwise-vs-bruck verdict for one alltoall response. Never
+// returns kA2aAuto; pairwise (the legacy byte stream) when the model
+// is missing or covers a different world. Strict argmin keeps ties on
+// pairwise — deterministic on every rank because the model doubles
+// are broadcast-identical.
+int ResolveAlltoallMeasured(int64_t bytes, int np, const TopologyModel& m);
+
 // Last-probe wall time for the topology_probe_ms gauge, process-wide
 // (the topology_links_measured gauge reads the LIVE controller model
 // instead — a cache-loaded model measured its links in another job).

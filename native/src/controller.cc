@@ -272,6 +272,10 @@ Response Controller::ConstructResponse(const std::string& name,
             for (int k = 0; k < size_; ++k)
               resp.recvsplits[static_cast<size_t>(r) * size_ + k] =
                   splits[k][r];
+          // Raw per-op schedule-family wish (AlltoallAlgo space, 0 =
+          // follow the synced force / measured verdict); resolved in
+          // CoordinatorStep like the allreduce algorithm.
+          resp.collective_algo = first.collective_algo;
         }
         break;
       }
@@ -315,6 +319,25 @@ int Controller::ResolveAlgoAuto(int64_t payload_bytes, int ncontributors,
   }
   return ResolveAlgoDefault(payload_bytes, ncontributors, hier_ok,
                             ring_threshold_bytes_);
+}
+
+int Controller::ResolveAlltoallAlgo(int request_algo,
+                                    int64_t payload_bytes) const {
+  int algo = (request_algo > kA2aAuto && request_algo < kNumAlltoallAlgos)
+                 ? request_algo
+                 : alltoall_algo_;
+  if (algo != kA2aAuto) return algo;
+  // Measured pairwise-vs-bruck verdict under the same model-staleness
+  // rules as ResolveAlgoAuto; the fallback is the pairwise exchange —
+  // the legacy byte stream. Alltoall is rejected under Join, so the
+  // contributor set is always the full world here.
+  auto m = topology_model();
+  if (m != nullptr && m->np == size_ &&
+      TopologyKeyMatchesWorld(m->hostkey, size_, local_size_)) {
+    MetricAdd(kCtrAlltoallMeasuredSelects);
+    return ResolveAlltoallMeasured(payload_bytes * size_, size_, *m);
+  }
+  return kA2aPairwise;
 }
 
 int Controller::ResolveCollectiveAlgo(int request_algo, int64_t payload_bytes,
@@ -458,6 +481,13 @@ ResponseList Controller::CoordinatorStep(
         merged.collective_algo = static_cast<int8_t>(
             ResolveCollectiveAlgo(merged.collective_algo, bytes, np));
       }
+    } else if (merged.response_type == ResponseType::ALLTOALL) {
+      // One concrete schedule family per response, coordinator-
+      // resolved from synced inputs — a per-rank pairwise/bruck
+      // divergence would deadlock the exchange like any desynced
+      // data-plane choice.
+      merged.collective_algo = static_cast<int8_t>(
+          ResolveAlltoallAlgo(merged.collective_algo, built[i].bytes));
     }
     out.responses.push_back(std::move(merged));
   }
@@ -631,7 +661,8 @@ Status TcpController::Initialize() {
                          std::to_string(collective_granularity_) + ":" +
                          std::to_string(hd_order_) + ":" +
                          std::to_string(steady_lock_knob_) + ":" +
-                         std::to_string(steady_persistent_knob_);
+                         std::to_string(steady_persistent_knob_) + ":" +
+                         std::to_string(alltoall_algo_);
     for (int peer = 1; peer < size_; ++peer) {
       if (!ctrl_conns_[peer].SendFrame(params))
         return Status::UnknownError("param sync: lost control link");
@@ -674,7 +705,8 @@ Status TcpController::Initialize() {
     auto c14 = c13 == std::string::npos ? c13 : params.find(':', c13 + 1);
     auto c15 = c14 == std::string::npos ? c14 : params.find(':', c14 + 1);
     auto c16 = c15 == std::string::npos ? c15 : params.find(':', c15 + 1);
-    if (!ok || c16 == std::string::npos)
+    auto c17 = c16 == std::string::npos ? c16 : params.find(':', c16 + 1);
+    if (!ok || c17 == std::string::npos)
       return Status::UnknownError("param sync: lost control link");
     fusion_threshold_bytes_ = std::atoll(params.c_str());
     ring_threshold_bytes_ = std::atoll(params.c_str() + c1 + 1);
@@ -699,6 +731,10 @@ Status TcpController::Initialize() {
     // persistent plan changes the consensus transport and the locked
     // wire framing, so it must be job-unique for the same reason.
     SetSteadyPersistent(std::atoi(params.c_str() + c16 + 1));
+    // Field 17: rank 0's HOROVOD_ALLTOALL_ALGO verdict — the family
+    // is resolved into each ALLTOALL response, so the force feeding
+    // that resolution must be job-unique like the allreduce one.
+    SetAlltoallAlgo(std::atoi(params.c_str() + c17 + 1));
     if (topo_mode_ == 2) {
       // Rank 0's cached model rides the quiet data link as one frame.
       std::string blob;

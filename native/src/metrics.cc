@@ -47,6 +47,7 @@ constexpr const char* kCounterNames[] = {
     "tcp_algo_hier_ops_total",
     "collective_measured_selects_total",
     "topology_probes_total",
+    "alltoall_measured_selects_total",
     "pool_jobs_total",
     "stall_events_total",
     "cycles_idle_total",
@@ -80,6 +81,7 @@ constexpr int kCounterKinds[] = {
     0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
     0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
     0, 0,        // measured selects, topology probes
+    0,           // alltoall measured selects
     0, 0, 0,     // idle cycles, lock engagements, bypassed responses
     0, 0, 0, 0, 0, 0, 0,  // unlocks: total + six reasons
     0,           // membership changes

@@ -923,6 +923,13 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
       hvd::EnvInt64Sane("HOROVOD_COLLECTIVE_GRANULARITY", 1, 1, 8)));
   st.controller->SetHdOrder(static_cast<int>(
       hvd::EnvInt64Sane("HOROVOD_HD_ORDER", 0, 0, 1)));
+  // Alltoall schedule-family force (ISSUE 18): same sane-choice and
+  // coordinator-sync discipline (param field 17) — "auto" lets the
+  // measured topology model arbitrate pairwise vs bruck per response.
+  st.controller->SetAlltoallAlgo(
+      hvd::EnvChoiceSane("HOROVOD_ALLTOALL_ALGO", 0,
+                         hvd::kAlltoallAlgoNames,
+                         hvd::kNumAlltoallAlgos));
   st.controller->SetTopology(local_rank, local_size, cross_rank, cross_size);
   st.controller->SetHierarchical(   // any nonzero enables (see above)
       hvd::EnvInt64Sane("HOROVOD_HIERARCHICAL_ALLREDUCE", 0, 0, 1 << 30)
@@ -1676,6 +1683,41 @@ const char* hvd_algo_name(int algo) { return hvd::CollectiveAlgoName(algo); }
 int hvd_collective_algo() {
   auto& st = hvd::State();
   return st.controller ? st.controller->collective_algo() : 0;
+}
+
+const char* hvd_alltoall_algo_name(int algo) {
+  return hvd::AlltoallAlgoName(algo);
+}
+
+// The live job-wide alltoall family force (0 = measured verdict)
+// after env parse and param sync.
+int hvd_alltoall_algo() {
+  auto& st = hvd::State();
+  return st.controller ? st.controller->alltoall_algo() : 0;
+}
+
+// Alpha-beta cost (us) of one alltoall family's P tables at TOTAL
+// exchanged bytes under the live model; <0 when no model. bench.py and
+// the selection tests use this to cross-check the measured verdict
+// against the priced tables.
+double hvd_alltoall_cost_us(int algo, int64_t bytes) {
+  auto& st = hvd::State();
+  if (!st.controller) return -1.0;
+  auto m = st.controller->topology_model();
+  if (m == nullptr) return -1.0;
+  const double c = hvd::AlltoallAlgoCostUs(algo, bytes, *m);
+  return c >= 1e18 ? -1.0 : c;
+}
+
+// Measured-model alltoall verdict for one (total bytes, np) cell using
+// THIS process's broadcast topology model. Returns -1 when no model
+// covers np — the coordinator then serves pairwise.
+int hvd_alltoall_select_measured(int64_t bytes, int np) {
+  auto& st = hvd::State();
+  if (!st.controller) return -1;
+  auto m = st.controller->topology_model();
+  if (m == nullptr || m->np != np) return -1;
+  return hvd::ResolveAlltoallMeasured(bytes, np, *m);
 }
 
 // Wire-codec kernel entry points (tests/test_host_kernels.py drives

@@ -2343,12 +2343,19 @@ Status TcpOps::Alltoall(const Response& r,
     return o;
   };
   if (tables_on_ && size > 1) {
-    // Pairwise exchange as a table (ISSUE 13): chunk s*size + d is the
+    // Alltoall as a table (ISSUE 13): chunk s*size + d is the
     // (src → dst) block; my row's spans point into the input at the
     // send offsets, my column's into the output at the recv offsets,
-    // and the COPY op is the self block. Step order and per-step byte
-    // stream match the legacy SendRecv loop exactly.
-    ChunkSchedule sched = BuildAlltoallPairwise(size, rank);
+    // and the COPY op is the self block. The coordinator resolves the
+    // schedule family into the response (ISSUE 18): pairwise keeps
+    // the legacy SendRecv loop's step order and byte stream exactly;
+    // bruck trades relayed bytes for log-round latency and routes
+    // each relayed chunk through a scratch span (RECV one step, SEND
+    // the same bytes a later step — safe because the engine joins its
+    // recv helpers per step).
+    const bool bruck = r.collective_algo == kA2aBruck;
+    ChunkSchedule sched = bruck ? BuildAlltoallBruck(size, rank)
+                                : BuildAlltoallPairwise(size, rank);
     std::vector<std::vector<struct iovec>> sspans(
         static_cast<size_t>(size) * size);
     std::vector<std::vector<struct iovec>> rspans(
@@ -2365,6 +2372,34 @@ Status TcpOps::Alltoall(const Response& r,
       if (b > 0)
         rspans[static_cast<size_t>(k) * size + rank].push_back(
             {out + recv_off_rows(k) * row_bytes, static_cast<size_t>(b)});
+    }
+    std::vector<uint8_t> scratch;
+    if (bruck) {
+      // Relay chunks: every RECV whose chunk is not destined here is
+      // a store-and-forward hop — it lands in scratch and the later
+      // SEND of the same chunk ships the same bytes. The recvsplits
+      // matrix makes every chunk's size locally computable.
+      std::vector<int> relay;
+      for (const auto& o : sched.ops)
+        if (o.action == ChunkAction::RECV && o.chunk % size != rank)
+          relay.push_back(o.chunk);
+      int64_t total = 0;
+      std::vector<int64_t> offs(relay.size());
+      for (size_t i = 0; i < relay.size(); ++i) {
+        offs[i] = total;
+        total += recv_rows(relay[i] % size, relay[i] / size) * row_bytes;
+      }
+      scratch.resize(static_cast<size_t>(total));
+      for (size_t i = 0; i < relay.size(); ++i) {
+        const int64_t b =
+            recv_rows(relay[i] % size, relay[i] / size) * row_bytes;
+        if (b > 0) {
+          const struct iovec io = {scratch.data() + offs[i],
+                                   static_cast<size_t>(b)};
+          sspans[relay[i]].push_back(io);
+          rspans[relay[i]].push_back(io);
+        }
+      }
     }
     std::vector<int> all_ranks(size);
     for (int k = 0; k < size; ++k) all_ranks[k] = k;

@@ -10,6 +10,14 @@ const char* CollectiveAlgoName(int algo) {
                                                  : "?";
 }
 
+const char* const kAlltoallAlgoNames[kNumAlltoallAlgos] = {
+    "auto", "pairwise", "bruck"};
+
+const char* AlltoallAlgoName(int algo) {
+  return algo >= 0 && algo < kNumAlltoallAlgos ? kAlltoallAlgoNames[algo]
+                                               : "?";
+}
+
 namespace {
 
 void Push(ChunkSchedule* s, int step, int peer, int chunk, ChunkAction a,
@@ -239,6 +247,46 @@ ChunkSchedule BuildAlltoallPairwise(int P, int p) {
   return s;
 }
 
+ChunkSchedule BuildAlltoallBruck(int P, int p) {
+  // Grid P*P, chunk s*P + d = the (src s → dst d) block, same as the
+  // pairwise table. Chunk (s, d) travels the binary expansion of
+  // dist = mod(d - s): round k (step k + 1) moves every chunk whose
+  // dist has bit k set forward by 2^k. The holder before round k is
+  // mod(s + (dist & (2^k - 1))); partial bit-sums are distinct values
+  // below P, so a chunk visits each rank at most once and a relay
+  // never re-sends a chunk in the round it lands. Each round every
+  // rank talks to ONE peer pair (send to p + 2^k, recv from p - 2^k),
+  // so the exchange is ceil(log2(P)) steps of ~half the grid instead
+  // of P - 1 direct steps — relayed bytes ship multiple times, which
+  // is exactly the trade AlltoallAlgoCostUs prices.
+  ChunkSchedule s;
+  s.nchunks = P * P;
+  if (P <= 1) return Trivial(P * P);
+  auto mod = [&](int x) { return ((x % P) + P) % P; };
+  Push(&s, 0, 0, p * P + p, ChunkAction::COPY);
+  int rounds = 0;
+  while ((1 << rounds) < P) ++rounds;
+  for (int k = 0; k < rounds; ++k) {
+    const int hop = 1 << k;
+    // Both sides of every link enumerate the grid in the same
+    // (src, dst) order — the per-(step, pair) framing contract the
+    // verifier checks.
+    for (int src = 0; src < P; ++src) {
+      for (int dst = 0; dst < P; ++dst) {
+        const int dist = mod(dst - src);
+        if (!(dist & hop)) continue;
+        const int holder = mod(src + (dist & (hop - 1)));
+        const int chunk = src * P + dst;
+        if (holder == p)
+          Push(&s, k + 1, mod(p + hop), chunk, ChunkAction::SEND);
+        else if (mod(holder + hop) == p)
+          Push(&s, k + 1, holder, chunk, ChunkAction::RECV);
+      }
+    }
+  }
+  return s;
+}
+
 ChunkSchedule BuildSchedule(int algo, int nranks, int pos) {
   return BuildSchedule(algo, nranks, pos, 2, 1, 0);
 }
@@ -269,7 +317,9 @@ ChunkSchedule BuildCollSchedule(int kind, int algo, int nranks, int pos,
     case kCollReducescatter:
       return BuildReduceScatterRing(nranks, pos);
     case kCollAlltoall:
-      return BuildAlltoallPairwise(nranks, pos);
+      // `algo` is in AlltoallAlgo space for this kind.
+      return algo == kA2aBruck ? BuildAlltoallBruck(nranks, pos)
+                               : BuildAlltoallPairwise(nranks, pos);
     default:
       return ChunkSchedule{};
   }

@@ -420,6 +420,34 @@ double AlgoCostUs(int algo, int64_t bytes, const TopologyModel& m,
   return ScheduleCostUs(tables, bytes, m);
 }
 
+double AlltoallAlgoCostUs(int algo, int64_t bytes, const TopologyModel& m) {
+  if (!m.valid()) return 1e18;
+  const int P = m.np;
+  std::vector<ChunkSchedule> tables;
+  tables.reserve(P);
+  for (int p = 0; p < P; ++p)
+    tables.push_back(BuildCollSchedule(kCollAlltoall, algo, P, p,
+                                       /*stripes=*/2, /*granularity=*/1,
+                                       /*hd_order=*/0));
+  if (tables[0].ops.empty()) return 1e18;
+  return ScheduleCostUs(tables, bytes, m);
+}
+
+int ResolveAlltoallMeasured(int64_t bytes, int np, const TopologyModel& m) {
+  if (!m.valid() || m.np != np) return kA2aPairwise;
+  static const int kCandidates[] = {kA2aPairwise, kA2aBruck};
+  int best = kA2aPairwise;
+  double best_cost = 1e18;
+  for (int algo : kCandidates) {
+    const double c = AlltoallAlgoCostUs(algo, bytes, m);
+    if (c < best_cost) {
+      best_cost = c;
+      best = algo;
+    }
+  }
+  return best;
+}
+
 int ResolveAlgoMeasured(int64_t bytes, int np, bool hier_ok,
                         int64_t ring_threshold_bytes,
                         const TopologyModel& m, int stripes,

@@ -1895,6 +1895,102 @@ def main():
         assert (out == sum(range(1, s + 1))).all()
         print(f"OK rank={r}")
 
+    elif scenario == "a2a_algo":
+        # Alltoall schedule families (ISSUE 18): whatever family the
+        # coordinator resolves (HOROVOD_ALLTOALL_ALGO force or the
+        # measured verdict), ragged + uniform + fused alltoalls must
+        # produce the exact legacy bytes — the driver compares a
+        # bruck-forced job against a pairwise one digest-for-digest.
+        import hashlib
+
+        from horovod_tpu.common.basics import get_lib
+
+        digests = []
+        rng = np.random.RandomState(50 + r)
+        splits = [k + 1 for k in range(s)]
+        xa = rng.randn(sum(splits), 3).astype(np.float32)
+        a2a, rsplits = hvd.alltoall(xa, splits=splits, name="aa.ragged")
+        assert list(rsplits) == [r + 1] * s, rsplits
+        digests.append("rag:" + hashlib.sha1(
+            np.asarray(a2a).tobytes()).hexdigest())
+        # Uniform splits, wide enough rows that the >8KB helper-thread
+        # wave runs through the relay scratch when bruck serves.
+        xu = rng.randn(4 * s, 2048).astype(np.float32)
+        u, _ = hvd.alltoall(xu, name="aa.uniform")
+        digests.append("uni:" + hashlib.sha1(
+            np.asarray(u).tobytes()).hexdigest())
+        ha = hvd.alltoall_async(
+            rng.randn(s, 5).astype(np.float32), name="aa.f.a")
+        hb = hvd.alltoall_async(
+            rng.randn(2 * s, 7).astype(np.float32), name="aa.f.b")
+        outs = [hvd.synchronize(ha), hvd.synchronize(hb)]
+        digests.append("fus:" + hashlib.sha1(
+            b"".join(np.asarray(x).tobytes() for x in outs)).hexdigest())
+        print("DIGEST " + "|".join(digests))
+        # Introspection: every rank reports the coordinator-synced
+        # family force (rank 0's env wins through param field 17).
+        print(f"A2AALGO {get_lib().hvd_alltoall_algo()}")
+        print(f"OK rank={r}")
+
+    elif scenario == "a2a_measured":
+        # Measured alltoall selection (ISSUE 18): inject a synthetic
+        # alpha-beta model and pin the verdict bands — bruck's
+        # log-round tables win the latency regime, pairwise's
+        # every-byte-once exchange wins the bandwidth regime — plus
+        # the coordinator's live auto path (metric tick + staleness
+        # refusal), all with exact alltoall results throughout.
+        import ctypes
+
+        from horovod_tpu.common.basics import get_lib
+
+        lib = get_lib()
+        lib.hvd_alltoall_cost_us.restype = ctypes.c_double
+        n = s * s
+
+        def _blob(key, alpha, beta):
+            al = " ".join("0" if i % (s + 1) == 0 else str(alpha)
+                          for i in range(n))
+            be = " ".join("0" if i % (s + 1) == 0 else str(beta)
+                          for i in range(n))
+            return (f"hvdtopo 1\nkey {key}\nnp {s}\n"
+                    f"alpha {al}\nbeta {be}\n").encode()
+
+        live_key = f"w|np{s}|ls{hvd.local_size()}"
+        assert lib.hvd_topology_inject(
+            _blob(live_key, 500, 0.001)) == s
+        A2A_PAIRWISE, A2A_BRUCK = 1, 2
+        small, huge = ctypes.c_int64(1 << 12), ctypes.c_int64(1 << 27)
+        assert lib.hvd_alltoall_select_measured(small, s) == A2A_BRUCK
+        assert lib.hvd_alltoall_select_measured(huge, s) == A2A_PAIRWISE
+        # The verdict is the argmin of the priced tables, by
+        # construction — pin the cost ordering behind each band.
+        assert (lib.hvd_alltoall_cost_us(A2A_BRUCK, small)
+                < lib.hvd_alltoall_cost_us(A2A_PAIRWISE, small))
+        assert (lib.hvd_alltoall_cost_us(A2A_PAIRWISE, huge)
+                < lib.hvd_alltoall_cost_us(A2A_BRUCK, huge))
+        # Live auto path: the coordinator (rank 0) resolves through the
+        # measured model — the select counter ticks there, and the
+        # exchange stays exact whichever family served.
+        m0 = hvd.metrics()["alltoall_measured_selects_total"]
+        x = np.arange(s * 4, dtype=np.float32) + 100 * r
+        out, _ = hvd.alltoall(x.reshape(s, 4), name="am.x")
+        want = np.stack([np.arange(4, dtype=np.float32) + 4 * r + 100 * k
+                         for k in range(s)])
+        assert (np.asarray(out) == want).all(), out
+        m1 = hvd.metrics()["alltoall_measured_selects_total"]
+        if r == 0:
+            assert m1 == m0 + 1, (m0, m1)
+        # Staleness: a model keyed to a DIFFERENT world shape must be
+        # refused — no tick, pairwise fallback serves, still exact.
+        assert lib.hvd_topology_inject(
+            _blob("w|np64|ls64", 500, 0.001)) == s
+        out2, _ = hvd.alltoall(x.reshape(s, 4), name="am.y")
+        assert (np.asarray(out2) == want).all()
+        if r == 0:
+            assert (hvd.metrics()["alltoall_measured_selects_total"]
+                    == m1), "stale alltoall model served a verdict"
+        print(f"OK rank={r}")
+
     elif scenario == "idle_cycles":
         # Event-driven loop telemetry (ISSUE 15 satellite): while the
         # process idles the background thread parks on the enqueue CV —

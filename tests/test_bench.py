@@ -380,3 +380,32 @@ def test_find_regressions_elastic_churn_key_directions():
         {"extra": {"steady_relock_after_join_ms": 700.0}},
         {"extra": {"steady_relock_after_join_ms": 1200.0}})
     assert "extra.steady_relock_after_join_ms" in regs2
+
+
+def test_find_regressions_moe_dispatch_key_directions():
+    """ISSUE 18 keys: the MoE dispatch arms
+    (`moe_tokens_per_sec_{gspmd,none,bf16,int8}`) are throughput
+    metrics — higher is better, gated on drops, an int8 win over the
+    gspmd reference never flags — and `moe_dispatch_bytes_saved_pct`
+    is a static efficiency metric that gates higher-is-better like
+    `wire_bytes_saved_pct` (a drop means the codec's byte accounting
+    or block geometry regressed, which no tokens/sec noise excuses)."""
+    prev = {"extra": {"moe_tokens_per_sec_gspmd": 9.0e3,
+                      "moe_tokens_per_sec_none": 9.1e3,
+                      "moe_tokens_per_sec_bf16": 1.1e4,
+                      "moe_tokens_per_sec_int8": 1.3e4,
+                      "moe_dispatch_bytes_saved_pct": 74.5}}
+    cur = {"extra": {"moe_tokens_per_sec_gspmd": 8.8e3,   # noise: silent
+                     "moe_tokens_per_sec_none": 9.2e3,    # noise: silent
+                     "moe_tokens_per_sec_bf16": 7.0e3,    # drop: flags
+                     "moe_tokens_per_sec_int8": 1.6e4,    # gain: silent
+                     "moe_dispatch_bytes_saved_pct": 49.0}}
+    regs = bench.find_regressions(prev, cur)
+    assert set(regs) == {"extra.moe_tokens_per_sec_bf16",
+                         "extra.moe_dispatch_bytes_saved_pct"}
+    assert regs["extra.moe_tokens_per_sec_bf16"]["drop_pct"] > 35
+    assert regs["extra.moe_dispatch_bytes_saved_pct"]["drop_pct"] > 30
+    # A single-device round (gspmd key only) against a full round must
+    # not flag the absent island keys.
+    assert bench.find_regressions(
+        prev, {"extra": {"moe_tokens_per_sec_gspmd": 8.9e3}}) == {}

@@ -153,3 +153,50 @@ def test_synthesized_tables_bitwise_match_ring_np8():
     _digests_agree(run_job("synth_live", 8, timeout=420, extra_env=dict(
         TCP, HOROVOD_COLLECTIVE_STRIPES="2",
         HOROVOD_COLLECTIVE_GRANULARITY="2", HOROVOD_HD_ORDER="1")))
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 18: the Bruck alltoall family live, and the measured
+# (alpha-beta) pairwise-vs-bruck verdict.
+# ---------------------------------------------------------------------------
+
+def _a2a_digests(outs, want_algo):
+    digests = []
+    for r, out in enumerate(outs):
+        assert f"OK rank={r}" in out
+        assert f"A2AALGO {want_algo}" in out, (r, out[-400:])
+        for line in out.splitlines():
+            if line.startswith("DIGEST "):
+                digests.append(line)
+    assert len(set(digests)) <= 1 or digests, digests
+    return digests
+
+
+def test_alltoall_bruck_bitwise_matches_pairwise_np4():
+    """The acceptance pin for the relay engine: ragged, uniform-wide
+    (>8KB helper-thread wave through the relay scratch) and async-pair
+    alltoalls under HOROVOD_ALLTOALL_ALGO=bruck produce the EXACT
+    bytes of the default pairwise exchange — two identical jobs, one
+    per family, digests compared bit for bit. Every rank introspects
+    the param-synced family force (field 17)."""
+    bruck = _a2a_digests(run_job("a2a_algo", 4, timeout=240,
+                                 extra_env=dict(
+                                     TCP, HOROVOD_ALLTOALL_ALGO="bruck")),
+                         want_algo=2)
+    pair = _a2a_digests(run_job("a2a_algo", 4, timeout=240,
+                                extra_env=TCP), want_algo=0)
+    assert bruck == pair, (bruck, pair)
+
+
+def test_alltoall_measured_verdict_bands_and_staleness():
+    """Injected synthetic model: bruck wins the latency band, pairwise
+    the bandwidth band (argmin of hvd_alltoall_cost_us both times);
+    the coordinator's auto path ticks alltoall_measured_selects_total
+    and a stale-keyed model is refused — with exact exchange results
+    under every verdict. np=4 because bruck's round saving only
+    appears at ceil(log2 P) < P - 1 (at np=3 both families run two
+    exchange rounds and bruck adds relay bytes, so pairwise correctly
+    wins everywhere)."""
+    outs = run_job("a2a_measured", 4, timeout=240, extra_env=TCP)
+    for r, out in enumerate(outs):
+        assert f"OK rank={r}" in out

@@ -146,6 +146,30 @@ def test_lm_pretrain_example_spmd_mesh(tmp_path):
     assert "'dp': 2" in proc.stdout and "'tp': 2" in proc.stdout
 
 
+@pytest.mark.slow  # same budget call as the dense smoke above: the
+# island train step itself is pinned in tier-1 (test_moe's ten-step
+# bitwise/convergence tests); this adds only the example's argv
+# plumbing on a subprocess-spawned 8-device mesh.
+def test_lm_pretrain_example_moe_island(tmp_path):
+    """`--moe --ep 8` drives the expert-parallel island end to end
+    from the example CLI: ep-only mesh, int8 dispatch codec, finite
+    loss."""
+    import subprocess
+
+    env = dict(os.environ)
+    env.update(_WORKER_ENV)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "examples", "lm_pretrain.py"),
+         "--platform", "cpu", "--steps", "2", "--tiny", "--moe",
+         "--ep", "8", "--moe-compression", "int8"],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "DONE loss=" in proc.stdout
+    assert "'ep': 8" in proc.stdout
+
+
 @pytest.mark.slow  # heavy multiprocess spawn; coverage overlaps the
 # fast tier — keeps tier-1 inside its wall-clock budget
 def test_torch_synthetic_benchmark_2proc(capfd):

@@ -520,11 +520,84 @@ def test_undocumented_membership_metric_fires(tree):
     assert run_all(tree, only={"metric-sync"}) == []
 
 
+def test_moe_knobs_covered_by_knob_rule(tree):
+    """ISSUE 18 satellite: the env-var rule really covers the MoE
+    dispatch knobs spelled the way models/moe.py spells them
+    (resolve_moe_knobs' os.environ reads) and the native alltoall
+    family force: undocumented they fire one finding each, and knob
+    rows like the real perf_tuning.md's clear them (the live-tree
+    guarantee is test_real_tree_is_clean)."""
+    _write(tree, "horovod_tpu/models/moe2.py",
+           'import os\n'
+           'd = os.environ.get("HOROVOD_MOE_DISPATCH", "gspmd")\n'
+           'c = os.environ.get("HOROVOD_MOE_COMPRESSION", "int8")\n')
+    _write(tree, "native/src/operations2.cc",
+           'int a = EnvChoiceSane("HOROVOD_ALLTOALL_ALGO", 0, kC, 3);\n')
+    knobs = {"HOROVOD_MOE_DISPATCH", "HOROVOD_MOE_COMPRESSION",
+             "HOROVOD_ALLTOALL_ALGO"}
+    fs = run_all(tree, only={"knob-docs"})
+    hit = {k for f in fs for k in knobs if f.message.startswith(k + " ")}
+    assert hit == knobs, fs
+    _write(tree, "docs/tuning.md",
+           "`HOROVOD_MOE_DISPATCH` selects the island; "
+           "`HOROVOD_MOE_COMPRESSION` its codec; "
+           "`HOROVOD_ALLTOALL_ALGO` forces pairwise/bruck.\n")
+    assert run_all(tree, only={"knob-docs"}) == []
+
+
+def test_undocumented_moe_metric_fires(tree):
+    """ISSUE 18 satellite: a key in MOE_METRIC_KEYS missing from the
+    observability catalog fires moe-metric-pins — the guard that
+    forced the real catalog rows. The clean tree has no MoE plane, so
+    the rule starts silent; writing moe.py arms it."""
+    _write(tree, "horovod_tpu/models/moe.py", """\
+        MOE_METRIC_KEYS = (
+            "moe_dispatch_overflow_tokens_total",
+            "moe_dispatch_dropped_token_frac",
+        )
+        """)
+    fs = run_all(tree, only={"moe-metric-pins"})
+    hit = {k for f in fs for k in
+           ("moe_dispatch_overflow_tokens_total",
+            "moe_dispatch_dropped_token_frac") if k in f.message}
+    assert hit == {"moe_dispatch_overflow_tokens_total",
+                   "moe_dispatch_dropped_token_frac"}, fs
+    # A brace-family catalog row documents both keys at once.
+    _write(tree, "docs/observability.md",
+           "`cycles_total` `shm_ops_total` `cycle_us` "
+           "`moe_dispatch_{overflow_tokens_total,dropped_token_frac}`\n"
+           "HOROVOD_CYCLE_TIME HOROVOD_COLLECTIVE_ALGO\n")
+    assert run_all(tree, only={"moe-metric-pins"}) == []
+
+
+def test_moe_metric_pin_discipline_fires(tree):
+    """moe-metric-pins' single-source half: a missing tuple, an
+    off-namespace key, and a stray second definition site each fire."""
+    _write(tree, "docs/observability.md",
+           "`cycles_total` `shm_ops_total` `cycle_us` `moe_dispatch_x`\n"
+           "HOROVOD_CYCLE_TIME HOROVOD_COLLECTIVE_ALGO\n")
+    _write(tree, "horovod_tpu/models/moe.py",
+           "KEYS = ()  # renamed\n")
+    fs = run_all(tree, only={"moe-metric-pins"})
+    assert len(fs) == 1 and "not found" in fs[0].message, fs
+    _write(tree, "horovod_tpu/models/moe.py",
+           'MOE_METRIC_KEYS = ("serve_thing",)\n')
+    fs = run_all(tree, only={"moe-metric-pins"})
+    assert any("namespace" in f.message for f in fs), fs
+    _write(tree, "horovod_tpu/models/moe.py",
+           'MOE_METRIC_KEYS = ("moe_dispatch_x",)\n')
+    _write(tree, "horovod_tpu/runtime.py",
+           'MOE_METRIC_KEYS = ("moe_dispatch_x",)\n')
+    fs = run_all(tree, only={"moe-metric-pins"})
+    assert len(fs) == 1 and fs[0].path == "horovod_tpu/runtime.py", fs
+
+
 def test_every_rule_has_an_injection_test():
     """Meta-guard: adding a rule without an injection test here should
     fail loudly, not pass silently."""
     covered = {"getenv", "knob-docs", "abi-literal", "metric-sync",
-               "doc-links", "wire-codec-pins", "algo-name-pins"}
+               "doc-links", "wire-codec-pins", "algo-name-pins",
+               "moe-metric-pins"}
     assert covered == set(ALL_RULES), (
         "new lint rule(s) without bug-injection coverage: "
         f"{set(ALL_RULES) - covered}")

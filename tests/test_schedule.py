@@ -285,3 +285,65 @@ def test_measured_select_without_model_is_unavailable():
     lib = get_lib()
     assert lib.hvd_algo_select_measured(
         ctypes.c_int64(1 << 20), 4, 0, ctypes.c_int64(RING_THRESHOLD)) == -1
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 18: the Bruck alltoall family — log-round store-and-forward
+# tables for the latency band the measured cost model prices against
+# pairwise (AlltoallAlgoCostUs / ResolveAlltoallMeasured).
+# ---------------------------------------------------------------------------
+
+A2A_PAIRWISE, A2A_BRUCK = 1, 2
+
+
+@pytest.mark.parametrize("nranks", NPS)
+def test_alltoall_bruck_tables_verify(nranks):
+    """Every (s → d) block lands intact through the relay chain — the
+    verifier's alltoall semantics over all ranks in lockstep, including
+    the non-power-of-two np=3 where dist bits straddle the modulus."""
+    scheds = build_all(nranks, algo=A2A_BRUCK, kind=COLL_A2A)
+    sv.verify(scheds, nranks, sv.KIND_ALLTOALL)
+
+
+@pytest.mark.parametrize("nranks", NPS)
+def test_alltoall_bruck_log_rounds(nranks):
+    """Bruck runs ceil(log2 P) exchange rounds plus the step-0 self
+    COPY; pairwise needs P - 1 rounds. The step saving at P >= 4 is the
+    alpha-term win the cost model trades against the ~P/2x relay
+    bytes."""
+    bruck = build_all(nranks, algo=A2A_BRUCK, kind=COLL_A2A)
+    pair = build_all(nranks, algo=A2A_PAIRWISE, kind=COLL_A2A)
+    rounds = (nranks - 1).bit_length()
+    assert bruck[0][0] == rounds + 1
+    assert pair[0][0] == nranks
+    if nranks >= 4:
+        assert bruck[0][0] < pair[0][0]
+
+
+def test_alltoall_bruck_relays_chunks():
+    """At P=8 some chunks must hop through an intermediate: rank p
+    RECVs blocks NOT addressed to it (chunk % P != p) and re-SENDs them
+    a later round — the store-and-forward structure pairwise never
+    has."""
+    P = 8
+    for p in range(P):
+        _, _, ops = build_all(P, algo=A2A_BRUCK, kind=COLL_A2A)[p]
+        relayed = {c for (st, peer, c, act, fl) in ops
+                   if act == RECV and c % P != p}
+        assert relayed, f"rank {p}: no relayed chunks at P={P}"
+        resent = {c for (st, peer, c, act, fl) in ops
+                  if act == SEND and c in relayed}
+        assert resent == relayed, (p, relayed - resent)
+    pair_ops = build_all(P, algo=A2A_PAIRWISE, kind=COLL_A2A)[0][2]
+    assert not any(act == RECV and c % P != 0
+                   for (_s, _pe, c, act, _f) in pair_ops)
+
+
+def test_alltoall_measured_probes_without_model_unavailable():
+    """hvd_alltoall_select_measured / hvd_alltoall_cost_us return -1
+    with no live model — the coordinator then serves pairwise (the
+    ResolveAlltoallAlgo fallback band)."""
+    lib = get_lib()
+    lib.hvd_alltoall_cost_us.restype = ctypes.c_double
+    assert lib.hvd_alltoall_select_measured(ctypes.c_int64(1 << 20), 4) == -1
+    assert lib.hvd_alltoall_cost_us(A2A_BRUCK, ctypes.c_int64(1 << 20)) < 0

@@ -27,18 +27,40 @@ def test_abi_version_pins_match():
     assert _header_constant("kAbiVersion") == basics.ABI_VERSION
 
 
-def test_issue17_version_bumps_landed():
-    """ISSUE 17 lockstep pins: wire formats unchanged (ResponseList
-    stays v7 — the persistent plane reuses the 8-byte LockToken frame
-    and glues it to existing payload bytes) / ABI v13 (the
-    hvd_steady_persistent + hvd_tcp_prepost_buffers accessors and the
-    HOROVOD_STEADY_PERSISTENT param-sync field) / metrics v8 (the
-    persistent-fire counters + pre-post gauge). The relative checks
-    above catch a one-sided bump; this pins the absolute values so a
-    stray revert of BOTH sides is caught too."""
+def test_issue18_version_bumps_landed():
+    """ISSUE 18 lockstep pins: wire formats unchanged (ResponseList
+    stays v7 — Response already serializes collective_algo for every
+    response type, so the alltoall family verdict rides existing
+    bytes) / ABI v14 (the hvd_alltoall_algo + hvd_alltoall_algo_name
+    accessors and the HOROVOD_ALLTOALL_ALGO param-sync field 17) /
+    metrics v9 (alltoall_measured_selects_total shifts later counter
+    ids). The relative checks above catch a one-sided bump; this pins
+    the absolute values so a stray revert of BOTH sides is caught
+    too."""
     assert basics.WIRE_VERSION_RESPONSE_LIST == 7
-    assert basics.ABI_VERSION == 13
-    assert basics.METRICS_VERSION == 8
+    assert basics.ABI_VERSION == 14
+    assert basics.METRICS_VERSION == 9
+
+
+def test_issue18_alltoall_algo_ids_pin_native_enum():
+    """The Python alltoall-family ids (basics.ALLTOALL_ALGOS) must
+    equal the AlltoallAlgo enum in schedule.h, and the loaded library
+    must name them identically — the HOROVOD_ALLTOALL_ALGO knob and
+    the coordinator's resolved verdict must mean the same table on
+    both planes."""
+    import ctypes
+
+    hdr = os.path.join(os.path.dirname(HEADER), "schedule.h")
+    src = open(hdr).read()
+    body = re.search(r"enum\s+AlltoallAlgo[^{]*\{([^}]*)\}", src).group(1)
+    enum = {n: int(v) for n, v in re.findall(r"(kA2a\w+)\s*=\s*(\d+)", body)}
+    assert basics.ALLTOALL_ALGOS["auto"] == enum["kA2aAuto"]
+    assert basics.ALLTOALL_ALGOS["pairwise"] == enum["kA2aPairwise"]
+    assert basics.ALLTOALL_ALGOS["bruck"] == enum["kA2aBruck"]
+    lib = basics.get_lib()
+    lib.hvd_alltoall_algo_name.restype = ctypes.c_char_p
+    for name, aid in basics.ALLTOALL_ALGOS.items():
+        assert lib.hvd_alltoall_algo_name(aid) == name.encode()
 
 
 def test_issue17_inline_geometry_pins():

@@ -484,6 +484,65 @@ def rule_metric_sync(root: str) -> List[Finding]:
     return out
 
 
+# --------------------------------------------------------- moe-metric-pins
+
+# The Python-plane MoE telemetry keys (models/moe.py exports them via
+# the process-wide prometheus exposition) follow the same lockstep
+# discipline metric-sync enforces for the native name tables: one
+# definition site, every key in the observability catalog.
+_MOE_PY = "horovod_tpu/models/moe.py"
+_MOE_KEYS_RE = re.compile(r"MOE_METRIC_KEYS\s*=\s*\(([^)]*)\)")
+_MOE_STRAY_RE = re.compile(r"^\s*MOE_METRIC_KEYS\s*=", re.MULTILINE)
+
+
+def rule_moe_metric_pins(root: str) -> List[Finding]:
+    """MOE_METRIC_KEYS is defined once (models/moe.py), every key lives
+    in the moe_ namespace, and every key is documented in the
+    observability catalog — an undocumented series is invisible to the
+    operators watching for capacity-factor drops."""
+    out: List[Finding] = []
+    try:
+        moe = _read(root, _MOE_PY)
+    except FileNotFoundError:
+        return []          # trees without the MoE plane: nothing to pin
+    m = _MOE_KEYS_RE.search(moe)
+    if not m:
+        return [Finding("moe-metric-pins", _MOE_PY, 0,
+                        "MOE_METRIC_KEYS tuple pin not found")]
+    keys = re.findall(r'"([a-z0-9_]+)"', m.group(1))
+    for d in sorted({k for k in keys if keys.count(k) > 1}):
+        out.append(Finding("moe-metric-pins", _MOE_PY, 0,
+                           f"duplicate metric key {d!r} in MOE_METRIC_KEYS"))
+    for k in keys:
+        if not k.startswith("moe_"):
+            out.append(Finding(
+                "moe-metric-pins", _MOE_PY, 0,
+                f"metric key {k!r} outside the moe_ namespace — the "
+                "exporter's keys must not collide with other planes"))
+    doc_path = os.path.join(root, _METRICS_DOC)
+    doc_toks = (_doc_metric_tokens(_read(root, _METRICS_DOC))
+                if os.path.exists(doc_path) else set())
+    for k in keys:
+        if k not in doc_toks:
+            out.append(Finding(
+                "moe-metric-pins", _METRICS_DOC, 0,
+                f"MoE metric {k!r} (MOE_METRIC_KEYS) missing from the "
+                "observability catalog"))
+    for subdir in ("horovod_tpu", "bin", "examples"):
+        if not os.path.isdir(os.path.join(root, subdir)):
+            continue
+        for rel in _walk(root, subdir, {".py"}):
+            if rel == _MOE_PY:
+                continue
+            for i, ln in enumerate(_read(root, rel).splitlines(), 1):
+                if _MOE_STRAY_RE.match(ln):
+                    out.append(Finding(
+                        "moe-metric-pins", rel, i,
+                        f"MOE_METRIC_KEYS assigned outside its home "
+                        f"{_MOE_PY} — import the pin instead"))
+    return out
+
+
 # -------------------------------------------------------------- doc-links
 
 _MD_LINK_RE = re.compile(r"\[[^\]]*\]\(([^)\s]+)\)")
@@ -521,6 +580,7 @@ ALL_RULES: Dict[str, Callable[[str], List[Finding]]] = {
     "wire-codec-pins": rule_wire_codec_pins,
     "algo-name-pins": rule_algo_name_pins,
     "metric-sync": rule_metric_sync,
+    "moe-metric-pins": rule_moe_metric_pins,
     "doc-links": rule_doc_links,
 }
 
