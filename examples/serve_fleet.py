@@ -159,7 +159,7 @@ def main():
     router.run_until_idle()
 
     by_replica = {}
-    for rid, inst, match in router.placement_log:
+    for rid, inst, match, _cost in router.placement_log:
         by_replica.setdefault(inst, []).append((rid, match))
     print(f"fleet: {args.replicas} replicas "
           f"({args.prefill} prefill / "

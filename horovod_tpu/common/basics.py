@@ -406,6 +406,16 @@ def _declare_abi(lib: ctypes.CDLL, path: str) -> ctypes.CDLL:
     lib.hvd_algo_cost_us.argtypes = [ctypes.c_int, ctypes.c_int64,
                                      ctypes.c_int, ctypes.c_int,
                                      ctypes.c_int]
+    # Point-to-point migration pricing (docs/serving.md "Direct
+    # migration"): the native half of the serving router's cost twin
+    # (horovod_tpu/serve/migrate.py mirrors both formulas); <0 when no
+    # model. The sanitizer tier cross-checks native vs twin.
+    lib.hvd_link_cost_us.restype = ctypes.c_double
+    lib.hvd_link_cost_us.argtypes = [ctypes.c_int, ctypes.c_int,
+                                     ctypes.c_int64]
+    lib.hvd_migration_cost_us.restype = ctypes.c_double
+    lib.hvd_migration_cost_us.argtypes = [ctypes.c_int, ctypes.c_int,
+                                          ctypes.c_int64, ctypes.c_int64]
     lib.hvd_build_coll_schedule.restype = ctypes.c_int
     lib.hvd_build_coll_schedule.argtypes = [
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,

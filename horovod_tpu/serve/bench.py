@@ -414,10 +414,32 @@ def run_spec_benchmark(n_requests: int = 24, *, seed: int = 0,
     }
 
 
+def _thread_fleet(n: int):
+    """In-thread remote fleet: every replica is a real ReplicaWorker
+    dispatch loop on a daemon thread over a socketpair — the RPC seam
+    without process spawn, sharing this process's jit memo. The
+    workers' peer bulk listeners are REAL loopback TCP sockets, so the
+    direct migration plane is exercised end to end."""
+    import socket
+    import threading
+
+    from horovod_tpu.serve.rpc import RpcConn, WorkerHandle
+    from horovod_tpu.serve.worker import ReplicaWorker
+
+    handles = []
+    for _ in range(n):
+        a, b = socket.socketpair()
+        w = ReplicaWorker(RpcConn(b))
+        threading.Thread(target=w.serve, daemon=True).start()
+        handles.append(WorkerHandle(conn=RpcConn(a)))
+    return handles
+
+
 def _run_router_pass(model_cfg, params, trace, *, placement: str,
                      n_replicas: int, n_prefill: int, serve_cfg,
                      seed: int, workers=None,
-                     handoff_compression=None) -> dict:
+                     handoff_compression=None,
+                     direct_migration: str = "env") -> dict:
     """One cold-fleet pass: fresh router (empty caches, reset
     placement state) over the whole trace. Freshness is the point —
     the routed-vs-random claim is about where PLACEMENT puts the
@@ -435,7 +457,8 @@ def _run_router_pass(model_cfg, params, trace, *, placement: str,
     rc = RouterConfig(n_replicas=n_replicas, n_prefill=n_prefill,
                       max_queue=max(len(trace), 8),
                       placement=placement, seed=seed,
-                      handoff_compression=handoff_compression)
+                      handoff_compression=handoff_compression,
+                      direct_migration=direct_migration)
     router = ServeRouter(model_cfg, None if workers else params, rc,
                          serve_cfg, workers=workers, worker_seed=0)
     wire0 = sum(w.conn.span_wire_bytes for w in workers or [])
@@ -459,6 +482,9 @@ def _run_router_pass(model_cfg, params, trace, *, placement: str,
             sum(w.conn.span_wire_bytes for w in workers or []) - wire0,
         "handoff_raw_bytes":
             sum(w.conn.span_raw_bytes for w in workers or []) - raw0,
+        "p50_migration_ms": snap["p50_migration_ms"],
+        "migration_bytes": snap["migration_bytes_total"],
+        "direct_migrations": snap["direct_migrations_total"],
         "_tokens": streams,
     }
 
@@ -569,6 +595,65 @@ def run_router_benchmark(n_requests: int = 48, *, seed: int = 0,
         for h in handles:
             h.close()
 
+    # Direct-vs-relayed migration arm (docs/serving.md "Direct
+    # migration"): a split prefill/decode fleet of in-thread remote
+    # workers with bf16 KV encoding, so EVERY request migrates its
+    # pages pool to pool. The direct arm streams worker->worker over
+    # the peer bulk channel; the relayed arm forces the router-hop
+    # path (HOROVOD_FLEET_DIRECT_MIGRATION=off semantics). Fresh fleet
+    # per pass (cold pools — migration time is the claim, not cache
+    # reuse), arms interleaved per the +-30% drift protocol, p50 takes
+    # the best pass. Byte savings compare the direct arm's wire bytes
+    # (one bf16 traversal) against the relayed arm's router-held raw
+    # bytes — the two traversals the direct plane deletes.
+    #
+    # The arm carries its own long-context trace: at the router
+    # trace's ~100KB sequences, fixed per-move dispatch (the jitted
+    # inject scatter, RPC marshalling) drowns the traversal the
+    # direct plane deletes; ~2MB sequences put the claim where
+    # production KV sizes live.
+    mig_cfg = TransformerConfig.tiny(
+        d_model=256, d_ff=1024, n_layers=2, n_heads=8, n_kv_heads=4,
+        dtype=jnp.float32, remat=False, max_seq=1024)
+    # The prompt lands the cached stream on EXACTLY 128 pages — the
+    # 1024-token bucket width — so the bucket-exact gather/scatter
+    # (no padding rows, no staging copy) runs on both arms.
+    mig_prompt = 128 * block_size - 2
+    rng = np.random.RandomState(seed)
+    # 16 moves per pass: the first direct move pays the peer dial
+    # (cached afterwards), so the p50 must sit in steady state, not on
+    # the handshake.
+    mig_trace = [(rng.randint(1, 256, size=mig_prompt).tolist(), 2)
+                 for _ in range(16)]
+    mig_serve_cfg = ServeConfig(
+        max_batch=max_batch, max_queue=len(mig_trace),
+        block_size=block_size,
+        max_prompt=mig_prompt, max_new_tokens=2,
+        n_blocks=(max_batch + len(mig_trace)) * (mig_prompt
+                                                 // block_size + 1))
+
+    # One prefill -> one decode replica: the cleanest per-move
+    # topology (the single peer dial amortizes over every move, and no
+    # third replica's decode work interleaves into the timing).
+    def migration_pass(mode):
+        fleet = _thread_fleet(2)
+        try:
+            return _run_router_pass(
+                mig_cfg, None, mig_trace, placement="affinity",
+                n_replicas=2, n_prefill=1,
+                serve_cfg=mig_serve_cfg, seed=seed, workers=fleet,
+                handoff_compression="bf16", direct_migration=mode)
+        finally:
+            for h in fleet:
+                h.close()
+
+    if warmup:
+        migration_pass("auto")   # jit the long-context buckets once
+    mig = {"direct": [], "relayed": []}
+    for _ in range(max(repeats, 1)):
+        mig["direct"].append(migration_pass("auto"))
+        mig["relayed"].append(migration_pass("off"))
+
     # Parity arms (structural, untimed): a single replica on the same
     # trace, and a split prefill/decode fleet exercising the handoff.
     ref_engine = ServeEngine(model_cfg, params, serve_cfg)
@@ -621,8 +706,35 @@ def run_router_benchmark(n_requests: int = 48, *, seed: int = 0,
                 (round(100.0 * (raw - rpc_split["handoff_wire_bytes"])
                        / raw, 2) if raw else None),
         }
+    def _best_p50(ps):
+        vals = [s["p50_migration_ms"] for s in ps
+                if s["p50_migration_ms"] is not None]
+        return min(vals) if vals else None
+
+    d_p50, r_p50 = _best_p50(mig["direct"]), _best_p50(mig["relayed"])
+    r_bytes = mig["relayed"][0]["migration_bytes"]
+    d_bytes = mig["direct"][0]["migration_bytes"]
+    mig_keys = {
+        "serve_migration_p50_ms":
+            None if d_p50 is None else round(d_p50, 3),
+        "serve_migration_direct_over_relayed":
+            (round(r_p50 / d_p50, 3)
+             if d_p50 and r_p50 is not None else None),
+        "serve_migration_bytes_saved_pct":
+            (round(100.0 * (r_bytes - d_bytes) / r_bytes, 2)
+             if r_bytes else None),
+        "serve_migration_direct_count":
+            sum(s["direct_migrations"] for s in mig["direct"]),
+        # bf16 is idempotent, so ONE codec pass (direct) must emit
+        # bitwise the streams of TWO (relayed) — pinned here and in
+        # tests/test_rpc.py.
+        "serve_migration_tokens_identical":
+            all(s["_tokens"] == mig["relayed"][0]["_tokens"]
+                for ps in mig.values() for s in ps),
+    }
     return {
         **rpc_keys,
+        **mig_keys,
         "serve_router_tokens_per_sec_per_chip":
             round(best["routed"]["tokens_per_sec_wall"] / n_dev, 2),
         "serve_router_random_tokens_per_sec_per_chip":

@@ -389,6 +389,13 @@ class ServeEngine:
          self._inject_fn, self._verify_fn) = decode_lib.make_serve_fns(
              model_cfg, mesh, block_size=bs,
              table_width=self._table_width, compression=cfg.compression)
+        # Jitted page gather for handoff export — the twin of the
+        # inject scatter. Op-by-op fancy indexing pays a full dispatch
+        # per export (measured ~3x the compiled gather on the bench
+        # payloads); widths ride the same bucket menu as inject so one
+        # program per bucket serves every export.
+        import jax as _jax
+        self._export_fn = _jax.jit(lambda k, v, i: (k[:, i], v[:, i]))
 
         self.metrics = ServeMetrics(clock=clock, instance=instance)
         self.metrics.attach_allocator(self.allocator)
@@ -405,6 +412,11 @@ class ServeEngine:
         self._handoff: Dict[int, _Seq] = {}
         self._results: Dict[int, RequestResult] = {}
         self._rids = itertools.count()
+        # Staged (chunked) injects in flight: token -> {meta, blocks,
+        # n_pages, cursor}. Invisible to admission/decode until commit;
+        # an abort returns the block reservation.
+        self._inject_staging: Dict[int, Dict[str, Any]] = {}
+        self._inject_tokens = itertools.count()
         # Drain-rate signal behind retry_after_s estimates.
         self._retire_ema = RetireEma()
         # Speculative side-car: draft params + mirror KV pool + the
@@ -799,9 +811,18 @@ class ServeEngine:
         to, the same null-padding contract decode relies on), then
         free the local reservation."""
         n_blk = self.allocator.blocks_for_tokens(seq.n_cached)
-        idx = np.asarray(seq.blocks[:n_blk], np.int32)
-        k_pages = np.asarray(self.cache.k[:, idx])
-        v_pages = np.asarray(self.cache.v[:, idx])
+        width = pick_bucket(n_blk, self._inject_widths)
+        idx = np.zeros(width, np.int32)   # pad gathers the null block
+        idx[:n_blk] = seq.blocks[:n_blk]
+        k_g, v_g = self._export_fn(self.cache.k, self.cache.v, idx)
+        if n_blk == width:
+            k_pages = np.asarray(k_g)
+            v_pages = np.asarray(v_g)
+        else:
+            # Trim the padding rows on the host; contiguous because
+            # the wire layer ships the buffer as-is.
+            k_pages = np.ascontiguousarray(np.asarray(k_g)[:, :n_blk])
+            v_pages = np.ascontiguousarray(np.asarray(v_g)[:, :n_blk])
         self.allocator.free(seq.blocks)
         if self._spec is not None:
             self._spec.drop(seq.rid)
@@ -851,70 +872,155 @@ class ServeEngine:
         :class:`QueueFull` (no batch slot) or
         :class:`~horovod_tpu.serve.kv_cache.OutOfBlocks` — the router
         checks :meth:`admission_snapshot` capacity first, so hitting
-        either here is a router bug, not backpressure."""
-        if h.block_size != self.cfg.block_size:
+        either here is a router bug, not backpressure.
+
+        Implemented as the one-chunk case of the staged inject
+        (:meth:`inject_begin` / :meth:`inject_chunk` /
+        :meth:`inject_commit`) — the relayed and direct migration
+        paths run literally the same scatter, which is what makes the
+        bitwise direct-vs-relayed parity pin in tests/test_rpc.py a
+        tautology rather than a hope."""
+        token = self.inject_begin({
+            "prompt": h.prompt, "max_new": h.max_new,
+            "generated": h.generated, "submitted_at": h.submitted_at,
+            "first_token_at": h.first_token_at,
+            "deadline_class": h.deadline_class, "chain": h.chain,
+            "block_size": h.block_size, "n_cached": h.n_cached,
+            "n_pages": h.n_pages})
+        self.inject_chunk(token, h.k_pages, h.v_pages)
+        return self.inject_commit(token)
+
+    def inject_begin(self, meta: Dict[str, Any]) -> int:
+        """First leg of the staged (chunked) inject: validate the
+        handoff manifest — everything :meth:`inject_prefilled` checks,
+        pages excluded — and reserve the sequence's worst-case blocks.
+        Returns a staging token for :meth:`inject_chunk` /
+        :meth:`inject_commit` / :meth:`inject_abort`. Until commit the
+        staged sequence is invisible to decode, admission counts, and
+        results — an abort (or a dropped peer connection mid-stream)
+        simply returns the reservation, which is what makes a
+        mid-transfer reset resolve exactly-once at the router."""
+        if meta["block_size"] != self.cfg.block_size:
             raise ValueError(
-                f"handoff block_size {h.block_size} != engine "
+                f"handoff block_size {meta['block_size']} != engine "
                 f"block_size {self.cfg.block_size} — replicas must "
                 "share geometry for pages to map block-for-block")
-        plen = len(h.prompt)
-        if not (plen <= h.n_cached <= plen + h.max_new
-                and h.generated
-                and h.n_cached == plen + len(h.generated) - 1):
+        plen = len(meta["prompt"])
+        n_cached = int(meta["n_cached"])
+        if not (plen <= n_cached <= plen + meta["max_new"]
+                and meta["generated"]
+                and n_cached == plen + len(meta["generated"]) - 1):
             raise ValueError(
-                f"inconsistent handoff: n_cached={h.n_cached} "
-                f"prompt={plen} generated={len(h.generated)}")
+                f"inconsistent handoff: n_cached={n_cached} "
+                f"prompt={plen} generated={len(meta['generated'])}")
+        n_page = int(meta["n_pages"])
+        if n_page != self.allocator.blocks_for_tokens(n_cached):
+            raise ValueError(
+                f"handoff carries {n_page} pages but n_cached="
+                f"{n_cached} needs "
+                f"{self.allocator.blocks_for_tokens(n_cached)}")
         if len(self._active) + len(self._prefilling) >= self.cfg.max_batch:
             raise QueueFull("no batch slot for handoff",
                             reason="no_batch_slot",
                             retry_after_s=self._retry_after())
-        need = self.allocator.blocks_for_tokens(plen + h.max_new)
+        need = self.allocator.blocks_for_tokens(plen + meta["max_new"])
         blocks = self.allocator.alloc(need)
-        # Jitted donated scatter: pages land in place, O(carried
-        # pages), never a full-pool copy. The pad width rides the
-        # prefill bucket menu extended by table_width (a migrated
-        # RUNNING sequence can exceed the largest prompt bucket): one
-        # compiled program per width, device transfer proportional to
-        # the carried pages, NULL_BLOCK targets + zero pages for the
-        # padding rows — written garbage on the null block is never
-        # read, the prefill bucket-padding contract.
-        n_page = h.n_pages
-        if n_page != self.allocator.blocks_for_tokens(h.n_cached):
+        token = next(self._inject_tokens)
+        self._inject_staging[token] = {
+            "meta": meta, "blocks": blocks, "n_pages": n_page,
+            "cursor": 0}
+        return token
+
+    def inject_chunk(self, token: int, k_pages, v_pages) -> int:
+        """Scatter one block-aligned run of pages (``[cursor, cursor +
+        chunk)`` in manifest page order) into the reserved blocks.
+        Jitted donated scatter: pages land in place, O(carried pages),
+        never a full-pool copy. The pad width rides the prefill bucket
+        menu extended by table_width (a migrated RUNNING sequence can
+        exceed the largest prompt bucket): one compiled program per
+        width, device transfer proportional to the carried pages,
+        NULL_BLOCK targets + zero pages for the padding rows — written
+        garbage on the null block is never read, the prefill
+        bucket-padding contract. Chunks target disjoint block rows, so
+        the committed pool state is bitwise the monolithic scatter's
+        regardless of chunking. Returns pages remaining."""
+        st = self._inject_staging[token]
+        k_pages = np.asarray(k_pages)
+        v_pages = np.asarray(v_pages)
+        cn = int(k_pages.shape[1])
+        if cn < 1 or st["cursor"] + cn > st["n_pages"]:
             raise ValueError(
-                f"handoff carries {n_page} pages but n_cached="
-                f"{h.n_cached} needs "
-                f"{self.allocator.blocks_for_tokens(h.n_cached)}")
-        width = pick_bucket(n_page, self._inject_widths)
-        idx = np.full(width, 0, np.int32)               # NULL_BLOCK
-        idx[:n_page] = blocks[:n_page]
-        shape = (h.k_pages.shape[0], width) + h.k_pages.shape[2:]
-        k_pad = np.zeros(shape, h.k_pages.dtype)
-        v_pad = np.zeros(shape, h.v_pages.dtype)
-        k_pad[:, :n_page] = h.k_pages
-        v_pad[:, :n_page] = h.v_pages
+                f"inject chunk of {cn} pages at cursor {st['cursor']} "
+                f"overruns the {st['n_pages']}-page manifest")
+        width = pick_bucket(cn, self._inject_widths)
+        if cn == width:
+            # Bucket-exact chunk: no padding rows, no staging copy —
+            # the wire arrays feed the scatter directly. This is the
+            # shape a topology plan aims for (chunk sizes drawn from
+            # the bucket menu), and it halves the inject's host-side
+            # memory traffic.
+            idx = np.asarray(
+                st["blocks"][st["cursor"]:st["cursor"] + cn], np.int32)
+            k_pad, v_pad = k_pages, v_pages
+        else:
+            idx = np.full(width, 0, np.int32)           # NULL_BLOCK
+            idx[:cn] = st["blocks"][st["cursor"]:st["cursor"] + cn]
+            shape = (k_pages.shape[0], width) + k_pages.shape[2:]
+            k_pad = np.zeros(shape, k_pages.dtype)
+            v_pad = np.zeros(shape, v_pages.dtype)
+            k_pad[:, :cn] = k_pages
+            v_pad[:, :cn] = v_pages
         self.cache.k, self.cache.v = self._inject_fn(
             self.cache.k, self.cache.v, idx, k_pad, v_pad)
+        st["cursor"] += cn
+        return st["n_pages"] - st["cursor"]
+
+    def inject_commit(self, token: int) -> int:
+        """Every manifest page landed: materialize the sequence into
+        the decode batch and return its rid. Registration, metrics,
+        and batch membership all happen HERE — a partially-streamed
+        sequence never observes any of them."""
+        st = self._inject_staging[token]
+        if st["cursor"] != st["n_pages"]:
+            raise ValueError(
+                f"inject commit with {st['cursor']}/{st['n_pages']} "
+                "pages streamed")
+        del self._inject_staging[token]
+        meta, blocks = st["meta"], st["blocks"]
         table = np.zeros(self._table_width, np.int32)
         table[:len(blocks)] = blocks
         rid = next(self._rids)
         seq = _Seq(
-            rid=rid, prompt=list(h.prompt), max_new=h.max_new,
-            blocks=blocks, table=table, n_cached=h.n_cached,
-            generated=list(h.generated), submitted_at=h.submitted_at,
-            chain=list(h.chain), registered=0,
-            deadline_class=h.deadline_class)
-        seq.first_token_at = h.first_token_at
+            rid=rid, prompt=list(meta["prompt"]),
+            max_new=meta["max_new"], blocks=blocks, table=table,
+            n_cached=int(meta["n_cached"]),
+            generated=list(meta["generated"]),
+            submitted_at=meta["submitted_at"],
+            chain=list(meta["chain"]), registered=0,
+            deadline_class=meta["deadline_class"])
+        seq.first_token_at = meta["first_token_at"]
         if self.cfg.prefix_caching:
             # Publish the injected prompt blocks locally: future
             # same-prefix requests (or handoffs) landing here hit them
             # for free. A hash already published keeps this private
             # copy anonymous (register no-ops), same as the twin race.
-            for i, ch in enumerate(h.chain):
+            for i, ch in enumerate(meta["chain"]):
                 self.allocator.register(blocks[i], ch)
-            seq.registered = len(h.chain)
+            seq.registered = len(meta["chain"])
         self._active.append(seq)
         self.metrics.record_handoff_in()
         return rid
+
+    def inject_abort(self, token: int) -> None:
+        """Discard a staged inject (stream died mid-transfer, or the
+        source declared the manifest stale): the block reservation
+        returns to the pool, any pages already scattered stay as
+        unreferenced garbage on freed blocks — never attended to, the
+        same contract as any freed block's stale contents. Idempotent
+        per token."""
+        st = self._inject_staging.pop(token, None)
+        if st is not None:
+            self.allocator.free(st["blocks"])
 
     def _decode_once(self) -> None:
         import jax

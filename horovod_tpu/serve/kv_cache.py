@@ -324,3 +324,18 @@ def pick_bucket(n: int, buckets: Tuple[int, ...]) -> int:
         if n <= b:
             return b
     raise ValueError(f"{n} exceeds largest bucket {buckets[-1]}")
+
+
+def page_chunks(n_pages: int, chunk_pages: int) -> List[Tuple[int, int]]:
+    """Block-aligned ``[start, stop)`` ranges covering ``n_pages`` KV
+    pages in ``chunk_pages``-sized pieces (last one ragged). This is
+    the one chunking function shared by the direct-migration stream
+    (worker side), chunked inject (engine side), and the Python cost
+    twin — all three must agree on the chunk boundaries or the
+    scheduled cost describes a transfer that never happens."""
+    if n_pages < 0:
+        raise ValueError(f"n_pages {n_pages} < 0")
+    if chunk_pages < 1:
+        raise ValueError(f"chunk_pages {chunk_pages} < 1")
+    return [(lo, min(lo + chunk_pages, n_pages))
+            for lo in range(0, n_pages, chunk_pages)]

@@ -1,0 +1,221 @@
+"""Topology-scheduled direct KV-page migration (docs/serving.md
+"Direct migration").
+
+The serving fleet's bulk data path — prefill handoffs, migrating
+drains, dead-worker recovery — historically relayed KV pages through
+the router process: ``export`` pulled the pages to the router,
+``inject`` pushed them to the target, two full wire traversals per
+migration. This module is the planning half of the direct plane that
+removes the router from the bulk path:
+
+* **The knob.** ``HOROVOD_FLEET_DIRECT_MIGRATION`` (sane-env style:
+  ``auto`` = dial worker→worker and fall back to relayed when the
+  dial fails; ``off`` = the relayed path, byte for byte). The router
+  reads it once per fleet via :func:`direct_migration_mode`.
+* **The cost twin.** Training collectives get alpha-beta cost
+  verdicts from the native schedule interpreter
+  (``hvd_algo_cost_us``); a KV migration is a point-to-point stream,
+  so its verdict is a two-term closed form over the SAME measured
+  model ``hvd.topology()`` publishes. :func:`migration_cost_us` is
+  mirrored bit-for-bit by the native ``hvd_migration_cost_us`` export
+  (native/src/topology.cc) and the sanitizer tier cross-checks the
+  two — the twin exists so router tests can score placements without
+  a controller, not so the formulas can drift.
+* **The plan.** :func:`plan_migration` turns one candidate move
+  (source, target, codec, raw bytes) into a chunk schedule: it sweeps
+  a power-of-two chunk menu through the cost model and returns the
+  argmin. Chunking pipelines export → wire → inject (the per-chunk
+  alpha+ack overhead buys overlap of the final chunk's inject), so
+  the model has a genuine interior minimum instead of always
+  answering "one big span".
+
+Replica → topology rank: router instances are small decimal strings
+(``"0"``, ``"1"``, ...); :func:`replica_rank` maps one onto the
+``np``-rank ring the probe measured. On a single-host fleet the model
+is usually ``None`` and every cost is 0 — placement then degrades to
+the pure least-load pick, pinned by the topology-scored drain test.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import warnings
+from typing import Any, Dict, List, Optional, Tuple
+
+#: The sane-env knob: ``auto`` (default) dials the direct channel and
+#: falls back to relayed on a failed dial; ``off`` forces the PR 12
+#: relayed path byte-for-byte. Documented in docs/serving.md.
+DIRECT_MIGRATION_ENV = "HOROVOD_FLEET_DIRECT_MIGRATION"
+
+#: Python mirror of ``kSpanOverheadUs`` (native/src/topology.cc): the
+#: fixed per-span bookkeeping cost the schedule interpreter charges on
+#: top of alpha. A migration chunk pays it twice (send + ack).
+SPAN_OVERHEAD_US = 0.2
+
+
+#: Single pin home for the direct-migration exposition families (lint:
+#: migration-metric-pins). Every key is a ``serve_fleet_``-namespaced
+#: row in docs/observability.md; the histogram renders pooled tails as
+#: ``serve_fleet_p{50,99}_migration_ms``.
+MIGRATION_METRIC_KEYS = (
+    "serve_fleet_direct_migrations_total",
+    "serve_fleet_migration_bytes_total",
+    "serve_fleet_migration_ms",
+    "serve_fleet_migration_link_cost_us",
+)
+
+_warned_bad_mode = False
+
+
+def direct_migration_mode() -> str:
+    """``"auto"`` or ``"off"`` from :data:`DIRECT_MIGRATION_ENV`.
+    Lenient parse in the sane-env tradition: the off-ish spellings
+    (``off``/``0``/``false``/``no``/``relayed``) disable, anything
+    else (including unset) is ``auto`` — with a warn-once on garbage
+    so a typo degrades loudly, not silently."""
+    global _warned_bad_mode
+    raw = os.environ.get(DIRECT_MIGRATION_ENV, "auto").strip().lower()
+    if raw in ("off", "0", "false", "no", "relayed"):
+        return "off"
+    if raw not in ("auto", "on", "1", "true", "yes", "direct", ""):
+        if not _warned_bad_mode:
+            _warned_bad_mode = True
+            warnings.warn(
+                f"{DIRECT_MIGRATION_ENV}={raw!r} is not auto/off; "
+                "treating as auto", stacklevel=2)
+    return "auto"
+
+
+def fleet_topology() -> Optional[Dict[str, Any]]:
+    """The measured alpha-beta model for migration scoring, or
+    ``None`` when no model exists. This is the ONE seam the router
+    reads topology through — tests monkeypatch it with a synthetic
+    model, and it swallows the not-initialized case (router fleets in
+    tier-1 run without ``hvd.init()``; every topology export is
+    controller-gated)."""
+    try:
+        from horovod_tpu import api
+        return api.topology()
+    except Exception:
+        return None
+
+
+def replica_rank(instance: str, n_ranks: int) -> int:
+    """Map a router replica instance id onto a topology rank. Instance
+    ids are the router's decimal join counter; fleets larger than the
+    probed ring wrap (two replicas sharing a rank share its links,
+    which is exactly the single-host reality)."""
+    digits = "".join(c for c in instance if c.isdigit())
+    return (int(digits) % n_ranks) if digits and n_ranks > 0 else 0
+
+
+def link_cost_us(model: Optional[Dict[str, Any]], src: int, dst: int,
+                 n_bytes: int) -> float:
+    """One-shot alpha-beta cost of moving ``n_bytes`` src → dst under
+    ``model`` (0 when loopback or no model). The single-span verdict —
+    :func:`migration_cost_us` is the chunked generalization."""
+    if model is None or src == dst:
+        return 0.0
+    alpha = model["alpha_us"][src][dst]
+    beta = model["beta_us_per_byte"][src][dst]
+    return alpha + beta * n_bytes
+
+
+def migration_cost_us(model: Optional[Dict[str, Any]], src: int,
+                      dst: int, n_bytes: int, n_chunks: int) -> float:
+    """Cost verdict for streaming ``n_bytes`` src → dst in
+    ``n_chunks`` pipelined chunks. Mirrored EXACTLY (same terms, same
+    order) by the native ``hvd_migration_cost_us`` — change one,
+    change both, and the sanitizer cross-check pins the agreement.
+
+    Terms: every chunk pays launch + ack latency plus twice the span
+    bookkeeping overhead; the full payload crosses the wire once; and
+    the LAST chunk's inject cannot overlap anything, modeled as one
+    chunk's worth of extra beta. More chunks buy overlap (smaller
+    tail term) at the price of per-chunk latency — an interior
+    minimum, which is the whole point of scheduling the transfer."""
+    if n_chunks < 1:
+        raise ValueError(f"n_chunks {n_chunks} < 1")
+    if model is None or src == dst:
+        return 0.0
+    alpha_fwd = model["alpha_us"][src][dst]
+    alpha_ack = model["alpha_us"][dst][src]
+    beta = model["beta_us_per_byte"][src][dst]
+    per_chunk = alpha_fwd + alpha_ack + 2.0 * SPAN_OVERHEAD_US
+    return (n_chunks * per_chunk + n_bytes * beta
+            + (n_bytes / n_chunks) * beta)
+
+
+def codec_wire_ratio(codec) -> float:
+    """Wire-bytes ratio of the span codec on an f32 pool: the cast
+    codecs (bf16/fp16) halve every page, ``None`` ships raw. Accepts
+    the same spellings ``rpc.span_codec_id`` does (name string,
+    ``None``, or a ``hvd.Compression`` member)."""
+    if codec is None:
+        return 1.0
+    wire = getattr(codec, "wire_codec", None)
+    if wire is not None:
+        return 0.5 if int(wire) in (1, 2) else 1.0
+    return 0.5 if str(codec) in ("bf16", "fp16") else 1.0
+
+
+def page_nbytes(model_cfg, block_size: int) -> int:
+    """Raw bytes of one K+V page pair under ``model_cfg`` — the
+    per-block unit the migration planner converts block counts into
+    wire bytes with."""
+    try:
+        import numpy as _np
+        itemsize = _np.dtype(model_cfg.dtype).itemsize
+    except Exception:
+        itemsize = 4
+    return int(2 * model_cfg.n_layers * block_size
+               * model_cfg.n_kv_heads * model_cfg.head_dim * itemsize)
+
+
+def chunk_menu(n_pages: int) -> List[int]:
+    """Candidate chunk sizes (in pages) the planner sweeps: powers of
+    two up to the page count, plus the monolithic transfer."""
+    if n_pages < 1:
+        return [1]
+    menu = []
+    c = 1
+    while c < n_pages:
+        menu.append(c)
+        c *= 2
+    menu.append(n_pages)
+    return menu
+
+
+def plan_migration(n_pages: int, page_bytes: int, *,
+                   src: int, dst: int,
+                   codec: Optional[str] = None,
+                   model: Optional[Dict[str, Any]] = None,
+                   ) -> Dict[str, Any]:
+    """Pick the chunk schedule for one candidate migration: sweep
+    :func:`chunk_menu` through :func:`migration_cost_us` over the wire
+    byte count (codec applied) and return the argmin::
+
+        {"chunk_pages", "n_chunks", "cost_us", "wire_bytes"}
+
+    No model (or loopback) → ONE monolithic chunk with cost 0: with
+    no evidence that per-chunk latency is cheap, blind chunking only
+    multiplies the target's per-chunk inject dispatches (measured to
+    dominate small moves), so an unprobed fleet streams each sequence
+    whole — exactly the relayed path's granularity — and placement
+    degrades to pure least-load."""
+    n_pages = max(int(n_pages), 1)
+    wire_bytes = int(math.ceil(n_pages * page_bytes
+                               * codec_wire_ratio(codec)))
+    if model is None or src == dst:
+        return {"chunk_pages": n_pages, "n_chunks": 1,
+                "cost_us": 0.0, "wire_bytes": wire_bytes}
+    best: Optional[Tuple[float, int, int]] = None
+    for chunk in chunk_menu(n_pages):
+        n_chunks = -(-n_pages // chunk)
+        cost = migration_cost_us(model, src, dst, wire_bytes, n_chunks)
+        if best is None or cost < best[0]:
+            best = (cost, chunk, n_chunks)
+    cost, chunk, n_chunks = best
+    return {"chunk_pages": chunk, "n_chunks": n_chunks,
+            "cost_us": cost, "wire_bytes": wire_bytes}

@@ -181,6 +181,12 @@ class RouterConfig:
     # failover). Generous default — the first step against a fresh
     # worker pays jit compiles.
     rpc_timeout: float = 300.0
+    # Direct worker<->worker page migration (docs/serving.md "Direct
+    # migration"): "env" defers to HOROVOD_FLEET_DIRECT_MIGRATION
+    # (auto|off), or force it per-fleet — "off" is the relayed
+    # export->router->inject path byte-for-byte; "auto" dials the
+    # bulk channel and falls back to relayed when the dial fails.
+    direct_migration: str = "env"
 
     def __post_init__(self):
         if self.n_replicas < 1:
@@ -195,6 +201,10 @@ class RouterConfig:
         if self.heartbeat_every < 0:
             raise ValueError(
                 f"heartbeat_every {self.heartbeat_every} < 0")
+        if self.direct_migration not in ("env", "auto", "off"):
+            raise ValueError(
+                f"unknown direct_migration {self.direct_migration!r} "
+                "(want env, auto, or off)")
         # Fail on garbage at config time, not mid-handoff.
         from horovod_tpu.serve.rpc import span_codec_id
         span_codec_id(self.handoff_compression)
@@ -295,6 +305,17 @@ class FleetMetrics:
         #                              replicas (each still resolves
         #                              exactly once)
         self.migrations = 0          # RUNNING decodes moved by a drain
+        # Direct-migration plane (docs/observability.md rows; the
+        # exported names are pinned in serve/migrate.py
+        # MIGRATION_METRIC_KEYS — lint: migration-metric-pins):
+        self.direct_migrations_total = 0   # page moves over the
+        #                                    worker<->worker channel
+        self.migration_bytes_total = 0     # wire bytes moved by the
+        #                                    page-move plane, any path
+        self.migration_link_cost_us = 0.0  # last decision's alpha-beta
+        #                                    cost verdict (gauge)
+        self.migration_ms: List[float] = []   # per-move wall samples
+        #                                       (pooled-tail histogram)
         self._retired: Dict[str, float] = {}   # absorbed counters
         # ...and the same counters bucketed by model group, feeding
         # the per-model rollup series (label model=...).
@@ -335,6 +356,10 @@ class FleetMetrics:
         self.shed_by_class[deadline_class] = (
             self.shed_by_class.get(deadline_class, 0) + 1)
 
+    def record_migration_ms(self, ms: float) -> None:
+        if len(self.migration_ms) < MAX_SAMPLES:
+            self.migration_ms.append(float(ms))
+
     def snapshot(self) -> Dict[str, float]:
         router = self._router()
         if router is None:
@@ -353,7 +378,17 @@ class FleetMetrics:
             "worker_deaths": self.worker_deaths,
             "requeued_total": self.requeued_total,
             "migrations": self.migrations,
+            "direct_migrations_total": self.direct_migrations_total,
+            "migration_bytes_total": self.migration_bytes_total,
+            "migration_link_cost_us": self.migration_link_cost_us,
         }
+        # Page-move wall-time tails: pooled samples like every other
+        # fleet histogram (a quantile of the union, not an average of
+        # per-path quantiles).
+        for q in (50, 99):
+            v = percentile(self.migration_ms, q)
+            out[f"p{q}_migration_ms"] = (None if v is None
+                                         else round(v, 3))
         for c, n in sorted(self.shed_by_class.items()):
             out[f"shed_class_{c}"] = n
         for key in self.ABSORBED + ("kv_blocks_in_use",
@@ -488,10 +523,24 @@ class ServeRouter:
         self._rids = itertools.count()
         self._retire_ema = RetireEma()
         self.metrics = FleetMetrics(self)
-        #: (rid, replica instance, chain-match length) per placement,
-        #: in placement order — the determinism probe the property
-        #: test replays. Capped like every other unbounded series.
-        self.placement_log: List[Tuple[int, str, int]] = []
+        from horovod_tpu.serve import migrate as migrate_mod
+        # "env" resolves the sane-env knob ONCE at fleet construction
+        # (a fleet never flips mid-life); "auto"/"off" force it.
+        self._direct_mode = (migrate_mod.direct_migration_mode()
+                             if self.cfg.direct_migration == "env"
+                             else self.cfg.direct_migration)
+        # Manifest epochs: every direct-migration attempt carries a
+        # fresh one, so a stale partial stream can never replay into
+        # a target (the worker refuses repeated epochs).
+        self._migration_epochs = itertools.count(1)
+        #: (rid, replica instance, chain-match length, link cost in
+        #: us) per placement decision, in decision order — the
+        #: determinism probe the property test replays. Queue
+        #: placements carry cost 0.0 (no source pool to move from);
+        #: page-move target picks log match -1 with the decision's
+        #: alpha-beta cost verdict. Capped like every other unbounded
+        #: series.
+        self.placement_log: List[Tuple[int, str, int, float]] = []
         workers = list(workers or [])
         if workers and len(workers) != self.cfg.n_replicas:
             raise ValueError(
@@ -1031,7 +1080,7 @@ class ServeRouter:
                 self.metrics.record_placed(match)
                 if len(self.placement_log) < MAX_SAMPLES:
                     self.placement_log.append(
-                        (req.rid, rep.instance, match))
+                        (req.rid, rep.instance, match, 0.0))
             if placed:
                 # A death mid-pass UN-places work: _handle_dead
                 # requeued every rid the dead replica owned — including
@@ -1070,14 +1119,15 @@ class ServeRouter:
                     len(req.prompt) + req.max_new)
                 target = self._pick_capacity(("decode",), need,
                                              exclude=rep,
-                                             model=rep.model)
+                                             model=rep.model,
+                                             source=rep)
                 if target is None:
                     # No decode capacity this step; the sequence stays
                     # parked (blocks held at the prefill replica) and
                     # is retried next step — never dropped.
                     continue
                 if not self._move_seq(rep, erid, rid, target,
-                                      rep.engine.export_prefilled):
+                                      "prefilled", need):
                     if rep not in self._replicas:
                         break   # source died; its work is requeued
                     continue
@@ -1108,26 +1158,104 @@ class ServeRouter:
                 need = rep.engine.allocator.blocks_for_tokens(
                     len(req.prompt) + req.max_new)
                 target = self._pick_capacity(pool, need, exclude=rep,
-                                             model=rep.model)
+                                             model=rep.model,
+                                             source=rep)
                 if target is None:
                     continue
                 if not self._move_seq(rep, erid, rid, target,
-                                      rep.engine.export_running):
+                                      "running", need):
                     if rep not in self._replicas:
                         break
                     continue
                 self.metrics.migrations += 1
 
+    def _migration_plan(self, src: _Replica, target: _Replica,
+                        need_blocks: int) -> Dict[str, Any]:
+        """Chunk-schedule verdict for moving ``need_blocks`` worth of
+        pages src -> target: the Python cost twin over the measured
+        alpha-beta model (mirrored by the native
+        ``hvd_migration_cost_us``). No model (tier-1 fleets, single
+        hosts) degrades to the default chunking with cost 0."""
+        from horovod_tpu.serve import migrate as migrate_mod
+        topo = migrate_mod.fleet_topology()
+        n_ranks = int(topo["np"]) if topo else 0
+        return migrate_mod.plan_migration(
+            need_blocks,
+            migrate_mod.page_nbytes(
+                self._models[src.model].model_cfg,
+                src.engine.allocator.block_size),
+            src=migrate_mod.replica_rank(src.instance, n_ranks),
+            dst=migrate_mod.replica_rank(target.instance, n_ranks),
+            codec=self.cfg.handoff_compression, model=topo)
+
+    def _note_migration(self, rid: int, target: _Replica,
+                        cost_us: float, wire_bytes: int,
+                        ms: float) -> None:
+        m = self.metrics
+        m.migration_bytes_total += int(wire_bytes)
+        m.record_migration_ms(ms)
+        m.migration_link_cost_us = round(float(cost_us), 3)
+        if len(self.placement_log) < MAX_SAMPLES:
+            # match -1 marks a page-move target pick (vs a queue
+            # placement); the cost column is the decision's verdict.
+            self.placement_log.append(
+                (rid, target.instance, -1, round(float(cost_us), 3)))
+
     def _move_seq(self, src: _Replica, erid: int, rid: int,
-                  target: _Replica, export_fn) -> bool:
-        """Export ``erid`` off ``src`` and inject into ``target``.
-        Failure semantics keep exactly-once: an export that dies takes
-        the whole source down (its outstanding work — this rid
-        included — requeues); an inject that dies after the export
-        freed the source pages requeues THIS request explicitly (its
-        pages died with the target; it re-prefills from scratch on a
-        survivor)."""
-        h = self._guard(src, lambda: export_fn(erid))
+                  target: _Replica, kind: str,
+                  need_blocks: int) -> bool:
+        """Move sequence ``erid`` (``kind`` = "prefilled" | "running")
+        off ``src`` and into ``target``.
+
+        With the direct plane on and both ends remote, the router
+        sends ONE control frame (``migrate_to``) and the source
+        streams the pages point-to-point to the target's bulk
+        listener, chunked per the topology plan — the bytes never
+        visit this process. A failed dial falls back to the relayed
+        export->inject below, byte-compatible.
+
+        Failure semantics keep exactly-once on every path: an export
+        that dies takes the whole source down (its outstanding work —
+        this rid included — requeues); a stream or inject that dies
+        AFTER the export freed the source pages requeues THIS request
+        explicitly at the queue front (its pages died in flight; it
+        re-prefills from scratch on a survivor), while the target
+        discards its partial pages by staging-abort."""
+        t0 = self._clock()
+        plan = self._migration_plan(src, target, need_blocks)
+        if (self._direct_mode == "auto" and src.remote and target.remote
+                and src is not target
+                and getattr(target.engine, "peer_port", 0)):
+            ret = self._guard(src, lambda: src.engine.migrate_direct(
+                erid, kind, target.engine.peer_host,
+                target.engine.peer_port, plan["chunk_pages"],
+                next(self._migration_epochs)))
+            if ret is None:
+                return False     # source died: _handle_dead requeued
+            status = ret.get("status")
+            if status == "ok":
+                del src.outstanding[erid]
+                target.outstanding[int(ret["erid"])] = rid
+                target.engine.note_remote_inject()
+                self.metrics.direct_migrations_total += 1
+                self._note_migration(
+                    rid, target, cost_us=plan["cost_us"],
+                    wire_bytes=int(ret.get("wire_bytes") or 0),
+                    ms=float(ret.get("ms") or 0.0))
+                return True
+            if status != "dial_failed":
+                # Exported, then the stream died mid-transfer: pages
+                # are gone on both sides (target staging aborted on
+                # disconnect). Queue front, exactly-once.
+                del src.outstanding[erid]
+                self._queue.appendleft(self._requests[rid])
+                self.metrics.requeued_total += 1
+                return False
+            # dial_failed: the sequence never left the source — fall
+            # through to the relayed path.
+        h = self._guard(src,
+                        lambda: getattr(src.engine,
+                                        f"export_{kind}")(erid))
         if h is None:
             return False
         del src.outstanding[erid]
@@ -1138,19 +1266,50 @@ class ServeRouter:
             self.metrics.requeued_total += 1
             return False
         target.outstanding[new_erid] = rid
+        # Relayed accounting: the pages crossed the router, raw (span
+        # codec applies per hop on remote ends; nbytes here is the
+        # router-held copy — one traversal's worth for parity with
+        # the direct counter).
+        self._note_migration(
+            rid, target, cost_us=plan["cost_us"],
+            wire_bytes=int(np.asarray(h.k_pages).nbytes
+                           + np.asarray(h.v_pages).nbytes),
+            ms=(self._clock() - t0) * 1e3)
         return True
 
     def _pick_capacity(self, pool_role: Tuple[str, ...],
                        need_blocks: int,
                        exclude: Optional[_Replica] = None,
                        model: str = DEFAULT_MODEL,
+                       source: Optional[_Replica] = None,
                        ) -> Optional[_Replica]:
-        """Least-loaded same-MODEL replica in ``pool_role`` with a
-        batch slot AND ``need_blocks`` of KV headroom — the handoff/
-        migration target filter (admission-queue room is irrelevant:
-        an injected sequence bypasses the queue). Pages only ever move
-        between replicas of one model group: a KV page is meaningless
-        under another model's weights."""
+        """Cheapest-link, then least-loaded same-MODEL replica in
+        ``pool_role`` with a batch slot AND ``need_blocks`` of KV
+        headroom — the handoff/migration target filter
+        (admission-queue room is irrelevant: an injected sequence
+        bypasses the queue). With a measured topology model and a
+        ``source``, candidates are scored by the alpha-beta cost of
+        moving the pages over their link first (a drain on a
+        multi-host fleet prefers the cheap link); without a model —
+        tier-1 fleets, single hosts — every cost is 0 and the pick is
+        the historical pure least-load. Pages only ever move between
+        replicas of one model group: a KV page is meaningless under
+        another model's weights."""
+        from horovod_tpu.serve import migrate as migrate_mod
+        topo = migrate_mod.fleet_topology() if source is not None \
+            else None
+        n_ranks = int(topo["np"]) if topo else 0
+        src_rank = (migrate_mod.replica_rank(source.instance, n_ranks)
+                    if source is not None else 0)
+        xfer_bytes = 0
+        if topo is not None:
+            xfer_bytes = int(
+                need_blocks
+                * migrate_mod.page_nbytes(
+                    self._models[model].model_cfg,
+                    source.engine.allocator.block_size)
+                * migrate_mod.codec_wire_ratio(
+                    self.cfg.handoff_compression))
         cands = []
         for r in list(self._replicas):
             if (r.model != model or r.role not in pool_role
@@ -1159,10 +1318,15 @@ class ServeRouter:
             snap = self._guard(r, r.engine.admission_snapshot)
             if (snap is not None and snap["batch_slots_free"] > 0
                     and r.engine.allocator.can_alloc(need_blocks)):
-                cands.append((r, snap))
+                cost = migrate_mod.link_cost_us(
+                    topo, src_rank,
+                    migrate_mod.replica_rank(r.instance, n_ranks),
+                    xfer_bytes)
+                cands.append((r, snap, cost))
         if not cands:
             return None
-        return min(cands, key=lambda t: self._load(t[1]))[0]
+        return min(cands, key=lambda t: (round(t[2], 3),
+                                         self._load(t[1])))[0]
 
     # -- the fleet iteration -----------------------------------------
 

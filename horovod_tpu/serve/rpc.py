@@ -584,11 +584,14 @@ WORKER_READY_PREFIX = "HVD-SERVE-WORKER ready"
 @dataclasses.dataclass
 class WorkerHandle:
     """A spawned (or attached) serve worker: its RPC connection plus,
-    for spawned workers, the process handle for kill/cleanup."""
+    for spawned workers, the process handle for kill/cleanup.
+    ``host`` is where the worker's sockets live — peers dial its bulk
+    migration listener at ``(host, peer_port-from-configure)``."""
 
     conn: RpcConn
     proc: Optional[subprocess.Popen] = None
     port: int = 0
+    host: str = "127.0.0.1"
 
     def kill(self) -> None:
         """Hard-kill the worker (the failover tests' crash lever)."""
@@ -694,7 +697,7 @@ def connect_worker(host: str, port: int, *,
     sock = socket.create_connection((host, port), timeout=rpc_timeout)
     sock.settimeout(None)
     return WorkerHandle(conn=RpcConn(sock, timeout=rpc_timeout,
-                                     codec=codec), port=port)
+                                     codec=codec), port=port, host=host)
 
 
 # ---------------------------------------------------------------------------
@@ -778,6 +781,40 @@ def handoff_to_wire(h, now: float) -> Dict[str, Any]:
         "k_pages": np.asarray(h.k_pages),
         "v_pages": np.asarray(h.v_pages),
         "block_size": h.block_size, "n_cached": h.n_cached,
+    }
+
+
+def handoff_meta_to_wire(h, now: float) -> Dict[str, Any]:
+    """The manifest half of a handoff — everything but the pages —
+    for the direct-migration ``peer_begin`` frame. The pages follow as
+    ``peer_chunk`` spans, so the target can reserve blocks (and fail
+    fast on no-capacity) before a single bulk byte moves."""
+    return {
+        "prompt": list(h.prompt), "max_new": h.max_new,
+        "generated": list(h.generated),
+        "age_submitted": now - h.submitted_at,
+        "age_first_token": now - h.first_token_at,
+        "deadline_class": h.deadline_class,
+        "chain": list(h.chain),
+        "block_size": h.block_size, "n_cached": h.n_cached,
+        "n_pages": h.n_pages,
+    }
+
+
+def handoff_meta_from_wire(d: Dict[str, Any], now: float) -> Dict[str, Any]:
+    """Inverse of :func:`handoff_meta_to_wire`, re-anchored onto this
+    process's clock — the dict ``ServeEngine.inject_begin`` takes."""
+    return {
+        "prompt": [int(t) for t in d["prompt"]],
+        "max_new": int(d["max_new"]),
+        "generated": [int(t) for t in d["generated"]],
+        "submitted_at": now - d["age_submitted"],
+        "first_token_at": now - d["age_first_token"],
+        "deadline_class": int(d["deadline_class"]),
+        "chain": [bytes(c) for c in d["chain"]],
+        "block_size": int(d["block_size"]),
+        "n_cached": int(d["n_cached"]),
+        "n_pages": int(d["n_pages"]),
     }
 
 
@@ -872,6 +909,11 @@ class RemoteReplica:
             instance=instance, kv_codec=self._conn.codec)
         self.allocator = _RemoteAllocatorView(int(ret["n_blocks"]),
                                               int(ret["block_size"]))
+        # Direct-migration dial target: the worker's bulk peer
+        # listener (docs/serving.md "Direct migration"). 0 = the
+        # worker has none; the router then stays on the relayed path.
+        self.peer_host = handle.host
+        self.peer_port = int(ret.get("peer_port") or 0)
         self.metrics = RemoteReplicaMetrics(instance)
         self._results: Dict[int, Any] = {}
         self._pending = False
@@ -970,6 +1012,30 @@ class RemoteReplica:
     def export_running(self, rid: int):
         d = self._conn.call("export_running", int(rid))
         return handoff_from_wire(d, self._clock())
+
+    # -- direct migration (docs/serving.md "Direct migration") -------
+
+    def migrate_direct(self, erid: int, kind: str, host: str,
+                       port: int, chunk_pages: int,
+                       epoch: int) -> Dict[str, Any]:
+        """Ask THIS worker (the source) to stream sequence ``erid``'s
+        pages point-to-point to a peer worker's bulk listener — the
+        control frame of the direct plane; the router never touches
+        the pages. Returns the worker's status dict: ``ok`` (with the
+        target-side erid and byte/latency accounting),
+        ``dial_failed`` (sequence untouched — fall back to relayed),
+        or ``failed`` (exported then lost — requeue the request)."""
+        return self._conn.call(
+            "migrate_to", kind=str(kind), erid=int(erid),
+            host=str(host), port=int(port),
+            chunk_pages=int(chunk_pages), epoch=int(epoch))
+
+    def note_remote_inject(self) -> None:
+        """A sequence landed on this worker OUTSIDE the router's
+        connection (a peer-streamed inject): mark the cached pending
+        flag so the step loop drives the worker before the next beat
+        refreshes it."""
+        self._pending = True
 
     # -- lifecycle ---------------------------------------------------
 

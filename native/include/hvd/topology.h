@@ -114,6 +114,19 @@ double AlgoCostUs(int algo, int64_t bytes, const TopologyModel& m,
 double ScheduleCostUs(const std::vector<ChunkSchedule>& tables,
                       int64_t bytes, const TopologyModel& m);
 
+// Point-to-point pricing for the serving fleet's KV-page migration
+// plane (hvd_link_cost_us / hvd_migration_cost_us exports). LinkCostUs
+// is one span src -> dst (alpha + bytes*beta, 0 on loopback);
+// MigrationCostUs is the chunked generalization — per-chunk
+// launch+ack+span overhead, one wire crossing of the payload, plus the
+// unoverlappable last-chunk inject. Term-for-term identical to the
+// Python twin in horovod_tpu/serve/migrate.py (the sanitizer tier
+// cross-checks the pair). Huge value on an invalid model or
+// out-of-range rank, so callers gate the same way AlgoCostUs users do.
+double LinkCostUs(const TopologyModel& m, int src, int dst, int64_t bytes);
+double MigrationCostUs(const TopologyModel& m, int src, int dst,
+                       int64_t bytes, int64_t n_chunks);
+
 // Measured replacement for ResolveAlgoDefault: argmin cost over the
 // candidate family at the synced synthesis parameters. Defers to the
 // hand bands' hier verdict (the loopback model cannot price the

@@ -1709,6 +1709,32 @@ double hvd_alltoall_cost_us(int algo, int64_t bytes) {
   return c >= 1e18 ? -1.0 : c;
 }
 
+// Point-to-point migration pricing (docs/serving.md "Direct
+// migration"): alpha-beta cost (us) of one span src -> dst under the
+// live model, and the chunked-stream generalization the serving
+// router's chunk planner sweeps. Both <0 when no model / bad args —
+// the Python cost twin (horovod_tpu/serve/migrate.py) then stands
+// alone, and the sanitizer tier cross-checks the pair bit-for-bit
+// whenever a model exists.
+double hvd_link_cost_us(int src, int dst, int64_t bytes) {
+  auto& st = hvd::State();
+  if (!st.controller) return -1.0;
+  auto m = st.controller->topology_model();
+  if (m == nullptr) return -1.0;
+  const double c = hvd::LinkCostUs(*m, src, dst, bytes);
+  return c >= 1e18 ? -1.0 : c;
+}
+
+double hvd_migration_cost_us(int src, int dst, int64_t bytes,
+                             int64_t n_chunks) {
+  auto& st = hvd::State();
+  if (!st.controller) return -1.0;
+  auto m = st.controller->topology_model();
+  if (m == nullptr) return -1.0;
+  const double c = hvd::MigrationCostUs(*m, src, dst, bytes, n_chunks);
+  return c >= 1e18 ? -1.0 : c;
+}
+
 // Measured-model alltoall verdict for one (total bytes, np) cell using
 // THIS process's broadcast topology model. Returns -1 when no model
 // covers np — the coordinator then serves pairwise.

@@ -378,6 +378,34 @@ double ScheduleCostUs(const std::vector<ChunkSchedule>& tables,
   return total;
 }
 
+double LinkCostUs(const TopologyModel& m, int src, int dst,
+                  int64_t bytes) {
+  if (!m.valid() || src < 0 || dst < 0 || src >= m.np || dst >= m.np)
+    return 1e18;
+  if (src == dst) return 0.0;
+  return m.alpha_us[src * m.np + dst] +
+         bytes * m.beta_us_per_byte[src * m.np + dst];
+}
+
+double MigrationCostUs(const TopologyModel& m, int src, int dst,
+                       int64_t bytes, int64_t n_chunks) {
+  if (!m.valid() || n_chunks < 1 || src < 0 || dst < 0 ||
+      src >= m.np || dst >= m.np)
+    return 1e18;
+  if (src == dst) return 0.0;
+  // Term-for-term twin of horovod_tpu/serve/migrate.py
+  // migration_cost_us — the sanitizer tier cross-checks the two, so
+  // keep the expression order identical: per-chunk launch + ack +
+  // twice the span bookkeeping, the payload's one wire crossing, and
+  // the unoverlappable last-chunk inject as one chunk of extra beta.
+  const double alpha_fwd = m.alpha_us[src * m.np + dst];
+  const double alpha_ack = m.alpha_us[dst * m.np + src];
+  const double beta = m.beta_us_per_byte[src * m.np + dst];
+  const double per_chunk = alpha_fwd + alpha_ack + 2.0 * kSpanOverheadUs;
+  return n_chunks * per_chunk + bytes * beta +
+         (static_cast<double>(bytes) / n_chunks) * beta;
+}
+
 double AlgoCostUs(int algo, int64_t bytes, const TopologyModel& m,
                   int stripes, int granularity, int hd_order) {
   if (!m.valid()) return 1e18;

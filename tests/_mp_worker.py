@@ -2014,6 +2014,124 @@ def main():
         np.testing.assert_allclose(np.asarray(out), float(s))
         print(f"OK rank={r}")
 
+    elif scenario == "migration_plane":
+        # Direct KV-page migration plane (ISSUE 19): (a) the native
+        # alpha-beta cost twin agrees term-for-term with the Python
+        # planner over an injected model; (b) an in-thread serving
+        # fleet runs TWO migrating drains plus one injected worker
+        # death concurrently — peer bulk streams (native sendv/recvv +
+        # bf16 wire codec) race the surviving workers' step RPCs and
+        # the dead conn's teardown, the scheduling hazards this tier
+        # exists to prove clean. Rank 0 runs the fleet; the other rank
+        # holds the world open so the injected topology model stays
+        # live.
+        import ctypes
+
+        from horovod_tpu.common.basics import get_lib
+
+        lib = get_lib()
+        hvd.allreduce(np.ones(4, np.float32), name="mig.enter")
+        if r == 0:
+            from horovod_tpu.serve import migrate
+
+            lib.hvd_link_cost_us.restype = ctypes.c_double
+            lib.hvd_link_cost_us.argtypes = [
+                ctypes.c_int, ctypes.c_int, ctypes.c_int64]
+            lib.hvd_migration_cost_us.restype = ctypes.c_double
+            lib.hvd_migration_cost_us.argtypes = [
+                ctypes.c_int, ctypes.c_int, ctypes.c_int64,
+                ctypes.c_int64]
+            n = s * s
+            alpha, beta = 500.0, 0.001
+            al = " ".join("0" if i % (s + 1) == 0 else str(alpha)
+                          for i in range(n))
+            be = " ".join("0" if i % (s + 1) == 0 else str(beta)
+                          for i in range(n))
+            blob = (f"hvdtopo 1\nkey mig|np{s}|ls{hvd.local_size()}\n"
+                    f"np {s}\nalpha {al}\nbeta {be}\n").encode()
+            assert lib.hvd_topology_inject(blob) == s
+            model = {
+                "np": s,
+                "alpha_us": [[0.0 if i == j else alpha
+                              for j in range(s)] for i in range(s)],
+                "beta_us_per_byte": [[0.0 if i == j else beta
+                                      for j in range(s)]
+                                     for i in range(s)],
+            }
+            # The twins, term for term: link (single span) and the
+            # chunked migration form, across payload regimes.
+            for nb in (1, 4096, 1 << 20, 1 << 27):
+                py = migrate.link_cost_us(model, 0, 1, nb)
+                nat = lib.hvd_link_cost_us(0, 1, nb)
+                assert abs(py - nat) <= 1e-9 * max(abs(py), 1.0), (
+                    nb, py, nat)
+                for nc in (1, 2, 8, 64):
+                    py = migrate.migration_cost_us(model, 0, 1, nb, nc)
+                    nat = lib.hvd_migration_cost_us(0, 1, nb, nc)
+                    assert abs(py - nat) <= 1e-9 * max(abs(py), 1.0), (
+                        nb, nc, py, nat)
+            assert lib.hvd_link_cost_us(0, 0, 4096) == 0.0
+            assert lib.hvd_migration_cost_us(1, 1, 4096, 2) == 0.0
+            assert lib.hvd_link_cost_us(0, s + 7, 4096) == -1.0
+            assert lib.hvd_migration_cost_us(0, 1, 4096, 0) == -1.0
+
+            # -- concurrent migrations: two drains + one injected
+            # death through the direct plane --------------------------
+            import socket as socket_mod
+            import threading as _th
+
+            import jax
+            import jax.numpy as jnp
+
+            from horovod_tpu.models import TransformerConfig
+            from horovod_tpu.serve import (
+                RouterConfig, ServeConfig, ServeRouter,
+            )
+            from horovod_tpu.serve.rpc import RpcConn, WorkerHandle
+            from horovod_tpu.serve.worker import ReplicaWorker
+
+            def _thread_worker():
+                a, b = socket_mod.socketpair()
+                w = ReplicaWorker(RpcConn(b))
+                _th.Thread(target=w.serve, daemon=True).start()
+                return WorkerHandle(conn=RpcConn(a))
+
+            cfg = TransformerConfig.tiny(dtype=jnp.float32, remat=False)
+            sc = ServeConfig(max_batch=4, block_size=4, max_prompt=24,
+                             max_new_tokens=6, batch_buckets=(4,),
+                             prefill_buckets=(4, 8, 16, 24))
+            rc = RouterConfig(n_replicas=4, direct_migration="auto",
+                              handoff_compression="bf16")
+            workers = [_thread_worker() for _ in range(4)]
+            router = ServeRouter(cfg, None, rc, sc, workers=workers,
+                                 worker_seed=0)
+            rng = np.random.RandomState(7)
+            prompts = [rng.randint(1, 256,
+                                   size=int(rng.randint(8, 20))).tolist()
+                       for _ in range(12)]
+            rids = [router.submit(p, 6) for p in prompts]
+            router.step()
+            router.step()
+            reps = list(router._replicas)
+            # Two overlapping migrating drains: the second starts while
+            # the first's sequences are still streaming out.
+            router.remove_replica(reps[0].instance, migrate_running=True)
+            router.step()
+            router.remove_replica(reps[1].instance, migrate_running=True)
+            router.step()
+            # Injected death: a survivor's control conn drops cold; its
+            # uncollected work requeues on the remaining replica.
+            workers[2].conn.close()
+            router.run_until_idle()
+            res = [router.result(x) for x in rids]
+            assert all(x is not None and x.status == "ok" for x in res)
+            assert len({x.rid for x in res}) == len(rids)
+            snap = router.metrics.snapshot()
+            assert snap["direct_migrations_total"] >= 1, snap
+            assert snap["worker_deaths"] >= 1, snap
+            router.close()
+        hvd.allreduce(np.ones(4, np.float32), name="mig.exit")
+
     else:
         raise SystemExit(f"unknown scenario {scenario}")
 

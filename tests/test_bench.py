@@ -409,3 +409,33 @@ def test_find_regressions_moe_dispatch_key_directions():
     # not flag the absent island keys.
     assert bench.find_regressions(
         prev, {"extra": {"moe_tokens_per_sec_gspmd": 8.9e3}}) == {}
+
+
+def test_find_regressions_migration_key_directions():
+    """ISSUE 19 satellite: the direct-migration A/B keys gate in the
+    right directions — `serve_migration_p50_ms` rides the latency
+    inversion (a rise is the regression), the speedup ratio and the
+    byte savings gate higher-is-better, and the move tally is a
+    direction-less counter."""
+    prev = {"extra": {"serve_migration_p50_ms": 6.0,
+                      "serve_migration_direct_over_relayed": 1.5,
+                      "serve_migration_bytes_saved_pct": 50.0,
+                      "serve_migration_direct_count": 48.0}}
+    # Direct path got slower AND lost its edge AND stopped saving
+    # bytes; the count swing must not trip anything.
+    cur = {"extra": {"serve_migration_p50_ms": 9.0,
+                     "serve_migration_direct_over_relayed": 1.0,
+                     "serve_migration_bytes_saved_pct": 0.0,
+                     "serve_migration_direct_count": 16.0}}
+    regs = bench.find_regressions(prev, cur)
+    assert set(regs) == {"extra.serve_migration_p50_ms",
+                         "extra.serve_migration_direct_over_relayed",
+                         "extra.serve_migration_bytes_saved_pct"}
+    assert regs["extra.serve_migration_p50_ms"]["rise_pct"] == 50.0
+    # Latency fell, ratio rose, savings held: a clean round reports
+    # nothing (the count stays ungated in this direction too).
+    cur2 = {"extra": {"serve_migration_p50_ms": 4.0,
+                      "serve_migration_direct_over_relayed": 1.8,
+                      "serve_migration_bytes_saved_pct": 50.0,
+                      "serve_migration_direct_count": 96.0}}
+    assert bench.find_regressions(prev, cur2) == {}

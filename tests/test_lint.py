@@ -592,12 +592,61 @@ def test_moe_metric_pin_discipline_fires(tree):
     assert len(fs) == 1 and fs[0].path == "horovod_tpu/runtime.py", fs
 
 
+def test_undocumented_migration_metric_fires(tree):
+    """ISSUE 19 satellite: a key in MIGRATION_METRIC_KEYS missing from
+    the observability catalog fires migration-metric-pins — the guard
+    that forced the real catalog rows. The clean tree has no migration
+    plane, so the rule starts silent; writing migrate.py arms it."""
+    _write(tree, "horovod_tpu/serve/migrate.py", """\
+        MIGRATION_METRIC_KEYS = (
+            "serve_fleet_direct_migrations_total",
+            "serve_fleet_migration_ms",
+        )
+        """)
+    fs = run_all(tree, only={"migration-metric-pins"})
+    hit = {k for f in fs for k in
+           ("serve_fleet_direct_migrations_total",
+            "serve_fleet_migration_ms") if k in f.message}
+    assert hit == {"serve_fleet_direct_migrations_total",
+                   "serve_fleet_migration_ms"}, fs
+    _write(tree, "docs/observability.md",
+           "`cycles_total` `shm_ops_total` `cycle_us` "
+           "`serve_fleet_direct_migrations_total` "
+           "`serve_fleet_migration_ms`\n"
+           "HOROVOD_CYCLE_TIME HOROVOD_COLLECTIVE_ALGO\n")
+    assert run_all(tree, only={"migration-metric-pins"}) == []
+
+
+def test_migration_metric_pin_discipline_fires(tree):
+    """migration-metric-pins' single-source half: a missing tuple, an
+    off-namespace key, and a stray second definition site each
+    fire."""
+    _write(tree, "docs/observability.md",
+           "`cycles_total` `shm_ops_total` `cycle_us` "
+           "`serve_fleet_migration_ms`\n"
+           "HOROVOD_CYCLE_TIME HOROVOD_COLLECTIVE_ALGO\n")
+    _write(tree, "horovod_tpu/serve/migrate.py",
+           "KEYS = ()  # renamed\n")
+    fs = run_all(tree, only={"migration-metric-pins"})
+    assert len(fs) == 1 and "not found" in fs[0].message, fs
+    _write(tree, "horovod_tpu/serve/migrate.py",
+           'MIGRATION_METRIC_KEYS = ("moe_thing",)\n')
+    fs = run_all(tree, only={"migration-metric-pins"})
+    assert any("namespace" in f.message for f in fs), fs
+    _write(tree, "horovod_tpu/serve/migrate.py",
+           'MIGRATION_METRIC_KEYS = ("serve_fleet_migration_ms",)\n')
+    _write(tree, "horovod_tpu/serve/router2.py",
+           'MIGRATION_METRIC_KEYS = ("serve_fleet_migration_ms",)\n')
+    fs = run_all(tree, only={"migration-metric-pins"})
+    assert len(fs) == 1 and fs[0].path == "horovod_tpu/serve/router2.py", fs
+
+
 def test_every_rule_has_an_injection_test():
     """Meta-guard: adding a rule without an injection test here should
     fail loudly, not pass silently."""
     covered = {"getenv", "knob-docs", "abi-literal", "metric-sync",
                "doc-links", "wire-codec-pins", "algo-name-pins",
-               "moe-metric-pins"}
+               "moe-metric-pins", "migration-metric-pins"}
     assert covered == set(ALL_RULES), (
         "new lint rule(s) without bug-injection coverage: "
         f"{set(ALL_RULES) - covered}")

@@ -1,0 +1,157 @@
+"""Unit tests for the migration planning plane
+(``horovod_tpu.serve.migrate`` + the shared chunking helper): knob
+parsing, the alpha-beta cost twin (term-for-term against hand
+arithmetic — the native mirror is cross-checked in the sanitizer
+tier), the chunk-menu argmin, and the block-aligned chunk ranges all
+three consumers share.
+"""
+
+import math
+
+import pytest
+
+from horovod_tpu.serve import migrate
+from horovod_tpu.serve.kv_cache import page_chunks
+
+
+def _model(np_=2, alpha=100.0, beta=0.01, alpha_back=None):
+    a = [[0.0] * np_ for _ in range(np_)]
+    b = [[0.0] * np_ for _ in range(np_)]
+    for s in range(np_):
+        for d in range(np_):
+            if s != d:
+                a[s][d] = alpha
+                b[s][d] = beta
+    if alpha_back is not None:
+        a[1][0] = alpha_back
+    return {"np": np_, "alpha_us": a, "beta_us_per_byte": b}
+
+
+def test_direct_migration_mode_spellings(monkeypatch):
+    for off in ("off", "0", "false", "no", "relayed", " OFF "):
+        monkeypatch.setenv(migrate.DIRECT_MIGRATION_ENV, off)
+        assert migrate.direct_migration_mode() == "off"
+    for on in ("auto", "on", "1", "true", "yes", "direct", ""):
+        monkeypatch.setenv(migrate.DIRECT_MIGRATION_ENV, on)
+        assert migrate.direct_migration_mode() == "auto"
+    monkeypatch.delenv(migrate.DIRECT_MIGRATION_ENV, raising=False)
+    assert migrate.direct_migration_mode() == "auto"
+
+
+def test_direct_migration_mode_garbage_warns_once(monkeypatch):
+    monkeypatch.setenv(migrate.DIRECT_MIGRATION_ENV, "sideways")
+    monkeypatch.setattr(migrate, "_warned_bad_mode", False)
+    with pytest.warns(UserWarning, match="sideways"):
+        assert migrate.direct_migration_mode() == "auto"
+    # warn-once: the second read is silent
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert migrate.direct_migration_mode() == "auto"
+
+
+def test_link_cost_is_alpha_plus_beta_bytes():
+    m = _model(alpha=100.0, beta=0.01)
+    assert migrate.link_cost_us(m, 0, 1, 1000) == 100.0 + 10.0
+    assert migrate.link_cost_us(m, 0, 0, 1000) == 0.0     # loopback
+    assert migrate.link_cost_us(None, 0, 1, 1000) == 0.0  # no model
+
+
+def test_migration_cost_terms_by_hand():
+    """The closed form, written out: n_chunks * (alpha_fwd + alpha_ack
+    + 2*SPAN_OVERHEAD_US) + bytes*beta + (bytes/n_chunks)*beta —
+    EXACTLY the terms the native hvd_migration_cost_us computes."""
+    m = _model(alpha=50.0, beta=0.002, alpha_back=30.0)
+    n_bytes, n_chunks = 10_000, 4
+    want = (n_chunks * (50.0 + 30.0 + 2 * migrate.SPAN_OVERHEAD_US)
+            + n_bytes * 0.002 + (n_bytes / n_chunks) * 0.002)
+    got = migrate.migration_cost_us(m, 0, 1, n_bytes, n_chunks)
+    assert got == pytest.approx(want)
+    assert migrate.migration_cost_us(m, 0, 0, n_bytes, 2) == 0.0
+    assert migrate.migration_cost_us(None, 0, 1, n_bytes, 2) == 0.0
+    with pytest.raises(ValueError):
+        migrate.migration_cost_us(m, 0, 1, n_bytes, 0)
+
+
+def test_chunking_has_interior_optimum():
+    """Cheap per-chunk latency + a fat tail term -> more chunks win;
+    expensive latency -> monolithic wins. The planner's argmin agrees
+    with brute force over its own menu in both regimes."""
+    n_pages, page_bytes = 64, 4096
+    for alpha in (1.0, 1e6):
+        m = _model(alpha=alpha, beta=0.01)
+        plan = migrate.plan_migration(n_pages, page_bytes, src=0,
+                                      dst=1, model=m)
+        wire = plan["wire_bytes"]
+        best = min(
+            migrate.chunk_menu(n_pages),
+            key=lambda c: migrate.migration_cost_us(
+                m, 0, 1, wire, -(-n_pages // c)))
+        assert plan["chunk_pages"] == best
+    cheap = migrate.plan_migration(n_pages, page_bytes, src=0, dst=1,
+                                   model=_model(alpha=1.0, beta=0.01))
+    dear = migrate.plan_migration(n_pages, page_bytes, src=0, dst=1,
+                                  model=_model(alpha=1e6, beta=0.01))
+    assert cheap["n_chunks"] > 1, cheap
+    assert dear["n_chunks"] == 1, dear
+
+
+def test_plan_without_model_is_monolithic():
+    """No model (or loopback): one chunk, cost 0 — blind chunking only
+    multiplies the target's per-chunk inject dispatches."""
+    plan = migrate.plan_migration(37, 1024, src=0, dst=1, model=None)
+    assert plan == {"chunk_pages": 37, "n_chunks": 1, "cost_us": 0.0,
+                    "wire_bytes": 37 * 1024}
+    loop = migrate.plan_migration(8, 1024, src=2, dst=2,
+                                  model=_model(np_=4))
+    assert loop["n_chunks"] == 1 and loop["cost_us"] == 0.0
+
+
+def test_codec_wire_ratio_and_plan_bytes():
+    assert migrate.codec_wire_ratio(None) == 1.0
+    assert migrate.codec_wire_ratio("bf16") == 0.5
+    assert migrate.codec_wire_ratio("fp16") == 0.5
+    assert migrate.codec_wire_ratio("zlib") == 1.0
+    plan = migrate.plan_migration(10, 1000, src=0, dst=1,
+                                  codec="bf16", model=None)
+    assert plan["wire_bytes"] == math.ceil(10 * 1000 * 0.5)
+
+
+def test_chunk_menu_is_powers_of_two_plus_monolithic():
+    assert migrate.chunk_menu(1) == [1]
+    assert migrate.chunk_menu(8) == [1, 2, 4, 8]
+    assert migrate.chunk_menu(11) == [1, 2, 4, 8, 11]
+    assert migrate.chunk_menu(0) == [1]
+
+
+def test_replica_rank_wraps_onto_the_ring():
+    assert migrate.replica_rank("0", 4) == 0
+    assert migrate.replica_rank("5", 4) == 1
+    assert migrate.replica_rank("worker-7", 4) == 3
+    assert migrate.replica_rank("x", 4) == 0     # no digits
+    assert migrate.replica_rank("3", 0) == 0     # no ring
+
+
+def test_page_chunks_cover_exactly_once():
+    assert page_chunks(10, 4) == [(0, 4), (4, 8), (8, 10)]
+    assert page_chunks(8, 8) == [(0, 8)]
+    assert page_chunks(0, 3) == []
+    assert page_chunks(5, 100) == [(0, 5)]
+    with pytest.raises(ValueError):
+        page_chunks(4, 0)
+    with pytest.raises(ValueError):
+        page_chunks(-1, 2)
+    # the invariant all three consumers rely on: disjoint, ordered,
+    # complete coverage
+    for n, c in [(63, 8), (64, 8), (1, 1), (17, 16)]:
+        ranges = page_chunks(n, c)
+        assert ranges[0][0] == 0 and ranges[-1][1] == n
+        assert all(a[1] == b[0] for a, b in zip(ranges, ranges[1:]))
+
+
+def test_fleet_topology_swallows_uninitialized():
+    """Tier-1 fleets run without hvd.init(): the seam returns None
+    instead of raising, which is what makes every cost 0 and the
+    placement degrade to pure least-load."""
+    assert migrate.fleet_topology() is None

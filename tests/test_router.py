@@ -147,14 +147,14 @@ def test_affinity_groups_same_prefix_traffic(served_model):
     prompts = _tenant_prompts(n_per_tenant=3, n_tenants=2)
     router = _mk_router(served_model, n_replicas=2)
     router.generate(prompts, 4)
-    by_rid = {rid: inst for rid, inst, _ in router.placement_log}
+    by_rid = {rid: inst for rid, inst, _, _ in router.placement_log}
     tenant_a = [by_rid[i] for i in range(0, len(prompts), 2)]
     tenant_b = [by_rid[i] for i in range(1, len(prompts), 2)]
     assert len(set(tenant_a)) == 1
     assert len(set(tenant_b)) == 1
     assert tenant_a[0] != tenant_b[0]
     # Follow-up same-tenant requests report a positive chain match.
-    matches = [m for rid, _, m in router.placement_log if rid >= 2]
+    matches = [m for rid, _, m, _c in router.placement_log if rid >= 2]
     assert all(m > 0 for m in matches)
     # The fleet rollup sees the grouped traffic as cache hits.
     snap = router.metrics.snapshot()
@@ -173,7 +173,7 @@ def test_affinity_only_routes_with_capacity(served_model):
                         serve_kw={"max_queue": 2})
     rids = [router.submit(p, 2) for p in prompts]
     router._place_queued()
-    by_rid = {rid: inst for rid, inst, _ in router.placement_log}
+    by_rid = {rid: inst for rid, inst, _, _ in router.placement_log}
     # First two stick to the affinity target; once its queue is full
     # the rest MUST go elsewhere (not stall, not overflow).
     assert len(set(by_rid.values())) == 2
@@ -375,7 +375,7 @@ def test_multi_model_routing_isolation_and_parity(served_model):
     assert [router.result(r).tokens for r in rids_a] == ref
     assert [router.result(r).tokens for r in rids_b] == ref
     # The wrong-model invariant, on every placement that happened.
-    placed = {rid: inst for rid, inst, _ in router.placement_log}
+    placed = {rid: inst for rid, inst, _, _ in router.placement_log}
     assert all(placed[r] in a_insts for r in rids_a)
     assert all(placed[r] in b_insts for r in rids_b)
     # Per-model rollups split the traffic; the fleet total covers both.
@@ -401,7 +401,7 @@ def test_multi_model_capacity_never_spills_across_groups(served_model):
     rids_b = [router.submit(p, 2, model="b") for p in prompts]
     rids_a = [router.submit(p, 2) for p in prompts]
     router._place_queued()
-    placed = {rid: inst for rid, inst, _ in router.placement_log}
+    placed = {rid: inst for rid, inst, _, _ in router.placement_log}
     # All of a's requests placed despite b's backlog ahead of them in
     # the router queue; b's spill stayed queued.
     assert all(r in placed and placed[r] not in b_insts
@@ -561,7 +561,7 @@ def test_router_randomized_property(served_model):
     # The wrong-model invariant over every placement that happened.
     req_model = {rid: m for rid, (m, _s, _t) in results1.items()}
     placed_models = set()
-    for rid, inst, _match in log1:
+    for rid, inst, _match, _cost in log1:
         assert inst_model[inst] == req_model[rid], (rid, inst)
         placed_models.add(req_model[rid])
     assert placed_models == {"default", "b"}
@@ -621,3 +621,79 @@ def test_fleet_prometheus_instances_and_rollup(served_model):
         mine += float(mm.group(1))
     assert mine == fleet_total == 2.0
     assert sum(per) >= fleet_total
+
+
+# ---------------- topology-scored migration targets (ISSUE 19) -------
+
+
+def _toy_model(np_, cheap, expensive, src=0):
+    """Synthetic alpha-beta model: every link off ``src`` is
+    ``expensive`` except ``src -> cheap``."""
+    alpha = [[0.0] * np_ for _ in range(np_)]
+    for d in range(np_):
+        if d != src:
+            alpha[src][d] = expensive
+    alpha[src][cheap] = 1.0
+    beta = [[0.0] * np_ for _ in range(np_)]
+    return {"np": np_, "alpha_us": alpha, "beta_us_per_byte": beta}
+
+
+def test_drain_target_scored_by_link_cost(served_model, monkeypatch):
+    """ISSUE 19 satellite: with a measured topology model the drain
+    target pick prefers the cheap link even over a less-loaded
+    replica; without a model every cost is 0 and the pick is the
+    historical pure least-load — the degradation contract
+    plan_migration documents."""
+    from horovod_tpu.serve import migrate
+
+    router = _mk_router(served_model, n_replicas=3)
+    r0, r1, r2 = router._replicas
+    # Load replica "2" (instances are "0"/"1"/"2" -> ranks 0/1/2):
+    # least-load alone must prefer the idle "1".
+    r2.engine.submit([1, 2, 3], 2)
+    need = r0.engine.allocator.blocks_for_tokens(8)
+
+    monkeypatch.setattr(migrate, "fleet_topology", lambda: None)
+    assert router._pick_capacity(("unified",), need, exclude=r0,
+                                 source=r0) is r1
+    # Cheap link 0 -> 2 overrides the load gap.
+    monkeypatch.setattr(migrate, "fleet_topology",
+                        lambda: _toy_model(3, cheap=2, expensive=5e6))
+    assert router._pick_capacity(("unified",), need, exclude=r0,
+                                 source=r0) is r2
+    # ... and the cost twin is really what scored it: flipping the
+    # cheap link flips the pick.
+    monkeypatch.setattr(migrate, "fleet_topology",
+                        lambda: _toy_model(3, cheap=1, expensive=5e6))
+    assert router._pick_capacity(("unified",), need, exclude=r0,
+                                 source=r0) is r1
+
+
+def test_drain_end_to_end_lands_on_cheap_link(served_model,
+                                              monkeypatch):
+    """A migrating drain under a synthetic model actually moves its
+    RUNNING sequences over the cheap link, and the placement log's
+    cost column records the verdict (match == -1 rows)."""
+    from horovod_tpu.serve import migrate
+
+    monkeypatch.setattr(migrate, "fleet_topology",
+                        lambda: _toy_model(3, cheap=2, expensive=5e6))
+    router = _mk_router(served_model, n_replicas=3)
+    r0, r1, r2 = router._replicas
+    prompts = _tenant_prompts(n_per_tenant=2)
+    ref = _mk_engine(served_model).generate(prompts, 4)
+    rids = [router.submit(p, 4) for p in prompts]
+    router.step()
+    if not r0.outstanding:       # placement put nothing on "0"
+        pytest.skip("seeded placement left the victim idle")
+    router.remove_replica(r0.instance, migrate_running=True)
+    router.run_until_idle()
+    assert [router.result(r).tokens for r in rids] == ref
+    moves = [e for e in router.placement_log if e[2] == -1]
+    assert moves, "no migration rows in the placement log"
+    # Every move scored the cheap link, and the cost column is the
+    # plan's verdict: one monolithic chunk over alpha 1.0 both ways
+    # is alpha_fwd + alpha_ack + 2 * SPAN_OVERHEAD_US (beta 0).
+    want = round(1.0 + 0.0 + 2 * migrate.SPAN_OVERHEAD_US, 3)
+    assert all(e[3] == want for e in moves), (moves, want)
+    assert all(e[1] == r2.instance for e in moves), moves

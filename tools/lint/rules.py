@@ -543,6 +543,68 @@ def rule_moe_metric_pins(root: str) -> List[Finding]:
     return out
 
 
+# --------------------------------------------------- migration-metric-pins
+
+# The direct-migration exposition keys (serve/migrate.py is the single
+# pin home; the fleet metrics plane emits them) follow the same
+# lockstep discipline as the MoE plane: one definition site, the
+# serve_fleet_ namespace, every key documented in the catalog.
+_MIGRATE_PY = "horovod_tpu/serve/migrate.py"
+_MIGRATION_KEYS_RE = re.compile(r"MIGRATION_METRIC_KEYS\s*=\s*\(([^)]*)\)")
+_MIGRATION_STRAY_RE = re.compile(r"^\s*MIGRATION_METRIC_KEYS\s*=",
+                                 re.MULTILINE)
+
+
+def rule_migration_metric_pins(root: str) -> List[Finding]:
+    """MIGRATION_METRIC_KEYS is defined once (serve/migrate.py), every
+    key lives in the serve_fleet_ namespace, and every key is in the
+    observability catalog — the migration plane's regression gates read
+    these series, so an undocumented or drifting key silently ungates
+    the direct-path perf claim."""
+    out: List[Finding] = []
+    try:
+        mig = _read(root, _MIGRATE_PY)
+    except FileNotFoundError:
+        return []       # trees without the migration plane: nothing to pin
+    m = _MIGRATION_KEYS_RE.search(mig)
+    if not m:
+        return [Finding("migration-metric-pins", _MIGRATE_PY, 0,
+                        "MIGRATION_METRIC_KEYS tuple pin not found")]
+    keys = re.findall(r'"([a-z0-9_]+)"', m.group(1))
+    for d in sorted({k for k in keys if keys.count(k) > 1}):
+        out.append(Finding(
+            "migration-metric-pins", _MIGRATE_PY, 0,
+            f"duplicate metric key {d!r} in MIGRATION_METRIC_KEYS"))
+    for k in keys:
+        if not k.startswith("serve_fleet_"):
+            out.append(Finding(
+                "migration-metric-pins", _MIGRATE_PY, 0,
+                f"metric key {k!r} outside the serve_fleet_ namespace "
+                "— migration series must not collide with other planes"))
+    doc_path = os.path.join(root, _METRICS_DOC)
+    doc_toks = (_doc_metric_tokens(_read(root, _METRICS_DOC))
+                if os.path.exists(doc_path) else set())
+    for k in keys:
+        if k not in doc_toks:
+            out.append(Finding(
+                "migration-metric-pins", _METRICS_DOC, 0,
+                f"migration metric {k!r} (MIGRATION_METRIC_KEYS) "
+                "missing from the observability catalog"))
+    for subdir in ("horovod_tpu", "bin", "examples"):
+        if not os.path.isdir(os.path.join(root, subdir)):
+            continue
+        for rel in _walk(root, subdir, {".py"}):
+            if rel == _MIGRATE_PY:
+                continue
+            for i, ln in enumerate(_read(root, rel).splitlines(), 1):
+                if _MIGRATION_STRAY_RE.match(ln):
+                    out.append(Finding(
+                        "migration-metric-pins", rel, i,
+                        f"MIGRATION_METRIC_KEYS assigned outside its "
+                        f"home {_MIGRATE_PY} — import the pin instead"))
+    return out
+
+
 # -------------------------------------------------------------- doc-links
 
 _MD_LINK_RE = re.compile(r"\[[^\]]*\]\(([^)\s]+)\)")
@@ -581,6 +643,7 @@ ALL_RULES: Dict[str, Callable[[str], List[Finding]]] = {
     "algo-name-pins": rule_algo_name_pins,
     "metric-sync": rule_metric_sync,
     "moe-metric-pins": rule_moe_metric_pins,
+    "migration-metric-pins": rule_migration_metric_pins,
     "doc-links": rule_doc_links,
 }
 
