@@ -812,12 +812,19 @@ def _serve_worker():
         from horovod_tpu.serve.bench import (
             run_prefix_benchmark, run_router_benchmark,
             run_serving_benchmark, run_spec_benchmark,
+            run_trace_overhead_benchmark,
         )
 
         # The benchmark's own contract: continuous batching must beat
         # static on mixed lengths; ride the ratio into the payload so
         # a scheduler regression is visible round-over-round.
         out = run_serving_benchmark(n_requests=32)
+        print("SERVEEXTRA " + json.dumps(out), flush=True)
+        # Observability tax: request-trace tagging overhead (the
+        # always-on <2% promise) + the full-ring flight-dump cost.
+        # Both UNGATED trajectory keys; cheap (reuses the tiny model's
+        # compiled bucket set).
+        out.update(run_trace_overhead_benchmark(n_requests=24))
         print("SERVEEXTRA " + json.dumps(out), flush=True)
         # Prefix-cache tier: cache-on/off ratio + hit rate on the
         # shared-prefix trace (the tokens-per-request lever).
@@ -1054,8 +1061,14 @@ LOWER_IS_BETTER_SUFFIXES = ("_ms", "_us", "_us_p50_np4")
 # the fleet): pure counts with no better/worse direction, while the
 # router's hit-rate/throughput keys gate higher-is-better and its
 # *_ms keys ride the latency inversion above.
+# _overhead_pct (trace-tagging tax) and _dump_ms (full-ring flight
+# dump) are sub-percent / sub-ms observability costs whose round-over-
+# round swing is scheduler noise: trajectory keys, never gated — and
+# _dump_ms must be listed HERE or the `_ms` suffix would latency-gate
+# it.
 UNGATED_SUFFIXES = ("_steps", "_evictions", "_high_water", "_us_p99",
-                    "_fill_pct", "_count", "_probe_ms")
+                    "_fill_pct", "_count", "_probe_ms", "_overhead_pct",
+                    "_dump_ms")
 
 
 def find_regressions(prev, cur, threshold=0.10):

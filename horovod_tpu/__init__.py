@@ -89,6 +89,10 @@ from horovod_tpu.api import (  # noqa: F401
     metrics_reset,
     stalled_tensors,
     start_metrics_server,
+    flight_events,
+    flight_record,
+    flight_dump,
+    flight_clear,
 )
 from horovod_tpu.compression import Compression  # noqa: F401
 from horovod_tpu.functions import (  # noqa: F401

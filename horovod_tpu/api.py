@@ -134,6 +134,42 @@ def stalled_tensors():
     return _fn()
 
 
+def flight_events():
+    """Decoded snapshot of the native flight recorder — the always-on
+    control-plane event ring (lock engage/release, membership epochs,
+    negotiation cycle summaries, stall findings, peer deaths, autotune
+    verdicts). One ``{"seq", "t_us", "event", "a0", "a1"}`` dict per
+    surviving slot, oldest first; ``t_us`` is CLOCK_MONOTONIC
+    microseconds. See ``docs/observability.md`` for the event catalog."""
+    from horovod_tpu.metrics import flight_events as _fn
+    return _fn()
+
+
+def flight_record(event: int, a0: int = 0, a1: int = 0) -> None:
+    """Append one event to the native flight ring from Python (the
+    serve plane records requeues and router-side findings this way).
+    ``event`` is a ``FLIGHT_*`` id from :mod:`horovod_tpu.common.basics`."""
+    from horovod_tpu.metrics import flight_record as _fn
+    _fn(event, a0, a1)
+
+
+def flight_dump(path=None) -> bool:
+    """Write the flight ring to ``path`` (or, when None, to the
+    auto-dump path under ``HOROVOD_FLIGHT_DIR``). Returns True on
+    success. The same dump fires automatically on fatal signals and
+    :class:`~horovod_tpu.common.exceptions.HorovodInternalError` when
+    ``HOROVOD_FLIGHT_DIR`` is set."""
+    from horovod_tpu.metrics import flight_dump as _fn
+    return _fn(path)
+
+
+def flight_clear() -> None:
+    """Drop every recorded flight event (scopes a test or measurement
+    window, like :func:`metrics_reset` for the event ring)."""
+    from horovod_tpu.metrics import flight_clear as _fn
+    _fn()
+
+
 def steady_lock_engaged() -> bool:
     """True while this rank runs the steady-state schedule lock's
     negotiation-bypass plane (``HOROVOD_STEADY_LOCK``, see

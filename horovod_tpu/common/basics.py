@@ -38,7 +38,7 @@ EXEC_CALLBACK = 1
 # is enforced at library load below, and tests/test_wire_abi.py greps
 # the header so a native bump can't silently skew this shim even
 # before a rebuild happens.
-ABI_VERSION = 14
+ABI_VERSION = 15
 WIRE_VERSION_REQUEST_LIST = 3
 WIRE_VERSION_RESPONSE_LIST = 7
 
@@ -286,6 +286,26 @@ def _declare_abi(lib: ctypes.CDLL, path: str) -> ctypes.CDLL:
     # byte count needed including the NUL, copies at most len-1 bytes.
     lib.hvd_stalled_tensors.restype = ctypes.c_int
     lib.hvd_stalled_tensors.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    # Flight recorder (native/include/hvd/flight.h): always-on
+    # control-plane event ring with postmortem dump. Snapshot follows
+    # the stalled_tensors size-probe protocol.
+    lib.hvd_flight_record.restype = None
+    lib.hvd_flight_record.argtypes = [ctypes.c_int, ctypes.c_longlong,
+                                      ctypes.c_longlong]
+    lib.hvd_flight_snapshot.restype = ctypes.c_longlong
+    lib.hvd_flight_snapshot.argtypes = [ctypes.c_char_p, ctypes.c_longlong]
+    lib.hvd_flight_dump.restype = ctypes.c_int
+    lib.hvd_flight_dump.argtypes = [ctypes.c_char_p]
+    lib.hvd_flight_install.restype = ctypes.c_int
+    lib.hvd_flight_install.argtypes = [ctypes.c_char_p]
+    lib.hvd_flight_num_events.restype = ctypes.c_int
+    lib.hvd_flight_event_name.restype = ctypes.c_char_p
+    lib.hvd_flight_event_name.argtypes = [ctypes.c_int]
+    lib.hvd_flight_count.restype = ctypes.c_longlong
+    lib.hvd_flight_clear.restype = None
+    lib.hvd_flight_set_enabled.restype = None
+    lib.hvd_flight_set_enabled.argtypes = [ctypes.c_int]
+    lib.hvd_flight_enabled.restype = ctypes.c_int
     got_metrics = lib.hvd_metrics_version()
     if got_metrics != METRICS_VERSION:
         raise OSError(
@@ -465,6 +485,14 @@ MEMBER_RESET = 0
 MEMBER_JOIN = 1
 MEMBER_DEAD_PEER = 2
 MEMBER_SHRINK = 3
+
+# Flight-recorder event ids (native/include/hvd/flight.h FlightEvent —
+# stable ints, part of the ABI surface; only the ones Python records
+# are named here, pinned against the native name table by
+# tests/test_flight.py).
+FLIGHT_PEER_DEATH = 6
+FLIGHT_REQUEUE = 10
+FLIGHT_INTERNAL_ERROR = 11
 
 
 _lib: Optional[ctypes.CDLL] = None

@@ -13,7 +13,24 @@ class HorovodInternalError(RuntimeError):
 
     Under elastic training this is recoverable: state is restored from
     the last commit and the job re-rendezvouses.
+
+    When ``HOROVOD_FLIGHT_DIR`` is set, constructing one records an
+    ``internal_error`` flight event and dumps the native flight ring —
+    the failure that triggers a restore is exactly the moment the
+    control-plane trail matters (see ``docs/observability.md``).
     """
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        import os
+        if os.environ.get("HOROVOD_FLIGHT_DIR"):
+            try:
+                from horovod_tpu.common import basics
+                from horovod_tpu.metrics import flight_dump, flight_record
+                flight_record(basics.FLIGHT_INTERNAL_ERROR)
+                flight_dump()
+            except Exception:
+                pass  # never let telemetry mask the real failure
 
 
 class HostsUpdatedInterrupt(Exception):

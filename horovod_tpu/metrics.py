@@ -191,6 +191,90 @@ def stalled_tensors() -> List[dict]:
 
 
 # ---------------------------------------------------------------------------
+# flight recorder (the postmortem half of the stall/metrics story)
+# ---------------------------------------------------------------------------
+
+def _parse_flight_text(text: str) -> List[dict]:
+    """Parse the flight dump/snapshot text format (header line plus one
+    ``seq\\tt_us\\tname\\ta0\\ta1`` row per event) into event dicts.
+    Shared with ``bin/hvd-trace``, which reads the same format off
+    disk. ``t_us`` is CLOCK_MONOTONIC microseconds; the header's
+    ``mono_us``/``wall_us`` pair (:func:`_parse_flight_header`) maps it
+    onto wall time."""
+    events = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        seq, t_us, name, a0, a1 = line.split("\t")
+        events.append({
+            "seq": int(seq),
+            "t_us": int(t_us),
+            "event": name,
+            "a0": int(a0),
+            "a1": int(a1),
+        })
+    return events
+
+
+def _parse_flight_header(text: str) -> dict:
+    """``{"version", "pid", "mono_us", "wall_us"}`` from the dump's
+    ``# flight v1 pid=... mono_us=... wall_us=...`` header line."""
+    out: dict = {}
+    for line in text.splitlines():
+        if not line.startswith("# flight"):
+            continue
+        for tok in line.split():
+            if tok.startswith("v") and tok[1:].isdigit():
+                out["version"] = int(tok[1:])
+            elif "=" in tok:
+                k, _, v = tok.partition("=")
+                out[k] = int(v)
+        break
+    return out
+
+
+def _flight_text() -> str:
+    lib = _lib()
+    need = lib.hvd_flight_snapshot(None, 0)
+    while True:
+        buf = ctypes.create_string_buffer(int(need) + 256)
+        need = lib.hvd_flight_snapshot(buf, len(buf))
+        if need <= len(buf):
+            break
+    return buf.value.decode()
+
+
+def flight_events() -> List[dict]:
+    """The flight recorder's surviving ring, oldest first: one
+    ``{"seq", "t_us", "event", "a0", "a1"}`` per control-plane event
+    (catalog with argument units in docs/observability.md). ``t_us``
+    is on the ``time.monotonic()`` axis, so an event's age is
+    ``time.monotonic() - e["t_us"] / 1e6``."""
+    return _parse_flight_text(_flight_text())
+
+
+def flight_record(event: int, a0: int = 0, a1: int = 0) -> None:
+    """Record one event into the native ring (ids:
+    ``basics.FLIGHT_*``). Python control planes — the fleet router's
+    peer-death/requeue path — share the ring with the native core so
+    one dump tells the whole story."""
+    _lib().hvd_flight_record(int(event), int(a0), int(a1))
+
+
+def flight_dump(path: Optional[str] = None) -> bool:
+    """Write the postmortem dump. ``None`` uses the
+    ``HOROVOD_FLIGHT_DIR`` auto-dump path armed at library load;
+    returns False when neither resolves (no directory configured)."""
+    p = path.encode() if isinstance(path, str) else path
+    return _lib().hvd_flight_dump(p) == 0
+
+
+def flight_clear() -> None:
+    """Empty the ring (test/measurement-window scoping)."""
+    _lib().hvd_flight_clear()
+
+
+# ---------------------------------------------------------------------------
 # Prometheus exposition
 # ---------------------------------------------------------------------------
 

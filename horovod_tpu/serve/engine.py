@@ -183,6 +183,7 @@ class _Queued:
     #                              submit (not per admission retry)
     deadline_class: int = 0
     prefill_only: bool = False   # park for handoff instead of decoding
+    trace: int = 0               # distributed trace id (0 = unsampled)
 
 
 @dataclasses.dataclass
@@ -205,6 +206,7 @@ class _Seq:
     #                              token once prefill completes
     deadline_class: int = 0
     prefill_only: bool = False
+    trace: int = 0               # distributed trace id (0 = unsampled)
 
     @property
     def last_token(self) -> int:
@@ -243,6 +245,7 @@ class PrefillHandoff:
     v_pages: Any
     block_size: int
     n_cached: int                # tokens covered by the pages
+    trace_id: int = 0            # distributed trace id (0 = unsampled)
 
     @property
     def n_pages(self) -> int:
@@ -433,7 +436,8 @@ class ServeEngine:
                deadline: Optional[float] = None,
                deadline_class: int = 0,
                prefill_only: bool = False,
-               chain: Optional[List[bytes]] = None) -> int:
+               chain: Optional[List[bytes]] = None,
+               trace_id: int = 0) -> int:
         """Enqueue a request; returns its id. Raises :class:`QueueFull`
         when the admission queue is at capacity (backpressure) and
         ``ValueError`` on shapes the engine cannot ever serve.
@@ -443,7 +447,9 @@ class ServeEngine:
         ``chain`` is the prompt's precomputed hash chain (the router
         hashed it once at fleet admission — passing it through keeps
         the PR 4 hash-ONCE discipline across tiers; trusted, must
-        match ``hash_chain(prompt, block_size)``)."""
+        match ``hash_chain(prompt, block_size)``); ``trace_id`` is the
+        router-minted distributed trace id (0 = unsampled) that tags
+        this request's prefill/decode spans (docs/observability.md)."""
         prompt = list(prompt)
         max_new = (self.cfg.max_new_tokens if max_new_tokens is None
                    else max_new_tokens)
@@ -463,7 +469,8 @@ class ServeEngine:
         self._queue.append(_Queued(rid, prompt, max_new, deadline,
                                    self._clock(), chain,
                                    deadline_class=deadline_class,
-                                   prefill_only=prefill_only))
+                                   prefill_only=prefill_only,
+                                   trace=trace_id))
         self.metrics.record_submitted()
         self.metrics.record_queue_depth(len(self._queue))
         return rid
@@ -673,7 +680,8 @@ class ServeEngine:
                 generated=[], submitted_at=req.submitted_at,
                 chain=req.chain, registered=len(matched),
                 deadline_class=req.deadline_class,
-                prefill_only=req.prefill_only))
+                prefill_only=req.prefill_only,
+                trace=req.trace))
 
     def _advance_prefills(self) -> None:
         """Run prefill chunks FIFO across admitted-but-incomplete
@@ -763,7 +771,8 @@ class ServeEngine:
         self.cache.k, self.cache.v = kc, vc
         seq.n_cached = offset + chunk
         seq.last_prefill_tok = tok
-        self.metrics.record_prefill(t0, dur, chunk, offset=offset)
+        self.metrics.record_prefill(t0, dur, chunk, offset=offset,
+                                    trace=seq.trace)
         if self.cfg.prefix_caching:
             # Publish the prompt blocks this chunk filled. A losing
             # race (hash already published by a concurrent twin) keeps
@@ -834,7 +843,8 @@ class ServeEngine:
             first_token_at=seq.first_token_at,
             deadline_class=seq.deadline_class, chain=list(seq.chain),
             k_pages=k_pages, v_pages=v_pages,
-            block_size=self.cfg.block_size, n_cached=seq.n_cached)
+            block_size=self.cfg.block_size, n_cached=seq.n_cached,
+            trace_id=seq.trace)
 
     def running_exportable(self) -> List[int]:
         """rids of RUNNING (decoding) sequences a drain could migrate
@@ -886,7 +896,7 @@ class ServeEngine:
             "first_token_at": h.first_token_at,
             "deadline_class": h.deadline_class, "chain": h.chain,
             "block_size": h.block_size, "n_cached": h.n_cached,
-            "n_pages": h.n_pages})
+            "n_pages": h.n_pages, "trace_id": h.trace_id})
         self.inject_chunk(token, h.k_pages, h.v_pages)
         return self.inject_commit(token)
 
@@ -997,7 +1007,8 @@ class ServeEngine:
             generated=list(meta["generated"]),
             submitted_at=meta["submitted_at"],
             chain=list(meta["chain"]), registered=0,
-            deadline_class=meta["deadline_class"])
+            deadline_class=meta["deadline_class"],
+            trace=int(meta.get("trace_id", 0)))
         seq.first_token_at = meta["first_token_at"]
         if self.cfg.prefix_caching:
             # Publish the injected prompt blocks locally: future
@@ -1055,4 +1066,6 @@ class ServeEngine:
         for i, seq in enumerate(self._active):
             seq.n_cached += 1
             seq.generated.append(int(out[i]))
-        self.metrics.record_decode(t0, dur, n, self.cfg.max_batch)
+        self.metrics.record_decode(
+            t0, dur, n, self.cfg.max_batch,
+            traces=[s.trace for s in self._active if s.trace])

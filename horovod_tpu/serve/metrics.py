@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import os
 import time
 from typing import Dict, List, Optional
 
@@ -146,12 +147,15 @@ class ServeMetrics:
         return {"blocks_in_use": a.n_used, "blocks_cached": a.n_cached}
 
     def record_prefill(self, t0: float, dur_s: float, n_tokens: int,
-                       offset: int = 0) -> None:
+                       offset: int = 0, trace: int = 0) -> None:
         """One prefill chunk of ``n_tokens`` starting at token
-        ``offset`` (0 + whole prompt = the monolithic case)."""
+        ``offset`` (0 + whole prompt = the monolithic case);
+        ``trace`` is the request's distributed trace id (0 =
+        unsampled, omitted from the span)."""
         self.prefill_steps += 1
+        extra = {"trace": trace} if trace else {}
         self._span("serve:prefill", t0, dur_s, n_tokens=n_tokens,
-                   offset=offset, **self._pool_gauges())
+                   offset=offset, **extra, **self._pool_gauges())
 
     def record_prefix_lookup(self, hit_tokens: int,
                              suffix_tokens: int) -> None:
@@ -169,7 +173,7 @@ class ServeMetrics:
         self.prefix_prefill_tokens -= tokens
 
     def record_decode(self, t0: float, dur_s: float, n_active: int,
-                      max_batch: int) -> None:
+                      max_batch: int, traces=None) -> None:
         self.decode_steps += 1
         self.tokens_generated += n_active
         self._occupancy_sum += n_active / max_batch
@@ -177,13 +181,17 @@ class ServeMetrics:
             # Every active sequence advanced one token this step, so
             # the step wall time IS the per-token latency sample.
             self.per_token_s.append(dur_s)
+        # A decode step serves the whole batch, so it carries the
+        # trace ids of every sampled sequence in it (plural key).
+        extra = {"traces": list(traces)} if traces else {}
         self._span("serve:decode", t0, dur_s, n_active=n_active,
-                   **self._pool_gauges())
+                   **extra, **self._pool_gauges())
 
     def record_spec_round(self, t0: float, draft_dur_s: float,
                           verify_dur_s: float, n_active: int,
                           max_batch: int, *, proposed: int,
-                          accepted: int, emitted: int) -> None:
+                          accepted: int, emitted: int,
+                          traces=None) -> None:
         """One speculative iteration: the k batched draft decode steps
         (one span) plus the single chunked verify step, with the
         round's proposal/acceptance tallies. Feeds the same
@@ -205,10 +213,11 @@ class ServeMetrics:
             self.spec_draft_s.append(draft_dur_s)
         if len(self.spec_verify_s) < MAX_SAMPLES:
             self.spec_verify_s.append(verify_dur_s)
+        extra = {"traces": list(traces)} if traces else {}
         self._span("serve:spec_draft", t0, draft_dur_s,
-                   n_active=n_active, proposed=proposed)
+                   n_active=n_active, proposed=proposed, **extra)
         self._span("serve:spec_verify", t0 + draft_dur_s, verify_dur_s,
-                   accepted=accepted, emitted=emitted,
+                   accepted=accepted, emitted=emitted, **extra,
                    **self._pool_gauges())
 
     def record_first_token(self, latency_s: float) -> None:
@@ -331,9 +340,30 @@ class ServeMetrics:
         return render_gauges("serve", self.snapshot(),
                              labels={"instance": self.instance})
 
-    def export_chrome_trace(self, path: str) -> None:
+    def trace_metadata(self, **extra) -> dict:
+        """Timebase anchor for :meth:`export_chrome_trace` and the
+        RPC ``export_trace`` verb: span ``ts`` values are microseconds
+        since ``started_at`` on this engine's clock, and the
+        ``(clock_now, wall_now)`` pair taken here lets
+        ``bin/hvd-trace merge`` map them onto any other process's
+        clock (docs/observability.md "One timebase")."""
+        md = {
+            "kind": "engine",
+            "instance": self.instance,
+            "pid": os.getpid(),
+            "started_at": self.started_at,
+            "clock_now": self._clock(),
+            "wall_now": time.time(),
+        }
+        md.update(extra)
+        return md
+
+    def export_chrome_trace(self, path: str, **extra) -> None:
         """Write recorded step spans as a chrome-tracing file (the
-        timeline format the rest of the framework emits)."""
+        timeline format the rest of the framework emits), with the
+        :meth:`trace_metadata` anchor so merged fleet views can
+        re-anchor the spans onto one timebase."""
         with open(path, "w") as f:
             json.dump({"traceEvents": self._events,
-                       "displayTimeUnit": "ms"}, f)
+                       "displayTimeUnit": "ms",
+                       "metadata": self.trace_metadata(**extra)}, f)

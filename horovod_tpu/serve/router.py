@@ -92,6 +92,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import itertools
+import os
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -116,14 +117,18 @@ def _codec_id(name) -> int:
     return span_codec_id(name)
 
 
-def _advance_membership(reason: int) -> None:
+def _advance_membership(reason: int, rank: int = -1) -> None:
     """Tick the process-global membership plane (docs/elastic.md): the
     serving fleet's replica churn rides the same epoch
     ``hvd.membership()`` reports for training, so one monotone number
     fences both planes. Safe from any thread — the plane's fences gate
-    background-owned state internally."""
+    background-owned state internally. ``rank`` names the affected
+    member when there is one (a dead replica's numeric instance): the
+    native plane records it in the ``peer_death`` flight event, so a
+    post-mortem flight dump says WHO died, not just that someone
+    did."""
     from horovod_tpu.common import basics
-    basics.get_lib().hvd_membership_advance(reason, -1)
+    basics.get_lib().hvd_membership_advance(reason, rank)
 
 
 def _record_flap(identity: str) -> None:
@@ -230,6 +235,7 @@ class _Pending:
     submitted_at: float
     chain: List[bytes]
     model: str = DEFAULT_MODEL
+    trace: int = 0               # distributed trace id (0 = unsampled)
 
 
 @dataclasses.dataclass
@@ -523,6 +529,12 @@ class ServeRouter:
         self._rids = itertools.count()
         self._retire_ema = RetireEma()
         self.metrics = FleetMetrics(self)
+        # Distributed tracing (docs/observability.md): the router's
+        # half of every sampled request's timeline. Ids are minted at
+        # submit (salted by cfg.seed — deterministic across seeded
+        # reruns) and ride the RPC frame header to workers.
+        from horovod_tpu.serve.trace import RouterTrace
+        self.trace = RouterTrace(clock=clock)
         from horovod_tpu.serve import migrate as migrate_mod
         # "env" resolves the sane-env knob ONCE at fleet construction
         # (a fleet never flips mid-life); "auto"/"off" force it.
@@ -572,7 +584,7 @@ class ServeRouter:
             eng = RemoteReplica(worker, group.model_cfg,
                                 group.serve_cfg,
                                 seed=group.worker_seed, instance=label,
-                                clock=self._clock)
+                                clock=self._clock, trace=self.trace)
         else:
             if group.params is None:
                 raise ValueError(
@@ -773,16 +785,34 @@ class ServeRouter:
             return
         self._replicas.remove(rep)
         from horovod_tpu.common import basics
-        _advance_membership(basics.MEMBER_DEAD_PEER)
+        # The numeric instance rides into the native peer_death flight
+        # event — a post-mortem dump names WHO died.
+        try:
+            dead_rank = int(rep.instance)
+        except ValueError:
+            dead_rank = -1
+        _advance_membership(basics.MEMBER_DEAD_PEER, rank=dead_rank)
         _record_flap(f"replica:{self.metrics.fleet}.{rep.instance}")
         getattr(rep.engine, "mark_dead", lambda: None)()
         requeue = [rid for rid in rep.outstanding.values()
                    if rid in self._requests]
         for rid in sorted(requeue, reverse=True):
             self._queue.appendleft(self._requests[rid])
+            req = self._requests[rid]
+            self.trace.instant("router:requeue", trace=req.trace,
+                               rid=rid, from_instance=rep.instance)
         self.metrics.worker_deaths += 1
         self.metrics.requeued_total += len(requeue)
         self.metrics.absorb(rep.engine.metrics, rep.model)
+        # Flight trail: one requeue record per orphaned request
+        # (a0 = router rid, a1 = dead instance), then — when the
+        # operator asked for post-mortems — dump the ring. The native
+        # peer_death record from _advance_membership is already in it.
+        from horovod_tpu.metrics import flight_dump, flight_record
+        for rid in requeue:
+            flight_record(basics.FLIGHT_REQUEUE, rid, dead_rank)
+        if os.environ.get("HOROVOD_FLIGHT_DIR"):
+            flight_dump()
 
     def _heartbeat_sweep(self, now: float) -> None:
         """Probe remote replicas the step loop will not otherwise talk
@@ -890,12 +920,19 @@ class ServeRouter:
         # same-prefix tenant onto one hot replica for zero benefit.
         chain = (hash_chain(prompt, cfg.block_size)
                  if cfg.prefix_caching else [])
+        from horovod_tpu.serve.trace import mint_trace_id
+        now = self._clock()
+        trace = mint_trace_id(rid, salt=self.cfg.seed)
         req = _Pending(
             rid=rid, prompt=prompt, max_new=max_new, deadline=deadline,
-            deadline_class=deadline_class, submitted_at=self._clock(),
-            chain=chain, model=model)
+            deadline_class=deadline_class, submitted_at=now,
+            chain=chain, model=model, trace=trace)
         self._requests[rid] = req
         self._queue.append(req)
+        if trace:
+            self.trace.instant("router:submit", t=now, trace=trace,
+                               rid=rid, n_prompt=len(prompt),
+                               model=model)
         return rid
 
     def _shed_candidate(self, incoming_class: int) -> Optional[int]:
@@ -1063,14 +1100,23 @@ class ServeRouter:
                     stuck.add(req.model)
                     continue
                 rep, match = self._pick(req, cands)
+                t_place = self._clock()
                 erid = self._guard(rep, lambda: rep.engine.submit(
                     req.prompt, req.max_new, deadline=req.deadline,
                     deadline_class=req.deadline_class,
                     prefill_only=(rep.role == "prefill"),
-                    chain=req.chain))
+                    chain=req.chain, trace_id=req.trace))
                 if erid is None:
                     died = True
                     break
+                if req.trace:
+                    # Queue wait closes at placement: submit -> the
+                    # instant the request left the router queue.
+                    self.trace.span(
+                        "router:queue_wait", req.submitted_at,
+                        t_place - req.submitted_at, trace=req.trace,
+                        rid=req.rid, instance=rep.instance,
+                        match=match)
                 placed.add(req.rid)
                 rep.outstanding[erid] = req.rid
                 if self.cfg.placement == "affinity":
@@ -1242,6 +1288,7 @@ class ServeRouter:
                     rid, target, cost_us=plan["cost_us"],
                     wire_bytes=int(ret.get("wire_bytes") or 0),
                     ms=float(ret.get("ms") or 0.0))
+                self._trace_handoff(rid, src, target, kind, t0)
                 return True
             if status != "dial_failed":
                 # Exported, then the stream died mid-transfer: pages
@@ -1275,7 +1322,17 @@ class ServeRouter:
             wire_bytes=int(np.asarray(h.k_pages).nbytes
                            + np.asarray(h.v_pages).nbytes),
             ms=(self._clock() - t0) * 1e3)
+        self._trace_handoff(rid, src, target, kind, t0)
         return True
+
+    def _trace_handoff(self, rid: int, src: _Replica,
+                       target: _Replica, kind: str, t0: float) -> None:
+        req = self._requests.get(rid)
+        if req is None or not req.trace:
+            return
+        self.trace.span("router:handoff", t0, self._clock() - t0,
+                        trace=req.trace, rid=rid, kind=kind,
+                        src=src.instance, dst=target.instance)
 
     def _pick_capacity(self, pool_role: Tuple[str, ...],
                        need_blocks: int,
@@ -1426,9 +1483,21 @@ class ServeRouter:
                 # Rebind to the router's rid space; everything else
                 # (tokens, latencies, structured-rejection fields)
                 # passes through untouched.
+                req = self._requests[rid]
                 self._results[rid] = dataclasses.replace(res, rid=rid)
                 del self._requests[rid]
                 done.append(erid)
+                if req.trace:
+                    # End-to-end on the router clock: submit to the
+                    # step the result came home. The critical-path
+                    # breakdown in `hvd-trace` decomposes exactly
+                    # this span.
+                    t_end = self._clock()
+                    self.trace.span(
+                        "router:e2e", req.submitted_at,
+                        t_end - req.submitted_at, trace=req.trace,
+                        rid=rid, status=res.status,
+                        instance=rep.instance)
                 # Only REAL retirements feed the drain-rate EMA (the
                 # engine's own EMA observes only _finish): a deadline
                 # storm of back-to-back expirations would otherwise
@@ -1473,6 +1542,43 @@ class ServeRouter:
         rids = [self.submit(p, max_new_tokens) for p in prompts]
         self.run_until_idle()
         return [self._results[r].tokens for r in rids]
+
+    def export_fleet_trace(self, dir_path: str) -> List[str]:
+        """Write the whole fleet's trace files into ``dir_path``:
+        ``router.json`` (this router's spans + timebase anchor) and
+        one ``replica-<instance>.json`` per live replica, each
+        carrying its own anchor and — for remote replicas — the
+        router's RTT-estimated clock offset. ``bin/hvd-trace merge``
+        over the directory produces the single-timebase Perfetto
+        view. Returns the paths written. Remote replicas with no
+        offset sample yet get one forced heartbeat first (a fleet
+        that never idled may never have swept them)."""
+        import json as _json
+        os.makedirs(dir_path, exist_ok=True)
+        paths = []
+        p = os.path.join(dir_path, "router.json")
+        self.trace.export(p, fleet=self.metrics.fleet)
+        paths.append(p)
+        for rep in list(self._replicas):
+            p = os.path.join(dir_path,
+                             f"replica-{rep.instance}.json")
+            if rep.remote:
+                if rep.engine.clock_rtt == float("inf"):
+                    self._guard(rep, rep.engine.heartbeat)
+                    if rep not in self._replicas:
+                        continue   # died on the forced beat
+                d = self._guard(rep, rep.engine.export_trace)
+                if d is None:
+                    continue
+                with open(p, "w") as f:
+                    _json.dump({"traceEvents": d["events"],
+                                "displayTimeUnit": "ms",
+                                "metadata": d["meta"]}, f)
+            else:
+                rep.engine.metrics.export_chrome_trace(
+                    p, instance=rep.instance, clock_offset=0.0)
+            paths.append(p)
+        return paths
 
     def close(self) -> None:
         """Release remote replicas without drain semantics: best-

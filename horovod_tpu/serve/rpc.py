@@ -20,7 +20,15 @@ connection — the router treats it identically to an in-process
 Wire layout of one message::
 
     [u64 frame_len][frame: u32 magic | u16 version | u16 n_spans |
-                    packed body][span 0 bytes]...[span n-1 bytes]
+                    u64 trace_id | packed body]
+    [span 0 bytes]...[span n-1 bytes]
+
+The ``trace_id`` header field (protocol v2) carries the distributed
+tracing context of :mod:`horovod_tpu.serve.trace`: the router stamps
+the request's trace id on the frame that places it (``submit`` /
+``inject_prefilled``), the worker reads it off the header
+(:attr:`RpcConn.last_trace_id`) and tags its engine spans. 0 = no
+trace context (the overwhelmingly common frame).
 
 The body is the request/response value tree; every numpy array in the
 tree is replaced by a struct-packed descriptor ``(codec, dtype, shape,
@@ -74,7 +82,11 @@ from horovod_tpu.common.basics import dtype_id, get_lib, np_dtype
 #: site (lint rule ``abi-literal`` treats it like the wire-version
 #: pins): bump on ANY change to the frame header, the value-codec
 #: tags, or the span descriptor layout.
-RPC_PROTOCOL_VERSION = 1
+#: v2: the frame header grew a u64 ``trace_id`` after ``n_spans``
+#: (distributed request tracing, serve/trace.py) — same magic, same
+#: leading fields, so a v1 peer is detected and named before the new
+#: field is ever parsed.
+RPC_PROTOCOL_VERSION = 2
 
 #: Frame magic ("HRPC", little-endian).
 RPC_MAGIC = 0x43505248
@@ -143,6 +155,7 @@ def span_codec_id(name) -> int:
 
 _T_NONE, _T_TRUE, _T_FALSE, _T_INT, _T_FLOAT = 0, 1, 2, 3, 4
 _T_BYTES, _T_STR, _T_LIST, _T_DICT, _T_ARRAY = 5, 6, 7, 8, 9
+_T_U64 = 10  # ints in [2**63, 2**64): 64-bit ids (FNV-1a trace ids)
 
 
 class _ArrayStub:
@@ -198,7 +211,14 @@ def _pack_value(obj, out: List[bytes],
     elif obj is False:
         out.append(struct.pack("<B", _T_FALSE))
     elif isinstance(obj, (int, np.integer)):
-        out.append(struct.pack("<Bq", _T_INT, int(obj)))
+        v = int(obj)
+        if -(1 << 63) <= v < (1 << 63):
+            out.append(struct.pack("<Bq", _T_INT, v))
+        elif (1 << 63) <= v < (1 << 64):
+            out.append(struct.pack("<BQ", _T_U64, v))
+        else:
+            raise TypeError(
+                f"rpc value codec cannot marshal {v}: wider than 64 bits")
     elif isinstance(obj, (float, np.floating)):
         out.append(struct.pack("<Bd", _T_FLOAT, float(obj)))
     elif isinstance(obj, bytes):
@@ -281,6 +301,8 @@ def _unpack_value(r: _Reader, stubs: List[_ArrayStub]):
         return False
     if tag == _T_INT:
         return r.take("<q")[0]
+    if tag == _T_U64:
+        return r.take("<Q")[0]
     if tag == _T_FLOAT:
         return r.take("<d")[0]
     if tag == _T_BYTES:
@@ -366,6 +388,13 @@ class RpcConn:
         self.bytes_received = 0
         self.span_raw_bytes = 0    # pre-codec payload bytes, both ways
         self.span_wire_bytes = 0   # on-the-wire span bytes, both ways
+        # Distributed-tracing context (serve/trace.py): `trace_id` is
+        # stamped on the NEXT outgoing frame's header and consumed;
+        # `last_trace_id` is the most recent received frame's stamp
+        # (0 = no context) — the worker's dispatch reads it to tag the
+        # engine spans of the request the frame carried.
+        self.trace_id = 0
+        self.last_trace_id = 0
         if timeout is not None:
             self.set_timeout(timeout)
 
@@ -389,8 +418,10 @@ class RpcConn:
         body: List[bytes] = []
         spans: List[Tuple[np.ndarray, int]] = []
         _pack_value(obj, body, spans, self.codec)
-        frame = struct.pack("<IHH", RPC_MAGIC, RPC_PROTOCOL_VERSION,
-                            len(spans)) + b"".join(body)
+        trace_id, self.trace_id = self.trace_id, 0
+        frame = struct.pack(
+            "<IHHQ", RPC_MAGIC, RPC_PROTOCOL_VERSION, len(spans),
+            trace_id & 0xFFFFFFFFFFFFFFFF) + b"".join(body)
         chunks = [struct.pack("<Q", len(frame)), frame]
         chunks += [p for p, _ in spans]
         bufs, lens, n, keep = _as_iovec(chunks)
@@ -428,10 +459,15 @@ class RpcConn:
             raise RpcProtocolError(
                 f"bad frame magic {magic:#x} (expected {RPC_MAGIC:#x})")
         if version != RPC_PROTOCOL_VERSION:
+            # Version check runs BEFORE the v2 trace_id field is
+            # parsed: a v1 frame's header simply ends here, so skew is
+            # a clean structured error naming both versions — never a
+            # misparse of body bytes as a trace id.
             self.close()
             raise RpcProtocolError(
                 f"peer speaks rpc protocol v{version}, this side "
                 f"v{RPC_PROTOCOL_VERSION} — upgrade in lockstep")
+        (self.last_trace_id,) = r.take("<Q")
         stubs: List[_ArrayStub] = []
         try:
             obj = _unpack_value(r, stubs)
@@ -767,7 +803,8 @@ def handoff_from_wire(d: Dict[str, Any], now: float):
         chain=[bytes(c) for c in d["chain"]],
         k_pages=d["k_pages"], v_pages=d["v_pages"],
         block_size=int(d["block_size"]),
-        n_cached=int(d["n_cached"]))
+        n_cached=int(d["n_cached"]),
+        trace_id=int(d.get("trace_id") or 0))
 
 
 def handoff_to_wire(h, now: float) -> Dict[str, Any]:
@@ -781,6 +818,7 @@ def handoff_to_wire(h, now: float) -> Dict[str, Any]:
         "k_pages": np.asarray(h.k_pages),
         "v_pages": np.asarray(h.v_pages),
         "block_size": h.block_size, "n_cached": h.n_cached,
+        "trace_id": h.trace_id,
     }
 
 
@@ -798,6 +836,7 @@ def handoff_meta_to_wire(h, now: float) -> Dict[str, Any]:
         "chain": list(h.chain),
         "block_size": h.block_size, "n_cached": h.n_cached,
         "n_pages": h.n_pages,
+        "trace_id": h.trace_id,
     }
 
 
@@ -815,6 +854,7 @@ def handoff_meta_from_wire(d: Dict[str, Any], now: float) -> Dict[str, Any]:
         "block_size": int(d["block_size"]),
         "n_cached": int(d["n_cached"]),
         "n_pages": int(d["n_pages"]),
+        "trace_id": int(d.get("trace_id") or 0),
     }
 
 
@@ -898,11 +938,26 @@ class RemoteReplica:
     remote = True
 
     def __init__(self, handle: WorkerHandle, model_cfg, serve_cfg, *,
-                 seed: int, instance: str, clock=time.perf_counter):
+                 seed: int, instance: str, clock=time.perf_counter,
+                 trace=None):
         self._handle = handle
         self._conn = handle.conn
         self._clock = clock
         self.instance = instance
+        # Router-side trace recorder (serve/trace.RouterTrace, None =
+        # tracing off): placement RPCs record their wire time under
+        # the request's trace id.
+        self._trace = trace
+        # Worker-clock offset estimation (docs/observability.md
+        # "One timebase"): every heartbeat reply carries the worker's
+        # `now`; this side brackets the RPC with t0/t1 and estimates
+        # offset = worker_now - (t0+t1)/2 — the RTT-midpoint re-anchor
+        # of the PR 11 age discipline, made persistent. The sample
+        # with the smallest RTT seen so far wins (its midpoint bound
+        # is tightest), so the estimate survives heartbeat gaps and
+        # only ever improves.
+        self.clock_offset = 0.0       # worker clock - router clock
+        self.clock_rtt = float("inf")  # RTT of the winning sample
         ret = self._conn.call(
             "configure", model_cfg=model_cfg_to_wire(model_cfg),
             serve_cfg=serve_cfg_to_wire(serve_cfg), seed=int(seed),
@@ -922,7 +977,9 @@ class RemoteReplica:
 
     # -- beat plumbing ----------------------------------------------
 
-    def _absorb_beat(self, beat: Dict[str, Any]) -> None:
+    def _absorb_beat(self, beat: Dict[str, Any],
+                     t0: Optional[float] = None,
+                     t1: Optional[float] = None) -> None:
         now = self._clock()
         self._pending = bool(beat["pending"])
         self.allocator._free = int(beat["kv_blocks_free"])
@@ -930,11 +987,24 @@ class RemoteReplica:
         for erid, rd in beat["results"].items():
             self._results[int(erid)] = result_from_wire(rd, now)
         self.last_beat = now
+        # Offset sample: only from calls the caller bracketed (the
+        # cheap symmetric heartbeat — a step RPC's reply time includes
+        # the worker's compute, which would skew the midpoint).
+        if (t0 is not None and t1 is not None
+                and beat.get("now") is not None):
+            rtt = t1 - t0
+            if rtt < self.clock_rtt:
+                self.clock_rtt = rtt
+                self.clock_offset = (float(beat["now"])
+                                     - (t0 + t1) / 2.0)
 
     def heartbeat(self) -> None:
-        """Liveness probe + metrics scrape in one round trip; raises
-        :class:`RpcConnectionError` when the worker is gone."""
-        self._absorb_beat(self._conn.call("heartbeat"))
+        """Liveness probe + metrics scrape + clock-offset sample in
+        one round trip; raises :class:`RpcConnectionError` when the
+        worker is gone."""
+        t0 = self._clock()
+        beat = self._conn.call("heartbeat")
+        self._absorb_beat(beat, t0, self._clock())
 
     # -- the engine seam ---------------------------------------------
 
@@ -954,17 +1024,25 @@ class RemoteReplica:
 
     def submit(self, prompt, max_new_tokens=None, deadline=None,
                deadline_class: int = 0, prefill_only: bool = False,
-               chain=None) -> int:
+               chain=None, trace_id: int = 0) -> int:
         # Absolute deadlines are ROUTER-clock times; processes don't
         # share a perf_counter epoch, so the wire carries the time
         # REMAINING and the worker re-anchors onto its own clock.
         deadline_in = (None if deadline is None
                        else deadline - self._clock())
+        # The trace id rides the NEXT frame's v2 header (not the
+        # payload): the worker's dispatch reads it off the conn, so
+        # every placement verb propagates identity the same way.
+        self._conn.trace_id = trace_id
+        t0 = self._clock()
         erid = self._conn.call(
             "submit", prompt=[int(t) for t in prompt],
             max_new_tokens=max_new_tokens, deadline_in=deadline_in,
             deadline_class=deadline_class, prefill_only=prefill_only,
             chain=list(chain) if chain is not None else None)
+        if trace_id and self._trace is not None:
+            self._trace.span("rpc:submit", t0, self._clock() - t0,
+                             trace=trace_id, instance=self.instance)
         self._pending = True
         return int(erid)
 
@@ -1001,10 +1079,26 @@ class RemoteReplica:
         return handoff_from_wire(d, self._clock())
 
     def inject_prefilled(self, h) -> int:
+        # Tag the frame too: the handoff payload carries trace_id for
+        # the engine, the header keeps the wire-level convention
+        # uniform across placement verbs.
+        self._conn.trace_id = getattr(h, "trace_id", 0)
         erid = self._conn.call("inject_prefilled",
                                handoff_to_wire(h, self._clock()))
         self._pending = True
         return int(erid)
+
+    def export_trace(self) -> Dict[str, Any]:
+        """This worker's chrome-trace events + timebase anchor, with
+        the router's RTT-estimated clock offset stamped in (the merge
+        key ``bin/hvd-trace`` uses to re-anchor worker spans onto the
+        router clock)."""
+        d = self._conn.call("export_trace")
+        d["meta"]["instance"] = self.instance
+        d["meta"]["clock_offset"] = self.clock_offset
+        d["meta"]["clock_rtt"] = (None if self.clock_rtt == float("inf")
+                                  else self.clock_rtt)
+        return d
 
     def running_exportable(self) -> List[int]:
         return [int(r) for r in self._conn.call("running_exportable")]

@@ -313,7 +313,8 @@ class SpecDecoder:
         eng.metrics.record_spec_round(
             t0, t1 - t0, t2 - t1, n, eng.cfg.max_batch,
             proposed=proposed_total, accepted=accepted_total,
-            emitted=emitted_total)
+            emitted=emitted_total,
+            traces=[s.trace for s in active if s.trace])
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,391 @@
+"""Fleet trace merge: one Perfetto file, one timebase, one command.
+
+The library behind ``bin/hvd-trace``. A fleet run scatters its
+observability across files and clock epochs:
+
+* ``router.json`` / ``replica-*.json`` — chrome traces from
+  :meth:`ServeRouter.export_fleet_trace`, each with span ``ts`` in
+  microseconds since that PROCESS's ``started_at`` on that process's
+  ``perf_counter`` clock, plus a ``metadata`` anchor
+  (``started_at`` / ``clock_now`` / ``wall_now`` / ``clock_offset``).
+* ``flight-*.txt`` — native flight-recorder dumps (docs/
+  observability.md "Flight recorder"): ``t_us`` on CLOCK_MONOTONIC
+  (the Linux ``perf_counter`` epoch), with a ``mono_us``/``wall_us``
+  header pair.
+* ``timeline*.json`` — host timelines (``hvd.start_timeline``),
+  B/E/i/C events with no anchor metadata (they ride along on their
+  own timebase, each under its own pid, clearly labeled).
+
+:func:`merge` maps everything anchored onto ONE timebase — the
+router's wall clock, in microseconds — via each file's anchor pair
+and the router's RTT-estimated per-worker ``clock_offset``
+(rpc.py heartbeat midpoints). :func:`critical_path` then decomposes a
+request's ``router:e2e`` span into an exact partition (queue wait /
+rpc wire / prefill / handoff / decode / wait) whose rows sum to the
+end-to-end latency BY CONSTRUCTION — it is an interval attribution
+over the e2e window, not a sum of independently-measured pieces.
+:func:`straggler_summary` ranks processes by collective barrier wait;
+the rank everyone else waits on is the one that waits LEAST.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+#: Span-name priority for critical-path attribution, highest first:
+#: where compute and a control phase overlap, the window is charged to
+#: the compute (the control span covers it by definition). The last
+#: resort, uncovered time, reports as "wait".
+CRITICAL_PATH_PRIORITY = (
+    "prefill", "decode", "spec", "handoff", "rpc_wire", "queue_wait",
+)
+
+
+# ---------------------------------------------------------------------------
+# Loading
+# ---------------------------------------------------------------------------
+
+def _load_chrome_json(path: str) -> Optional[Dict[str, Any]]:
+    """Parse a chrome-trace file: the object form (``traceEvents`` +
+    ``metadata``) or the bare/unterminated array form the native
+    timeline writer streams (trailing comma, no closing bracket — the
+    format chrome://tracing itself tolerates)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        d = json.loads(text)
+    except json.JSONDecodeError:
+        # Streamed array: strip the trailing ",\n" and close it.
+        t = text.strip()
+        if t.startswith("["):
+            t = t.rstrip().rstrip(",") + "]"
+            try:
+                d = json.loads(t)
+            except json.JSONDecodeError:
+                return None
+        else:
+            return None
+    if isinstance(d, list):
+        return {"traceEvents": d, "metadata": {}}
+    if isinstance(d, dict) and "traceEvents" in d:
+        d.setdefault("metadata", {})
+        return d
+    return None
+
+
+_FLIGHT_HEADER = re.compile(
+    r"^# flight v1 pid=(\d+) mono_us=(\d+) wall_us=(\d+)")
+
+
+def _load_flight_dump(path: str) -> Optional[Dict[str, Any]]:
+    """Parse a native flight dump into instant events on the dump
+    process's WALL clock (the header's mono/wall pair maps each
+    monotonic ``t_us`` over)."""
+    with open(path) as f:
+        lines = f.read().splitlines()
+    if not lines:
+        return None
+    m = _FLIGHT_HEADER.match(lines[0])
+    if m is None:
+        return None
+    pid, mono_us, wall_us = (int(g) for g in m.groups())
+    events = []
+    for line in lines[1:]:
+        parts = line.split("\t")
+        if len(parts) != 5:
+            continue
+        seq, t_us, name, a0, a1 = parts
+        events.append({
+            "name": f"flight:{name}", "ph": "i", "s": "t",
+            "pid": pid, "tid": 0,
+            # Already wall µs after the header mapping.
+            "ts": wall_us + (int(t_us) - mono_us),
+            "args": {"seq": int(seq), "a0": int(a0), "a1": int(a1)},
+        })
+    return {"kind": "flight", "pid": pid, "events": events}
+
+
+def classify(path: str) -> str:
+    """'serve' (anchored chrome trace), 'flight', 'timeline'
+    (unanchored chrome trace), or 'skip'."""
+    base = os.path.basename(path)
+    if base.endswith(".txt"):
+        return "flight" if base.startswith("flight") else "skip"
+    if base.endswith(".json"):
+        d = _load_chrome_json(path)
+        if d is None:
+            return "skip"
+        return "serve" if d["metadata"].get("clock_now") else "timeline"
+    return "skip"
+
+
+def discover(target: str) -> List[str]:
+    """Files to merge: ``target`` itself, or — for a directory —
+    every ``*.json`` / ``flight-*.txt`` in it, sorted (router first so
+    pid 0 stays the router)."""
+    if os.path.isfile(target):
+        return [target]
+    out = []
+    for name in sorted(os.listdir(target)):
+        if name.endswith(".json") or (name.startswith("flight")
+                                      and name.endswith(".txt")):
+            out.append(os.path.join(target, name))
+    # Router file leads: its anchor defines the merged timebase.
+    out.sort(key=lambda p: (0 if os.path.basename(p) == "router.json"
+                            else 1, p))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Merging
+# ---------------------------------------------------------------------------
+
+def _wall_mapper(router_meta: Optional[Dict[str, Any]],
+                 meta: Dict[str, Any]):
+    """Returns f(ts_us) -> merged wall µs for one anchored file.
+
+    ``ts_us`` is microseconds since ``meta['started_at']`` on the
+    file's own clock. Own-clock absolute time re-anchors onto the
+    ROUTER clock via ``clock_offset`` (own − router, 0 for the router
+    itself), then onto wall time via the router's
+    ``(clock_now, wall_now)`` pair — one pair, so every file lands on
+    the SAME wall timebase even if their own wall clocks disagree.
+    Without a router file, the file's own pair anchors it."""
+    anchor = router_meta if router_meta is not None else meta
+    offset = float(meta.get("clock_offset") or 0.0)
+    started = float(meta["started_at"])
+    c_now = float(anchor["clock_now"])
+    w_now = float(anchor["wall_now"])
+
+    def to_wall_us(ts_us: float) -> float:
+        t_own = started + ts_us / 1e6          # own clock, seconds
+        t_router = t_own - offset              # router clock
+        return (w_now + (t_router - c_now)) * 1e6
+
+    return to_wall_us
+
+
+def merge(paths: List[str]) -> Dict[str, Any]:
+    """Merge trace files onto one timebase. Returns a chrome-trace
+    dict: every anchored event's ``ts`` is microseconds on the merged
+    (router-wall) timebase, normalized so the earliest event is 0;
+    each source file gets its own ``pid`` with a ``process_name``
+    metadata event naming it. Unanchored timelines ride along under
+    their own pid on their OWN timebase (flagged in the name — merging
+    can't invent an anchor that was never recorded)."""
+    router_meta = None
+    loaded: List[Tuple[str, str, Dict[str, Any]]] = []
+    for p in paths:
+        kind = classify(p)
+        if kind == "skip":
+            continue
+        if kind == "flight":
+            d = _load_flight_dump(p)
+            if d is not None:
+                loaded.append((p, kind, d))
+            continue
+        d = _load_chrome_json(p)
+        if d is None:
+            continue
+        if kind == "serve" and d["metadata"].get("kind") == "router" \
+                and router_meta is None:
+            router_meta = d["metadata"]
+        loaded.append((p, kind, d))
+
+    out_events: List[dict] = []
+    sources: List[Dict[str, Any]] = []
+    # Router wall anchor in µs: flight dumps are already wall µs on
+    # their own wall clock; with a router anchor present they line up
+    # directly (wall clocks of one host agree to NTP slop, and the
+    # flight pair was taken in the dump process itself).
+    next_pid = 0
+    for path, kind, d in loaded:
+        pid = next_pid
+        next_pid += 1
+        base = os.path.basename(path)
+        if kind == "flight":
+            label = f"flight {d['pid']} ({base})"
+            events = d["events"]
+            for e in events:
+                e = dict(e)
+                e["pid"] = pid
+                out_events.append(e)
+        elif kind == "serve":
+            meta = d["metadata"]
+            mk = meta.get("kind", "engine")
+            inst = meta.get("instance")
+            label = (f"router ({base})" if mk == "router"
+                     else f"replica {inst} ({base})")
+            to_wall = _wall_mapper(router_meta, meta)
+            for e in d["traceEvents"]:
+                e = dict(e)
+                e["ts"] = to_wall(float(e.get("ts", 0.0)))
+                e["pid"] = pid
+                out_events.append(e)
+        else:
+            label = f"timeline ({base}) [unanchored timebase]"
+            for e in d["traceEvents"]:
+                if e.get("ph") == "M":
+                    continue
+                e = dict(e)
+                e["pid"] = pid
+                out_events.append(e)
+        sources.append({"pid": pid, "path": path, "kind": kind,
+                        "name": label})
+
+    # Normalize: earliest ANCHORED event becomes ts 0 (µs stay µs).
+    anchored_pids = {s["pid"] for s in sources
+                     if s["kind"] in ("serve", "flight")}
+    anchored_ts = [e["ts"] for e in out_events
+                   if e["pid"] in anchored_pids]
+    t0 = min(anchored_ts) if anchored_ts else 0.0
+    for e in out_events:
+        if e["pid"] in anchored_pids:
+            e["ts"] = round(e["ts"] - t0, 1)
+    meta_events = [
+        {"name": "process_name", "ph": "M", "pid": s["pid"],
+         "args": {"name": s["name"]}}
+        for s in sources]
+    return {
+        "traceEvents": meta_events + out_events,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "merged_from": [s["path"] for s in sources],
+            "timebase": ("router wall clock, µs"
+                         if router_meta is not None
+                         else "per-file wall clock, µs"),
+            "t0_wall_us": t0,
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# Critical path
+# ---------------------------------------------------------------------------
+
+def _category(e: dict) -> Optional[str]:
+    name = e.get("name", "")
+    if name == "router:queue_wait":
+        return "queue_wait"
+    if name == "rpc:submit":
+        return "rpc_wire"
+    if name == "serve:prefill":
+        return "prefill"
+    if name == "router:handoff":
+        return "handoff"
+    if name == "serve:decode":
+        return "decode"
+    if name in ("serve:spec_draft", "serve:spec_verify"):
+        return "spec"
+    return None
+
+
+def _carries(e: dict, tid: int) -> bool:
+    args = e.get("args") or {}
+    if args.get("trace") == tid:
+        return True
+    return tid in (args.get("traces") or ())
+
+
+def trace_ids(events: List[dict]) -> List[int]:
+    """Trace ids with a completed ``router:e2e`` span, in end order."""
+    out = []
+    for e in events:
+        if e.get("name") == "router:e2e":
+            tid = (e.get("args") or {}).get("trace")
+            if tid:
+                out.append(tid)
+    return out
+
+
+def critical_path(events: List[dict], tid: int) -> Dict[str, Any]:
+    """Exact decomposition of trace ``tid``'s end-to-end window.
+
+    Collects every span carrying ``tid`` (or, for batched decode /
+    spec spans, listing it), clips to the ``router:e2e`` window, and
+    attributes each instant of the window to the highest-priority
+    covering category (:data:`CRITICAL_PATH_PRIORITY`); uncovered time
+    is ``wait``. Because this partitions the window, the per-category
+    microseconds sum EXACTLY to the e2e duration."""
+    e2e = None
+    for e in events:
+        if e.get("name") == "router:e2e" and _carries(e, tid):
+            e2e = e
+            break
+    if e2e is None:
+        raise KeyError(f"no router:e2e span for trace {tid:#x}")
+    w0 = float(e2e["ts"])
+    w1 = w0 + float(e2e.get("dur", 0.0))
+
+    spans: List[Tuple[float, float, str]] = []
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        cat = _category(e)
+        if cat is None or not _carries(e, tid):
+            continue
+        s0 = max(w0, float(e["ts"]))
+        s1 = min(w1, float(e["ts"]) + float(e.get("dur", 0.0)))
+        if s1 > s0:
+            spans.append((s0, s1, cat))
+
+    # Sweep the window's segment boundaries; charge each segment to
+    # its best-priority covering span.
+    cuts = sorted({w0, w1, *(s for s0, s1, _ in spans
+                             for s in (s0, s1))})
+    totals = {cat: 0.0 for cat in CRITICAL_PATH_PRIORITY}
+    totals["wait"] = 0.0
+    rank = {c: i for i, c in enumerate(CRITICAL_PATH_PRIORITY)}
+    for a, b in zip(cuts, cuts[1:]):
+        covering = [cat for s0, s1, cat in spans if s0 <= a and b <= s1]
+        if covering:
+            cat = min(covering, key=lambda c: rank[c])
+        else:
+            cat = "wait"
+        totals[cat] += b - a
+    return {
+        "trace": tid,
+        "rid": (e2e.get("args") or {}).get("rid"),
+        "e2e_us": round(w1 - w0, 1),
+        "breakdown_us": {k: round(v, 1) for k, v in totals.items()},
+    }
+
+
+# ---------------------------------------------------------------------------
+# Straggler attribution
+# ---------------------------------------------------------------------------
+
+def straggler_summary(events: List[dict]) -> List[Dict[str, Any]]:
+    """Per-pid collective barrier wait, ascending. The straggler is
+    the process that waits LEAST at the barrier — everyone else's
+    ``shm_barrier``/NEGOTIATE time is spent waiting for it. Sums 'X'
+    span durations and B/E pairs whose name carries the barrier or
+    negotiate markers; pids with none are omitted."""
+    per: Dict[int, float] = {}
+    open_b: Dict[Tuple[int, str, str], float] = {}
+    for e in events:
+        name = str(e.get("name", ""))
+        barrier = ("barrier" in name.lower()
+                   or name.startswith("NEGOTIATE"))
+        pid = int(e.get("pid", 0))
+        ph = e.get("ph")
+        if ph == "X" and barrier:
+            per[pid] = per.get(pid, 0.0) + float(e.get("dur", 0.0))
+        elif ph == "B" and barrier:
+            open_b[(pid, str(e.get("tid", "")), name)] = float(e["ts"])
+        elif ph == "E":
+            # The writer emits E with an empty name; close the newest
+            # open barrier span on this (pid, tid).
+            for key in sorted(open_b,
+                              key=lambda k: -open_b[k]):
+                if key[0] == pid and key[1] == str(e.get("tid", "")):
+                    per[pid] = per.get(pid, 0.0) + \
+                        (float(e["ts"]) - open_b.pop(key))
+                    break
+    return sorted(
+        ({"pid": pid, "barrier_wait_us": round(us, 1)}
+         for pid, us in per.items()),
+        key=lambda r: r["barrier_wait_us"])

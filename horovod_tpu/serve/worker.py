@@ -198,6 +198,11 @@ class ReplicaWorker:
             "snap": m.snapshot(),
             "ft": ft, "pt": pt,
             "results": results,
+            # This worker's clock at beat time: the router brackets
+            # the heartbeat RPC and estimates the cross-process clock
+            # offset from the RTT midpoint (docs/observability.md
+            # "One timebase").
+            "now": now,
         }
 
     def heartbeat(self):
@@ -226,7 +231,10 @@ class ReplicaWorker:
             deadline=deadline, deadline_class=int(deadline_class),
             prefill_only=bool(prefill_only),
             chain=[bytes(c) for c in chain] if chain is not None
-            else None)
+            else None,
+            # The distributed trace id rides the v2 frame header, not
+            # the payload — the recv loop parked it on the conn.
+            trace_id=self.conn.last_trace_id)
 
     def withdraw(self, rid):
         return self._require_engine().withdraw(int(rid))
@@ -251,6 +259,16 @@ class ReplicaWorker:
         eng = self._require_engine()
         return handoff_to_wire(eng.export_running(int(rid)),
                                self._clock())
+
+    def export_trace(self):
+        """This replica's chrome-trace events plus the timebase anchor
+        (``trace_metadata``) — the router's ``export_fleet_trace``
+        collects one of these per worker and stamps its RTT-estimated
+        clock offset into the metadata so ``bin/hvd-trace merge`` can
+        put every span on the router's clock."""
+        m = self._require_engine().metrics
+        return {"events": list(m._events),
+                "meta": m.trace_metadata(worker_pid=os.getpid())}
 
     def shutdown(self):
         if self._peer_lsock is not None:
@@ -463,6 +481,7 @@ class ReplicaWorker:
             "inject_prefilled": self.inject_prefilled,
             "running_exportable": self.running_exportable,
             "export_running": self.export_running,
+            "export_trace": self.export_trace,
             "shutdown": self.shutdown,
         }
         # Peer streams touch the same engine from their own threads,

@@ -1,0 +1,132 @@
+// Always-on control-plane flight recorder.
+//
+// The metrics registry (hvd/metrics.h) answers "how much"; the chrome
+// timeline answers "what happened" — but only if the process lives to
+// flush it. The flight recorder answers the postmortem question: a
+// fixed ring of the LAST control-plane events (lock engage/release,
+// membership churn, stall findings, peer death, autotune stages,
+// wire/algo verdict changes), cheap enough to leave on always, and
+// dumpable from a fatal-signal handler so a chaos kill or a wedged
+// lock leaves something readable behind in HOROVOD_FLIGHT_DIR.
+//
+// Design constraints (shared with metrics.h):
+//  * Lock-free writers: one relaxed fetch_add to claim a slot, then
+//    relaxed stores into all-atomic fields bracketed seqlock-style by
+//    a release store of the sequence number — readers detect and skip
+//    a slot that is mid-overwrite instead of blocking the writer.
+//  * Fixed identity: events are enum-indexed with a compile-time name
+//    table (flight.cc) pinned against the catalog in
+//    docs/observability.md by the flight-event-pins lint rule.
+//  * Async-signal-safe dump: DumpFd uses only write(2)/clock_gettime
+//    and stack formatting — no malloc, no iostream — so the fatal
+//    signal handler InstallAutoDump registers can call it.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+
+namespace hvd {
+
+// Dump/snapshot text layout version (bump on any format change) and
+// ring capacity. 4096 slots at ~1 event per coordination cycle keeps
+// minutes of history; the interesting events (churn, stalls, death)
+// are orders of magnitude rarer than the cycle summaries that pad the
+// ring out.
+constexpr int kFlightVersion = 1;
+constexpr int kFlightRingSlots = 4096;
+
+// Control-plane event ids. Order MUST match kFlightEventNames in
+// flight.cc (static_assert there) and every name must appear in the
+// docs/observability.md flight-recorder catalog — the
+// flight-event-pins lint rule enforces the lockstep, same discipline
+// as the metric rows.
+enum FlightEvent : int {
+  kFlightLockEngage = 0,   // a0 = locked ring slots
+  kFlightLockRelease,      // a0 = unlock reason (steady_lock.h), a1 = requeued
+  kFlightMembershipEpoch,  // a0 = new epoch, a1 = change reason (membership.h)
+  kFlightCycleSummary,     // a0 = responses fired, a1 = payload bytes
+  kFlightStallFinding,     // a0 = stalled tensors, a1 = worst age (s)
+  kFlightStallBreach,      // a0 = stalled tensors at the shutdown breach
+  kFlightPeerDeath,        // a0 = dead rank (or replica instance id)
+  kFlightAutotuneStage,    // a0 = fusion threshold (bytes), a1 = cycle (us)
+  kFlightWireVerdict,      // a0 = new wire codec, a1 = previous
+  kFlightAlgoVerdict,      // a0 = new collective algo, a1 = previous
+  kFlightRequeue,          // a0 = requests/sequences sent back to the queue
+  kFlightInternalError,    // a0 = origin tag (0 = HorovodInternalError)
+  kNumFlightEvents
+};
+
+// Name table (flight.cc).
+const char* FlightEventName(int i);
+
+class FlightRecorder {
+ public:
+  static FlightRecorder& Get();
+
+  // Process-wide switch, same contract as the metrics registry: off
+  // short-circuits the clock read and the slot claim, so the overhead
+  // guard's off arm measures the true baseline.
+  void SetEnabled(bool on) { enabled_.store(on, std::memory_order_relaxed); }
+  bool enabled() const { return enabled_.load(std::memory_order_relaxed); }
+
+  void Record(FlightEvent e, int64_t a0, int64_t a1);
+  void Clear();
+  int64_t count() const { return cursor_.load(std::memory_order_relaxed); }
+
+  // Text snapshot, oldest surviving event first:
+  //   "# flight v<N> pid=<pid> mono_us=<m> wall_us=<w>\n"
+  //   then one "seq\tt_us\tname\ta0\ta1\n" per event (t_us is
+  //   CLOCK_MONOTONIC microseconds — the same axis as Python's
+  //   time.monotonic(); the header pair maps it to wall time).
+  // Returns the byte count needed INCLUDING the NUL; copies at most
+  // len-1 bytes (size-probe protocol, like hvd_stalled_tensors).
+  int64_t SnapshotText(char* buf, int64_t len) const;
+
+  // Async-signal-safe render of the same text straight to fd.
+  void DumpFd(int fd) const;
+  // Open/truncate path (nullptr or "" = the InstallAutoDump path) and
+  // DumpFd into it. Returns 0, or -1 when no path resolves / open
+  // fails.
+  int DumpFile(const char* path) const;
+
+  // Arm the postmortem: resolve "<dir>/flight-<pid>.txt" and install
+  // fatal-signal handlers (SEGV/ABRT/BUS/FPE/ILL/TERM) that dump the
+  // ring there, then restore the default disposition and re-raise.
+  // Called automatically at library load when HOROVOD_FLIGHT_DIR is
+  // set. Returns 0, or -1 when the path does not fit.
+  int InstallAutoDump(const char* dir);
+  // Resolved auto-dump path ("" until InstallAutoDump succeeds).
+  const char* autodump_path() const { return autodump_path_; }
+
+ private:
+  // Seqlock-lite slot: a writer claims seq via the cursor, marks the
+  // slot in-progress (seq = -1), stores the payload, then publishes
+  // seq with release. Readers skip any slot whose seq doesn't match
+  // the expected value before AND after reading the payload. All
+  // fields atomic so concurrent overwrite is a skipped entry, never a
+  // data race.
+  struct Slot {
+    std::atomic<int64_t> seq{-1};
+    std::atomic<int64_t> t_us{0};
+    std::atomic<int64_t> event{0};
+    std::atomic<int64_t> a0{0};
+    std::atomic<int64_t> a1{0};
+  };
+
+  std::atomic<bool> enabled_{true};
+  std::atomic<int64_t> cursor_{0};
+  Slot slots_[kFlightRingSlots];
+  char autodump_path_[512] = {0};
+};
+
+// Hot-path shorthand.
+inline void FlightRecord(FlightEvent e, int64_t a0 = 0, int64_t a1 = 0) {
+  FlightRecorder::Get().Record(e, a0, a1);
+}
+
+// Best-effort postmortem for in-process fatal paths (stall-shutdown
+// breach, HorovodInternalError): dump to the installed auto-dump path;
+// no-op when HOROVOD_FLIGHT_DIR was never pointed anywhere.
+void FlightAutoDump();
+
+}  // namespace hvd

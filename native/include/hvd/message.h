@@ -21,6 +21,11 @@ namespace hvd {
 // here so a bump is one edit — and guarded by tests/test_wire_abi.py,
 // which asserts the Python side expects the same numbers (a native
 // bump can't silently skew the shim).
+// ABI v15 (wire formats unchanged): flight recorder (hvd/flight.h) —
+// the hvd_flight_* surface (record / snapshot / dump / install /
+// num_events / event_name / count / clear / set_enabled / enabled)
+// over the always-on control-plane event ring, auto-armed for
+// fatal-signal dump when HOROVOD_FLIGHT_DIR is set at library load.
 // ABI v14 (wire formats unchanged — Response already serializes
 // collective_algo for every response type): alltoall schedule
 // families (hvd/schedule.h AlltoallAlgo) — the HOROVOD_ALLTOALL_ALGO
@@ -71,7 +76,7 @@ namespace hvd {
 // hvd_stalled_tensors, and hvd_start_timeline returning an error code.
 constexpr int kWireVersionRequestList = 3;
 constexpr int kWireVersionResponseList = 7;
-constexpr int kAbiVersion = 14;
+constexpr int kAbiVersion = 15;
 
 enum class RequestType : uint8_t {
   ALLREDUCE = 0,

@@ -1,0 +1,278 @@
+#include "hvd/flight.h"
+
+#include <fcntl.h>
+#include <signal.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "hvd/env.h"
+
+namespace hvd {
+
+namespace {
+
+// Names are lowercase tokens (units live in the doc catalog). Order
+// MUST match FlightEvent in flight.h — the static_assert pins the
+// length, and the flight-event-pins lint rule pins every name against
+// the docs/observability.md catalog row.
+constexpr const char* kFlightEventNames[] = {
+    "lock_engage",
+    "lock_release",
+    "membership_epoch",
+    "cycle_summary",
+    "stall_finding",
+    "stall_breach",
+    "peer_death",
+    "autotune_stage",
+    "wire_verdict",
+    "algo_verdict",
+    "requeue",
+    "internal_error",
+};
+
+static_assert(sizeof(kFlightEventNames) / sizeof(kFlightEventNames[0]) ==
+                  kNumFlightEvents,
+              "flight event name table out of sync with FlightEvent");
+
+int64_t MonoUs() {
+  // CLOCK_MONOTONIC, not steady_clock: clock_gettime is async-signal-
+  // safe (the dump handler timestamps its header with the same call)
+  // and shares an axis with Python's time.monotonic(), the membership
+  // plane's flap-decay convention.
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000000 + ts.tv_nsec / 1000;
+}
+
+int64_t WallUs() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000000 + ts.tv_nsec / 1000;
+}
+
+// Async-signal-safe decimal formatter: writes v into buf, returns the
+// byte count. buf must hold >= 21 bytes.
+int FormatInt(int64_t v, char* buf) {
+  char tmp[21];
+  int n = 0;
+  uint64_t u;
+  if (v < 0) {
+    buf[0] = '-';
+    u = static_cast<uint64_t>(-(v + 1)) + 1;  // INT64_MIN-safe
+  } else {
+    u = static_cast<uint64_t>(v);
+  }
+  do {
+    tmp[n++] = static_cast<char>('0' + u % 10);
+    u /= 10;
+  } while (u != 0);
+  int off = v < 0 ? 1 : 0;
+  for (int i = 0; i < n; ++i) buf[off + i] = tmp[n - 1 - i];
+  return off + n;
+}
+
+void WriteAll(int fd, const char* buf, size_t len) {
+  size_t done = 0;
+  while (done < len) {
+    ssize_t w = write(fd, buf + done, len - done);
+    if (w <= 0) {
+      if (w < 0 && errno == EINTR) continue;
+      return;  // best-effort: a postmortem must never loop forever
+    }
+    done += static_cast<size_t>(w);
+  }
+}
+
+// One torn-tolerant read of slot `want` out of the ring. Returns false
+// when the slot is mid-overwrite (skip it).
+bool ReadSlot(const std::atomic<int64_t>& seq_field,
+              const std::atomic<int64_t>& t_field,
+              const std::atomic<int64_t>& e_field,
+              const std::atomic<int64_t>& a0_field,
+              const std::atomic<int64_t>& a1_field, int64_t want,
+              int64_t out[4]) {
+  if (seq_field.load(std::memory_order_acquire) != want) return false;
+  out[0] = t_field.load(std::memory_order_relaxed);
+  out[1] = e_field.load(std::memory_order_relaxed);
+  out[2] = a0_field.load(std::memory_order_relaxed);
+  out[3] = a1_field.load(std::memory_order_relaxed);
+  std::atomic_thread_fence(std::memory_order_acquire);
+  return seq_field.load(std::memory_order_relaxed) == want;
+}
+
+// The signal half lives outside the class so the handler is a plain
+// function pointer with no captures.
+const int kFatalSignals[] = {SIGSEGV, SIGABRT, SIGBUS, SIGFPE, SIGILL,
+                             SIGTERM};
+
+void FlightSignalHandler(int sig) {
+  FlightRecorder::Get().DumpFile(nullptr);
+  // Restore the default disposition and re-raise so the process dies
+  // with the signal's normal semantics (core, exit code 128+sig) —
+  // the recorder observes the crash, it never swallows it.
+  signal(sig, SIG_DFL);
+  raise(sig);
+}
+
+}  // namespace
+
+const char* FlightEventName(int i) {
+  return i >= 0 && i < kNumFlightEvents ? kFlightEventNames[i] : "";
+}
+
+FlightRecorder& FlightRecorder::Get() {
+  // Leaked singleton (metrics.cc discipline): instrumented threads and
+  // the signal handler may record/dump during static teardown.
+  static FlightRecorder* rec = new FlightRecorder();
+  return *rec;
+}
+
+void FlightRecorder::Record(FlightEvent e, int64_t a0, int64_t a1) {
+  if (!enabled()) return;
+  const int64_t seq = cursor_.fetch_add(1, std::memory_order_relaxed);
+  Slot& s = slots_[seq % kFlightRingSlots];
+  s.seq.store(-1, std::memory_order_release);  // mark mid-write
+  s.t_us.store(MonoUs(), std::memory_order_relaxed);
+  s.event.store(e, std::memory_order_relaxed);
+  s.a0.store(a0, std::memory_order_relaxed);
+  s.a1.store(a1, std::memory_order_relaxed);
+  s.seq.store(seq, std::memory_order_release);
+}
+
+void FlightRecorder::Clear() {
+  for (auto& s : slots_) s.seq.store(-1, std::memory_order_relaxed);
+  cursor_.store(0, std::memory_order_relaxed);
+}
+
+int64_t FlightRecorder::SnapshotText(char* buf, int64_t len) const {
+  std::string out;
+  out += "# flight v";
+  out += std::to_string(kFlightVersion);
+  out += " pid=";
+  out += std::to_string(static_cast<long long>(getpid()));
+  out += " mono_us=";
+  out += std::to_string(static_cast<long long>(MonoUs()));
+  out += " wall_us=";
+  out += std::to_string(static_cast<long long>(WallUs()));
+  out += '\n';
+  const int64_t end = cursor_.load(std::memory_order_acquire);
+  const int64_t start = end > kFlightRingSlots ? end - kFlightRingSlots : 0;
+  for (int64_t seq = start; seq < end; ++seq) {
+    const Slot& s = slots_[seq % kFlightRingSlots];
+    int64_t f[4];
+    if (!ReadSlot(s.seq, s.t_us, s.event, s.a0, s.a1, seq, f)) continue;
+    out += std::to_string(static_cast<long long>(seq));
+    out += '\t';
+    out += std::to_string(static_cast<long long>(f[0]));
+    out += '\t';
+    out += FlightEventName(static_cast<int>(f[1]));
+    out += '\t';
+    out += std::to_string(static_cast<long long>(f[2]));
+    out += '\t';
+    out += std::to_string(static_cast<long long>(f[3]));
+    out += '\n';
+  }
+  if (buf != nullptr && len > 0) {
+    std::strncpy(buf, out.c_str(), len - 1);
+    buf[len - 1] = '\0';
+  }
+  return static_cast<int64_t>(out.size()) + 1;
+}
+
+void FlightRecorder::DumpFd(int fd) const {
+  // Hand-rolled formatting throughout: this runs inside fatal-signal
+  // handlers, where malloc/iostream/std::string are off the table.
+  char line[160];
+  int n = 0;
+  auto put_str = [&](const char* s) {
+    while (*s && n < static_cast<int>(sizeof(line)) - 1) line[n++] = *s++;
+  };
+  auto put_int = [&](int64_t v) {
+    if (n + 22 < static_cast<int>(sizeof(line))) n += FormatInt(v, line + n);
+  };
+  put_str("# flight v");
+  put_int(kFlightVersion);
+  put_str(" pid=");
+  put_int(getpid());
+  put_str(" mono_us=");
+  put_int(MonoUs());
+  put_str(" wall_us=");
+  put_int(WallUs());
+  put_str("\n");
+  WriteAll(fd, line, n);
+  const int64_t end = cursor_.load(std::memory_order_acquire);
+  const int64_t start = end > kFlightRingSlots ? end - kFlightRingSlots : 0;
+  for (int64_t seq = start; seq < end; ++seq) {
+    const Slot& s = slots_[seq % kFlightRingSlots];
+    int64_t f[4];
+    if (!ReadSlot(s.seq, s.t_us, s.event, s.a0, s.a1, seq, f)) continue;
+    n = 0;
+    put_int(seq);
+    put_str("\t");
+    put_int(f[0]);
+    put_str("\t");
+    put_str(FlightEventName(static_cast<int>(f[1])));
+    put_str("\t");
+    put_int(f[2]);
+    put_str("\t");
+    put_int(f[3]);
+    put_str("\n");
+    WriteAll(fd, line, n);
+  }
+}
+
+int FlightRecorder::DumpFile(const char* path) const {
+  if (path == nullptr || *path == '\0') path = autodump_path_;
+  if (*path == '\0') return -1;
+  const int fd = open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return -1;
+  DumpFd(fd);
+  close(fd);
+  return 0;
+}
+
+int FlightRecorder::InstallAutoDump(const char* dir) {
+  if (dir == nullptr || *dir == '\0') return -1;
+  const int n =
+      std::snprintf(autodump_path_, sizeof(autodump_path_),
+                    "%s/flight-%lld.txt", dir,
+                    static_cast<long long>(getpid()));
+  if (n <= 0 || n >= static_cast<int>(sizeof(autodump_path_))) {
+    autodump_path_[0] = '\0';
+    return -1;
+  }
+  struct sigaction sa;
+  std::memset(&sa, 0, sizeof(sa));
+  sa.sa_handler = FlightSignalHandler;
+  sigemptyset(&sa.sa_mask);
+  // SA_RESETHAND would also work, but an explicit SIG_DFL + raise in
+  // the handler keeps the re-raise visible in one place.
+  for (int sig : kFatalSignals) sigaction(sig, &sa, nullptr);
+  return 0;
+}
+
+void FlightAutoDump() { FlightRecorder::Get().DumpFile(nullptr); }
+
+namespace {
+
+// Always-on arming: any process that loads the core with
+// HOROVOD_FLIGHT_DIR set (training rank, serve worker, router — the
+// router never calls hvd_init but still loads the library for the
+// membership plane) gets the fatal-signal postmortem without opting
+// in per call site.
+struct FlightEnvArm {
+  FlightEnvArm() {
+    if (const char* d = EnvStr("HOROVOD_FLIGHT_DIR"))
+      FlightRecorder::Get().InstallAutoDump(d);
+  }
+};
+FlightEnvArm g_flight_env_arm;
+
+}  // namespace
+
+}  // namespace hvd

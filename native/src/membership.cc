@@ -6,6 +6,7 @@
 #include <cmath>
 
 #include "hvd/env.h"
+#include "hvd/flight.h"
 #include "hvd/logging.h"
 #include "hvd/metrics.h"
 
@@ -47,6 +48,7 @@ void MembershipPlane::Reset(int64_t external_epoch, int size) {
     fences = fences_;
   }
   MetricAdd(kCtrMembershipChanges);
+  FlightRecord(kFlightMembershipEpoch, epoch, kMemberReset);
   for (auto& f : fences) f.fn(kMemberReset, epoch);
 }
 
@@ -70,6 +72,9 @@ int64_t MembershipPlane::Advance(int reason, int rank) {
     fences = fences_;
   }
   MetricAdd(kCtrMembershipChanges);
+  FlightRecord(kFlightMembershipEpoch, epoch, reason);
+  if (reason == kMemberDeadPeer && rank >= 0)
+    FlightRecord(kFlightPeerDeath, rank);
   for (auto& f : fences) f.fn(reason, epoch);
   return epoch;
 }

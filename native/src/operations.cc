@@ -31,6 +31,7 @@
 #include "hvd/common.h"
 #include "hvd/controller.h"
 #include "hvd/env.h"
+#include "hvd/flight.h"
 #include "hvd/fusion_buffer.h"
 #include "hvd/logging.h"
 #include "hvd/membership.h"
@@ -495,17 +496,25 @@ bool MaybeAutotuneRank0(GlobalState& st, int64_t bytes, double now_secs) {
     tuned_depth = st.controller->shm_segment_depth();
   }
   if (st.param_manager.wire_tunable()) {
+    const int prev_wire = st.controller->wire_codec();
     st.controller->SetWireCodec(st.param_manager.wire_codec());
     tuned_wire = st.controller->wire_codec();
+    if (tuned_wire != prev_wire)
+      FlightRecord(hvd::kFlightWireVerdict, tuned_wire, prev_wire);
   }
   if (st.param_manager.algo_tunable()) {
+    const int prev_algo = st.controller->collective_algo();
     st.controller->SetCollectiveAlgo(st.param_manager.collective_algo());
     tuned_algo = st.controller->collective_algo();
+    if (tuned_algo != prev_algo)
+      FlightRecord(hvd::kFlightAlgoVerdict, tuned_algo, prev_algo);
   }
   st.controller->StageTunedParams(
       st.param_manager.fusion_threshold(), st.param_manager.cycle_time_ms(),
       cat(PM::kCatHier), cat(PM::kCatCache), cat(PM::kCatShm), tuned_threads,
       tuned_depth, tuned_wire, tuned_algo);
+  FlightRecord(hvd::kFlightAutotuneStage, st.param_manager.fusion_threshold(),
+               static_cast<int64_t>(st.param_manager.cycle_time_ms() * 1000));
   return true;
 }
 
@@ -646,14 +655,22 @@ void BackgroundThreadLoop(GlobalState& st) {
       // tuned default here keeps this rank's introspected value — and
       // any "follow the default" requests it originates as a future
       // coordinator — truthful.
-      if (list.tuned_wire_codec >= 0)
+      if (list.tuned_wire_codec >= 0 &&
+          list.tuned_wire_codec != st.controller->wire_codec()) {
+        FlightRecord(kFlightWireVerdict, list.tuned_wire_codec,
+                     st.controller->wire_codec());
         st.controller->SetWireCodec(list.tuned_wire_codec);
+      }
       // Algorithm agreement per response is already guaranteed (the
       // coordinator resolves it into each Response); as with the wire
       // codec, applying the tuned force here keeps this rank's
       // introspected value truthful.
-      if (list.tuned_collective_algo >= 0)
+      if (list.tuned_collective_algo >= 0 &&
+          list.tuned_collective_algo != st.controller->collective_algo()) {
+        FlightRecord(kFlightAlgoVerdict, list.tuned_collective_algo,
+                     st.controller->collective_algo());
         st.controller->SetCollectiveAlgo(list.tuned_collective_algo);
+      }
     }
     for (const auto& resp : list.responses) PerformOperation(st, resp);
     if (list.shutdown) break;
@@ -675,6 +692,8 @@ void BackgroundThreadLoop(GlobalState& st) {
     if (!empty_cycle) {
       int64_t bytes = 0;
       for (const auto& r : list.responses) bytes += r.TotalByteSize();
+      FlightRecord(kFlightCycleSummary,
+                   static_cast<int64_t>(list.responses.size()), bytes);
       MaybeAutotuneRank0(st, bytes,
                          std::chrono::duration<double>(
                              std::chrono::steady_clock::now() - loop_epoch)
@@ -1112,6 +1131,15 @@ void hvd_shutdown() {
   st.initialized.store(false);
 }
 
+// v15 (wire formats unchanged): flight recorder (hvd/flight.h) — the
+// hvd_flight_* surface (record / snapshot / dump / install /
+// num_events / event_name / count / clear / set_enabled / enabled)
+// over the always-on control-plane event ring, armed for fatal-signal
+// auto-dump by HOROVOD_FLIGHT_DIR at library load.
+// v14 (wire formats unchanged): alltoall schedule families — the
+// HOROVOD_ALLTOALL_ALGO knob with the hvd_alltoall_* accessors and
+// probes, and the Bruck table selected by the measured cost model;
+// metrics v9 adds alltoall_measured_selects_total.
 // v13 (wire formats unchanged): persistent locked data plane — the
 // HOROVOD_STEADY_PERSISTENT knob (param field 16) with the
 // hvd_steady_persistent accessor and the hvd_tcp_prepost_buffers
@@ -1451,6 +1479,46 @@ int hvd_stalled_tensors(char* buf, int len) {
     buf[len - 1] = '\0';
   }
   return static_cast<int>(out.size()) + 1;
+}
+
+// ---------------------------------------------------------------------------
+// Flight recorder (hvd/flight.h): always-on control-plane event ring,
+// dumped as a postmortem by fatal-signal handlers / the stall-breach
+// path / HorovodInternalError. Consumed by horovod_tpu/metrics.py
+// (hvd.flight_events()) and merged by bin/hvd-trace.
+// ---------------------------------------------------------------------------
+
+void hvd_flight_record(int event, long long a0, long long a1) {
+  if (event < 0 || event >= hvd::kNumFlightEvents) return;
+  hvd::FlightRecord(static_cast<hvd::FlightEvent>(event), a0, a1);
+}
+
+// Size-probe text protocol (hvd_stalled_tensors discipline): returns
+// the byte count needed INCLUDING the NUL, copies at most len-1.
+long long hvd_flight_snapshot(char* buf, long long len) {
+  return hvd::FlightRecorder::Get().SnapshotText(buf, len);
+}
+
+// path == NULL/"" dumps to the HOROVOD_FLIGHT_DIR auto-dump path.
+int hvd_flight_dump(const char* path) {
+  return hvd::FlightRecorder::Get().DumpFile(path);
+}
+
+int hvd_flight_install(const char* dir) {
+  return hvd::FlightRecorder::Get().InstallAutoDump(dir);
+}
+
+int hvd_flight_num_events() { return hvd::kNumFlightEvents; }
+const char* hvd_flight_event_name(int i) { return hvd::FlightEventName(i); }
+long long hvd_flight_count() {
+  return hvd::FlightRecorder::Get().count();
+}
+void hvd_flight_clear() { hvd::FlightRecorder::Get().Clear(); }
+void hvd_flight_set_enabled(int on) {
+  hvd::FlightRecorder::Get().SetEnabled(on != 0);
+}
+int hvd_flight_enabled() {
+  return hvd::FlightRecorder::Get().enabled() ? 1 : 0;
 }
 
 // Direct host-kernel entry points: the dtype/op matrix is verified

@@ -3,6 +3,7 @@
 #include <algorithm>
 #include <sstream>
 
+#include "hvd/flight.h"
 #include "hvd/logging.h"
 #include "hvd/metrics.h"
 
@@ -73,6 +74,7 @@ bool StallInspector::CheckForStalledTensors(int global_size) {
   bool should_shutdown = false;
   std::ostringstream warn;
   int stalled = 0;
+  double worst_age = 0.0;
   for (const auto& f : findings) {
     std::ostringstream missing;
     for (size_t i = 0; i < f.missing_ranks.size(); ++i)
@@ -81,16 +83,27 @@ bool StallInspector::CheckForStalledTensors(int global_size) {
       warn << "\n  " << f.name << " (" << static_cast<int>(f.age_secs)
            << "s, missing ranks: [" << missing.str() << "])";
     }
+    worst_age = std::max(worst_age, f.age_secs);
     if (shutdown_secs_ > 0 && f.age_secs > shutdown_secs_)
       should_shutdown = true;
   }
   if (stalled > 0) {
     MetricAdd(kCtrStallEvents);
+    FlightRecord(kFlightStallFinding, stalled,
+                 static_cast<int64_t>(worst_age));
     LOG_WARNING << "One or more tensors were submitted to be reduced/gathered "
                 << "but some ranks have not yet submitted them (" << stalled
                 << " stalled):" << warn.str()
                 << "\nThis typically indicates diverged control flow "
                 << "across ranks.";
+  }
+  if (should_shutdown) {
+    // The job is about to tear itself down; make sure the evidence
+    // (the findings trail above, plus whatever control-plane events
+    // led here) survives the shutdown.
+    FlightRecord(kFlightStallBreach, stalled,
+                 static_cast<int64_t>(worst_age));
+    FlightAutoDump();
   }
   return should_shutdown;
 }

@@ -15,6 +15,7 @@
 #include <cstring>
 
 #include "hvd/controller.h"
+#include "hvd/flight.h"
 #include "hvd/logging.h"
 #include "hvd/metrics.h"
 #include "hvd/schedule.h"
@@ -247,6 +248,7 @@ void Controller::EngageLock(const std::vector<Response>& ring) {
   }
   lock_engaged_.store(true, std::memory_order_relaxed);
   MetricAdd(kCtrLocks);
+  FlightRecord(kFlightLockEngage, static_cast<int64_t>(ring.size()));
   LOG_DEBUG << "steady-state lock engaged: ring of " << ring.size()
             << " fused response(s)";
 }
@@ -291,11 +293,14 @@ void Controller::UnlockNow(int reason) {
   lock_inline_ok_.clear();
   lock_inline_bytes_.clear();
   lock_engaged_.store(false, std::memory_order_relaxed);
+  const int64_t n_requeued = static_cast<int64_t>(requeue.size());
   if (!requeue.empty() && deps_.tensor_queue != nullptr)
     deps_.tensor_queue->AddToTensorQueue({}, std::move(requeue));
   MetricAdd(kCtrUnlocks);
   if (reason >= 0 && reason < kNumUnlockReasons)
     MetricAdd(kUnlockReasonCounters[reason]);
+  FlightRecord(kFlightLockRelease, reason, n_requeued);
+  if (n_requeued > 0) FlightRecord(kFlightRequeue, n_requeued);
   LOG_DEBUG << "steady-state lock released (reason " << reason << ")";
 }
 

@@ -1047,6 +1047,19 @@ def main():
         p1, p2 = os.path.join(d, "t1.json"), os.path.join(d, "t2.json")
         hvd.start_timeline(p1)
         hvd.allreduce(np.ones(8, np.float32), name="tl.first")
+        # The registry-fed counter tracks are flushed by the
+        # BACKGROUND cycle thread, not the allreduce that returned —
+        # restarting immediately races its next flush and flakes the
+        # counter assertion below. Wait for the evidence itself: a
+        # counter event in the file is the "flushed" signal (bounded —
+        # the cycle loop ticks continuously while the timeline runs).
+        import time as _t
+        deadline = _t.monotonic() + 30.0
+        while _t.monotonic() < deadline:
+            raw1 = open(p1).read()
+            if '"ph": "C"' in raw1 and "queue_depth" in raw1:
+                break
+            _t.sleep(0.02)
         hvd.start_timeline(p2)  # restart onto a NEW path while running
         hvd.allreduce(np.ones(8, np.float32), name="tl.second")
         hvd.stop_timeline()
@@ -2131,6 +2144,60 @@ def main():
             assert snap["worker_deaths"] >= 1, snap
             router.close()
         hvd.allreduce(np.ones(4, np.float32), name="mig.exit")
+
+    elif scenario == "flight_churn":
+        # Flight recorder concurrency (ISSUE 20): Python threads hammer
+        # Record() into the seqlock-lite ring while another thread
+        # loops flight_events() snapshots and a third dumps SnapshotText
+        # to disk, all over live allreduce traffic feeding the ring its
+        # native cycle summaries. The ring's claim-then-publish slot
+        # protocol (readers skip mid-overwrite slots) is exactly the
+        # pattern tsan must prove is synchronization, not luck.
+        import tempfile
+        import threading
+
+        from horovod_tpu.common import basics
+        from horovod_tpu.metrics import (flight_clear, flight_dump,
+                                         flight_events, flight_record)
+
+        flight_clear()
+        stop = threading.Event()
+
+        def _writer(tag):
+            i = 0
+            while not stop.is_set():
+                flight_record(basics.FLIGHT_REQUEUE, i, tag)
+                i += 1
+
+        def _reader():
+            while not stop.is_set():
+                evs = flight_events()
+                for e in evs:
+                    assert e["event"], e  # every survivor slot coherent
+
+        def _dumper(path):
+            while not stop.is_set():
+                assert flight_dump(path)
+
+        dump_path = os.path.join(tempfile.mkdtemp(), f"flight-{r}.txt")
+        threads = ([threading.Thread(target=_writer, args=(t,))
+                    for t in range(2)]
+                   + [threading.Thread(target=_reader),
+                      threading.Thread(target=_dumper, args=(dump_path,))])
+        for t in threads:
+            t.start()
+        for i in range(20):
+            hvd.allreduce(np.ones(1 << 14, np.float32), name=f"fl.{i % 4}")
+        stop.set()
+        for t in threads:
+            t.join()
+        # More events recorded than slots: the ring wrapped under load.
+        evs = flight_events()
+        assert 0 < len(evs) <= 4096, len(evs)
+        assert any(e["event"] == "requeue" for e in evs)
+        with open(dump_path) as f:
+            head = f.readline()
+        assert head.startswith("# flight v1 pid="), head
 
     else:
         raise SystemExit(f"unknown scenario {scenario}")

@@ -439,3 +439,22 @@ def test_find_regressions_migration_key_directions():
                       "serve_migration_bytes_saved_pct": 50.0,
                       "serve_migration_direct_count": 96.0}}
     assert bench.find_regressions(prev, cur2) == {}
+
+
+def test_find_regressions_trace_observability_keys_ungated():
+    """ISSUE 20 satellite: the observability-tax keys are trajectory
+    keys — `serve_trace_overhead_pct` swinging up (or down: LESS
+    overhead must never read as a higher-is-better drop) and
+    `flight_dump_ms` multiplying must trip nothing. `_dump_ms` must
+    stay in UNGATED_SUFFIXES or the `_ms` suffix would latency-gate
+    it."""
+    prev = {"extra": {"serve_trace_overhead_pct": 1.5,
+                      "flight_dump_ms": 0.4}}
+    cur = {"extra": {"serve_trace_overhead_pct": 0.2,   # improvement
+                     "flight_dump_ms": 4.0}}            # 10x rise
+    assert bench.find_regressions(prev, cur) == {}
+    cur2 = {"extra": {"serve_trace_overhead_pct": 30.0,
+                      "flight_dump_ms": 0.1}}
+    assert bench.find_regressions(prev, cur2) == {}
+    assert "_dump_ms" in bench.UNGATED_SUFFIXES
+    assert "_overhead_pct" in bench.UNGATED_SUFFIXES

@@ -1,0 +1,165 @@
+"""Flight recorder (``native/include/hvd/flight.h``, ISSUE 20): the
+always-on control-plane event ring and its postmortem dump. Pins the
+Python-plane ``FLIGHT_*`` ids two-sidedly against the loaded library's
+name table (the same discipline as ``test_metrics_abi.py``), unit-tests
+the ring (ordering, wrap, seqlock-survivor coherence, snapshot/dump
+format), and proves the failover acceptance: a SIGKILLed fleet worker
+leaves behind a ROUTER-side dump whose tail records the peer death and
+the requeues.
+"""
+
+import os
+import re
+import threading
+
+import pytest
+
+from horovod_tpu.common import basics
+from horovod_tpu.metrics import (
+    _parse_flight_header,
+    flight_clear,
+    flight_dump,
+    flight_events,
+    flight_record,
+)
+
+HEADER = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native", "include", "hvd", "flight.h")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ring():
+    flight_clear()
+    yield
+    flight_clear()
+
+
+# ---------------------------------------------------------------------------
+# identity pins
+# ---------------------------------------------------------------------------
+
+def test_python_flight_ids_match_native_name_table():
+    """basics.FLIGHT_* are positions into the native name table; a
+    drifted id would record one event while believing it recorded
+    another (also linted statically by flight-event-pins)."""
+    lib = basics.get_lib()
+    n = lib.hvd_flight_num_events()
+    assert n >= 12
+    for const, want in (("FLIGHT_PEER_DEATH", "peer_death"),
+                        ("FLIGHT_REQUEUE", "requeue"),
+                        ("FLIGHT_INTERNAL_ERROR", "internal_error")):
+        idx = getattr(basics, const)
+        assert 0 <= idx < n, (const, idx, n)
+        assert lib.hvd_flight_event_name(idx).decode() == want, const
+
+
+def test_native_name_table_matches_header_enum():
+    """Loaded-library name table vs the header's enum idents — the
+    runtime side of the static_assert/lint lockstep."""
+    lib = basics.get_lib()
+    src = open(HEADER).read()
+    body = src.split("enum FlightEvent", 1)[1]
+    body = body[:body.index("};")]
+    idents = [m.group(1) for m in
+              re.finditer(r"^\s*(kFlight[A-Za-z0-9]+)\s*(?:=\s*\d+\s*)?,",
+                          body, re.MULTILINE)]
+    assert len(idents) == lib.hvd_flight_num_events()
+    for i, ident in enumerate(idents):
+        snake = re.sub(r"(?<!^)(?=[A-Z])", "_", ident[len("kFlight"):]).lower()
+        assert lib.hvd_flight_event_name(i).decode() == snake, (i, ident)
+    # Out-of-range probes answer empty, never crash.
+    assert lib.hvd_flight_event_name(-1).decode() == ""
+    assert lib.hvd_flight_event_name(10_000).decode() == ""
+
+
+# ---------------------------------------------------------------------------
+# ring semantics
+# ---------------------------------------------------------------------------
+
+def test_events_come_back_oldest_first_with_args():
+    for i in range(5):
+        flight_record(basics.FLIGHT_REQUEUE, i, 100 + i)
+    evs = flight_events()
+    assert [e["a0"] for e in evs] == [0, 1, 2, 3, 4]
+    assert [e["a1"] for e in evs] == [100, 101, 102, 103, 104]
+    assert all(e["event"] == "requeue" for e in evs)
+    seqs = [e["seq"] for e in evs]
+    assert seqs == sorted(seqs)
+    ts = [e["t_us"] for e in evs]
+    assert ts == sorted(ts)
+
+
+def test_ring_wraps_keeping_the_newest():
+    n = 4096
+    for i in range(n + 100):
+        flight_record(basics.FLIGHT_REQUEUE, i, 0)
+    evs = flight_events()
+    assert len(evs) <= n
+    # Survivors are the most recent claims, still oldest-first.
+    assert evs[-1]["a0"] == n + 99
+    a0s = [e["a0"] for e in evs]
+    assert a0s == sorted(a0s)
+    assert a0s[0] >= 100   # the first 100 were overwritten
+
+
+def test_clear_empties_and_reuses_the_ring():
+    flight_record(basics.FLIGHT_PEER_DEATH, 3, 0)
+    assert flight_events()
+    flight_clear()
+    assert flight_events() == []
+    flight_record(basics.FLIGHT_REQUEUE, 7, 0)
+    evs = flight_events()
+    assert len(evs) == 1 and evs[0]["a0"] == 7
+
+
+def test_concurrent_writers_lose_nothing():
+    """N threads x M records: every claim lands (count is a fetch_add)
+    and each survivor slot is coherent — the (a0, a1) pair always
+    belongs to one write, never a torn mix."""
+    def w(tag):
+        for i in range(500):
+            flight_record(basics.FLIGHT_REQUEUE, tag, i)
+    ts = [threading.Thread(target=w, args=(t,)) for t in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    evs = flight_events()
+    assert len(evs) == 2000
+    per_tag = {}
+    for e in evs:
+        per_tag.setdefault(e["a0"], []).append(e["a1"])
+    assert set(per_tag) == {0, 1, 2, 3}
+    for tag, vals in per_tag.items():
+        assert sorted(vals) == list(range(500)), tag
+
+
+# ---------------------------------------------------------------------------
+# dump format
+# ---------------------------------------------------------------------------
+
+def test_dump_file_format_and_header_anchor(tmp_path):
+    flight_record(basics.FLIGHT_PEER_DEATH, 2, 0)
+    flight_record(basics.FLIGHT_REQUEUE, 5, 2)
+    path = str(tmp_path / "flight.txt")
+    assert flight_dump(path)
+    text = open(path).read()
+    hdr = _parse_flight_header(text)
+    assert hdr["version"] == 1
+    assert hdr["pid"] == os.getpid()
+    # The mono/wall pair is the re-anchoring contract hvd-trace uses.
+    assert hdr["mono_us"] > 0 and hdr["wall_us"] > hdr["mono_us"]
+    lines = [ln for ln in text.splitlines()
+             if ln and not ln.startswith("#")]
+    assert len(lines) == 2
+    seq, t_us, name, a0, a1 = lines[0].split("\t")
+    assert name == "peer_death" and int(a0) == 2
+    assert lines[1].split("\t")[2] == "requeue"
+
+
+def test_dump_without_dir_or_path_reports_false():
+    """No explicit path and no HOROVOD_FLIGHT_DIR armed at load —
+    flight_dump(None) must refuse, not write somewhere surprising."""
+    if os.environ.get("HOROVOD_FLIGHT_DIR"):
+        pytest.skip("auto-dump armed in this environment")
+    assert flight_dump(None) is False

@@ -641,12 +641,117 @@ def test_migration_metric_pin_discipline_fires(tree):
     assert len(fs) == 1 and fs[0].path == "horovod_tpu/serve/router2.py", fs
 
 
+def _arm_flight(tree, second_name="peer_death"):
+    """ISSUE 20: the clean tree has no flight recorder, so
+    flight-event-pins starts silent; writing flight.h/.cc arms it."""
+    _write(tree, "native/include/hvd/flight.h", """\
+        enum FlightEvent : int {
+          kFlightLockEngage = 0,
+          kFlightPeerDeath,
+          kNumFlightEvents
+        };
+        """)
+    _write(tree, "native/src/flight.cc", f"""\
+        const char* kFlightEventNames[] = {{
+            "lock_engage",
+            "{second_name}",
+        }};
+        """)
+    _write(tree, "docs/observability.md", """\
+        `cycles_total` `shm_ops_total` `cycle_us`
+        `lock_engage` `peer_death`
+        HOROVOD_CYCLE_TIME HOROVOD_COLLECTIVE_ALGO
+        """)
+
+
+def test_injected_flight_name_drift_fires(tree):
+    """A kFlightEventNames entry that disagrees with its enum slot (the
+    exact bug the static_assert can't see — same length, wrong word)
+    fires flight-event-pins; so does a length drift."""
+    _arm_flight(tree)
+    assert run_all(tree, only={"flight-event-pins"}) == []
+    _arm_flight(tree, second_name="peer_dead")  # drifted word
+    fs = run_all(tree, only={"flight-event-pins"})
+    assert any("peer_death" in f.message and "peer_dead" in f.message
+               for f in fs), fs
+    _write(tree, "native/src/flight.cc", """\
+        const char* kFlightEventNames[] = {
+            "lock_engage",
+        };
+        """)
+    fs = run_all(tree, only={"flight-event-pins"})
+    assert any("lockstep" in f.message for f in fs), fs
+
+
+def test_injected_undocumented_flight_event_fires(tree):
+    """Every flight event name must appear in the observability
+    catalog — a dump full of names the docs never define is not a
+    postmortem tool."""
+    _arm_flight(tree)
+    _write(tree, "docs/observability.md", """\
+        `cycles_total` `shm_ops_total` `cycle_us`
+        `lock_engage`
+        HOROVOD_CYCLE_TIME HOROVOD_COLLECTIVE_ALGO
+        """)
+    fs = run_all(tree, only={"flight-event-pins"})
+    assert len(fs) == 1 and "peer_death" in fs[0].message, fs
+
+
+def test_injected_flight_python_pin_drift_fires(tree):
+    """The Python-plane FLIGHT_* indices must agree with the enum
+    positions, and may only be assigned in their basics.py home."""
+    _arm_flight(tree)
+    _write(tree, "horovod_tpu/common/basics.py", """\
+        ABI_VERSION = 6
+        WIRE_VERSION_REQUEST_LIST = 2
+        WIRE_VERSION_RESPONSE_LIST = 5
+        METRICS_VERSION = 1
+        COLLECTIVE_ALGOS = {
+            "auto": 0,
+            "ring": 1,
+        }
+        FLIGHT_PEER_DEATH = 1
+        """)
+    assert run_all(tree, only={"flight-event-pins"}) == []
+    _write(tree, "horovod_tpu/common/basics.py", """\
+        ABI_VERSION = 6
+        WIRE_VERSION_REQUEST_LIST = 2
+        WIRE_VERSION_RESPONSE_LIST = 5
+        METRICS_VERSION = 1
+        COLLECTIVE_ALGOS = {
+            "auto": 0,
+            "ring": 1,
+        }
+        FLIGHT_PEER_DEATH = 0
+        FLIGHT_GHOST_EVENT = 1
+        """)
+    fs = run_all(tree, only={"flight-event-pins"})
+    assert any("FLIGHT_PEER_DEATH = 0" in f.message for f in fs), fs
+    assert any("FLIGHT_GHOST_EVENT" in f.message for f in fs), fs
+    _write(tree, "horovod_tpu/common/basics.py", """\
+        ABI_VERSION = 6
+        WIRE_VERSION_REQUEST_LIST = 2
+        WIRE_VERSION_RESPONSE_LIST = 5
+        METRICS_VERSION = 1
+        COLLECTIVE_ALGOS = {
+            "auto": 0,
+            "ring": 1,
+        }
+        FLIGHT_PEER_DEATH = 1
+        """)
+    _write(tree, "horovod_tpu/serve/router2.py",
+           "FLIGHT_PEER_DEATH = 1\n")
+    fs = run_all(tree, only={"flight-event-pins"})
+    assert len(fs) == 1 and fs[0].path == "horovod_tpu/serve/router2.py", fs
+
+
 def test_every_rule_has_an_injection_test():
     """Meta-guard: adding a rule without an injection test here should
     fail loudly, not pass silently."""
     covered = {"getenv", "knob-docs", "abi-literal", "metric-sync",
                "doc-links", "wire-codec-pins", "algo-name-pins",
-               "moe-metric-pins", "migration-metric-pins"}
+               "moe-metric-pins", "migration-metric-pins",
+               "flight-event-pins"}
     assert covered == set(ALL_RULES), (
         "new lint rule(s) without bug-injection coverage: "
         f"{set(ALL_RULES) - covered}")

@@ -21,6 +21,7 @@
   logic every tier-1 run.
 """
 
+import os
 import socket
 import struct
 import threading
@@ -71,7 +72,8 @@ def test_value_codec_roundtrip_matrix(conn_pair):
         "empty": np.empty((0, 3), np.float32),
         "scalar0d": np.array(7.5, np.float32),
     }
-    args = (None, True, False, 0, -(2 ** 62), 2.5, float("inf"),
+    args = (None, True, False, 0, -(2 ** 62), 2 ** 63 - 1, -(2 ** 63),
+            2 ** 63, 2 ** 64 - 1, 2.5, float("inf"),
             "héllo\tworld", b"\x00raw\nbytes\xff", [1, [2, 3], {}],
             {"k": "v", 7: [b"x"], "nested": {"deep": None}})
     got_args, got_kw = ca.call("echo", *args, **arrs)
@@ -80,6 +82,16 @@ def test_value_codec_roundtrip_matrix(conn_pair):
         got = got_kw[k]
         assert got.dtype == a.dtype and got.shape == a.shape, k
         np.testing.assert_array_equal(np.asarray(got), np.asarray(a))
+
+
+def test_int_wider_than_64_bits_is_a_type_error(conn_pair):
+    """Unbounded Python ints can't ride the wire: the codec refuses
+    loudly at pack time (before any bytes move) instead of crashing
+    the serve thread with a struct error mid-frame."""
+    ca, _ = conn_pair
+    for v in (1 << 64, -(1 << 63) - 1, 1 << 100):
+        with pytest.raises(TypeError, match="wider than 64 bits"):
+            ca.call("echo", v)
 
 
 def test_large_spans_cross_socket_buffers(conn_pair):
@@ -170,6 +182,29 @@ def test_version_mismatch_rejected():
         b.close()
 
 
+def test_version_skew_error_names_both_versions():
+    """ISSUE 20: a v1 peer (pre-trace-id framing — its header has NO
+    trailing trace u64) hitting a v2 side must die on a structured
+    error that names BOTH versions, not a struct.error from eating 8
+    body bytes as a trace id. The version field sits before the v2
+    extension precisely so the check fires first."""
+    a, b = socket.socketpair()
+    try:
+        cb = RpcConn(b)
+        # Authentic v1 frame: <IHH> header + body, no trace_id u64.
+        frame = struct.pack("<IHH", RPC_MAGIC, 1, 0) + struct.pack("<B", 0)
+        a.sendall(struct.pack("<Q", len(frame)) + frame)
+        with pytest.raises(RpcProtocolError) as ei:
+            cb.recv()
+        msg = str(ei.value)
+        assert "v1" in msg and f"v{RPC_PROTOCOL_VERSION}" in msg, msg
+        assert "lockstep" in msg, msg
+        assert not cb.alive
+    finally:
+        a.close()
+        b.close()
+
+
 def test_bad_magic_and_insane_length_rejected():
     from horovod_tpu.serve.rpc import RpcConnectionError
 
@@ -206,8 +241,8 @@ def test_corrupt_codec_span_is_a_protocol_error_not_oob():
         # wire bytes (bf16 needs 2048).
         body = struct.pack("<BBB", 9, 1, 7) + struct.pack("<B", 1) \
             + struct.pack("<q", 1024) + struct.pack("<Q", 100)
-        frame = struct.pack("<IHH", RPC_MAGIC, RPC_PROTOCOL_VERSION,
-                            1) + body
+        frame = struct.pack("<IHHQ", RPC_MAGIC, RPC_PROTOCOL_VERSION,
+                            1, 0) + body
         a.sendall(struct.pack("<Q", len(frame)) + frame + b"x" * 100)
         with pytest.raises(RpcProtocolError, match="wire bytes"):
             cb.recv()
@@ -743,6 +778,89 @@ def test_router_scrape_spans_worker_processes(served_model):
         router.close()
 
 
+def test_fleet_trace_ids_propagate_and_merge(served_model, tmp_path):
+    """ISSUE 20 (in-thread tier): one request's router-side spans and
+    its worker-side engine spans share ONE trace id, the fleet export
+    + merge puts them on one timebase, and the critical-path
+    decomposition partitions the e2e window exactly."""
+    from horovod_tpu.serve import trace_merge
+
+    router, _workers = _mk_remote_router(served_model, 2)
+    try:
+        prompts = _prompts(n_per_tenant=2)
+        rids = [router.submit(p, 4) for p in prompts]
+        router.run_until_idle()
+        assert all(router.result(x).status == "ok" for x in rids)
+        tdir = str(tmp_path / "traces")
+        paths = router.export_fleet_trace(tdir)
+        assert len(paths) == 3 and paths[0].endswith("router.json")
+        merged = trace_merge.merge(trace_merge.discover(tdir))
+        evs = merged["traceEvents"]
+        tids = trace_merge.trace_ids(evs)
+        # Default sampling traces every request, each with its own id.
+        assert len(tids) == len(rids) and len(set(tids)) == len(rids)
+        per_pid_names = {}
+        for tid in tids:
+            row = trace_merge.critical_path(evs, tid)
+            b = row["breakdown_us"]
+            # Exact partition: the rows sum to e2e (ISSUE acceptance
+            # asks within 5%; the interval construction gives 0%).
+            assert sum(b.values()) == pytest.approx(row["e2e_us"],
+                                                    abs=0.5)
+            assert b["prefill"] > 0, (tid, b)
+            carriers = [e for e in evs if trace_merge._carries(e, tid)]
+            names = {e["name"] for e in carriers}
+            assert {"router:submit", "router:queue_wait",
+                    "router:e2e"} <= names, names
+            assert "serve:prefill" in names and "serve:decode" in names
+            for e in carriers:
+                per_pid_names.setdefault(e["pid"], set()).add(e["name"])
+        # The id really spans PROCESS-SEPARATED files: router spans and
+        # engine spans live under different merged pids.
+        router_pids = {p for p, ns in per_pid_names.items()
+                       if "router:e2e" in ns}
+        engine_pids = {p for p, ns in per_pid_names.items()
+                       if "serve:prefill" in ns}
+        assert router_pids and engine_pids and not (router_pids
+                                                    & engine_pids)
+        # Worker-side ids are a subset of what the router minted —
+        # nobody invents trace ids.
+        minted = set(tids)
+        for e in evs:
+            args = e.get("args") or {}
+            for t in [args.get("trace"), *(args.get("traces") or ())]:
+                assert t is None or t in minted, e
+        # Offsets were estimated and exported for the remote side.
+        import json as _json
+        for p in paths[1:]:
+            md = _json.load(open(p))["metadata"]
+            assert md["kind"] == "engine"
+            assert md["clock_rtt"] is not None
+            assert abs(md["clock_offset"]) < 5.0   # same host, same epoch
+    finally:
+        router.close()
+
+
+def test_trace_sampling_off_tags_nothing(served_model, monkeypatch):
+    """HOROVOD_TRACE_SAMPLE=0: no ids minted, no span args tagged —
+    the zero-cost configuration really is zero-identity."""
+    monkeypatch.setenv("HOROVOD_TRACE_SAMPLE", "0")
+    router, _workers = _mk_remote_router(served_model, 2)
+    try:
+        rids = [router.submit(p, 4) for p in _prompts(n_per_tenant=1)]
+        router.run_until_idle()
+        assert all(router.result(x).status == "ok" for x in rids)
+        for e in router.trace.events:
+            assert "trace" not in (e.get("args") or {}), e
+        for rep in router._replicas:
+            d = rep.engine.export_trace()
+            for e in d["events"]:
+                args = e.get("args") or {}
+                assert not args.get("trace") and not args.get("traces")
+    finally:
+        router.close()
+
+
 # ---------------------------------------------------------------------------
 # Cross-process tier (slow): real worker processes
 # ---------------------------------------------------------------------------
@@ -841,6 +959,82 @@ def test_cross_process_fleet_parity_drain_and_kill(served_model):
     finally:
         for w in workers:
             w.kill()
+
+
+@pytest.mark.slow  # 2 worker processes x (jax import + tiny compile);
+# the in-thread trace test above pins the identical id/offset plumbing
+# tier-1 — this is the ISSUE 20 end-to-end acceptance gate.
+def test_cross_process_trace_merge_and_flight_postmortem(
+        served_model, tmp_path, monkeypatch):
+    """Acceptance (ISSUE 20): over a REAL 2-worker cross-process fleet
+    with a mid-run SIGKILL, one ``export_fleet_trace`` + merge yields a
+    single timeline where a request's router and worker spans share
+    one trace id on one timebase with an exactly-summing critical
+    path, and the surviving router's flight dump ends with the
+    peer-death and requeue records that explain the failover."""
+    import shutil
+
+    from horovod_tpu.common import basics as _basics
+    from horovod_tpu.metrics import flight_clear
+    from horovod_tpu.serve import trace_merge
+    from horovod_tpu.serve.rpc import spawn_worker
+
+    cfg, _params = served_model
+    fdir = tmp_path / "flight"
+    fdir.mkdir()
+    # Arm the auto-dump path as library load would have with the env
+    # set; the router's death path keys off the env var.
+    assert _basics.get_lib().hvd_flight_install(str(fdir).encode()) == 0
+    monkeypatch.setenv("HOROVOD_FLIGHT_DIR", str(fdir))
+    flight_clear()
+
+    workers = [spawn_worker() for _ in range(2)]
+    tdir = str(tmp_path / "traces")
+    try:
+        router = ServeRouter(cfg, None, RouterConfig(n_replicas=2),
+                             ServeConfig(**_KW), workers=workers,
+                             worker_seed=0)
+        rids = [router.submit(p, 4) for p in _prompts(n_per_tenant=2)]
+        router.step()
+        workers[1].kill()            # hard death, no goodbye
+        router.run_until_idle()
+        res = [router.result(x) for x in rids]
+        assert all(x is not None and x.status == "ok" for x in res)
+        snap = router.metrics.snapshot()
+        assert snap["worker_deaths"] == 1
+        router.export_fleet_trace(tdir)
+        router.close()
+    finally:
+        for w in workers:
+            w.kill()
+
+    # The postmortem dump survives in HOROVOD_FLIGHT_DIR and its last
+    # events record what the fleet did about the kill.
+    dump = fdir / f"flight-{os.getpid()}.txt"
+    assert dump.exists(), list(fdir.iterdir())
+    names = [ln.split("\t")[2] for ln in
+             dump.read_text().splitlines()[1:] if "\t" in ln]
+    assert "peer_death" in names and "requeue" in names, names
+
+    # One merge over traces + dump: single timebase, shared ids.
+    shutil.copy(str(dump), tdir)
+    merged = trace_merge.merge(trace_merge.discover(tdir))
+    evs = merged["traceEvents"]
+    assert any(e["name"] == "flight:peer_death" for e in evs)
+    tids = trace_merge.trace_ids(evs)
+    assert len(tids) == len(rids)
+    spanned = 0
+    for tid in tids:
+        row = trace_merge.critical_path(evs, tid)
+        b = row["breakdown_us"]
+        assert sum(b.values()) == pytest.approx(row["e2e_us"], abs=0.5)
+        names = {e["name"] for e in evs if trace_merge._carries(e, tid)}
+        if {"router:e2e", "serve:prefill", "serve:decode"} <= names \
+                and b["prefill"] > 0:
+            spanned += 1
+    # The killed worker took its un-exported spans with it; every
+    # request that finished on the survivor still stitches end to end.
+    assert spanned >= 1, tids
 
 
 # ---------------- direct KV-page migration (ISSUE 19) ----------------

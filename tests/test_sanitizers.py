@@ -63,6 +63,12 @@ SCENARIOS = [
     ("wire_ring", 4, {"HOROVOD_SHM_DISABLE": "1"}),
     ("metrics", 2, {}),
     ("stall", 2, {"HOROVOD_STALL_CHECK_TIME_SECONDS": "0.5"}),
+    # Flight recorder (ISSUE 20): Python writer threads race a
+    # snapshot reader and a file dumper over the seqlock-lite ring
+    # while allreduce traffic feeds it natively — the claim/publish
+    # slot protocol and the reader's skip-on-mismatch run under the
+    # sanitizer.
+    ("flight_churn", 2, {}),
     # Schedule interpreter (ISSUE 7): per-step receiver-thread waves +
     # the encoded-chunk cache, across hd/striped/doubling and every
     # codec, at the ragged np that exercises fold/unfold.

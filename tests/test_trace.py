@@ -1,0 +1,354 @@
+"""Distributed tracing + trace merge (ISSUE 20), jax-free tier:
+trace-id minting and sampling, the router-side recorder, the heartbeat
+clock-offset estimator's re-anchoring discipline (skewed
+``perf_counter`` epochs must merge in router-clock order, and an
+offset must survive a heartbeat gap), the ``trace_merge`` timebase
+math, the exact-partition critical path, straggler attribution, and
+the ``bin/hvd-trace`` CLI over a synthetic fleet directory. The live
+fleet integration (real spans through real RPC) rides in
+``test_rpc.py`` where the in-thread fleet already lives.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import warnings
+
+import pytest
+
+import horovod_tpu.serve.trace as trace_mod
+from horovod_tpu.serve import trace_merge
+from horovod_tpu.serve.trace import RouterTrace, mint_trace_id
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLI = os.path.join(ROOT, "bin", "hvd-trace")
+
+
+# ---------------------------------------------------------------------------
+# minting + sampling
+# ---------------------------------------------------------------------------
+
+def test_mint_is_deterministic_and_never_zero():
+    ids = [mint_trace_id(rid, salt=7, sample=1.0) for rid in range(200)]
+    assert ids == [mint_trace_id(r, salt=7, sample=1.0) for r in range(200)]
+    assert all(i != 0 for i in ids)
+    assert len(set(ids)) == 200          # 64-bit ids don't collide here
+    assert ids[0] != mint_trace_id(0, salt=8, sample=1.0)   # salt matters
+
+
+def test_sampling_is_deterministic_by_rid():
+    """rate p traces a fixed, replayable subset; 0 disables minting."""
+    assert all(mint_trace_id(r, sample=0.0) == 0 for r in range(50))
+    picked = [r for r in range(2000)
+              if mint_trace_id(r, salt=3, sample=0.1)]
+    assert picked == [r for r in range(2000)
+                      if mint_trace_id(r, salt=3, sample=0.1)]
+    assert 50 < len(picked) < 400        # ~200 expected
+    # A sampled-in request gets the SAME id it would at rate 1.
+    for r in picked[:10]:
+        assert mint_trace_id(r, salt=3, sample=0.1) \
+            == mint_trace_id(r, salt=3, sample=1.0)
+
+
+def test_sample_env_is_lenient(monkeypatch):
+    monkeypatch.delenv(trace_mod.TRACE_SAMPLE_ENV, raising=False)
+    assert trace_mod.trace_sample_rate() == 1.0
+    monkeypatch.setenv(trace_mod.TRACE_SAMPLE_ENV, "0.25")
+    assert trace_mod.trace_sample_rate() == 0.25
+    monkeypatch.setenv(trace_mod.TRACE_SAMPLE_ENV, "7")
+    assert trace_mod.trace_sample_rate() == 1.0      # clamps
+    monkeypatch.setenv(trace_mod.TRACE_SAMPLE_ENV, "-2")
+    assert trace_mod.trace_sample_rate() == 0.0
+    monkeypatch.setenv(trace_mod.TRACE_SAMPLE_ENV, "lots")
+    monkeypatch.setattr(trace_mod, "_warned_bad_sample", False)
+    with pytest.warns(UserWarning, match="HOROVOD_TRACE_SAMPLE"):
+        assert trace_mod.trace_sample_rate() == 1.0
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")   # warns ONCE, not per call
+        assert trace_mod.trace_sample_rate() == 1.0
+
+
+# ---------------------------------------------------------------------------
+# the router recorder
+# ---------------------------------------------------------------------------
+
+def test_router_trace_spans_and_export(tmp_path):
+    t = [100.0]
+    tr = RouterTrace(clock=lambda: t[0])
+    t[0] = 101.0
+    tr.span("router:queue_wait", 100.5, 0.25, trace=42, rid=1)
+    tr.span("router:e2e", 100.5, 0.5, trace=0, rid=1)   # unsampled
+    tr.instant("router:submit", trace=42, rid=1)
+    evs = tr.events
+    assert evs[0]["ts"] == 0.5e6 and evs[0]["dur"] == 0.25e6
+    assert evs[0]["args"]["trace"] == 42
+    assert "trace" not in evs[1]["args"]     # id 0 never tagged
+    assert evs[2]["ph"] == "i" and evs[2]["ts"] == 1e6
+    p = str(tmp_path / "router.json")
+    tr.export(p, fleet="f0")
+    d = json.load(open(p))
+    md = d["metadata"]
+    assert md["kind"] == "router" and md["fleet"] == "f0"
+    assert md["started_at"] == 100.0 and md["clock_now"] == 101.0
+    assert md["clock_offset"] == 0.0 and md["wall_now"] > 0
+    assert len(d["traceEvents"]) == 3
+
+
+def test_router_trace_caps_events():
+    tr = RouterTrace(clock=lambda: 0.0)
+    trace_mod.MAX_TRACE_EVENTS, saved = 10, trace_mod.MAX_TRACE_EVENTS
+    try:
+        for i in range(50):
+            tr.instant("x", t=0.0)
+        assert len(tr.events) == 10
+    finally:
+        trace_mod.MAX_TRACE_EVENTS = saved
+
+
+# ---------------------------------------------------------------------------
+# clock-offset estimation (satellite: re-anchoring discipline)
+# ---------------------------------------------------------------------------
+
+class _Stub:
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+    def update(self, *a, **k):
+        pass
+
+
+def _bare_replica(clock):
+    """A RemoteReplica with just the state _absorb_beat touches — the
+    offset estimator under test, minus the fleet."""
+    from horovod_tpu.serve.rpc import RemoteReplica
+    rep = RemoteReplica.__new__(RemoteReplica)
+    rep._clock = clock
+    rep._pending = False
+    rep.allocator = _Stub(_free=0)
+    rep.metrics = _Stub()
+    rep._results = {}
+    rep.last_beat = -float("inf")
+    rep.clock_offset = 0.0
+    rep.clock_rtt = float("inf")
+    return rep
+
+
+def _beat(now=None, **kw):
+    return {"pending": False, "kv_blocks_free": 4, "snap": {},
+            "ft": [], "pt": [], "results": {}, "now": now, **kw}
+
+
+def test_offset_takes_rtt_midpoint_and_min_rtt_wins():
+    rep = _bare_replica(lambda: 0.0)
+    # Worker clock = router clock + 500s; symmetric 10ms round trip.
+    rep._absorb_beat(_beat(now=1000.005 + 500.0), t0=1000.0, t1=1000.010)
+    assert rep.clock_rtt == pytest.approx(0.010)
+    assert rep.clock_offset == pytest.approx(500.0)
+    # A slower, skewed sample must NOT displace the sharper one.
+    rep._absorb_beat(_beat(now=2000.190 + 500.0), t0=2000.0, t1=2000.200)
+    assert rep.clock_rtt == pytest.approx(0.010)
+    assert rep.clock_offset == pytest.approx(500.0)
+    # A sharper one does.
+    rep._absorb_beat(_beat(now=3000.001 + 500.0), t0=3000.0, t1=3000.002)
+    assert rep.clock_rtt == pytest.approx(0.002)
+    assert rep.clock_offset == pytest.approx(500.0, abs=1e-6)
+
+
+def test_offset_survives_heartbeat_gap():
+    """Beats absorbed off step replies (no caller bracket — the reply
+    time includes worker compute) must never touch the offset: a busy
+    replica that hasn't idle-heartbeated in minutes keeps the estimate
+    from its last bracketed round trip."""
+    rep = _bare_replica(lambda: 0.0)
+    rep._absorb_beat(_beat(now=600.0), t0=99.995, t1=100.005)
+    want = 600.0 - 100.0
+    assert rep.clock_offset == pytest.approx(want)
+    for k in range(50):                      # a long unbracketed gap
+        rep._absorb_beat(_beat(now=9999.0 + k))
+    rep._absorb_beat(_beat(now=None), t0=1.0, t1=2.0)   # pre-v2 worker
+    assert rep.clock_offset == pytest.approx(want)
+    assert rep.clock_rtt == pytest.approx(0.010)
+
+
+# ---------------------------------------------------------------------------
+# merge timebase
+# ---------------------------------------------------------------------------
+
+def _mk_fleet_dir(tmp_path):
+    """A synthetic 1-router + 1-replica fleet with WILDLY skewed
+    perf_counter epochs, plus a flight dump and an unanchored streamed
+    host timeline. True router-clock times: router span at t=1001,
+    replica span at t=1002 (offset 2,000,000s), flight event at
+    t=1001.5."""
+    d = tmp_path / "traces"
+    d.mkdir()
+    router = {
+        "traceEvents": [
+            {"name": "router:e2e", "ph": "X", "pid": 0, "tid": 0,
+             "ts": 1.0e6, "dur": 0.5e6, "args": {"trace": 42, "rid": 1}},
+        ],
+        "metadata": {"kind": "router", "pid": 10, "started_at": 1000.0,
+                     "clock_now": 1010.0, "wall_now": 5000.0,
+                     "clock_offset": 0.0},
+    }
+    (d / "router.json").write_text(json.dumps(router))
+    replica = {
+        "traceEvents": [
+            {"name": "serve:prefill", "ph": "X", "pid": 0, "tid": 0,
+             "ts": 502.0e6, "dur": 0.1e6, "args": {"trace": 42}},
+        ],
+        # Own epoch ~2M seconds ahead; own wall clock also disagrees —
+        # the ROUTER pair must win.
+        "metadata": {"kind": "engine", "instance": "0", "pid": 11,
+                     "started_at": 2000500.0, "clock_now": 2000600.0,
+                     "wall_now": 123.0, "clock_offset": 2000000.0},
+    }
+    (d / "replica-0.json").write_text(json.dumps(replica))
+    (d / "flight-11.txt").write_text(
+        "# flight v1 pid=11 mono_us=7000000 wall_us=4991500000\n"
+        "0\t7000100\tpeer_death\t1\t0\n"
+        "1\t7000200\trequeue\t3\t1\n")
+    # Streamed native-timeline form: trailing comma, never terminated.
+    (d / "timeline.json").write_text(
+        '[\n{"name": "process_name", "ph": "M", "pid": 9, '
+        '"args": {"name": "rank 0"}},\n'
+        '{"name": "NEGOTIATE_ALLREDUCE", "ph": "B", "pid": 9, '
+        '"tid": 1, "ts": 50},\n'
+        '{"name": "", "ph": "E", "pid": 9, "tid": 1, "ts": 450},\n')
+    return str(d)
+
+
+def test_merge_puts_skewed_epochs_in_router_clock_order(tmp_path):
+    d = _mk_fleet_dir(tmp_path)
+    paths = trace_merge.discover(d)
+    assert os.path.basename(paths[0]) == "router.json"
+    merged = trace_merge.merge(paths)
+    assert merged["metadata"]["timebase"].startswith("router wall")
+    evs = merged["traceEvents"]
+    by = {e["name"]: e for e in evs if e.get("ph") != "M"}
+    # Router t=1001 is the earliest anchored instant -> ts 0; the
+    # replica span lands 1s later DESPITE its 2M-second epoch skew and
+    # bogus own wall clock; the flight events sit in between.
+    assert by["router:e2e"]["ts"] == pytest.approx(0.0, abs=0.2)
+    assert by["serve:prefill"]["ts"] == pytest.approx(1.0e6, abs=1.0)
+    assert by["flight:peer_death"]["ts"] == pytest.approx(0.5e6 + 100,
+                                                          abs=1.0)
+    assert by["flight:requeue"]["ts"] == pytest.approx(0.5e6 + 200,
+                                                       abs=1.0)
+    # Every source got its own pid + a process_name label; the
+    # unanchored timeline is flagged and left on its own timebase.
+    labels = {e["args"]["name"] for e in evs if e.get("ph") == "M"}
+    assert any("router" in x for x in labels)
+    assert any("replica 0" in x for x in labels)
+    assert any("flight 11" in x for x in labels)
+    assert any("[unanchored timebase]" in x for x in labels)
+    assert by["NEGOTIATE_ALLREDUCE"]["ts"] == 50   # untouched
+
+
+def test_merge_without_router_uses_own_anchor(tmp_path):
+    d = tmp_path / "t"
+    d.mkdir()
+    f = {
+        "traceEvents": [{"name": "serve:decode", "ph": "X", "pid": 0,
+                         "tid": 0, "ts": 2.0e6, "dur": 1.0e5,
+                         "args": {}}],
+        "metadata": {"kind": "engine", "instance": "3",
+                     "started_at": 50.0, "clock_now": 60.0,
+                     "wall_now": 7000.0, "clock_offset": 0.0},
+    }
+    (d / "replica-3.json").write_text(json.dumps(f))
+    merged = trace_merge.merge(trace_merge.discover(str(d)))
+    assert merged["metadata"]["timebase"].startswith("per-file")
+    (ev,) = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    assert ev["ts"] == 0.0   # normalized against itself
+    assert merged["metadata"]["t0_wall_us"] == pytest.approx(
+        (7000.0 + (50.0 + 2.0 - 60.0)) * 1e6)
+
+
+# ---------------------------------------------------------------------------
+# critical path: exact partition
+# ---------------------------------------------------------------------------
+
+def _span(name, ts, dur, **args):
+    return {"name": name, "ph": "X", "pid": 0, "tid": 0,
+            "ts": float(ts), "dur": float(dur), "args": args}
+
+
+def test_critical_path_rows_sum_exactly_to_e2e():
+    evs = [
+        _span("router:e2e", 0, 1000, trace=42, rid=1),
+        _span("router:queue_wait", 0, 200, trace=42),
+        _span("rpc:submit", 150, 100, trace=42),       # overlaps queue
+        _span("serve:prefill", 250, 300, trace=42),
+        _span("router:handoff", 540, 40, trace=42),    # overlaps prefill
+        _span("serve:decode", 600, 300, traces=[42, 77]),
+        _span("serve:decode", 0, 1000, traces=[77]),   # other trace
+        _span("serve:prefill", 900, 5000, trace=42),   # clips at 1000
+    ]
+    row = trace_merge.critical_path(evs, 42)
+    b = row["breakdown_us"]
+    assert row["e2e_us"] == 1000.0 and row["rid"] == 1
+    assert b["queue_wait"] == 150.0      # rpc_wire outranks its tail
+    assert b["rpc_wire"] == 100.0
+    assert b["prefill"] == 300.0 + 100.0  # incl. the clipped tail span
+    assert b["handoff"] == 30.0          # prefill outranks the overlap
+    assert b["decode"] == 300.0
+    assert b["wait"] == 20.0             # 580..600; 900..1000 is prefill
+    assert sum(b.values()) == pytest.approx(row["e2e_us"], abs=1e-9)
+
+
+def test_critical_path_unknown_trace_raises():
+    with pytest.raises(KeyError):
+        trace_merge.critical_path([_span("router:e2e", 0, 10, trace=1)], 2)
+
+
+def test_trace_ids_in_end_order():
+    evs = [_span("router:e2e", 5, 10, trace=9),
+           _span("router:e2e", 0, 3, trace=4),
+           _span("router:e2e", 1, 1)]          # unsampled: skipped
+    assert trace_merge.trace_ids(evs) == [9, 4]
+
+
+# ---------------------------------------------------------------------------
+# straggler attribution
+# ---------------------------------------------------------------------------
+
+def test_straggler_is_the_least_barrier_wait():
+    evs = [
+        _span("shm_barrier", 0, 900) | {"pid": 1},
+        _span("shm_barrier", 0, 100) | {"pid": 2},   # the straggler
+        {"name": "NEGOTIATE_ALLREDUCE", "ph": "B", "pid": 3, "tid": 0,
+         "ts": 0.0},
+        {"name": "", "ph": "E", "pid": 3, "tid": 0, "ts": 800.0},
+        _span("serve:decode", 0, 5000) | {"pid": 2},  # not a barrier
+    ]
+    rows = trace_merge.straggler_summary(evs)
+    assert [r["pid"] for r in rows] == [2, 3, 1]
+    assert rows[0]["barrier_wait_us"] == 100.0
+    assert rows[1]["barrier_wait_us"] == 800.0
+
+
+# ---------------------------------------------------------------------------
+# the CLI
+# ---------------------------------------------------------------------------
+
+def test_cli_merge_critical_path_and_straggler(tmp_path):
+    d = _mk_fleet_dir(tmp_path)
+    out = str(tmp_path / "fleet.json")
+    r = subprocess.run([sys.executable, CLI, "merge", d, "-o", out,
+                        "--critical-path"],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert "merged 4 file(s)" in r.stdout, r.stdout
+    assert f"{42:#016x}" in r.stdout     # the critical-path table row
+    d2 = json.load(open(out))
+    assert d2["metadata"]["timebase"].startswith("router wall")
+    r2 = subprocess.run([sys.executable, CLI, "straggler", d],
+                        capture_output=True, text=True)
+    assert r2.returncode == 0, r2.stderr
+    assert "suspected straggler: pid" in r2.stdout
+    r3 = subprocess.run([sys.executable, CLI, "merge",
+                         str(tmp_path / "empty"), "-o", out],
+                        capture_output=True, text=True)
+    assert r3.returncode == 1

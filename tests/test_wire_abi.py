@@ -36,9 +36,21 @@ def test_issue18_version_bumps_landed():
     metrics v9 (alltoall_measured_selects_total shifts later counter
     ids). The relative checks above catch a one-sided bump; this pins
     the absolute values so a stray revert of BOTH sides is caught
-    too."""
+    too. (The ABI absolute moved to the ISSUE 20 pin below when the
+    flight-recorder surface bumped it past 14.)"""
     assert basics.WIRE_VERSION_RESPONSE_LIST == 7
-    assert basics.ABI_VERSION == 14
+    assert basics.METRICS_VERSION == 9
+
+
+def test_issue20_version_bumps_landed():
+    """ISSUE 20 lockstep pins: ABI v15 (the hvd_flight_* recorder
+    surface: record/snapshot/dump/install/clear/enable plus the
+    event-name table accessors). Wire formats and the metrics
+    registry are untouched — the trace id rides the RPC v2 frame
+    header (a Python-plane protocol, versioned separately as
+    ``rpc.RPC_PROTOCOL_VERSION``), not the native wire."""
+    assert basics.ABI_VERSION == 15
+    assert basics.WIRE_VERSION_RESPONSE_LIST == 7
     assert basics.METRICS_VERSION == 9
 
 

@@ -605,6 +605,104 @@ def rule_migration_metric_pins(root: str) -> List[Finding]:
     return out
 
 
+# -------------------------------------------------------- flight-event-pins
+
+# The flight recorder's event identity is spread over three files by
+# necessity (native enum, native name table, operator catalog) plus the
+# Python constants that record events from the serve plane; the
+# static_assert in flight.cc pins only the lengths — this rule pins the
+# NAMES and the Python indices.
+_FLIGHT_H = "native/include/hvd/flight.h"
+_FLIGHT_CC = "native/src/flight.cc"
+_FLIGHT_PY = "horovod_tpu/common/basics.py"
+_FLIGHT_PY_RE = re.compile(r"^\s*(FLIGHT_[A-Z0-9_]+)\s*=\s*(\d+)\b",
+                           re.MULTILINE)
+
+
+def _flight_snake(ident: str) -> str:
+    """kFlightLockEngage -> lock_engage (the name-table convention)."""
+    body = ident[len("kFlight"):]
+    return re.sub(r"(?<!^)(?=[A-Z])", "_", body).lower()
+
+
+def rule_flight_event_pins(root: str) -> List[Finding]:
+    """The FlightEvent enum (flight.h), the kFlightEventNames table
+    (flight.cc), the docs/observability.md flight catalog, and the
+    FLIGHT_* Python indices (common/basics.py) name the same events in
+    the same order. A drifted name means a postmortem dump lies about
+    what happened; a drifted Python index means the serve plane records
+    one event while believing it recorded another."""
+    out: List[Finding] = []
+    try:
+        h = _read(root, _FLIGHT_H)
+        cc = _read(root, _FLIGHT_CC)
+    except FileNotFoundError:
+        return []      # trees without the flight recorder: nothing to pin
+    idents = _enum_idents(h, "FlightEvent", "kNumFlightEvents")
+    names = _name_table(cc, "kFlightEventNames")
+    if len(idents) != len(names):
+        out.append(Finding(
+            "flight-event-pins", _FLIGHT_CC, 0,
+            f"kFlightEventNames has {len(names)} entries but enum "
+            f"FlightEvent has {len(idents)} — the tables must stay in "
+            "lockstep"))
+    for i, (ident, name) in enumerate(zip(idents, names)):
+        if _flight_snake(ident) != name:
+            out.append(Finding(
+                "flight-event-pins", _FLIGHT_CC, 0,
+                f"kFlightEventNames[{i}] is {name!r} but the enum slot "
+                f"is {ident} (expected {_flight_snake(ident)!r}) — "
+                "name and enum order must agree"))
+    for d in sorted({n for n in names if names.count(n) > 1}):
+        out.append(Finding(
+            "flight-event-pins", _FLIGHT_CC, 0,
+            f"duplicate flight event name {d!r} in kFlightEventNames"))
+    doc_path = os.path.join(root, _METRICS_DOC)
+    doc_toks = (_doc_metric_tokens(_read(root, _METRICS_DOC))
+                if os.path.exists(doc_path) else set())
+    for n in names:
+        if doc_toks and n not in doc_toks:
+            out.append(Finding(
+                "flight-event-pins", _METRICS_DOC, 0,
+                f"flight event {n!r} (kFlightEventNames) missing from "
+                "the observability flight-recorder catalog"))
+    # Python-plane indices: FLIGHT_PEER_DEATH = 6 must point at the
+    # enum slot whose snake name is peer_death.
+    try:
+        py = _read(root, _FLIGHT_PY)
+    except FileNotFoundError:
+        return out
+    by_name = {n: i for i, n in enumerate(names)}
+    for m in _FLIGHT_PY_RE.finditer(py):
+        const, val = m.group(1), int(m.group(2))
+        snake = const[len("FLIGHT_"):].lower()
+        if snake not in by_name:
+            out.append(Finding(
+                "flight-event-pins", _FLIGHT_PY, 0,
+                f"{const} names no flight event (no {snake!r} in "
+                "kFlightEventNames)"))
+        elif by_name[snake] != val:
+            out.append(Finding(
+                "flight-event-pins", _FLIGHT_PY, 0,
+                f"{const} = {val} but {snake!r} is enum slot "
+                f"{by_name[snake]} — the recorded event id would lie"))
+    # Single definition site for the indices: a second FLIGHT_* pin
+    # elsewhere is how two planes 'agree' on ids that aren't.
+    for subdir in ("horovod_tpu", "bin", "examples"):
+        if not os.path.isdir(os.path.join(root, subdir)):
+            continue
+        for rel in _walk(root, subdir, {".py"}):
+            if rel == _FLIGHT_PY:
+                continue
+            for i, ln in enumerate(_read(root, rel).splitlines(), 1):
+                if re.match(r"^\s*FLIGHT_[A-Z0-9_]+\s*=\s*\d+\b", ln):
+                    out.append(Finding(
+                        "flight-event-pins", rel, i,
+                        f"FLIGHT_* index assigned outside its home "
+                        f"{_FLIGHT_PY} — import the pin instead"))
+    return out
+
+
 # -------------------------------------------------------------- doc-links
 
 _MD_LINK_RE = re.compile(r"\[[^\]]*\]\(([^)\s]+)\)")
@@ -644,6 +742,7 @@ ALL_RULES: Dict[str, Callable[[str], List[Finding]]] = {
     "metric-sync": rule_metric_sync,
     "moe-metric-pins": rule_moe_metric_pins,
     "migration-metric-pins": rule_migration_metric_pins,
+    "flight-event-pins": rule_flight_event_pins,
     "doc-links": rule_doc_links,
 }
 
